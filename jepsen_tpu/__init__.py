@@ -1,0 +1,20 @@
+"""jepsen_tpu — a TPU-native distributed-systems testing framework.
+
+A brand-new framework with the capability surface of Jepsen (reference:
+/root/reference): a control plane that installs databases on cluster nodes,
+drives concurrent client operations from a pure-functional generator, injects
+faults through a nemesis, records a complete invocation/completion history,
+and then decides the system's consistency claims by analysing that history.
+
+The defining difference from the reference is the analysis engine:
+linearizability checking (the reference delegates to the external `knossos`
+library, jepsen/src/jepsen/checker.clj:185-216) is implemented here as a
+JAX/XLA search — model step functions are pure jax.numpy transitions,
+candidate linearization frontiers are fixed-shape device buffers expanded by
+vmapped steps and deduplicated with sort kernels, and frontiers shard across
+a TPU mesh via shard_map.
+"""
+
+__version__ = "0.1.0"
+
+from jepsen_tpu.history import Op, History  # noqa: F401

@@ -1,0 +1,140 @@
+"""History preprocessing for linearizability engines.
+
+Turns a raw history into the event stream both engines (CPU oracle and TPU
+search) consume, and computes the *pending-window* slot assignment that is the
+core compression behind the device representation:
+
+    In the configuration-BFS view of linearizability checking (Wing & Gong's
+    search, as refined by Lowe's just-in-time linearization), a configuration
+    is (set of linearized ops, model state).  But every op whose completion
+    event has been processed MUST be linearized in every surviving
+    configuration, and ops not yet invoked CANNOT be — so configurations can
+    only disagree about ops that are *currently pending*.  A configuration
+    therefore compresses to (bitmask over pending-window slots, model state):
+    a handful of int32 lanes, fixed-shape, perfect for vmapped expansion on
+    device.  (See PAPERS.md: P-compositionality's just-in-time linearization;
+    knossos's configurations play the same role on the JVM.)
+
+Rules applied here (knossos parity):
+  - only client ops participate (nemesis ops are stripped);
+  - ``fail`` ops never took effect — invoke+fail pairs are removed outright;
+  - ``info`` ops may take effect at any time from invocation on — they enter
+    the window and never leave (crashed ops, reference behavior at
+    jepsen/src/jepsen/generator/interpreter.clj:142-157);
+  - ``info`` pure-read ops with unknown values are dropped (unconstraining);
+  - ``ok`` ops produce an ENTER event at their invocation index and a RETURN
+    event at their completion index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from jepsen_tpu.history import History, INFO, INVOKE, OK, FAIL, Op
+from jepsen_tpu.models.base import JaxModel, UNKNOWN32
+
+EV_ENTER = 0   # op joins the pending window (its invocation)
+EV_RETURN = 1  # op's ok-completion: must be linearized in every config
+
+
+@dataclass
+class PreparedHistory:
+    """Event-stream view of a history, ready for either engine."""
+
+    # Per-event columns (length E):
+    kind: np.ndarray        # int32, EV_ENTER / EV_RETURN
+    slot: np.ndarray        # int32, pending-window slot of the event's op
+    f: np.ndarray           # int32, model op code (0 if no encoder given)
+    a: np.ndarray           # int32 operand
+    b: np.ndarray           # int32 operand
+    op_id: np.ndarray       # int32, index into ``ops`` (invocation order)
+    # Scalars / host-side:
+    window: int             # number of slots ever needed (max concurrency)
+    ops: List[Op]           # participating ops, invocation order
+    crashed_slots: Tuple[int, ...]  # slots held forever by info ops
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.ops)
+
+    def __len__(self):
+        return len(self.kind)
+
+
+class WindowOverflow(Exception):
+    """History's pending-op concurrency exceeds the engine's window size."""
+
+
+def prepare(history: History,
+            model: Optional[JaxModel] = None,
+            max_window: Optional[int] = None,
+            pure_read_names: Sequence[str] = ("read", "r"),
+            ) -> PreparedHistory:
+    """Build the event stream.  With a :class:`JaxModel`, ops are encoded into
+    the int32 (f, a, b) columns and the model's ``pure_read_fs`` drive
+    crashed-read elimination; without one (host-tier engines), columns are
+    zero and ``pure_read_names`` + a None value identify droppable reads."""
+    h = history.client_ops().complete()
+    pairs = h.pair_index()
+
+    events: List[Tuple[int, int, int, int, int, int]] = []
+    ops: List[Op] = []
+    free: List[int] = []
+    next_slot = 0
+    slot_of: dict = {}      # history position of invoke -> slot
+    opid_of: dict = {}      # history position of invoke -> op_id
+    crashed: List[int] = []
+    pure_fs: Set[int] = set(model.pure_read_fs) if model else set()
+
+    def alloc_slot() -> int:
+        nonlocal next_slot
+        if free:
+            return free.pop()
+        s = next_slot
+        next_slot += 1
+        return s
+
+    for i, op in enumerate(h):
+        if op.type == INVOKE:
+            j = pairs[i]
+            comp = h[j] if j >= 0 else None
+            ctype = comp.type if comp is not None else INFO
+            if ctype == FAIL:
+                continue  # never took effect
+            if model is not None:
+                f, a, b = model.encode_op(op)
+                if ctype == INFO and f in pure_fs and a == UNKNOWN32:
+                    continue  # crashed read, unknown value: unconstraining
+            else:
+                f = a = b = 0
+                if ctype == INFO and op.f in pure_read_names and op.value is None:
+                    continue
+            s = alloc_slot()
+            slot_of[i] = s
+            opid_of[i] = len(ops)
+            events.append((EV_ENTER, s, f, a, b, len(ops)))
+            ops.append(op)
+            if ctype == INFO:
+                crashed.append(s)
+        elif op.type == OK:
+            j = pairs[i]
+            if j in slot_of:
+                s = slot_of[j]
+                events.append((EV_RETURN, s, 0, 0, 0, opid_of[j]))
+                free.append(s)
+        # FAIL completions: pair already skipped. INFO completions: op stays.
+
+    if max_window is not None and next_slot > max_window:
+        raise WindowOverflow(
+            f"history needs {next_slot} pending-window slots "
+            f"(> max {max_window}); raise max_window or shard the history")
+
+    cols = np.array(events, np.int32).reshape(-1, 6)
+    return PreparedHistory(
+        kind=cols[:, 0], slot=cols[:, 1], f=cols[:, 2],
+        a=cols[:, 3], b=cols[:, 4], op_id=cols[:, 5],
+        window=next_slot, ops=ops, crashed_slots=tuple(crashed),
+    )

@@ -1,0 +1,114 @@
+"""Host-tier linearizability engine — the correctness oracle.
+
+A breadth-first configuration search in the style of knossos's WGL solver
+(the reference races knossos.linear / knossos.wgl / knossos.competition at
+jepsen/src/jepsen/checker.clj:185-216).  Configurations are
+(pending-window bitmask, model state) pairs per the compression argument in
+:mod:`jepsen_tpu.checker.prep`; the search:
+
+  - at an ENTER event, adds the op to the pending window (no expansion —
+    linearizing it now or at the next RETURN closure is equivalent);
+  - at a RETURN event for op i, computes the closure of the configuration set
+    under linearizing any pending ops (model permitting), then prunes to
+    configurations that linearized i, then retires i's window bit;
+  - reports not-linearizable with the offending op and the surviving
+    configurations just before pruning (knossos-style final configs).
+
+Works with any host-tier :class:`~jepsen_tpu.models.base.Model` (hashable,
+immutable).  This is also the measured "CPU knossos" baseline for BENCH runs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.base import Inconsistent, Model
+from jepsen_tpu.checker.prep import EV_ENTER, EV_RETURN, PreparedHistory, prepare
+
+Config = Tuple[int, Model]  # (pending-window bitmask, model state)
+
+
+def check(model: Model, history: History,
+          prepared: Optional[PreparedHistory] = None,
+          max_configs: int = 2_000_000) -> Dict[str, Any]:
+    """Decide linearizability of ``history`` against ``model``.
+
+    Returns a knossos-shaped analysis map: ``{"valid": bool, ...}`` with the
+    failing op and a sample of final configurations on refutation."""
+    p = prepared if prepared is not None else prepare(history)
+    window: Dict[int, Op] = {}         # slot -> pending op
+    configs: Set[Config] = {(0, model)}
+    n_explored = 0
+
+    for e in range(len(p)):
+        kind, slot, op_id = int(p.kind[e]), int(p.slot[e]), int(p.op_id[e])
+        if kind == EV_ENTER:
+            window[slot] = p.ops[op_id]
+            continue
+        # RETURN: expand closure, then prune on the returning op's bit.
+        configs = _closure(configs, window, max_configs)
+        n_explored += len(configs)
+        bit = 1 << slot
+        survivors = {(mask & ~bit, m) for (mask, m) in configs if mask & bit}
+        if not survivors:
+            return {
+                "valid": False,
+                "analyzer": "wgl-cpu",
+                "op": p.ops[op_id].to_dict(),
+                "previous-ok": True,
+                "final-configs": _render_configs(configs, window, limit=10),
+                "pending": [o.to_dict() for o in window.values()],
+                "configs-explored": n_explored,
+            }
+        del window[slot]
+        configs = survivors
+
+    # Any surviving configuration witnesses a legal linearization: info ops
+    # still pending are optional, and every ok op was pruned on at a RETURN.
+    return {"valid": True, "analyzer": "wgl-cpu",
+            "configs-explored": n_explored,
+            "final-configs-count": len(configs)}
+
+
+def _closure(configs: Set[Config], window: Dict[int, Op],
+             max_configs: int) -> Set[Config]:
+    seen = set(configs)
+    frontier = configs
+    while frontier:
+        new: Set[Config] = set()
+        for mask, m in frontier:
+            for slot, op in window.items():
+                bit = 1 << slot
+                if mask & bit:
+                    continue
+                m2 = m.step(op)
+                if isinstance(m2, Inconsistent):
+                    continue
+                c2 = (mask | bit, m2)
+                if c2 not in seen:
+                    seen.add(c2)
+                    new.add(c2)
+                    if len(seen) > max_configs:
+                        raise SearchExploded(len(seen))
+        frontier = new
+    return seen
+
+
+class SearchExploded(Exception):
+    """Configuration set exceeded the budget; verdict is unknown."""
+
+    def __init__(self, n):
+        super().__init__(f"configuration set exceeded budget at {n}")
+        self.n = n
+
+
+def _render_configs(configs: Set[Config], window: Dict[int, Op], limit: int):
+    out = []
+    for mask, m in list(configs)[:limit]:
+        out.append({
+            "model": repr(m),
+            "linearized-pending": [window[s].to_dict() for s in window
+                                   if mask & (1 << s)],
+        })
+    return out

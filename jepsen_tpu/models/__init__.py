@@ -1,0 +1,20 @@
+"""Sequential datatype models for linearizability checking.
+
+Host-tier models (:class:`~jepsen_tpu.models.base.Model`) are arbitrary
+immutable Python objects; device-tier models
+(:class:`~jepsen_tpu.models.base.JaxModel`) are pure int32 state machines the
+TPU engine vmaps over configuration frontiers.  ``get_model(name)`` looks up
+registered device-tier models by the same names the reference's suites use
+for knossos models.
+"""
+
+from jepsen_tpu.models.base import (  # noqa: F401
+    Inconsistent, JaxModel, Model, UNKNOWN32,
+    get_model, inconsistent, known_models, register_model,
+)
+from jepsen_tpu.models.register import (  # noqa: F401
+    CASRegister, RWRegister, cas_register_jax, rw_register_jax,
+)
+from jepsen_tpu.models.collections import (  # noqa: F401
+    FIFOQueue, MultiRegister, Mutex, SetModel, UnorderedQueue,
+)

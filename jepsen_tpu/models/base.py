@@ -1,0 +1,115 @@
+"""Model interfaces for linearizability checking.
+
+Two tiers, mirroring the reference's split between knossos.model's pluggable
+Clojure models and the checker engines that consume them
+(jepsen/src/jepsen/checker.clj:185-216, and the Model protocol echoed at
+jepsen/src/jepsen/tests/causal.clj:13-27):
+
+- :class:`Model` — a host-side immutable object with ``step(op)``; any Python
+  model works, checked by the CPU engine.  This is the compatibility tier.
+- :class:`JaxModel` — a pure function ``step(state, f, a, b) -> (state', ok)``
+  over fixed-width int32 state, plus an op encoder.  This is the fast tier:
+  the TPU engine vmaps the step over whole configuration frontiers.
+
+A model may provide both; ``linearizable(..., algorithm="competition")`` races
+the tiers like knossos.competition does for its two CPU solvers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.history import Op
+
+# Sentinel for "value unknown" in int32 op encodings (e.g. crashed reads).
+UNKNOWN32 = -(2**31)
+
+
+class Inconsistent:
+    """Returned by Model.step when the op cannot be applied to this state."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str = ""):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+    def __bool__(self):  # allow `if result:` to mean "consistent"
+        return False
+
+
+def inconsistent(msg: str = "") -> Inconsistent:
+    return Inconsistent(msg)
+
+
+class Model:
+    """Immutable sequential datatype specification (host tier).
+
+    Implementations must be hashable and equality-comparable on their state
+    (use frozen dataclasses), and must implement :meth:`step`.
+    """
+
+    def step(self, op: Op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    def __eq__(self, other):  # pragma: no cover - overridden by dataclasses
+        raise NotImplementedError
+
+    def __hash__(self):  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class JaxModel:
+    """Device-tier model: pure int32 state machine.
+
+    ``step(state, f, a, b)`` must be jax-traceable, where ``state`` is an
+    int32[state_size] vector and (f, a, b) the encoded op; returns
+    ``(new_state, ok)`` with ok a bool scalar.  ``encode_op`` maps an
+    :class:`Op` (with completion-filled values) to ``(f, a, b)`` int32s.
+    """
+
+    name: str
+    state_size: int
+    init_state: np.ndarray
+    step: Callable  # (state, f, a, b) -> (new_state, ok)
+    encode_op: Callable[[Op], Tuple[int, int, int]]
+    # Optional factory for the equivalent host-tier model (the oracle).
+    cpu_model: Optional[Callable[[], Model]] = None
+    # f codes that never mutate state AND always succeed when their value is
+    # unknown — ops with these codes and unknown values can be dropped during
+    # preprocessing (e.g. crashed reads; knossos does the same elimination).
+    pure_read_fs: Tuple[int, ...] = ()
+
+    def init_state_array(self) -> np.ndarray:
+        return np.asarray(self.init_state, np.int32).reshape(self.state_size)
+
+
+# ---------------------------------------------------------------------------
+# Registry — name -> JaxModel factory (mirrors how suites name knossos models,
+# e.g. model/cas-register at zookeeper/src/jepsen/zookeeper.clj:132-136).
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Callable[..., JaxModel]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_model(name: str, **kw) -> JaxModel:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kw)
+
+
+def known_models():
+    return sorted(_REGISTRY)

@@ -1,0 +1,190 @@
+"""Mutex, queue, set, and multi-register models (knossos.model parity).
+
+The reference's suites construct these via knossos.model (e.g. mutex for lock
+services, fifo-queue for queue workloads); see the external-library inventory
+in SURVEY.md §2.2.  Host tier for all; device tier for mutex (trivial state)
+and bounded-domain set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, FrozenSet, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.models.base import (
+    UNKNOWN32, JaxModel, Model, inconsistent, register_model,
+)
+
+
+# -- mutex ------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Mutex(Model):
+    locked: bool = False
+
+    def step(self, op: Op):
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("already held")
+            return Mutex(True)
+        if op.f == "release":
+            if not self.locked:
+                return inconsistent("not held")
+            return Mutex(False)
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+F_ACQUIRE, F_RELEASE = 0, 1
+
+
+@register_model("mutex")
+def mutex_jax() -> JaxModel:
+    def step(state, f, a, b):
+        locked = state[0]
+        is_acq = f == F_ACQUIRE
+        ok = jnp.where(is_acq, locked == 0, locked == 1)
+        new = jnp.where(ok, jnp.where(is_acq, 1, 0), locked)
+        return new[None].astype(jnp.int32), ok
+
+    def encode(op: Op):
+        if op.f == "acquire":
+            return F_ACQUIRE, 0, 0
+        if op.f == "release":
+            return F_RELEASE, 0, 0
+        raise ValueError(f"mutex can't encode f={op.f!r}")
+
+    return JaxModel(name="mutex", state_size=1,
+                    init_state=np.array([0], np.int32),
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: Mutex())
+
+
+# -- fifo / unordered queues ------------------------------------------------
+
+@dataclass(frozen=True)
+class FIFOQueue(Model):
+    items: Tuple[Any, ...] = ()
+
+    def step(self, op: Op):
+        if op.f == "enqueue":
+            return FIFOQueue(self.items + (op.value,))
+        if op.f == "dequeue":
+            if not self.items:
+                return inconsistent("dequeue from empty queue")
+            if op.value is not None and self.items[0] != op.value:
+                return inconsistent(
+                    f"expected {op.value!r} at head, found {self.items[0]!r}")
+            return FIFOQueue(self.items[1:])
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """Queue without ordering guarantees — dequeue may take any element."""
+
+    items: FrozenSet[Any] = frozenset()
+
+    def step(self, op: Op):
+        if op.f == "enqueue":
+            return UnorderedQueue(self.items | {op.value})
+        if op.f == "dequeue":
+            if op.value is None:
+                if not self.items:
+                    return inconsistent("dequeue from empty queue")
+                return UnorderedQueue(frozenset(list(self.items)[1:]))
+            if op.value not in self.items:
+                return inconsistent(f"{op.value!r} not in queue")
+            return UnorderedQueue(self.items - {op.value})
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+# -- grow-only / read-full set ---------------------------------------------
+
+@dataclass(frozen=True)
+class SetModel(Model):
+    items: FrozenSet[Any] = frozenset()
+
+    def step(self, op: Op):
+        if op.f == "add":
+            return SetModel(self.items | {op.value})
+        if op.f == "read":
+            if op.value is None:
+                return self
+            observed = frozenset(op.value)
+            if observed == self.items:
+                return self
+            return inconsistent(
+                f"read {sorted(map(repr, observed))} but set is "
+                f"{sorted(map(repr, self.items))}")
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+# -- multi-register ---------------------------------------------------------
+
+@dataclass(frozen=True)
+class MultiRegister(Model):
+    """Map of keys to values; ops carry value = {key: v, ...} maps.
+
+    read asserts all observed keys; write sets all given keys (knossos
+    multi-register parity).
+    """
+
+    values: Tuple[Tuple[Any, Any], ...] = ()
+
+    def _as_dict(self):
+        return dict(self.values)
+
+    def step(self, op: Op):
+        d = self._as_dict()
+        if op.f in ("read", "r"):
+            if op.value is None:
+                return self
+            for k, v in dict(op.value).items():
+                if d.get(k) != v:
+                    return inconsistent(f"key {k!r}: read {v!r}, have {d.get(k)!r}")
+            return self
+        if op.f in ("write", "w"):
+            d.update(dict(op.value))
+            return MultiRegister(tuple(sorted(d.items(), key=repr)))
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+# -- bounded-domain set, device tier ---------------------------------------
+
+F_ADD, F_READBIT = 0, 1
+
+
+@register_model("bitset")
+def bitset_jax(domain: int = 1024) -> JaxModel:
+    """Grow-only set over int keys [0, domain): state is a bitmask.
+
+    Device-tier analog of SetModel for workloads whose reads check a single
+    element's membership: f=add value=k; f=read value=(k, present?1:0).
+    """
+    words = (domain + 31) // 32
+
+    def step(state, f, a, b):
+        word, bit = a // 32, a % 32
+        mask = (jnp.int32(1) << bit)
+        has = (state[word] & mask) != 0
+        is_add = f == F_ADD
+        ok = jnp.where(is_add, True, has == (b != 0))
+        new = state.at[word].set(
+            jnp.where(is_add, state[word] | mask, state[word]))
+        return new, ok
+
+    def encode(op: Op):
+        if op.f == "add":
+            return F_ADD, int(op.value), 0
+        if op.f == "read":
+            k, present = op.value
+            return F_READBIT, int(k), int(bool(present))
+        raise ValueError(f"bitset can't encode f={op.f!r}")
+
+    return JaxModel(name="bitset", state_size=words,
+                    init_state=np.zeros(words, np.int32),
+                    step=step, encode_op=encode)

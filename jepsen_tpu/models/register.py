@@ -1,0 +1,141 @@
+"""Register models: read/write register and CAS register.
+
+Parity targets: knossos.model/register and knossos.model/cas-register as used
+by the reference's linearizable-register workloads
+(jepsen/src/jepsen/tests/linearizable_register.clj:18-53,
+zookeeper/src/jepsen/zookeeper.clj:132-136, consul CAS register —
+consul/src/jepsen/consul/register.clj:72).
+
+Op language:
+  read  — value = observed register value (None on the invoke; filled from
+          the completion by History.complete()).
+  write — value = value written.
+  cas   — value = [old, new]; succeeds iff register == old.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.models.base import (
+    UNKNOWN32, Inconsistent, JaxModel, Model, inconsistent, register_model,
+)
+
+F_READ, F_WRITE, F_CAS = 0, 1, 2
+F_NAMES = {"read": F_READ, "r": F_READ,
+           "write": F_WRITE, "w": F_WRITE,
+           "cas": F_CAS}
+
+# Initial register value.  The reference's cas-register starts nil; we encode
+# nil as UNKNOWN32+1 (distinct from the unknown-value sentinel).
+NIL32 = UNKNOWN32 + 1
+
+
+# -- host tier --------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CASRegister(Model):
+    value: Any = None
+
+    def step(self, op: Op):
+        f = op.f
+        if f in ("read", "r"):
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"can't read {op.value!r} from {self.value!r}")
+        if f in ("write", "w"):
+            return CASRegister(op.value)
+        if f == "cas":
+            old, new = op.value
+            if self.value == old:
+                return CASRegister(new)
+            return inconsistent(f"can't CAS {self.value!r} from {old!r}")
+        return inconsistent(f"unknown f {f!r}")
+
+
+@dataclass(frozen=True)
+class RWRegister(Model):
+    """Read/write register (no CAS)."""
+
+    value: Any = None
+
+    def step(self, op: Op):
+        f = op.f
+        if f in ("read", "r"):
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(f"can't read {op.value!r} from {self.value!r}")
+        if f in ("write", "w"):
+            return RWRegister(op.value)
+        return inconsistent(f"unknown f {f!r}")
+
+
+# -- device tier ------------------------------------------------------------
+
+def _encode_register_op(op: Op):
+    f = F_NAMES.get(op.f)
+    if f is None:
+        raise ValueError(f"register models can't encode f={op.f!r}")
+    v = op.value
+    if f == F_CAS:
+        old, new = v
+        return f, int(old), int(new)
+    if v is None:
+        return f, UNKNOWN32, 0
+    return f, int(v), 0
+
+
+def _cas_step(state, f, a, b):
+    """state: int32[1]; returns (new_state, ok)."""
+    v = state[0]
+    is_read = f == F_READ
+    is_write = f == F_WRITE
+    is_cas = f == F_CAS
+    read_ok = (a == UNKNOWN32) | (a == v)
+    cas_ok = v == a
+    ok = jnp.where(is_read, read_ok, jnp.where(is_cas, cas_ok, is_write))
+    new_v = jnp.where(is_write, a, jnp.where(is_cas & cas_ok, b, v))
+    return jnp.where(ok, new_v, v)[None], ok
+
+
+@register_model("cas-register")
+def cas_register_jax(init: Optional[int] = None) -> JaxModel:
+    init32 = NIL32 if init is None else int(init)
+    return JaxModel(
+        name="cas-register",
+        state_size=1,
+        init_state=np.array([init32], np.int32),
+        step=_cas_step,
+        encode_op=_encode_register_op,
+        cpu_model=lambda: CASRegister(init),
+        pure_read_fs=(F_READ,),
+    )
+
+
+@register_model("register")
+def rw_register_jax(init: Optional[int] = None) -> JaxModel:
+    init32 = NIL32 if init is None else int(init)
+
+    def step(state, f, a, b):
+        v = state[0]
+        is_read = f == F_READ
+        is_write = f == F_WRITE
+        read_ok = (a == UNKNOWN32) | (a == v)
+        ok = jnp.where(is_read, read_ok, is_write)
+        new_v = jnp.where(is_write, a, v)
+        return jnp.where(ok, new_v, v)[None], ok
+
+    return JaxModel(
+        name="register",
+        state_size=1,
+        init_state=np.array([init32], np.int32),
+        step=step,
+        encode_op=_encode_register_op,
+        cpu_model=lambda: RWRegister(init),
+        pure_read_fs=(F_READ,),
+    )

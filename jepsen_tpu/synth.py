@@ -1,0 +1,132 @@
+"""Synthetic history generation — golden corpora for checker tests and bench.
+
+The reference tests its checkers on hand-written histories
+(test/jepsen/checker_test.clj, test/jepsen/perf_test.clj:11-60); at 10k ops
+that needs a generator.  ``cas_register_history`` simulates an actual
+concurrent execution against a sequential register — invocations, effects,
+and completions interleave freely, processes can crash mid-op — so the
+result is linearizable *by construction*.  ``corrupt_reads`` then flips
+observed read values to produce refutable histories with a known culprit.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
+
+
+def cas_register_history(n_ops: int,
+                         concurrency: int = 5,
+                         values: int = 5,
+                         crash_p: float = 0.003,
+                         seed: int = 0,
+                         read_p: float = 0.5,
+                         write_p: float = 0.25) -> History:
+    """Simulate ``n_ops`` reads/writes/cas against one register.
+
+    Returns a linearizable history (invoke/ok/fail/info entries, values
+    filled, nanosecond-ish times).  Crashed ops (probability ``crash_p``)
+    become ``info``; half of crashed mutations still take effect later —
+    exercising the forever-pending window path.
+    """
+    rng = random.Random(seed)
+    state: Optional[int] = None
+    history: List[Op] = []
+    free = list(range(concurrency))
+    # pending: process -> dict(op, effected, result_type, result_value)
+    pending = {}
+    # crashed-but-will-still-effect ops waiting for their moment
+    ghost_effects = []
+    t = 0
+    invoked = 0
+
+    def effect(p):
+        nonlocal state
+        d = pending[p]
+        op = d["op"]
+        if op.f == "read":
+            d["result_value"] = state
+            d["result_type"] = OK
+        elif op.f == "write":
+            state = op.value
+            d["result_value"] = op.value
+            d["result_type"] = OK
+        else:  # cas
+            old, new = op.value
+            if state == old:
+                state = new
+                d["result_type"] = OK
+            else:
+                d["result_type"] = FAIL
+            d["result_value"] = op.value
+        d["effected"] = True
+
+    while invoked < n_ops or pending:
+        t += rng.randint(1, 1000)
+        # Maybe fire a deferred ghost effect from a crashed op.
+        if ghost_effects and rng.random() < 0.3:
+            ge = ghost_effects.pop(rng.randrange(len(ghost_effects)))
+            if ge["op"].f == "write":
+                state = ge["op"].value
+            elif ge["op"].f == "cas":
+                old, new = ge["op"].value
+                if state == old:
+                    state = new
+        roll = rng.random()
+        if free and invoked < n_ops and (roll < 0.45 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            r = rng.random()
+            if r < read_p:
+                op = Op(process=p, type=INVOKE, f="read", value=None, time=t)
+            elif r < read_p + write_p:
+                op = Op(process=p, type=INVOKE, f="write",
+                        value=rng.randrange(values), time=t)
+            else:
+                op = Op(process=p, type=INVOKE, f="cas",
+                        value=[rng.randrange(values), rng.randrange(values)],
+                        time=t)
+            history.append(op)
+            pending[p] = {"op": op, "effected": False,
+                          "result_type": None, "result_value": None}
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            d = pending[p]
+            if rng.random() < crash_p:
+                # Crash: process never reports back.
+                history.append(Op(process=p, type=INFO, f=d["op"].f,
+                                  value=None, time=t, error="crashed"))
+                if not d["effected"] and d["op"].f != "read" and rng.random() < 0.5:
+                    ghost_effects.append(d)
+                del pending[p]
+                # Process id is burned (the runtime would spawn a fresh one);
+                # reuse here to keep concurrency bounded — window slots in the
+                # checker are assigned independently of process ids.
+                free.append(p)
+            elif not d["effected"]:
+                effect(p)
+            else:
+                history.append(Op(process=p, type=d["result_type"],
+                                  f=d["op"].f, value=d["result_value"], time=t))
+                del pending[p]
+                free.append(p)
+
+    return History(history)
+
+
+def corrupt_reads(history: History, n: int = 1, seed: int = 0,
+                  values: int = 5) -> History:
+    """Flip the observed value of ``n`` ok-reads to a value that was never
+    current at any point during the read — producing (with overwhelming
+    likelihood) a non-linearizable history."""
+    rng = random.Random(seed)
+    ops = [o.with_() for o in history]
+    read_oks = [i for i, o in enumerate(ops) if o.type == OK and o.f == "read"]
+    if not read_oks:
+        raise ValueError("no ok reads to corrupt")
+    for i in rng.sample(read_oks, min(n, len(read_oks))):
+        bad = values + 1000 + rng.randrange(100)  # outside the value domain
+        ops[i] = ops[i].with_(value=bad)
+    return History(ops, reindex=True)

@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware isn't available in CI; all sharding tests run against
+8 virtual CPU devices (the driver separately dry-runs the multichip path via
+__graft_entry__.dryrun_multichip).  Env must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()

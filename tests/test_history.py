@@ -1,0 +1,102 @@
+"""History model, pairing, EDN parsing, SoA encoding."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import (
+    History, INVOKE, OK, FAIL, INFO, Op, encode_soa, parse_edn,
+    parse_edn_stream,
+)
+from jepsen_tpu.models import get_model
+
+
+def mk(process, type_, f, value=None, **kw):
+    return Op(process=process, type=type_, f=f, value=value, **kw)
+
+
+class TestHistory:
+    def test_index_assignment(self):
+        h = History([mk(0, INVOKE, "read"), mk(0, OK, "read", 3)])
+        assert [o.index for o in h] == [0, 1]
+
+    def test_pairing(self):
+        h = History([
+            mk(0, INVOKE, "write", 1),
+            mk(1, INVOKE, "read"),
+            mk(0, OK, "write", 1),
+            mk(1, OK, "read", 1),
+        ])
+        assert list(h.pair_index()) == [2, 3, 0, 1]
+
+    def test_unmatched_invoke_pairs_to_minus_one(self):
+        h = History([mk(0, INVOKE, "write", 1)])
+        assert list(h.pair_index()) == [-1]
+
+    def test_complete_fills_read_values(self):
+        h = History([mk(0, INVOKE, "read"), mk(0, OK, "read", 7)]).complete()
+        assert h[0].value == 7
+
+    def test_pairs_listing(self):
+        h = History([
+            mk(0, INVOKE, "write", 1),
+            mk(1, INVOKE, "read"),
+            mk(1, INFO, "read"),
+            mk(0, OK, "write", 1),
+        ])
+        ps = h.pairs()
+        assert len(ps) == 2
+        assert ps[0][1].type == OK
+        assert ps[1][1].type == INFO
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        h = History([mk(0, INVOKE, "cas", [1, 2]), mk(0, FAIL, "cas", [1, 2])])
+        p = str(tmp_path / "h.jsonl")
+        h.to_jsonl(p)
+        h2 = History.from_jsonl(p)
+        assert [o.to_dict() for o in h2] == [o.to_dict() for o in h]
+
+
+class TestEdn:
+    def test_scalars(self):
+        assert parse_edn("nil") is None
+        assert parse_edn("true") is True
+        assert parse_edn("42") == 42
+        assert parse_edn("-1.5") == -1.5
+        assert parse_edn(":read") == "read"
+        assert parse_edn('"hi\\n"') == "hi\n"
+
+    def test_map_vector(self):
+        m = parse_edn('{:type :invoke, :f :cas, :value [1 2], :process 0}')
+        assert m == {"type": "invoke", "f": "cas", "value": [1, 2], "process": 0}
+
+    def test_stream_and_history(self):
+        text = """
+        {:index 0 :type :invoke :f :write :value 3 :process 0 :time 10}
+        {:index 1 :type :ok :f :write :value 3 :process 0 :time 20}
+        """
+        h = History.from_edn(text)
+        assert len(h) == 2 and h[1].type == OK and h[1].value == 3
+
+    def test_comments_and_sets(self):
+        vals = parse_edn_stream("; a comment\n#{1 2} [3]")
+        assert vals[0] == {1, 2} and vals[1] == [3]
+
+    def test_nemesis_keyword_process(self):
+        h = History.from_edn('{:type :info :f :start :process :nemesis :value nil}')
+        assert h[0].process == "nemesis"
+
+
+class TestSOA:
+    def test_encode_cas(self):
+        model = get_model("cas-register")
+        h = History([
+            mk(0, INVOKE, "write", 1),
+            mk(0, OK, "write", 1),
+            mk(1, INVOKE, "cas", [1, 2]),
+            mk(1, OK, "cas", [1, 2]),
+        ])
+        soa = encode_soa(h, model.encode_op)
+        assert soa.f.tolist() == [1, 1, 2, 2]
+        assert soa.a.tolist() == [1, 1, 1, 1]
+        assert soa.b.tolist() == [0, 0, 2, 2]
+        assert soa.pair.tolist() == [1, 0, 3, 2]
