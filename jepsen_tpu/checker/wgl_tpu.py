@@ -1,0 +1,297 @@
+"""Device-tier linearizability engine — the point of this framework.
+
+Replaces the reference's external knossos solver (invoked at
+jepsen/src/jepsen/checker.clj:185-216) with a JAX search that runs entirely in
+fixed-shape device buffers:
+
+- A configuration is (pending-window bitmask, model state): uint32[MW] mask
+  lanes + int32[S] state lanes (see prep.py for why that compression is
+  complete).  The engine holds up to ``capacity`` configurations.
+- The history is a stream of ENTER/RETURN events consumed by ``lax.scan`` in
+  chunks; the host polls failure/overflow flags between chunks (early exit),
+  so a refuted history stops in O(prefix).
+- At a RETURN event the engine expands the configuration closure: a nested
+  vmap applies the model step to every (configuration × pending op) pair —
+  [C, W] parallel model steps per round — then the union is deduplicated and
+  compacted by a multi-key sort (ops/dedup.py).  Closure repeats to fixpoint
+  (count-stable), then configurations lacking the returning op are pruned.
+- Closure is skipped when the set is already closed: pruning on a bit
+  preserves closedness (expansions of a surviving configuration also carried
+  the bit), so closure is only needed after new ENTERs — the ``dirty`` flag.
+
+Single-history frontier sharding across a device mesh lives in
+jepsen_tpu.parallel; this module is mesh-agnostic but takes an optional
+``axis_name`` so the closure can all_gather candidate rows and keep a
+device-local slice of the deduplicated global set.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from jepsen_tpu.checker.prep import (
+    EV_ENTER, EV_RETURN, PreparedHistory, WindowOverflow, prepare,
+)
+from jepsen_tpu.history import History
+from jepsen_tpu.models.base import JaxModel
+from jepsen_tpu.ops.dedup import sort_dedup_compact
+
+EV_NOP = 2
+
+# carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
+#          overflow, explored)
+
+
+def make_engine(model: JaxModel, window: int, capacity: int,
+                axis_name: Optional[str] = None, num_shards: int = 1):
+    """Build the jittable (carry0, event_step, run_chunk) triple.
+
+    ``window`` must be a multiple of 32.  With ``axis_name``, buffers are
+    device-local shards of a global set of ``capacity * num_shards``
+    configurations and closure dedup synchronizes via all_gather.
+    """
+    assert window % 32 == 0 and window > 0
+    W, MW, S, C = window, window // 32, model.state_size, capacity
+    step = model.step
+
+    # slot_masks[w] = uint32[MW] with bit w set.
+    sm = np.zeros((W, MW), np.uint32)
+    for w in range(W):
+        sm[w, w // 32] = np.uint32(1) << np.uint32(w % 32)
+    slot_masks = jnp.asarray(sm)
+
+    def slot_bitmask(slot):
+        word = slot // 32
+        bit = jnp.left_shift(jnp.uint32(1), (slot % 32).astype(jnp.uint32))
+        return jnp.where(jnp.arange(MW) == word, bit, jnp.uint32(0))
+
+    def expand(states, win_ops):
+        def per_config(st):
+            def per_slot(op):
+                ns, ok = step(st, op[0], op[1], op[2])
+                return ns.astype(jnp.int32), ok
+            return jax.vmap(per_slot)(win_ops)
+        return jax.vmap(per_config)(states)  # [C, W, S], [C, W]
+
+    def global_sum(x):
+        return lax.psum(x, axis_name) if axis_name else x
+
+    def closure(mask, states, valid, win_ops, active, overflow):
+        count0 = global_sum(valid.sum())
+
+        def cond(c):
+            _, _, _, _, changed, ovf, it = c
+            return changed & ~ovf & (it < W + 1)
+
+        def body(c):
+            mask, states, valid, count, _, ovf, it = c
+            cand_states, ok = expand(states, win_ops)
+            has = ((mask[:, None, :] & slot_masks[None, :, :]) != 0).any(-1)
+            cand_valid = valid[:, None] & active[None, :] & ~has & ok
+            cand_mask = mask[:, None, :] | slot_masks[None, :, :]
+
+            all_mask = jnp.concatenate([mask, cand_mask.reshape(C * W, MW)])
+            all_states = jnp.concatenate([states, cand_states.reshape(C * W, S)])
+            all_valid = jnp.concatenate([valid, cand_valid.reshape(C * W)])
+            if axis_name is not None:
+                all_mask = lax.all_gather(all_mask, axis_name, tiled=True)
+                all_states = lax.all_gather(all_states, axis_name, tiled=True)
+                all_valid = lax.all_gather(all_valid, axis_name, tiled=True)
+            cols = ([all_mask[:, i] for i in range(MW)]
+                    + [all_states[:, i] for i in range(S)])
+            gcap = C * num_shards
+            out_cols, out_valid, total, ovf2 = sort_dedup_compact(
+                cols, all_valid, gcap)
+            new_mask = jnp.stack(out_cols[:MW], -1)
+            new_states = jnp.stack(out_cols[MW:], -1)
+            if axis_name is not None:
+                start = lax.axis_index(axis_name) * C
+                new_mask = lax.dynamic_slice_in_dim(new_mask, start, C)
+                new_states = lax.dynamic_slice_in_dim(new_states, start, C)
+                out_valid = lax.dynamic_slice_in_dim(out_valid, start, C)
+            changed = total > count
+            return (new_mask, new_states, out_valid, total, changed,
+                    ovf | ovf2, it + 1)
+
+        init = (mask, states, valid, count0, jnp.bool_(True), overflow,
+                jnp.int32(0))
+        mask, states, valid, count, _, overflow, _ = lax.while_loop(
+            cond, body, init)
+        return mask, states, valid, count, overflow
+
+    def event_step(carry, ev):
+        (mask, states, valid, win_ops, active, dirty, failed, failed_op,
+         overflow, explored) = carry
+        kind, slot, f, a, b, op_id = (ev[0], ev[1], ev[2], ev[3], ev[4], ev[5])
+        alive = ~failed & ~overflow
+
+        def do_enter(c):
+            (mask, states, valid, win_ops, active, dirty, failed, failed_op,
+             overflow, explored) = c
+            win_ops2 = win_ops.at[slot].set(jnp.stack([f, a, b]))
+            active2 = active.at[slot].set(True)
+            return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
+                    failed, failed_op, overflow, explored)
+
+        def do_return(c):
+            (mask, states, valid, win_ops, active, dirty, failed, failed_op,
+             overflow, explored) = c
+
+            def with_closure(args):
+                mask, states, valid, overflow, explored = args
+                mask, states, valid, count, overflow = closure(
+                    mask, states, valid, win_ops, active, overflow)
+                return mask, states, valid, overflow, explored + count
+
+            mask, states, valid, overflow, explored = lax.cond(
+                dirty, with_closure, lambda a: a,
+                (mask, states, valid, overflow, explored))
+
+            bm = slot_bitmask(slot)
+            has = ((mask & bm[None, :]) != 0).any(-1)
+            valid2 = valid & has
+            n_surv = global_sum(valid2.sum())
+            newly_failed = n_surv == 0
+            failed_op2 = jnp.where(newly_failed & ~failed, op_id, failed_op)
+            mask2 = mask & ~bm[None, :]
+            active2 = active.at[slot].set(False)
+            return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
+                    failed | newly_failed, failed_op2, overflow, explored)
+
+        new_carry = lax.cond(
+            alive,
+            lambda c: lax.switch(kind, [do_enter, do_return, lambda x: x], c),
+            lambda c: c, carry)
+        return new_carry, None
+
+    def carry0():
+        states = jnp.tile(jnp.asarray(model.init_state_array())[None, :], (C, 1))
+        return (jnp.zeros((C, MW), jnp.uint32),            # mask
+                states,                                    # states
+                jnp.arange(C) == 0 if axis_name is None    # valid: one config
+                else None,                                 # (set by caller)
+                jnp.zeros((W, 3), jnp.int32),              # win_ops
+                jnp.zeros(W, dtype=bool),                  # active
+                jnp.bool_(False),                          # dirty
+                jnp.bool_(False),                          # failed
+                jnp.int32(-1),                             # failed_op
+                jnp.bool_(False),                          # overflow
+                jnp.int32(0))                              # explored
+
+    def run_chunk(carry, events):
+        carry, _ = lax.scan(event_step, carry, events)
+        return carry
+
+    return carry0, event_step, run_chunk
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+# ---------------------------------------------------------------------------
+
+_ENGINE_CACHE: Dict[Tuple, Any] = {}
+
+
+def _get_run_chunk(model: JaxModel, window: int, capacity: int):
+    # Same-named registry models share step semantics; keying on the name +
+    # initial state (not the closure id) lets every get_model() call reuse
+    # one compiled engine.
+    key = (model.name, model.state_size,
+           tuple(model.init_state_array().tolist()), window, capacity)
+    if key not in _ENGINE_CACHE:
+        carry0, _, run_chunk = make_engine(model, window, capacity)
+        _ENGINE_CACHE[key] = (carry0, jax.jit(run_chunk, donate_argnums=0))
+    return _ENGINE_CACHE[key]
+
+
+def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
+    """[E_padded, 6] int32 event stream, NOP-padded to a chunk multiple."""
+    e = len(p)
+    ep = max(chunk, ((e + chunk - 1) // chunk) * chunk)
+    ev = np.full((ep, 6), 0, np.int32)
+    ev[:, 0] = EV_NOP
+    ev[:e, 0] = p.kind
+    ev[:e, 1] = p.slot
+    ev[:e, 2] = p.f
+    ev[:e, 3] = p.a
+    ev[:e, 4] = p.b
+    ev[:e, 5] = p.op_id
+    return ev
+
+
+def check(model: JaxModel, history: Optional[History] = None,
+          prepared: Optional[PreparedHistory] = None,
+          capacity: int = 1024, max_capacity: int = 65536,
+          chunk: int = 2048, max_window: int = 4096,
+          explain: bool = True) -> Dict[str, Any]:
+    """Decide linearizability on device.  Retries with larger configuration
+    capacity on overflow; falls back to ``valid: "unknown"`` past
+    ``max_capacity``.  On refutation, optionally re-derives a witness on the
+    failing prefix with the CPU oracle (cheap: the prefix is exactly what the
+    device already searched)."""
+    p = prepared if prepared is not None else prepare(
+        history, model, max_window=max_window)
+    window = max(32, ((p.window + 31) // 32) * 32)
+    ev = events_array(p, chunk)
+    n_chunks = ev.shape[0] // chunk
+
+    cap = capacity
+    while True:
+        carry0, run_chunk = _get_run_chunk(model, window, cap)
+        carry = carry0()
+        failed = overflow = False
+        for ci in range(n_chunks):
+            carry = run_chunk(carry, jnp.asarray(ev[ci * chunk:(ci + 1) * chunk]))
+            failed = bool(carry[6])
+            overflow = bool(carry[8])
+            if failed or overflow:
+                break
+        if overflow and cap < max_capacity:
+            cap = min(cap * 8, max_capacity)
+            continue
+        break
+
+    explored = int(carry[9])
+    if overflow:
+        return {"valid": "unknown", "analyzer": "wgl-tpu",
+                "error": f"configuration capacity exceeded at {cap}",
+                "configs-explored": explored}
+    if not failed:
+        return {"valid": True, "analyzer": "wgl-tpu",
+                "configs-explored": explored,
+                "window": p.window, "capacity": cap}
+    failed_op = p.ops[int(carry[7])]
+    res: Dict[str, Any] = {"valid": False, "analyzer": "wgl-tpu",
+                           "op": failed_op.to_dict(),
+                           "configs-explored": explored,
+                           "window": p.window, "capacity": cap}
+    if explain and history is not None and model.cpu_model is not None:
+        res["witness"] = _cpu_witness(model, history, failed_op)
+    return res
+
+
+def _cpu_witness(model: JaxModel, history: History, failed_op) -> Dict[str, Any]:
+    """Re-run the CPU oracle on the prefix ending at the failing op's
+    completion for a knossos-style final-configs report."""
+    from jepsen_tpu.checker import wgl_cpu
+    h = history.client_ops().complete()
+    pairs = h.pair_index()
+    cut = None
+    for i, op in enumerate(h):
+        if op.index == failed_op.index:
+            cut = int(pairs[i]) if pairs[i] >= 0 else i
+            break
+    if cut is None:
+        return {"error": "failing op not found in history"}
+    prefix = History(h.ops[:cut + 1])
+    try:
+        return wgl_cpu.check(model.cpu_model(), prefix, max_configs=200_000)
+    except wgl_cpu.SearchExploded:
+        return {"error": "witness search exceeded budget"}

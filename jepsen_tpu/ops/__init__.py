@@ -1,0 +1,3 @@
+"""Device-side primitive ops shared by the analysis engines."""
+
+from jepsen_tpu.ops.dedup import sort_dedup_compact  # noqa: F401

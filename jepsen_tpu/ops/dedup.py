@@ -1,0 +1,58 @@
+"""Sort-based row deduplication with fixed-capacity compaction.
+
+The TPU search's configuration sets live in fixed-shape buffers; after each
+closure expansion the union of (existing ∪ candidate) rows must be
+deduplicated and compacted back to capacity.  Rows are fully described by
+their key columns, so a multi-operand lexicographic ``lax.sort`` (invalid rows
+keyed last), a neighbour-equality pass, and a cumsum/scatter compaction do the
+whole job with static shapes — no host round-trips, no dynamic allocation.
+
+This replaces what knossos does with JVM hash sets of configuration objects;
+sort+compare is the shape XLA tiles well.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_dedup_compact(cols: Sequence[jnp.ndarray],
+                       valid: jnp.ndarray,
+                       capacity: int,
+                       ) -> Tuple[List[jnp.ndarray], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Deduplicate rows described by ``cols`` (each [N], int dtypes) among
+    entries where ``valid`` is True; compact the distinct rows into buffers of
+    ``capacity`` rows.
+
+    Returns ``(out_cols, out_valid, total, overflow)`` where ``total`` is the
+    number of distinct valid rows (may exceed capacity — then ``overflow`` is
+    True and the surplus rows were dropped).
+    """
+    n = valid.shape[0]
+    # Key 0: invalid rows sort after all valid rows.
+    inv = (~valid).astype(jnp.int32)
+    operands = [inv] + [c for c in cols]
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=len(operands))
+    s_inv, s_cols = sorted_ops[0], list(sorted_ops[1:])
+    s_valid = s_inv == 0
+
+    same_as_prev = jnp.ones(n, dtype=bool)
+    for c in s_cols:
+        same_as_prev &= c == jnp.roll(c, 1)
+    same_as_prev = same_as_prev.at[0].set(False)
+    keep = s_valid & ~(same_as_prev & jnp.roll(s_valid, 1))
+
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    total = pos[-1] + 1
+    overflow = total > capacity
+    dest = jnp.where(keep & (pos < capacity), pos, capacity)
+
+    out_cols = []
+    for c in s_cols:
+        buf = jnp.zeros(capacity + 1, dtype=c.dtype)
+        out_cols.append(buf.at[dest].set(c, mode="drop")[:capacity])
+    out_valid = jnp.arange(capacity) < jnp.minimum(total, capacity)
+    return out_cols, out_valid, total, overflow

@@ -2,7 +2,12 @@
 
 Multi-chip hardware isn't available in CI; all sharding tests run against
 8 virtual CPU devices (the driver separately dry-runs the multichip path via
-__graft_entry__.dryrun_multichip).  Env must be set before jax imports.
+__graft_entry__.dryrun_multichip).
+
+Note: the environment may import jax at interpreter startup (sitecustomize
+registering an accelerator plugin), so setting JAX_PLATFORMS via os.environ
+here can be too late — but backends initialize lazily, so a config update
+before first device use still wins.
 """
 
 import os
@@ -12,3 +17,7 @@ xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
