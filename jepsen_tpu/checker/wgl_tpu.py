@@ -46,7 +46,7 @@ from jepsen_tpu.ops.dedup import sort_dedup_compact
 EV_NOP = 2
 
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-#          overflow, explored)
+#          overflow, explored, rounds)
 
 
 def make_engine(model: JaxModel, window: int, capacity: int,
@@ -122,37 +122,38 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
         init = (mask, states, valid, count0, jnp.bool_(True), overflow,
                 jnp.int32(0))
-        mask, states, valid, count, _, overflow, _ = lax.while_loop(
+        mask, states, valid, count, _, overflow, iters = lax.while_loop(
             cond, body, init)
-        return mask, states, valid, count, overflow
+        return mask, states, valid, count, overflow, iters
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-         overflow, explored) = carry
+         overflow, explored, rounds) = carry
         kind, slot, f, a, b, op_id = (ev[0], ev[1], ev[2], ev[3], ev[4], ev[5])
         alive = ~failed & ~overflow
 
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored) = c
+             overflow, explored, rounds) = c
             win_ops2 = win_ops.at[slot].set(jnp.stack([f, a, b]))
             active2 = active.at[slot].set(True)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
-                    failed, failed_op, overflow, explored)
+                    failed, failed_op, overflow, explored, rounds)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored) = c
+             overflow, explored, rounds) = c
 
             def with_closure(args):
-                mask, states, valid, overflow, explored = args
-                mask, states, valid, count, overflow = closure(
+                mask, states, valid, overflow, explored, rounds = args
+                mask, states, valid, count, overflow, iters = closure(
                     mask, states, valid, win_ops, active, overflow)
-                return mask, states, valid, overflow, explored + count
+                return (mask, states, valid, overflow, explored + count,
+                        rounds + iters)
 
-            mask, states, valid, overflow, explored = lax.cond(
+            mask, states, valid, overflow, explored, rounds = lax.cond(
                 dirty, with_closure, lambda a: a,
-                (mask, states, valid, overflow, explored))
+                (mask, states, valid, overflow, explored, rounds))
 
             bm = slot_bitmask(slot)
             has = ((mask & bm[None, :]) != 0).any(-1)
@@ -163,7 +164,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             mask2 = mask & ~bm[None, :]
             active2 = active.at[slot].set(False)
             return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
-                    failed | newly_failed, failed_op2, overflow, explored)
+                    failed | newly_failed, failed_op2, overflow, explored,
+                    rounds)
 
         new_carry = lax.cond(
             alive,
@@ -183,7 +185,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.bool_(False),                          # failed
                 jnp.int32(-1),                             # failed_op
                 jnp.bool_(False),                          # overflow
-                jnp.int32(0))                              # explored
+                jnp.int32(0),                              # explored
+                jnp.int32(0))                              # closure rounds
 
     def run_chunk(carry, events):
         carry, _ = lax.scan(event_step, carry, events)
@@ -207,7 +210,9 @@ def _get_run_chunk(model: JaxModel, window: int, capacity: int):
            tuple(model.init_state_array().tolist()), window, capacity)
     if key not in _ENGINE_CACHE:
         carry0, _, run_chunk = make_engine(model, window, capacity)
-        _ENGINE_CACHE[key] = (carry0, jax.jit(run_chunk, donate_argnums=0))
+        # No donation: the overflow-resume path re-uses the chunk-boundary
+        # carry snapshot after the call, and the buffers are small anyway.
+        _ENGINE_CACHE[key] = (carry0, jax.jit(run_chunk))
     return _ENGINE_CACHE[key]
 
 
@@ -243,20 +248,26 @@ def check(model: JaxModel, history: Optional[History] = None,
     n_chunks = ev.shape[0] // chunk
 
     cap = capacity
-    while True:
-        carry0, run_chunk = _get_run_chunk(model, window, cap)
-        carry = carry0()
-        failed = overflow = False
-        for ci in range(n_chunks):
-            carry = run_chunk(carry, jnp.asarray(ev[ci * chunk:(ci + 1) * chunk]))
-            failed = bool(carry[6])
-            overflow = bool(carry[8])
-            if failed or overflow:
-                break
+    carry0, run_chunk = _get_run_chunk(model, window, cap)
+    carry = carry0()
+    failed = overflow = False
+    ci = 0
+    while ci < n_chunks:
+        prev = carry  # chunk-boundary snapshot: the resume point on overflow
+        carry = run_chunk(carry, jnp.asarray(ev[ci * chunk:(ci + 1) * chunk]))
+        failed = bool(carry[6])
+        overflow = bool(carry[8])
         if overflow and cap < max_capacity:
+            # Grow the configuration buffers and resume from the snapshot —
+            # no restart, no re-search of the prefix.
             cap = min(cap * 8, max_capacity)
+            _, run_chunk = _get_run_chunk(model, window, cap)
+            carry = _grow_carry(prev, cap)
+            overflow = False
             continue
-        break
+        if failed or overflow:
+            break
+        ci += 1
 
     explored = int(carry[9])
     if overflow:
@@ -266,6 +277,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     if not failed:
         return {"valid": True, "analyzer": "wgl-tpu",
                 "configs-explored": explored,
+                "closure-rounds": int(carry[10]),
                 "window": p.window, "capacity": cap}
     failed_op = p.ops[int(carry[7])]
     res: Dict[str, Any] = {"valid": False, "analyzer": "wgl-tpu",
@@ -275,6 +287,21 @@ def check(model: JaxModel, history: Optional[History] = None,
     if explain and history is not None and model.cpu_model is not None:
         res["witness"] = _cpu_witness(model, history, failed_op)
     return res
+
+
+def _grow_carry(carry, new_capacity: int):
+    """Pad the configuration buffers (mask, states, valid) of a
+    chunk-boundary carry up to a larger capacity; other elements carry over.
+    Gaps are fine — the engine tracks liveness with the valid flags."""
+    mask, states, valid = carry[0], carry[1], carry[2]
+    c = mask.shape[0]
+    extra = new_capacity - c
+    mask2 = jnp.concatenate([mask, jnp.zeros((extra,) + mask.shape[1:],
+                                             mask.dtype)])
+    states2 = jnp.concatenate([states, jnp.zeros((extra,) + states.shape[1:],
+                                                 states.dtype)])
+    valid2 = jnp.concatenate([valid, jnp.zeros(extra, valid.dtype)])
+    return (mask2, states2, valid2) + tuple(carry[3:])
 
 
 def _cpu_witness(model: JaxModel, history: History, failed_op) -> Dict[str, Any]:

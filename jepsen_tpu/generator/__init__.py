@@ -1,0 +1,1167 @@
+"""Pure-functional operation scheduler — the generator DSL.
+
+Parity target: the reference's generator system
+(jepsen/src/jepsen/generator.clj): a *generator* is an immutable value that,
+given the test and a scheduling *context*, either yields an operation (plus
+its successor generator), declares itself :pending (nothing to do yet), or is
+exhausted; and is *updated* with every history event so it can react to
+completions.  The interpreter (jepsen_tpu.generator.interpreter) folds a
+generator into a history.
+
+Protocol (generator.clj:382-390):
+    gen.op(test, ctx)        -> None | (op, gen') | (PENDING, gen')
+    gen.update(test, ctx, ev) -> gen'
+
+Lifting (generator.clj:326-371): plain dicts/Ops are one-shot generators;
+callables are infinite streams of whatever they return (exhausted on None);
+lists/tuples are sequential concatenation.
+
+All combinators of the reference exist here with the same semantics:
+mix, stagger, time_limit, limit, once, repeat, cycle, phases, then, any,
+each_thread, reserve, clients, nemesis, on_threads, f_map, map, filter,
+on_update, synchronize, sleep, delay, log, trace, until_ok, flip_flop,
+process_limit, concurrency_limit, cycle_times, validate.
+
+Randomness flows through a module RNG so the deterministic simulation
+harness (testkit.py, mirroring jepsen.generator.test/simulate) can seed it.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _random_mod
+from dataclasses import dataclass, field, replace
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple, Union)
+
+from jepsen_tpu.history import INVOKE, NEMESIS, OK, Op
+
+PENDING = "pending"
+
+# Module RNG: seedable for deterministic simulation (the reference pins
+# rand-int via with-fixed-rand-int, generator/test.clj:32-48).
+RNG = _random_mod.Random()
+
+
+def seed(n: int) -> None:
+    RNG.seed(n)
+
+
+# ---------------------------------------------------------------------------
+# Context
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Context:
+    """Scheduling context (generator.clj:453-530): logical time (ns), the set
+    of free threads, and the thread->process map (processes migrate to fresh
+    ids when they crash; threads are fixed)."""
+
+    time: int
+    free_threads: frozenset
+    workers: Tuple[Tuple[Any, Any], ...]  # ((thread, process), ...)
+
+    # -- derived ----------------------------------------------------------
+    def worker_map(self) -> Dict[Any, Any]:
+        return dict(self.workers)
+
+    def all_threads(self) -> List[Any]:
+        return [t for t, _ in self.workers]
+
+    def thread_process(self, thread) -> Any:
+        return self.worker_map()[thread]
+
+    def process_thread(self, process) -> Any:
+        for t, p in self.workers:
+            if p == process:
+                return t
+        return None
+
+    def free_processes(self) -> List[Any]:
+        wm = self.worker_map()
+        return [wm[t] for t in self.sorted_free_threads()]
+
+    def sorted_free_threads(self) -> List[Any]:
+        return sorted(self.free_threads, key=_thread_key)
+
+    def some_free_process(self) -> Optional[Any]:
+        """A uniformly random free process (fair scheduling; the reference
+        uses a Bifurcan set for O(1) random nth, generator.clj:437-451).
+
+        Client threads are preferred; the nemesis only receives ops when the
+        context is restricted to it (via the nemesis() wrapper) — unwrapped
+        workload generators never land on the nemesis thread."""
+        free = self.sorted_free_threads()
+        has_client_workers = any(t != NEMESIS for t, _ in self.workers)
+        if has_client_workers:
+            pool = [t for t in free if t != NEMESIS]
+        else:
+            pool = free
+        if not pool:
+            return None
+        return self.worker_map()[RNG.choice(pool)]
+
+    # -- functional updates ----------------------------------------------
+    def with_time(self, time: int) -> "Context":
+        return replace(self, time=time)
+
+    def busy_thread(self, thread) -> "Context":
+        return replace(self, free_threads=self.free_threads - {thread})
+
+    def free_thread(self, thread) -> "Context":
+        return replace(self, free_threads=self.free_threads | {thread})
+
+    def with_next_process(self, thread) -> "Context":
+        """Replace thread's process with its next incarnation (crashed
+        process semantics: p' = p + (#client threads), generator.clj:519-529)."""
+        n = len([t for t, _ in self.workers if t != NEMESIS])
+        wm = self.worker_map()
+        p = wm[thread]
+        wm[thread] = p + n if isinstance(p, int) else p
+        return replace(self, workers=tuple(sorted(wm.items(), key=lambda kv: _thread_key(kv[0]))))
+
+    def restrict(self, threads) -> "Context":
+        """Sub-context visible to a generator bound to `threads`."""
+        tset = set(threads)
+        return replace(
+            self,
+            free_threads=frozenset(t for t in self.free_threads if t in tset),
+            workers=tuple((t, p) for t, p in self.workers if t in tset))
+
+
+def _thread_key(t):
+    return (1, 0) if t == NEMESIS else (0, t)
+
+
+def context(test: Dict[str, Any]) -> Context:
+    """Fresh context for a test map: concurrency client threads + nemesis."""
+    n = int(test.get("concurrency", 1))
+    workers = [(i, i) for i in range(n)] + [(NEMESIS, NEMESIS)]
+    return Context(time=0,
+                   free_threads=frozenset([i for i in range(n)] + [NEMESIS]),
+                   workers=tuple(workers))
+
+
+# ---------------------------------------------------------------------------
+# Generator protocol + lifting
+# ---------------------------------------------------------------------------
+
+
+class Generator:
+    def op(self, test, ctx) -> Optional[Tuple[Any, Optional["Generator"]]]:
+        raise NotImplementedError
+
+    def update(self, test, ctx, event) -> Optional["Generator"]:
+        return self
+
+
+GenLike = Union[Generator, Dict[str, Any], Op, Callable, Sequence, None]
+
+
+def lift(g: GenLike) -> Optional[Generator]:
+    """Coerce a value into a Generator (generator.clj's protocol extension
+    over maps, fns, and seqs)."""
+    if g is None or isinstance(g, Generator):
+        return g
+    if isinstance(g, (dict, Op)):
+        return OpGen(g)
+    if callable(g):
+        return FnGen(g)
+    if isinstance(g, (list, tuple)):
+        return Concat([lift(x) for x in g])
+    raise TypeError(f"can't lift {type(g)} into a Generator")
+
+
+def fill_op(template: Union[Dict, Op], ctx: Context):
+    """Complete an op template with time/process from the context; returns
+    PENDING if it needs a free process and none exists."""
+    if isinstance(template, Op):
+        op = template
+        d_process = op.process
+        op = op.with_(time=ctx.time)
+    else:
+        d = dict(template)
+        d_process = d.get("process")
+        op = Op(process=d_process,
+                type=d.get("type", INVOKE),
+                f=d.get("f"),
+                value=d.get("value"),
+                time=ctx.time,
+                extra={k: v for k, v in d.items()
+                       if k not in ("process", "type", "f", "value", "time")})
+    if op.process is None:
+        p = ctx.some_free_process()
+        if p is None:
+            return PENDING
+        op = op.with_(process=p)
+    else:
+        # A fixed process must be free to dispatch.
+        t = ctx.process_thread(op.process)
+        if t is None or t not in ctx.free_threads:
+            return PENDING
+    return op
+
+
+class OpGen(Generator):
+    """A single op (dict/Op literal): yields exactly one operation."""
+
+    def __init__(self, template):
+        self.template = template
+
+    def op(self, test, ctx):
+        op = fill_op(self.template, ctx)
+        if op is PENDING:
+            return (PENDING, self)
+        return (op, None)
+
+    def __repr__(self):
+        return f"OpGen({self.template!r})"
+
+
+class FnGen(Generator):
+    """A function of () or (test, ctx): an infinite stream; each call's
+    return value is lifted and asked for one op.  Exhausted when the function
+    returns None.  A value produced while dispatch is blocked (:pending) is
+    cached, not discarded — stateful functions see each call delivered."""
+
+    def __init__(self, f, pending_gen: Optional[Generator] = None):
+        self.f = f
+        self.pending_gen = pending_gen
+
+    def op(self, test, ctx):
+        g = self.pending_gen
+        while True:
+            if g is None:
+                try:
+                    v = self.f(test, ctx)
+                except TypeError:
+                    v = self.f()
+                if v is None:
+                    return None
+                g = lift(v)
+            r = g.op(test, ctx)
+            if r is None:
+                g = None  # inner produced nothing; draw the next value
+                continue
+            v, _ = r
+            if v is PENDING:
+                return (PENDING, FnGen(self.f, g))
+            return (v, FnGen(self.f))
+
+    def __repr__(self):
+        return f"FnGen({getattr(self.f, '__name__', self.f)!r})"
+
+
+class Concat(Generator):
+    """Sequential concatenation (generator.clj concat/seq extension): draws
+    from the first non-exhausted element."""
+
+    def __init__(self, gens: Sequence[Optional[Generator]]):
+        self.gens = [g for g in gens if g is not None]
+
+    def op(self, test, ctx):
+        gens = self.gens
+        i = 0
+        while i < len(gens):
+            r = gens[i].op(test, ctx)
+            if r is None:
+                i += 1
+                continue
+            v, g2 = r
+            rest = gens[i + 1:]
+            new = ([g2] if g2 is not None else []) + rest
+            if not new:
+                return (v, None)
+            return (v, Concat(new) if len(new) > 1 else new[0])
+        return None
+
+    def update(self, test, ctx, event):
+        if not self.gens:
+            return self
+        g2 = self.gens[0].update(test, ctx, event)
+        return Concat([g2] + self.gens[1:])
+
+    def __repr__(self):
+        return f"Concat({self.gens!r})"
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+
+
+class _Wrap(Generator):
+    """Base for single-child wrappers; update recurses by default."""
+
+    def __init__(self, gen):
+        self.gen = lift(gen)
+
+    def _new(self, gen) -> "Generator":
+        c = type(self).__new__(type(self))
+        c.__dict__.update(self.__dict__)
+        c.gen = gen
+        return c
+
+    def update(self, test, ctx, event):
+        if self.gen is None:
+            return self
+        return self._new(self.gen.update(test, ctx, event))
+
+
+class Validate(_Wrap):
+    """Assert generator contract on every emitted op
+    (generator.clj:622-676)."""
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is not PENDING:
+            if not isinstance(v, Op):
+                raise ValueError(f"generator yielded non-op {v!r}")
+            if v.process is None or v.time is None or v.f is None:
+                raise ValueError(f"generator yielded incomplete op {v!r}")
+            wm = ctx.worker_map()
+            t = ctx.process_thread(v.process)
+            if t is None:
+                raise ValueError(
+                    f"op process {v.process!r} is not a worker: {wm}")
+        return (v, self._new(g2) if g2 is not None else None)
+
+
+def validate(gen):
+    return Validate(gen)
+
+
+class Map(_Wrap):
+    """Transform every emitted op with f (generator.clj map at 782)."""
+
+    def __init__(self, f, gen):
+        super().__init__(gen)
+        self.f = f
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        v2 = v if v is PENDING else self.f(v)
+        return (v2, self._new(g2) if g2 is not None else None)
+
+
+def gen_map(f, gen):
+    return Map(f, gen)
+
+
+def f_map(fmap: Dict[Any, Any], gen):
+    """Rewrite op :f values through a mapping (generator.clj:790; used by
+    nemesis composition)."""
+    return Map(lambda op: op.with_(f=fmap.get(op.f, op.f)), gen)
+
+
+class Filter(_Wrap):
+    """Drop emitted ops failing the predicate (generator.clj:812)."""
+
+    def __init__(self, pred, gen):
+        super().__init__(gen)
+        self.pred = pred
+
+    def op(self, test, ctx):
+        gen = self.gen
+        while gen is not None:
+            r = gen.op(test, ctx)
+            if r is None:
+                return None
+            v, g2 = r
+            if v is PENDING or self.pred(v):
+                return (v, self._new(g2) if g2 is not None else None)
+            gen = g2
+        return None
+
+
+def gen_filter(pred, gen):
+    return Filter(pred, gen)
+
+
+class OnUpdate(_Wrap):
+    """Call (f this test ctx event) on updates (generator.clj:836)."""
+
+    def __init__(self, f, gen):
+        super().__init__(gen)
+        self.f = f
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        return (v, self._new(g2) if g2 is not None else None)
+
+    def update(self, test, ctx, event):
+        return self.f(self, test, ctx, event)
+
+
+def on_update(f, gen):
+    return OnUpdate(f, gen)
+
+
+class OnThreads(_Wrap):
+    """Restrict a generator to a subset of threads (generator.clj:844-882);
+    both op and update see a filtered context."""
+
+    def __init__(self, pred, gen):
+        super().__init__(gen)
+        if callable(pred) and not isinstance(pred, (set, frozenset)):
+            self.pred = pred
+        else:
+            s = set(pred) if not isinstance(pred, (set, frozenset)) else pred
+            self.pred = lambda t: t in s
+
+    def _threads(self, ctx):
+        return [t for t in ctx.all_threads() if self.pred(t)]
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        sub = ctx.restrict(self._threads(ctx))
+        r = self.gen.op(test, sub)
+        if r is None:
+            return None
+        v, g2 = r
+        return (v, self._new(g2) if g2 is not None else None)
+
+    def update(self, test, ctx, event):
+        t = ctx.process_thread(getattr(event, "process", None))
+        if t is None or not self.pred(t):
+            return self
+        sub = ctx.restrict(self._threads(ctx))
+        return self._new(self.gen.update(test, sub, event))
+
+
+def on_threads(pred, gen):
+    return OnThreads(pred, gen)
+
+
+on = on_threads
+
+
+def clients(gen):
+    """Ops only on client threads (generator.clj:1093)."""
+    return OnThreads(lambda t: t != NEMESIS, gen)
+
+
+def nemesis(gen):
+    """Ops only on the nemesis thread (generator.clj:1105)."""
+    return OnThreads(lambda t: t == NEMESIS, gen)
+
+
+class Any(Generator):
+    """Race: each call takes an op from whichever child can produce the
+    soonest one (generator.clj:946)."""
+
+    def __init__(self, *gens):
+        self.gens = [lift(g) for g in gens if g is not None]
+
+    def op(self, test, ctx):
+        best = None
+        best_i = -1
+        soonest = math.inf
+        pending_any = False
+        gens = list(self.gens)
+        for i, g in enumerate(self.gens):
+            r = g.op(test, ctx)
+            if r is None:
+                continue
+            v, g2 = r
+            if v is PENDING:
+                pending_any = True
+                if g2 is not None:
+                    gens[i] = g2
+                continue
+            if v.time < soonest:
+                soonest = v.time
+                best = (v, g2)
+                best_i = i
+        if best is None:
+            return (PENDING, Any(*gens)) if pending_any else None
+        v, g2 = best
+        gens = list(self.gens)
+        if g2 is None:
+            gens.pop(best_i)
+        else:
+            gens[best_i] = g2
+        if not gens:
+            return (v, None)
+        return (v, Any(*gens))
+
+    def update(self, test, ctx, event):
+        return Any(*[g.update(test, ctx, event) for g in self.gens])
+
+
+def any_gen(*gens):
+    return Any(*gens)
+
+
+class EachThread(_Wrap):
+    """Every thread runs its own fresh copy of the generator
+    (generator.clj:1001)."""
+
+    def __init__(self, gen):
+        self.proto = lift(gen)
+        self.per: Dict[Any, Optional[Generator]] = {}
+        self.started: set = set()
+
+    def _copy(self):
+        c = EachThread.__new__(EachThread)
+        c.proto = self.proto
+        c.per = dict(self.per)
+        c.started = set(self.started)
+        return c
+
+    def _gen_for(self, t):
+        if t not in self.started:
+            return self.proto
+        return self.per.get(t)
+
+    def op(self, test, ctx):
+        pending = False
+        cur = self
+        for t in ctx.sorted_free_threads():
+            g = cur._gen_for(t)
+            if g is None:
+                continue
+            sub = ctx.restrict([t])
+            r = g.op(test, sub)
+            if r is None:
+                continue
+            v, g2 = r
+            if v is PENDING:
+                pending = True
+                if g2 is not None:
+                    cur = cur._copy()
+                    cur.started.add(t)
+                    cur.per[t] = g2
+                continue
+            c = cur._copy()
+            c.started.add(t)
+            c.per[t] = g2
+            return (v, c)
+        all_done = all(cur._gen_for(t) is None for t in ctx.all_threads())
+        if all_done:
+            return None
+        return (PENDING, cur)
+
+    def update(self, test, ctx, event):
+        t = ctx.process_thread(getattr(event, "process", None))
+        if t is None:
+            return self
+        g = self._gen_for(t)
+        if g is None:
+            return self
+        c = self._copy()
+        c.started.add(t)
+        c.per[t] = g.update(test, ctx.restrict([t]), event)
+        return c
+
+
+def each_thread(gen):
+    return EachThread(gen)
+
+
+class Reserve(Generator):
+    """Partition client threads into ranges, each with its own sub-generator;
+    remaining threads run the default (generator.clj:1056-1092)."""
+
+    def __init__(self, *args):
+        if len(args) % 2 != 1:
+            raise ValueError("reserve takes n1, gen1, n2, gen2, ..., default")
+        self.counts = [int(args[i]) for i in range(0, len(args) - 1, 2)]
+        gens = [lift(args[i]) for i in range(1, len(args) - 1, 2)]
+        self.default = lift(args[-1])
+        self.gens = gens
+
+    def _ranges(self, ctx):
+        threads = [t for t in ctx.all_threads() if t != NEMESIS]
+        out = []
+        i = 0
+        for n in self.counts:
+            out.append(threads[i:i + n])
+            i += n
+        rest = threads[i:] + [NEMESIS]
+        return out, rest
+
+    def op(self, test, ctx):
+        ranges, rest = self._ranges(ctx)
+        soonest = None
+        pending = False
+        pieces = list(zip(ranges, self.gens)) + [(rest, self.default)]
+        for i, (threads, g) in enumerate(pieces):
+            if g is None:
+                continue
+            r = g.op(test, ctx.restrict(threads))
+            if r is None:
+                continue
+            v, g2 = r
+            if v is PENDING:
+                pending = True
+                continue
+            if soonest is None or v.time < soonest[0].time:
+                soonest = (v, i, g2)
+        if soonest is None:
+            return (PENDING, self) if pending else None
+        v, i, g2 = soonest
+        c = Reserve.__new__(Reserve)
+        c.counts = self.counts
+        c.default = self.default
+        c.gens = list(self.gens)
+        if i == len(pieces) - 1:
+            c.default = g2
+        else:
+            c.gens[i] = g2
+        return (v, c)
+
+    def update(self, test, ctx, event):
+        t = ctx.process_thread(getattr(event, "process", None))
+        if t is None:
+            return self
+        ranges, rest = self._ranges(ctx)
+        c = Reserve.__new__(Reserve)
+        c.counts = self.counts
+        c.default = self.default
+        c.gens = list(self.gens)
+        for i, threads in enumerate(ranges):
+            if t in threads and c.gens[i] is not None:
+                c.gens[i] = c.gens[i].update(test, ctx.restrict(threads), event)
+                return c
+        if c.default is not None:
+            c.default = c.default.update(test, ctx.restrict(rest), event)
+        return c
+
+
+def reserve(*args):
+    return Reserve(*args)
+
+
+class Mix(Generator):
+    """Uniformly choose among sub-generators per op; exhausted children drop
+    out (generator.clj:1140)."""
+
+    def __init__(self, gens):
+        self.gens = [lift(g) for g in gens if g is not None]
+
+    def op(self, test, ctx):
+        gens = list(self.gens)
+        order = list(range(len(gens)))
+        RNG.shuffle(order)
+        pending = False
+        for i in order:
+            r = gens[i].op(test, ctx)
+            if r is None:
+                gens2 = gens[:i] + gens[i + 1:]
+                if not gens2:
+                    return None
+                return Mix(gens2).op(test, ctx)
+            v, g2 = r
+            if v is PENDING:
+                pending = True
+                if g2 is not None:
+                    gens[i] = g2
+                continue
+            if g2 is None:
+                gens2 = gens[:i] + gens[i + 1:]
+            else:
+                gens2 = gens
+                gens2[i] = g2
+            return (v, Mix(gens2) if gens2 else None)
+        return (PENDING, Mix(gens)) if pending else None
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def mix(gens):
+    return Mix(gens)
+
+
+class Limit(_Wrap):
+    """At most n ops (generator.clj:1166)."""
+
+    def __init__(self, n, gen):
+        super().__init__(gen)
+        self.n = n
+
+    def op(self, test, ctx):
+        if self.n <= 0 or self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        c = self._new(g2)
+        c.n = self.n - 1
+        return (v, c if (c.gen is not None and c.n > 0) else None)
+
+
+def limit(n, gen):
+    return Limit(n, gen)
+
+
+def once(gen):
+    return Limit(1, gen)
+
+
+class Repeat(_Wrap):
+    """Repeat the generator's next op forever (or n times): like the
+    reference's repeat (generator.clj:1196), each emitted op comes from the
+    same (non-advancing) generator."""
+
+    def __init__(self, gen, n=None):
+        super().__init__(gen)
+        self.n = n
+
+    def op(self, test, ctx):
+        if self.gen is None or (self.n is not None and self.n <= 0):
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        c = self._new(self.gen)
+        if self.n is not None:
+            c.n = self.n - 1
+            if c.n <= 0:
+                return (v, None)
+        return (v, c)
+
+
+def repeat(gen, n=None):
+    return Repeat(gen, n)
+
+
+class Cycle(_Wrap):
+    """Restart the generator when it exhausts (generator.clj:1228)."""
+
+    def __init__(self, gen, n=None):
+        super().__init__(gen)
+        self.proto = self.gen
+        self.n = n
+
+    def op(self, test, ctx):
+        if self.n is not None and self.n <= 0:
+            return None
+        r = self.gen.op(test, ctx) if self.gen is not None else None
+        if r is None:
+            n2 = None if self.n is None else self.n - 1
+            if n2 is not None and n2 <= 0:
+                return None
+            c = Cycle.__new__(Cycle)
+            c.proto = self.proto
+            c.gen = self.proto
+            c.n = n2
+            r = c.gen.op(test, ctx)
+            if r is None:
+                return None
+            v, g2 = r
+            c2 = c._new(g2 if g2 is not None else None)
+            c2.proto = self.proto
+            return (v, c2)
+        v, g2 = r
+        c = self._new(g2)
+        c.proto = self.proto
+        return (v, c)
+
+
+def cycle(gen, n=None):
+    return Cycle(gen, n)
+
+
+class ProcessLimit(_Wrap):
+    """Stop after n distinct processes have participated
+    (generator.clj:1253)."""
+
+    def __init__(self, n, gen):
+        super().__init__(gen)
+        self.n = n
+        self.seen: frozenset = frozenset()
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        seen = self.seen | {v.process}
+        if len(seen) > self.n:
+            return None
+        c = self._new(g2)
+        c.seen = seen
+        return (v, c if c.gen is not None else None)
+
+
+def process_limit(n, gen):
+    return ProcessLimit(n, gen)
+
+
+class TimeLimit(_Wrap):
+    """Cut off after dt seconds of logical time (generator.clj:1286)."""
+
+    def __init__(self, dt_s, gen):
+        super().__init__(gen)
+        self.deadline: Optional[int] = None
+        self.dt = int(dt_s * 1e9)
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        deadline = self.deadline if self.deadline is not None \
+            else ctx.time + self.dt
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is not PENDING and v.time >= deadline:
+            return None
+        c = self._new(g2)
+        c.deadline = deadline
+        if v is PENDING:
+            return (PENDING, c)
+        return (v, c if c.gen is not None else None)
+
+
+def time_limit(dt_s, gen):
+    return TimeLimit(dt_s, gen)
+
+
+class Stagger(_Wrap):
+    """Poisson-ish pacing: uniform random delay with mean dt seconds between
+    ops across the whole generator (generator.clj:1315)."""
+
+    def __init__(self, dt_s, gen):
+        super().__init__(gen)
+        self.dt2 = 2 * dt_s * 1e9
+        self.next_time: Optional[int] = None
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        nt = self.next_time if self.next_time is not None else ctx.time
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            c = self._new(g2)
+            c.next_time = nt
+            return (PENDING, c)
+        t = max(nt, v.time)
+        c = self._new(g2)
+        c.next_time = t + int(RNG.random() * self.dt2)
+        v = v.with_(time=t)
+        return (v, c if c.gen is not None else c)
+
+
+def stagger(dt_s, gen):
+    return Stagger(dt_s, gen)
+
+
+class DelayGen(_Wrap):
+    """Exactly dt seconds between ops (generator.clj:1385)."""
+
+    def __init__(self, dt_s, gen):
+        super().__init__(gen)
+        self.dt = int(dt_s * 1e9)
+        self.next_time: Optional[int] = None
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        nt = self.next_time if self.next_time is not None else v.time
+        t = max(nt, v.time)
+        c = self._new(g2)
+        c.next_time = t + self.dt
+        return (v.with_(time=t), c if c.gen is not None else None)
+
+
+def delay(dt_s, gen):
+    return DelayGen(dt_s, gen)
+
+
+class Sleep(Generator):
+    """Emit nothing for dt seconds, then exhaust (generator.clj:1397)."""
+
+    def __init__(self, dt_s):
+        self.dt = int(dt_s * 1e9)
+        self.deadline: Optional[int] = None
+
+    def op(self, test, ctx):
+        deadline = self.deadline if self.deadline is not None \
+            else ctx.time + self.dt
+        if ctx.time >= deadline:
+            return None
+        c = Sleep.__new__(Sleep)
+        c.dt = self.dt
+        c.deadline = deadline
+        return (PENDING, c)
+
+
+def sleep(dt_s):
+    return Sleep(dt_s)
+
+
+class Synchronize(_Wrap):
+    """Wait for all threads to be free before the wrapped generator starts
+    (generator.clj:1420)."""
+
+    def __init__(self, gen):
+        super().__init__(gen)
+        self.released = False
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        if not self.released and len(ctx.free_threads) < len(ctx.workers):
+            return (PENDING, self)
+        c = self._new(self.gen)
+        c.released = True
+        return c.gen_op_through(test, ctx)
+
+    def gen_op_through(self, test, ctx):
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        c = self._new(g2)
+        c.released = True
+        return (v, c if c.gen is not None else None)
+
+
+def synchronize(gen):
+    return Synchronize(gen)
+
+
+def phases(*gens):
+    """Each phase waits for quiescence before starting
+    (generator.clj:1425)."""
+    return Concat([Synchronize(g) for g in gens])
+
+
+def then(a, b):
+    """b, then a — argument order matches the reference's ->> threading
+    (generator.clj:1432)."""
+    return Concat([lift(b), Synchronize(a)])
+
+
+class LogGen(Generator):
+    """Emit a log message into the interpreter's logging (generator.clj:1177);
+    modeled as a :log op on no thread — interpreters treat it specially."""
+
+    def __init__(self, msg):
+        self.msg = msg
+        self.done = False
+
+    def op(self, test, ctx):
+        if self.done:
+            return None
+        op = Op(process=NEMESIS, type="log", f="log", value=self.msg,
+                time=ctx.time)
+        return (op, None)
+
+
+def log(msg):
+    return LogGen(msg)
+
+
+class Trace(_Wrap):
+    """Print every op/update flowing through (generator.clj:720-764)."""
+
+    def __init__(self, name, gen):
+        super().__init__(gen)
+        self.name = name
+
+    def op(self, test, ctx):
+        r = self.gen.op(test, ctx) if self.gen is not None else None
+        print(f"[gen-trace {self.name}] op -> "
+              f"{None if r is None else r[0]!r}")
+        if r is None:
+            return None
+        v, g2 = r
+        return (v, self._new(g2) if g2 is not None else None)
+
+    def update(self, test, ctx, event):
+        print(f"[gen-trace {self.name}] update <- {event!r}")
+        return super().update(test, ctx, event)
+
+
+def trace(name, gen):
+    return Trace(name, gen)
+
+
+class UntilOk(_Wrap):
+    """Retry the generator's ops until one completes :ok
+    (generator.clj:1469)."""
+
+    def __init__(self, gen):
+        super().__init__(gen)
+        self.done = False
+
+    def op(self, test, ctx):
+        if self.done or self.gen is None:
+            return None
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        # Keep our own generator alive; completion flips done.
+        c = self._new(g2 if g2 is not None else self.gen)
+        return (v, c)
+
+    def update(self, test, ctx, event):
+        c = self._new(self.gen.update(test, ctx, event)
+                      if self.gen is not None else None)
+        if getattr(event, "type", None) == OK:
+            c.done = True
+        return c
+
+
+def until_ok(gen):
+    return UntilOk(gen)
+
+
+class FlipFlop(Generator):
+    """Alternate between two generators on each op (generator.clj:1485)."""
+
+    def __init__(self, a, b, turn=0):
+        self.gens = [lift(a), lift(b)]
+        self.turn = turn
+
+    def op(self, test, ctx):
+        g = self.gens[self.turn]
+        if g is None:
+            return None
+        r = g.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            pair = list(self.gens)
+            pair[self.turn] = g2
+            return (PENDING, FlipFlop(pair[0], pair[1], self.turn))
+        pair = list(self.gens)
+        pair[self.turn] = g2
+        return (v, FlipFlop(pair[0], pair[1], (self.turn + 1) % 2))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def flip_flop(a, b):
+    return FlipFlop(a, b)
+
+
+class CycleTimes(Generator):
+    """Rotate between generators on a wall-clock schedule: spend t_i seconds
+    in gen_i, cycling (generator.clj:1557)."""
+
+    def __init__(self, *args, _start=None, _i=0):
+        if len(args) % 2 != 0:
+            raise ValueError("cycle_times takes t1, gen1, t2, gen2, ...")
+        self.durations = [int(args[i] * 1e9) for i in range(0, len(args), 2)]
+        self.gens = [lift(args[i]) for i in range(1, len(args), 2)]
+        self.start = _start
+        self.i = _i
+
+    def _clone(self, **kw):
+        c = CycleTimes.__new__(CycleTimes)
+        c.durations = self.durations
+        c.gens = list(self.gens)
+        c.start = kw.get("start", self.start)
+        c.i = kw.get("i", self.i)
+        return c
+
+    def op(self, test, ctx):
+        start = self.start if self.start is not None else ctx.time
+        i = self.i
+        # advance phase by logical time
+        while ctx.time >= start + self.durations[i]:
+            start += self.durations[i]
+            i = (i + 1) % len(self.gens)
+        g = self.gens[i]
+        if g is None:
+            return None
+        r = g.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        c = self._clone(start=start, i=i)
+        c.gens[i] = g2 if g2 is not None else c.gens[i]
+        if v is PENDING:
+            return (PENDING, c)
+        return (v, c)
+
+    def update(self, test, ctx, event):
+        c = self._clone()
+        c.gens = [g.update(test, ctx, event) if g is not None else None
+                  for g in self.gens]
+        return c
+
+
+def cycle_times(*args):
+    return CycleTimes(*args)
+
+
+class ConcurrencyLimit(_Wrap):
+    """At most n of this generator's ops outstanding at once."""
+
+    def __init__(self, n, gen):
+        super().__init__(gen)
+        self.n = n
+        self.outstanding: frozenset = frozenset()
+
+    def op(self, test, ctx):
+        if self.gen is None:
+            return None
+        if len(self.outstanding) >= self.n:
+            return (PENDING, self)
+        r = self.gen.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is PENDING:
+            return (PENDING, self._new(g2))
+        c = self._new(g2)
+        c.outstanding = self.outstanding | {v.process}
+        return (v, c if c.gen is not None or c.outstanding else None)
+
+    def update(self, test, ctx, event):
+        c = self._new(self.gen.update(test, ctx, event)
+                      if self.gen is not None else None)
+        if getattr(event, "type", None) in (OK, "fail", "info"):
+            c.outstanding = self.outstanding - {event.process}
+        return c
+
+
+def concurrency_limit(n, gen):
+    return ConcurrencyLimit(n, gen)

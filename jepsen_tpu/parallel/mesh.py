@@ -1,0 +1,29 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(shape: Optional[Tuple[int, ...]] = None,
+              axis_names: Sequence[str] = ("data", "model"),
+              devices=None) -> Mesh:
+    """Build a mesh over available devices.
+
+    Default: all devices on the ``data`` axis, 1 on ``model``; pass an
+    explicit shape (e.g. ``(4, 2)``) to split.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n != len(devs):
+        devs = devs[:n]
+        if len(devs) != n:
+            raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                             f"have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(shape), tuple(axis_names))

@@ -1,0 +1,122 @@
+"""Frontier sharding: one history's configuration set split across a mesh.
+
+The long-history analog of sequence parallelism (SURVEY.md §5.7): instead of
+splitting a history into short per-key pieces the way the reference must
+(jepsen/src/jepsen/independent.clj:1-7), the configuration frontier itself is
+sharded over the ``model`` mesh axis.  Each device expands its local shard of
+configurations (vmapped model steps), candidates are exchanged with
+all_gather over ICI, every device deduplicates the global set identically
+(replicated sort), and keeps its deterministic slice.  Failure/overflow flags
+are psum-reduced so all shards agree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from jepsen_tpu.checker.prep import PreparedHistory, prepare
+from jepsen_tpu.checker.wgl_tpu import events_array, make_engine
+from jepsen_tpu.history import History
+from jepsen_tpu.models.base import JaxModel
+
+_CACHE: Dict[Any, Any] = {}
+
+
+def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
+                    mesh: Mesh, axis: str):
+    key = ("shard", model.name, model.state_size,
+           tuple(model.init_state_array().tolist()), window,
+           capacity_per_shard, id(mesh), axis)
+    if key in _CACHE:
+        return _CACHE[key]
+    n = mesh.shape[axis]
+    _, _, run_chunk = make_engine(model, window, capacity_per_shard,
+                                  axis_name=axis, num_shards=n)
+    # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
+    #               dirty, failed, failed_op, overflow, explored)
+    sharded = P(axis)
+    repl = P()
+    in_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
+                 repl, repl, repl), repl)
+    out_specs = (sharded, sharded, sharded, repl, repl, repl, repl, repl,
+                 repl, repl, repl)
+    # check_vma=False: closure dedup sorts the *gathered* global row set, so
+    # every shard computes bit-identical "replicated" scalars (counts, flags),
+    # but the varying-axes checker can't prove that post-all_gather.
+    fn = jax.jit(shard_map(run_chunk, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False))
+    _CACHE[key] = fn
+    return fn
+
+
+def check_sharded(model: JaxModel,
+                  history: Optional[History] = None,
+                  prepared: Optional[PreparedHistory] = None,
+                  mesh: Optional[Mesh] = None,
+                  axis: str = "model",
+                  capacity_per_shard: int = 1024,
+                  max_capacity_per_shard: int = 65536,
+                  chunk: int = 2048,
+                  max_window: int = 4096) -> Dict[str, Any]:
+    """Frontier-sharded linearizability check of one history."""
+    assert mesh is not None, "check_sharded requires a mesh"
+    p = prepared if prepared is not None else prepare(
+        history, model, max_window=max_window)
+    window = max(32, ((p.window + 31) // 32) * 32)
+    ev = events_array(p, chunk)
+    n_chunks = ev.shape[0] // chunk
+    n = mesh.shape[axis]
+    MW, S = window // 32, model.state_size
+
+    cap = capacity_per_shard
+    while True:
+        run = _sharded_runner(model, window, cap, mesh, axis)
+        gcap = cap * n
+        shard_rows = NamedSharding(mesh, P(axis))
+
+        def put(x, spec):
+            return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+        carry = (
+            put(np.zeros((gcap, MW), np.uint32), P(axis)),
+            put(np.tile(model.init_state_array()[None], (gcap, 1)), P(axis)),
+            put(np.arange(gcap) == 0, P(axis)),
+            put(np.zeros((window, 3), np.int32), P()),
+            put(np.zeros(window, bool), P()),
+            put(np.bool_(False), P()),
+            put(np.bool_(False), P()),
+            put(np.int32(-1), P()),
+            put(np.bool_(False), P()),
+            put(np.int32(0), P()),
+            put(np.int32(0), P()),
+        )
+        failed = overflow = False
+        for ci in range(n_chunks):
+            carry = run(carry, put(ev[ci * chunk:(ci + 1) * chunk], P()))
+            failed = bool(carry[6])
+            overflow = bool(carry[8])
+            if failed or overflow:
+                break
+        if overflow and cap < max_capacity_per_shard:
+            cap = min(cap * 8, max_capacity_per_shard)
+            continue
+        break
+
+    explored = int(carry[9])
+    if overflow:
+        return {"valid": "unknown", "analyzer": "wgl-tpu-sharded",
+                "error": f"capacity exceeded at {cap}x{n}",
+                "configs-explored": explored}
+    if not failed:
+        return {"valid": True, "analyzer": "wgl-tpu-sharded",
+                "configs-explored": explored, "shards": n,
+                "capacity": cap * n}
+    return {"valid": False, "analyzer": "wgl-tpu-sharded",
+            "op": p.ops[int(carry[7])].to_dict(),
+            "configs-explored": explored, "shards": n}

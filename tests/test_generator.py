@@ -1,0 +1,212 @@
+"""Generator DSL semantics via the deterministic simulator
+(mirrors the reference's generator test approach: fixed seed, no threads)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import testkit
+from jepsen_tpu.history import FAIL, INFO, INVOKE, NEMESIS, OK, Op
+
+
+def invokes(h):
+    return [o for o in h if o.type == INVOKE]
+
+
+class TestLifting:
+    def test_dict_is_one_shot(self):
+        h = testkit.quick({"f": "read"})
+        assert len(invokes(h)) == 1
+        assert invokes(h)[0].f == "read"
+
+    def test_list_concats(self):
+        h = testkit.quick([{"f": "a"}, {"f": "b"}, {"f": "c"}])
+        assert [o.f for o in invokes(h)] == ["a", "b", "c"]
+
+    def test_fn_is_infinite_stream(self):
+        counter = {"n": 0}
+
+        def f():
+            counter["n"] += 1
+            return {"f": "w", "value": counter["n"]}
+
+        h = testkit.quick(gen.limit(5, f))
+        assert [o.value for o in invokes(h)] == [1, 2, 3, 4, 5]
+
+    def test_fn_exhausts_on_none(self):
+        state = {"n": 0}
+
+        def f():
+            state["n"] += 1
+            return {"f": "x"} if state["n"] <= 3 else None
+
+        h = testkit.quick(f)
+        assert len(invokes(h)) == 3
+
+
+class TestCombinators:
+    def test_limit_and_once(self):
+        h = testkit.quick(gen.once(lambda: {"f": "r"}))
+        assert len(invokes(h)) == 1
+
+    def test_repeat(self):
+        h = testkit.quick(gen.repeat({"f": "r"}, n=4))
+        assert [o.f for o in invokes(h)] == ["r"] * 4
+
+    def test_cycle(self):
+        h = testkit.quick(gen.cycle([{"f": "a"}, {"f": "b"}], n=3))
+        assert [o.f for o in invokes(h)] == ["a", "b"] * 3
+
+    def test_mix_draws_from_all(self):
+        r = {"f": "read"}
+        w = {"f": "write"}
+        h = testkit.quick(gen.limit(50, gen.mix([gen.repeat(r), gen.repeat(w)])))
+        fs = {o.f for o in invokes(h)}
+        assert fs == {"read", "write"}
+        assert len(invokes(h)) == 50
+
+    def test_map_transforms(self):
+        h = testkit.quick(gen.gen_map(lambda op: op.with_(value=42),
+                                      {"f": "r"}))
+        assert invokes(h)[0].value == 42
+
+    def test_f_map(self):
+        h = testkit.quick(gen.f_map({"start": "start-partition"},
+                                    {"f": "start"}))
+        assert invokes(h)[0].f == "start-partition"
+
+    def test_filter(self):
+        seq = [{"f": "a", "value": i} for i in range(10)]
+        h = testkit.quick(gen.gen_filter(lambda op: op.value % 2 == 0, seq))
+        assert [o.value for o in invokes(h)] == [0, 2, 4, 6, 8]
+
+    def test_stagger_spaces_ops(self):
+        h = testkit.quick(gen.stagger(0.1, gen.limit(20, lambda: {"f": "r"})),
+                          concurrency=1)
+        times = [o.time for o in invokes(h)]
+        assert times == sorted(times)
+        # mean gap should be ~100ms; loose bounds
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        mean = sum(gaps) / len(gaps)
+        assert 20e6 < mean < 400e6
+
+    def test_delay_exact_spacing(self):
+        h = testkit.quick(gen.delay(0.05, gen.limit(5, lambda: {"f": "r"})),
+                          concurrency=1)
+        times = [o.time for o in invokes(h)]
+        gaps = {b - a for a, b in zip(times, times[1:])}
+        assert gaps == {50_000_000}
+
+    def test_time_limit(self):
+        h = testkit.quick(
+            gen.time_limit(1.0, gen.delay(0.3, gen.repeat(lambda: {"f": "r"}))),
+            concurrency=1)
+        assert 2 <= len(invokes(h)) <= 4
+        assert all(o.time < 1.1e9 for o in invokes(h))
+
+    def test_process_limit(self):
+        h = testkit.quick(gen.process_limit(2, gen.repeat({"f": "r"}, n=50)),
+                          concurrency=2)
+        assert len({o.process for o in invokes(h)}) <= 2
+
+    def test_flip_flop(self):
+        h = testkit.quick(gen.limit(6, gen.flip_flop(
+            gen.repeat({"f": "a"}), gen.repeat({"f": "b"}))))
+        assert [o.f for o in invokes(h)] == ["a", "b", "a", "b", "a", "b"]
+
+    def test_any_picks_soonest(self):
+        a = [gen.sleep(0.5), {"f": "slow"}]
+        b = [gen.sleep(0.1), {"f": "fast"}]
+        h = testkit.quick(gen.any_gen(a, b), concurrency=4)
+        fs = [o.f for o in invokes(h)]
+        assert fs[0] == "fast"
+        assert set(fs) == {"slow", "fast"}
+
+    def test_sleep_then(self):
+        h = testkit.quick([gen.sleep(0.5), {"f": "late"}], concurrency=1)
+        op = invokes(h)[0]
+        assert op.time >= 0.5e9
+
+
+class TestThreads:
+    def test_clients_vs_nemesis_routing(self):
+        g = [gen.nemesis(gen.limit(2, lambda: {"f": "kill", "type": "info"})),
+             gen.clients(gen.limit(3, lambda: {"f": "read"}))]
+        h = testkit.quick(g, concurrency=3)
+        kills = [o for o in h if o.f == "kill" and o.type == "info"]
+        reads = invokes(h)
+        assert all(o.process == NEMESIS for o in kills)
+        assert all(o.process != NEMESIS for o in reads)
+        assert len(kills) == 2 and len(reads) == 3
+
+    def test_each_thread(self):
+        h = testkit.quick(gen.each_thread({"f": "hi"}), concurrency=3)
+        procs = sorted(o.process for o in invokes(h) if o.process != NEMESIS)
+        # nemesis thread also runs a copy
+        assert procs == [0, 1, 2]
+        assert len(invokes(h)) == 4
+
+    def test_reserve_partitions_threads(self):
+        g = gen.reserve(2, gen.repeat({"f": "a"}, n=10),
+                        gen.repeat({"f": "b"}, n=10))
+        h = testkit.quick(gen.time_limit(2.0, g), concurrency=5)
+        a_procs = {o.process for o in invokes(h) if o.f == "a"}
+        b_procs = {o.process for o in invokes(h) if o.f == "b"}
+        assert a_procs <= {0, 1}
+        assert b_procs <= {2, 3, 4, NEMESIS}
+        assert a_procs and b_procs
+
+    def test_phases_synchronize(self):
+        g = gen.phases(gen.limit(4, lambda: {"f": "p1"}),
+                       gen.limit(4, lambda: {"f": "p2"}))
+        h = testkit.quick(g, concurrency=2)
+        last_p1 = max(o.time for o in h if o.f == "p1" and o.type == OK)
+        first_p2 = min(o.time for o in h if o.f == "p2" and o.type == INVOKE)
+        assert first_p2 >= last_p1
+
+    def test_until_ok_retries_failures(self):
+        attempts = {"n": 0}
+
+        def complete(op):
+            attempts["n"] += 1
+            return (1_000_000, FAIL if attempts["n"] < 3 else OK)
+
+        h = testkit.quick(gen.until_ok(gen.repeat({"f": "w"})),
+                          complete_fn=complete, concurrency=1)
+        assert [o.type for o in h if o.type in (OK, FAIL)] == [FAIL, FAIL, OK]
+
+    def test_crashed_process_migrates(self):
+        def complete(op):
+            return (1_000_000, INFO)
+
+        h = testkit.quick(gen.limit(3, gen.repeat(lambda: {"f": "w"})),
+                          complete_fn=complete, concurrency=1)
+        procs = [o.process for o in invokes(h)]
+        # each crash burns a process id: 0, 1, 2 (thread count 1)
+        assert procs == [0, 1, 2]
+
+
+class TestValidate:
+    def test_rejects_bad_ops(self):
+        with pytest.raises(ValueError):
+            testkit.quick(lambda: {"value": 1})  # no :f
+
+    def test_accepts_good(self):
+        h = testkit.quick({"f": "ok"})
+        assert len(invokes(h)) == 1
+
+
+class TestPerf:
+    def test_scheduler_throughput(self):
+        """The reference cites >20k ops/s for pure generator scheduling
+        (generator.clj:67-70); assert we're within striking distance in the
+        simulator (which also pays completion costs)."""
+        import time
+        g = gen.limit(20_000, gen.mix([gen.repeat({"f": "r"}),
+                                       gen.repeat({"f": "w", "value": 1})]))
+        t0 = time.time()
+        h = testkit.quick(g, concurrency=10, complete_fn=testkit.instant)
+        dt = time.time() - t0
+        n = len([o for o in h if o.type == INVOKE])
+        assert n == 20_000
+        rate = n / dt
+        assert rate > 5_000, f"scheduler too slow: {rate:.0f} ops/s"

@@ -1,0 +1,75 @@
+"""Mesh-parallel engines on the 8-virtual-device CPU backend."""
+
+import jax
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister, get_model
+from jepsen_tpu.parallel import check_batch, check_sharded, make_mesh
+from jepsen_tpu.synth import cas_register_history, corrupt_reads
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model("cas-register")
+
+
+class TestMesh:
+    def test_make_mesh_default(self):
+        mesh = make_mesh()
+        assert mesh.shape["data"] == 8 and mesh.shape["model"] == 1
+
+    def test_make_mesh_2d(self):
+        mesh = make_mesh((4, 2))
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+
+class TestBatch:
+    def test_batch_unsharded(self, model):
+        hs = [cas_register_history(100, concurrency=4, seed=s) for s in range(3)]
+        hs.append(corrupt_reads(hs[0], n=1, seed=9))
+        rs = check_batch(model, hs, capacity=128, chunk=256)
+        assert [r["valid"] for r in rs] == [True, True, True, False]
+
+    def test_batch_sharded_over_data(self, model):
+        mesh = make_mesh((8, 1))
+        hs = [cas_register_history(80, concurrency=4, seed=s) for s in range(5)]
+        hs.insert(2, corrupt_reads(hs[1], n=1, seed=3))
+        rs = check_batch(model, hs, mesh=mesh, capacity=128, chunk=256)
+        expect = [wgl_cpu.check(CASRegister(), h)["valid"] for h in hs]
+        assert [r["valid"] for r in rs] == expect
+        assert expect.count(False) == 1
+
+    def test_batch_empty(self, model):
+        assert check_batch(model, []) == []
+
+
+class TestSharded:
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_sharded_matches_oracle(self, model, shards):
+        mesh = make_mesh((8 // shards, shards))
+        h = cas_register_history(120, concurrency=5, crash_p=0.01, seed=7)
+        r = check_sharded(model, h, mesh=mesh, capacity_per_shard=64,
+                          chunk=256)
+        assert r["valid"] is True
+        assert r["shards"] == shards
+
+    def test_sharded_refutes(self, model):
+        mesh = make_mesh((4, 2))
+        h = corrupt_reads(cas_register_history(120, concurrency=5, seed=3),
+                          n=1, seed=3)
+        r = check_sharded(model, h, mesh=mesh, capacity_per_shard=64,
+                          chunk=256)
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is False
+        assert r["op"]["index"] == cpu["op"]["index"]
+
+    def test_sharded_agrees_with_single_device(self, model):
+        mesh = make_mesh((2, 4))
+        h = cas_register_history(150, concurrency=6, crash_p=0.02, seed=11)
+        r_sh = check_sharded(model, h, mesh=mesh, capacity_per_shard=64,
+                             chunk=256)
+        r_1 = wgl_tpu.check(model, h, capacity=256, chunk=256)
+        assert r_sh["valid"] == r_1["valid"] is True
