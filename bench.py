@@ -37,7 +37,7 @@ def main():
     # Warm-up: compile the engine at both the starting capacity and the
     # first escalation step, so a mid-run overflow resume pays no compile.
     small = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)
-    for cap in (1024, 8192):
+    for cap in (1024, 4096):
         r = wgl_tpu.check(model, small,
                           prepared=_pad_window(prepare(small, model), window),
                           capacity=cap, chunk=2048)

@@ -260,7 +260,7 @@ def check(model: JaxModel, history: Optional[History] = None,
         if overflow and cap < max_capacity:
             # Grow the configuration buffers and resume from the snapshot —
             # no restart, no re-search of the prefix.
-            cap = min(cap * 8, max_capacity)
+            cap = min(cap * 4, max_capacity)
             _, run_chunk = _get_run_chunk(model, window, cap)
             carry = _grow_carry(prev, cap)
             overflow = False
@@ -268,6 +268,17 @@ def check(model: JaxModel, history: Optional[History] = None,
         if failed or overflow:
             break
         ci += 1
+        if cap > capacity:
+            # Crash-bursts inflate the configuration set transiently; once it
+            # subsides, drop back to a smaller (cheaper-per-round) engine.
+            n_valid = int(jnp.sum(carry[2]))
+            target = cap
+            while target > capacity and n_valid * 6 <= target:
+                target //= 4
+            if target < cap:
+                cap = target
+                _, run_chunk = _get_run_chunk(model, window, cap)
+                carry = _shrink_carry(carry, cap)
 
     explored = int(carry[9])
     if overflow:
@@ -302,6 +313,23 @@ def _grow_carry(carry, new_capacity: int):
                                                  states.dtype)])
     valid2 = jnp.concatenate([valid, jnp.zeros(extra, valid.dtype)])
     return (mask2, states2, valid2) + tuple(carry[3:])
+
+
+def _shrink_carry(carry, new_capacity: int):
+    """Compact live configurations into a smaller buffer (host-side; the
+    arrays are KBs).  Only called when they provably fit."""
+    mask = np.asarray(carry[0])
+    states = np.asarray(carry[1])
+    valid = np.asarray(carry[2])
+    idx = np.flatnonzero(valid)[:new_capacity]
+    mask2 = np.zeros((new_capacity,) + mask.shape[1:], mask.dtype)
+    states2 = np.zeros((new_capacity,) + states.shape[1:], states.dtype)
+    valid2 = np.zeros(new_capacity, bool)
+    mask2[:len(idx)] = mask[idx]
+    states2[:len(idx)] = states[idx]
+    valid2[:len(idx)] = True
+    return (jnp.asarray(mask2), jnp.asarray(states2),
+            jnp.asarray(valid2)) + tuple(carry[3:])
 
 
 def _cpu_witness(model: JaxModel, history: History, failed_op) -> Dict[str, Any]:

@@ -1,0 +1,97 @@
+"""Client protocol — how a workload talks to the system under test.
+
+Parity: jepsen.client (jepsen/src/jepsen/client.clj:9-27): a Client is opened
+for a node, set up once, invoked with ops, torn down, and closed.  Clients
+are single-threaded: each logical process owns one client instance; when a
+process crashes the interpreter opens a fresh client for its successor
+process unless the client is Reusable (client.clj:29).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.history import Op
+
+
+class Client:
+    """One logical process's connection to the system under test."""
+
+    def open(self, test: Dict[str, Any], node: str) -> "Client":
+        """Return a client bound to ``node`` (fresh instance or self)."""
+        return self
+
+    def setup(self, test: Dict[str, Any]) -> None:
+        """One-time data setup (create tables, etc.)."""
+
+    def invoke(self, test: Dict[str, Any], op: Op) -> Op:
+        """Apply ``op``; return its completion (type ok/fail/info)."""
+        raise NotImplementedError
+
+    def teardown(self, test: Dict[str, Any]) -> None:
+        """Undo setup."""
+
+    def close(self, test: Dict[str, Any]) -> None:
+        """Release the connection."""
+
+    # -- optional: Reusable (client.clj:29) -------------------------------
+    reusable = False
+
+
+class NoopClient(Client):
+    """Completes every op ok without talking to anything (client.clj:46)."""
+
+    def invoke(self, test, op):
+        return op.with_(type="ok")
+
+
+noop = NoopClient
+
+
+class ValidatingClient(Client):
+    """Wraps a client, asserting protocol contracts at runtime
+    (client.clj:64-114): completions must be completions of the invocation
+    (same process/f), with a legal type."""
+
+    def __init__(self, inner: Client):
+        self.inner = inner
+
+    def open(self, test, node):
+        return ValidatingClient(self.inner.open(test, node))
+
+    def setup(self, test):
+        self.inner.setup(test)
+
+    def invoke(self, test, op):
+        res = self.inner.invoke(test, op)
+        if not isinstance(res, Op):
+            raise RuntimeError(
+                f"client invoke returned {res!r}, not an Op, for {op!r}")
+        if res.type not in ("ok", "fail", "info"):
+            raise RuntimeError(
+                f"client completion has illegal type {res.type!r}: {res!r}")
+        if res.process != op.process or res.f != op.f:
+            raise RuntimeError(
+                f"client completion {res!r} does not match invocation {op!r}")
+        return res
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def close(self, test):
+        self.inner.close(test)
+
+    @property
+    def reusable(self):
+        return self.inner.reusable
+
+
+def validate(client: Client) -> Client:
+    return ValidatingClient(client)
+
+
+class ClosedClient(Client):
+    """Placeholder for a client that has been closed; any use is a bug."""
+
+    def invoke(self, test, op):
+        raise RuntimeError("client is closed")

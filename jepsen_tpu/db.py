@@ -1,0 +1,72 @@
+"""DB protocol — installing and managing the database on cluster nodes.
+
+Parity: jepsen.db (jepsen/src/jepsen/db.clj:12-48): setup!/teardown! per
+node, with optional capabilities (Kill, Pause, Primary, LogFiles) that the
+nemesis packages and log snarfing interrogate.  ``cycle_`` retries
+teardown+setup (db.clj:162-199); the tcpdump wrapper captures packets around
+another DB (db.clj:88-156).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class DB:
+    def setup(self, test: Dict[str, Any], node: str) -> None:
+        """Install and start the database on ``node``."""
+
+    def teardown(self, test: Dict[str, Any], node: str) -> None:
+        """Stop the database and wipe its state on ``node``."""
+
+
+class Kill:
+    """Capability: start/kill database processes (db.clj:16)."""
+
+    def start(self, test, node) -> None: ...
+    def kill(self, test, node) -> None: ...
+
+
+class Pause:
+    """Capability: pause/resume via SIGSTOP/SIGCONT (db.clj:30)."""
+
+    def pause(self, test, node) -> None: ...
+    def resume(self, test, node) -> None: ...
+
+
+class Primary:
+    """Capability: primary-aware databases (db.clj:35)."""
+
+    def primaries(self, test) -> List[str]:
+        return []
+
+    def setup_primary(self, test, node) -> None: ...
+
+
+class LogFiles:
+    """Capability: which node-side files to download after a run
+    (db.clj:44)."""
+
+    def log_files(self, test, node) -> List[str]:
+        return []
+
+
+class NoopDB(DB):
+    """No database at all — the in-process testing workhorse."""
+
+
+noop = NoopDB
+
+
+def cycle_(db: DB, test: Dict[str, Any], node: str, tries: int = 3) -> None:
+    """teardown! then setup!, retrying up to ``tries`` times
+    (db.clj:162-199)."""
+    last: Optional[Exception] = None
+    for _ in range(tries):
+        try:
+            db.teardown(test, node)
+            db.setup(test, node)
+            return
+        except Exception as e:  # noqa: BLE001 - retry any setup failure
+            last = e
+    raise RuntimeError(f"db cycle failed after {tries} tries on {node}") from last

@@ -1,0 +1,217 @@
+"""The interpreter: folds a generator into a history using real threads.
+
+Parity: jepsen.generator.interpreter (interpreter.clj:181-313).  A
+single-threaded scheduler loop owns the generator and the context; one
+worker thread per client thread (plus the nemesis) performs invocations.
+Key semantics carried over exactly:
+
+- all generator computation happens in the scheduler loop; workers only
+  run client/nemesis invoke;
+- a worker exception converts the op into an ``info`` completion with the
+  error attached (interpreter.clj:142-157) — indeterminate, not failed;
+- a crashed client process is burned: its thread gets a fresh process id
+  (p + concurrency) and a fresh client, unless the client is Reusable
+  (interpreter.clj:33-67, 234-239);
+- :pending polls with a bounded (1 ms) backoff (interpreter.clj:166-170);
+- ops scheduled in the future are dispatched no earlier than their time.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History, INFO, INVOKE, NEMESIS, Op
+
+logger = logging.getLogger("jepsen.interpreter")
+
+_STOP = object()
+MAX_PENDING_WAIT_S = 0.001  # 1 ms, like the reference's poll granularity
+
+
+class _Worker(threading.Thread):
+    """Base worker: pulls ops from its queue, pushes completions to the
+    shared completion queue."""
+
+    def __init__(self, thread_id, test, completions):
+        super().__init__(name=f"jepsen-worker-{thread_id}", daemon=True)
+        self.thread_id = thread_id
+        self.test = test
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.completions = completions
+
+    def run(self):
+        while True:
+            item = self.inbox.get()
+            if item is _STOP:
+                self._shutdown()
+                return
+            op: Op = item
+            try:
+                res = self._invoke(op)
+                if res.type == INVOKE:
+                    raise RuntimeError(
+                        f"invoke returned an :invoke op: {res!r}")
+            except Exception as e:  # noqa: BLE001 - crash => indeterminate
+                logger.warning("process %s crashed in %s: %s",
+                               op.process, op.f, e)
+                res = op.with_(type=INFO, error=str(e) or type(e).__name__)
+            self.completions.put((self.thread_id, res))
+
+    def _invoke(self, op: Op) -> Op:
+        raise NotImplementedError
+
+    def _shutdown(self):
+        pass
+
+
+class ClientWorker(_Worker):
+    """Owns the client lifecycle for its thread's current process
+    (interpreter.clj:33-67)."""
+
+    def __init__(self, thread_id, test, completions, client_proto):
+        super().__init__(thread_id, test, completions)
+        self.client_proto = client_proto
+        self.client: Optional[jclient.Client] = None
+        self.process = None
+
+    def _node_for(self, process) -> Optional[str]:
+        nodes = self.test.get("nodes") or []
+        if not nodes:
+            return None
+        return nodes[process % len(nodes)]
+
+    def _invoke(self, op: Op) -> Op:
+        if self.process != op.process or self.client is None:
+            # Fresh process: open a client for it (unless reusable).
+            if self.client is not None and not self.client.reusable:
+                try:
+                    self.client.close(self.test)
+                except Exception:  # noqa: BLE001
+                    logger.exception("closing crashed client")
+                self.client = None
+            if self.client is None:
+                self.client = self.client_proto.open(
+                    self.test, self._node_for(op.process))
+            self.process = op.process
+        return self.client.invoke(self.test, op)
+
+    def _shutdown(self):
+        if self.client is not None:
+            try:
+                self.client.close(self.test)
+            except Exception:  # noqa: BLE001
+                logger.exception("closing client at shutdown")
+
+
+class NemesisWorker(_Worker):
+    """The nemesis runs on its own logical thread (interpreter.clj:69)."""
+
+    def __init__(self, test, completions, nemesis):
+        super().__init__(NEMESIS, test, completions)
+        self.nemesis = nemesis
+
+    def _invoke(self, op: Op) -> Op:
+        return self.nemesis.invoke(self.test, op)
+
+
+def run(test: Dict[str, Any]) -> History:
+    """Run test["generator"] against test["client"] / test["nemesis"],
+    returning the complete history.  In-process; no cluster required."""
+    g = gen.validate(gen.lift(test.get("generator")))
+    client_proto = test.get("client") or jclient.NoopClient()
+    nemesis = test.get("nemesis")
+    if nemesis is None:
+        from jepsen_tpu import nemesis as jnemesis
+        nemesis = jnemesis.NoopNemesis()
+
+    ctx = gen.context(test)
+    completions: "queue.Queue" = queue.Queue()
+    workers: Dict[Any, _Worker] = {}
+    for t in ctx.all_threads():
+        if t == NEMESIS:
+            workers[t] = NemesisWorker(test, completions, nemesis)
+        else:
+            workers[t] = ClientWorker(t, test, completions, client_proto)
+        workers[t].start()
+
+    history: List[Op] = []
+    outstanding = 0
+    t0 = _time.monotonic_ns()
+
+    def now() -> int:
+        return _time.monotonic_ns() - t0
+
+    def handle_completion(item):
+        nonlocal ctx, g, outstanding
+        thread_id, res = item
+        outstanding -= 1
+        res = res.with_(time=now(), index=len(history))
+        history.append(res)
+        ctx = ctx.with_time(res.time).free_thread(thread_id)
+        if res.type == INFO and thread_id != NEMESIS:
+            ctx = ctx.with_next_process(thread_id)
+        if g is not None:
+            g = g.update(test, ctx, res)
+
+    try:
+        while True:
+            # 1. Drain any ready completions.
+            drained = False
+            while True:
+                try:
+                    handle_completion(completions.get_nowait())
+                    drained = True
+                except queue.Empty:
+                    break
+            if drained:
+                continue
+            # 2. Ask the generator.
+            ctx = ctx.with_time(now())
+            r = g.op(test, ctx) if g is not None else None
+            if r is None:
+                if outstanding == 0:
+                    break
+                handle_completion(completions.get())
+                continue
+            v, g2 = r
+            if v == gen.PENDING:
+                g = g2
+                try:
+                    handle_completion(
+                        completions.get(timeout=MAX_PENDING_WAIT_S))
+                except queue.Empty:
+                    pass
+                continue
+            op: Op = v
+            if op.time is not None and op.time > ctx.time:
+                # Scheduled in the future: wait, staying responsive.
+                wait = (op.time - ctx.time) / 1e9
+                try:
+                    handle_completion(completions.get(timeout=wait))
+                    continue  # context changed; re-ask the generator
+                except queue.Empty:
+                    pass
+            if op.type == "log":
+                logger.info("%s", op.value)
+                g = g2
+                continue
+            op = op.with_(time=now(), index=len(history))
+            thread_id = ctx.process_thread(op.process)
+            history.append(op)
+            ctx = ctx.busy_thread(thread_id)
+            g = g2.update(test, ctx, op) if g2 is not None else None
+            outstanding += 1
+            workers[thread_id].inbox.put(op)
+    finally:
+        for w in workers.values():
+            w.inbox.put(_STOP)
+        for w in workers.values():
+            w.join(timeout=5)
+
+    return History(history, reindex=True)

@@ -1,0 +1,164 @@
+"""Nemesis protocol and composition — fault injection as a special client.
+
+Parity: jepsen.nemesis (jepsen/src/jepsen/nemesis.clj:12-22): a nemesis is
+set up for the whole cluster, receives :info ops from the generator's
+nemesis thread, performs faults, and returns completions.  Composition and
+f-mapping (nemesis.clj:303-433) let independent fault injectors share the
+one nemesis thread.  Network partitioners live in jepsen_tpu.nemesis.partition
+(they need the net/control layers); this module is the transport-free core.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence
+
+from jepsen_tpu.history import Op
+
+
+class Nemesis:
+    def setup(self, test: Dict[str, Any]) -> "Nemesis":
+        return self
+
+    def invoke(self, test: Dict[str, Any], op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test: Dict[str, Any]) -> None:
+        pass
+
+    # -- optional Reflection (nemesis.clj:22): which fs this nemesis handles
+    def fs(self) -> Optional[Iterable[Any]]:
+        return None
+
+
+class NoopNemesis(Nemesis):
+    """Does nothing, usefully (nemesis.clj noop)."""
+
+    def invoke(self, test, op):
+        return op.with_(type="info")
+
+
+noop = NoopNemesis
+
+
+class FnNemesis(Nemesis):
+    """Build a nemesis from a dict of f -> handler(test, op) -> op."""
+
+    def __init__(self, handlers: Dict[Any, Callable],
+                 setup_fn: Optional[Callable] = None,
+                 teardown_fn: Optional[Callable] = None):
+        self.handlers = handlers
+        self.setup_fn = setup_fn
+        self.teardown_fn = teardown_fn
+
+    def setup(self, test):
+        if self.setup_fn:
+            self.setup_fn(test)
+        return self
+
+    def invoke(self, test, op):
+        h = self.handlers.get(op.f)
+        if h is None:
+            raise ValueError(f"nemesis has no handler for f={op.f!r}")
+        return h(test, op)
+
+    def teardown(self, test):
+        if self.teardown_fn:
+            self.teardown_fn(test)
+
+    def fs(self):
+        return list(self.handlers)
+
+
+class FMap(Nemesis):
+    """Rewrite incoming op :f values through a mapping before delegating —
+    the dual of generator f_map (nemesis.clj:303)."""
+
+    def __init__(self, fmap: Dict[Any, Any], inner: Nemesis):
+        self.fmap = fmap
+        self.inv = {v: k for k, v in fmap.items()}
+        self.inner = inner
+
+    def setup(self, test):
+        self.inner = self.inner.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        inner_f = self.inv.get(op.f, op.f)
+        res = self.inner.invoke(test, op.with_(f=inner_f))
+        return res.with_(f=self.fmap.get(res.f, res.f))
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def fs(self):
+        inner_fs = self.inner.fs() or []
+        return [self.fmap.get(f, f) for f in inner_fs]
+
+
+def f_map(fmap: Dict[Any, Any], nemesis: Nemesis) -> Nemesis:
+    return FMap(fmap, nemesis)
+
+
+class Compose(Nemesis):
+    """Route ops to member nemeses by f (nemesis.clj:385): members declare
+    their fs via Reflection, or are given explicit f-sets."""
+
+    def __init__(self, members: Sequence[Nemesis],
+                 f_sets: Optional[Sequence[Optional[set]]] = None):
+        self.members = list(members)
+        self.f_sets = list(f_sets) if f_sets is not None else \
+            [set(m.fs() or []) for m in members]
+
+    def setup(self, test):
+        self.members = [m.setup(test) for m in self.members]
+        return self
+
+    def invoke(self, test, op):
+        for m, fs in zip(self.members, self.f_sets):
+            if fs is None or op.f in fs:
+                return m.invoke(test, op)
+        raise ValueError(f"no composed nemesis handles f={op.f!r}")
+
+    def teardown(self, test):
+        for m in self.members:
+            m.teardown(test)
+
+    def fs(self):
+        out = []
+        for fs in self.f_sets:
+            out.extend(fs or [])
+        return out
+
+
+def compose(members: Sequence[Nemesis]) -> Nemesis:
+    return Compose(members)
+
+
+class ValidatingNemesis(Nemesis):
+    """Contract assertions around a nemesis (nemesis.clj:50-91)."""
+
+    def __init__(self, inner: Nemesis):
+        self.inner = inner
+
+    def setup(self, test):
+        n = self.inner.setup(test)
+        if n is None:
+            raise RuntimeError("nemesis setup returned None")
+        self.inner = n
+        return self
+
+    def invoke(self, test, op):
+        res = self.inner.invoke(test, op)
+        if not isinstance(res, Op):
+            raise RuntimeError(f"nemesis returned {res!r}, not an Op")
+        return res
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+    def fs(self):
+        return self.inner.fs()
+
+
+def validate(nemesis: Nemesis) -> Nemesis:
+    return ValidatingNemesis(nemesis)

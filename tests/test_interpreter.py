@@ -1,0 +1,166 @@
+"""Threaded interpreter: full in-process pipeline tests (the reference's
+dummy-remote pattern — whole-framework tests with no cluster,
+test/jepsen/core_test.clj style)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import FAIL, INFO, INVOKE, NEMESIS, OK
+from jepsen_tpu.models import CASRegister
+
+
+class MockRegisterClient(jclient.Client):
+    """In-process linearizable CAS register (lock-protected)."""
+
+    def __init__(self, state=None, fail_every=None, stale=False):
+        self.state = state if state is not None else {"v": None}
+        self.lock = getattr(self, "lock", threading.Lock())
+        self.fail_every = fail_every
+        self.stale = stale
+        self.calls = 0
+        self.reusable = True
+
+    def open(self, test, node):
+        return self  # shared in-process service
+
+    def invoke(self, test, op):
+        self.calls += 1
+        if self.fail_every and self.calls % self.fail_every == 0:
+            raise RuntimeError("simulated connection loss")
+        with self.lock:
+            if op.f == "read":
+                v = self.state["v"]
+                if self.stale and self.calls % 7 == 0:
+                    v = (v or 0) + 1000  # impossible value
+                return op.with_(type=OK, value=v)
+            if op.f == "write":
+                self.state["v"] = op.value
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = op.value
+                if self.state["v"] == old:
+                    self.state["v"] = new
+                    return op.with_(type=OK)
+                return op.with_(type=FAIL)
+        raise ValueError(op.f)
+
+
+def rwc_gen(n):
+    import random
+    rng = random.Random(7)
+
+    def one():
+        r = rng.random()
+        if r < 0.5:
+            return {"f": "read"}
+        if r < 0.75:
+            return {"f": "write", "value": rng.randrange(5)}
+        return {"f": "cas", "value": [rng.randrange(5), rng.randrange(5)]}
+
+    return gen.limit(n, one)
+
+
+class TestInterpreter:
+    def test_noop_run_structure(self):
+        test = {"concurrency": 3, "client": jclient.NoopClient(),
+                "generator": gen.clients(rwc_gen(30))}
+        h = interpreter.run(test)
+        invokes = [o for o in h if o.type == INVOKE]
+        assert len(invokes) == 30
+        # every invoke has a completion, pairing is total
+        pairs = h.pair_index()
+        assert all(pairs[o.index] >= 0 for o in invokes)
+        # per-process alternation: no two open invokes on one process
+        open_ = set()
+        for o in h:
+            if o.type == INVOKE:
+                assert o.process not in open_
+                open_.add(o.process)
+            else:
+                open_.discard(o.process)
+
+    def test_indices_and_times_monotone(self):
+        test = {"concurrency": 2, "client": jclient.NoopClient(),
+                "generator": gen.clients(rwc_gen(10))}
+        h = interpreter.run(test)
+        assert [o.index for o in h] == list(range(len(h)))
+        times = [o.time for o in h]
+        assert all(b >= a for a, b in zip(times, times[1:]))
+
+    def test_crash_becomes_info_and_process_migrates(self):
+        test = {"concurrency": 2,
+                "client": MockRegisterClient(fail_every=5),
+                "generator": gen.clients(rwc_gen(40))}
+        h = interpreter.run(test)
+        infos = [o for o in h if o.type == INFO and o.process != NEMESIS]
+        assert infos, "expected crashed ops"
+        assert all(o.error for o in infos)
+        # crashed processes are burned: successors appear
+        procs = {o.process for o in h if o.type == INVOKE}
+        assert any(p >= 2 for p in procs)
+
+    def test_end_to_end_linearizable(self):
+        test = {"concurrency": 4,
+                "client": MockRegisterClient(),
+                "generator": gen.clients(rwc_gen(120))}
+        h = interpreter.run(test)
+        r = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is True
+
+    def test_end_to_end_catches_bug(self):
+        test = {"concurrency": 4,
+                "client": MockRegisterClient(stale=True),
+                "generator": gen.clients(rwc_gen(120))}
+        h = interpreter.run(test)
+        r = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is False
+
+    def test_end_to_end_with_crashes_still_linearizable(self):
+        test = {"concurrency": 4,
+                "client": MockRegisterClient(fail_every=17),
+                "generator": gen.clients(rwc_gen(100))}
+        h = interpreter.run(test)
+        r = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is True
+
+    def test_nemesis_ops_routed(self):
+        from jepsen_tpu import nemesis as jnemesis
+
+        events = []
+
+        def start(test, op):
+            events.append("start")
+            return op.with_(type=INFO, value="partitioned")
+
+        def stop(test, op):
+            events.append("stop")
+            return op.with_(type=INFO, value="healed")
+
+        nem = jnemesis.FnNemesis({"start": start, "stop": stop})
+        test = {"concurrency": 2,
+                "client": jclient.NoopClient(),
+                "nemesis": nem,
+                "generator": [
+                    gen.nemesis(gen.lift(
+                        [{"f": "start", "type": "info"},
+                         {"f": "stop", "type": "info"}])),
+                    gen.clients(rwc_gen(10)),
+                ]}
+        h = interpreter.run(test)
+        assert events == ["start", "stop"]
+        nem_ops = [o for o in h if o.process == NEMESIS]
+        assert len(nem_ops) == 4  # 2 invocations + 2 completions
+
+    def test_time_limited_run_terminates(self):
+        test = {"concurrency": 2,
+                "client": jclient.NoopClient(),
+                "generator": gen.time_limit(
+                    0.3, gen.clients(gen.repeat(lambda: {"f": "read"})))}
+        h = interpreter.run(test)
+        assert len(h) > 0
+        assert max(o.time for o in h) < 2e9
