@@ -1,0 +1,14 @@
+"""Checkers: history-in, verdict-out analysis engines.
+
+The plugin seam matching the reference's jepsen.checker namespace: a Checker
+checks a completed history; verdicts merge false > unknown > true.  The
+linearizable checker dispatches to the CPU oracle or the TPU search engine.
+"""
+
+from jepsen_tpu.checker.core import (  # noqa: F401
+    Checker, Compose, CounterChecker, LogFilePattern, NoopChecker,
+    QueueChecker, SetChecker, SetFullChecker, Stats, TotalQueueChecker,
+    UNKNOWN, UnhandledExceptions, UniqueIds, check_safe, compose,
+    concurrency_limit, merge_valid, noop, unbridled_optimism,
+)
+from jepsen_tpu.checker.linearizable import Linearizable, linearizable  # noqa: F401

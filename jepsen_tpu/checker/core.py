@@ -1,0 +1,371 @@
+"""Checker protocol, composition, and the standard checker battery.
+
+Parity: jepsen.checker (jepsen/src/jepsen/checker.clj): a Checker examines a
+completed history and returns a map with a ``valid`` verdict; verdicts merge
+through a priority lattice where false beats unknown beats true
+(checker.clj:29-50).  ``compose`` runs several checkers (in parallel threads,
+like the reference's pmap, checker.clj:87) and merges; ``check_safe`` turns
+checker crashes into unknown verdicts (checker.clj:74).
+
+Checkers here: stats, unhandled_exceptions, queue, total_queue, set,
+set_full, unique_ids, counter — history-in/verdict-out, no cluster needed.
+The linearizable checker lives in jepsen_tpu.checker.linearizable.
+"""
+
+from __future__ import annotations
+
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from collections import Counter as _Counter, defaultdict
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, NEMESIS, OK, Op
+
+UNKNOWN = "unknown"
+
+
+class Checker:
+    def check(self, test: Dict[str, Any], history: History,
+              opts: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+def merge_valid(valids: List[Any]) -> Any:
+    """false > unknown > true (checker.clj:29-50)."""
+    out = True
+    for v in valids:
+        if v is False:
+            return False
+        if v == UNKNOWN:
+            out = UNKNOWN
+    return out
+
+
+def check_safe(checker: Checker, test, history, opts=None) -> Dict[str, Any]:
+    """Run a checker, converting crashes into unknown verdicts
+    (checker.clj:74)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception as e:  # noqa: BLE001
+        return {"valid": UNKNOWN, "error": str(e),
+                "traceback": traceback.format_exc()}
+
+
+class Compose(Checker):
+    """Run named sub-checkers concurrently; merge verdicts
+    (checker.clj:87)."""
+
+    def __init__(self, checkers: Dict[str, Checker]):
+        self.checkers = checkers
+
+    def check(self, test, history, opts=None):
+        opts = opts or {}
+        names = list(self.checkers)
+        with ThreadPoolExecutor(max_workers=max(1, len(names))) as ex:
+            futs = {n: ex.submit(check_safe, self.checkers[n], test, history,
+                                 opts)
+                    for n in names}
+            results = {n: f.result() for n, f in futs.items()}
+        return {"valid": merge_valid([r.get("valid") for r in results.values()]),
+                **results}
+
+
+def compose(checkers: Dict[str, Checker]) -> Checker:
+    return Compose(checkers)
+
+
+class NoopChecker(Checker):
+    def check(self, test, history, opts=None):
+        return {"valid": True}
+
+
+noop = NoopChecker
+unbridled_optimism = NoopChecker  # the reference's cheekily-named default
+
+
+class Stats(Checker):
+    """Per-f ok/fail/info/crash counts; valid unless some f never succeeded
+    (checker.clj:166-183)."""
+
+    def check(self, test, history, opts=None):
+        by_f: Dict[Any, _Counter] = defaultdict(_Counter)
+        total = _Counter()
+        for op in history:
+            if op.process == NEMESIS or op.type == INVOKE:
+                continue
+            by_f[op.f][op.type] += 1
+            total[op.type] += 1
+        valid = True
+        for f, c in by_f.items():
+            if c[OK] == 0 and (c[FAIL] > 0 or c[INFO] > 0):
+                valid = UNKNOWN  # nothing ever worked for this f
+        return {"valid": valid,
+                "count": sum(total.values()),
+                "ok-count": total[OK], "fail-count": total[FAIL],
+                "info-count": total[INFO],
+                "by-f": {f: dict(c) for f, c in by_f.items()}}
+
+
+class UnhandledExceptions(Checker):
+    """Collect ops that crashed with errors, grouped by class
+    (checker.clj:124)."""
+
+    def check(self, test, history, opts=None):
+        by_err: Dict[str, List[Op]] = defaultdict(list)
+        for op in history:
+            if op.error is not None and op.type == INFO:
+                by_err[str(op.error)].append(op)
+        return {"valid": True,
+                "exceptions": {k: {"count": len(v),
+                                   "example": v[0].to_dict()}
+                               for k, v in by_err.items()}}
+
+
+class SetChecker(Checker):
+    """Grow-only set: adds followed by a final read; elements read-but-
+    never-added are illegal; added-but-never-read are lost
+    (checker.clj:240)."""
+
+    def check(self, test, history, opts=None):
+        attempts = set()
+        adds = set()
+        final_read = None
+        for op in history:
+            if op.f == "add" and op.type == INVOKE:
+                attempts.add(op.value)
+            elif op.f == "add" and op.type == OK:
+                adds.add(op.value)
+            elif op.f == "read" and op.type == OK:
+                final_read = set(op.value or [])
+        if final_read is None:
+            return {"valid": UNKNOWN, "error": "no read completed"}
+        lost = adds - final_read
+        unexpected = final_read - attempts
+        recovered = (final_read & attempts) - adds
+        return {"valid": not lost and not unexpected,
+                "attempt-count": len(attempts),
+                "acknowledged-count": len(adds),
+                "ok-count": len(final_read & attempts),
+                "lost-count": len(lost), "lost": sorted(lost, key=repr),
+                "unexpected-count": len(unexpected),
+                "unexpected": sorted(unexpected, key=repr),
+                "recovered-count": len(recovered)}
+
+
+class SetFullChecker(Checker):
+    """Per-element visibility analysis over many reads (checker.clj:294-461):
+    each ok-add must eventually be visible; flags stale windows (absent then
+    present) and lost elements (absent from the final reads)."""
+
+    def check(self, test, history, opts=None):
+        pairs = history.pair_index()
+        add_done: Dict[Any, int] = {}   # element -> completion time
+        reads: List[Op] = []            # ok reads with invoke times
+        read_invoke_time: Dict[int, int] = {}
+        for i, op in enumerate(history):
+            if op.f == "add" and op.type == OK:
+                j = pairs[i]
+                inv = history[j] if j >= 0 else op
+                add_done[inv.value if inv.value is not None else op.value] = \
+                    op.time or 0
+            elif op.f == "read" and op.type == OK:
+                j = pairs[i]
+                read_invoke_time[len(reads)] = \
+                    (history[j].time if j >= 0 else op.time) or 0
+                reads.append(op)
+        if not reads:
+            return {"valid": UNKNOWN, "error": "no reads completed"}
+        lost, stale, never_read = [], [], []
+        for e, t_add in add_done.items():
+            later = [k for k in range(len(reads))
+                     if read_invoke_time[k] >= t_add]
+            if not later:
+                never_read.append(e)
+                continue
+            present = [e in set(reads[k].value or []) for k in later]
+            if not present[-1]:
+                lost.append(e)
+            elif not all(present):
+                # absent somewhere, present later: stale window
+                stale.append(e)
+        return {"valid": merge_valid([not lost,
+                                      UNKNOWN if never_read else True]),
+                "add-count": len(add_done),
+                "read-count": len(reads),
+                "lost-count": len(lost), "lost": sorted(lost, key=repr),
+                "stale-count": len(stale), "stale": sorted(stale, key=repr),
+                "never-read-count": len(never_read)}
+
+
+class QueueChecker(Checker):
+    """Dequeues must match some enqueue; at-most-once delivery
+    (checker.clj:218 queue)."""
+
+    def check(self, test, history, opts=None):
+        enq = _Counter()
+        deq = _Counter()
+        errors = []
+        for op in history:
+            if op.f == "enqueue" and op.type in (OK, INFO):
+                enq[op.value] += 1
+            elif op.f == "dequeue" and op.type == OK:
+                deq[op.value] += 1
+                if deq[op.value] > enq[op.value]:
+                    errors.append(op.to_dict())
+        return {"valid": not errors, "errors": errors}
+
+
+class TotalQueueChecker(Checker):
+    """Every enqueued element is dequeued exactly once (checker.clj:628):
+    reports lost (acked enqueue, never dequeued), unexpected (dequeued,
+    never enqueued), duplicated (dequeued more than once), and recovered
+    (uncertain enqueue that was dequeued)."""
+
+    def check(self, test, history, opts=None):
+        attempts = _Counter()
+        enqueues = _Counter()
+        dequeues = _Counter()
+        for op in history:
+            if op.f == "enqueue" and op.type == INVOKE:
+                attempts[op.value] += 1
+            elif op.f == "enqueue" and op.type == OK:
+                enqueues[op.value] += 1
+            elif op.f == "dequeue" and op.type == OK:
+                dequeues[op.value] += 1
+        lost = {v: n - dequeues[v] for v, n in enqueues.items()
+                if dequeues[v] < n}
+        unexpected = {v: n for v, n in dequeues.items() if attempts[v] == 0}
+        duplicated = {v: n - max(attempts[v], 1)
+                      for v, n in dequeues.items()
+                      if n > max(attempts[v], 1)}
+        recovered = {v: n for v, n in dequeues.items()
+                     if 0 < n <= attempts[v] and enqueues[v] < n}
+        return {"valid": not lost and not unexpected and not duplicated,
+                "attempt-count": sum(attempts.values()),
+                "acknowledged-count": sum(enqueues.values()),
+                "ok-count": sum(dequeues.values()),
+                "lost-count": sum(lost.values()), "lost": lost,
+                "unexpected-count": sum(unexpected.values()),
+                "unexpected": unexpected,
+                "duplicated-count": sum(duplicated.values()),
+                "duplicated": duplicated,
+                "recovered-count": sum(recovered.values())}
+
+
+class UniqueIds(Checker):
+    """All ok-op values are distinct (checker.clj:689)."""
+
+    def check(self, test, history, opts=None):
+        seen = _Counter()
+        for op in history:
+            if op.type == OK and op.value is not None:
+                seen[op.value] += 1
+        dups = {v: n for v, n in seen.items() if n > 1}
+        return {"valid": not dups,
+                "attempted-count": sum(seen.values()),
+                "acknowledged-count": len(seen),
+                "duplicated-count": len(dups),
+                "duplicated": dups}
+
+
+class CounterChecker(Checker):
+    """Reads of a PN-counter must fall within the feasible envelope implied
+    by concurrent adds (checker.clj:737): a read may observe any subset of
+    the adds that were pending at any instant during it, plus everything
+    surely applied, never excluding anything surely applied before it
+    began."""
+
+    def check(self, test, history, opts=None):
+        pairs = history.pair_index()
+        reads = []
+        lo = hi = 0          # envelope of possibly-applied sums
+        applied = 0          # surely applied (ok) sum
+        open_adds: Dict[int, int] = {}  # invoke index -> delta
+        errors = []
+        for i, op in enumerate(history):
+            if op.f == "add":
+                d = op.value or 0
+                if op.type == INVOKE:
+                    open_adds[i] = d
+                    if d > 0:
+                        hi += d
+                    else:
+                        lo += d
+                elif op.type == OK:
+                    j = int(pairs[i])
+                    d = open_adds.pop(j, d)
+                    applied += d
+                    if d > 0:
+                        lo += d
+                    else:
+                        hi += d
+                elif op.type in (FAIL,):
+                    j = int(pairs[i])
+                    d = open_adds.pop(j, d)
+                    if d > 0:
+                        hi -= d
+                    else:
+                        lo -= d
+                # INFO: stays open forever (may or may not apply)
+            elif op.f == "read" and op.type == OK:
+                v = op.value
+                if v is None or not (lo <= v <= hi):
+                    errors.append({**op.to_dict(), "bounds": [lo, hi]})
+                reads.append(v)
+        return {"valid": not errors,
+                "reads": len(reads), "errors": errors,
+                "final-bounds": [lo, hi], "applied-sum": applied}
+
+
+class LogFilePattern(Checker):
+    """Grep downloaded node logs for a pattern (checker.clj:839); reads from
+    the store directory if present."""
+
+    def __init__(self, pattern: str, filename: str):
+        import re
+        self.re = re.compile(pattern)
+        self.filename = filename
+
+    def check(self, test, history, opts=None):
+        import os
+        matches = []
+        d = (opts or {}).get("store_dir") or test.get("store_dir")
+        if not d:
+            return {"valid": UNKNOWN, "error": "no store dir with logs"}
+        for root, _, files in os.walk(d):
+            for fn in files:
+                if fn != self.filename:
+                    continue
+                path = os.path.join(root, fn)
+                try:
+                    with open(path, errors="replace") as f:
+                        for line in f:
+                            if self.re.search(line):
+                                matches.append({"file": path,
+                                                "line": line.strip()})
+                except OSError:
+                    continue
+        return {"valid": not matches, "count": len(matches),
+                "matches": matches[:10]}
+
+
+class ConcurrencyLimitChecker(Checker):
+    """Bound how many expensive checks run at once via a shared semaphore
+    (checker.clj:101-116)."""
+
+    _sems: Dict[str, Any] = {}
+
+    def __init__(self, limit: int, inner: Checker, key: str = "default"):
+        import threading
+        self.inner = inner
+        sem = self._sems.setdefault(f"{key}:{limit}",
+                                    threading.Semaphore(limit))
+        self.sem = sem
+
+    def check(self, test, history, opts=None):
+        with self.sem:
+            return self.inner.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, inner: Checker) -> Checker:
+    return ConcurrencyLimitChecker(limit, inner)

@@ -1,0 +1,100 @@
+"""The linearizable checker facade — algorithm selection and competition.
+
+Parity: jepsen.checker/linearizable (checker.clj:185-216), which dispatches
+on ``:algorithm`` to knossos's linear/wgl/competition solvers.  Here the
+algorithms are:
+
+- ``"tpu"``          — the device engine (wgl_tpu), requires a JaxModel;
+- ``"cpu"``/"linear"/"wgl" — the host oracle (wgl_cpu), any Model;
+- ``"competition"``  — race both on two threads, first verdict wins
+  (knossos.competition parity; also the fallback tier for models with no
+  device encoding, SURVEY.md §7 hard-parts);
+- default: "tpu" when the model has a device tier, else "cpu".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Union
+
+from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History
+from jepsen_tpu.models.base import JaxModel, Model
+
+
+class Linearizable(Checker):
+    def __init__(self, model: Union[JaxModel, Model],
+                 algorithm: Optional[str] = None, **engine_opts):
+        self.model = model
+        self.algorithm = algorithm
+        self.engine_opts = engine_opts
+
+    def _cpu_model(self) -> Optional[Model]:
+        if isinstance(self.model, Model):
+            return self.model
+        if isinstance(self.model, JaxModel) and self.model.cpu_model:
+            return self.model.cpu_model()
+        return None
+
+    def _jax_model(self) -> Optional[JaxModel]:
+        return self.model if isinstance(self.model, JaxModel) else None
+
+    def check(self, test, history: History, opts=None):
+        algo = self.algorithm
+        jm, cm = self._jax_model(), self._cpu_model()
+        if algo is None:
+            algo = "tpu" if jm is not None else "cpu"
+        if algo == "tpu":
+            if jm is None:
+                return {"valid": UNKNOWN,
+                        "error": "model has no device tier; use cpu"}
+            return wgl_tpu.check(jm, history, **self.engine_opts)
+        if algo in ("cpu", "linear", "wgl"):
+            if cm is None:
+                return {"valid": UNKNOWN, "error": "no host-tier model"}
+            try:
+                return wgl_cpu.check(cm, history)
+            except wgl_cpu.SearchExploded as e:
+                return {"valid": UNKNOWN, "error": str(e)}
+        if algo == "competition":
+            return self._competition(test, history)
+        return {"valid": UNKNOWN, "error": f"unknown algorithm {algo!r}"}
+
+    def _competition(self, test, history):
+        """Race the device engine and the host oracle; first definite verdict
+        wins (knossos.competition parity)."""
+        jm, cm = self._jax_model(), self._cpu_model()
+        if jm is None or cm is None:
+            # only one tier available: no race
+            self2 = Linearizable(self.model, None, **self.engine_opts)
+            return self2.check(test, history)
+        done = threading.Event()
+        results: Dict[str, Any] = {}
+
+        def run_tpu():
+            try:
+                r = wgl_tpu.check(jm, history, **self.engine_opts)
+            except Exception as e:  # noqa: BLE001
+                r = {"valid": UNKNOWN, "error": str(e)}
+            results.setdefault("winner", {**r, "solver": "tpu"})
+            done.set()
+
+        def run_cpu():
+            try:
+                r = wgl_cpu.check(cm, history)
+            except Exception as e:  # noqa: BLE001
+                r = {"valid": UNKNOWN, "error": str(e)}
+            results.setdefault("winner", {**r, "solver": "cpu"})
+            done.set()
+
+        ts = [threading.Thread(target=run_tpu, daemon=True),
+              threading.Thread(target=run_cpu, daemon=True)]
+        for t in ts:
+            t.start()
+        done.wait()
+        return results["winner"]
+
+
+def linearizable(model, algorithm: Optional[str] = None, **kw) -> Checker:
+    return Linearizable(model, algorithm, **kw)

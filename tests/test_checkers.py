@@ -1,0 +1,187 @@
+"""Checker battery: hand-written histories with known verdicts
+(the reference's checker_test.clj approach)."""
+
+import pytest
+
+from jepsen_tpu.checker import (
+    CounterChecker, QueueChecker, SetChecker, SetFullChecker, Stats,
+    TotalQueueChecker, UNKNOWN, UnhandledExceptions, UniqueIds, check_safe,
+    compose, linearizable, merge_valid, noop,
+)
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
+from jepsen_tpu.models import CASRegister, get_model
+
+
+def mk(process, type_, f, value=None, **kw):
+    return Op(process=process, type=type_, f=f, value=value, **kw)
+
+
+T = {}  # test map
+
+
+class TestLattice:
+    def test_merge_valid(self):
+        assert merge_valid([True, True]) is True
+        assert merge_valid([True, UNKNOWN]) == UNKNOWN
+        assert merge_valid([UNKNOWN, False]) is False
+        assert merge_valid([]) is True
+
+    def test_check_safe_catches(self):
+        class Boom:
+            def check(self, *a):
+                raise RuntimeError("boom")
+        r = check_safe(Boom(), T, History([]))
+        assert r["valid"] == UNKNOWN and "boom" in r["error"]
+
+    def test_compose_merges(self):
+        class Valid:
+            def check(self, *a):
+                return {"valid": True}
+
+        class Invalid:
+            def check(self, *a):
+                return {"valid": False, "why": "nope"}
+
+        r = compose({"a": Valid(), "b": Invalid()}).check(T, History([]))
+        assert r["valid"] is False
+        assert r["b"]["why"] == "nope"
+
+
+class TestStats:
+    def test_counts(self):
+        h = History([
+            mk(0, INVOKE, "read"), mk(0, OK, "read", 1),
+            mk(0, INVOKE, "write", 2), mk(0, FAIL, "write", 2),
+            mk(1, INVOKE, "read"), mk(1, INFO, "read"),
+        ])
+        r = Stats().check(T, h)
+        assert r["ok-count"] == 1 and r["fail-count"] == 1
+        assert r["by-f"]["read"][OK] == 1
+        # write never succeeded -> unknown
+        assert r["valid"] == UNKNOWN
+
+    def test_unhandled_exceptions(self):
+        h = History([mk(0, INFO, "read", error="ConnectionRefused")])
+        r = UnhandledExceptions().check(T, h)
+        assert r["exceptions"]["ConnectionRefused"]["count"] == 1
+
+
+class TestSet:
+    def test_ok(self):
+        h = History([
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(0, INVOKE, "add", 2), mk(0, OK, "add", 2),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", [1, 2]),
+        ])
+        r = SetChecker().check(T, h)
+        assert r["valid"] is True and r["lost-count"] == 0
+
+    def test_lost_and_unexpected(self):
+        h = History([
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(0, INVOKE, "add", 2), mk(0, OK, "add", 2),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", [1, 99]),
+        ])
+        r = SetChecker().check(T, h)
+        assert r["valid"] is False
+        assert r["lost"] == [2] and r["unexpected"] == [99]
+
+    def test_set_full_stale_and_lost(self):
+        h = History([
+            mk(0, INVOKE, "add", 1, time=0), mk(0, OK, "add", 1, time=10),
+            mk(1, INVOKE, "read", time=20), mk(1, OK, "read", [], time=30),
+            mk(1, INVOKE, "read", time=40), mk(1, OK, "read", [1], time=50),
+            mk(0, INVOKE, "add", 2, time=60), mk(0, OK, "add", 2, time=70),
+            mk(1, INVOKE, "read", time=80), mk(1, OK, "read", [1], time=90),
+        ])
+        r = SetFullChecker().check(T, h)
+        assert r["valid"] is False
+        assert r["lost"] == [2]
+        assert r["stale"] == [1]
+
+
+class TestQueues:
+    def test_queue_at_most_once(self):
+        h = History([
+            mk(0, INVOKE, "enqueue", 1), mk(0, OK, "enqueue", 1),
+            mk(1, INVOKE, "dequeue"), mk(1, OK, "dequeue", 1),
+            mk(1, INVOKE, "dequeue"), mk(1, OK, "dequeue", 1),
+        ])
+        r = QueueChecker().check(T, h)
+        assert r["valid"] is False  # dequeued twice
+
+    def test_total_queue(self):
+        h = History([
+            mk(0, INVOKE, "enqueue", 1), mk(0, OK, "enqueue", 1),
+            mk(0, INVOKE, "enqueue", 2), mk(0, OK, "enqueue", 2),
+            mk(0, INVOKE, "enqueue", 3), mk(0, INFO, "enqueue", 3),
+            mk(1, INVOKE, "dequeue"), mk(1, OK, "dequeue", 1),
+            mk(1, INVOKE, "dequeue"), mk(1, OK, "dequeue", 3),
+        ])
+        r = TotalQueueChecker().check(T, h)
+        assert r["valid"] is False
+        assert r["lost"] == {2: 1}
+        assert r["recovered-count"] == 1
+
+
+class TestUniqueAndCounter:
+    def test_unique_ids(self):
+        h = History([
+            mk(0, INVOKE, "generate"), mk(0, OK, "generate", "a"),
+            mk(1, INVOKE, "generate"), mk(1, OK, "generate", "a"),
+        ])
+        r = UniqueIds().check(T, h)
+        assert r["valid"] is False and r["duplicated"] == {"a": 2}
+
+    def test_counter_within_bounds(self):
+        h = History([
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", 1),
+            mk(0, INVOKE, "add", 2), mk(0, INFO, "add", 2),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", 3),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", 1),
+        ])
+        r = CounterChecker().check(T, h)
+        assert r["valid"] is True
+
+    def test_counter_out_of_bounds(self):
+        h = History([
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(1, INVOKE, "read"), mk(1, OK, "read", 5),
+        ])
+        r = CounterChecker().check(T, h)
+        assert r["valid"] is False
+        assert r["errors"][0]["bounds"] == [1, 1]
+
+
+class TestLinearizableFacade:
+    H_GOOD = History([
+        mk(0, INVOKE, "write", 1), mk(0, OK, "write", 1),
+        mk(0, INVOKE, "read"), mk(0, OK, "read", 1),
+    ])
+    H_BAD = History([
+        mk(0, INVOKE, "write", 1), mk(0, OK, "write", 1),
+        mk(0, INVOKE, "read"), mk(0, OK, "read", 2),
+    ])
+
+    def test_cpu_algorithm_with_host_model(self):
+        c = linearizable(CASRegister(), algorithm="cpu")
+        assert c.check(T, self.H_GOOD)["valid"] is True
+        assert c.check(T, self.H_BAD)["valid"] is False
+
+    def test_tpu_algorithm(self):
+        c = linearizable(get_model("cas-register"),
+                         capacity=64, chunk=16)
+        assert c.check(T, self.H_GOOD)["valid"] is True
+        assert c.check(T, self.H_BAD)["valid"] is False
+
+    def test_competition(self):
+        c = linearizable(get_model("cas-register"), algorithm="competition",
+                         capacity=64, chunk=16)
+        r = c.check(T, self.H_GOOD)
+        assert r["valid"] is True
+        assert r["solver"] in ("cpu", "tpu")
+
+    def test_host_model_cannot_run_tpu(self):
+        c = linearizable(CASRegister(), algorithm="tpu")
+        assert c.check(T, self.H_GOOD)["valid"] == UNKNOWN
