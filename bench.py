@@ -33,7 +33,7 @@ def main():
     # configuration set, so crash count is the capacity driver).
     big = cas_register_history(N_OPS, concurrency=8, crash_p=0.0003, seed=2026)
     prep = prepare(big, model)
-    window = max(32, ((prep.window + 31) // 32) * 32)
+    window = wgl_tpu._round_window(prep.window)
     # Warm-up: compile the engine at both the starting capacity and the
     # first escalation step, so a mid-run overflow resume pays no compile.
     small = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)

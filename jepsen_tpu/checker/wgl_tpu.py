@@ -53,12 +53,14 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 axis_name: Optional[str] = None, num_shards: int = 1):
     """Build the jittable (carry0, event_step, run_chunk) triple.
 
-    ``window`` must be a multiple of 32.  With ``axis_name``, buffers are
-    device-local shards of a global set of ``capacity * num_shards``
-    configurations and closure dedup synchronizes via all_gather.
+    ``window`` may be any positive slot count (candidate-row count — and so
+    closure sort cost — scales with it, so callers pass the tightest window
+    the history needs).  With ``axis_name``, buffers are device-local shards
+    of a global set of ``capacity * num_shards`` configurations and closure
+    dedup synchronizes via all_gather.
     """
-    assert window % 32 == 0 and window > 0
-    W, MW, S, C = window, window // 32, model.state_size, capacity
+    assert window > 0
+    W, MW, S, C = window, (window + 31) // 32, model.state_size, capacity
     step = model.step
 
     # slot_masks[w] = uint32[MW] with bit w set.
@@ -243,7 +245,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     device already searched)."""
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
-    window = max(32, ((p.window + 31) // 32) * 32)
+    window = _round_window(p.window)
     ev = events_array(p, chunk)
     n_chunks = ev.shape[0] // chunk
 
@@ -252,6 +254,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     carry = carry0()
     failed = overflow = False
     ci = 0
+    last_overflow_chunk = -(10 ** 9)
     while ci < n_chunks:
         prev = carry  # chunk-boundary snapshot: the resume point on overflow
         carry = run_chunk(carry, jnp.asarray(ev[ci * chunk:(ci + 1) * chunk]))
@@ -261,6 +264,7 @@ def check(model: JaxModel, history: Optional[History] = None,
             # Grow the configuration buffers and resume from the snapshot —
             # no restart, no re-search of the prefix.
             cap = min(cap * 4, max_capacity)
+            last_overflow_chunk = ci
             _, run_chunk = _get_run_chunk(model, window, cap)
             carry = _grow_carry(prev, cap)
             overflow = False
@@ -268,12 +272,14 @@ def check(model: JaxModel, history: Optional[History] = None,
         if failed or overflow:
             break
         ci += 1
-        if cap > capacity:
+        if cap > capacity and ci - last_overflow_chunk >= 8:
             # Crash-bursts inflate the configuration set transiently; once it
-            # subsides, drop back to a smaller (cheaper-per-round) engine.
+            # clearly subsides (hysteresis: no overflow for 8 chunks, live
+            # count far below a smaller buffer), drop back to a
+            # cheaper-per-round engine.
             n_valid = int(jnp.sum(carry[2]))
             target = cap
-            while target > capacity and n_valid * 6 <= target:
+            while target > capacity and n_valid * 16 <= target:
                 target //= 4
             if target < cap:
                 cap = target
@@ -298,6 +304,11 @@ def check(model: JaxModel, history: Optional[History] = None,
     if explain and history is not None and model.cpu_model is not None:
         res["witness"] = _cpu_witness(model, history, failed_op)
     return res
+
+
+def _round_window(w: int) -> int:
+    """Tightest engine window for a history: multiple of 4, >= 8."""
+    return max(8, ((w + 3) // 4) * 4)
 
 
 def _grow_carry(carry, new_capacity: int):

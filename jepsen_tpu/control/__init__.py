@@ -1,0 +1,142 @@
+"""Control facade: per-node sessions, command sugar, and parallel fan-out.
+
+Parity: jepsen.control (jepsen/src/jepsen/control.clj).  Where the reference
+uses dynamic vars (*host*, *session*, *sudo*...) rebound per node
+(control.clj:43-57), this facade is explicit and immutable: a
+:class:`Session` binds a connected Remote to one node, and ``cd``/``sudo``/
+``env`` return derived session views.  ``on_nodes`` is the parallel fan-out
+(control.clj:299-315, via real-pmap).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu.control.core import (  # noqa: F401
+    CmdResult, Lit, Remote, RemoteCommandFailed, RemoteConnectError, build_cmd,
+    env_str, escape,
+)
+from jepsen_tpu.control.remotes import (  # noqa: F401
+    DockerExec, DummyRemote, K8sExec, RetryRemote, SshRemote, list_pods,
+)
+
+
+@dataclass
+class Session:
+    """A connected control channel to one node, plus execution context."""
+
+    remote: Remote
+    node: str
+    ctx: Dict[str, Any] = field(default_factory=dict)
+    trace: bool = False
+
+    # -- context derivation (control.clj:207-228 cd/sudo/su macros) -------
+    def cd(self, d: str) -> "Session":
+        return replace(self, ctx={**self.ctx, "dir": d})
+
+    def sudo(self, user: Any = True) -> "Session":
+        return replace(self, ctx={**self.ctx, "sudo": user})
+
+    def env(self, **env) -> "Session":
+        return replace(self, ctx={**self.ctx,
+                                  "env": {**self.ctx.get("env", {}), **env}})
+
+    def with_trace(self) -> "Session":
+        return replace(self, trace=True)
+
+    # -- execution (control.clj:142-161 exec/exec*) -----------------------
+    def exec_result(self, *parts, stdin: Optional[str] = None) -> CmdResult:
+        cmd = build_cmd(*parts)
+        if self.trace:
+            import logging
+            logging.getLogger("jepsen.control").info(
+                "[%s] %s", self.node, cmd)
+        return self.remote.execute(self.ctx, cmd, stdin=stdin)
+
+    def exec(self, *parts, stdin: Optional[str] = None) -> str:
+        res = self.exec_result(*parts, stdin=stdin)
+        res.throw_on_nonzero(f"on {self.node}")
+        return res.out.strip()
+
+    def upload(self, local_paths, remote_path: str) -> None:
+        if isinstance(local_paths, str):
+            local_paths = [local_paths]
+        self.remote.upload(self.ctx, local_paths, remote_path)
+
+    def download(self, remote_paths, local_path: str) -> None:
+        if isinstance(remote_paths, str):
+            remote_paths = [remote_paths]
+        self.remote.download(self.ctx, remote_paths, local_path)
+
+    def disconnect(self) -> None:
+        self.remote.disconnect()
+
+
+def conn_spec(test: Dict[str, Any], node: str) -> Dict[str, Any]:
+    """Connection spec for a node from the test's ssh options
+    (control.clj's conn-spec)."""
+    ssh = test.get("ssh", {})
+    return {"host": node,
+            "port": ssh.get("port", 22),
+            "user": ssh.get("username", "root"),
+            "password": ssh.get("password"),
+            "private_key_path": ssh.get("private_key_path"),
+            "namespace": ssh.get("namespace", "default")}
+
+
+def remote_for(test: Dict[str, Any]) -> Remote:
+    """Choose the Remote prototype for a test: test["remote"] wins; dummy
+    mode (ssh {dummy: true}) routes everything to the local dummy."""
+    r = test.get("remote")
+    if r is not None:
+        return r
+    if test.get("ssh", {}).get("dummy"):
+        return DummyRemote()
+    return RetryRemote(SshRemote())
+
+
+def setup_sessions(test: Dict[str, Any]) -> Dict[str, Session]:
+    """Connect a session per node, in parallel (core.clj with-sessions)."""
+    proto = remote_for(test)
+    nodes = list(test.get("nodes") or [])
+
+    def conn(node):
+        return Session(remote=proto.connect(conn_spec(test, node)), node=node)
+
+    with ThreadPoolExecutor(max_workers=max(1, len(nodes))) as ex:
+        sessions = dict(zip(nodes, ex.map(conn, nodes)))
+    test["sessions"] = sessions
+    return sessions
+
+
+def teardown_sessions(test: Dict[str, Any]) -> None:
+    for s in (test.get("sessions") or {}).values():
+        try:
+            s.disconnect()
+        except Exception:  # noqa: BLE001
+            pass
+    test.pop("sessions", None)
+
+
+def session(test: Dict[str, Any], node: str) -> Session:
+    sessions = test.get("sessions")
+    if not sessions or node not in sessions:
+        raise RuntimeError(f"no session for node {node!r}; "
+                           "run inside setup_sessions")
+    return sessions[node]
+
+
+def on_nodes(test: Dict[str, Any],
+             f: Callable[[Dict[str, Any], str], Any],
+             nodes: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+    """Run ``f(test, node)`` on each node concurrently, with that node's
+    session reachable via ``session(test, node)``; returns {node: result}
+    (control.clj:299-315)."""
+    ns = list(nodes if nodes is not None else test.get("nodes") or [])
+    if not ns:
+        return {}
+    with ThreadPoolExecutor(max_workers=len(ns)) as ex:
+        futs = {n: ex.submit(f, test, n) for n in ns}
+        return {n: fut.result() for n, fut in futs.items()}

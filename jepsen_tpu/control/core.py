@@ -1,0 +1,139 @@
+"""Remote protocol — the pluggable command/file transport to cluster nodes.
+
+Parity: jepsen.control.core (jepsen/src/jepsen/control/core.clj:7-58): a
+Remote connects to a node and can execute commands and move files.  The
+shell-escaping, env-var, and sudo-wrapping helpers (core.clj:67-155) live
+here too; everything above (the facade, fan-out) is jepsen_tpu.control.
+
+This is the *control plane* backend (SURVEY.md §5.8): host-side I/O over
+SSH/exec — deliberately not device code.  The data plane (history analysis)
+talks XLA collectives instead.
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+
+@dataclass
+class CmdResult:
+    cmd: str
+    exit: int
+    out: str
+    err: str
+
+    def throw_on_nonzero(self, context: str = ""):
+        if self.exit != 0:
+            raise RemoteCommandFailed(self, context)
+        return self
+
+    @property
+    def ok(self) -> bool:
+        return self.exit == 0
+
+
+class RemoteError(Exception):
+    pass
+
+
+class RemoteConnectError(RemoteError):
+    """Connection-level failure — retriable (control/retry.clj:15-67)."""
+
+
+class RemoteCommandFailed(RemoteError):
+    """Command ran but exited nonzero (core.clj:155's throw+)."""
+
+    def __init__(self, result: CmdResult, context: str = ""):
+        super().__init__(
+            f"command failed ({result.exit}): {result.cmd!r}"
+            + (f" [{context}]" if context else "")
+            + (f"\nstdout: {result.out.strip()}" if result.out.strip() else "")
+            + (f"\nstderr: {result.err.strip()}" if result.err.strip() else ""))
+        self.result = result
+
+
+class Remote:
+    """Transport to one node.  Implementations are context managers."""
+
+    def connect(self, conn_spec: Dict[str, Any]) -> "Remote":
+        """Open a connection per the spec {host, port, user, ...}; returns
+        the connected remote (often self)."""
+        return self
+
+    def disconnect(self) -> None:
+        pass
+
+    def execute(self, ctx: Dict[str, Any], cmd: str,
+                stdin: Optional[str] = None) -> CmdResult:
+        """Run a shell command; ctx may carry {dir, sudo, env}."""
+        raise NotImplementedError
+
+    def upload(self, ctx: Dict[str, Any], local_paths: Sequence[str],
+               remote_path: str) -> None:
+        raise NotImplementedError
+
+    def download(self, ctx: Dict[str, Any], remote_paths: Sequence[str],
+                 local_path: str) -> None:
+        raise NotImplementedError
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disconnect()
+
+
+# ---------------------------------------------------------------------------
+# Command construction helpers
+# ---------------------------------------------------------------------------
+
+
+def escape(arg: Any) -> str:
+    """Shell-escape one argument (core.clj:67-110)."""
+    return shlex.quote(str(arg))
+
+
+class Lit:
+    """A literal command fragment that must NOT be escaped (the reference's
+    jepsen.control/lit)."""
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __str__(self):
+        return self.s
+
+
+def build_cmd(*parts: Any) -> str:
+    """Join command parts, escaping everything but Lit fragments."""
+    out = []
+    for p in parts:
+        if isinstance(p, Lit):
+            out.append(str(p))
+        else:
+            out.append(escape(p))
+    return " ".join(out)
+
+
+def env_str(env: Dict[str, Any]) -> str:
+    """KEY=val prefix string (core.clj:112)."""
+    return " ".join(f"{k}={escape(v)}" for k, v in sorted(env.items()))
+
+
+def wrap_context(ctx: Dict[str, Any], cmd: str) -> str:
+    """Apply {env, dir, sudo, su} context to a command string
+    (core.clj:142's wrap-sudo + the facade's cd/su)."""
+    env = ctx.get("env")
+    if env:
+        cmd = f"env {env_str(env)} {cmd}"
+    d = ctx.get("dir")
+    if d:
+        cmd = f"cd {escape(d)} && {cmd}"
+    user = ctx.get("sudo")
+    if user is True:
+        user = "root"
+    if user:
+        cmd = f"sudo -S -u {escape(user)} bash -c {escape(cmd)}"
+    return cmd

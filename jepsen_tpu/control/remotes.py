@@ -1,0 +1,273 @@
+"""Remote implementations: local-exec dummy, OpenSSH subprocess, docker exec,
+kubectl exec, and the auto-retry wrapper.
+
+Parity map (SURVEY.md §5.8):
+- DummyRemote  — the reference's :dummy no-op session
+  (control/clj_ssh.clj:55-56): full-pipeline tests with no cluster.
+  Ours actually executes locally (sandboxed to a scratch dir) so control
+  utilities are testable for real.
+- SshRemote    — the default transport (control/sshj.clj).  Uses the
+  OpenSSH client with ControlMaster connection sharing: one authenticated
+  connection per node, multiplexed channels per command — the same design
+  point as the reference's one-SSHJ-connection + bounded channels
+  (control/sshj.clj:181-187).
+- DockerExec   — `docker exec` remote (control/docker.clj:30-76).
+- K8sExec      — `kubectl exec` remote (control/k8s.clj:14-95).
+- RetryRemote  — reconnect/backoff wrapper (control/retry.clj:15-67).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu.control.core import (
+    CmdResult, Remote, RemoteConnectError, wrap_context,
+)
+
+DEFAULT_TIMEOUT = 600.0
+
+
+def _run(argv: Sequence[str], stdin: Optional[str] = None,
+         timeout: float = DEFAULT_TIMEOUT) -> CmdResult:
+    try:
+        p = subprocess.run(list(argv), input=stdin, capture_output=True,
+                           text=True, timeout=timeout)
+    except FileNotFoundError as e:
+        raise RemoteConnectError(str(e)) from e
+    except subprocess.TimeoutExpired as e:
+        return CmdResult(cmd=" ".join(argv), exit=124,
+                         out=(e.stdout or ""), err=f"timeout after {timeout}s")
+    return CmdResult(cmd=" ".join(argv), exit=p.returncode,
+                     out=p.stdout, err=p.stderr)
+
+
+class DummyRemote(Remote):
+    """Executes commands locally under bash, or records them without running
+    (``record_only=True``) — both modes unlock full-pipeline tests with no
+    cluster, like the reference's dummy session."""
+
+    def __init__(self, record_only: bool = False):
+        self.record_only = record_only
+        self.log: List[str] = []
+        self.host: Optional[str] = None
+
+    def connect(self, conn_spec):
+        r = DummyRemote(self.record_only)
+        r.log = self.log  # shared command journal across nodes
+        r.host = conn_spec.get("host")
+        return r
+
+    def execute(self, ctx, cmd, stdin=None):
+        full = wrap_context(dict(ctx, sudo=None), cmd)  # no sudo locally
+        self.log.append(f"{self.host}: {full}")
+        if self.record_only:
+            return CmdResult(cmd=full, exit=0, out="", err="")
+        return _run(["bash", "-c", full], stdin=stdin)
+
+    def upload(self, ctx, local_paths, remote_path):
+        self.log.append(f"{self.host}: upload {local_paths} -> {remote_path}")
+        if not self.record_only:
+            import shutil
+            for lp in local_paths:
+                shutil.copy(lp, remote_path)
+
+    def download(self, ctx, remote_paths, local_path):
+        self.log.append(f"{self.host}: download {remote_paths} -> {local_path}")
+        if not self.record_only:
+            import shutil
+            for rp in remote_paths:
+                if os.path.exists(rp):
+                    shutil.copy(rp, local_path)
+
+
+class SshRemote(Remote):
+    """OpenSSH with ControlMaster multiplexing: connect() establishes the
+    master; each execute is a cheap multiplexed channel."""
+
+    def __init__(self):
+        self.spec: Dict[str, Any] = {}
+        self.ctrl_path: Optional[str] = None
+
+    # -- connection -------------------------------------------------------
+    def connect(self, conn_spec):
+        r = SshRemote()
+        r.spec = dict(conn_spec)
+        d = tempfile.mkdtemp(prefix="jt-ssh-")
+        r.ctrl_path = os.path.join(d, "ctl")
+        res = _run(r._ssh_argv(master=True) + ["true"],
+                   timeout=conn_spec.get("connect_timeout", 30))
+        if res.exit != 0:
+            raise RemoteConnectError(
+                f"ssh to {r._dest()} failed: {res.err.strip()}")
+        return r
+
+    def _dest(self) -> str:
+        user = self.spec.get("user", "root")
+        return f"{user}@{self.spec.get('host')}"
+
+    def _ssh_argv(self, master: bool = False) -> List[str]:
+        argv = ["ssh", "-o", "BatchMode=yes",
+                "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", "LogLevel=ERROR",
+                "-p", str(self.spec.get("port", 22))]
+        if self.ctrl_path:
+            argv += ["-o", f"ControlPath={self.ctrl_path}"]
+            if master:
+                argv += ["-o", "ControlMaster=auto",
+                         "-o", "ControlPersist=600"]
+        pk = self.spec.get("private_key_path")
+        if pk:
+            argv += ["-i", pk]
+        argv.append(self._dest())
+        return argv
+
+    def disconnect(self):
+        if self.ctrl_path and os.path.exists(self.ctrl_path):
+            _run(["ssh", "-o", f"ControlPath={self.ctrl_path}",
+                  "-O", "exit", self._dest()], timeout=10)
+
+    # -- operations -------------------------------------------------------
+    def execute(self, ctx, cmd, stdin=None):
+        full = wrap_context(ctx, cmd)
+        return _run(self._ssh_argv() + [full], stdin=stdin)
+
+    def _scp_base(self) -> List[str]:
+        argv = ["scp", "-o", "BatchMode=yes",
+                "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", "LogLevel=ERROR",
+                "-P", str(self.spec.get("port", 22))]
+        if self.ctrl_path:
+            argv += ["-o", f"ControlPath={self.ctrl_path}"]
+        pk = self.spec.get("private_key_path")
+        if pk:
+            argv += ["-i", pk]
+        return argv
+
+    def upload(self, ctx, local_paths, remote_path):
+        res = _run(self._scp_base() + list(local_paths)
+                   + [f"{self._dest()}:{remote_path}"])
+        res.throw_on_nonzero("upload")
+
+    def download(self, ctx, remote_paths, local_path):
+        res = _run(self._scp_base()
+                   + [f"{self._dest()}:{p}" for p in remote_paths]
+                   + [local_path])
+        res.throw_on_nonzero("download")
+
+
+class DockerExec(Remote):
+    """Runs commands in a container via docker exec
+    (control/docker.clj:30-76)."""
+
+    def __init__(self, container_prefix: str = ""):
+        self.container_prefix = container_prefix
+        self.container: Optional[str] = None
+
+    def connect(self, conn_spec):
+        r = DockerExec(self.container_prefix)
+        r.container = self.container_prefix + conn_spec["host"]
+        return r
+
+    def execute(self, ctx, cmd, stdin=None):
+        full = wrap_context(ctx, cmd)
+        return _run(["docker", "exec", "-i", self.container,
+                     "bash", "-c", full], stdin=stdin)
+
+    def upload(self, ctx, local_paths, remote_path):
+        for lp in local_paths:
+            _run(["docker", "cp", lp,
+                  f"{self.container}:{remote_path}"]).throw_on_nonzero()
+
+    def download(self, ctx, remote_paths, local_path):
+        for rp in remote_paths:
+            _run(["docker", "cp", f"{self.container}:{rp}",
+                  local_path]).throw_on_nonzero()
+
+
+class K8sExec(Remote):
+    """Runs commands in a pod via kubectl exec (control/k8s.clj:14-95)."""
+
+    def __init__(self, namespace: str = "default"):
+        self.namespace = namespace
+        self.pod: Optional[str] = None
+
+    def connect(self, conn_spec):
+        r = K8sExec(conn_spec.get("namespace", self.namespace))
+        r.pod = conn_spec["host"]
+        return r
+
+    def execute(self, ctx, cmd, stdin=None):
+        full = wrap_context(ctx, cmd)
+        return _run(["kubectl", "-n", self.namespace, "exec", "-i", self.pod,
+                     "--", "bash", "-c", full], stdin=stdin)
+
+    def upload(self, ctx, local_paths, remote_path):
+        for lp in local_paths:
+            _run(["kubectl", "-n", self.namespace, "cp", lp,
+                  f"{self.pod}:{remote_path}"]).throw_on_nonzero()
+
+    def download(self, ctx, remote_paths, local_path):
+        for rp in remote_paths:
+            _run(["kubectl", "-n", self.namespace, "cp",
+                  f"{self.pod}:{rp}", local_path]).throw_on_nonzero()
+
+
+def list_pods(namespace: str = "default") -> List[str]:
+    res = _run(["kubectl", "-n", namespace, "get", "pods",
+                "-o", "jsonpath={.items[*].metadata.name}"])
+    res.throw_on_nonzero()
+    return res.out.split()
+
+
+class RetryRemote(Remote):
+    """Wraps a remote with reconnect-and-retry on connection errors
+    (control/retry.clj: 5 tries, 1 s backoff)."""
+
+    def __init__(self, inner: Remote, tries: int = 5, backoff_s: float = 1.0):
+        self.proto = inner
+        self.inner: Optional[Remote] = None
+        self.spec: Dict[str, Any] = {}
+        self.tries = tries
+        self.backoff_s = backoff_s
+
+    def connect(self, conn_spec):
+        r = RetryRemote(self.proto, self.tries, self.backoff_s)
+        r.spec = conn_spec
+        r.inner = r._retry(lambda: self.proto.connect(conn_spec))
+        return r
+
+    def _retry(self, f):
+        last = None
+        for i in range(self.tries):
+            try:
+                return f()
+            except RemoteConnectError as e:
+                last = e
+                time.sleep(self.backoff_s)
+                if self.inner is not None:
+                    try:
+                        self.inner = self.proto.connect(self.spec)
+                    except RemoteConnectError:
+                        pass
+        raise last
+
+    def disconnect(self):
+        if self.inner:
+            self.inner.disconnect()
+
+    def execute(self, ctx, cmd, stdin=None):
+        return self._retry(lambda: self.inner.execute(ctx, cmd, stdin))
+
+    def upload(self, ctx, local_paths, remote_path):
+        return self._retry(lambda: self.inner.upload(ctx, local_paths,
+                                                     remote_path))
+
+    def download(self, ctx, remote_paths, local_path):
+        return self._retry(lambda: self.inner.download(ctx, remote_paths,
+                                                       local_path))

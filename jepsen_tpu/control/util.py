@@ -1,0 +1,146 @@
+"""Node-side helpers built on the control facade.
+
+Parity: jepsen.control.util (jepsen/src/jepsen/control/util.clj): daemon
+management with pidfiles, package download/installation with a control-side
+cache, process signalling, and small file utilities.  All functions take a
+:class:`~jepsen_tpu.control.Session`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu.control import Lit, RemoteCommandFailed, Session
+
+
+def exists(s: Session, path: str) -> bool:
+    return s.exec_result("test", "-e", path).ok
+
+
+def await_tcp_port(s: Session, port: int, timeout_s: float = 60,
+                   interval_s: float = 0.5) -> None:
+    """Block until something listens on ``port`` (util.clj:14)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if s.exec_result("bash", "-c",
+                         f"exec 3<>/dev/tcp/localhost/{port}").ok:
+            return
+        time.sleep(interval_s)
+    raise TimeoutError(f"port {port} on {s.node} not open "
+                       f"after {timeout_s}s")
+
+
+def tmp_file(s: Session, suffix: str = "") -> str:
+    return s.exec("mktemp", f"--suffix={suffix}" if suffix else "--suffix=")
+
+
+def tmp_dir(s: Session) -> str:
+    return s.exec("mktemp", "-d")
+
+
+def write_file(s: Session, content: str, path: str) -> None:
+    """Write a string to a node-side file (util.clj:88)."""
+    s.exec("tee", path, stdin=content)
+
+
+def wget(s: Session, url: str, dest: Optional[str] = None,
+         force: bool = False) -> str:
+    """Download a URL on the node (util.clj:133)."""
+    name = dest or url.rstrip("/").rsplit("/", 1)[-1]
+    if force or not exists(s, name):
+        s.exec("wget", "-q", "-O", name, url)
+    return name
+
+
+def cached_wget(s: Session, url: str,
+                cache_dir: str = "/tmp/jepsen/cache") -> str:
+    """Download once per node, keyed by URL hash (util.clj:167)."""
+    import hashlib
+    h = hashlib.sha256(url.encode()).hexdigest()[:16]
+    path = f"{cache_dir}/{h}"
+    if not exists(s, path):
+        s.exec("mkdir", "-p", cache_dir)
+        s.exec("wget", "-q", "-O", path + ".tmp", url)
+        s.exec("mv", path + ".tmp", path)
+    return path
+
+
+def install_archive(s: Session, url: str, dest: str,
+                    force: bool = False) -> str:
+    """Download and unpack a tarball/zip into ``dest``, stripping a single
+    top-level directory if present (util.clj:199)."""
+    if exists(s, dest) and not force:
+        return dest
+    local = cached_wget(s, url)
+    tmp = tmp_dir(s)
+    if url.endswith(".zip"):
+        s.exec("unzip", "-q", local, "-d", tmp)
+    else:
+        s.exec("tar", "-xf", local, "-C", tmp)
+    entries = s.exec("ls", "-A", tmp).split()
+    s.exec("rm", "-rf", dest)
+    s.exec("mkdir", "-p", Lit(f"$(dirname {dest})"))
+    if len(entries) == 1:
+        s.exec("mv", f"{tmp}/{entries[0]}", dest)
+        s.exec("rm", "-rf", tmp)
+    else:
+        s.exec("mv", tmp, dest)
+    return dest
+
+
+def ensure_user(s: Session, username: str) -> None:
+    """Create a user if absent (util.clj:277)."""
+    if not s.exec_result("id", username).ok:
+        s.exec("useradd", "--create-home", username)
+
+
+def grepkill(s: Session, pattern: str, signal: str = "KILL") -> None:
+    """Kill processes matching a pattern (util.clj:286)."""
+    s.exec_result("pkill", f"-{signal}", "-f", pattern)
+
+
+def signal(s: Session, process_name: str, sig: str) -> None:
+    """Send a signal by process name (util.clj:403)."""
+    s.exec_result("killall", f"-{sig}", process_name)
+
+
+def start_daemon(s: Session, binary: str, *args,
+                 pidfile: str, logfile: str, chdir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None) -> None:
+    """Start a long-running process detached with a pidfile
+    (util.clj:311's start-stop-daemon pattern, without requiring the
+    start-stop-daemon binary)."""
+    from jepsen_tpu.control.core import build_cmd, env_str
+    cmd = build_cmd(binary, *args)
+    if env:
+        cmd = f"env {env_str(env)} {cmd}"
+    if chdir:
+        cmd = f"cd {chdir} && {cmd}"
+    script = (f"if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; "
+              f"then echo already-running; else "
+              f"nohup {cmd} >> {logfile} 2>&1 & echo $! > {pidfile}; fi")
+    s.exec("bash", "-c", script)
+
+
+def stop_daemon(s: Session, pidfile: str, timeout_s: float = 10) -> None:
+    """Kill the pidfile's process tree and remove the pidfile
+    (util.clj:370)."""
+    script = (f"if [ -f {pidfile} ]; then pid=$(cat {pidfile}); "
+              f"kill -TERM $pid 2>/dev/null || true; fi")
+    s.exec("bash", "-c", script)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if not daemon_running(s, pidfile):
+            break
+        time.sleep(0.25)
+    script = (f"if [ -f {pidfile} ]; then pid=$(cat {pidfile}); "
+              f"kill -KILL $pid 2>/dev/null || true; rm -f {pidfile}; fi")
+    s.exec("bash", "-c", script)
+
+
+def daemon_running(s: Session, pidfile: str) -> bool:
+    """Is the pidfile's process alive? (util.clj:390)"""
+    return s.exec_result(
+        "bash", "-c",
+        f"[ -f {pidfile} ] && kill -0 $(cat {pidfile})").ok

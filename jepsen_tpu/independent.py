@@ -1,0 +1,226 @@
+"""Lift single-key workloads over a space of keys.
+
+Parity: jepsen.independent (jepsen/src/jepsen/independent.clj): ops carry
+``(key, value)`` tuples; generators run one key at a time
+(sequential_generator) or k keys across disjoint thread groups
+(concurrent_generator, independent.clj:213-239); the checker splits the
+history per key and checks each sub-history (independent.clj:266-317).
+
+TPU-first difference: when the sub-checker is a device-tier linearizable
+checker, the per-key sub-histories are checked as ONE vmapped batch sharded
+over the mesh (jepsen_tpu.parallel.check_batch) instead of a bounded pmap of
+independent solver runs — the per-key independence the reference exploits
+for CPU parallelism maps directly onto the ``data`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN, check_safe, merge_valid
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.history import History, INVOKE, NEMESIS, Op
+
+KeyedValue = Tuple[Any, Any]
+
+
+def tuple_(k, v) -> KeyedValue:
+    """A keyed value (independent.clj:21)."""
+    return (k, v)
+
+
+def key_of(op: Op) -> Optional[Any]:
+    v = op.value
+    if isinstance(v, tuple) and len(v) == 2:
+        return v[0]
+    return None
+
+
+def history_keys(history: History) -> List[Any]:
+    """All keys in the history, in first-appearance order
+    (independent.clj:240)."""
+    seen = []
+    ss = set()
+    for op in history:
+        k = key_of(op)
+        if k is not None and k not in ss:
+            ss.add(k)
+            seen.append(k)
+    return seen
+
+
+def subhistory(k, history: History) -> History:
+    """The sub-history of key ``k``, values unwrapped
+    (independent.clj:252)."""
+    out = []
+    for op in history:
+        kk = key_of(op)
+        if kk is None and op.process == NEMESIS:
+            out.append(op)  # nemesis ops apply to every key's timeline
+        elif kk == k:
+            out.append(op.with_(value=op.value[1]))
+    return History(out, reindex=True)
+
+
+class _WrapKey(gen.Generator):
+    """Wrap an inner generator's op values as (key, value)."""
+
+    def __init__(self, k, inner):
+        self.k = k
+        self.inner = gen.lift(inner)
+
+    def op(self, test, ctx):
+        if self.inner is None:
+            return None
+        r = self.inner.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is gen.PENDING:
+            return (gen.PENDING, _WrapKey(self.k, g2))
+        v = v.with_(value=(self.k, v.value))
+        return (v, _WrapKey(self.k, g2) if g2 is not None else None)
+
+    def update(self, test, ctx, event):
+        if self.inner is None:
+            return self
+        k = key_of(event)
+        if k == self.k:
+            event = event.with_(value=event.value[1])
+            return _WrapKey(self.k, self.inner.update(test, ctx, event))
+        return self
+
+
+def sequential_generator(keys: Iterable[Any],
+                         fgen: Callable[[Any], Any]) -> gen.Generator:
+    """One key at a time: when key k's generator exhausts, move to the next
+    (independent.clj:31)."""
+    return gen.Concat([_WrapKey(k, fgen(k)) for k in keys])
+
+
+class ConcurrentGenerator(gen.Generator):
+    """k keys at once, each owning a disjoint group of n threads
+    (independent.clj:213-239): when a key's generator exhausts, its thread
+    group moves on to the next unclaimed key."""
+
+    def __init__(self, n: int, keys: Sequence[Any],
+                 fgen: Callable[[Any], Any]):
+        self.n = n
+        self.keys = list(keys)
+        self.fgen = fgen
+        self.active: Dict[int, Optional[gen.Generator]] = {}  # group -> gen
+        self.next_key = 0
+
+    def _clone(self):
+        c = ConcurrentGenerator.__new__(ConcurrentGenerator)
+        c.n = self.n
+        c.keys = self.keys
+        c.fgen = self.fgen
+        c.active = dict(self.active)
+        c.next_key = self.next_key
+        return c
+
+    def _groups(self, ctx) -> List[List[Any]]:
+        threads = [t for t in ctx.all_threads() if t != NEMESIS]
+        return [threads[i:i + self.n]
+                for i in range(0, len(threads) - len(threads) % self.n, self.n)]
+
+    def _ensure(self, c, gi):
+        if gi not in c.active:
+            if c.next_key < len(c.keys):
+                k = c.keys[c.next_key]
+                c.next_key += 1
+                c.active[gi] = _WrapKey(k, c.fgen(k))
+            else:
+                c.active[gi] = None
+
+    def op(self, test, ctx):
+        c = self._clone()
+        groups = self._groups(ctx)
+        pending = False
+        for gi, threads in enumerate(groups):
+            while True:
+                self._ensure(c, gi)
+                g = c.active[gi]
+                if g is None:
+                    break
+                r = g.op(test, ctx.restrict(threads))
+                if r is None:
+                    # group's key exhausted: advance to next key
+                    del c.active[gi]
+                    if c.next_key >= len(c.keys):
+                        c.active[gi] = None
+                        break
+                    continue
+                v, g2 = r
+                if v is gen.PENDING:
+                    pending = True
+                    c.active[gi] = g2
+                    break
+                c.active[gi] = g2
+                return (v, c)
+        if pending:
+            return (gen.PENDING, c)
+        if all(g is None for g in c.active.values()) and \
+                c.next_key >= len(c.keys):
+            return None
+        return (gen.PENDING, c)
+
+    def update(self, test, ctx, event):
+        t = ctx.process_thread(getattr(event, "process", None))
+        if t is None or t == NEMESIS:
+            return self
+        c = self._clone()
+        for gi, threads in enumerate(self._groups(ctx)):
+            if t in threads and c.active.get(gi) is not None:
+                c.active[gi] = c.active[gi].update(
+                    test, ctx.restrict(threads), event)
+                break
+        return c
+
+
+def concurrent_generator(n: int, keys: Sequence[Any],
+                         fgen: Callable[[Any], Any]) -> gen.Generator:
+    return ConcurrentGenerator(n, keys, fgen)
+
+
+class IndependentChecker(Checker):
+    """Split the history per key; check each sub-history
+    (independent.clj:266-317).  Device-tier linearizable sub-checkers batch
+    all keys into one vmapped engine call (optionally mesh-sharded)."""
+
+    def __init__(self, inner: Checker, mesh=None, max_workers: int = 8):
+        self.inner = inner
+        self.mesh = mesh
+        self.max_workers = max_workers
+
+    def check(self, test, history, opts=None):
+        keys = history_keys(history)
+        subs = {k: subhistory(k, history) for k in keys}
+        results: Dict[Any, Dict[str, Any]] = {}
+
+        inner = self.inner
+        if isinstance(inner, Linearizable) and inner._jax_model() is not None:
+            from jepsen_tpu.parallel import check_batch
+            jm = inner._jax_model()
+            rs = check_batch(jm, [subs[k] for k in keys], mesh=self.mesh,
+                             **{k: v for k, v in inner.engine_opts.items()
+                                if k in ("capacity", "max_capacity", "chunk")})
+            results = dict(zip(keys, rs))
+        else:
+            with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+                futs = {k: ex.submit(check_safe, inner, test, subs[k], opts)
+                        for k in keys}
+                results = {k: f.result() for k, f in futs.items()}
+
+        bad = {k: r for k, r in results.items() if r.get("valid") is not True}
+        return {"valid": merge_valid([r.get("valid") for r in results.values()]),
+                "key-count": len(keys),
+                "results": results,
+                "failures": sorted(bad, key=repr)}
+
+
+def checker(inner: Checker, mesh=None) -> Checker:
+    return IndependentChecker(inner, mesh=mesh)

@@ -39,8 +39,9 @@ def check_batch(model: JaxModel,
     """
     if not histories:
         return []
+    from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
-    window = max(32, ((max(p.window for p in preps) + 31) // 32) * 32)
+    window = _round_window(max(p.window for p in preps))
     evs = [events_array(p, chunk) for p in preps]
     emax = max(e.shape[0] for e in evs)
     b = len(evs)

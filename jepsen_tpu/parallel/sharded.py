@@ -66,13 +66,14 @@ def check_sharded(model: JaxModel,
                   max_window: int = 4096) -> Dict[str, Any]:
     """Frontier-sharded linearizability check of one history."""
     assert mesh is not None, "check_sharded requires a mesh"
+    from jepsen_tpu.checker.wgl_tpu import _round_window
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
-    window = max(32, ((p.window + 31) // 32) * 32)
+    window = _round_window(p.window)
     ev = events_array(p, chunk)
     n_chunks = ev.shape[0] // chunk
     n = mesh.shape[axis]
-    MW, S = window // 32, model.state_size
+    MW, S = (window + 31) // 32, model.state_size
 
     cap = capacity_per_shard
     while True:

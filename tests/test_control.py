@@ -1,0 +1,116 @@
+"""Control plane: escaping, context wrapping, dummy remote (local exec),
+fan-out, daemon helpers.  No cluster needed — the dummy remote runs locally
+(the reference's :dummy session pattern)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.control.core import (
+    CmdResult, Lit, build_cmd, env_str, escape, wrap_context,
+)
+
+
+class TestEscaping:
+    def test_escape(self):
+        assert escape("simple") == "simple"
+        assert escape("has space") == "'has space'"
+        assert escape("a;rm -rf /") == "'a;rm -rf /'"
+
+    def test_build_cmd_with_lit(self):
+        assert build_cmd("echo", "hi there", Lit("| wc -l")) == \
+            "echo 'hi there' | wc -l"
+
+    def test_env_str(self):
+        assert env_str({"B": "2", "A": "one two"}) == "A='one two' B=2"
+
+    def test_wrap_context(self):
+        cmd = wrap_context({"dir": "/tmp", "env": {"X": "1"}}, "ls")
+        assert cmd == "cd /tmp && env X=1 ls"
+
+    def test_wrap_sudo(self):
+        cmd = wrap_context({"sudo": True}, "whoami")
+        assert cmd == "sudo -S -u root bash -c whoami"
+
+
+def dummy_test(nodes=("n1", "n2", "n3")):
+    return {"nodes": list(nodes), "ssh": {"dummy": True}}
+
+
+class TestDummySessions:
+    def test_exec_local(self):
+        t = dummy_test()
+        control.setup_sessions(t)
+        s = control.session(t, "n1")
+        assert s.exec("echo", "hello") == "hello"
+        control.teardown_sessions(t)
+
+    def test_throw_on_nonzero(self):
+        t = dummy_test()
+        control.setup_sessions(t)
+        s = control.session(t, "n1")
+        with pytest.raises(control.RemoteCommandFailed):
+            s.exec("false")
+        control.teardown_sessions(t)
+
+    def test_cd_env(self):
+        t = dummy_test()
+        control.setup_sessions(t)
+        s = control.session(t, "n1")
+        assert s.cd("/tmp").exec("pwd") == "/tmp"
+        assert s.env(JT_TEST="42").exec("bash", "-c", "echo $JT_TEST") == "42"
+        control.teardown_sessions(t)
+
+    def test_on_nodes_parallel(self):
+        t = dummy_test()
+        control.setup_sessions(t)
+
+        def hostname(test, node):
+            return control.session(test, node).exec("echo", node)
+
+        out = control.on_nodes(t, hostname)
+        assert out == {"n1": "n1", "n2": "n2", "n3": "n3"}
+        control.teardown_sessions(t)
+
+    def test_record_only_mode(self):
+        t = {"nodes": ["a"], "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        s = control.session(t, "a")
+        assert s.exec("rm", "-rf", "/never-actually-run") == ""
+        assert any("never-actually-run" in line for line in s.remote.log)
+        control.teardown_sessions(t)
+
+
+class TestUtil:
+    @pytest.fixture
+    def sess(self, tmp_path):
+        t = dummy_test(nodes=["local"])
+        control.setup_sessions(t)
+        yield control.session(t, "local")
+        control.teardown_sessions(t)
+
+    def test_write_and_exists(self, sess, tmp_path):
+        p = str(tmp_path / "f.txt")
+        cu.write_file(sess, "content\n", p)
+        assert cu.exists(sess, p)
+        assert sess.exec("cat", p) == "content"
+
+    def test_tmp_file_dir(self, sess):
+        f = cu.tmp_file(sess)
+        d = cu.tmp_dir(sess)
+        assert cu.exists(sess, f) and cu.exists(sess, d)
+        sess.exec("rm", "-rf", f, d)
+
+    def test_daemon_lifecycle(self, sess, tmp_path):
+        pidfile = str(tmp_path / "d.pid")
+        logfile = str(tmp_path / "d.log")
+        cu.start_daemon(sess, "sleep", "60",
+                        pidfile=pidfile, logfile=logfile)
+        assert cu.daemon_running(sess, pidfile)
+        # idempotent start
+        cu.start_daemon(sess, "sleep", "60",
+                        pidfile=pidfile, logfile=logfile)
+        cu.stop_daemon(sess, pidfile)
+        assert not cu.daemon_running(sess, pidfile)
