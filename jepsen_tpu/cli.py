@@ -1,0 +1,140 @@
+"""Command-line runner for test suites.
+
+Parity: jepsen.cli (jepsen/src/jepsen/cli.clj): a shared option vocabulary
+(nodes, ssh, concurrency with the "3n" syntax, time limits, repeat counts —
+cli.clj:64-168), a ``test`` subcommand built from a suite's test function
+(single-test-cmd, cli.clj:355), ``test-all`` sweeps (cli.clj:491), an
+``analyze`` mode for re-checking stored histories (the store/REPL pattern),
+and ``serve`` for the results browser.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import core, store
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """Shared test options (cli.clj:64-111 test-opt-spec)."""
+    p.add_argument("--node", "-n", action="append", dest="nodes",
+                   help="node hostname (repeatable)")
+    p.add_argument("--nodes", dest="nodes_csv",
+                   help="comma-separated node list")
+    p.add_argument("--nodes-file", help="file with one node per line")
+    p.add_argument("--username", default="root")
+    p.add_argument("--password")
+    p.add_argument("--ssh-private-key")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--dummy-ssh", action="store_true",
+                   help="no-op control plane (in-process testing)")
+    p.add_argument("--concurrency", "-c", default="1n",
+                   help="worker count; '3n' = 3x node count")
+    p.add_argument("--time-limit", type=float, default=60.0,
+                   help="workload duration in seconds")
+    p.add_argument("--test-count", type=int, default=1,
+                   help="how many times to run the test")
+    p.add_argument("--leave-db-running", action="store_true")
+    p.add_argument("--store", default="store", help="results directory")
+
+
+def parse_nodes(args) -> List[str]:
+    if args.nodes:
+        return args.nodes
+    if getattr(args, "nodes_csv", None):
+        return [n.strip() for n in args.nodes_csv.split(",") if n.strip()]
+    if args.nodes_file:
+        with open(args.nodes_file) as f:
+            return [l.strip() for l in f if l.strip()]
+    return ["n1", "n2", "n3", "n4", "n5"]  # cli.clj:18 default
+
+
+def test_opts_to_map(args) -> Dict[str, Any]:
+    return {
+        "nodes": parse_nodes(args),
+        "ssh": {"username": args.username,
+                "password": args.password,
+                "private_key_path": args.ssh_private_key,
+                "port": args.ssh_port,
+                "dummy": args.dummy_ssh},
+        "concurrency": args.concurrency,
+        "time_limit": args.time_limit,
+        "leave_db_running": args.leave_db_running,
+        "store_base": args.store,
+    }
+
+
+def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
+                    opt_fn: Optional[Callable] = None,
+                    argv: Optional[Sequence[str]] = None,
+                    prog: str = "jepsen-tpu") -> int:
+    """Build and run the standard CLI around a suite's test constructor
+    (cli.clj:355 single-test-cmd).  ``opt_fn`` may add suite options."""
+    parser = argparse.ArgumentParser(prog=prog)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    pt = sub.add_parser("test", help="run one test")
+    add_test_opts(pt)
+    if opt_fn:
+        opt_fn(pt)
+
+    pa = sub.add_parser("analyze", help="re-check a stored run")
+    pa.add_argument("dir", help="store run directory (or .../latest)")
+
+    ps = sub.add_parser("serve", help="results web browser")
+    ps.add_argument("--port", type=int, default=8080)
+    ps.add_argument("--store", default="store")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "test":
+        opts = test_opts_to_map(args)
+        for k, v in vars(args).items():
+            if k not in opts and v is not None:
+                opts[k.replace("-", "_")] = v
+        failures = 0
+        for i in range(args.test_count):
+            test = test_fn(dict(opts))
+            done = core.run(test)
+            valid = done.get("results", {}).get("valid")
+            print(json.dumps({"run": i, "dir": done.get("store_dir"),
+                              "valid": valid}))
+            if valid is not True:
+                failures += 1
+        return 1 if failures else 0
+
+    if args.cmd == "analyze":
+        test = store.load_test(args.dir)
+        history = store.load_history(args.dir)
+        full = test_fn(test)  # rebuild checker from suite
+        results = core.analyze(full, history)
+        print(json.dumps(results, indent=2, default=str))
+        return 0 if results.get("valid") is True else 1
+
+    if args.cmd == "serve":
+        from jepsen_tpu.web import serve
+        serve(base=args.store, port=args.port)
+        return 0
+
+    return 2
+
+
+def test_all_cmd(tests_fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]],
+                 opt_fn: Optional[Callable] = None,
+                 argv: Optional[Sequence[str]] = None) -> int:
+    """Run a suite's whole sweep matrix (cli.clj:433-519)."""
+    parser = argparse.ArgumentParser()
+    add_test_opts(parser)
+    if opt_fn:
+        opt_fn(parser)
+    args = parser.parse_args(argv)
+    opts = test_opts_to_map(args)
+    summary = core.run_tests(tests_fn(dict(opts)))
+    for r in summary["results"]:
+        print(json.dumps(r, default=str))
+    print(json.dumps({"failures": summary["failures"],
+                      "unknown": summary["unknown"]}))
+    return summary["exit"]

@@ -1,0 +1,188 @@
+"""The orchestrator: run a complete test end to end.
+
+Parity: jepsen.core/run! (jepsen/src/jepsen/core.clj:322-401), composed of
+the same phases with the same durability guarantees:
+
+  prepare -> store.save_0 -> sessions -> OS setup -> DB setup ->
+  client+nemesis setup -> interpreter run (history) -> store.save_1 ->
+  analysis (checker) -> store.save_2 -> log snarfing -> teardown
+
+Failures during analysis never lose the history (it hit disk in save_1);
+a JVM-shutdown-hook's job (core.clj:143-163) is played by try/finally
+blocks around log download and teardown.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import control, db as jdb, nemesis as jnemesis, store
+from jepsen_tpu import os as jos
+from jepsen_tpu.checker.core import Checker, UNKNOWN, check_safe
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History
+
+logger = logging.getLogger("jepsen.core")
+
+
+def prepare_test(test: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill defaults (core.clj:306-320 prepare-test)."""
+    test.setdefault("name", "noname")
+    test.setdefault("start_time", time.strftime("%Y%m%dT%H%M%S"))
+    test.setdefault("nodes", [])
+    concurrency = test.get("concurrency", 5)
+    if isinstance(concurrency, str) and concurrency.endswith("n"):
+        # "3n" syntax: multiple of node count (cli.clj:150-168)
+        concurrency = int(concurrency[:-1] or 1) * max(1, len(test["nodes"]))
+    test["concurrency"] = int(concurrency)
+    return test
+
+
+def run(test: Dict[str, Any]) -> Dict[str, Any]:
+    """Run the test; returns it with :history and :results attached."""
+    prepare_test(test)
+    store.make_run_dir(test)
+    log_handler = store.start_logging(test)
+    logger.info("Running test %s", test["name"])
+    try:
+        store.save_0(test)
+        has_cluster = bool(test.get("nodes"))
+        if has_cluster:
+            control.setup_sessions(test)
+        try:
+            _setup_os(test)
+            _setup_db(test)
+            try:
+                history = _run_case(test)
+            finally:
+                _teardown_db(test, final=True)
+            test["history"] = history
+            store.save_1(test, history)
+            results = analyze(test, history)
+            test["results"] = results
+            store.save_2(test, results)
+            _log_results(results)
+            return test
+        finally:
+            if has_cluster:
+                try:
+                    _snarf_logs(test)
+                except Exception:  # noqa: BLE001
+                    logger.exception("downloading node logs")
+                control.teardown_sessions(test)
+    finally:
+        store.stop_logging(log_handler)
+
+
+def _setup_os(test) -> None:
+    osys = test.get("os")
+    if osys is None or not test.get("nodes"):
+        return
+    logger.info("Setting up OS")
+    control.on_nodes(test, osys.setup)
+
+
+def _setup_db(test) -> None:
+    database = test.get("db")
+    if database is None or not test.get("nodes"):
+        return
+    logger.info("Setting up DB")
+
+    def cyc(t, node):
+        jdb.cycle_(database, t, node)
+
+    control.on_nodes(test, cyc)
+    if isinstance(database, jdb.Primary) and test["nodes"]:
+        database.setup_primary(test, test["nodes"][0])
+
+
+def _teardown_db(test, final: bool = False) -> None:
+    database = test.get("db")
+    if database is None or not test.get("nodes"):
+        return
+    if test.get("leave_db_running"):
+        logger.info("Leaving DB running for inspection")
+        return
+    logger.info("Tearing down DB")
+    control.on_nodes(test, database.teardown)
+
+
+def _run_case(test) -> History:
+    """Set up nemesis+clients, run the generator, tear down
+    (core.clj:176-214 run-case!)."""
+    nem = test.get("nemesis") or jnemesis.NoopNemesis()
+    test["nemesis"] = nem.setup(test)
+    try:
+        logger.info("Running workload")
+        return interpreter.run(test)
+    finally:
+        try:
+            test["nemesis"].teardown(test)
+        except Exception:  # noqa: BLE001
+            logger.exception("nemesis teardown")
+
+
+def analyze(test, history: History) -> Dict[str, Any]:
+    """Run the checker over the history (core.clj:216-232 analyze!)."""
+    logger.info("Analyzing history (%d ops)", len(history))
+    checker: Optional[Checker] = test.get("checker")
+    if checker is None:
+        return {"valid": True, "note": "no checker configured"}
+    return check_safe(checker, test, history,
+                      {"store_dir": test.get("store_dir")})
+
+
+def _snarf_logs(test) -> None:
+    """Download db log files into the store dir (core.clj:102-129)."""
+    database = test.get("db")
+    if not isinstance(database, jdb.LogFiles):
+        return
+    import os as _os
+
+    def snarf(t, node):
+        s = control.session(t, node)
+        dest = _os.path.join(t["store_dir"], node)
+        _os.makedirs(dest, exist_ok=True)
+        for path in database.log_files(t, node):
+            try:
+                s.download(path, dest)
+            except Exception:  # noqa: BLE001
+                logger.warning("couldn't download %s from %s", path, node)
+
+    control.on_nodes(test, snarf)
+
+
+def _log_results(results: Dict[str, Any]) -> None:
+    v = results.get("valid")
+    if v is True:
+        logger.info("Everything looks good! (⌐■_■)")
+    elif v == UNKNOWN:
+        logger.warning("Errors occurred during analysis; verdict unknown")
+    else:
+        logger.error("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+
+
+def run_tests(tests, raise_on_failure: bool = False):
+    """Run a sequence of tests, collecting verdicts (cli.clj:433-519
+    test-all)."""
+    results = []
+    for t in tests:
+        try:
+            done = run(t)
+            results.append({"name": done.get("name"),
+                            "dir": done.get("store_dir"),
+                            "valid": done.get("results", {}).get("valid")})
+        except Exception as e:  # noqa: BLE001
+            logger.error("test crashed: %s", e)
+            results.append({"name": t.get("name"), "valid": UNKNOWN,
+                            "error": traceback.format_exc()})
+    n_bad = sum(1 for r in results if r["valid"] is False)
+    n_unknown = sum(1 for r in results if r["valid"] == UNKNOWN)
+    summary = {"results": results, "failures": n_bad, "unknown": n_unknown,
+               "exit": 2 if n_unknown and not n_bad else (1 if n_bad else 0)}
+    if raise_on_failure and summary["exit"]:
+        raise RuntimeError(f"{n_bad} failures, {n_unknown} unknown")
+    return summary

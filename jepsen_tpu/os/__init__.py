@@ -1,0 +1,81 @@
+"""OS protocol — preparing cluster nodes' operating systems.
+
+Parity: jepsen.os (jepsen/src/jepsen/os.clj:4-8) plus the distro
+implementations (os/debian.clj, os/centos.clj, os/ubuntu.clj).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+from jepsen_tpu.control import Session, session
+
+
+class OS:
+    def setup(self, test: Dict[str, Any], node: str) -> None:
+        """Prepare the OS: packages, hostnames, users."""
+
+    def teardown(self, test: Dict[str, Any], node: str) -> None:
+        pass
+
+
+class NoopOS(OS):
+    pass
+
+
+noop = NoopOS
+
+
+class Debian(OS):
+    """Debian/Ubuntu node prep (os/debian.clj:13-197): apt packages,
+    /etc/hosts population."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.env(DEBIAN_FRONTEND="noninteractive").exec(
+            "apt-get", "install", "-y", "--no-install-recommends",
+            "curl", "wget", "unzip", "iptables", "iproute2", "psmisc",
+            "gcc", "libc6-dev", *self.packages)
+        self._setup_hosts(test, s)
+
+    def _setup_hosts(self, test, s: Session):
+        nodes = test.get("nodes") or []
+        lines = []
+        for n in nodes:
+            ip = self.ip_of(s, n)
+            if ip:
+                lines.append(f"{ip} {n}")
+        if lines:
+            from jepsen_tpu.control import util as cu
+            hosts = s.exec("cat", "/etc/hosts")
+            add = [l for l in lines if l not in hosts]
+            if add:
+                s.exec("tee", "-a", "/etc/hosts",
+                       stdin="\n".join(add) + "\n")
+
+    @staticmethod
+    def ip_of(s: Session, hostname: str):
+        """Resolve a hostname from the node (control/net.clj:19-38)."""
+        r = s.exec_result("getent", "hosts", hostname)
+        if r.ok and r.out.strip():
+            return r.out.split()[0]
+        return None
+
+
+debian = Debian
+
+
+class Centos(OS):
+    """RHEL-family prep (os/centos.clj): yum packages."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("yum", "install", "-y",
+               "curl", "wget", "unzip", "iptables", "iproute",
+               "psmisc", "gcc", *self.packages)

@@ -1,0 +1,172 @@
+"""Persistent test store — results on disk, durable in stages.
+
+Parity: jepsen.store (jepsen/src/jepsen/store.clj): every run owns
+``store/<test-name>/<timestamp>/`` with ``latest`` symlinks
+(store.clj:33-66,350), and durability is staged exactly like the reference's
+three-phase save (store.clj:413-457):
+
+  save_0 — the test map, before anything runs;
+  save_1 — the history, immediately after the run (pre-analysis): a crashed
+           analysis can always be re-run from disk;
+  save_2 — the analysis results.
+
+Formats: JSON for the test map and results; the history as JSONL
+(line-per-op — append-friendly and streamable, serving the role of the
+reference's custom append-only block format) plus an optional packed
+struct-of-arrays .npz for zero-parse reload into the device engine.
+Per-run logging mirrors store.clj:462-496 (a jepsen.log per run).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.history import History, Op
+
+BASE = "store"
+
+_NONSERIALIZABLE = {"client", "nemesis", "generator", "checker", "db", "os",
+                    "remote", "sessions", "barrier", "store_dir"}
+# (store.clj:94-100 nonserializable-keys)
+
+
+def test_dir(test: Dict[str, Any], base: Optional[str] = None) -> str:
+    name = test.get("name", "noname")
+    start = test.get("start_time") or time.strftime("%Y%m%dT%H%M%S")
+    return os.path.join(base or test.get("store_base", BASE), name, start)
+
+
+def make_run_dir(test: Dict[str, Any], base: Optional[str] = None) -> str:
+    d = test_dir(test, base)
+    os.makedirs(d, exist_ok=True)
+    _update_symlink(os.path.join(os.path.dirname(d), "latest"), d)
+    _update_symlink(os.path.join(os.path.dirname(os.path.dirname(d)),
+                                 "latest"), d)
+    test["store_dir"] = d
+    return d
+
+
+def _update_symlink(link: str, target: str) -> None:
+    try:
+        if os.path.islink(link):
+            os.unlink(link)
+        os.symlink(os.path.abspath(target), link)
+    except OSError:
+        pass
+
+
+def serializable_test(test: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in test.items():
+        if k in _NONSERIALIZABLE:
+            continue
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out
+
+
+def save_0(test: Dict[str, Any]) -> str:
+    """Phase 0: persist the test map before the run (store.clj:413)."""
+    d = test.get("store_dir") or make_run_dir(test)
+    with open(os.path.join(d, "test.json"), "w") as f:
+        json.dump(serializable_test(test), f, indent=2, default=str)
+    return d
+
+
+def save_1(test: Dict[str, Any], history: History) -> None:
+    """Phase 1: persist the history right after the run (store.clj:422)."""
+    d = test["store_dir"]
+    history.to_jsonl(os.path.join(d, "history.jsonl"))
+    try:
+        import numpy as np
+        cols: Dict[str, Any] = {
+            "index": [o.index for o in history],
+            "type": [o.type for o in history],
+            "process": [str(o.process) for o in history],
+            "f": [str(o.f) for o in history],
+            "time": [o.time or 0 for o in history],
+        }
+        np.savez_compressed(os.path.join(d, "history.npz"),
+                            **{k: np.asarray(v) for k, v in cols.items()})
+    except Exception:  # noqa: BLE001 - the npz is a convenience copy
+        pass
+
+
+def save_2(test: Dict[str, Any], results: Dict[str, Any]) -> None:
+    """Phase 2: persist analysis results (store.clj:439)."""
+    d = test["store_dir"]
+    with open(os.path.join(d, "results.json"), "w") as f:
+        json.dump(results, f, indent=2, default=str)
+
+
+def load_test(path: str) -> Dict[str, Any]:
+    """Reload a run for re-analysis (store.clj:122/265's load/test)."""
+    if os.path.islink(path) or os.path.isdir(path):
+        d = os.path.realpath(path)
+    else:
+        d = path
+    with open(os.path.join(d, "test.json")) as f:
+        test = json.load(f)
+    test["store_dir"] = d
+    return test
+
+
+def load_history(path: str) -> History:
+    d = os.path.realpath(path) if os.path.isdir(path) else os.path.dirname(path)
+    return History.from_jsonl(os.path.join(d, "history.jsonl"))
+
+
+def load_results(path: str) -> Dict[str, Any]:
+    d = os.path.realpath(path)
+    with open(os.path.join(d, "results.json")) as f:
+        return json.load(f)
+
+
+def runs(base: str = BASE) -> List[Dict[str, Any]]:
+    """All stored runs with verdicts, newest first (for CLI/web browsing)."""
+    out = []
+    if not os.path.isdir(base):
+        return out
+    for name in sorted(os.listdir(base)):
+        nd = os.path.join(base, name)
+        if not os.path.isdir(nd) or name == "latest":
+            continue
+        for stamp in sorted(os.listdir(nd), reverse=True):
+            d = os.path.join(nd, stamp)
+            if stamp == "latest" or not os.path.isdir(d):
+                continue
+            entry = {"name": name, "time": stamp, "dir": d, "valid": None}
+            rp = os.path.join(d, "results.json")
+            if os.path.exists(rp):
+                try:
+                    with open(rp) as f:
+                        entry["valid"] = json.load(f).get("valid")
+                except (OSError, json.JSONDecodeError):
+                    pass
+            out.append(entry)
+    return out
+
+
+def start_logging(test: Dict[str, Any]) -> logging.Handler:
+    """Per-run log file (store.clj:474 start-logging!)."""
+    d = test.get("store_dir") or make_run_dir(test)
+    h = logging.FileHandler(os.path.join(d, "jepsen.log"))
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: %(message)s"))
+    root = logging.getLogger()
+    root.addHandler(h)
+    if root.level > logging.INFO:
+        root.setLevel(logging.INFO)
+    return h
+
+
+def stop_logging(handler: logging.Handler) -> None:
+    logging.getLogger().removeHandler(handler)
+    handler.close()

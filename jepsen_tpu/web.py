@@ -1,0 +1,108 @@
+"""Results web browser.
+
+Parity: jepsen.web (jepsen/src/jepsen/web.clj): an HTTP server listing runs
+with validity-colored rows (web.clj:28-36,175), per-run file browsing, and
+zip export of a run directory.  Stdlib http.server — no framework needed.
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import os
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+from jepsen_tpu import store
+
+_COLORS = {True: "#6DB6FE", False: "#FFAA8F", None: "#EEEEEE",
+           "unknown": "#FEB95F"}  # validity color scheme
+
+
+def _index_html(base: str) -> str:
+    rows = []
+    for r in store.runs(base):
+        color = _COLORS.get(r["valid"], _COLORS["unknown"])
+        d = html.escape(f"/files/{r['name']}/{r['time']}/")
+        z = html.escape(f"/zip/{r['name']}/{r['time']}")
+        rows.append(
+            f"<tr style='background:{color}'>"
+            f"<td><a href='{d}'>{html.escape(r['name'])}</a></td>"
+            f"<td>{html.escape(r['time'])}</td>"
+            f"<td>{html.escape(str(r['valid']))}</td>"
+            f"<td><a href='{z}'>zip</a></td></tr>")
+    return ("<html><head><title>jepsen-tpu</title></head><body>"
+            "<h1>jepsen-tpu runs</h1>"
+            "<table border=1 cellpadding=4 style='border-collapse:collapse'>"
+            "<tr><th>test</th><th>time</th><th>valid</th><th>export</th></tr>"
+            + "".join(rows) + "</table></body></html>")
+
+
+def make_handler(base: str):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "text/html; charset=utf-8"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            path = unquote(self.path)
+            if path in ("/", "/index.html"):
+                return self._send(200, _index_html(base).encode())
+            if path.startswith("/files/"):
+                return self._files(path[len("/files/"):])
+            if path.startswith("/zip/"):
+                return self._zip(path[len("/zip/"):])
+            return self._send(404, b"not found")
+
+        def _safe(self, rel: str):
+            p = os.path.realpath(os.path.join(base, rel))
+            if not p.startswith(os.path.realpath(base)):
+                return None
+            return p
+
+        def _files(self, rel: str):
+            p = self._safe(rel)
+            if p is None or not os.path.exists(p):
+                return self._send(404, b"not found")
+            if os.path.isdir(p):
+                entries = sorted(os.listdir(p))
+                items = "".join(
+                    f"<li><a href='{html.escape(name + ('/' if os.path.isdir(os.path.join(p, name)) else ''))}'>"
+                    f"{html.escape(name)}</a></li>" for name in entries)
+                return self._send(200, f"<ul>{items}</ul>".encode())
+            with open(p, "rb") as f:
+                data = f.read()
+            ctype = ("application/json" if p.endswith(".json")
+                     else "text/plain; charset=utf-8")
+            return self._send(200, data, ctype)
+
+        def _zip(self, rel: str):
+            p = self._safe(rel)
+            if p is None or not os.path.isdir(p):
+                return self._send(404, b"not found")
+            buf = io.BytesIO()
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                for root, _, files in os.walk(p):
+                    for fn in files:
+                        full = os.path.join(root, fn)
+                        z.write(full, os.path.relpath(full, p))
+            return self._send(200, buf.getvalue(), "application/zip")
+
+    return Handler
+
+
+def serve(base: str = "store", port: int = 8080, block: bool = True):
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(base))
+    if block:
+        print(f"jepsen-tpu web on http://0.0.0.0:{port}")
+        httpd.serve_forever()
+    return httpd

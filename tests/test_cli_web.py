@@ -1,0 +1,82 @@
+"""CLI runner and web browser (in-process, dummy cluster)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import cli, core, store
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Stats
+from jepsen_tpu.control import DummyRemote
+from tests.test_interpreter import rwc_gen
+
+
+def suite_test_fn(opts):
+    return {**opts,
+            "name": "cli-suite",
+            "remote": DummyRemote(record_only=True),
+            "client": jclient.NoopClient(),
+            "generator": gen.clients(rwc_gen(10)),
+            "checker": Stats()}
+
+
+class TestCli:
+    def test_single_test_cmd(self, tmp_path, capsys):
+        rc = cli.single_test_cmd(
+            suite_test_fn,
+            argv=["test", "--dummy-ssh", "--node", "a", "--node", "b",
+                  "--store", str(tmp_path / "store"),
+                  "--concurrency", "2n"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        rec = json.loads(out[-1])
+        assert rec["valid"] is True
+
+    def test_analyze_cmd(self, tmp_path, capsys):
+        rc = cli.single_test_cmd(
+            suite_test_fn,
+            argv=["test", "--dummy-ssh", "--node", "a",
+                  "--store", str(tmp_path / "store")])
+        assert rc == 0
+        run_dir = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])["dir"]
+        rc = cli.single_test_cmd(suite_test_fn, argv=["analyze", run_dir])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["valid"] is True
+
+    def test_concurrency_parse(self, tmp_path):
+        t = {"nodes": ["a", "b"], "concurrency": "3n"}
+        core.prepare_test(t)
+        assert t["concurrency"] == 6
+
+
+class TestWeb:
+    def test_index_and_files(self, tmp_path):
+        base = str(tmp_path / "store")
+        t = suite_test_fn({"nodes": [], "store_base": base,
+                           "concurrency": 2})
+        core.run(t)
+        from jepsen_tpu.web import serve
+        httpd = serve(base=base, port=0, block=False)
+        port = httpd.server_address[1]
+        import threading
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        try:
+            idx = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/").read().decode()
+            assert "cli-suite" in idx and "True" in idx
+            runs = store.runs(base)
+            files = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/cli-suite/"
+                f"{runs[0]['time']}/").read().decode()
+            assert "history.jsonl" in files
+            zipdata = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/zip/cli-suite/"
+                f"{runs[0]['time']}").read()
+            assert zipdata[:2] == b"PK"
+        finally:
+            httpd.shutdown()

@@ -1,0 +1,115 @@
+"""Full core.run pipeline on a dummy cluster + store durability phases
+(the reference's core_test.clj pattern: whole framework, no real nodes)."""
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import core, db as jdb, store
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import Stats, compose, linearizable
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CASRegister
+from tests.test_interpreter import MockRegisterClient, rwc_gen
+
+
+def base_test(tmp_path, **kw):
+    t = {"name": "noop-test",
+         "nodes": ["n1", "n2", "n3"],
+         "remote": DummyRemote(record_only=True),
+         "concurrency": 3,
+         "store_base": str(tmp_path / "store"),
+         "client": jclient.NoopClient(),
+         "generator": gen.clients(rwc_gen(20))}
+    t.update(kw)
+    return t
+
+
+class TestRun:
+    def test_noop_run_completes(self, tmp_path):
+        t = core.run(base_test(tmp_path))
+        assert len(t["history"]) == 40
+        assert t["results"]["valid"] is True
+
+    def test_store_phases(self, tmp_path):
+        t = core.run(base_test(tmp_path, checker=Stats()))
+        d = t["store_dir"]
+        assert os.path.exists(os.path.join(d, "test.json"))
+        assert os.path.exists(os.path.join(d, "history.jsonl"))
+        assert os.path.exists(os.path.join(d, "results.json"))
+        assert os.path.exists(os.path.join(d, "jepsen.log"))
+        # latest symlink points at the run
+        latest = os.path.join(os.path.dirname(d), "latest")
+        assert os.path.realpath(latest) == os.path.realpath(d)
+
+    def test_reload_and_recheck(self, tmp_path):
+        """Crashed-analysis recovery: re-run checking from the stored
+        history (store.clj:122/265 pattern)."""
+        t = core.run(base_test(
+            tmp_path,
+            client=MockRegisterClient(),
+            generator=gen.clients(rwc_gen(60)),
+            checker=linearizable(CASRegister(), algorithm="cpu")))
+        d = t["store_dir"]
+        test2 = store.load_test(d)
+        h2 = store.load_history(d)
+        assert len(h2) == len(t["history"])
+        r2 = core.analyze({**test2,
+                           "checker": linearizable(CASRegister(),
+                                                   algorithm="cpu")}, h2)
+        assert r2["valid"] == t["results"]["valid"] is True
+
+    def test_end_to_end_detects_bug(self, tmp_path):
+        t = core.run(base_test(
+            tmp_path,
+            client=MockRegisterClient(stale=True),
+            generator=gen.clients(rwc_gen(100)),
+            checker=compose({
+                "stats": Stats(),
+                "linear": linearizable(CASRegister(), algorithm="cpu")})))
+        assert t["results"]["valid"] is False
+        assert t["results"]["linear"]["valid"] is False
+        assert t["results"]["stats"]["valid"] is True
+
+    def test_concurrency_n_syntax(self, tmp_path):
+        t = base_test(tmp_path, concurrency="2n")
+        core.prepare_test(t)
+        assert t["concurrency"] == 6
+
+    def test_run_tests_summary(self, tmp_path):
+        ts = [base_test(tmp_path, name="a"),
+              base_test(tmp_path, name="b",
+                        client=MockRegisterClient(stale=True),
+                        generator=gen.clients(rwc_gen(80)),
+                        checker=linearizable(CASRegister(), algorithm="cpu"))]
+        summary = core.run_tests(ts)
+        assert summary["failures"] == 1
+        assert summary["exit"] == 1
+
+    def test_runs_listing(self, tmp_path):
+        core.run(base_test(tmp_path, checker=Stats()))
+        rs = store.runs(str(tmp_path / "store"))
+        assert len(rs) == 1
+        assert rs[0]["valid"] is True
+
+
+class TestDbLifecycle:
+    def test_db_setup_teardown_called(self, tmp_path):
+        calls = []
+
+        class TrackingDB(jdb.DB):
+            def setup(self, test, node):
+                calls.append(("setup", node))
+
+            def teardown(self, test, node):
+                calls.append(("teardown", node))
+
+        core.run(base_test(tmp_path, db=TrackingDB()))
+        setups = [n for op, n in calls if op == "setup"]
+        teardowns = [n for op, n in calls if op == "teardown"]
+        assert sorted(setups) == ["n1", "n2", "n3"]
+        # teardown in cycle_ + final teardown
+        assert len(teardowns) >= 6
