@@ -1,0 +1,69 @@
+// Flip random bits in a file, corrupting it in place.
+//
+// Usage: bitflip <path> <probability>
+//
+// Each byte of the file independently has its lowest-entropy corruption:
+// with probability p, one random bit of that byte is flipped.  Node-side
+// helper for the bit-rot nemesis (jepsen_tpu.nemesis.faults.Bitflip);
+// compiled on the target node with g++.  Plays the role the reference
+// fills by downloading a prebuilt Go binary (independent implementation).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <vector>
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <path> <probability>\n", argv[0]);
+    return 2;
+  }
+  const char *path = argv[1];
+  double p = std::atof(argv[2]);
+  if (p <= 0 || p > 1) {
+    std::fprintf(stderr, "probability must be in (0, 1]\n");
+    return 2;
+  }
+
+  std::FILE *f = std::fopen(path, "r+b");
+  if (!f) {
+    std::perror("fopen");
+    return 1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  if (size <= 0) {
+    std::fclose(f);
+    return 0;
+  }
+
+  std::random_device rd;
+  std::mt19937_64 rng(rd());
+  std::uniform_real_distribution<double> coin(0.0, 1.0);
+  std::uniform_int_distribution<int> bit(0, 7);
+
+  const long CHUNK = 1 << 20;
+  std::vector<unsigned char> buf(CHUNK);
+  long flipped = 0;
+  for (long off = 0; off < size; off += CHUNK) {
+    long n = std::min(CHUNK, size - off);
+    std::fseek(f, off, SEEK_SET);
+    if (std::fread(buf.data(), 1, n, f) != (size_t)n) break;
+    bool dirty = false;
+    for (long i = 0; i < n; i++) {
+      if (coin(rng) < p) {
+        buf[i] ^= (1u << bit(rng));
+        dirty = true;
+        flipped++;
+      }
+    }
+    if (dirty) {
+      std::fseek(f, off, SEEK_SET);
+      std::fwrite(buf.data(), 1, n, f);
+    }
+  }
+  std::fclose(f);
+  std::printf("%ld bits flipped\n", flipped);
+  return 0;
+}

@@ -1,0 +1,40 @@
+/* Jump the system realtime clock by a signed number of milliseconds.
+ *
+ * Usage: bump-time <delta-ms>
+ *
+ * Node-side helper for the clock-skew nemesis (jepsen_tpu.nemesis.time);
+ * compiled on the target node with `gcc -O2 -o bump-time bump-time.c`.
+ * Serves the role of the reference's resources/bump-time.c (independent
+ * implementation).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+    return 2;
+  }
+  long long delta_ms = atoll(argv[1]);
+
+  struct timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+
+  long long ns = ts.tv_nsec + (delta_ms % 1000) * 1000000LL;
+  ts.tv_sec += delta_ms / 1000 + ns / 1000000000LL;
+  ts.tv_nsec = ns % 1000000000LL;
+  if (ts.tv_nsec < 0) {
+    ts.tv_nsec += 1000000000LL;
+    ts.tv_sec -= 1;
+  }
+
+  if (clock_settime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,58 @@
+/* Oscillate the system realtime clock between now and now+delta.
+ *
+ * Usage: strobe-time <delta-ms> <period-ms> <duration-ms>
+ *
+ * Every <period-ms> the clock flips between the true timeline and a
+ * timeline offset by <delta-ms>, for <duration-ms> total.  Node-side helper
+ * for the clock-skew nemesis; compiled on the target node.  Serves the role
+ * of the reference's resources/strobe-time.c (independent implementation).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include <unistd.h>
+
+static void shift_clock(long long delta_ms) {
+  struct timespec ts;
+  if (clock_gettime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_gettime");
+    exit(1);
+  }
+  long long ns = ts.tv_nsec + (delta_ms % 1000) * 1000000LL;
+  ts.tv_sec += delta_ms / 1000 + ns / 1000000000LL;
+  ts.tv_nsec = ns % 1000000000LL;
+  if (ts.tv_nsec < 0) {
+    ts.tv_nsec += 1000000000LL;
+    ts.tv_sec -= 1;
+  }
+  if (clock_settime(CLOCK_REALTIME, &ts) != 0) {
+    perror("clock_settime");
+    exit(1);
+  }
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-ms>\n",
+            argv[0]);
+    return 2;
+  }
+  long long delta_ms = atoll(argv[1]);
+  long long period_ms = atoll(argv[2]);
+  long long duration_ms = atoll(argv[3]);
+  if (period_ms <= 0) {
+    fprintf(stderr, "period must be positive\n");
+    return 2;
+  }
+
+  long long elapsed = 0;
+  int shifted = 0;
+  while (elapsed < duration_ms) {
+    shift_clock(shifted ? -delta_ms : delta_ms);
+    shifted = !shifted;
+    usleep((useconds_t)(period_ms * 1000));
+    elapsed += period_ms;
+  }
+  if (shifted) shift_clock(-delta_ms); /* leave the clock where we found it */
+  return 0;
+}

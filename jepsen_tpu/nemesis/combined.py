@@ -1,0 +1,157 @@
+"""Combined nemesis packages — nemesis + generator pairs that compose.
+
+Parity: jepsen.nemesis.combined (jepsen/src/jepsen/nemesis/combined.clj):
+a *package* bundles a nemesis, the generator that drives it, a final
+(healing) generator, and perf-plot metadata; packages compose into one
+nemesis + one interleaved fault schedule (compose-packages at
+combined.clj:383, nemesis-package one-stop at 407).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnemesis
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.faults import KillNemesis, PauseNemesis
+from jepsen_tpu.nemesis.partition import PacketNemesis, Partitioner
+from jepsen_tpu.nemesis.time import ClockNemesis, clock_gen
+from jepsen_tpu import net as jnet
+
+DEFAULT_INTERVAL = 10.0  # seconds between fault transitions
+                          # (combined.clj default-interval)
+
+
+@dataclass
+class Package:
+    nemesis: Optional[Nemesis] = None
+    generator: Any = None
+    final_generator: Any = None
+    perf: List[Dict[str, Any]] = field(default_factory=list)
+
+
+def _cycle_ops(interval, *ops):
+    """start/stop loop with the package interval."""
+    return gen.stagger(interval, gen.cycle(gen.lift(list(ops))))
+
+
+def db_package(opts: Optional[Dict] = None) -> Package:
+    """Kill/pause faults via DB capabilities (combined.clj:142)."""
+    opts = opts or {}
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    faults = set(opts.get("faults", ["kill", "pause"]))
+    members, gens, finals, perf = [], [], [], []
+    if "kill" in faults:
+        members.append(KillNemesis())
+        gens.append(_cycle_ops(
+            interval,
+            {"f": "kill", "type": "info",
+             "value": opts.get("targets", "one")},
+            {"f": "start", "type": "info"}))
+        finals.append({"f": "start", "type": "info"})
+        perf.append({"name": "kill", "start": ["kill"], "stop": ["start"],
+                     "color": "#E9A4A0"})
+    if "pause" in faults:
+        members.append(PauseNemesis())
+        gens.append(_cycle_ops(
+            interval,
+            {"f": "pause", "type": "info",
+             "value": opts.get("targets", "one")},
+            {"f": "resume", "type": "info"}))
+        finals.append({"f": "resume", "type": "info"})
+        perf.append({"name": "pause", "start": ["pause"], "stop": ["resume"],
+                     "color": "#C5A0E9"})
+    return Package(nemesis=jnemesis.compose(members) if members else None,
+                   generator=gen.mix(gens) if gens else None,
+                   final_generator=finals or None,
+                   perf=perf)
+
+
+def partition_package(opts: Optional[Dict] = None) -> Package:
+    """Network partition faults (combined.clj:227)."""
+    opts = opts or {}
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+
+    def random_grudge(nodes):
+        kind = random.choice(["halves", "one", "majorities-ring"])
+        if kind == "halves":
+            ns = list(nodes)
+            random.shuffle(ns)
+            return jnet.complete_grudge(jnet.bisect(ns))
+        if kind == "one":
+            return jnet.complete_grudge(
+                jnet.split_one(random.choice(list(nodes)), nodes))
+        return jnet.majorities_ring(nodes)
+
+    nem = Partitioner(opts.get("grudge_fn", random_grudge))
+    g = _cycle_ops(interval,
+                   {"f": "start-partition", "type": "info"},
+                   {"f": "stop-partition", "type": "info"})
+    return Package(nemesis=nem, generator=g,
+                   final_generator=[{"f": "stop-partition", "type": "info"}],
+                   perf=[{"name": "partition", "start": ["start-partition"],
+                          "stop": ["stop-partition"], "color": "#E9DCA0"}])
+
+
+def packet_package(opts: Optional[Dict] = None) -> Package:
+    """tc-netem packet faults (combined.clj:285)."""
+    opts = opts or {}
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    behaviors = opts.get("behaviors", ["slow", "flaky"])
+    nem = PacketNemesis()
+    g = _cycle_ops(interval,
+                   gen.FnGen(lambda: {"f": "start-packet", "type": "info",
+                                      "value": random.choice(behaviors)}),
+                   {"f": "stop-packet", "type": "info"})
+    return Package(nemesis=nem, generator=g,
+                   final_generator=[{"f": "stop-packet", "type": "info"}],
+                   perf=[{"name": "packet", "start": ["start-packet"],
+                          "stop": ["stop-packet"], "color": "#A0E9DB"}])
+
+
+def clock_package(opts: Optional[Dict] = None) -> Package:
+    """Clock skew faults (combined.clj:326)."""
+    opts = opts or {}
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    nem = ClockNemesis()
+    g = gen.stagger(interval, clock_gen())
+    return Package(nemesis=nem, generator=g,
+                   final_generator=[{"f": "reset-clock", "type": "info",
+                                     "value": {"targets": "all"}}],
+                   perf=[{"name": "clock", "start": ["bump-clock",
+                                                     "strobe-clock"],
+                          "stop": ["reset-clock"], "color": "#A0B2E9"}])
+
+
+def compose_packages(packages: Sequence[Package]) -> Package:
+    """Merge packages: composed nemesis, mixed generators, sequential finals
+    (combined.clj:383)."""
+    ps = [p for p in packages if p.nemesis is not None]
+    return Package(
+        nemesis=jnemesis.compose([p.nemesis for p in ps]),
+        generator=gen.mix([p.generator for p in ps
+                           if p.generator is not None]),
+        final_generator=[p.final_generator for p in ps
+                         if p.final_generator is not None],
+        perf=[x for p in ps for x in p.perf])
+
+
+def nemesis_package(opts: Optional[Dict] = None) -> Package:
+    """One-stop construction from a fault list (combined.clj:407):
+    faults ⊆ {partition, kill, pause, packet, clock}."""
+    opts = opts or {}
+    faults = set(opts.get("faults", ["partition"]))
+    packages = []
+    if faults & {"kill", "pause"}:
+        packages.append(db_package({**opts,
+                                    "faults": faults & {"kill", "pause"}}))
+    if "partition" in faults:
+        packages.append(partition_package(opts))
+    if "packet" in faults:
+        packages.append(packet_package(opts))
+    if "clock" in faults:
+        packages.append(clock_package(opts))
+    return compose_packages(packages)

@@ -1,0 +1,175 @@
+"""Process and file fault nemeses: kill, pause, truncate, bitflip.
+
+Parity: jepsen.nemesis's node-start-stopper/hammer-time (nemesis.clj:453-512)
+and file corruption (truncate-file nemesis.clj:514, bitflip 550-580 — the
+reference downloads a Go binary; ours ships a C++ tool, native/bitflip.cpp,
+compiled on the node like the reference compiles its clock helpers).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+
+
+def pick_nodes(test, spec) -> List[str]:
+    """Node-spec language of nemesis/combined.clj:39-62:
+    :one / :minority / :majority / :primaries / :all / explicit list."""
+    nodes = list(test["nodes"])
+    if spec in (None, "one"):
+        return [random.choice(nodes)]
+    if spec == "minority":
+        k = max(1, (len(nodes) - 1) // 2)
+        return random.sample(nodes, k)
+    if spec == "majority":
+        k = len(nodes) // 2 + 1
+        return random.sample(nodes, k)
+    if spec == "all":
+        return nodes
+    if spec == "primaries":
+        database = test.get("db")
+        if isinstance(database, jdb.Primary):
+            return list(database.primaries(test)) or [nodes[0]]
+        return [nodes[0]]
+    if isinstance(spec, (list, tuple)):
+        return list(spec)
+    return [spec]
+
+
+class KillNemesis(Nemesis):
+    """Kill/restart database processes via the DB's Kill capability
+    (nemesis/combined.clj:71-99's db-nemesis)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        database = test.get("db")
+        if not isinstance(database, jdb.Kill):
+            raise RuntimeError("db does not support Kill")
+        if op.f == "kill":
+            targets = pick_nodes(test, op.value)
+            for n in targets:
+                database.kill(test, n)
+            return op.with_(type="info", value=sorted(targets))
+        if op.f == "start":
+            for n in test["nodes"]:
+                database.start(test, n)
+            return op.with_(type="info", value="started")
+        raise ValueError(f"kill nemesis doesn't handle f={op.f!r}")
+
+    def fs(self):
+        return ["kill", "start"]
+
+
+class PauseNemesis(Nemesis):
+    """SIGSTOP/SIGCONT via the DB's Pause capability (hammer-time,
+    nemesis.clj:498)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        database = test.get("db")
+        if not isinstance(database, jdb.Pause):
+            raise RuntimeError("db does not support Pause")
+        if op.f == "pause":
+            targets = pick_nodes(test, op.value)
+            for n in targets:
+                database.pause(test, n)
+            return op.with_(type="info", value=sorted(targets))
+        if op.f == "resume":
+            for n in test["nodes"]:
+                database.resume(test, n)
+            return op.with_(type="info", value="resumed")
+        raise ValueError(f"pause nemesis doesn't handle f={op.f!r}")
+
+    def fs(self):
+        return ["pause", "resume"]
+
+
+class TruncateFile(Nemesis):
+    """Chop bytes off the end of a file on some nodes (nemesis.clj:514)."""
+
+    def __init__(self, path: str, bytes_: int = 64):
+        self.path = path
+        self.bytes_ = bytes_
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "truncate":
+            raise ValueError(f"truncate nemesis doesn't handle f={op.f!r}")
+        targets = pick_nodes(test, op.value)
+        for n in targets:
+            s = session(test, n).sudo()
+            s.exec("truncate", "-s", f"-{self.bytes_}", self.path)
+        return op.with_(type="info", value=sorted(targets))
+
+    def fs(self):
+        return ["truncate"]
+
+
+class Bitflip(Nemesis):
+    """Flip random bits in a file — ships and compiles native/bitflip.cpp on
+    the node (build-on-node, like the reference's clock helpers)."""
+
+    def __init__(self, path: str, probability: float = 1e-3):
+        self.path = path
+        self.probability = probability
+        self._bin: Dict[str, str] = {}
+
+    def _ensure_tool(self, test, node) -> str:
+        if node in self._bin:
+            return self._bin[node]
+        s = session(test, node)
+        src = os.path.join(NATIVE_DIR, "bitflip.cpp")
+        remote_src = "/tmp/jepsen-bitflip.cpp"
+        remote_bin = "/tmp/jepsen-bitflip"
+        s.upload(src, remote_src)
+        s.exec("g++", "-O2", "-o", remote_bin, remote_src)
+        self._bin[node] = remote_bin
+        return remote_bin
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f != "bitflip":
+            raise ValueError(f"bitflip nemesis doesn't handle f={op.f!r}")
+        targets = pick_nodes(test, op.value)
+        for n in targets:
+            tool = self._ensure_tool(test, n)
+            s = session(test, n).sudo()
+            s.exec(tool, self.path, str(self.probability))
+        return op.with_(type="info", value=sorted(targets))
+
+    def fs(self):
+        return ["bitflip"]
+
+
+class NodeStartStopper(Nemesis):
+    """Generic start/stop with user commands (nemesis.clj:453):
+    on :start run stop_cmd on targets, on :stop run start_cmd everywhere."""
+
+    def __init__(self, targeter: Callable = None,
+                 stop_fn: Callable = None, start_fn: Callable = None):
+        self.targeter = targeter or (lambda test, nodes: [random.choice(nodes)])
+        self.stop_fn = stop_fn
+        self.start_fn = start_fn
+        self.affected: List[str] = []
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == "start":
+            targets = self.targeter(test, list(test["nodes"]))
+            for n in targets:
+                self.stop_fn(test, n)
+            self.affected = targets
+            return op.with_(type="info", value=sorted(targets))
+        if op.f == "stop":
+            for n in (self.affected or test["nodes"]):
+                self.start_fn(test, n)
+            healed, self.affected = self.affected, []
+            return op.with_(type="info", value=sorted(healed))
+        raise ValueError(f"start-stopper doesn't handle f={op.f!r}")
+
+    def fs(self):
+        return ["start", "stop"]

@@ -1,0 +1,123 @@
+"""Partition nemeses — network splits driven by grudge functions.
+
+Parity: the partitioner family in jepsen.nemesis (nemesis.clj:109-285):
+a partitioner nemesis takes a grudge function (nodes -> grudge map), starts
+a partition on :start-partition, heals on :stop-partition.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import net as jnet
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+
+
+def _net_of(test) -> jnet.Net:
+    return test.get("net") or jnet.IptablesNet()
+
+
+class Partitioner(Nemesis):
+    """Generic partitioner (nemesis.clj:158-185).  ``grudge_fn(nodes)``
+    returns {node: [nodes-to-ignore]}; op values may carry an explicit
+    grudge."""
+
+    def __init__(self, grudge_fn: Optional[Callable] = None,
+                 start_f="start-partition", stop_f="stop-partition"):
+        self.grudge_fn = grudge_fn
+        self.start_f = start_f
+        self.stop_f = stop_f
+
+    def setup(self, test):
+        _net_of(test).heal(test)
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        if op.f == self.start_f:
+            grudge = op.value if isinstance(op.value, dict) else \
+                (self.grudge_fn(list(test["nodes"])) if self.grudge_fn
+                 else None)
+            if grudge is None:
+                raise ValueError("no grudge to apply")
+            _net_of(test).drop_all(test, grudge)
+            return op.with_(type="info",
+                            value={n: sorted(v) for n, v in grudge.items()})
+        if op.f == self.stop_f:
+            _net_of(test).heal(test)
+            return op.with_(type="info", value="network healed")
+        raise ValueError(f"partitioner doesn't handle f={op.f!r}")
+
+    def teardown(self, test):
+        try:
+            _net_of(test).heal(test)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def fs(self):
+        return [self.start_f, self.stop_f]
+
+
+def partition_halves() -> Nemesis:
+    """Cut the cluster in half (nemesis.clj:186)."""
+    return Partitioner(lambda nodes: jnet.complete_grudge(
+        jnet.bisect(nodes)))
+
+
+def partition_random_halves() -> Nemesis:
+    def grudge(nodes):
+        ns = list(nodes)
+        random.shuffle(ns)
+        return jnet.complete_grudge(jnet.bisect(ns))
+    return Partitioner(grudge)
+
+
+def partition_random_node() -> Nemesis:
+    """Isolate a random node (nemesis.clj:198)."""
+    return Partitioner(lambda nodes: jnet.complete_grudge(
+        jnet.split_one(random.choice(list(nodes)), nodes)))
+
+
+def partition_majorities_ring() -> Nemesis:
+    """Intersecting-majorities ring (nemesis.clj:261)."""
+    return Partitioner(jnet.majorities_ring)
+
+
+def bridge_partition() -> Nemesis:
+    """Halves connected only via a bridge node (nemesis.clj:145)."""
+    return Partitioner(jnet.bridge)
+
+
+class PacketNemesis(Nemesis):
+    """tc-netem packet shaping (the packet-package of
+    nemesis/combined.clj:285): :start-packet applies a behavior to target
+    nodes, :stop-packet restores."""
+
+    def __init__(self, behaviors: Optional[Dict[str, Dict]] = None):
+        self.behaviors = behaviors or {
+            "slow": jnet.DEFAULT_SLOW, "flaky": jnet.DEFAULT_FLAKY}
+
+    def invoke(self, test, op: Op) -> Op:
+        n = _net_of(test)
+        if op.f == "start-packet":
+            spec = op.value or {}
+            name = spec.get("behavior", "slow") if isinstance(spec, dict) \
+                else spec
+            nodes = spec.get("targets") if isinstance(spec, dict) else None
+            n.shape(test, nodes=nodes,
+                    behavior=self.behaviors.get(name, jnet.DEFAULT_SLOW))
+            return op.with_(type="info")
+        if op.f == "stop-packet":
+            n.fast(test)
+            return op.with_(type="info")
+        raise ValueError(f"packet nemesis doesn't handle f={op.f!r}")
+
+    def teardown(self, test):
+        try:
+            _net_of(test).fast(test)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def fs(self):
+        return ["start-packet", "stop-packet"]

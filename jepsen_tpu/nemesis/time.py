@@ -1,0 +1,111 @@
+"""Clock-skew nemesis — native helpers compiled on the nodes.
+
+Parity: jepsen.nemesis.time (jepsen/src/jepsen/nemesis/time.clj): uploads C
+sources (ours: jepsen_tpu/native/bump-time.c, strobe-time.c — independent
+implementations) and gcc-compiles them on each node (time.clj:21-51), then
+drives clock faults: reset (time.clj:86), bump (92), strobe (98); the
+clock nemesis (104) and its generator (204).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.control import on_nodes, session
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.faults import NATIVE_DIR, pick_nodes
+
+REMOTE_DIR = "/opt/jepsen-tpu"
+
+
+def install_tools(test) -> None:
+    """Upload + compile the clock helpers on every node (time.clj:21-51)."""
+
+    def inst(t, node):
+        s = session(t, node).sudo()
+        s.exec("mkdir", "-p", REMOTE_DIR)
+        for name in ("bump-time", "strobe-time"):
+            src = os.path.join(NATIVE_DIR, f"{name}.c")
+            session(t, node).upload(src, f"/tmp/{name}.c")
+            s.exec("gcc", "-O2", "-o", f"{REMOTE_DIR}/{name}",
+                   f"/tmp/{name}.c")
+
+    on_nodes(test, inst)
+
+
+def reset_time(test, nodes=None) -> None:
+    """Resync with NTP or force a sane clock (time.clj:86)."""
+
+    def rt(t, node):
+        s = session(t, node).sudo()
+        if not s.exec_result("ntpdate", "-p", "1", "-b",
+                             "pool.ntp.org").ok:
+            s.exec_result("chronyc", "makestep")
+
+    on_nodes(test, rt, nodes)
+
+
+def bump_time(test, node: str, delta_ms: int) -> None:
+    session(test, node).sudo().exec(f"{REMOTE_DIR}/bump-time", str(delta_ms))
+
+
+def strobe_time(test, node: str, delta_ms: int, period_ms: int,
+                duration_ms: int) -> None:
+    session(test, node).sudo().exec(
+        f"{REMOTE_DIR}/strobe-time", str(delta_ms), str(period_ms),
+        str(duration_ms))
+
+
+class ClockNemesis(Nemesis):
+    """Drives :reset / :bump / :strobe clock ops (time.clj:104)."""
+
+    def setup(self, test):
+        install_tools(test)
+        reset_time(test)
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        v = op.value if isinstance(op.value, dict) else {}
+        targets = pick_nodes(test, v.get("targets", "all"))
+        if op.f == "reset-clock":
+            reset_time(test, targets)
+        elif op.f == "bump-clock":
+            delta = v.get("delta_ms", random.choice(
+                [-60_000, -1_000, -250, 250, 1_000, 60_000]))
+            for n in targets:
+                bump_time(test, n, delta)
+        elif op.f == "strobe-clock":
+            for n in targets:
+                strobe_time(test, n,
+                            v.get("delta_ms", 200),
+                            v.get("period_ms", 10),
+                            v.get("duration_ms", 1_000))
+        else:
+            raise ValueError(f"clock nemesis doesn't handle f={op.f!r}")
+        return op.with_(type="info", value={"targets": sorted(targets),
+                                            **v})
+
+    def teardown(self, test):
+        try:
+            reset_time(test)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def fs(self):
+        return ["reset-clock", "bump-clock", "strobe-clock"]
+
+
+def clock_gen():
+    """Mixed clock-fault generator (time.clj:204 clock-gen)."""
+    from jepsen_tpu import generator as gen
+
+    def one():
+        f = random.choice(["bump-clock", "strobe-clock", "reset-clock"])
+        return {"f": f, "type": "info",
+                "value": {"targets": random.choice(
+                    ["one", "minority", "majority", "all"])}}
+
+    return gen.FnGen(one)

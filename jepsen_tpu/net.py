@@ -1,0 +1,223 @@
+"""Network manipulation — partitions and packet shaping.
+
+Parity: jepsen.net (jepsen/src/jepsen/net.clj, net/proto.clj:5-12): a Net
+implementation can sever links (drop), heal everything, and shape traffic
+(slow/flaky/fast/shape) between nodes.  The iptables implementation includes
+the batched all-grudges fast path (net.clj:176-186); tc-netem behaviors
+mirror net.clj:49-71's defaults.
+
+A *grudge* maps each node to the collection of nodes it refuses to hear
+from (nemesis.clj's partition language).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from jepsen_tpu.control import Lit, session
+
+# tc-netem behavior defaults (net.clj:49-71)
+DEFAULT_SLOW = {"delay": "50ms", "jitter": "10ms", "correlation": "25%"}
+DEFAULT_FLAKY = {"loss": "20%", "correlation": "75%"}
+
+
+class Net:
+    def drop(self, test, src: str, dst: str) -> None:
+        """dst stops accepting traffic from src."""
+        raise NotImplementedError
+
+    def drop_all(self, test, grudge: Dict[str, Iterable[str]]) -> None:
+        """Apply a whole grudge: node -> senders to ignore."""
+        for dst, srcs in grudge.items():
+            for src in srcs:
+                self.drop(test, src, dst)
+
+    def heal(self, test) -> None:
+        raise NotImplementedError
+
+    def slow(self, test, opts: Optional[Dict] = None) -> None:
+        raise NotImplementedError
+
+    def flaky(self, test) -> None:
+        raise NotImplementedError
+
+    def fast(self, test) -> None:
+        raise NotImplementedError
+
+    def shape(self, test, nodes: Optional[Sequence[str]] = None,
+              behavior: Optional[Dict] = None) -> None:
+        raise NotImplementedError
+
+
+class NoopNet(Net):
+    def drop(self, test, src, dst):
+        pass
+
+    def heal(self, test):
+        pass
+
+    def slow(self, test, opts=None):
+        pass
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+    def shape(self, test, nodes=None, behavior=None):
+        pass
+
+
+noop = NoopNet
+
+
+class IptablesNet(Net):
+    """INPUT-chain DROP rules (net.clj:130-186)."""
+
+    def drop(self, test, src, dst):
+        s = session(test, dst).sudo()
+        s.exec("iptables", "-A", "INPUT", "-s", src, "-j", "DROP",
+               "-w")
+
+    def drop_all(self, test, grudge):
+        # Batched fast path: one shell invocation per node
+        # (net.clj:176-186 PartitionAll).
+        from jepsen_tpu.control import on_nodes
+
+        def apply_(t, node):
+            srcs = list(grudge.get(node) or [])
+            if not srcs:
+                return
+            s = session(t, node).sudo()
+            cmds = " && ".join(
+                f"iptables -A INPUT -s {src} -j DROP -w" for src in srcs)
+            s.exec("bash", "-c", cmds)
+
+        on_nodes(test, apply_, list(grudge.keys()))
+
+    def heal(self, test):
+        from jepsen_tpu.control import on_nodes
+
+        def heal_(t, node):
+            s = session(t, node).sudo()
+            s.exec("iptables", "-F", "-w")
+            s.exec("iptables", "-X", "-w")
+
+        on_nodes(test, heal_)
+
+    # -- tc packet shaping -------------------------------------------------
+    def _netem_args(self, behavior: Dict) -> List[str]:
+        out = []
+        if "delay" in behavior:
+            out += ["delay", behavior["delay"]]
+            if "jitter" in behavior:
+                out.append(behavior["jitter"])
+            if "correlation" in behavior:
+                out.append(behavior["correlation"])
+        if "loss" in behavior:
+            out += ["loss", behavior["loss"]]
+            if "correlation" in behavior and "delay" not in behavior:
+                out.append(behavior["correlation"])
+        if "corrupt" in behavior:
+            out += ["corrupt", behavior["corrupt"]]
+        if "duplicate" in behavior:
+            out += ["duplicate", behavior["duplicate"]]
+        if "reorder" in behavior:
+            out += ["reorder", behavior["reorder"]]
+        if "rate" in behavior:
+            out += ["rate", behavior["rate"]]
+        return out
+
+    def shape(self, test, nodes=None, behavior=None):
+        from jepsen_tpu.control import on_nodes
+        behavior = behavior or DEFAULT_SLOW
+
+        def shape_(t, node):
+            s = session(t, node).sudo()
+            dev = _default_dev(s)
+            s.exec_result("tc", "qdisc", "del", "dev", dev, "root")
+            s.exec("tc", "qdisc", "add", "dev", dev, "root", "netem",
+                   *self._netem_args(behavior))
+
+        on_nodes(test, shape_, nodes)
+
+    def slow(self, test, opts=None):
+        self.shape(test, behavior={**DEFAULT_SLOW, **(opts or {})})
+
+    def flaky(self, test):
+        self.shape(test, behavior=DEFAULT_FLAKY)
+
+    def fast(self, test):
+        from jepsen_tpu.control import on_nodes
+
+        def fast_(t, node):
+            s = session(t, node).sudo()
+            dev = _default_dev(s)
+            s.exec_result("tc", "qdisc", "del", "dev", dev, "root")
+
+        on_nodes(test, fast_)
+
+
+iptables = IptablesNet
+
+
+def _default_dev(s) -> str:
+    out = s.exec("bash", "-c",
+                 "ip route show default | head -1 | grep -o 'dev [^ ]*' "
+                 "| cut -d' ' -f2 || echo eth0")
+    return out.strip() or "eth0"
+
+
+# ---------------------------------------------------------------------------
+# Grudge constructors (jepsen.nemesis partition language, nemesis.clj:109-285)
+# ---------------------------------------------------------------------------
+
+
+def complete_grudge(components: Sequence[Sequence[str]]) -> Dict[str, List[str]]:
+    """Nodes in different components can't talk (nemesis.clj:121)."""
+    grudge: Dict[str, List[str]] = {}
+    for comp in components:
+        others = [n for c in components if c is not comp for n in c]
+        for n in comp:
+            grudge[n] = list(others)
+    return grudge
+
+
+def bisect(nodes: Sequence[str]) -> List[List[str]]:
+    """Split nodes into two halves (nemesis.clj:109)."""
+    mid = len(nodes) // 2
+    return [list(nodes[:mid]), list(nodes[mid:])]
+
+
+def split_one(node: str, nodes: Sequence[str]) -> List[List[str]]:
+    """Isolate one node (nemesis.clj:114)."""
+    return [[node], [n for n in nodes if n != node]]
+
+
+def bridge(nodes: Sequence[str]) -> Dict[str, List[str]]:
+    """Two halves joined only through one bridge node (nemesis.clj:145)."""
+    n = len(nodes)
+    mid = n // 2
+    bridge_node = nodes[mid]
+    a = list(nodes[:mid])
+    b = list(nodes[mid + 1:])
+    grudge = {}
+    for x in a:
+        grudge[x] = list(b)
+    for x in b:
+        grudge[x] = list(a)
+    grudge[bridge_node] = []
+    return grudge
+
+
+def majorities_ring(nodes: Sequence[str]) -> Dict[str, List[str]]:
+    """Every node sees a majority, but no two nodes see the same majority
+    (nemesis.clj:261): node i hears from the floor(n/2) nodes around it."""
+    n = len(nodes)
+    k = n // 2
+    grudge = {}
+    for i, node in enumerate(nodes):
+        visible = {nodes[(i + d) % n] for d in range(-(k // 2), k - k // 2 + 1)}
+        grudge[node] = [m for m in nodes if m not in visible]
+    return grudge

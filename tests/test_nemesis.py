@@ -1,0 +1,140 @@
+"""Fault layer: grudges, partitioners, packages — verified against the
+record-only dummy remote (commands journaled, not run)."""
+
+import pytest
+
+from jepsen_tpu import control, net as jnet
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnemesis
+from jepsen_tpu.history import INFO, Op
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.nemesis.partition import Partitioner, partition_halves
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+class TestGrudges:
+    def test_bisect(self):
+        assert jnet.bisect(NODES) == [["n1", "n2"], ["n3", "n4", "n5"]]
+
+    def test_split_one(self):
+        comps = jnet.split_one("n2", NODES)
+        assert comps == [["n2"], ["n1", "n3", "n4", "n5"]]
+
+    def test_complete_grudge(self):
+        g = jnet.complete_grudge(jnet.bisect(NODES))
+        assert g["n1"] == ["n3", "n4", "n5"]
+        assert g["n5"] == ["n1", "n2"]
+
+    def test_bridge(self):
+        g = jnet.bridge(NODES)
+        # bridge node n3 talks to everyone
+        assert g["n3"] == []
+        assert set(g["n1"]) == {"n4", "n5"}
+        assert set(g["n5"]) == {"n1", "n2"}
+
+    def test_majorities_ring(self):
+        g = jnet.majorities_ring(NODES)
+        for node, blocked in g.items():
+            visible = len(NODES) - len(blocked)
+            assert visible >= 3, (node, blocked)  # majority of 5
+        # no two nodes see the same set
+        views = {frozenset(set(NODES) - set(b)) for b in g.values()}
+        assert len(views) == len(NODES)
+
+
+def record_test(**kw):
+    t = {"nodes": list(NODES),
+         "remote": control.DummyRemote(record_only=True),
+         "net": jnet.IptablesNet()}
+    t.update(kw)
+    control.setup_sessions(t)
+    return t
+
+
+class TestPartitioner:
+    def test_start_stop_issues_iptables(self):
+        t = record_test()
+        nem = partition_halves().setup(t)
+        res = nem.invoke(t, Op(process="nemesis", type=INFO,
+                               f="start-partition"))
+        assert res.type == INFO
+        log = "\n".join(t["remote"].log)
+        assert "iptables -A INPUT -s" in log
+        res = nem.invoke(t, Op(process="nemesis", type=INFO,
+                               f="stop-partition"))
+        assert "iptables -F" in "\n".join(t["remote"].log)
+        control.teardown_sessions(t)
+
+    def test_explicit_grudge_value(self):
+        t = record_test()
+        nem = Partitioner().setup(t)
+        res = nem.invoke(t, Op(process="nemesis", type=INFO,
+                               f="start-partition",
+                               value={"n1": ["n2"], "n2": ["n1"]}))
+        assert res.value == {"n1": ["n2"], "n2": ["n1"]}
+        control.teardown_sessions(t)
+
+
+class TestComposition:
+    def test_compose_routes_by_f(self):
+        calls = []
+
+        class A(jnemesis.Nemesis):
+            def invoke(self, test, op):
+                calls.append(("a", op.f))
+                return op
+
+            def fs(self):
+                return ["fa"]
+
+        class B(jnemesis.Nemesis):
+            def invoke(self, test, op):
+                calls.append(("b", op.f))
+                return op
+
+            def fs(self):
+                return ["fb"]
+
+        nem = jnemesis.compose([A(), B()])
+        nem.invoke({}, Op(process="nemesis", type=INFO, f="fb"))
+        nem.invoke({}, Op(process="nemesis", type=INFO, f="fa"))
+        assert calls == [("b", "fb"), ("a", "fa")]
+
+    def test_f_map(self):
+        class Inner(jnemesis.Nemesis):
+            def invoke(self, test, op):
+                assert op.f == "start"
+                return op
+
+            def fs(self):
+                return ["start"]
+
+        nem = jnemesis.f_map({"start": "start-foo"}, Inner())
+        res = nem.invoke({}, Op(process="nemesis", type=INFO, f="start-foo"))
+        assert res.f == "start-foo"
+        assert nem.fs() == ["start-foo"]
+
+
+class TestPackages:
+    def test_partition_package_shape(self):
+        p = combined.partition_package({"interval": 1.0})
+        assert p.nemesis is not None
+        assert p.generator is not None
+        assert p.perf[0]["name"] == "partition"
+
+    def test_nemesis_package_composes(self):
+        p = combined.nemesis_package(
+            {"faults": ["partition", "packet"], "interval": 1.0})
+        fs = set(p.nemesis.fs())
+        assert {"start-partition", "stop-partition",
+                "start-packet", "stop-packet"} <= fs
+
+    def test_package_generator_emits_faults(self):
+        p = combined.partition_package({"interval": 0.01})
+        from jepsen_tpu.generator import testkit
+        h = testkit.quick(gen.nemesis(gen.time_limit(0.5, p.generator)),
+                          concurrency=2)
+        fs = [o.f for o in h if o.process == "nemesis"]
+        assert "start-partition" in fs and "stop-partition" in fs
