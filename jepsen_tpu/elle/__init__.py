@@ -1,0 +1,11 @@
+"""Transactional-anomaly detection — the Elle-equivalent analysis engines.
+
+The reference delegates to the external elle library
+(jepsen/src/jepsen/tests/cycle.clj, cycle/append.clj, cycle/wr.clj); this
+package provides the same capability natively: dependency-graph construction
+from transactional histories, strongly-connected-component cycle search, and
+anomaly classification (G0, G1a/b/c, G-single, G2-item) for the list-append
+and rw-register workload languages.
+"""
+
+from jepsen_tpu.elle.graph import Graph, find_cycle, sccs  # noqa: F401

@@ -1,0 +1,136 @@
+"""Dependency graphs, SCCs, and cycle extraction.
+
+The backbone of the anomaly checkers: nodes are transaction ids, labelled
+edges carry dependency types (ww/wr/rw/realtime/process).  Tarjan SCC
+(iterative — histories are long) plus shortest-cycle recovery inside an SCC.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+
+class Graph:
+    def __init__(self):
+        self.out: Dict[Any, Dict[Any, Set[str]]] = defaultdict(dict)
+        self.nodes: Set[Any] = set()
+
+    def add_node(self, n) -> None:
+        self.nodes.add(n)
+
+    def add_edge(self, a, b, kind: str) -> None:
+        if a == b:
+            return
+        self.nodes.add(a)
+        self.nodes.add(b)
+        self.out[a].setdefault(b, set()).add(kind)
+
+    def succs(self, n) -> Iterable[Any]:
+        return self.out.get(n, {})
+
+    def edge_kinds(self, a, b) -> Set[str]:
+        return self.out.get(a, {}).get(b, set())
+
+    def filter_kinds(self, kinds: Iterable[str]) -> "Graph":
+        ks = set(kinds)
+        g = Graph()
+        g.nodes = set(self.nodes)
+        for a, bs in self.out.items():
+            for b, ek in bs.items():
+                inter = ek & ks
+                if inter:
+                    for k in inter:
+                        g.add_edge(a, b, k)
+        return g
+
+    def __len__(self):
+        return len(self.nodes)
+
+
+def sccs(g: Graph) -> List[List[Any]]:
+    """Iterative Tarjan; returns nontrivial SCCs (size >= 2)."""
+    index: Dict[Any, int] = {}
+    low: Dict[Any, int] = {}
+    on_stack: Set[Any] = set()
+    stack: List[Any] = []
+    out: List[List[Any]] = []
+    counter = [0]
+
+    for root in g.nodes:
+        if root in index:
+            continue
+        work = [(root, iter(g.succs(root)))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(g.succs(w))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(comp)
+    return out
+
+
+def find_cycle(g: Graph, component: List[Any]) -> Optional[List[Any]]:
+    """A shortest cycle within an SCC: BFS from each node back to itself
+    (bounded — component members only)."""
+    comp = set(component)
+    best: Optional[List[Any]] = None
+    for start in component:
+        # BFS over comp
+        prev: Dict[Any, Any] = {start: None}
+        q = deque([start])
+        found = None
+        while q and found is None:
+            n = q.popleft()
+            for m in g.succs(n):
+                if m == start:
+                    found = n
+                    break
+                if m in comp and m not in prev:
+                    prev[m] = n
+                    q.append(m)
+        if found is not None:
+            path = [found]
+            while prev[path[-1]] is not None:
+                path.append(prev[path[-1]])
+            path.reverse()
+            path.append(start)  # close: start -> ... -> found -> start
+            cyc = [start] + path if path[0] != start else path
+            # normalize: cycle as [n0, n1, ..., n0]
+            if best is None or len(cyc) < len(best):
+                best = cyc
+        if best is not None and len(best) == 2:
+            break
+    return best
+
+
+def cycle_edge_kinds(g: Graph, cycle: List[Any]) -> List[Set[str]]:
+    return [g.edge_kinds(a, b) for a, b in zip(cycle, cycle[1:])]

@@ -1,0 +1,180 @@
+"""List-append anomaly inference.
+
+Parity: elle.list-append as consumed by the reference
+(jepsen/src/jepsen/tests/cycle/append.clj:11-46).  The workload: each
+transaction is a list of mops ``["append", k, v]`` / ``["r", k, [v...]]``
+where appended values are unique per key.  Reads observe the key's whole
+list, which *traces the version history exactly* — that's what makes
+dependency inference sound:
+
+- version order per key = the longest read list (all reads must agree on
+  prefixes; disagreement = :incompatible-order);
+- wr edge  W →wr R:  R read a list whose last element was appended by W;
+- ww edge  W1 →ww W2: W2 appended the value immediately following W1's in
+  the version order;
+- rw edge  R →rw W:  R observed the state just before W's append;
+- realtime edge T1 → T2: T1's ok preceded T2's invoke (strict mode).
+
+Anomalies: G1a (read of aborted write), G1b (read of intermediate state),
+duplicates, incompatible orders, and dependency cycles classified as
+G0 (ww only), G1c (ww+wr), G-single (exactly one rw), G2-item (≥1 rw).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
+
+CYCLE_SEVERITY = ["G0", "G1c", "G-single", "G2-item"]
+
+
+def classify_cycle(kind_sets: List[Set[str]]) -> str:
+    has_rw = sum(1 for ks in kind_sets if ks <= {"rw"})
+    any_rw = any("rw" in ks for ks in kind_sets)
+    only_ww = all("ww" in ks for ks in kind_sets)
+    if only_ww and not any_rw:
+        return "G0"
+    if not any_rw:
+        return "G1c"
+    if has_rw == 1 or sum(1 for ks in kind_sets if "rw" in ks) == 1:
+        return "G-single"
+    return "G2-item"
+
+
+def check(history: History, consistency_models: Sequence[str] = ("serializable",),
+          realtime: bool = False) -> Dict[str, Any]:
+    """Analyze a list-append history; returns an elle-shaped result map."""
+    oks: List[Tuple[int, Op]] = []
+    failed_writes: Set[Tuple[Any, Any]] = set()
+    info_writes: Set[Tuple[Any, Any]] = set()
+    pairs = history.pair_index()
+
+    for i, op in enumerate(history):
+        if not isinstance(op.value, (list, tuple)):
+            continue
+        if op.type == OK:
+            oks.append((i, op))
+        elif op.type in (FAIL, INFO):
+            j = pairs[i]
+            txn = op.value if op.value else (
+                history[j].value if j >= 0 else None)
+            if txn:
+                for f, k, v in txn:
+                    if f == "append":
+                        (failed_writes if op.type == FAIL
+                         else info_writes).add((k, v))
+
+    anomalies: Dict[str, List[Any]] = defaultdict(list)
+
+    # writer index + duplicate detection
+    writer: Dict[Tuple[Any, Any], int] = {}
+    txn_of: Dict[int, List] = {}
+    for tid, (_, op) in enumerate(oks):
+        txn_of[tid] = op.value
+        for f, k, v in op.value:
+            if f == "append":
+                if (k, v) in writer:
+                    anomalies["duplicate-appends"].append(
+                        {"key": k, "value": v})
+                writer[(k, v)] = tid
+
+    # per-key longest read + prefix consistency + G1a/G1b
+    longest: Dict[Any, List[Any]] = {}
+    for tid, (_, op) in enumerate(oks):
+        for f, k, v in op.value:
+            if f not in ("r", "read") or v is None:
+                continue
+            lst = list(v)
+            # G1a: observed value appended by a failed txn
+            for x in lst:
+                if (k, x) in failed_writes:
+                    anomalies["G1a"].append({"key": k, "value": x,
+                                             "reader": op.to_dict()})
+            cur = longest.get(k, [])
+            short, long_ = (lst, cur) if len(lst) <= len(cur) else (cur, lst)
+            if short != long_[:len(short)]:
+                anomalies["incompatible-order"].append(
+                    {"key": k, "a": cur, "b": lst})
+            if len(lst) > len(cur):
+                longest[k] = lst
+
+    # G1b: a read that ends inside another txn's append run
+    # (observes some but not all of a txn's appends to k, with nothing after)
+    appends_by_txn_key: Dict[Tuple[int, Any], List[Any]] = defaultdict(list)
+    for tid, (_, op) in enumerate(oks):
+        for f, k, v in op.value:
+            if f == "append":
+                appends_by_txn_key[(tid, k)].append(v)
+    for rtid, (_, op) in enumerate(oks):
+        for f, k, v in op.value:
+            if f not in ("r", "read") or not v:
+                continue
+            last = v[-1]
+            wtid = writer.get((k, last))
+            if wtid is None or wtid == rtid:
+                continue
+            run = appends_by_txn_key[(wtid, k)]
+            if run and last != run[-1]:
+                anomalies["G1b"].append({"key": k, "value": last,
+                                         "reader": op.to_dict()})
+
+    # dependency graph
+    g = Graph()
+    for tid in range(len(oks)):
+        g.add_node(tid)
+
+    for k, order in longest.items():
+        # ww edges along the version order
+        for a, b in zip(order, order[1:]):
+            wa, wb = writer.get((k, a)), writer.get((k, b))
+            if wa is not None and wb is not None and wa != wb:
+                g.add_edge(wa, wb, "ww")
+
+    for rtid, (_, op) in enumerate(oks):
+        for f, k, v in op.value:
+            if f not in ("r", "read") or v is None:
+                continue
+            lst = list(v)
+            if lst:
+                w = writer.get((k, lst[-1]))
+                if w is not None and w != rtid:
+                    g.add_edge(w, rtid, "wr")
+            # rw: the next value after the observed state
+            order = longest.get(k, [])
+            nxt = order[len(lst)] if len(lst) < len(order) and \
+                order[:len(lst)] == lst else None
+            if nxt is not None:
+                w = writer.get((k, nxt))
+                if w is not None and w != rtid:
+                    g.add_edge(rtid, w, "rw")
+
+    if realtime:
+        # T1 -> T2 if T1's completion index < T2's invocation index
+        for t1, (i1, op1) in enumerate(oks):
+            inv1 = pairs[i1]
+            for t2, (i2, op2) in enumerate(oks):
+                if t1 == t2:
+                    continue
+                inv2 = pairs[i2]
+                if inv2 >= 0 and i1 < inv2:
+                    g.add_edge(t1, t2, "realtime")
+
+    # cycles
+    for comp in sccs(g):
+        cyc = find_cycle(g, comp)
+        if not cyc:
+            continue
+        kinds = cycle_edge_kinds(g, cyc)
+        label = classify_cycle(kinds)
+        anomalies[label].append({
+            "cycle": [txn_of[t] for t in cyc],
+            "edges": [sorted(ks) for ks in kinds]})
+
+    valid = not anomalies
+    return {"valid": valid,
+            "anomaly-types": sorted(anomalies),
+            "anomalies": {k: v[:8] for k, v in anomalies.items()},
+            "count": len(oks)}

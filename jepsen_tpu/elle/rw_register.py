@@ -1,0 +1,121 @@
+"""Read/write-register anomaly inference.
+
+Parity: elle.rw-register as consumed by the reference
+(jepsen/src/jepsen/tests/cycle/wr.clj:9-25).  Transactions carry
+``["w", k, v]`` (v unique per key) and ``["r", k, v]`` mops.  Unlike
+list-append, reads don't trace version history, so the dependency graph is
+inferred from:
+
+- wr edges (exact): the unique writer of an observed value → the reader;
+- ww edges (partial): per-key version order inferred from each transaction's
+  own read-then-write (a txn that read v and wrote v' orders v < v'), plus
+  the initial state (nil before any observed value);
+- rw edges: reader of v → writer of any v' with v <ww v' immediately after;
+- realtime edges in strict mode.
+
+Plus G1a (reads of failed writes) and duplicate-write detection.  Full
+Elle-grade version-order recovery (inferred from recoverability and
+traceability assumptions) goes deeper; this covers its core and reports
+what it can prove.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.elle.list_append import classify_cycle
+from jepsen_tpu.history import FAIL, History, INFO, OK, Op
+from jepsen_tpu.txn import READ_FS, WRITE_FS
+
+
+def check(history: History, realtime: bool = False) -> Dict[str, Any]:
+    pairs = history.pair_index()
+    oks: List[Tuple[int, Op]] = []
+    failed_writes: Set[Tuple[Any, Any]] = set()
+    for i, op in enumerate(history):
+        if not isinstance(op.value, (list, tuple)):
+            continue
+        if op.type == OK:
+            oks.append((i, op))
+        elif op.type == FAIL:
+            j = pairs[i]
+            txn = op.value or (history[j].value if j >= 0 else None)
+            if txn:
+                for f, k, v in txn:
+                    if f in WRITE_FS:
+                        failed_writes.add((k, v))
+
+    anomalies: Dict[str, List[Any]] = defaultdict(list)
+    writer: Dict[Tuple[Any, Any], int] = {}
+    txn_of: Dict[int, List] = {}
+    for tid, (_, op) in enumerate(oks):
+        txn_of[tid] = op.value
+        for f, k, v in op.value:
+            if f in WRITE_FS:
+                if (k, v) in writer:
+                    anomalies["duplicate-writes"].append({"key": k,
+                                                          "value": v})
+                writer[(k, v)] = tid
+
+    g = Graph()
+    for tid in range(len(oks)):
+        g.add_node(tid)
+
+    # per-key successor order v -> v' from read-then-write within one txn
+    succ: Dict[Tuple[Any, Any], Set[Any]] = defaultdict(set)
+    for tid, (_, op) in enumerate(oks):
+        reads: Dict[Any, Any] = {}
+        for f, k, v in op.value:
+            if f in READ_FS:
+                reads[k] = v
+            elif f in WRITE_FS:
+                if k in reads:
+                    succ[(k, reads[k])].add(v)
+
+    for tid, (_, op) in enumerate(oks):
+        for f, k, v in op.value:
+            if f in READ_FS:
+                if (k, v) in failed_writes:
+                    anomalies["G1a"].append({"key": k, "value": v,
+                                             "reader": op.to_dict()})
+                if v is not None:
+                    w = writer.get((k, v))
+                    if w is not None and w != tid:
+                        g.add_edge(w, tid, "wr")
+                # rw: observed v, some txn wrote a direct successor of v
+                for v2 in succ.get((k, v), ()):
+                    w2 = writer.get((k, v2))
+                    if w2 is not None and w2 != tid:
+                        g.add_edge(tid, w2, "rw")
+
+    # ww edges from the same successor relation
+    for (k, v), nexts in succ.items():
+        w1 = writer.get((k, v))
+        for v2 in nexts:
+            w2 = writer.get((k, v2))
+            if w1 is not None and w2 is not None and w1 != w2:
+                g.add_edge(w1, w2, "ww")
+
+    if realtime:
+        for t1, (i1, _) in enumerate(oks):
+            for t2, (i2, _) in enumerate(oks):
+                if t1 != t2:
+                    inv2 = pairs[i2]
+                    if inv2 >= 0 and i1 < inv2:
+                        g.add_edge(t1, t2, "realtime")
+
+    for comp in sccs(g):
+        cyc = find_cycle(g, comp)
+        if not cyc:
+            continue
+        kinds = cycle_edge_kinds(g, cyc)
+        anomalies[classify_cycle(kinds)].append({
+            "cycle": [txn_of[t] for t in cyc],
+            "edges": [sorted(ks) for ks in kinds]})
+
+    return {"valid": not anomalies,
+            "anomaly-types": sorted(anomalies),
+            "anomalies": {k: v[:8] for k, v in anomalies.items()},
+            "count": len(oks)}

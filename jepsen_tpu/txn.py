@@ -1,0 +1,56 @@
+"""Micro-op transaction utilities.
+
+Parity: jepsen.txn (vendored at txn/src/jepsen/txn.clj:1-40 in the
+reference): transactions are sequences of micro-ops ("mops")
+``[f, k, v]`` — e.g. ``["r", "x", [1, 2]]`` or ``["append", "x", 3]`` —
+and these helpers extract external reads/writes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+Mop = Sequence  # [f, k, v]
+
+WRITE_FS = {"w", "write", "append"}
+READ_FS = {"r", "read"}
+
+
+def ext_reads(txn: Sequence[Mop]) -> Dict[Any, Any]:
+    """External reads: the first read of each key *before* any write to it
+    (txn.clj ext-reads)."""
+    reads: Dict[Any, Any] = {}
+    written = set()
+    for f, k, v in txn:
+        if f in READ_FS:
+            if k not in written and k not in reads:
+                reads[k] = v
+        elif f in WRITE_FS:
+            written.add(k)
+    return reads
+
+
+def ext_writes(txn: Sequence[Mop]) -> Dict[Any, Any]:
+    """External writes: the last write of each key (txn.clj ext-writes)."""
+    writes: Dict[Any, Any] = {}
+    for f, k, v in txn:
+        if f in WRITE_FS:
+            writes[k] = v
+    return writes
+
+
+def reads_of(txn: Sequence[Mop]) -> List[Mop]:
+    return [m for m in txn if m[0] in READ_FS]
+
+
+def writes_of(txn: Sequence[Mop]) -> List[Mop]:
+    return [m for m in txn if m[0] in WRITE_FS]
+
+
+def keys_of(txn: Sequence[Mop]) -> List[Any]:
+    seen, out = set(), []
+    for _, k, _ in txn:
+        if k not in seen:
+            seen.add(k)
+            out.append(k)
+    return out

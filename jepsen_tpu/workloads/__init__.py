@@ -1,0 +1,5 @@
+"""Workload kits — reusable generator+checker bundles.
+
+Parity: jepsen.tests.* (jepsen/src/jepsen/tests/): each workload returns a
+dict {generator, checker, client-ops...} a suite merges into its test map.
+"""

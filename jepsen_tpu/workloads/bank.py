@@ -1,0 +1,80 @@
+"""Bank workload: concurrent transfers must conserve total balance.
+
+Parity: jepsen.tests.bank (jepsen/src/jepsen/tests/bank.clj): transfer ops
+move money between accounts; reads return the whole account map; under
+snapshot isolation the total must never change (bank.clj:41-179).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History, INVOKE, OK, Op
+
+DEFAULT_ACCOUNTS = list(range(8))
+DEFAULT_TOTAL = 100
+DEFAULT_MAX_TRANSFER = 5
+
+
+def transfer_gen(accounts=None, max_transfer=DEFAULT_MAX_TRANSFER):
+    accounts = accounts or DEFAULT_ACCOUNTS
+
+    def one():
+        frm, to = random.sample(accounts, 2)
+        return {"f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": random.randint(1, max_transfer)}}
+
+    return gen.FnGen(one)
+
+
+def read_gen():
+    return gen.repeat({"f": "read"})
+
+
+def generator(accounts=None, max_transfer=DEFAULT_MAX_TRANSFER):
+    """Mixed reads and transfers (bank.clj:41)."""
+    return gen.mix([read_gen(), transfer_gen(accounts, max_transfer)])
+
+
+class BankChecker(Checker):
+    """Every read's total must equal the invariant total; negative balances
+    are illegal unless negative_balances is allowed (bank.clj:84-179)."""
+
+    def __init__(self, total: int = DEFAULT_TOTAL,
+                 negative_balances: bool = False):
+        self.total = total
+        self.negative_balances = negative_balances
+
+    def check(self, test, history: History, opts=None):
+        bad_reads: List[Dict[str, Any]] = []
+        n_reads = 0
+        for op in history:
+            if op.f == "read" and op.type == OK and op.value is not None:
+                n_reads += 1
+                balances = dict(op.value)
+                total = sum(balances.values())
+                neg = {k: v for k, v in balances.items() if v < 0}
+                if total != self.total:
+                    bad_reads.append({"op": op.to_dict(), "total": total,
+                                      "expected": self.total})
+                elif neg and not self.negative_balances:
+                    bad_reads.append({"op": op.to_dict(), "negative": neg})
+        if n_reads == 0:
+            return {"valid": UNKNOWN, "error": "no reads completed"}
+        return {"valid": not bad_reads,
+                "read-count": n_reads,
+                "bad-reads-count": len(bad_reads),
+                "bad-reads": bad_reads[:10]}
+
+
+def workload(accounts=None, total=DEFAULT_TOTAL,
+             max_transfer=DEFAULT_MAX_TRANSFER) -> Dict[str, Any]:
+    accounts = accounts or DEFAULT_ACCOUNTS
+    return {"accounts": accounts,
+            "total_amount": total,
+            "generator": generator(accounts, max_transfer),
+            "checker": BankChecker(total)}

@@ -1,0 +1,79 @@
+"""Transactional cycle workloads — generators + Elle-equivalent checkers.
+
+Parity: jepsen.tests.cycle / cycle.append / cycle.wr (the thin adapters at
+jepsen/src/jepsen/tests/cycle/append.clj:11-46 and wr.clj:9-25): generators
+emit micro-op transactions; checkers run the anomaly inference from
+jepsen_tpu.elle.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.elle import list_append, rw_register
+from jepsen_tpu.history import History
+
+
+def append_gen(keys: int = 8, min_len: int = 1, max_len: int = 4,
+               read_p: float = 0.5):
+    """Random list-append transactions with per-key unique values."""
+    counters = [itertools.count(1) for _ in range(keys)]
+
+    def one():
+        txn = []
+        for _ in range(random.randint(min_len, max_len)):
+            k = random.randrange(keys)
+            if random.random() < read_p:
+                txn.append(["r", k, None])
+            else:
+                txn.append(["append", k, next(counters[k])])
+        return {"f": "txn", "value": txn}
+
+    return gen.FnGen(one)
+
+
+def wr_gen(keys: int = 8, min_len: int = 1, max_len: int = 4,
+           read_p: float = 0.5):
+    counters = [itertools.count(1) for _ in range(keys)]
+
+    def one():
+        txn = []
+        for _ in range(random.randint(min_len, max_len)):
+            k = random.randrange(keys)
+            if random.random() < read_p:
+                txn.append(["r", k, None])
+            else:
+                txn.append(["w", k, next(counters[k])])
+        return {"f": "txn", "value": txn}
+
+    return gen.FnGen(one)
+
+
+class AppendChecker(Checker):
+    def __init__(self, realtime: bool = False):
+        self.realtime = realtime
+
+    def check(self, test, history: History, opts=None):
+        return list_append.check(history, realtime=self.realtime)
+
+
+class WrChecker(Checker):
+    def __init__(self, realtime: bool = False):
+        self.realtime = realtime
+
+    def check(self, test, history: History, opts=None):
+        return rw_register.check(history, realtime=self.realtime)
+
+
+def append_workload(keys: int = 8, **kw) -> Dict[str, Any]:
+    return {"generator": append_gen(keys, **kw),
+            "checker": AppendChecker()}
+
+
+def wr_workload(keys: int = 8, **kw) -> Dict[str, Any]:
+    return {"generator": wr_gen(keys, **kw),
+            "checker": WrChecker()}

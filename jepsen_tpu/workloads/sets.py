@@ -1,0 +1,32 @@
+"""Set workload: unique adds, final (or repeated) reads.
+
+Parity: the set workloads used across the reference's suites, checked by
+checker/set and checker/set-full (jepsen/src/jepsen/checker.clj:240,294).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import SetChecker, SetFullChecker
+
+
+def adds():
+    counter = itertools.count()
+    return gen.FnGen(lambda: {"f": "add", "value": next(counter)})
+
+
+def final_read():
+    return gen.once({"f": "read"})
+
+
+def workload(full: bool = False, read_interval: float = 1.0) -> Dict[str, Any]:
+    if full:
+        # interleave reads throughout (set-full analysis needs them)
+        g = gen.mix([adds(), gen.stagger(read_interval,
+                                         gen.repeat({"f": "read"}))])
+        return {"generator": g, "checker": SetFullChecker()}
+    return {"generator": adds(), "final_generator": final_read(),
+            "checker": SetChecker()}

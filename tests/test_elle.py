@@ -1,0 +1,174 @@
+"""Elle-equivalent anomaly checkers: hand-built histories with known
+anomalies, plus an end-to-end run against an atomic in-process store."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu import txn as jtxn
+from jepsen_tpu.elle import graph, list_append, rw_register
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import FAIL, History, INVOKE, OK, Op
+from jepsen_tpu.workloads import cycle as cycle_wl
+
+
+def ok_txn(process, value):
+    return [Op(process=process, type=INVOKE, f="txn", value=value),
+            Op(process=process, type=OK, f="txn", value=value)]
+
+
+def fail_txn(process, value):
+    return [Op(process=process, type=INVOKE, f="txn", value=value),
+            Op(process=process, type=FAIL, f="txn", value=value)]
+
+
+class TestTxnUtils:
+    def test_ext_reads_writes(self):
+        t = [["r", "x", 1], ["w", "x", 2], ["r", "x", 2], ["r", "y", 5]]
+        assert jtxn.ext_reads(t) == {"x": 1, "y": 5}
+        assert jtxn.ext_writes(t) == {"x": 2}
+
+
+class TestGraph:
+    def test_scc_and_cycle(self):
+        g = graph.Graph()
+        g.add_edge(1, 2, "ww")
+        g.add_edge(2, 3, "ww")
+        g.add_edge(3, 1, "ww")
+        g.add_edge(3, 4, "ww")  # not in cycle
+        comps = graph.sccs(g)
+        assert len(comps) == 1 and set(comps[0]) == {1, 2, 3}
+        cyc = graph.find_cycle(g, comps[0])
+        assert cyc[0] == cyc[-1] and len(cyc) == 4
+
+    def test_no_cycle(self):
+        g = graph.Graph()
+        g.add_edge(1, 2, "ww")
+        g.add_edge(2, 3, "wr")
+        assert graph.sccs(g) == []
+
+
+class TestListAppend:
+    def test_clean_history_valid(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1]]) +
+            ok_txn(1, [["append", "x", 2]]) +
+            ok_txn(0, [["r", "x", [1, 2]]]))
+        r = list_append.check(h)
+        assert r["valid"] is True
+
+    def test_g1a_aborted_read(self):
+        h = History(
+            fail_txn(0, [["append", "x", 1]]) +
+            ok_txn(1, [["r", "x", [1]]]))
+        r = list_append.check(h)
+        assert "G1a" in r["anomaly-types"]
+
+    def test_g1b_intermediate_read(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1], ["append", "x", 2]]) +
+            ok_txn(1, [["r", "x", [1]]]))
+        r = list_append.check(h)
+        assert "G1b" in r["anomaly-types"]
+
+    def test_incompatible_order(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1]]) +
+            ok_txn(1, [["append", "x", 2]]) +
+            ok_txn(0, [["r", "x", [1, 2]]]) +
+            ok_txn(1, [["r", "x", [2, 1]]]))
+        r = list_append.check(h)
+        assert "incompatible-order" in r["anomaly-types"]
+
+    def test_g0_write_cycle(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1], ["append", "y", 1]]) +
+            ok_txn(1, [["append", "x", 2], ["append", "y", 2]]) +
+            ok_txn(2, [["r", "x", [1, 2]]]) +
+            ok_txn(3, [["r", "y", [2, 1]]]))
+        r = list_append.check(h)
+        assert "G0" in r["anomaly-types"], r
+
+    def test_g1c_wr_cycle(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1], ["r", "y", [1]]]) +
+            ok_txn(1, [["append", "y", 1], ["r", "x", [1]]]))
+        r = list_append.check(h)
+        assert "G1c" in r["anomaly-types"], r
+
+    def test_g_single(self):
+        h = History(
+            ok_txn(0, [["r", "z", []], ["r", "x", [1]]]) +
+            ok_txn(1, [["append", "x", 1], ["append", "z", 1]]) +
+            ok_txn(2, [["r", "z", [1]]]))
+        r = list_append.check(h)
+        assert "G-single" in r["anomaly-types"], r
+
+    def test_duplicate_append(self):
+        h = History(
+            ok_txn(0, [["append", "x", 1]]) +
+            ok_txn(1, [["append", "x", 1]]))
+        r = list_append.check(h)
+        assert "duplicate-appends" in r["anomaly-types"]
+
+
+class TestRwRegister:
+    def test_clean_valid(self):
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(1, [["r", "x", 1]]))
+        assert rw_register.check(h)["valid"] is True
+
+    def test_g1a(self):
+        h = History(
+            fail_txn(0, [["w", "x", 1]]) +
+            ok_txn(1, [["r", "x", 1]]))
+        assert "G1a" in rw_register.check(h)["anomaly-types"]
+
+    def test_wr_cycle(self):
+        h = History(
+            ok_txn(0, [["w", "x", 1], ["r", "y", 1]]) +
+            ok_txn(1, [["w", "y", 1], ["r", "x", 1]]))
+        r = rw_register.check(h)
+        assert "G1c" in r["anomaly-types"], r
+
+
+class AtomicTxnClient(jclient.Client):
+    """Serializable in-process store: applies a whole txn under one lock."""
+
+    _store = None
+    _lock = None
+
+    def __init__(self):
+        if AtomicTxnClient._store is None:
+            AtomicTxnClient._store = {}
+            AtomicTxnClient._lock = threading.Lock()
+        self.reusable = True
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with AtomicTxnClient._lock:
+            out = []
+            for f, k, v in op.value:
+                if f == "append":
+                    AtomicTxnClient._store.setdefault(k, []).append(v)
+                    out.append([f, k, v])
+                else:
+                    out.append([f, k, list(AtomicTxnClient._store.get(k, []))])
+            return op.with_(type=OK, value=out)
+
+
+class TestEndToEnd:
+    def test_atomic_store_is_serializable(self):
+        AtomicTxnClient._store = None
+        test = {"concurrency": 4,
+                "client": AtomicTxnClient(),
+                "generator": gen.clients(
+                    gen.limit(150, cycle_wl.append_gen(keys=4)))}
+        h = interpreter.run(test)
+        r = list_append.check(h)
+        assert r["valid"] is True, r["anomaly-types"]

@@ -1,0 +1,168 @@
+"""Workload kits: bank, sets, linearizable-register (independent lift)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.generator import interpreter, testkit
+from jepsen_tpu.history import History, INVOKE, OK, Op
+from jepsen_tpu.workloads import bank, linearizable_register, sets
+
+
+class BankClient(jclient.Client):
+    """Atomic in-process bank."""
+
+    def __init__(self, accounts, total):
+        n = len(accounts)
+        self.balances = {a: total // n for a in accounts}
+        self.balances[accounts[0]] += total - sum(self.balances.values())
+        self.lock = threading.Lock()
+        self.reusable = True
+        self.buggy = False
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f == "read":
+                return op.with_(type=OK, value=dict(self.balances))
+            v = op.value
+            frm, to, amt = v["from"], v["to"], v["amount"]
+            if self.balances[frm] < amt and not self.buggy:
+                return op.with_(type="fail")
+            self.balances[frm] -= amt
+            self.balances[to] += amt
+            if self.buggy:
+                self.balances[to] += 1  # conjure money
+            return op.with_(type=OK)
+
+
+class TestBank:
+    def test_honest_bank_valid(self):
+        wl = bank.workload()
+        client = BankClient(wl["accounts"], wl["total_amount"])
+        test = {"concurrency": 4, "client": client,
+                "generator": gen.clients(gen.limit(120, wl["generator"]))}
+        h = interpreter.run(test)
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is True, r
+
+    def test_buggy_bank_detected(self):
+        wl = bank.workload()
+        client = BankClient(wl["accounts"], wl["total_amount"])
+        client.buggy = True
+        test = {"concurrency": 4, "client": client,
+                "generator": gen.clients(gen.limit(120, wl["generator"]))}
+        h = interpreter.run(test)
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is False
+
+
+class SetClient(jclient.Client):
+    def __init__(self, lossy=False):
+        self.items = []
+        self.lock = threading.Lock()
+        self.lossy = lossy
+        self.n = 0
+        self.reusable = True
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f == "add":
+                self.n += 1
+                if self.lossy and self.n % 5 == 0:
+                    return op.with_(type=OK)  # ack but drop
+                self.items.append(op.value)
+                return op.with_(type=OK)
+            return op.with_(type=OK, value=list(self.items))
+
+
+class TestSets:
+    def test_set_workload(self):
+        wl = sets.workload()
+        test = {"concurrency": 3, "client": SetClient(),
+                "generator": [gen.clients(gen.limit(30, wl["generator"])),
+                              gen.clients(wl["final_generator"])]}
+        h = interpreter.run(test)
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is True, r
+
+    def test_lossy_set_detected(self):
+        wl = sets.workload()
+        test = {"concurrency": 3, "client": SetClient(lossy=True),
+                "generator": [gen.clients(gen.limit(30, wl["generator"])),
+                              gen.clients(wl["final_generator"])]}
+        h = interpreter.run(test)
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is False
+        assert r["lost-count"] > 0
+
+
+class KeyedRegisterClient(jclient.Client):
+    """Per-key linearizable CAS registers, values as (key, value) tuples."""
+
+    def __init__(self):
+        self.regs = {}
+        self.lock = threading.Lock()
+        self.reusable = True
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        k, v = op.value
+        with self.lock:
+            cur = self.regs.get(k)
+            if op.f == "read":
+                return op.with_(type=OK, value=(k, cur))
+            if op.f == "write":
+                self.regs[k] = v
+                return op.with_(type=OK)
+            old, new = v
+            if cur == old:
+                self.regs[k] = new
+                return op.with_(type=OK)
+            return op.with_(type="fail")
+
+
+class TestLinearizableRegister:
+    def test_independent_lift_end_to_end(self):
+        wl = linearizable_register.workload(
+            keys=[0, 1, 2, 3], ops_per_key=40, threads_per_key=2,
+            algorithm="cpu")
+        test = {"concurrency": 8, "client": KeyedRegisterClient(),
+                "generator": gen.clients(wl["generator"])}
+        h = interpreter.run(test)
+        keys = independent.history_keys(h)
+        assert set(keys) == {0, 1, 2, 3}
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is True, r["failures"]
+
+    def test_device_batched_independent_checker(self):
+        wl = linearizable_register.workload(
+            keys=[0, 1], ops_per_key=30, threads_per_key=2,
+            capacity=128, chunk=128)
+        test = {"concurrency": 4, "client": KeyedRegisterClient(),
+                "generator": gen.clients(wl["generator"])}
+        h = interpreter.run(test)
+        r = wl["checker"].check(test, h)
+        assert r["valid"] is True, r
+        assert all(res["analyzer"] == "wgl-tpu-batch"
+                   for res in r["results"].values())
+
+    def test_subhistory_roundtrip(self):
+        h = History([
+            Op(process=0, type=INVOKE, f="write", value=(1, 5)),
+            Op(process=0, type=OK, f="write", value=(1, 5)),
+            Op(process=1, type=INVOKE, f="read", value=(2, None)),
+            Op(process=1, type=OK, f="read", value=(2, 7)),
+        ])
+        sub = independent.subhistory(1, h)
+        assert len(sub) == 2 and sub[0].value == 5
