@@ -1,0 +1,193 @@
+"""Performance analysis and plots from histories.
+
+Parity: jepsen.checker.perf + the perf/latency-graph/rate-graph checkers
+(jepsen/src/jepsen/checker.clj:797-829, checker/perf.clj:21-80): latency
+quantiles and throughput over time, rendered with matplotlib (the
+reference's gnuplot), with nemesis activity windows shaded
+(util.clj:744 nemesis-intervals).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, NEMESIS, OK
+
+QUANTILES = [0.5, 0.95, 0.99, 1.0]
+
+
+def latency_points(history: History) -> Dict[str, List[Tuple[float, float]]]:
+    """[(invoke-time-s, latency-ms)] per f, completed client ops only."""
+    pairs = history.pair_index()
+    out: Dict[str, List[Tuple[float, float]]] = defaultdict(list)
+    for i, op in enumerate(history):
+        if op.process == NEMESIS or op.type != INVOKE:
+            continue
+        j = pairs[i]
+        if j < 0:
+            continue
+        comp = history[j]
+        if None in (op.time, comp.time):
+            continue
+        out[f"{op.f}:{comp.type}"].append(
+            (op.time / 1e9, (comp.time - op.time) / 1e6))
+    return dict(out)
+
+
+def rate_points(history: History, dt_s: float = 1.0) -> Dict[str, np.ndarray]:
+    """Completions/sec per (f, type) in dt buckets."""
+    buckets: Dict[str, Dict[int, int]] = defaultdict(lambda: defaultdict(int))
+    tmax = 0.0
+    for op in history:
+        if op.process == NEMESIS or op.type == INVOKE or op.time is None:
+            continue
+        t = op.time / 1e9
+        tmax = max(tmax, t)
+        buckets[f"{op.f}:{op.type}"][int(t / dt_s)] += 1
+    n = int(tmax / dt_s) + 1
+    out = {}
+    for k, b in buckets.items():
+        arr = np.zeros(n)
+        for i, c in b.items():
+            arr[i] = c / dt_s
+        out[k] = arr
+    return out
+
+
+def nemesis_intervals(history: History,
+                      start_fs=("start",), stop_fs=("stop",)
+                      ) -> List[Tuple[float, float]]:
+    """[(start-s, stop-s)] windows of nemesis activity (util.clj:744);
+    any nemesis f containing 'start'/'stop' (or listed) toggles."""
+    out = []
+    open_t: Optional[float] = None
+    tmax = 0.0
+    for op in history:
+        if op.time is None:
+            continue
+        tmax = max(tmax, op.time / 1e9)
+        if op.process != NEMESIS or op.type == INVOKE:
+            continue
+        f = str(op.f)
+        is_start = f in start_fs or f.startswith("start") or "start-" in f
+        is_stop = f in stop_fs or f.startswith("stop") or "stop-" in f or \
+            f.startswith("heal") or f.startswith("resume")
+        if is_start and open_t is None:
+            open_t = op.time / 1e9
+        elif is_stop and open_t is not None:
+            out.append((open_t, op.time / 1e9))
+            open_t = None
+    if open_t is not None:
+        out.append((open_t, tmax))
+    return out
+
+
+def latency_quantiles(history: History) -> Dict[str, Dict[str, float]]:
+    pts = latency_points(history)
+    out = {}
+    for k, series in pts.items():
+        lat = np.array([l for _, l in series])
+        out[k] = {f"p{int(q * 100)}": float(np.quantile(lat, q))
+                  for q in QUANTILES}
+        out[k]["count"] = len(series)
+    return out
+
+
+def _plot(history: History, store_dir: str, which: str) -> Optional[str]:
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return None
+    fig, ax = plt.subplots(figsize=(10, 5))
+    for a, b in nemesis_intervals(history):
+        ax.axvspan(a, b, color="#FDD", zorder=0)
+    if which == "latency":
+        for k, series in sorted(latency_points(history).items()):
+            xs = [t for t, _ in series]
+            ys = [l for _, l in series]
+            marker = "." if k.endswith(OK) else "x"
+            ax.plot(xs, ys, marker, markersize=3, label=k, alpha=0.6)
+        ax.set_yscale("log")
+        ax.set_ylabel("latency (ms)")
+    else:
+        for k, arr in sorted(rate_points(history).items()):
+            ax.plot(np.arange(len(arr)), arr, label=k)
+        ax.set_ylabel("throughput (ops/s)")
+    ax.set_xlabel("time (s)")
+    ax.legend(fontsize=7)
+    path = os.path.join(store_dir, f"{which}-raw.png")
+    fig.savefig(path, dpi=100, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+class LatencyGraph(Checker):
+    """checker.clj:797 latency-graph."""
+
+    def check(self, test, history, opts=None):
+        d = (opts or {}).get("store_dir") or test.get("store_dir")
+        out = {"valid": True, "quantiles": latency_quantiles(history)}
+        if d:
+            out["plot"] = _plot(history, d, "latency")
+        return out
+
+
+class RateGraph(Checker):
+    """checker.clj:810 rate-graph."""
+
+    def check(self, test, history, opts=None):
+        d = (opts or {}).get("store_dir") or test.get("store_dir")
+        out = {"valid": True}
+        if d:
+            out["plot"] = _plot(history, d, "rate")
+        return out
+
+
+class Perf(Checker):
+    """checker.clj:822 perf — both graphs."""
+
+    def check(self, test, history, opts=None):
+        lg = LatencyGraph().check(test, history, opts)
+        rg = RateGraph().check(test, history, opts)
+        return {"valid": True, "latency": lg, "rate": rg}
+
+
+class ClockPlot(Checker):
+    """Plot clock offsets recorded by a clock nemesis
+    (checker/clock.clj:13-34): ops whose value carries {node: offset-s}."""
+
+    def check(self, test, history, opts=None):
+        series: Dict[str, List[Tuple[float, float]]] = defaultdict(list)
+        for op in history:
+            if op.f == "clock-offsets" and isinstance(op.value, dict) \
+                    and op.time is not None:
+                for node, off in op.value.items():
+                    series[node].append((op.time / 1e9, off))
+        out = {"valid": True, "nodes": sorted(series)}
+        d = (opts or {}).get("store_dir") or test.get("store_dir")
+        if d and series:
+            try:
+                import matplotlib
+                matplotlib.use("Agg")
+                import matplotlib.pyplot as plt
+                fig, ax = plt.subplots(figsize=(10, 4))
+                for node, pts in sorted(series.items()):
+                    ax.plot([t for t, _ in pts], [o for _, o in pts],
+                            label=node)
+                ax.set_xlabel("time (s)")
+                ax.set_ylabel("clock offset (s)")
+                ax.legend(fontsize=7)
+                path = os.path.join(d, "clock-skew.png")
+                fig.savefig(path, dpi=100, bbox_inches="tight")
+                plt.close(fig)
+                out["plot"] = path
+            except ImportError:
+                pass
+        return out

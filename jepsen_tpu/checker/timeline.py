@@ -1,0 +1,89 @@
+"""HTML timeline of per-process operations.
+
+Parity: jepsen.checker.timeline (jepsen/src/jepsen/checker/timeline.clj):
+renders every process's ops as positioned bars in an HTML page, capped at
+10k ops for browser sanity (timeline.clj:12-14).
+"""
+
+from __future__ import annotations
+
+import html
+import os
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, NEMESIS, OK
+
+MAX_OPS = 10_000  # timeline.clj:12
+
+_COLORS = {OK: "#6DB6FE", INFO: "#FEB95F", FAIL: "#FFAA8F",
+           None: "#DDDDDD"}
+
+_STYLE = """
+body { font-family: monospace; }
+.op { position: absolute; padding: 1px 3px; border-radius: 2px;
+      font-size: 9px; overflow: hidden; white-space: nowrap;
+      border: 1px solid #888; }
+.proc-label { position: absolute; top: 0; font-weight: bold; }
+"""
+
+
+class Timeline(Checker):
+    def check(self, test, history: History, opts=None):
+        d = (opts or {}).get("store_dir") or test.get("store_dir")
+        if not d:
+            return {"valid": True, "note": "no store dir; skipped"}
+        path = os.path.join(d, "timeline.html")
+        with open(path, "w") as f:
+            f.write(self.render(history))
+        return {"valid": True, "file": path}
+
+    def render(self, history: History) -> str:
+        pairs = history.pair_index()
+        procs = []
+        seen = set()
+        for op in history:
+            if op.process not in seen:
+                seen.add(op.process)
+                procs.append(op.process)
+        col_of = {p: i for i, p in enumerate(procs)}
+        col_w, scale = 220, 1e-6  # 1 ms/px
+
+        cells = []
+        n = 0
+        for i, op in enumerate(history):
+            if op.type != INVOKE and not (op.process == NEMESIS
+                                          and op.type == INFO
+                                          and pairs[i] < 0):
+                continue
+            n += 1
+            if n > MAX_OPS:
+                break
+            j = pairs[i]
+            comp = history[j] if j >= 0 else None
+            t0 = (op.time or 0) * scale
+            t1 = (comp.time * scale) if comp and comp.time else t0 + 10
+            color = _COLORS.get(comp.type if comp else None, "#DDDDDD")
+            label = f"{op.process} {op.f} {op.value!r}"
+            if comp is not None and comp.value is not None and \
+                    comp.value != op.value:
+                label += f" → {comp.value!r}"
+            title = html.escape(
+                f"{op.type} {label} [{op.time}..{comp.time if comp else '?'}]")
+            cells.append(
+                f"<div class='op' title='{title}' style='"
+                f"left:{col_of[op.process] * col_w}px;"
+                f"top:{20 + t0:.1f}px;"
+                f"height:{max(3, t1 - t0):.1f}px;"
+                f"width:{col_w - 10}px;"
+                f"background:{color}'>{html.escape(label[:40])}</div>")
+
+        labels = [f"<div class='proc-label' style='left:{c * col_w}px'>"
+                  f"{html.escape(str(p))}</div>"
+                  for p, c in col_of.items()]
+        return (f"<html><head><style>{_STYLE}</style></head><body>"
+                f"<div style='position:relative'>{''.join(labels)}"
+                f"{''.join(cells)}</div></body></html>")
+
+
+timeline = Timeline

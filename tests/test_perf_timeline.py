@@ -1,0 +1,77 @@
+"""Perf plots, quantiles, nemesis intervals, timeline HTML."""
+
+import os
+
+import pytest
+
+from jepsen_tpu.checker.perf import (
+    ClockPlot, LatencyGraph, Perf, RateGraph, latency_quantiles,
+    nemesis_intervals,
+)
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.history import History, INFO, INVOKE, NEMESIS, OK, Op
+
+
+def ms(x):
+    return int(x * 1e6)
+
+
+def make_history():
+    ops = []
+    t = 0
+    for i in range(50):
+        t += ms(10)
+        ops.append(Op(process=i % 3, type=INVOKE, f="read", time=t))
+        ops.append(Op(process=i % 3, type=OK, f="read", value=i,
+                      time=t + ms(5 + i % 7)))
+    ops.insert(20, Op(process=NEMESIS, type=INVOKE, f="start-partition",
+                      time=ms(100)))
+    ops.insert(21, Op(process=NEMESIS, type=INFO, f="start-partition",
+                      time=ms(101)))
+    ops.append(Op(process=NEMESIS, type=INVOKE, f="stop-partition",
+                  time=ms(400)))
+    ops.append(Op(process=NEMESIS, type=INFO, f="stop-partition",
+                  time=ms(401)))
+    return History(ops, reindex=True)
+
+
+class TestPerf:
+    def test_quantiles(self):
+        q = latency_quantiles(make_history())
+        assert "read:ok" in q
+        assert 5 <= q["read:ok"]["p50"] <= 12
+        assert q["read:ok"]["count"] == 50
+
+    def test_nemesis_intervals(self):
+        iv = nemesis_intervals(make_history())
+        assert len(iv) == 1
+        a, b = iv[0]
+        assert abs(a - 0.101) < 1e-6 and abs(b - 0.401) < 1e-6
+
+    def test_plots_written(self, tmp_path):
+        t = {"store_dir": str(tmp_path)}
+        h = make_history()
+        r = Perf().check(t, h)
+        assert r["valid"] is True
+        assert os.path.exists(os.path.join(str(tmp_path), "latency-raw.png"))
+        assert os.path.exists(os.path.join(str(tmp_path), "rate-raw.png"))
+
+    def test_clock_plot(self, tmp_path):
+        h = History([
+            Op(process=NEMESIS, type=INFO, f="clock-offsets",
+               value={"n1": 0.5, "n2": -0.2}, time=ms(10)),
+            Op(process=NEMESIS, type=INFO, f="clock-offsets",
+               value={"n1": 1.5, "n2": 0.0}, time=ms(20)),
+        ])
+        r = ClockPlot().check({"store_dir": str(tmp_path)}, h)
+        assert r["nodes"] == ["n1", "n2"]
+        assert os.path.exists(os.path.join(str(tmp_path), "clock-skew.png"))
+
+
+class TestTimeline:
+    def test_renders_html(self, tmp_path):
+        t = {"store_dir": str(tmp_path)}
+        r = Timeline().check(t, make_history())
+        assert r["valid"] is True
+        content = open(r["file"]).read()
+        assert "read" in content and "start-partition" in content
