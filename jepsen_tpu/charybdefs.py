@@ -1,0 +1,86 @@
+"""CharybdeFS integration — syscall-level filesystem error injection.
+
+Parity: the charybdefs wrapper suite
+(charybdefs/src/jepsen/charybdefs.clj:40-87): build the CharybdeFS
+Thrift+FUSE filesystem on each node, mount it at /faulty, and inject
+EIO-class faults: break everything, break probabilistically, clear.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+
+REPO = "https://github.com/scylladb/charybdefs.git"
+DIR = "/opt/jepsen-tpu/charybdefs"
+MOUNT = "/faulty"
+
+
+def install(test, node) -> None:
+    """Clone + build (charybdefs.clj:40-67)."""
+    s = session(test, node).sudo()
+    if cu.exists(s, f"{DIR}/charybdefs"):
+        return
+    s.env(DEBIAN_FRONTEND="noninteractive").exec(
+        "apt-get", "install", "-y", "git", "g++", "cmake", "libfuse-dev",
+        "thrift-compiler", "libthrift-dev", "python3-thrift")
+    s.exec("rm", "-rf", DIR)
+    s.exec("git", "clone", REPO, DIR)
+    s.cd(DIR).exec("thrift", "-r", "--gen", "cpp", "server.thrift")
+    s.cd(DIR).exec("cmake", ".")
+    s.cd(DIR).exec("make")
+
+
+def mount(test, node, backing_dir: str = "/faulty-data") -> None:
+    s = session(test, node).sudo()
+    s.exec("mkdir", "-p", MOUNT, backing_dir)
+    cu.start_daemon(s, f"{DIR}/charybdefs", MOUNT,
+                    "-oallow_other", "-omodules=subdir",
+                    f"-osubdir={backing_dir}",
+                    pidfile="/var/run/charybdefs.pid",
+                    logfile="/var/log/charybdefs.log")
+
+
+def _client_cmd(test, node, method: str, *args) -> None:
+    """Drive the Thrift control interface via the bundled client
+    (charybdefs.clj:74-87's cookbook recipes)."""
+    s = session(test, node).sudo()
+    argv = " ".join(str(a) for a in args)
+    s.exec("python3", f"{DIR}/cookbook/recipes.py", method, *map(str, args)) \
+        if cu.exists(s, f"{DIR}/cookbook/recipes.py") else \
+        s.exec("bash", "-c",
+               f"cd {DIR}/cookbook && python3 -c "
+               f"'import recipes; recipes.{method}({argv})'")
+
+
+def break_all(test, node) -> None:
+    _client_cmd(test, node, "break_all")
+
+
+def break_one_percent(test, node) -> None:
+    _client_cmd(test, node, "break_one_percent")
+
+
+def clear(test, node) -> None:
+    _client_cmd(test, node, "clear")
+
+
+class CharybdeFSNemesis(Nemesis):
+    def invoke(self, test, op: Op) -> Op:
+        from jepsen_tpu.nemesis.faults import pick_nodes
+        targets = pick_nodes(test, op.value)
+        fn = {"break-all": break_all,
+              "break-some": break_one_percent,
+              "clear-faults": clear}.get(op.f)
+        if fn is None:
+            raise ValueError(f"charybdefs nemesis doesn't handle f={op.f!r}")
+        for n in targets:
+            fn(test, n)
+        return op.with_(type="info", value=sorted(targets))
+
+    def fs(self):
+        return ["break-all", "break-some", "clear-faults"]

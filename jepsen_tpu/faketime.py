@@ -1,0 +1,51 @@
+"""Per-process clock lies via LD_PRELOAD.
+
+Parity: jepsen.faketime (jepsen/src/jepsen/faketime.clj:8-60): build
+libfaketime on the node and generate wrapper scripts that launch a database
+binary under a faked clock with a per-run offset and rate.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.control import Session, session
+from jepsen_tpu.control import util as cu
+
+LIB_PATH = "/usr/local/lib/faketime/libfaketime.so.1"
+
+
+def install(test, node) -> None:
+    """Install libfaketime from the distro package (faketime.clj builds a
+    fork; the packaged library covers the rate+offset interface we use)."""
+    s = session(test, node).sudo()
+    if not cu.exists(s, LIB_PATH) and \
+            not cu.exists(s, "/usr/lib/x86_64-linux-gnu/faketime/libfaketime.so.1"):
+        s.env(DEBIAN_FRONTEND="noninteractive").exec(
+            "apt-get", "install", "-y", "libfaketime")
+
+
+def script(binary: str, offset_s: float, rate: float) -> str:
+    """A wrapper script launching ``binary`` under a faked clock
+    (faketime.clj:24-60): offset seconds plus a rate multiplier."""
+    spec = f"{'+' if offset_s >= 0 else ''}{offset_s}s x{rate}"
+    return ("#!/bin/bash\n"
+            f"export LD_PRELOAD=\"{LIB_PATH}\"\n"
+            f"export FAKETIME=\"{spec}\"\n"
+            "export FAKETIME_DONT_FAKE_MONOTONIC=1\n"
+            f"exec {binary} \"$@\"\n")
+
+
+def wrap_binary(test, node, binary: str, wrapper_path: str,
+                offset_s: Optional[float] = None,
+                rate: Optional[float] = None) -> str:
+    """Install a faketime wrapper for ``binary`` at ``wrapper_path`` with a
+    random (or given) skew, returning the chosen spec."""
+    offset_s = offset_s if offset_s is not None else \
+        random.uniform(-60.0, 60.0)
+    rate = rate if rate is not None else random.uniform(0.95, 1.05)
+    s = session(test, node).sudo()
+    cu.write_file(s, script(binary, offset_s, rate), wrapper_path)
+    s.exec("chmod", "+x", wrapper_path)
+    return f"{offset_s:+.3f}s x{rate:.4f}"

@@ -1,0 +1,86 @@
+"""Control-node persistent cache for files, strings, and structured data.
+
+Parity: jepsen.fs-cache (jepsen/src/jepsen/fs_cache.clj): cache expensive
+artifacts (package downloads, built binaries) across runs, keyed by logical
+paths, with atomic writes and per-key locking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+DEFAULT_DIR = os.path.join("store", "cache")
+
+_locks: dict = {}
+_locks_guard = threading.Lock()
+
+
+def _lock_for(key: str) -> threading.Lock:
+    with _locks_guard:
+        return _locks.setdefault(key, threading.Lock())
+
+
+class Cache:
+    def __init__(self, base: str = DEFAULT_DIR):
+        self.base = base
+
+    def _path(self, key: Sequence[Any]) -> str:
+        parts = [str(k).replace(os.sep, "_") for k in key]
+        return os.path.join(self.base, *parts)
+
+    def locking(self, key: Sequence[Any]):
+        return _lock_for(self._path(key))
+
+    # -- presence ----------------------------------------------------------
+    def cached(self, key: Sequence[Any]) -> bool:
+        return os.path.exists(self._path(key))
+
+    def clear(self, key: Optional[Sequence[Any]] = None) -> None:
+        p = self._path(key) if key else self.base
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.unlink(p)
+
+    # -- files -------------------------------------------------------------
+    def save_file(self, src: str, key: Sequence[Any]) -> str:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        shutil.copy(src, tmp)
+        os.replace(tmp, dst)
+        return dst
+
+    def file_path(self, key: Sequence[Any]) -> Optional[str]:
+        p = self._path(key)
+        return p if os.path.exists(p) else None
+
+    # -- strings / data ----------------------------------------------------
+    def save_string(self, s: str, key: Sequence[Any]) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(s)
+        os.replace(tmp, dst)
+
+    def load_string(self, key: Sequence[Any]) -> Optional[str]:
+        p = self.file_path(key)
+        if p is None:
+            return None
+        with open(p) as f:
+            return f.read()
+
+    def save_data(self, value: Any, key: Sequence[Any]) -> None:
+        self.save_string(json.dumps(value, default=str), key)
+
+    def load_data(self, key: Sequence[Any]) -> Any:
+        s = self.load_string(key)
+        return None if s is None else json.loads(s)
+
+
+cache = Cache()

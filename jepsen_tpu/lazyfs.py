@@ -1,0 +1,134 @@
+"""lazyfs integration — lose-unfsynced-writes faults via a FUSE filesystem.
+
+Parity: jepsen.lazyfs (jepsen/src/jepsen/lazyfs.clj): clone and build the
+lazyfs C++ FUSE filesystem on each node at a pinned commit (lazyfs.clj:23-29),
+mount a directory through it, and drive faults through its fifo command
+channel — ``lose-unfsynced-writes!`` (243) and ``checkpoint!`` (253).
+Includes the DB wrapper and nemesis (224, 262).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import Session, session
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+
+REPO = "https://github.com/dsrhaslab/lazyfs.git"
+COMMIT = "2902807a2b7a9c0e9a69d8a4e39b9d95e6e57d1b"  # pinned like lazyfs.clj
+DIR = "/opt/jepsen-tpu/lazyfs"
+
+
+@dataclass
+class LazyFS:
+    """A directory mounted through lazyfs on a node."""
+
+    mount_dir: str
+    data_dir: Optional[str] = None
+    fifo: Optional[str] = None
+
+    def __post_init__(self):
+        base = self.mount_dir.rstrip("/")
+        self.data_dir = self.data_dir or base + ".root"
+        self.fifo = self.fifo or base + ".fifo"
+
+
+def install(test, node) -> None:
+    """Clone + build lazyfs (build-on-node, lazyfs.clj:23-49)."""
+    s = session(test, node).sudo()
+    if cu.exists(s, f"{DIR}/lazyfs/build/lazyfs"):
+        return
+    s.env(DEBIAN_FRONTEND="noninteractive").exec(
+        "apt-get", "install", "-y", "git", "g++", "cmake", "libfuse3-dev",
+        "fuse3")
+    s.exec("rm", "-rf", DIR)
+    s.exec("git", "clone", REPO, DIR)
+    s.cd(DIR).exec("git", "checkout", COMMIT)
+    s.cd(f"{DIR}/libs/libpcache").exec("./build.sh")
+    s.cd(f"{DIR}/lazyfs").exec("./build.sh")
+
+
+def config(fs: LazyFS) -> str:
+    return (f"[faults]\nfifo_path=\"{fs.fifo}\"\n"
+            "[cache]\napply_lru_eviction=false\n"
+            "[cache.simple]\ncustom_size=\"1gb\"\nblocks_per_page=1\n")
+
+
+def mount(test, node, fs: LazyFS) -> None:
+    s = session(test, node).sudo()
+    cfg = f"{fs.mount_dir.rstrip('/')}.lazyfs.toml"
+    s.exec("mkdir", "-p", fs.mount_dir, fs.data_dir)
+    cu.write_file(s, config(fs), cfg)
+    cu.start_daemon(
+        s, f"{DIR}/lazyfs/build/lazyfs", fs.mount_dir,
+        "--config-path", cfg, "-o", "allow_other", "-o", "modules=subdir",
+        "-o", f"subdir={fs.data_dir}", "-f",
+        pidfile=fs.mount_dir.rstrip("/") + ".pid",
+        logfile=fs.mount_dir.rstrip("/") + ".log")
+
+
+def umount(test, node, fs: LazyFS) -> None:
+    s = session(test, node).sudo()
+    s.exec_result("fusermount3", "-u", fs.mount_dir)
+    cu.stop_daemon(s, fs.mount_dir.rstrip("/") + ".pid")
+
+
+def fifo_command(test, node, fs: LazyFS, cmd: str) -> None:
+    """Write a command into the lazyfs fifo (lazyfs.clj:218-224)."""
+    s = session(test, node).sudo()
+    s.exec("bash", "-c", f"echo {cmd} > {fs.fifo}")
+
+
+def lose_unfsynced_writes(test, node, fs: LazyFS) -> None:
+    """Drop every page not yet fsynced (lazyfs.clj:243)."""
+    fifo_command(test, node, fs, "lazyfs::clear-cache")
+
+
+def checkpoint(test, node, fs: LazyFS) -> None:
+    """Flush everything to disk (lazyfs.clj:253)."""
+    fifo_command(test, node, fs, "lazyfs::cache-checkpoint")
+
+
+class LazyFSDB(jdb.DB):
+    """Wrap a DB so its data dir lives on lazyfs (lazyfs.clj:224)."""
+
+    def __init__(self, inner: jdb.DB, fs: LazyFS):
+        self.inner = inner
+        self.fs = fs
+
+    def setup(self, test, node):
+        install(test, node)
+        mount(test, node, self.fs)
+        self.inner.setup(test, node)
+
+    def teardown(self, test, node):
+        self.inner.teardown(test, node)
+        umount(test, node, self.fs)
+
+
+class LazyFSNemesis(Nemesis):
+    """Drives lose-unfsynced-writes / checkpoint ops (lazyfs.clj:262)."""
+
+    def __init__(self, lazy_fs: LazyFS):
+        self.lazy_fs = lazy_fs
+
+    def invoke(self, test, op: Op) -> Op:
+        from jepsen_tpu.nemesis.faults import pick_nodes
+        targets = pick_nodes(test, op.value)
+        if op.f == "lose-unfsynced-writes":
+            for n in targets:
+                lose_unfsynced_writes(test, n, self.lazy_fs)
+        elif op.f == "checkpoint":
+            for n in targets:
+                checkpoint(test, n, self.lazy_fs)
+        else:
+            raise ValueError(f"lazyfs nemesis doesn't handle f={op.f!r}")
+        return op.with_(type="info", value=sorted(targets))
+
+    def fs(self):
+        return ["lose-unfsynced-writes", "checkpoint"]

@@ -1,0 +1,132 @@
+"""Membership nemesis — cluster join/leave churn as a state machine.
+
+Parity: jepsen.nemesis.membership + membership.state
+(jepsen/src/jepsen/nemesis/membership.clj:1-60, membership/state.clj:20):
+a database-specific :class:`State` answers how to view the cluster from a
+node, how to merge node views, which membership ops are possible, and how
+to apply/resolve them; the nemesis keeps a merged view fresh by polling and
+drives ops from the possible-op stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.control import on_nodes
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import Nemesis
+
+
+class State:
+    """Database-specific membership logic (membership/state.clj:20)."""
+
+    def setup(self, test) -> "State":
+        return self
+
+    def node_view(self, test, node) -> Any:
+        """This node's view of the cluster (may be None if unreachable)."""
+        raise NotImplementedError
+
+    def merge_views(self, test, views: Dict[str, Any]) -> Any:
+        """Combine per-node views into one cluster view."""
+        raise NotImplementedError
+
+    def possible_ops(self, test, view) -> List[Dict[str, Any]]:
+        """Ops the nemesis could do now, e.g. [{'f': 'remove-node', ...}]."""
+        raise NotImplementedError
+
+    def apply_op(self, test, view, op: Op) -> Op:
+        """Perform a membership change; return the completion op."""
+        raise NotImplementedError
+
+    def resolved(self, test, view, op: Op) -> bool:
+        """Has this op's effect converged in the view?"""
+        return True
+
+    def teardown(self, test) -> None:
+        pass
+
+
+class MembershipNemesis(Nemesis):
+    """Polls node views on a background thread; invokes membership ops
+    against the current merged view (membership.clj)."""
+
+    def __init__(self, state: State, poll_interval_s: float = 1.0):
+        self.state = state
+        self.poll_interval_s = poll_interval_s
+        self.view: Any = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.pending: List[Op] = []
+
+    # -- view maintenance --------------------------------------------------
+    def _refresh(self, test) -> None:
+        def nv(t, node):
+            try:
+                return self.state.node_view(t, node)
+            except Exception:  # noqa: BLE001
+                return None
+
+        views = on_nodes(test, nv)
+        merged = self.state.merge_views(test, views)
+        with self._lock:
+            self.view = merged
+            self.pending = [op for op in self.pending
+                            if not self.state.resolved(test, merged, op)]
+
+    def _poll_loop(self, test) -> None:
+        while not self._stop.is_set():
+            try:
+                self._refresh(test)
+            except Exception:  # noqa: BLE001
+                pass
+            self._stop.wait(self.poll_interval_s)
+
+    # -- nemesis protocol --------------------------------------------------
+    def setup(self, test):
+        self.state = self.state.setup(test)
+        self._refresh(test)
+        self._thread = threading.Thread(
+            target=self._poll_loop, args=(test,), daemon=True,
+            name="membership-poll")
+        self._thread.start()
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        with self._lock:
+            view = self.view
+        res = self.state.apply_op(test, view, op)
+        with self._lock:
+            self.pending.append(res)
+        return res
+
+    def teardown(self, test):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.state.teardown(test)
+
+    def fs(self):
+        return None  # handles whatever the state's possible_ops emit
+
+    # -- generator ---------------------------------------------------------
+    def op_stream(self, test):
+        """A generator function yielding possible membership ops."""
+        import random
+
+        def one():
+            with self._lock:
+                view = self.view
+            ops = self.state.possible_ops(test, view) if view is not None \
+                else []
+            if not ops:
+                return None
+            d = dict(random.choice(ops))
+            d.setdefault("type", "info")
+            return d
+
+        from jepsen_tpu import generator as gen
+        return gen.FnGen(one)

@@ -1,0 +1,58 @@
+"""Auto-reconnecting connection wrapper.
+
+Parity: jepsen.reconnect (jepsen/src/jepsen/reconnect.clj:17-151): wraps a
+flaky connection with an RW lock; operations share the connection, errors
+close it, and the next caller reopens.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+
+class Wrapper:
+    def __init__(self, open_fn: Callable[[], Any],
+                 close_fn: Callable[[Any], None] = lambda c: None,
+                 log_name: str = "conn"):
+        self.open_fn = open_fn
+        self.close_fn = close_fn
+        self.log_name = log_name
+        self._conn: Optional[Any] = None
+        self._lock = threading.RLock()
+
+    def conn(self) -> Any:
+        with self._lock:
+            if self._conn is None:
+                self._conn = self.open_fn()
+            return self._conn
+
+    def reopen(self) -> None:
+        with self._lock:
+            self.close()
+            self._conn = self.open_fn()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self.close_fn(self._conn)
+                finally:
+                    self._conn = None
+
+    def with_conn(self, f: Callable[[Any], Any], retries: int = 1,
+                  backoff_s: float = 0.0) -> Any:
+        """Run ``f(conn)``; on error, drop the connection so the next call
+        reconnects, optionally retrying here."""
+        attempts = retries + 1
+        last: Optional[Exception] = None
+        for i in range(attempts):
+            try:
+                return f(self.conn())
+            except Exception as e:  # noqa: BLE001
+                last = e
+                self.close()
+                if backoff_s and i + 1 < attempts:
+                    time.sleep(backoff_s)
+        raise last
