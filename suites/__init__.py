@@ -1,0 +1,6 @@
+"""Database test suites — consumers of the whole framework.
+
+Parity: the reference's per-database projects (zookeeper/, consul/, tidb/,
+etc. — SURVEY.md §2.5): each suite provides a DB (install/start/stop),
+clients, a workload registry, nemesis options, and a CLI entry point.
+"""

@@ -1,0 +1,8 @@
+"""Demo suite — a complete, in-process test target that runs anywhere.
+
+Plays the role of the reference's canonical noop/tutorial tests
+(jepsen/src/jepsen/tests.clj:13-26 noop-test, doc/tutorial): a mock
+replicated register with injectable consistency bugs, so the whole pipeline
+(generator -> interpreter -> history -> TPU checker -> store) runs with no
+cluster, and seeded bugs are provably caught.
+"""

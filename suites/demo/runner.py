@@ -1,0 +1,105 @@
+"""Demo suite: mock replicated register, optional seeded bugs.
+
+    python -m suites.demo.runner test --dummy-ssh --time-limit 5
+    python -m suites.demo.runner test --dummy-ssh --bug stale-reads
+
+The mock "database" is an in-process register per key with a configurable
+consistency bug; the checker must return valid for the honest store and
+invalid when a bug is seeded.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import cli, client as jclient, generator as gen
+from jepsen_tpu.checker import Stats, compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.history import FAIL, OK
+from jepsen_tpu.workloads import linearizable_register
+
+
+class MockStore:
+    """Shared 'replicated' register map with injectable bugs."""
+
+    def __init__(self, bug: Optional[str] = None):
+        self.regs: Dict[Any, Any] = {}
+        self.lock = threading.Lock()
+        self.bug = bug
+        self.history_of: Dict[Any, list] = {}
+
+    def apply(self, op):
+        k, v = op.value
+        with self.lock:
+            cur = self.regs.get(k)
+            if op.f == "read":
+                out = cur
+                if self.bug == "stale-reads" and random.random() < 0.05:
+                    past = self.history_of.get(k) or [None]
+                    out = past[max(0, len(past) - 3)]
+                return op.with_(type=OK, value=(k, out))
+            if op.f == "write":
+                self.regs[k] = v
+                self.history_of.setdefault(k, []).append(v)
+                return op.with_(type=OK)
+            old, new = v
+            if self.bug == "phantom-cas" and random.random() < 0.03:
+                return op.with_(type=OK)  # claims success, did nothing
+            if cur == old:
+                self.regs[k] = new
+                self.history_of.setdefault(k, []).append(new)
+                return op.with_(type=OK)
+            return op.with_(type=FAIL)
+
+
+class MockClient(jclient.Client):
+    def __init__(self, store: MockStore):
+        self.store = store
+        self.reusable = True
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        return self.store.apply(op)
+
+
+def demo_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    bug = opts.get("bug") or None
+    if bug == "none":
+        bug = None
+    store = MockStore(bug=bug)
+    keys = int(opts.get("keys", 4))
+    wl = linearizable_register.workload(
+        keys=range(keys),
+        ops_per_key=int(opts.get("ops_per_key", 150)),
+        threads_per_key=2,
+        algorithm=opts.get("algorithm"))
+    time_limit = float(opts.get("time_limit", 30.0))
+    return {**opts,
+            "name": f"demo-register{'-' + bug if bug else ''}",
+            "client": MockClient(store),
+            "generator": gen.time_limit(time_limit,
+                                        gen.clients(wl["generator"])),
+            "checker": compose({"stats": Stats(),
+                                "workload": wl["checker"],
+                                "perf": Perf(),
+                                "timeline": Timeline()})}
+
+
+def _suite_opts(parser):
+    parser.add_argument("--bug", default="none",
+                        choices=["none", "stale-reads", "phantom-cas"])
+    parser.add_argument("--keys", type=int, default=4)
+    parser.add_argument("--ops-per-key", type=int, default=150)
+    parser.add_argument("--algorithm", default=None,
+                        choices=[None, "tpu", "cpu", "competition"])
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli.single_test_cmd(demo_test, opt_fn=_suite_opts,
+                                 prog="jepsen-tpu-demo"))

@@ -1,0 +1,9 @@
+"""etcd test suite — the canonical small real-database target.
+
+Plays the role of the reference's zookeeper suite
+(zookeeper/src/jepsen/zookeeper.clj:112-143, the minimal canonical suite and
+BASELINE config #2) and consul's CAS-register competition checker
+(consul/src/jepsen/consul/register.clj:72): a linearizable-register workload
+against a real consensus store, faults included, verdict from the device
+engine.
+"""

@@ -1,0 +1,135 @@
+"""etcd clients over the v3 JSON/gRPC gateway (stdlib urllib only).
+
+Register ops use etcd transactions for CAS (the same op language as the
+reference's zookeeper/consul register clients:
+zookeeper/src/jepsen/zookeeper.clj:91-104).  Values are (key, value) tuples
+from the independent lift.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+CLIENT_PORT = 2379
+
+
+def b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+class EtcdError(Exception):
+    pass
+
+
+class EtcdConn:
+    def __init__(self, node: str, timeout: float = 5.0):
+        self.base = f"http://{node}:{CLIENT_PORT}"
+        self.timeout = timeout
+
+    def call(self, path: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.load(r)
+
+    def get(self, key: str) -> Optional[str]:
+        r = self.call("/v3/kv/range", {"key": b64(key)})
+        kvs = r.get("kvs") or []
+        return unb64(kvs[0]["value"]) if kvs else None
+
+    def put(self, key: str, value: str) -> None:
+        self.call("/v3/kv/put", {"key": b64(key), "value": b64(value)})
+
+    def cas(self, key: str, old: str, new: str) -> bool:
+        """Transactional compare-and-set."""
+        r = self.call("/v3/kv/txn", {
+            "compare": [{"key": b64(key), "target": "VALUE",
+                         "value": b64(old), "result": "EQUAL"}],
+            "success": [{"requestPut": {"key": b64(key),
+                                        "value": b64(new)}}],
+        })
+        return bool(r.get("succeeded"))
+
+
+class RegisterClient(jclient.Client):
+    """Linearizable per-key register ops: read / write / cas."""
+
+    def __init__(self, conn: Optional[EtcdConn] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(EtcdConn(node))
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        key = f"jt/r/{k}"
+        try:
+            if op.f == "read":
+                cur = self.conn.get(key)
+                return op.with_(type=OK,
+                                value=(k, int(cur) if cur is not None
+                                       else None))
+            if op.f == "write":
+                self.conn.put(key, str(v))
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                ok = self.conn.cas(key, str(old), str(new))
+                return op.with_(type=OK if ok else FAIL)
+            raise ValueError(op.f)
+        except (urllib.error.URLError, socket.timeout, TimeoutError,
+                ConnectionError) as e:
+            # Reads that fail definitely didn't happen; mutations are
+            # indeterminate (the op may have been applied).
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+
+class SetClient(jclient.Client):
+    """Grow-only set as one key holding a JSON list, updated with CAS
+    retry loops."""
+
+    def __init__(self, conn: Optional[EtcdConn] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SetClient(EtcdConn(node))
+
+    def invoke(self, test, op: Op) -> Op:
+        key = "jt/set"
+        try:
+            if op.f == "read":
+                cur = self.conn.get(key)
+                return op.with_(type=OK,
+                                value=json.loads(cur) if cur else [])
+            if op.f == "add":
+                for _ in range(16):
+                    cur = self.conn.get(key)
+                    if cur is None:
+                        self.conn.put(key, json.dumps([op.value]))
+                        return op.with_(type=OK)
+                    items = json.loads(cur)
+                    items.append(op.value)
+                    if self.conn.cas(key, cur, json.dumps(items)):
+                        return op.with_(type=OK)
+                return op.with_(type=FAIL, error="cas-retries-exhausted")
+            raise ValueError(op.f)
+        except (urllib.error.URLError, socket.timeout, TimeoutError,
+                ConnectionError) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

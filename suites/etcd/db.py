@@ -1,0 +1,100 @@
+"""etcd cluster install/start/stop on test nodes.
+
+Same responsibilities as the reference suites' db namespaces (e.g.
+zookeeper/src/jepsen/zookeeper.clj's db, tidb/src/tidb/db.clj): download the
+release, render config, run as a daemon, implement Kill/Pause/Primary/
+LogFiles capabilities for the nemesis packages and log snarfing.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "3.5.17"
+URL = ("https://github.com/etcd-io/etcd/releases/download/"
+       f"v{VERSION}/etcd-v{VERSION}-linux-amd64.tar.gz")
+DIR = "/opt/etcd"
+DATA_DIR = "/opt/etcd/data"
+PIDFILE = "/var/run/etcd.pid"
+LOGFILE = "/var/log/etcd.log"
+CLIENT_PORT = 2379
+PEER_PORT = 2380
+
+
+def node_url(node: str, port: int) -> str:
+    return f"http://{node}:{port}"
+
+
+def initial_cluster(test) -> str:
+    return ",".join(f"{n}={node_url(n, PEER_PORT)}" for n in test["nodes"])
+
+
+class EtcdDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        self.start(test, node)
+        cu.await_tcp_port(s, CLIENT_PORT, timeout_s=60)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("rm", "-rf", DATA_DIR, LOGFILE)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(
+            s, f"{DIR}/etcd",
+            "--name", node,
+            "--data-dir", DATA_DIR,
+            "--listen-client-urls", f"http://0.0.0.0:{CLIENT_PORT}",
+            "--advertise-client-urls", node_url(node, CLIENT_PORT),
+            "--listen-peer-urls", f"http://0.0.0.0:{PEER_PORT}",
+            "--initial-advertise-peer-urls", node_url(node, PEER_PORT),
+            "--initial-cluster", initial_cluster(test),
+            "--initial-cluster-state", "new",
+            "--snapshot-count", "10000",
+            pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "etcd", signal="KILL")
+        s.exec("rm", "-f", PIDFILE)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "etcd", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "etcd", "CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        import urllib.request
+        for node in test["nodes"]:
+            try:
+                req = urllib.request.Request(
+                    node_url(node, CLIENT_PORT) + "/v3/maintenance/status",
+                    data=b"{}", headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=2) as r:
+                    st = json.load(r)
+                leader = st.get("leader")
+                member = st.get("header", {}).get("member_id")
+                if leader and leader == member:
+                    return [node]
+            except Exception:  # noqa: BLE001
+                continue
+        return []
+
+    def setup_primary(self, test, node):
+        pass  # etcd elects its own leader
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

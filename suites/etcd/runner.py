@@ -1,0 +1,109 @@
+"""etcd suite CLI — workload x nemesis registry and test construction.
+
+Same shape as the reference's suite mains (tidb/src/tidb/core.clj:32-80's
+workload registry + sweep matrices, zookeeper.clj:112-143's test fn):
+
+    python -m suites.etcd.runner test --node n1 ... --workload register \
+        --nemesis partition
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu import cli, generator as gen
+from jepsen_tpu import os as jos
+from jepsen_tpu.checker import Stats, compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.workloads import linearizable_register, sets
+
+from suites.etcd.client import RegisterClient, SetClient
+from suites.etcd.db import EtcdDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 200)),
+        threads_per_key=2)
+    return {**wl, "client": RegisterClient()}
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    wl = sets.workload()
+    return {"client": SetClient(),
+            "generator": wl["generator"],
+            "final_generator": wl["final_generator"],
+            "checker": wl["checker"]}
+
+
+WORKLOADS = {"register": register_workload, "set": set_workload}
+
+NEMESES = {
+    "none": lambda opts: combined.Package(),
+    "partition": lambda opts: combined.partition_package(opts),
+    "kill": lambda opts: combined.db_package({**opts, "faults": ["kill"]}),
+    "pause": lambda opts: combined.db_package({**opts, "faults": ["pause"]}),
+    "clock": lambda opts: combined.clock_package(opts),
+    "all": lambda opts: combined.nemesis_package(
+        {**opts, "faults": ["partition", "kill", "pause", "clock"]}),
+}
+
+
+def etcd_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    workload_name = opts.get("workload", "register")
+    nemesis_name = opts.get("nemesis", "partition")
+    wl = WORKLOADS[workload_name](opts)
+    pkg = NEMESES[nemesis_name](
+        {"interval": float(opts.get("nemesis_interval", 10.0))})
+
+    time_limit = float(opts.get("time_limit", 60.0))
+    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    parts = [client_gen]
+    if pkg.generator is not None:
+        parts = [gen.any_gen(client_gen,
+                             gen.nemesis(gen.time_limit(time_limit,
+                                                        pkg.generator)))]
+    if pkg.final_generator is not None:
+        parts.append(gen.nemesis(gen.lift(pkg.final_generator)))
+    if wl.get("final_generator") is not None:
+        parts.append(gen.clients(gen.lift(wl["final_generator"])))
+
+    return {**opts,
+            "name": f"etcd-{workload_name}-{nemesis_name}",
+            "os": jos.Debian(),
+            "db": EtcdDB(),
+            "client": wl["client"],
+            "nemesis": pkg.nemesis,
+            "generator": parts,
+            "checker": compose({"stats": Stats(),
+                                "workload": wl["checker"],
+                                "perf": Perf(),
+                                "timeline": Timeline()})}
+
+
+def all_tests(opts: Dict[str, Any]):
+    """Sweep matrix: workloads x nemeses (tidb/core.clj:47-80 pattern)."""
+    out = []
+    for w in opts.get("workloads", list(WORKLOADS)):
+        for n in opts.get("nemeses", list(NEMESES)):
+            out.append(etcd_test({**opts, "workload": w, "nemesis": n}))
+    return out
+
+
+def _suite_opts(parser):
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(WORKLOADS))
+    parser.add_argument("--nemesis", default="partition",
+                        choices=sorted(NEMESES))
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=200)
+    parser.add_argument("--nemesis-interval", type=float, default=10.0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli.single_test_cmd(etcd_test, opt_fn=_suite_opts,
+                                 prog="jepsen-tpu-etcd"))

@@ -1,0 +1,77 @@
+"""Suites: demo runs end-to-end in process; etcd suite's control-plane
+actions are verified against the record-only remote."""
+
+import json
+
+import pytest
+
+from jepsen_tpu import control, core
+from suites.demo.runner import demo_test
+from suites.etcd import runner as etcd_runner
+from suites.etcd.db import EtcdDB, initial_cluster
+
+
+class TestDemoSuite:
+    def base(self, tmp_path, **kw):
+        opts = {"nodes": [], "concurrency": 6,
+                "store_base": str(tmp_path / "store"),
+                "time_limit": 5.0, "ops_per_key": 60, "keys": 3,
+                "algorithm": "cpu"}
+        opts.update(kw)
+        return opts
+
+    def test_honest_store_valid(self, tmp_path):
+        t = core.run(demo_test(self.base(tmp_path)))
+        assert t["results"]["valid"] is True
+        assert t["results"]["workload"]["key-count"] == 3
+
+    def test_stale_reads_detected(self, tmp_path):
+        t = core.run(demo_test(self.base(tmp_path, bug="stale-reads")))
+        assert t["results"]["valid"] is False
+        assert t["results"]["workload"]["failures"]
+
+    def test_phantom_cas_detected(self, tmp_path):
+        t = core.run(demo_test(self.base(tmp_path, bug="phantom-cas",
+                                         ops_per_key=120)))
+        assert t["results"]["valid"] is False
+
+
+class TestEtcdSuite:
+    def test_initial_cluster_string(self):
+        t = {"nodes": ["n1", "n2"]}
+        assert initial_cluster(t) == \
+            "n1=http://n1:2380,n2=http://n2:2380"
+
+    def test_test_construction(self):
+        t = etcd_runner.etcd_test({"nodes": ["n1", "n2", "n3"],
+                                   "workload": "register",
+                                   "nemesis": "partition",
+                                   "time_limit": 1.0})
+        assert t["name"] == "etcd-register-partition"
+        assert t["db"] is not None and t["nemesis"] is not None
+
+    def test_sweep_matrix(self):
+        ts = etcd_runner.all_tests({"nodes": ["n1"],
+                                    "workloads": ["register"],
+                                    "nemeses": ["none", "partition"]})
+        assert [t["name"] for t in ts] == ["etcd-register-none",
+                                           "etcd-register-partition"]
+
+    def test_db_control_commands(self):
+        """DB lifecycle issues the right control commands (record-only)."""
+        t = {"nodes": ["n1", "n2", "n3"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        db = EtcdDB()
+        db.start(t, "n1")
+        db.kill(t, "n1")
+        db.pause(t, "n2")
+        db.resume(t, "n2")
+        db.teardown(t, "n3")
+        log = "\n".join(t["remote"].log)
+        assert "--initial-cluster n1=http://n1:2380" in log
+        assert "pkill -KILL -f etcd" in log
+        assert "killall -STOP etcd" in log
+        assert "killall -CONT etcd" in log
+        assert "rm -rf /opt/etcd/data" in log
+        control.teardown_sessions(t)
