@@ -1,0 +1,127 @@
+// jepsen-tpu binary store format — append-only CRC32-checked blocks.
+//
+// Plays the role of the reference's custom .jepsen block file + positioned
+// output stream (store/format.clj, FileOffsetOutputStream.java):
+// crash-safe appends for larger-than-memory histories.  Independent design:
+//
+//   file   := magic blocks*            magic = "JTSF0001" (8 bytes)
+//   block  := len:u32le crc:u32le tag:u8 payload[len]
+//             crc = CRC32(tag || payload)
+//
+// Built as a shared library (ctypes); the Python side falls back to a pure
+// implementation of the same format when no compiler is available.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+const char MAGIC[8] = {'J', 'T', 'S', 'F', '0', '0', '0', '1'};
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32_update(uint32_t crc, const uint8_t *buf, size_t len) {
+  crc_init();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open for append; writes the magic if the file is new/empty.
+// Returns a FILE* handle as void*, or null on failure.
+void *jtsf_open(const char *path) {
+  FILE *f = std::fopen(path, "ab+");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  if (std::ftell(f) == 0) {
+    if (std::fwrite(MAGIC, 1, 8, f) != 8) {
+      std::fclose(f);
+      return nullptr;
+    }
+    std::fflush(f);
+  }
+  return f;
+}
+
+// Append one block; returns 0 on success.
+int jtsf_append(void *handle, uint8_t tag, const uint8_t *data,
+                uint32_t len) {
+  FILE *f = static_cast<FILE *>(handle);
+  uint32_t crc = crc32_update(0, &tag, 1);
+  crc = crc32_update(crc, data, len);
+  uint8_t hdr[9];
+  std::memcpy(hdr, &len, 4);
+  std::memcpy(hdr + 4, &crc, 4);
+  hdr[8] = tag;
+  if (std::fwrite(hdr, 1, 9, f) != 9) return 1;
+  if (len && std::fwrite(data, 1, len, f) != len) return 1;
+  return 0;
+}
+
+int jtsf_flush(void *handle) {
+  return std::fflush(static_cast<FILE *>(handle));
+}
+
+int jtsf_close(void *handle) {
+  return std::fclose(static_cast<FILE *>(handle));
+}
+
+// Verify a whole file's structure and checksums.
+// Returns the number of valid blocks, or -1 - <block#> on first corruption.
+long jtsf_verify(const char *path) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[8];
+  if (std::fread(magic, 1, 8, f) != 8 || std::memcmp(magic, MAGIC, 8)) {
+    std::fclose(f);
+    return -1;
+  }
+  long n = 0;
+  for (;;) {
+    uint8_t hdr[9];
+    size_t got = std::fread(hdr, 1, 9, f);
+    if (got == 0) break;
+    if (got != 9) { std::fclose(f); return -1 - n; }
+    uint32_t len, crc;
+    std::memcpy(&len, hdr, 4);
+    std::memcpy(&crc, hdr + 4, 4);
+    uint32_t actual = crc32_update(0, hdr + 8, 1);
+    const size_t CH = 1 << 20;
+    static uint8_t buf[1 << 20];
+    uint32_t left = len;
+    while (left) {
+      size_t want = left < CH ? left : CH;
+      if (std::fread(buf, 1, want, f) != want) { std::fclose(f); return -1 - n; }
+      actual = crc32_update(actual, buf, want);
+      left -= want;
+    }
+    if (actual != crc) { std::fclose(f); return -1 - n; }
+    n++;
+  }
+  std::fclose(f);
+  return n;
+}
+
+uint32_t jtsf_crc32(const uint8_t *data, uint32_t len) {
+  return crc32_update(0, data, len);
+}
+
+}  // extern "C"

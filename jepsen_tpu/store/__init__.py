@@ -81,9 +81,16 @@ def save_0(test: Dict[str, Any]) -> str:
 
 
 def save_1(test: Dict[str, Any], history: History) -> None:
-    """Phase 1: persist the history right after the run (store.clj:422)."""
+    """Phase 1: persist the history right after the run (store.clj:422),
+    in both JSONL (greppable) and the CRC32 block format (crash-safe,
+    lazily readable — store/format.py)."""
     d = test["store_dir"]
     history.to_jsonl(os.path.join(d, "history.jsonl"))
+    try:
+        from jepsen_tpu.store import format as _fmt
+        _fmt.write_history(os.path.join(d, "history.jtsf"), history)
+    except Exception:  # noqa: BLE001 - the JSONL copy is authoritative
+        pass
     try:
         import numpy as np
         cols: Dict[str, Any] = {

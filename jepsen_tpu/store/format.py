@@ -1,0 +1,201 @@
+"""Binary block store — append-only, CRC32-checked, lazily readable.
+
+Parity: the reference's custom .jepsen file format
+(jepsen/src/jepsen/store/format.clj:36-120: magic + checksummed blocks,
+append-only so a crash never corrupts earlier data, lazy reads for
+larger-than-memory histories) and its positioned Java write stream
+(store/FileOffsetOutputStream.java).
+
+Two interchangeable engines writing the identical format:
+- the C++ shared library (native/storefmt.cpp), compiled on demand with g++
+  and loaded via ctypes — the fast path;
+- a pure-Python fallback.
+
+Format:  "JTSF0001" then blocks of [len:u32le][crc:u32le][tag:u8][payload],
+crc = crc32(tag || payload).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+import subprocess
+import tempfile
+import zlib
+from typing import Any, Iterator, List, Optional, Tuple
+
+MAGIC = b"JTSF0001"
+
+TAG_JSON = 1
+TAG_BYTES = 2
+TAG_OPS = 3  # one JSONL chunk of ops
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    """Compile+load the C++ engine (cached .so); None if unavailable."""
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    src = os.path.join(os.path.dirname(__file__), "..", "native",
+                       "storefmt.cpp")
+    cache_dir = os.path.join(tempfile.gettempdir(), "jepsen-tpu-native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so = os.path.join(cache_dir, "libstorefmt.so")
+    try:
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+                           check=True, capture_output=True)
+        lib = ctypes.CDLL(so)
+        lib.jtsf_open.restype = ctypes.c_void_p
+        lib.jtsf_open.argtypes = [ctypes.c_char_p]
+        lib.jtsf_append.restype = ctypes.c_int
+        lib.jtsf_append.argtypes = [ctypes.c_void_p, ctypes.c_uint8,
+                                    ctypes.c_char_p, ctypes.c_uint32]
+        lib.jtsf_flush.argtypes = [ctypes.c_void_p]
+        lib.jtsf_close.argtypes = [ctypes.c_void_p]
+        lib.jtsf_verify.restype = ctypes.c_long
+        lib.jtsf_verify.argtypes = [ctypes.c_char_p]
+        _LIB = lib
+    except (subprocess.CalledProcessError, OSError):
+        _LIB = None
+    return _LIB
+
+
+class Writer:
+    """Append blocks to a store file (native engine when available)."""
+
+    def __init__(self, path: str, native: Optional[bool] = None):
+        self.path = path
+        lib = _native_lib() if native in (None, True) else None
+        if native is True and lib is None:
+            raise RuntimeError("native store engine unavailable")
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.jtsf_open(path.encode())
+            if not self._h:
+                raise OSError(f"can't open {path}")
+            self._f = None
+        else:
+            self._f = open(path, "ab")
+            if self._f.tell() == 0:
+                self._f.write(MAGIC)
+            self._h = None
+
+    @property
+    def engine(self) -> str:
+        return "native" if self._lib is not None else "python"
+
+    def append(self, payload: bytes, tag: int = TAG_BYTES) -> None:
+        if self._lib is not None:
+            rc = self._lib.jtsf_append(self._h, tag, payload, len(payload))
+            if rc != 0:
+                raise OSError("append failed")
+        else:
+            crc = zlib.crc32(bytes([tag]) + payload) & 0xFFFFFFFF
+            self._f.write(struct.pack("<II", len(payload), crc))
+            self._f.write(bytes([tag]))
+            self._f.write(payload)
+
+    def append_json(self, value: Any) -> None:
+        self.append(json.dumps(value, default=str).encode(), TAG_JSON)
+
+    def flush(self) -> None:
+        if self._lib is not None:
+            self._lib.jtsf_flush(self._h)
+        else:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._lib is not None:
+            if self._h:
+                self._lib.jtsf_close(self._h)
+                self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class CorruptBlock(Exception):
+    def __init__(self, index: int):
+        super().__init__(f"corrupt block #{index}")
+        self.index = index
+
+
+def read_blocks(path: str) -> Iterator[Tuple[int, bytes]]:
+    """Lazily yield (tag, payload), verifying CRCs as we go."""
+    with open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            raise CorruptBlock(-1)
+        i = 0
+        while True:
+            hdr = f.read(9)
+            if not hdr:
+                return
+            if len(hdr) != 9:
+                raise CorruptBlock(i)
+            length, crc = struct.unpack("<II", hdr[:8])
+            tag = hdr[8]
+            payload = f.read(length)
+            if len(payload) != length or \
+                    (zlib.crc32(bytes([tag]) + payload) & 0xFFFFFFFF) != crc:
+                raise CorruptBlock(i)
+            yield tag, payload
+            i += 1
+
+
+def verify(path: str) -> int:
+    """Number of valid blocks; raises CorruptBlock on damage.  Uses the
+    native verifier when available."""
+    lib = _native_lib()
+    if lib is not None:
+        n = lib.jtsf_verify(path.encode())
+        if n < 0:
+            raise CorruptBlock(-1 - n)
+        return int(n)
+    return sum(1 for _ in read_blocks(path))
+
+
+# -- history-specific layer --------------------------------------------------
+
+OPS_PER_BLOCK = 1024
+
+
+def write_history(path: str, history, chunk: int = OPS_PER_BLOCK) -> None:
+    """History as a sequence of op-chunk blocks (lazy, append-only)."""
+    with Writer(path) as w:
+        buf: List[str] = []
+        for op in history:
+            buf.append(json.dumps(op.to_dict(), default=str))
+            if len(buf) >= chunk:
+                w.append("\n".join(buf).encode(), TAG_OPS)
+                buf = []
+        if buf:
+            w.append("\n".join(buf).encode(), TAG_OPS)
+
+
+def iter_history(path: str):
+    """Lazily yield op dicts from a history store file."""
+    for tag, payload in read_blocks(path):
+        if tag != TAG_OPS:
+            continue
+        for line in payload.decode().splitlines():
+            if line.strip():
+                yield json.loads(line)
+
+
+def read_history(path: str):
+    from jepsen_tpu.history import History
+    return History(list(iter_history(path)))

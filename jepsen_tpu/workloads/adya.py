@@ -1,0 +1,123 @@
+"""Adya anomaly workloads: G2 (anti-dependency cycles) and dirty updates.
+
+Parity: jepsen.tests.adya (jepsen/src/jepsen/tests/adya.clj:12-87):
+generators that specifically provoke G2 write skew and dirty-update
+anomalies, plus checkers that detect them.
+
+- G2: pairs of transactions each read the other's predicate/key and insert
+  if absent; both succeeding is a write-skew cycle (two rw edges).
+- Dirty update: an update chain built on a value written by an aborted
+  transaction.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.elle import rw_register
+from jepsen_tpu.history import FAIL, History, OK
+
+
+def g2_generator(keys: int = 32):
+    """Each logical attempt: two txns on a key pair (a, b): txn1 reads b,
+    inserts a-if-b-absent; txn2 reads a, inserts b-if-a-absent
+    (adya.clj's g2 generator)."""
+    pair = itertools.count(0)
+
+    def one():
+        p = next(pair) % keys
+        a, b = f"a{p}", f"b{p}"
+        if random.random() < 0.5:
+            return {"f": "txn", "value": [["r", b, None], ["w", a, p]],
+                    "pair": p}
+        return {"f": "txn", "value": [["r", a, None], ["w", b, p]],
+                "pair": p}
+
+    return gen.FnGen(one)
+
+
+class G2Checker(Checker):
+    """Both halves of a G2 pair succeeded with each reading the other's key
+    as absent -> write skew (adya.clj's g2 checker; also derivable from the
+    general rw-cycle engine)."""
+
+    def check(self, test, history: History, opts=None):
+        by_pair: Dict[Any, List] = {}
+        for op in history:
+            if op.type != OK or not isinstance(op.value, (list, tuple)):
+                continue
+            p = op.extra.get("pair")
+            if p is None:
+                continue
+            by_pair.setdefault(p, []).append(op)
+        skews = []
+        for p, ops in by_pair.items():
+            wrote_a = [o for o in ops
+                       if any(f == "w" and str(k).startswith("a")
+                              for f, k, v in o.value)
+                       and all(v is None for f, k, v in o.value
+                               if f == "r")]
+            wrote_b = [o for o in ops
+                       if any(f == "w" and str(k).startswith("b")
+                              for f, k, v in o.value)
+                       and all(v is None for f, k, v in o.value
+                               if f == "r")]
+            if wrote_a and wrote_b:
+                skews.append({"pair": p,
+                              "txns": [wrote_a[0].to_dict(),
+                                       wrote_b[0].to_dict()]})
+        # also run the general cycle detector for corroboration
+        cyc = rw_register.check(history)
+        return {"valid": not skews,
+                "write-skews": skews[:8],
+                "cycle-analysis": {"valid": cyc["valid"],
+                                   "anomaly-types": cyc["anomaly-types"]}}
+
+
+def dirty_update_generator(keys: int = 16):
+    """Update chains: each txn reads k and writes read_value + 1; some
+    writers abort after writing (simulated by the client) — a later update
+    building on an aborted value is dirty (adya.clj dirty-update)."""
+    key = itertools.count(0)
+
+    def one():
+        k = next(key) % keys
+        return {"f": "txn", "value": [["r", k, None], ["w", k, None]],
+                "update": True}
+
+    return gen.FnGen(one)
+
+
+class DirtyUpdateChecker(Checker):
+    """A committed update whose read value was written by an aborted txn
+    (G1a restricted to update chains) — reported with the chain."""
+
+    def check(self, test, history: History, opts=None):
+        aborted = set()
+        for op in history:
+            if op.type == FAIL and isinstance(op.value, (list, tuple)):
+                for f, k, v in op.value:
+                    if f == "w" and v is not None:
+                        aborted.add((k, v))
+        dirty = []
+        for op in history:
+            if op.type != OK or not isinstance(op.value, (list, tuple)):
+                continue
+            for f, k, v in op.value:
+                if f == "r" and v is not None and (k, v) in aborted:
+                    dirty.append({"key": k, "aborted-value": v,
+                                  "txn": op.to_dict()})
+        return {"valid": not dirty, "dirty-updates": dirty[:8]}
+
+
+def g2_workload(keys: int = 32) -> Dict[str, Any]:
+    return {"generator": g2_generator(keys), "checker": G2Checker()}
+
+
+def dirty_update_workload(keys: int = 16) -> Dict[str, Any]:
+    return {"generator": dirty_update_generator(keys),
+            "checker": DirtyUpdateChecker()}

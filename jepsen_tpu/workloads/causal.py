@@ -1,0 +1,103 @@
+"""Causal-consistency workloads: causal register and causal-reverse.
+
+Parity:
+- jepsen.tests.causal (jepsen/src/jepsen/tests/causal.clj): a causal
+  register model — ops carry [k, v] where a read's expected value encodes
+  its causal predecessor; the checker walks the history asserting each op's
+  causal preconditions.
+- jepsen.tests.causal-reverse (causal_reverse.clj:21-114): strict
+  serializability's write-precedence — if w1 completes before w2 begins in
+  real time, no read may observe w2's effect while missing w1's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History, INVOKE, OK, Op
+from jepsen_tpu.models.base import Model, inconsistent
+
+
+@dataclass(frozen=True)
+class CausalRegister(Model):
+    """A register where each write's value must be exactly one greater than
+    the last value this session observed — reads carry the causally-expected
+    value (causal.clj:13-27's CausalRegister)."""
+
+    value: int = 0
+
+    def step(self, op: Op):
+        if op.f == "write":
+            if op.value == self.value + 1:
+                return CausalRegister(op.value)
+            return inconsistent(
+                f"write {op.value} out of causal order after {self.value}")
+        if op.f in ("read", "read-init"):
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(
+                f"read {op.value}, causally expected {self.value}")
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+class CausalChecker(Checker):
+    """Sequentially step the model through completed client ops in history
+    order (the causal checker pattern of causal.clj)."""
+
+    def __init__(self, model: Optional[Model] = None):
+        self.model = model or CausalRegister()
+
+    def check(self, test, history: History, opts=None):
+        from jepsen_tpu.models.base import Inconsistent
+        m = self.model
+        for op in history.complete():
+            if op.type != INVOKE or op.process == "nemesis":
+                continue
+            m2 = m.step(op)
+            if isinstance(m2, Inconsistent):
+                return {"valid": False, "error": m2.msg, "op": op.to_dict()}
+            m = m2
+        return {"valid": True, "final": repr(m)}
+
+
+class CausalReverseChecker(Checker):
+    """Write-precedence for strict serializability (causal_reverse.clj):
+    writes of unique values to one key; reads return the list of values in
+    write order.  If w(a) completed before w(b) was invoked, then any read
+    containing b must also contain a (and before it)."""
+
+    def check(self, test, history: History, opts=None):
+        pairs = history.pair_index()
+        w_done: Dict[Any, int] = {}     # value -> completion index
+        w_begin: Dict[Any, int] = {}    # value -> invocation index
+        for i, op in enumerate(history):
+            if op.f == "w" or op.f == "write":
+                if op.type == INVOKE:
+                    w_begin[op.value] = i
+                elif op.type == OK:
+                    j = pairs[i]
+                    v = history[j].value if j >= 0 else op.value
+                    w_done[v] = i
+        errors = []
+        for op in history:
+            if op.f not in ("read", "r") or op.type != OK or \
+                    not isinstance(op.value, (list, tuple)):
+                continue
+            seen = list(op.value)
+            pos = {v: i for i, v in enumerate(seen)}
+            for b in seen:
+                for a, done_i in w_done.items():
+                    if a == b:
+                        continue
+                    begin_b = w_begin.get(b)
+                    if begin_b is not None and done_i < begin_b:
+                        # a strictly precedes b in real time
+                        if a not in pos:
+                            errors.append({"missing": a, "saw": b,
+                                           "read": op.to_dict()})
+                        elif pos[a] > pos[b]:
+                            errors.append({"reversed": [a, b],
+                                           "read": op.to_dict()})
+        return {"valid": not errors, "errors": errors[:8]}

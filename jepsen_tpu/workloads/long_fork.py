@@ -1,0 +1,80 @@
+"""Long-fork detection — the parallel-snapshot-isolation anomaly.
+
+Parity: jepsen.tests.long-fork (jepsen/src/jepsen/tests/long_fork.clj):
+writers update distinct keys with unique values; readers read groups of
+keys.  Under PSI, two readers may observe two writes in *opposite* orders —
+the "long fork".  Detection: for writes w(x) and w(y) (distinct keys), a
+reader r1 seeing x-written but y-unwritten and a reader r2 seeing
+y-written but x-unwritten form a fork: no single order of w(x), w(y) can
+explain both.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History, OK
+from jepsen_tpu.txn import READ_FS, WRITE_FS
+
+
+def generator(group_size: int = 2, keys_per_group: Optional[int] = None):
+    """Write txns touch one key; read txns read a whole key group
+    (long_fork.clj's generator shape)."""
+    keys_per_group = keys_per_group or group_size
+    counter = itertools.count(1)
+    group = itertools.count(0)
+
+    def one():
+        g = next(group) % 4
+        base = g * keys_per_group
+        ks = list(range(base, base + keys_per_group))
+        if random.random() < 0.5:
+            k = random.choice(ks)
+            return {"f": "txn", "value": [["w", k, next(counter)]]}
+        return {"f": "txn", "value": [["r", k, None] for k in ks]}
+
+    return gen.FnGen(one)
+
+
+class LongForkChecker(Checker):
+    def check(self, test, history: History, opts=None):
+        # collect ok read-only txns and the write of each (key, value)
+        reads: List[Dict[Any, Any]] = []
+        for op in history:
+            if op.type != OK or not isinstance(op.value, (list, tuple)):
+                continue
+            mops = op.value
+            if all(f in READ_FS for f, _, _ in mops):
+                reads.append({k: v for f, k, v in mops})
+
+        forks = []
+        for i, r1 in enumerate(reads):
+            for r2 in reads[i + 1:]:
+                shared = [k for k in r1 if k in r2]
+                # find keys x,y where r1 has x but not y, r2 has y but not x
+                for x in shared:
+                    for y in shared:
+                        if x == y:
+                            continue
+                        if (r1[x] is not None and r1[y] is None and
+                                r2[x] is None and r2[y] is not None):
+                            forks.append({"r1": r1, "r2": r2,
+                                          "keys": [x, y]})
+                if len(forks) > 8:
+                    break
+            if len(forks) > 8:
+                break
+        if not reads:
+            return {"valid": UNKNOWN, "error": "no read transactions"}
+        return {"valid": not forks, "reads": len(reads),
+                "forks": forks[:8]}
+
+
+def workload(group_size: int = 2) -> Dict[str, Any]:
+    return {"generator": generator(group_size),
+            "checker": LongForkChecker()}

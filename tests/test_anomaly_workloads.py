@@ -1,0 +1,110 @@
+"""Long-fork, causal, causal-reverse, and Adya anomaly checkers."""
+
+import pytest
+
+from jepsen_tpu.history import FAIL, History, INVOKE, OK, Op
+from jepsen_tpu.workloads.adya import DirtyUpdateChecker, G2Checker
+from jepsen_tpu.workloads.causal import (
+    CausalChecker, CausalRegister, CausalReverseChecker,
+)
+from jepsen_tpu.workloads.long_fork import LongForkChecker
+
+
+def ok_txn(process, value, **extra):
+    inv = Op(process=process, type=INVOKE, f="txn", value=value, extra=extra)
+    return [inv, Op(process=process, type=OK, f="txn", value=value,
+                    extra=extra)]
+
+
+class TestLongFork:
+    def test_fork_detected(self):
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(1, [["w", "y", 1]]) +
+            ok_txn(2, [["r", "x", 1], ["r", "y", None]]) +
+            ok_txn(3, [["r", "x", None], ["r", "y", 1]]))
+        r = LongForkChecker().check({}, h)
+        assert r["valid"] is False
+        assert r["forks"]
+
+    def test_consistent_reads_ok(self):
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(2, [["r", "x", 1], ["r", "y", None]]) +
+            ok_txn(1, [["w", "y", 1]]) +
+            ok_txn(3, [["r", "x", 1], ["r", "y", 1]]))
+        assert LongForkChecker().check({}, h)["valid"] is True
+
+
+class TestCausal:
+    def test_causal_register_ok(self):
+        h = History([
+            Op(process=0, type=INVOKE, f="write", value=1),
+            Op(process=0, type=OK, f="write", value=1),
+            Op(process=0, type=INVOKE, f="read", value=1),
+            Op(process=0, type=OK, f="read", value=1),
+            Op(process=0, type=INVOKE, f="write", value=2),
+            Op(process=0, type=OK, f="write", value=2),
+        ])
+        assert CausalChecker().check({}, h)["valid"] is True
+
+    def test_causal_violation(self):
+        h = History([
+            Op(process=0, type=INVOKE, f="write", value=1),
+            Op(process=0, type=OK, f="write", value=1),
+            Op(process=0, type=INVOKE, f="read", value=0),
+            Op(process=0, type=OK, f="read", value=0),
+        ])
+        r = CausalChecker().check({}, h)
+        assert r["valid"] is False
+
+    def test_causal_reverse(self):
+        # w(1) completes before w(2) invokes; read sees [2] without 1
+        h = History([
+            Op(process=0, type=INVOKE, f="w", value=1),
+            Op(process=0, type=OK, f="w", value=1),
+            Op(process=1, type=INVOKE, f="w", value=2),
+            Op(process=1, type=OK, f="w", value=2),
+            Op(process=2, type=INVOKE, f="read"),
+            Op(process=2, type=OK, f="read", value=[2]),
+        ])
+        r = CausalReverseChecker().check({}, h)
+        assert r["valid"] is False
+        assert r["errors"][0]["missing"] == 1
+
+    def test_causal_reverse_order_ok(self):
+        h = History([
+            Op(process=0, type=INVOKE, f="w", value=1),
+            Op(process=0, type=OK, f="w", value=1),
+            Op(process=1, type=INVOKE, f="w", value=2),
+            Op(process=1, type=OK, f="w", value=2),
+            Op(process=2, type=INVOKE, f="read"),
+            Op(process=2, type=OK, f="read", value=[1, 2]),
+        ])
+        assert CausalReverseChecker().check({}, h)["valid"] is True
+
+
+class TestAdya:
+    def test_g2_write_skew(self):
+        h = History(
+            ok_txn(0, [["r", "b0", None], ["w", "a0", 0]], pair=0) +
+            ok_txn(1, [["r", "a0", None], ["w", "b0", 0]], pair=0))
+        r = G2Checker().check({}, h)
+        assert r["valid"] is False
+        assert r["write-skews"]
+
+    def test_g2_serialized_ok(self):
+        h = History(
+            ok_txn(0, [["r", "b0", None], ["w", "a0", 0]], pair=0) +
+            ok_txn(1, [["r", "a0", 0], ["w", "b0", 0]], pair=0))
+        assert G2Checker().check({}, h)["valid"] is True
+
+    def test_dirty_update(self):
+        h = History(
+            [Op(process=0, type=INVOKE, f="txn",
+                value=[["w", "k", 5]]),
+             Op(process=0, type=FAIL, f="txn", value=[["w", "k", 5]])] +
+            ok_txn(1, [["r", "k", 5], ["w", "k", 6]]))
+        r = DirtyUpdateChecker().check({}, h)
+        assert r["valid"] is False
+        assert r["dirty-updates"][0]["aborted-value"] == 5
