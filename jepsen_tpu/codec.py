@@ -1,0 +1,81 @@
+"""EDN codec — byte-level (de)serialization for reference interop.
+
+Parity: jepsen.codec (jepsen/src/jepsen/codec.clj): encode/decode values to
+bytes.  We add an EDN *writer* to complement the reader in history.py, so
+histories round-trip with reference-format tooling (history.edn files).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jepsen_tpu.history import History, Op, parse_edn
+
+KEYWORD_KEYS = {"type", "f"}
+
+
+class Keyword:
+    """An EDN keyword (:foo)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f":{self.name}"
+
+
+def to_edn(value: Any) -> str:
+    """Render a Python value as EDN text."""
+    if isinstance(value, Keyword):
+        return f":{value.name}"
+    if value is None:
+        return "nil"
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    if isinstance(value, str):
+        escaped = value.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(value, (int, float)):
+        return repr(value)
+    if isinstance(value, (list, tuple)):
+        return "[" + " ".join(to_edn(v) for v in value) + "]"
+    if isinstance(value, (set, frozenset)):
+        return ("#{" + " ".join(to_edn(v) for v in sorted(value, key=repr))
+                + "}")
+    if isinstance(value, dict):
+        parts = []
+        for k, v in value.items():
+            key = f":{k}" if isinstance(k, str) else to_edn(k)
+            parts.append(f"{key} {to_edn(v)}")
+        return "{" + ", ".join(parts) + "}"
+    return to_edn(repr(value))
+
+
+def op_to_edn(op: Op) -> str:
+    d = op.to_dict()
+    out: dict = {}
+    for k, v in d.items():
+        if k in KEYWORD_KEYS and isinstance(v, str):
+            out[k] = Keyword(v)
+        elif k == "process" and v == "nemesis":
+            out[k] = Keyword("nemesis")
+        else:
+            out[k] = v
+    return to_edn(out)
+
+
+def history_to_edn(history: History) -> str:
+    """One op map per line, reference style."""
+    return "\n".join(op_to_edn(op) for op in history) + "\n"
+
+
+def encode(value: Any) -> bytes:
+    return to_edn(value).encode()
+
+
+def decode(data: bytes) -> Any:
+    return parse_edn(data.decode())

@@ -1,0 +1,51 @@
+"""REPL conveniences for poking at stored runs.
+
+Parity: jepsen.repl (jepsen/src/jepsen/repl.clj) + jepsen.report: load the
+latest run, re-check histories interactively.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+from jepsen_tpu import store
+from jepsen_tpu.history import History
+
+
+def latest_test(base: str = "store") -> Optional[str]:
+    """Directory of the most recent run (repl.clj's latest-test)."""
+    runs = store.runs(base)
+    if not runs:
+        return None
+    return max(runs, key=lambda r: r["time"])["dir"]
+
+
+def load_latest(base: str = "store") -> Tuple[Dict[str, Any], History]:
+    d = latest_test(base)
+    if d is None:
+        raise FileNotFoundError(f"no runs under {base}")
+    return store.load_test(d), store.load_history(d)
+
+
+@contextlib.contextmanager
+def to_file(path: str):
+    """Redirect stdout into a file (jepsen.report's with-out-file)."""
+    old = sys.stdout
+    with open(path, "w") as f:
+        sys.stdout = f
+        try:
+            yield
+        finally:
+            sys.stdout = old
+
+
+def recheck(checker, base: str = "store") -> Dict[str, Any]:
+    """Re-run a checker over the latest stored history."""
+    test, history = load_latest(base)
+    from jepsen_tpu.checker.core import check_safe
+    return check_safe(checker, test, history,
+                      {"store_dir": test.get("store_dir")})

@@ -1,0 +1,160 @@
+"""Kafka-style log workload: sends, polls, and offset/order analyses.
+
+Parity: jepsen.tests.kafka (jepsen/src/jepsen/tests/kafka.clj): transactions
+of ``send``/``poll`` micro-ops against partitioned logs, analyzed for
+log-specific anomalies (kafka.clj's lost-write, duplicate, aborted-read,
+poll-skip, nonmonotonic-poll, unseen analyses, checker at kafka.clj:2049,
+workload at 2106).
+
+Op language (completed mops):
+  ["send", k, [offset, value]]    — producer appended value at offset
+                                    (invocation carries ["send", k, value])
+  ["poll", {k: [[offset, value], ...]}]
+                                  — consumer read records, per partition
+
+Anomalies:
+  duplicate        — one value at multiple offsets of a partition
+  lost-write       — acked send never seen although later offsets of the
+                     same partition were observed by some poll
+  aborted-read     — polled value from a failed send
+  poll-skip        — a process's consecutive polls of a partition skip over
+                     offsets that are known to exist
+  nonmonotonic-poll— a process's poll rewinds behind its previous position
+  internal-nonmonotonic — offsets within one poll not strictly ascending
+  unseen           — committed values never observed by any poll (info)
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN, merge_valid
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
+
+
+def generator(partitions: int = 4, max_mops: int = 3):
+    counter = itertools.count(1)
+
+    def one():
+        mops = []
+        for _ in range(random.randint(1, max_mops)):
+            k = random.randrange(partitions)
+            if random.random() < 0.5:
+                mops.append(["send", k, next(counter)])
+            else:
+                mops.append(["poll", {}])
+        return {"f": "txn", "value": mops}
+
+    return gen.FnGen(one)
+
+
+class KafkaChecker(Checker):
+    def check(self, test, history: History, opts=None):
+        sends_ok: Dict[Tuple[Any, int], Any] = {}   # (k, offset) -> value
+        send_of_value: Dict[Tuple[Any, Any], int] = {}  # (k, value) -> offset
+        failed_values: set = set()                   # (k, value) of failed sends
+        polls: List[Tuple[Any, Dict]] = []           # (process, {k: [[o,v]..]})
+        anomalies: Dict[str, List[Any]] = defaultdict(list)
+
+        for op in history:
+            if not isinstance(op.value, (list, tuple)):
+                continue
+            if op.type == OK:
+                for mop in op.value:
+                    if mop[0] == "send":
+                        k, ov = mop[1], mop[2]
+                        if isinstance(ov, (list, tuple)) and len(ov) == 2:
+                            o, v = ov
+                            if (k, o) in sends_ok and sends_ok[(k, o)] != v:
+                                anomalies["offset-conflict"].append(
+                                    {"key": k, "offset": o,
+                                     "values": [sends_ok[(k, o)], v]})
+                            if (k, v) in send_of_value and \
+                                    send_of_value[(k, v)] != o:
+                                anomalies["duplicate"].append(
+                                    {"key": k, "value": v,
+                                     "offsets": [send_of_value[(k, v)], o]})
+                            sends_ok[(k, o)] = v
+                            send_of_value[(k, v)] = o
+                    elif mop[0] == "poll" and isinstance(mop[1], dict):
+                        polls.append((op.process, mop[1]))
+            elif op.type == FAIL:
+                for mop in op.value:
+                    if mop[0] == "send":
+                        failed_values.add((mop[1], mop[2]))
+
+        # observed offsets per partition + in-poll order + aborted reads
+        observed: Dict[Any, set] = defaultdict(set)
+        for proc, pd in polls:
+            for k, recs in pd.items():
+                last = None
+                for o, v in recs:
+                    observed[k].add(o)
+                    if (k, v) in failed_values:
+                        anomalies["aborted-read"].append(
+                            {"key": k, "offset": o, "value": v})
+                    if (k, o) in sends_ok and sends_ok[(k, o)] != v:
+                        anomalies["poll-send-mismatch"].append(
+                            {"key": k, "offset": o,
+                             "polled": v, "sent": sends_ok[(k, o)]})
+                    if (k, v) in send_of_value and \
+                            send_of_value[(k, v)] != o:
+                        anomalies["duplicate"].append(
+                            {"key": k, "value": v,
+                             "offsets": [send_of_value[(k, v)], o]})
+                    if last is not None and o <= last:
+                        anomalies["internal-nonmonotonic"].append(
+                            {"key": k, "offsets": [last, o]})
+                    last = o
+
+        # per-process poll position tracking: skips and rewinds
+        pos: Dict[Tuple[Any, Any], int] = {}  # (process, k) -> last offset
+        for proc, pd in polls:
+            for k, recs in pd.items():
+                if not recs:
+                    continue
+                first, last = recs[0][0], recs[-1][0]
+                prev = pos.get((proc, k))
+                if prev is not None:
+                    if first <= prev:
+                        anomalies["nonmonotonic-poll"].append(
+                            {"process": proc, "key": k,
+                             "prev": prev, "rewound-to": first})
+                    else:
+                        skipped = [o for o in range(prev + 1, first)
+                                   if (k, o) in sends_ok or o in observed[k]]
+                        if skipped:
+                            anomalies["poll-skip"].append(
+                                {"process": proc, "key": k,
+                                 "prev": prev, "next": first,
+                                 "skipped": skipped})
+                pos[(proc, k)] = last
+
+        # lost writes: acked send at offset o never observed, while some
+        # poll observed an offset > o in that partition
+        for (k, o), v in sends_ok.items():
+            if o in observed[k]:
+                continue
+            if observed[k] and max(observed[k]) > o:
+                anomalies["lost-write"].append({"key": k, "offset": o,
+                                                "value": v})
+        unseen = [{"key": k, "offset": o, "value": v}
+                  for (k, o), v in sends_ok.items()
+                  if o not in observed[k]
+                  and not (observed[k] and max(observed[k]) > o)]
+
+        hard = {k: v for k, v in anomalies.items()}
+        return {"valid": (UNKNOWN if (not hard and unseen and not polls)
+                          else not hard),
+                "anomaly-types": sorted(hard),
+                "anomalies": {k: v[:8] for k, v in hard.items()},
+                "sends": len(sends_ok), "polls": len(polls),
+                "unseen-count": len(unseen), "unseen": unseen[:8]}
+
+
+def workload(partitions: int = 4) -> Dict[str, Any]:
+    return {"generator": generator(partitions), "checker": KafkaChecker()}

@@ -23,12 +23,14 @@ from jepsen_tpu.workloads import linearizable_register
 
 
 class MockStore:
-    """Shared 'replicated' register map with injectable bugs."""
+    """Shared 'replicated' register map with injectable bugs.  The bug
+    trigger uses its own seeded RNG so demo runs are reproducible."""
 
-    def __init__(self, bug: Optional[str] = None):
+    def __init__(self, bug: Optional[str] = None, seed: int = 1):
         self.regs: Dict[Any, Any] = {}
         self.lock = threading.Lock()
         self.bug = bug
+        self.rng = random.Random(seed)
         self.history_of: Dict[Any, list] = {}
 
     def apply(self, op):
@@ -37,16 +39,16 @@ class MockStore:
             cur = self.regs.get(k)
             if op.f == "read":
                 out = cur
-                if self.bug == "stale-reads" and random.random() < 0.05:
+                if self.bug == "stale-reads" and self.rng.random() < 0.1:
                     past = self.history_of.get(k) or [None]
-                    out = past[max(0, len(past) - 3)]
+                    out = past[max(0, len(past) - 4)]
                 return op.with_(type=OK, value=(k, out))
             if op.f == "write":
                 self.regs[k] = v
                 self.history_of.setdefault(k, []).append(v)
                 return op.with_(type=OK)
             old, new = v
-            if self.bug == "phantom-cas" and random.random() < 0.03:
+            if self.bug == "phantom-cas" and self.rng.random() < 0.05:
                 return op.with_(type=OK)  # claims success, did nothing
             if cur == old:
                 self.regs[k] = new
@@ -71,7 +73,7 @@ def demo_test(opts: Dict[str, Any]) -> Dict[str, Any]:
     bug = opts.get("bug") or None
     if bug == "none":
         bug = None
-    store = MockStore(bug=bug)
+    store = MockStore(bug=bug, seed=int(opts.get("seed", 1)))
     keys = int(opts.get("keys", 4))
     wl = linearizable_register.workload(
         keys=range(keys),

@@ -1,0 +1,40 @@
+"""EDN codec round-trips and repl helpers."""
+
+from jepsen_tpu import codec, repl, store, core
+from jepsen_tpu.history import History, INVOKE, OK, Op
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        v = {"type": "invoke", "f": "cas", "value": [1, 2], "process": 0,
+             "time": 10, "index": 0}
+        text = codec.to_edn(v)
+        assert codec.decode(text.encode())["value"] == [1, 2]
+
+    def test_history_edn_roundtrip(self):
+        h = History([
+            Op(process=0, type=INVOKE, f="write", value=3, time=1),
+            Op(process=0, type=OK, f="write", value=3, time=2),
+        ])
+        text = codec.history_to_edn(h)
+        h2 = History.from_edn(text)
+        assert [o.to_dict() for o in h2] == [o.to_dict() for o in h]
+
+    def test_keywords_rendered(self):
+        h = History([Op(process="nemesis", type="info", f="start")])
+        assert ":process :nemesis" in codec.history_to_edn(h)
+
+
+class TestRepl:
+    def test_latest_and_recheck(self, tmp_path):
+        from jepsen_tpu.checker import Stats
+        from tests.test_cli_web import suite_test_fn
+        base = str(tmp_path / "store")
+        core.run(suite_test_fn({"nodes": [], "store_base": base,
+                                "concurrency": 2}))
+        d = repl.latest_test(base)
+        assert d is not None
+        test, history = repl.load_latest(base)
+        assert len(history) > 0
+        r = repl.recheck(Stats(), base)
+        assert r["valid"] is True

@@ -1,0 +1,78 @@
+"""Kafka-style log analyses: crafted histories per anomaly
+(the reference has 610 lines of example-history tests for this module)."""
+
+import pytest
+
+from jepsen_tpu.history import FAIL, History, INVOKE, OK, Op
+from jepsen_tpu.workloads.kafka import KafkaChecker
+
+
+def ok(process, mops):
+    return [Op(process=process, type=INVOKE, f="txn", value=mops),
+            Op(process=process, type=OK, f="txn", value=mops)]
+
+
+def check(ops):
+    return KafkaChecker().check({}, History(ops))
+
+
+class TestKafka:
+    def test_clean(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]))
+        r = check(h)
+        assert r["valid"] is True and r["anomaly-types"] == []
+
+    def test_duplicate(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [2, 10]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert "duplicate" in r["anomaly-types"]
+
+    def test_lost_write(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[1, 11]]}]]))
+        r = check(h)
+        assert "lost-write" in r["anomaly-types"]
+
+    def test_aborted_read(self):
+        h = ([Op(process=0, type=INVOKE, f="txn", value=[["send", 0, 9]]),
+              Op(process=0, type=FAIL, f="txn", value=[["send", 0, 9]])] +
+             ok(1, [["poll", {0: [[0, 9]]}]]))
+        r = check(h)
+        assert "aborted-read" in r["anomaly-types"]
+
+    def test_poll_skip(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(0, [["send", 0, [2, 12]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]) +
+             ok(1, [["poll", {0: [[2, 12]]}]]))
+        r = check(h)
+        assert "poll-skip" in r["anomaly-types"]
+
+    def test_nonmonotonic_poll(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[1, 11]]}]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert "nonmonotonic-poll" in r["anomaly-types"]
+
+    def test_internal_nonmonotonic(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[1, 11], [0, 10]]}]]))
+        r = check(h)
+        assert "internal-nonmonotonic" in r["anomaly-types"]
+
+    def test_unseen_tail_is_not_an_anomaly(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert r["valid"] is True
+        assert r["unseen-count"] == 1
