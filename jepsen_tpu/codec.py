@@ -7,9 +7,13 @@ histories round-trip with reference-format tooling (history.edn files).
 
 from __future__ import annotations
 
+import re
 from typing import Any
 
 from jepsen_tpu.history import History, Op, parse_edn
+
+# EDN keyword-safe names: symbol chars only, no whitespace/delimiters.
+_KEYWORD_SAFE = re.compile(r"[A-Za-z0-9*+!\-_?.%&=<>/][A-Za-z0-9*+!\-_?.#%&=<>/:']*")
 
 KEYWORD_KEYS = {"type", "f"}
 
@@ -49,7 +53,12 @@ def to_edn(value: Any) -> str:
     if isinstance(value, dict):
         parts = []
         for k, v in value.items():
-            key = f":{k}" if isinstance(k, str) else to_edn(k)
+            # Bare-keyword a string key only when it's valid keyword syntax;
+            # otherwise emit an EDN string so readers don't mis-pair the map.
+            if isinstance(k, str) and _KEYWORD_SAFE.fullmatch(k):
+                key = f":{k}"
+            else:
+                key = to_edn(k)
             parts.append(f"{key} {to_edn(v)}")
         return "{" + ", ".join(parts) + "}"
     return to_edn(repr(value))

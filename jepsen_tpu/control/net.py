@@ -1,0 +1,55 @@
+"""Node-side network introspection helpers.
+
+Parity: jepsen.control.net (jepsen/src/jepsen/control/net.clj): reachability
+probes, hostname→IP resolution via getent, and the control node's IP as seen
+from a DB node (used e.g. by the tcpdump DB's clients-only filter,
+db.clj:107-110).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from jepsen_tpu.control import Session
+
+# (node-identity, hostname) -> ip; getent is stable for a run
+# (control/net.clj:38-40 memoizes the same way).
+_ip_cache: Dict[Tuple[int, str], str] = {}
+
+
+def reachable(s: Session, node: str) -> bool:
+    """Can the session's node ping ``node``? (control/net.clj:8-12)."""
+    return s.exec_result("ping", "-c", "1", "-w", "1", node).ok
+
+
+def local_ip(s: Session) -> Optional[str]:
+    """The node's own IP address (control/net.clj:14-17)."""
+    out = s.exec("hostname", "-I").split()
+    return out[0] if out else None
+
+
+def ip_of(s: Session, host: str, memo: bool = True) -> str:
+    """Resolve ``host`` to an IP from the session's node via
+    ``getent ahosts`` (control/net.clj:19-36).  Raises on blank results the
+    same way the reference throws :blank-getent-ip."""
+    key = (id(s.remote), host)
+    if memo and key in _ip_cache:
+        return _ip_cache[key]
+    res = s.exec("getent", "ahosts", host)
+    lines = res.splitlines()
+    ip = lines[0].split()[0] if lines and lines[0].split() else ""
+    if not ip:
+        raise RuntimeError(f"blank getent ip for {host!r}: {res!r}")
+    if memo:
+        _ip_cache[key] = ip
+    return ip
+
+
+def control_ip(s: Session) -> Optional[str]:
+    """The control node's IP as perceived by the DB node, from $SSH_CLIENT
+    (control/net.clj:41-53).  None when the transport isn't SSH (docker/k8s
+    exec, dummy)."""
+    out = s.exec_result("bash", "-c", "echo $SSH_CLIENT")
+    if out.ok and out.out.split():
+        return out.out.split()[0]
+    return None

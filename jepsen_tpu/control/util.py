@@ -115,11 +115,15 @@ def start_daemon(s: Session, binary: str, *args,
     cmd = build_cmd(binary, *args)
     if env:
         cmd = f"env {env_str(env)} {cmd}"
-    if chdir:
-        cmd = f"cd {chdir} && {cmd}"
+    # chdir runs as its own foreground statement: `nohup cd X && cmd` tries
+    # to exec the `cd` builtin and short-circuits; `cd X && nohup cmd &`
+    # backgrounds the whole list, so $! would be a wrapper subshell instead
+    # of the daemon and signals would never reach it.
+    prefix = f"cd {chdir} || exit 1; " if chdir else ""
     script = (f"if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; "
               f"then echo already-running; else "
-              f"nohup {cmd} >> {logfile} 2>&1 & echo $! > {pidfile}; fi")
+              f"{prefix}nohup {cmd} >> {logfile} 2>&1 & echo $! > {pidfile}; "
+              f"fi")
     s.exec("bash", "-c", script)
 
 

@@ -58,6 +58,74 @@ class NoopDB(DB):
 noop = NoopDB
 
 
+class TcpdumpDB(DB, LogFiles):
+    """Runs a tcpdump capture from setup to teardown and yields the pcap as
+    a log file (db.clj:88-156).  Options:
+
+    - ``ports``: capture only these ports
+    - ``clients_only``: filter to traffic from the control node's IP
+    - ``filter``: extra pcap filter expression, ANDed in
+    """
+
+    DIR = "/tmp/jepsen/tcpdump"
+
+    def __init__(self, ports: Optional[List[int]] = None,
+                 clients_only: bool = False,
+                 filter: Optional[str] = None):  # noqa: A002 - reference name
+        self.ports = list(ports or [])
+        self.clients_only = clients_only
+        self.filter = filter
+
+    def setup(self, test, node):
+        from jepsen_tpu.control import session
+        from jepsen_tpu.control import net as cn
+        from jepsen_tpu.control import util as cu
+        s = session(test, node).sudo()
+        s.exec("mkdir", "-p", self.DIR)
+        filters = []
+        if self.ports:
+            filters.append(" or ".join(f"port {p}" for p in self.ports))
+        if self.clients_only:
+            ip = cn.control_ip(s)
+            if ip:
+                filters.append(f"host {ip}")
+        if self.filter:
+            filters.append(self.filter)
+        # -U: unbuffered — SIGINT alone leaves the capture half-flushed
+        # (db.clj:126-131's observation).
+        # Parenthesize each sub-filter: pcap's `and` binds tighter than
+        # `or`, so a bare port alternation would swallow the host filter.
+        cu.start_daemon(
+            s, "/usr/bin/tcpdump",
+            "-w", f"{self.DIR}/tcpdump", "-s", "65535", "-B", "16384", "-U",
+            " and ".join(f"({f})" for f in filters if f),
+            pidfile=f"{self.DIR}/pid", logfile=f"{self.DIR}/log",
+            chdir=self.DIR)
+
+    def teardown(self, test, node):
+        from jepsen_tpu.control import session
+        from jepsen_tpu.control import util as cu
+        s = session(test, node).sudo()
+        # Clean INT first so tcpdump flushes, then the generic stop + wipe
+        # (db.clj:133-151).
+        s.exec_result(
+            "bash", "-c",
+            f"[ -f {self.DIR}/pid ] && kill -INT $(cat {self.DIR}/pid)")
+        import time as _time
+        deadline = _time.time() + 5
+        while (_time.time() < deadline
+               and cu.daemon_running(s, f"{self.DIR}/pid")):
+            _time.sleep(0.05)
+        cu.stop_daemon(s, f"{self.DIR}/pid")
+        s.exec("rm", "-rf", self.DIR)
+
+    def log_files(self, test, node):
+        return [f"{self.DIR}/log", f"{self.DIR}/tcpdump"]
+
+
+tcpdump = TcpdumpDB
+
+
 def cycle_(db: DB, test: Dict[str, Any], node: str, tries: int = 3) -> None:
     """teardown! then setup!, retrying up to ``tries`` times
     (db.clj:162-199)."""

@@ -162,6 +162,40 @@ class IptablesNet(Net):
 iptables = IptablesNet
 
 
+class IpfilterNet(IptablesNet):
+    """IPFilter rules for SmartOS/Solaris nodes (net.clj:188-223).  Shaping
+    inherits the tc-netem paths; only drop/heal differ."""
+
+    def drop(self, test, src, dst):
+        s = session(test, dst).sudo()
+        s.exec("bash", "-c",
+               f"echo 'block in from {src} to any' | ipf -f -")
+
+    def drop_all(self, test, grudge):
+        from jepsen_tpu.control import on_nodes
+
+        def apply_(t, node):
+            srcs = list(grudge.get(node) or [])
+            if not srcs:
+                return
+            rules = "\n".join(f"block in from {src} to any" for src in srcs)
+            s = session(t, node).sudo()
+            s.exec("bash", "-c", f"printf '%s\\n' '{rules}' | ipf -f -")
+
+        on_nodes(test, apply_, list(grudge.keys()))
+
+    def heal(self, test):
+        from jepsen_tpu.control import on_nodes
+
+        def heal_(t, node):
+            session(t, node).sudo().exec("ipf", "-Fa")
+
+        on_nodes(test, heal_)
+
+
+ipfilter = IpfilterNet
+
+
 def _default_dev(s) -> str:
     out = s.exec("bash", "-c",
                  "ip route show default | head -1 | grep -o 'dev [^ ]*' "

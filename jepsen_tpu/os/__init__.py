@@ -68,6 +68,63 @@ class Debian(OS):
 debian = Debian
 
 
+class Ubuntu(Debian):
+    """Ubuntu node prep (os/ubuntu.clj — a Debian variant that also ensures
+    the deadline scheduler / ntp bits cockroach wants; here: apt update
+    before install)."""
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.env(DEBIAN_FRONTEND="noninteractive").exec_result(
+            "apt-get", "update", "-y")
+        super().setup(test, node)
+
+
+ubuntu = Ubuntu
+
+
+class Smartos(OS):
+    """SmartOS node prep (os/smartos.clj): pkgin packages and a loopback
+    hostfile entry for the local hostname."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        self._setup_hostfile(s)
+        if self._stale_pkgin(s):
+            s.exec("pkgin", "update")
+        if self.packages:
+            s.exec("pkgin", "-y", "install", *self.packages)
+
+    def _setup_hostfile(self, s: Session):
+        # Append the local hostname to the 127.0.0.1 line if missing
+        # (smartos.clj:13-26).
+        name = s.exec("hostname").strip()
+        hosts = s.exec("cat", "/etc/hosts")
+        out = []
+        for line in hosts.splitlines():
+            if line.startswith("127.0.0.1") and name not in line.split():
+                line = f"{line} {name}"
+            out.append(line)
+        new = "\n".join(out)
+        if new != hosts:
+            s.exec("tee", "/etc/hosts", stdin=new + "\n")
+
+    @staticmethod
+    def _stale_pkgin(s: Session) -> bool:
+        """Has pkgin update run within a day? (smartos.clj:28-40).  POSIX
+        find -mtime, since illumos stat has no GNU -c."""
+        r = s.exec_result(
+            "bash", "-c",
+            "find /var/db/pkgin/sql.log -mtime +0 2>/dev/null")
+        return (not r.ok) or bool(r.out.strip())
+
+
+smartos = Smartos
+
+
 class Centos(OS):
     """RHEL-family prep (os/centos.clj): yum packages."""
 

@@ -7,8 +7,6 @@ latest run, re-check histories interactively.
 from __future__ import annotations
 
 import contextlib
-import io
-import os
 import sys
 from typing import Any, Dict, Optional, Tuple
 

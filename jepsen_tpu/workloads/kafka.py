@@ -32,8 +32,8 @@ from collections import defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu import generator as gen
-from jepsen_tpu.checker.core import Checker, UNKNOWN, merge_valid
-from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import FAIL, History, OK
 
 
 def generator(partitions: int = 4, max_mops: int = 3):

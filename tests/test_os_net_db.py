@@ -1,0 +1,133 @@
+"""OS variants, ipfilter net, tcpdump DB wrapper, control.net helpers —
+all against the dummy remote (record-only for command-shape assertions,
+local-exec for the introspection helpers)."""
+
+import pytest
+
+from jepsen_tpu import control, db as jdb, net as jnet
+from jepsen_tpu import os as jos
+from jepsen_tpu.control import net as cn
+
+
+def record_test(nodes=("n1", "n2", "n3")):
+    return {"nodes": list(nodes),
+            "remote": control.DummyRemote(record_only=True)}
+
+
+def logged(test, node):
+    return "\n".join(control.session(test, node).remote.log)
+
+
+class TestIpfilter:
+    def test_drop_all_and_heal(self):
+        t = record_test()
+        control.setup_sessions(t)
+        net = jnet.IpfilterNet()
+        net.drop_all(t, {"n1": ["n2", "n3"]})
+        log = logged(t, "n1")
+        assert "block in from n2 to any" in log
+        assert "ipf -f -" in log
+        net.heal(t)
+        for n in t["nodes"]:
+            assert "ipf -Fa" in logged(t, n)
+        control.teardown_sessions(t)
+
+    def test_drop_single(self):
+        t = record_test()
+        control.setup_sessions(t)
+        jnet.IpfilterNet().drop(t, "n2", "n1")
+        assert "block in from n2 to any" in logged(t, "n1")
+        control.teardown_sessions(t)
+
+
+class TestTcpdumpDB:
+    def test_setup_records_capture_daemon(self):
+        t = record_test(["n1"])
+        control.setup_sessions(t)
+        d = jdb.TcpdumpDB(ports=[2379, 2380], filter="host 10.0.0.9")
+        d.setup(t, "n1")
+        log = logged(t, "n1")
+        assert "tcpdump" in log and "-U" in log
+        assert "port 2379 or port 2380" in log
+        assert "host 10.0.0.9" in log
+        d.teardown(t, "n1")
+        log = logged(t, "n1")
+        assert "rm -rf /tmp/jepsen/tcpdump" in log
+        files = d.log_files(t, "n1")
+        assert any(f.endswith("tcpdump") for f in files)
+        control.teardown_sessions(t)
+
+
+class TestOSVariants:
+    def test_ubuntu_runs_apt_update_then_install(self):
+        t = record_test(["n1"])
+        control.setup_sessions(t)
+        jos.Ubuntu(packages=["ntp"]).setup(t, "n1")
+        log = logged(t, "n1")
+        assert "apt-get update" in log
+        assert "apt-get install" in log and "ntp" in log
+        control.teardown_sessions(t)
+
+    def test_smartos_pkgin(self):
+        t = record_test(["n1"])
+        control.setup_sessions(t)
+        jos.Smartos(packages=["curl"]).setup(t, "n1")
+        log = logged(t, "n1")
+        # record mode: find returns ok+empty -> cache looks fresh, no update
+        assert "find /var/db/pkgin/sql.log" in log
+        assert "pkgin -y install curl" in log
+        control.teardown_sessions(t)
+
+
+class TestStartDaemonChdir:
+    def test_chdir_pidfile_is_daemon_not_wrapper(self, tmp_path):
+        """`cd X && nohup cmd &` would record a wrapper subshell PID; the
+        daemon must be signalable via the pidfile."""
+        from jepsen_tpu.control import util as cu
+        t = {"nodes": ["local"], "ssh": {"dummy": True}}
+        control.setup_sessions(t)
+        s = control.session(t, "local")
+        pidfile = str(tmp_path / "d.pid")
+        cu.start_daemon(s, "sleep", "60",
+                        pidfile=pidfile, logfile=str(tmp_path / "d.log"),
+                        chdir=str(tmp_path))
+        pid = s.exec("cat", pidfile).strip()
+        comm = s.exec("ps", "-o", "comm=", "-p", pid).strip()
+        assert comm == "sleep", comm
+        cu.stop_daemon(s, pidfile)
+        assert not cu.daemon_running(s, pidfile)
+        control.teardown_sessions(t)
+
+
+class TestEdnOddKeys:
+    def test_non_keyword_keys_roundtrip_as_strings(self):
+        from jepsen_tpu import codec
+        s = codec.to_edn({"error msg": 1, "ok": 2})
+        assert '"error msg" 1' in s and ":ok 2" in s
+
+
+class TestControlNet:
+    @pytest.fixture
+    def sess(self):
+        t = {"nodes": ["local"], "ssh": {"dummy": True}}
+        control.setup_sessions(t)
+        yield control.session(t, "local")
+        control.teardown_sessions(t)
+
+    def test_ip_of_localhost(self, sess):
+        ip = cn.ip_of(sess, "localhost", memo=False)
+        assert ip.startswith("127.") or ":" in ip
+
+    def test_ip_of_blank_raises(self, sess):
+        with pytest.raises(Exception):
+            cn.ip_of(sess, "no-such-host-xyz.invalid", memo=False)
+
+    def test_local_ip(self, sess):
+        ip = cn.local_ip(sess)
+        assert ip is None or "." in ip or ":" in ip
+
+    def test_reachable_returns_bool(self, sess):
+        assert cn.reachable(sess, "localhost") in (True, False)
+
+    def test_control_ip_none_without_ssh(self, sess):
+        assert cn.control_ip(sess) is None
