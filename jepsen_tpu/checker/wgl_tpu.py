@@ -28,6 +28,7 @@ device-local slice of the deduplicated global set.
 from __future__ import annotations
 
 import math
+from collections import deque
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -45,8 +46,15 @@ from jepsen_tpu.ops.dedup import sort_dedup_compact
 
 EV_NOP = 2
 
+# Chunks dispatched ahead of the host's flag poll, so the device→host flags
+# transfer of chunk i overlaps with the device computing chunk i+1.
+LOOKAHEAD = 2
+
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-#          overflow, explored, rounds)
+#          overflow, explored, rounds, peak)
+# peak is the high-water mark of the distinct-configuration count since the
+# driver last reset it: the capacity the search *actually* needed, which the
+# host reads at chunk boundaries to pick the cheapest sufficient engine.
 
 
 def make_engine(model: JaxModel, window: int, capacity: int,
@@ -130,32 +138,32 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-         overflow, explored, rounds) = carry
+         overflow, explored, rounds, peak) = carry
         kind, slot, f, a, b, op_id = (ev[0], ev[1], ev[2], ev[3], ev[4], ev[5])
         alive = ~failed & ~overflow
 
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds) = c
+             overflow, explored, rounds, peak) = c
             win_ops2 = win_ops.at[slot].set(jnp.stack([f, a, b]))
             active2 = active.at[slot].set(True)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
-                    failed, failed_op, overflow, explored, rounds)
+                    failed, failed_op, overflow, explored, rounds, peak)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds) = c
+             overflow, explored, rounds, peak) = c
 
             def with_closure(args):
-                mask, states, valid, overflow, explored, rounds = args
+                mask, states, valid, overflow, explored, rounds, peak = args
                 mask, states, valid, count, overflow, iters = closure(
                     mask, states, valid, win_ops, active, overflow)
                 return (mask, states, valid, overflow, explored + count,
-                        rounds + iters)
+                        rounds + iters, jnp.maximum(peak, count))
 
-            mask, states, valid, overflow, explored, rounds = lax.cond(
+            mask, states, valid, overflow, explored, rounds, peak = lax.cond(
                 dirty, with_closure, lambda a: a,
-                (mask, states, valid, overflow, explored, rounds))
+                (mask, states, valid, overflow, explored, rounds, peak))
 
             bm = slot_bitmask(slot)
             has = ((mask & bm[None, :]) != 0).any(-1)
@@ -167,7 +175,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             active2 = active.at[slot].set(False)
             return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
                     failed | newly_failed, failed_op2, overflow, explored,
-                    rounds)
+                    rounds, peak)
 
         new_carry = lax.cond(
             alive,
@@ -188,11 +196,21 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.int32(-1),                             # failed_op
                 jnp.bool_(False),                          # overflow
                 jnp.int32(0),                              # explored
-                jnp.int32(0))                              # closure rounds
+                jnp.int32(0),                              # closure rounds
+                jnp.int32(1))                              # peak config count
 
     def run_chunk(carry, events):
+        # Reset the peak to the live count on entry (device-side: the host
+        # reads per-chunk peaks without extra round-trips), scan the events,
+        # and pack the scalars the host polls into ONE int32 vector so a
+        # chunk boundary costs a single device→host transfer.
+        live0 = global_sum(carry[2].sum()).astype(jnp.int32)
+        carry = carry[:11] + (live0,)
         carry, _ = lax.scan(event_step, carry, events)
-        return carry
+        flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
+                           carry[8].astype(jnp.int32),   # overflow
+                           carry[11]])                   # peak configs
+        return carry, flags
 
     return carry0, event_step, run_chunk
 
@@ -236,13 +254,20 @@ def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
 def check(model: JaxModel, history: Optional[History] = None,
           prepared: Optional[PreparedHistory] = None,
           capacity: int = 1024, max_capacity: int = 65536,
-          chunk: int = 2048, max_window: int = 4096,
+          chunk: int = 256, max_window: int = 4096,
           explain: bool = True) -> Dict[str, Any]:
     """Decide linearizability on device.  Retries with larger configuration
     capacity on overflow; falls back to ``valid: "unknown"`` past
     ``max_capacity``.  On refutation, optionally re-derives a witness on the
     failing prefix with the CPU oracle (cheap: the prefix is exactly what the
-    device already searched)."""
+    device already searched).
+
+    ``chunk`` trades host polls against capacity adaptivity: per-closure sort
+    cost scales with the *static* capacity, so small chunks let the driver
+    escalate/relax capacity tightly around crash-bursts (and re-run less on
+    overflow), while the lookahead pipeline hides the per-chunk flag
+    transfer.  256 is tuned for TPU; pure-throughput batch checking with no
+    mid-stream adaptation (check_batch) uses larger chunks."""
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
     window = _round_window(p.window)
@@ -252,39 +277,67 @@ def check(model: JaxModel, history: Optional[History] = None,
     cap = capacity
     carry0, run_chunk = _get_run_chunk(model, window, cap)
     carry = carry0()
-    failed = overflow = False
-    ci = 0
-    last_overflow_chunk = -(10 ** 9)
-    while ci < n_chunks:
-        prev = carry  # chunk-boundary snapshot: the resume point on overflow
-        carry = run_chunk(carry, jnp.asarray(ev[ci * chunk:(ci + 1) * chunk]))
-        failed = bool(carry[6])
-        overflow = bool(carry[8])
+    recent_peaks: deque = deque(maxlen=4)  # per-chunk high-water marks
+    # Pipelined dispatch: keep LOOKAHEAD chunks in flight so the (possibly
+    # slow, e.g. tunneled) device→host flags transfer of chunk i overlaps
+    # with the device computing chunk i+1.  Speculation is safe: once the
+    # failed/overflow lane is set, event_step gates all updates, so
+    # speculative chunks past a failure compute nothing wrong — they are
+    # simply discarded on resume.
+    inflight: deque = deque()  # (ci, carry_before, carry_after, flags)
+    next_ci = 0
+    # n_chunks >= 1 always (events_array pads to a chunk multiple of at
+    # least one chunk), so the loop pops at least once and failed/overflow/
+    # carry are always (re)assigned before use below.
+    while True:
+        while len(inflight) < LOOKAHEAD and next_ci < n_chunks:
+            prev = carry
+            carry, flags = run_chunk(
+                carry, jnp.asarray(ev[next_ci * chunk:(next_ci + 1) * chunk]))
+            inflight.append((next_ci, prev, carry, flags))
+            next_ci += 1
+        if not inflight:
+            break
+        ci, prev, after, flags = inflight.popleft()
+        fl = np.asarray(flags)
+        failed, overflow = bool(fl[0]), bool(fl[1])
+        peak = int(fl[2])
         if overflow and cap < max_capacity:
-            # Grow the configuration buffers and resume from the snapshot —
-            # no restart, no re-search of the prefix.
-            cap = min(cap * 4, max_capacity)
-            last_overflow_chunk = ci
+            # Grow straight to a capacity the observed peak says is enough
+            # (peak is a lower bound on the true need — it may itself have
+            # been clipped — so the loop can escalate again) and resume from
+            # the snapshot: no restart, no re-search of the prefix.
+            while cap < max_capacity and cap < 2 * peak:
+                cap = min(cap * 4, max_capacity)
+            recent_peaks.clear()
+            inflight.clear()
             _, run_chunk = _get_run_chunk(model, window, cap)
             carry = _grow_carry(prev, cap)
+            next_ci = ci
             overflow = False
             continue
+        done = after
         if failed or overflow:
             break
-        ci += 1
-        if cap > capacity and ci - last_overflow_chunk >= 8:
-            # Crash-bursts inflate the configuration set transiently; once it
-            # clearly subsides (hysteresis: no overflow for 8 chunks, live
-            # count far below a smaller buffer), drop back to a
-            # cheaper-per-round engine.
-            n_valid = int(jnp.sum(carry[2]))
+        recent_peaks.append(peak)
+        if cap > capacity and len(recent_peaks) == 4:
+            # Crash-bursts inflate the configuration set transiently.  The
+            # per-round sort cost scales with the *static* capacity, so once
+            # recent peaks show a smaller buffer suffices (2x headroom over
+            # the last 4 chunks' high-water mark), drop back to a
+            # cheaper-per-round engine (discarding speculative chunks).
+            need = 2 * max(recent_peaks)
             target = cap
-            while target > capacity and n_valid * 16 <= target:
+            while target > capacity and target // 4 >= need:
                 target //= 4
             if target < cap:
                 cap = target
+                recent_peaks.clear()
+                inflight.clear()
                 _, run_chunk = _get_run_chunk(model, window, cap)
-                carry = _shrink_carry(carry, cap)
+                carry = _shrink_carry(after, cap)
+                next_ci = ci + 1
+    carry = done
 
     explored = int(carry[9])
     if overflow:

@@ -72,7 +72,7 @@ def check_batch(model: JaxModel,
             batch_dev = jnp.asarray(batch)
         n_chunks = emax // chunk
         for ci in range(n_chunks):
-            carry = vrun(carry, batch_dev[:, ci * chunk:(ci + 1) * chunk])
+            carry, _ = vrun(carry, batch_dev[:, ci * chunk:(ci + 1) * chunk])
         overflow = np.asarray(carry[8])[:b]
         if overflow.any() and cap < max_capacity:
             cap = min(cap * 8, max_capacity)

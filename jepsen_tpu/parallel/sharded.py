@@ -39,13 +39,13 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
     _, _, run_chunk = make_engine(model, window, capacity_per_shard,
                                   axis_name=axis, num_shards=n)
     # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
-    #               dirty, failed, failed_op, overflow, explored)
+    #               dirty, failed, failed_op, overflow, explored, rounds, peak)
     sharded = P(axis)
     repl = P()
     in_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                 repl, repl, repl), repl)
-    out_specs = (sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                 repl, repl, repl)
+                 repl, repl, repl, repl), repl)
+    out_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
+                  repl, repl, repl, repl), repl)
     # check_vma=False: closure dedup sorts the *gathered* global row set, so
     # every shard computes bit-identical "replicated" scalars (counts, flags),
     # but the varying-axes checker can't prove that post-all_gather.
@@ -96,12 +96,13 @@ def check_sharded(model: JaxModel,
             put(np.bool_(False), P()),
             put(np.int32(0), P()),
             put(np.int32(0), P()),
+            put(np.int32(1), P()),
         )
         failed = overflow = False
         for ci in range(n_chunks):
-            carry = run(carry, put(ev[ci * chunk:(ci + 1) * chunk], P()))
-            failed = bool(carry[6])
-            overflow = bool(carry[8])
+            carry, flags = run(carry, put(ev[ci * chunk:(ci + 1) * chunk], P()))
+            fl = np.asarray(flags)
+            failed, overflow = bool(fl[0]), bool(fl[1])
             if failed or overflow:
                 break
         if overflow and cap < max_capacity_per_shard:
