@@ -49,17 +49,37 @@ class Linearizable(Checker):
             if jm is None:
                 return {"valid": UNKNOWN,
                         "error": "model has no device tier; use cpu"}
-            return wgl_tpu.check(jm, history, **self.engine_opts)
-        if algo in ("cpu", "linear", "wgl"):
+            res = wgl_tpu.check(jm, history, **self.engine_opts)
+        elif algo in ("cpu", "linear", "wgl"):
             if cm is None:
                 return {"valid": UNKNOWN, "error": "no host-tier model"}
             try:
-                return wgl_cpu.check(cm, history)
+                res = wgl_cpu.check(cm, history)
             except wgl_cpu.SearchExploded as e:
                 return {"valid": UNKNOWN, "error": str(e)}
-        if algo == "competition":
-            return self._competition(test, history)
-        return {"valid": UNKNOWN, "error": f"unknown algorithm {algo!r}"}
+        elif algo == "competition":
+            res = self._competition(test, history)
+        else:
+            return {"valid": UNKNOWN, "error": f"unknown algorithm {algo!r}"}
+        if res.get("valid") is False:
+            self._render(test, history, res, opts)
+        return res
+
+    def _render(self, test, history, res, opts) -> None:
+        """Write linear.svg next to the results (knossos.linear.report
+        parity, checker.clj:207-211).  Best-effort: rendering trouble must
+        never mask the verdict."""
+        import os
+        d = (opts or {}).get("store_dir") or (test or {}).get("store_dir")
+        if not d:
+            return
+        try:
+            from jepsen_tpu.checker.render import render_analysis
+            path = render_analysis(history, res, os.path.join(d, "linear.svg"))
+            if path:
+                res["render"] = path
+        except Exception as e:  # noqa: BLE001
+            res["render-error"] = str(e)
 
     def _competition(self, test, history):
         """Race the device engine and the host oracle; first definite verdict

@@ -1,0 +1,150 @@
+"""SVG rendering of a linearizability failure.
+
+Parity: knossos.linear.report/render-analysis! (invoked by the reference at
+jepsen/src/jepsen/checker.clj:207-211 to write ``linear.svg`` next to the
+results).  The drawing is the same idea re-done from scratch: the
+neighborhood of the failing operation as a per-process timeline — one row
+per process, one bar per op spanning invocation→completion, the crashed
+(info) ops open-ended, the failing op outlined in red — plus the surviving
+configurations ("final configs") the search held just before it ran out of
+legal linearizations.
+
+Pure-stdlib SVG emission; no plotting dependency.
+"""
+
+from __future__ import annotations
+
+import html
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK
+
+_FILL = {OK: "#a6d9a1", INFO: "#f5d06c", FAIL: "#f0a58f", None: "#d8d8d8"}
+
+ROW_H = 26
+BAR_H = 18
+LEFT = 90
+WIDTH = 960
+TOP = 34
+CONTEXT_OPS = 24  # completed ops of context drawn before the failing op
+
+
+def render_analysis(history: History, analysis: Dict[str, Any],
+                    path: str) -> Optional[str]:
+    """Write an SVG for a failed analysis; returns the path (None if the
+    analysis has no failing op to draw)."""
+    bad = analysis.get("op")
+    if analysis.get("valid") is True or not bad:
+        return None
+    svg = render_svg(history, analysis)
+    with open(path, "w") as f:
+        f.write(svg)
+    return path
+
+
+def render_svg(history: History, analysis: Dict[str, Any]) -> str:
+    bad = analysis["op"]
+    h = history.client_ops()
+    pairs = h.pair_index()
+
+    # Collect (invoke, complete) spans; remember the failing one.  Handmade
+    # histories may lack times — fall back to history position.
+    def t_of(op, i):
+        return op.time if op.time is not None else i
+
+    spans: List[Dict[str, Any]] = []
+    for i, op in enumerate(h):
+        if op.type != INVOKE:
+            continue
+        j = int(pairs[i]) if pairs[i] is not None else -1
+        comp = h[j] if j >= 0 else None
+        spans.append({
+            "op": op, "comp": comp,
+            "t0": t_of(op, i),
+            "t1": t_of(comp, j) if comp is not None else None,
+            "bad": bad is not None and op.index == bad.get("index"),
+        })
+    bad_k = next((k for k, s in enumerate(spans) if s["bad"]), None)
+    if bad_k is None:
+        # fall back: draw the tail of the history
+        bad_k = len(spans) - 1
+    lo = max(0, bad_k - CONTEXT_OPS)
+    view = [s for s in spans[lo:bad_k + 1]]
+    # plus any still-pending ops invoked before the failing op completes
+    t_end = view[-1]["t1"] or view[-1]["t0"]
+    for s in spans[:lo]:
+        if s["t1"] is None or s["t1"] >= view[0]["t0"]:
+            view.append(s)
+
+    times = [s["t0"] for s in view] + [s["t1"] for s in view if s["t1"]]
+    t_min, t_max = min(times), max(max(times), t_end)
+    t_span = max(t_max - t_min, 1)
+
+    def x(t):
+        return LEFT + (WIDTH - LEFT - 20) * (t - t_min) / t_span
+
+    procs = sorted({s["op"].process for s in view}, key=str)
+    rows = {p: i for i, p in enumerate(procs)}
+    height = TOP + ROW_H * len(procs) + 30
+
+    parts = []
+    for p in procs:
+        y = TOP + rows[p] * ROW_H
+        parts.append(f'<text x="4" y="{y + BAR_H - 4}" font-size="11" '
+                     f'font-family="monospace">{html.escape(str(p))}</text>')
+        parts.append(f'<line x1="{LEFT}" y1="{y + BAR_H / 2}" '
+                     f'x2="{WIDTH - 10}" y2="{y + BAR_H / 2}" '
+                     f'stroke="#eee"/>')
+    for s in view:
+        op, comp = s["op"], s["comp"]
+        y = TOP + rows[op.process] * ROW_H
+        x0 = x(s["t0"])
+        x1 = x(s["t1"]) if s["t1"] is not None else WIDTH - 12
+        ctype = comp.type if comp is not None else INFO
+        fill = _FILL.get(ctype, _FILL[None])
+        stroke = "#d62728" if s["bad"] else "#666"
+        sw = 2.5 if s["bad"] else 0.75
+        label = f"{op.f} {_short(op.value)}"
+        if comp is not None and comp.value is not None and ctype == OK:
+            label = f"{op.f} {_short(comp.value)}"
+        parts.append(
+            f'<rect x="{x0:.1f}" y="{y}" width="{max(x1 - x0, 3):.1f}" '
+            f'height="{BAR_H}" rx="3" fill="{fill}" stroke="{stroke}" '
+            f'stroke-width="{sw}"/>')
+        parts.append(
+            f'<text x="{x0 + 3:.1f}" y="{y + BAR_H - 5}" font-size="10" '
+            f'font-family="monospace">{html.escape(label)}</text>')
+
+    # Final-configs panel (from the search / witness)
+    finals = (analysis.get("final-configs")
+              or (analysis.get("witness") or {}).get("final-configs") or [])
+    fy = height
+    lines = []
+    for c in finals[:6]:
+        pend = ", ".join(o.get("f", "?") + "=" + _short(o.get("value"))
+                         for o in c.get("linearized-pending", []))
+        lines.append(f"state {c.get('model')}"
+                     + (f"  after linearizing [{pend}]" if pend else ""))
+    if lines:
+        height += 16 * (len(lines) + 1) + 8
+        parts.append(f'<text x="8" y="{fy + 12}" font-size="12" '
+                     f'font-weight="bold" font-family="monospace">'
+                     f'Surviving configurations before '
+                     f'{html.escape(str(bad.get("f")))} completed:</text>')
+        for i, ln in enumerate(lines):
+            parts.append(f'<text x="16" y="{fy + 28 + 16 * i}" font-size="11" '
+                         f'font-family="monospace">{html.escape(ln)}</text>')
+
+    title = (f'not linearizable: {bad.get("f")} '
+             f'{_short(bad.get("value"))} by process {bad.get("process")}')
+    head = (f'<svg xmlns="http://www.w3.org/2000/svg" width="{WIDTH}" '
+            f'height="{height}">'
+            f'<rect width="100%" height="100%" fill="white"/>'
+            f'<text x="8" y="18" font-size="13" font-weight="bold" '
+            f'font-family="monospace">{html.escape(title)}</text>')
+    return head + "".join(parts) + "</svg>"
+
+
+def _short(v: Any, n: int = 24) -> str:
+    s = "nil" if v is None else str(v)
+    return s if len(s) <= n else s[:n - 1] + "…"

@@ -62,11 +62,17 @@ class AppendChecker(Checker):
 
 
 class WrChecker(Checker):
-    def __init__(self, realtime: bool = False):
+    def __init__(self, realtime: bool = False,
+                 sequential_keys: bool = False,
+                 linearizable_keys: bool = False):
         self.realtime = realtime
+        self.sequential_keys = sequential_keys
+        self.linearizable_keys = linearizable_keys
 
     def check(self, test, history: History, opts=None):
-        return rw_register.check(history, realtime=self.realtime)
+        return rw_register.check(history, realtime=self.realtime,
+                                 sequential_keys=self.sequential_keys,
+                                 linearizable_keys=self.linearizable_keys)
 
 
 def append_workload(keys: int = 8, **kw) -> Dict[str, Any]:

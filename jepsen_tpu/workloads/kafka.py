@@ -1,27 +1,40 @@
 """Kafka-style log workload: sends, polls, and offset/order analyses.
 
 Parity: jepsen.tests.kafka (jepsen/src/jepsen/tests/kafka.clj): transactions
-of ``send``/``poll`` micro-ops against partitioned logs, analyzed for
-log-specific anomalies (kafka.clj's lost-write, duplicate, aborted-read,
-poll-skip, nonmonotonic-poll, unseen analyses, checker at kafka.clj:2049,
-workload at 2106).
+of ``send``/``poll`` micro-ops against partitioned logs, plus consumer-group
+``assign``/``subscribe`` control ops, analyzed for log-specific anomalies
+(kafka.clj's lost-write, duplicate, aborted-read, poll-skip,
+nonmonotonic-poll, int-send-skip, nonmonotonic-send, unseen analyses;
+checker at kafka.clj:2049, workload at 2106).
 
 Op language (completed mops):
   ["send", k, [offset, value]]    — producer appended value at offset
                                     (invocation carries ["send", k, value])
   ["poll", {k: [[offset, value], ...]}]
                                   — consumer read records, per partition
+Control ops (not txns):
+  {"f": "assign",    "value": [k, ...]}   — consumer now owns exactly these
+                                            partitions; poll positions reset
+  {"f": "subscribe", "value": [k, ...]}   — group-managed rebalance; same
+                                            position-reset consequences
+  {"f": "crash"}                          — consumer crashed; fresh state
 
 Anomalies:
-  duplicate        — one value at multiple offsets of a partition
-  lost-write       — acked send never seen although later offsets of the
-                     same partition were observed by some poll
-  aborted-read     — polled value from a failed send
-  poll-skip        — a process's consecutive polls of a partition skip over
-                     offsets that are known to exist
-  nonmonotonic-poll— a process's poll rewinds behind its previous position
-  internal-nonmonotonic — offsets within one poll not strictly ascending
-  unseen           — committed values never observed by any poll (info)
+  duplicate          — one value at multiple offsets of a partition
+  lost-write         — acked send never seen although later offsets of the
+                       same partition were observed by some poll
+  aborted-read       — polled value from a failed send
+  poll-skip          — a process's consecutive polls of a partition (within
+                       one assignment era) skip over known offsets
+  nonmonotonic-poll  — a process's poll rewinds behind its previous
+                       position within one assignment era
+  internal-nonmonotonic — offsets within one poll mop not strictly ascending
+  nonmonotonic-send  — consecutive sends to a partition within one txn
+                       landed at non-increasing offsets
+  int-send-skip      — consecutive sends to a partition within one txn
+                       skipped over offsets known to exist
+  offset-conflict    — two values acked at one (partition, offset)
+  unseen             — committed values never observed by any poll (info)
 """
 
 from __future__ import annotations
@@ -36,10 +49,19 @@ from jepsen_tpu.checker.core import Checker, UNKNOWN
 from jepsen_tpu.history import FAIL, History, OK
 
 
-def generator(partitions: int = 4, max_mops: int = 3):
+def generator(partitions: int = 4, max_mops: int = 3,
+              sub_p: float = 0.05):
+    """Mix of txn ops and occasional assign/subscribe rebalances
+    (kafka.clj's generator interleaves the same way)."""
     counter = itertools.count(1)
 
     def one():
+        r = random.random()
+        if r < sub_p:
+            ks = sorted(random.sample(range(partitions),
+                                      random.randint(1, partitions)))
+            f = "assign" if random.random() < 0.5 else "subscribe"
+            return {"f": f, "value": ks}
         mops = []
         for _ in range(random.randint(1, max_mops)):
             k = random.randrange(partitions)
@@ -56,15 +78,20 @@ class KafkaChecker(Checker):
     def check(self, test, history: History, opts=None):
         sends_ok: Dict[Tuple[Any, int], Any] = {}   # (k, offset) -> value
         send_of_value: Dict[Tuple[Any, Any], int] = {}  # (k, value) -> offset
-        failed_values: set = set()                   # (k, value) of failed sends
-        polls: List[Tuple[Any, Dict]] = []           # (process, {k: [[o,v]..]})
+        failed_values: set = set()                  # (k, value) of failed sends
+        n_polls = 0
         anomalies: Dict[str, List[Any]] = defaultdict(list)
 
+        # Pass 1: index every offset the history proves to exist — acked
+        # sends AND polled records (an offset whose send crashed is still
+        # real once any poll saw it) — so the ordered pass can ask "is
+        # offset o known?" for the skip analyses with full knowledge.
+        observed: Dict[Any, set] = defaultdict(set)
         for op in history:
-            if not isinstance(op.value, (list, tuple)):
-                continue
-            if op.type == OK:
+            if op.type == OK and isinstance(op.value, (list, tuple)):
                 for mop in op.value:
+                    if not (isinstance(mop, (list, tuple)) and mop):
+                        continue
                     if mop[0] == "send":
                         k, ov = mop[1], mop[2]
                         if isinstance(ov, (list, tuple)) and len(ov) == 2:
@@ -81,58 +108,95 @@ class KafkaChecker(Checker):
                             sends_ok[(k, o)] = v
                             send_of_value[(k, v)] = o
                     elif mop[0] == "poll" and isinstance(mop[1], dict):
-                        polls.append((op.process, mop[1]))
-            elif op.type == FAIL:
+                        for k, recs in mop[1].items():
+                            for o, _v in recs:
+                                observed[k].add(o)
+            elif op.type == FAIL and isinstance(op.value, (list, tuple)):
                 for mop in op.value:
-                    if mop[0] == "send":
+                    if isinstance(mop, (list, tuple)) and mop \
+                            and mop[0] == "send":
                         failed_values.add((mop[1], mop[2]))
 
-        # observed offsets per partition + in-poll order + aborted reads
-        observed: Dict[Any, set] = defaultdict(set)
-        for proc, pd in polls:
-            for k, recs in pd.items():
-                last = None
-                for o, v in recs:
-                    observed[k].add(o)
-                    if (k, v) in failed_values:
-                        anomalies["aborted-read"].append(
-                            {"key": k, "offset": o, "value": v})
-                    if (k, o) in sends_ok and sends_ok[(k, o)] != v:
-                        anomalies["poll-send-mismatch"].append(
-                            {"key": k, "offset": o,
-                             "polled": v, "sent": sends_ok[(k, o)]})
-                    if (k, v) in send_of_value and \
-                            send_of_value[(k, v)] != o:
-                        anomalies["duplicate"].append(
-                            {"key": k, "value": v,
-                             "offsets": [send_of_value[(k, v)], o]})
-                    if last is not None and o <= last:
-                        anomalies["internal-nonmonotonic"].append(
-                            {"key": k, "offsets": [last, o]})
-                    last = o
+        def known(k, o):
+            return (k, o) in sends_ok or o in observed[k]
 
-        # per-process poll position tracking: skips and rewinds
+        # Pass 2, in history order: per-process poll positions within
+        # assignment eras, per-txn send monotonicity, per-poll order.
         pos: Dict[Tuple[Any, Any], int] = {}  # (process, k) -> last offset
-        for proc, pd in polls:
-            for k, recs in pd.items():
-                if not recs:
+        for op in history:
+            if op.type != OK:
+                continue
+            if op.f in ("assign", "subscribe", "crash"):
+                # rebalance / restart: all positions of this process reset —
+                # a later poll legitimately rewinds or jumps (kafka.clj
+                # treats cross-rebalance polls as a fresh era).
+                for pk in [pk for pk in pos if pk[0] == op.process]:
+                    del pos[pk]
+                continue
+            if not isinstance(op.value, (list, tuple)):
+                continue
+            txn_send_last: Dict[Any, int] = {}  # k -> last offset this txn
+            for mop in op.value:
+                if not isinstance(mop, (list, tuple)) or not mop:
                     continue
-                first, last = recs[0][0], recs[-1][0]
-                prev = pos.get((proc, k))
-                if prev is not None:
-                    if first <= prev:
-                        anomalies["nonmonotonic-poll"].append(
-                            {"process": proc, "key": k,
-                             "prev": prev, "rewound-to": first})
-                    else:
-                        skipped = [o for o in range(prev + 1, first)
-                                   if (k, o) in sends_ok or o in observed[k]]
-                        if skipped:
-                            anomalies["poll-skip"].append(
-                                {"process": proc, "key": k,
-                                 "prev": prev, "next": first,
-                                 "skipped": skipped})
-                pos[(proc, k)] = last
+                if mop[0] == "send":
+                    k, ov = mop[1], mop[2]
+                    if not (isinstance(ov, (list, tuple)) and len(ov) == 2):
+                        continue
+                    o, _v = ov
+                    prev = txn_send_last.get(k)
+                    if prev is not None:
+                        if o <= prev:
+                            anomalies["nonmonotonic-send"].append(
+                                {"key": k, "offsets": [prev, o]})
+                        else:
+                            skipped = [oo for oo in range(prev + 1, o)
+                                       if known(k, oo)]
+                            if skipped:
+                                anomalies["int-send-skip"].append(
+                                    {"key": k, "offsets": [prev, o],
+                                     "skipped": skipped})
+                    txn_send_last[k] = o
+                elif mop[0] == "poll" and isinstance(mop[1], dict):
+                    pd = mop[1]
+                    n_polls += 1
+                    for k, recs in pd.items():
+                        last = None
+                        for o, v in recs:
+                            if (k, v) in failed_values:
+                                anomalies["aborted-read"].append(
+                                    {"key": k, "offset": o, "value": v})
+                            if (k, o) in sends_ok and sends_ok[(k, o)] != v:
+                                anomalies["poll-send-mismatch"].append(
+                                    {"key": k, "offset": o,
+                                     "polled": v, "sent": sends_ok[(k, o)]})
+                            if (k, v) in send_of_value and \
+                                    send_of_value[(k, v)] != o:
+                                anomalies["duplicate"].append(
+                                    {"key": k, "value": v,
+                                     "offsets": [send_of_value[(k, v)], o]})
+                            if last is not None and o <= last:
+                                anomalies["internal-nonmonotonic"].append(
+                                    {"key": k, "offsets": [last, o]})
+                            last = o
+                        if not recs:
+                            continue
+                        first = recs[0][0]
+                        prev = pos.get((op.process, k))
+                        if prev is not None:
+                            if first <= prev:
+                                anomalies["nonmonotonic-poll"].append(
+                                    {"process": op.process, "key": k,
+                                     "prev": prev, "rewound-to": first})
+                            else:
+                                skipped = [o for o in range(prev + 1, first)
+                                           if known(k, o)]
+                                if skipped:
+                                    anomalies["poll-skip"].append(
+                                        {"process": op.process, "key": k,
+                                         "prev": prev, "next": first,
+                                         "skipped": skipped})
+                        pos[(op.process, k)] = recs[-1][0]
 
         # lost writes: acked send at offset o never observed, while some
         # poll observed an offset > o in that partition
@@ -148,11 +212,11 @@ class KafkaChecker(Checker):
                   and not (observed[k] and max(observed[k]) > o)]
 
         hard = {k: v for k, v in anomalies.items()}
-        return {"valid": (UNKNOWN if (not hard and unseen and not polls)
+        return {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
                           else not hard),
                 "anomaly-types": sorted(hard),
                 "anomalies": {k: v[:8] for k, v in hard.items()},
-                "sends": len(sends_ok), "polls": len(polls),
+                "sends": len(sends_ok), "polls": n_polls,
                 "unseen-count": len(unseen), "unseen": unseen[:8]}
 
 

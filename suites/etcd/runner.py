@@ -66,10 +66,12 @@ def etcd_test(opts: Dict[str, Any]) -> Dict[str, Any]:
         parts = [gen.any_gen(client_gen,
                              gen.nemesis(gen.time_limit(time_limit,
                                                         pkg.generator)))]
+    # final phases barrier on quiescence (gen.synchronize) so a final read
+    # can't linearize before a still-in-flight op from the main phase
     if pkg.final_generator is not None:
-        parts.append(gen.nemesis(gen.lift(pkg.final_generator)))
+        parts.append(gen.synchronize(gen.nemesis(gen.lift(pkg.final_generator))))
     if wl.get("final_generator") is not None:
-        parts.append(gen.clients(gen.lift(wl["final_generator"])))
+        parts.append(gen.synchronize(gen.clients(gen.lift(wl["final_generator"]))))
 
     return {**opts,
             "name": f"etcd-{workload_name}-{nemesis_name}",

@@ -185,3 +185,50 @@ class TestLinearizableFacade:
     def test_host_model_cannot_run_tpu(self):
         c = linearizable(CASRegister(), algorithm="tpu")
         assert c.check(T, self.H_GOOD)["valid"] == UNKNOWN
+
+
+class TestRenderAnalysis:
+    """linear.svg failure rendering (knossos.linear.report parity)."""
+
+    def _bad_history(self):
+        return History([
+            mk(0, INVOKE, "write", 1, time=0), mk(0, OK, "write", 1, time=10),
+            mk(1, INVOKE, "cas", (1, 2), time=12),
+            mk(1, OK, "cas", (1, 2), time=20),
+            mk(0, INVOKE, "read", None, time=22),
+            mk(0, OK, "read", 3, time=30),
+        ])
+
+    def test_svg_written_on_failure(self, tmp_path):
+        c = linearizable(CASRegister(), algorithm="cpu")
+        r = c.check({"store_dir": str(tmp_path)}, self._bad_history())
+        assert r["valid"] is False
+        svg = tmp_path / "linear.svg"
+        assert svg.exists()
+        body = svg.read_text()
+        assert body.startswith("<svg")
+        assert "not linearizable" in body
+        assert "read" in body
+        # final configs from the search are listed
+        assert "Surviving configurations" in body
+
+    def test_no_svg_on_success(self, tmp_path):
+        c = linearizable(CASRegister(), algorithm="cpu")
+        h = History([mk(0, INVOKE, "write", 1, time=0),
+                     mk(0, OK, "write", 1, time=5)])
+        r = c.check({"store_dir": str(tmp_path)}, h)
+        assert r["valid"] is True
+        assert not (tmp_path / "linear.svg").exists()
+
+    def test_tpu_engine_failure_renders_too(self, tmp_path):
+        c = linearizable(get_model("cas-register"), capacity=64, chunk=16)
+        r = c.check({"store_dir": str(tmp_path)}, self._bad_history())
+        assert r["valid"] is False
+        assert (tmp_path / "linear.svg").exists()
+
+    def test_untimed_history_renders(self, tmp_path):
+        c = linearizable(CASRegister(), algorithm="cpu")
+        r = c.check({"store_dir": str(tmp_path)},
+                    TestLinearizableFacade.H_BAD)
+        assert r["valid"] is False
+        assert (tmp_path / "linear.svg").exists()

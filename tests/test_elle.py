@@ -98,6 +98,59 @@ class TestListAppend:
         r = list_append.check(h)
         assert "G1c" in r["anomaly-types"], r
 
+    def test_g1b_intermediate_read(self):
+        # txn 0 writes x=1 then x=2 (1 is intermediate); txn 1 reads x=1
+        h = History(
+            ok_txn(0, [["w", "x", 1], ["w", "x", 2]]) +
+            ok_txn(1, [["r", "x", 1]]))
+        assert "G1b" in rw_register.check(h)["anomaly-types"]
+
+    def test_initial_state_rw_edge(self):
+        # Write skew via the initial-state version source: each txn reads
+        # the other's key as nil while the other writes it, so
+        # t0 -rw(x)-> t1 and t1 -rw(y)-> t0 — a pure-anti-dependency G2
+        # cycle only visible because nil precedes every written value.
+        h = History(
+            ok_txn(0, [["r", "x", None], ["w", "y", 1]]) +
+            ok_txn(1, [["r", "y", None], ["w", "x", 1]]))
+        r = rw_register.check(h)
+        assert r["valid"] is False
+        assert any(t.startswith("G2") or t == "G-single"
+                   for t in r["anomaly-types"]), r
+
+    def test_cyclic_versions(self):
+        # txn 0: reads x=1, writes x=2; txn 1: reads x=2, writes x=1
+        # version order 1<2 and 2<1 -> cyclic-versions
+        h = History(
+            ok_txn(0, [["r", "x", 1], ["w", "x", 2]]) +
+            ok_txn(1, [["r", "x", 2], ["w", "x", 1]]))
+        assert "cyclic-versions" in rw_register.check(h)["anomaly-types"]
+
+    def test_sequential_keys_orders_writes(self):
+        # same process writes x=1 then x=2; a third txn reads 2 then a
+        # LATER txn reads 1: with sequential order 1<2, reader of 1 gets an
+        # rw edge to the writer of 2; combined with wr edges there is a
+        # cycle witnessing the stale read.
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(0, [["w", "x", 2]]) +
+            ok_txn(1, [["r", "x", 2], ["w", "y", 1]]) +
+            ok_txn(2, [["r", "y", 1], ["r", "x", 1]]))
+        r0 = rw_register.check(h)
+        assert r0["valid"] is True  # without the assumption: no cycle
+        r = rw_register.check(h, sequential_keys=True)
+        assert r["valid"] is False, r
+
+    def test_linearizable_keys_orders_writes(self):
+        # two different processes write x; realtime order x: 1 then 2.
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(1, [["w", "x", 2]]) +
+            ok_txn(2, [["r", "x", 2], ["w", "y", 1]]) +
+            ok_txn(3, [["r", "y", 1], ["r", "x", 1]]))
+        r = rw_register.check(h, linearizable_keys=True)
+        assert r["valid"] is False, r
+
     def test_g_single(self):
         h = History(
             ok_txn(0, [["r", "z", []], ["r", "x", [1]]]) +
@@ -172,3 +225,23 @@ class TestEndToEnd:
         h = interpreter.run(test)
         r = list_append.check(h)
         assert r["valid"] is True, r["anomaly-types"]
+
+
+class TestRwRegisterEdgeCases:
+    def test_written_none_is_not_cyclic(self):
+        h = History(ok_txn(0, [["w", "x", None]]))
+        r = rw_register.check(h)
+        assert "cyclic-versions" not in r["anomaly-types"], r
+
+    def test_linearizable_keys_transitive_chain(self):
+        # three sequential writers; a stale read of the first value after
+        # the third write is a cycle only via the transitive realtime
+        # version order 1 < 2 < 3 (sparse edge set must preserve it).
+        h = History(
+            ok_txn(0, [["w", "x", 1]]) +
+            ok_txn(1, [["w", "x", 2]]) +
+            ok_txn(2, [["w", "x", 3]]) +
+            ok_txn(3, [["r", "x", 3], ["w", "y", 1]]) +
+            ok_txn(4, [["r", "y", 1], ["r", "x", 1]]))
+        r = rw_register.check(h, linearizable_keys=True)
+        assert r["valid"] is False, r

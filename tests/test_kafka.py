@@ -76,3 +76,77 @@ class TestKafka:
         r = check(h)
         assert r["valid"] is True
         assert r["unseen-count"] == 1
+
+
+def ctl(process, f, value=None):
+    return [Op(process=process, type=INVOKE, f=f, value=value),
+            Op(process=process, type=OK, f=f, value=value)]
+
+
+class TestKafkaRebalance:
+    """assign/subscribe reset poll positions (kafka.clj era semantics)."""
+
+    def test_rewind_after_assign_is_legal(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]) +
+             ctl(1, "assign", [0]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))   # rewound, but new era
+        r = check(h)
+        assert r["valid"] is True, r
+
+    def test_rewind_without_assign_is_nonmonotonic(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        assert "nonmonotonic-poll" in check(h)["anomaly-types"]
+
+    def test_skip_after_subscribe_is_legal(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(0, [["send", 0, [2, 12]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]) +
+             ctl(1, "subscribe", [0]) +
+             ok(1, [["poll", {0: [[2, 12]]}]]))   # skipped 1, but new era
+        r = check(h)
+        assert "poll-skip" not in r["anomaly-types"], r
+
+    def test_assign_only_resets_that_process(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]) +
+             ctl(2, "assign", [0]) +               # other consumer
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        assert "nonmonotonic-poll" in check(h)["anomaly-types"]
+
+
+class TestKafkaTxnSends:
+    """Intra-transaction send offset analyses."""
+
+    def test_nonmonotonic_send(self):
+        h = ok(0, [["send", 0, [5, 10]], ["send", 0, [3, 11]]])
+        assert "nonmonotonic-send" in check(h)["anomaly-types"]
+
+    def test_int_send_skip(self):
+        # another producer's send proves offset 1 exists between this
+        # txn's sends at 0 and 2
+        h = (ok(1, [["send", 0, [1, 99]]]) +
+             ok(0, [["send", 0, [0, 10]], ["send", 0, [2, 11]]]))
+        assert "int-send-skip" in check(h)["anomaly-types"]
+
+    def test_consecutive_offsets_clean(self):
+        h = (ok(0, [["send", 0, [0, 10]], ["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]))
+        r = check(h)
+        assert r["valid"] is True, r
+
+
+class TestKafkaSkipEvidence:
+    def test_skip_evidenced_only_by_later_poll(self):
+        # offset 1's send was never acked, but a later poll proves it
+        # exists — the earlier skip over it is still a poll-skip.
+        h = (ok(1, [["poll", {0: [[0, 10]]}]]) +
+             ok(1, [["poll", {0: [[2, 12]]}]]) +
+             ok(2, [["poll", {0: [[1, 11]]}]]))
+        assert "poll-skip" in check(h)["anomaly-types"]

@@ -88,8 +88,9 @@ class TestSets:
     def test_set_workload(self):
         wl = sets.workload()
         test = {"concurrency": 3, "client": SetClient(),
-                "generator": [gen.clients(gen.limit(30, wl["generator"])),
-                              gen.clients(wl["final_generator"])]}
+                "generator": gen.phases(
+                    gen.clients(gen.limit(30, wl["generator"])),
+                    gen.clients(wl["final_generator"]))}
         h = interpreter.run(test)
         r = wl["checker"].check(test, h)
         assert r["valid"] is True, r
@@ -97,8 +98,9 @@ class TestSets:
     def test_lossy_set_detected(self):
         wl = sets.workload()
         test = {"concurrency": 3, "client": SetClient(lossy=True),
-                "generator": [gen.clients(gen.limit(30, wl["generator"])),
-                              gen.clients(wl["final_generator"])]}
+                "generator": gen.phases(
+                    gen.clients(gen.limit(30, wl["generator"])),
+                    gen.clients(wl["final_generator"]))}
         h = interpreter.run(test)
         r = wl["checker"].check(test, h)
         assert r["valid"] is False
