@@ -232,6 +232,13 @@ class TotalQueueChecker(Checker):
                 enqueues[op.value] += 1
             elif op.f == "dequeue" and op.type == OK:
                 dequeues[op.value] += 1
+            elif op.f == "drain" and op.type == OK \
+                    and isinstance(op.value, (list, tuple)):
+                # client-side drain loops return everything they pulled
+                # (the reference logs these as individual dequeues,
+                # disque.clj:216-240)
+                for v in op.value:
+                    dequeues[v] += 1
         lost = {v: n - dequeues[v] for v, n in enqueues.items()
                 if dequeues[v] < n}
         unexpected = {v: n for v, n in dequeues.items() if attempts[v] == 0}

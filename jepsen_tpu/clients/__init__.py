@@ -1,0 +1,19 @@
+"""Wire-protocol clients for the database suites.
+
+The reference's suites each pull a JVM driver (avout/ZK, jdbc, jedis, …);
+here the suites speak the databases' actual wire protocols through small
+stdlib-socket clients, so a suite is runnable with zero external driver
+dependencies and testable against in-process fake servers:
+
+- :mod:`resp`    — Redis serialization protocol (raftis, disque)
+- :mod:`pgwire`  — PostgreSQL simple-query protocol (postgres-rds, stolon,
+                   cockroachdb, yugabyte YSQL)
+- :mod:`mysql`   — MySQL client/server protocol (galera, percona,
+                   mysql-cluster, tidb)
+- :mod:`http`    — thin JSON-over-HTTP helper (consul, elasticsearch,
+                   crate, dgraph, chronos, ignite, rethinkdb-admin, …)
+- :mod:`zk`      — ZooKeeper jute subset (zookeeper)
+- :mod:`mongo`   — MongoDB OP_MSG + minimal BSON (mongodb suites)
+"""
+
+from jepsen_tpu.clients import http, mongo, mysql, pgwire, resp, zk  # noqa: F401

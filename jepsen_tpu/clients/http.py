@@ -1,0 +1,67 @@
+"""Thin JSON-over-HTTP client for REST-ish databases.
+
+The consul/elasticsearch/crate/dgraph/chronos/ignite suites all talk HTTP
+(the reference uses clj-http, e.g. consul/src/jepsen/consul/client.clj);
+urllib with explicit timeouts and error mapping is all they need.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+class HttpClient:
+    def __init__(self, host: str, port: int, timeout: float = 5.0,
+                 scheme: str = "http"):
+        self.base = f"{scheme}://{host}:{port}"
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                body: Any = None, raw: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                ) -> Tuple[int, Any]:
+        """One request; returns (status, parsed-JSON-or-text).  4xx/5xx raise
+        HttpError (with the body preserved for checkers)."""
+        data = raw
+        hdrs = dict(headers or {})
+        if body is not None:
+            data = json.dumps(body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method, headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, _parse(r.read())
+        except urllib.error.HTTPError as e:
+            raise HttpError(e.code, e.read().decode(errors="replace")) from e
+
+    def get(self, path: str, **kw):
+        return self.request("GET", path, **kw)
+
+    def put(self, path: str, body: Any = None, **kw):
+        return self.request("PUT", path, body=body, **kw)
+
+    def post(self, path: str, body: Any = None, **kw):
+        return self.request("POST", path, body=body, **kw)
+
+    def delete(self, path: str, **kw):
+        return self.request("DELETE", path, **kw)
+
+
+def _parse(b: bytes) -> Any:
+    if not b:
+        return None
+    try:
+        return json.loads(b)
+    except ValueError:
+        return b.decode(errors="replace")

@@ -1,0 +1,192 @@
+"""MongoDB client — OP_MSG with a minimal BSON codec.
+
+Used by the mongodb-rocks / mongodb-smartos suites (the reference drives
+mongo through the Java driver, mongodb-smartos/src/jepsen/mongodb/*.clj);
+the modern wire protocol is a single message kind (OP_MSG, opcode 2013)
+carrying one BSON command document, which covers find / insert / update /
+findAndModify (the CAS primitive) and replSetGetStatus for primary
+discovery.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_PORT = 27017
+OP_MSG = 2013
+
+
+# --------------------------------------------------------------------------
+# BSON (subset: the types the suites' documents use)
+# --------------------------------------------------------------------------
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    body = b"".join(_elem(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\0"
+
+
+def _elem(k: str, v: Any) -> bytes:
+    key = k.encode() + b"\0"
+    if isinstance(v, bool):
+        return b"\x08" + key + (b"\x01" if v else b"\x00")
+    if isinstance(v, int):
+        if -(2 ** 31) <= v < 2 ** 31:
+            return b"\x10" + key + struct.pack("<i", v)
+        return b"\x12" + key + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + key + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + key + struct.pack("<i", len(b) + 1) + b + b"\0"
+    if isinstance(v, bytes):
+        return b"\x05" + key + struct.pack("<i", len(v)) + b"\x00" + v
+    if v is None:
+        return b"\x0a" + key
+    if isinstance(v, dict):
+        return b"\x03" + key + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        return b"\x04" + key + bson_encode(
+            {str(i): x for i, x in enumerate(v)})
+    raise TypeError(f"bson: unsupported type {type(v)}")
+
+
+def bson_decode(b: bytes) -> Dict[str, Any]:
+    doc, _ = _dec_doc(b, 0)
+    return doc
+
+
+def _dec_doc(b: bytes, off: int) -> Tuple[Dict[str, Any], int]:
+    (ln,) = struct.unpack_from("<i", b, off)
+    end = off + ln - 1
+    off += 4
+    out: Dict[str, Any] = {}
+    while off < end:
+        t = b[off]
+        off += 1
+        z = b.index(b"\0", off)
+        k = b[off:z].decode()
+        off = z + 1
+        if t == 0x01:
+            (v,) = struct.unpack_from("<d", b, off)
+            off += 8
+        elif t == 0x02:
+            (sl,) = struct.unpack_from("<i", b, off)
+            v = b[off + 4:off + 4 + sl - 1].decode()
+            off += 4 + sl
+        elif t in (0x03, 0x04):
+            v, off = _dec_doc(b, off)
+            if t == 0x04:
+                v = [v[str(i)] for i in range(len(v))]
+        elif t == 0x05:
+            (bl,) = struct.unpack_from("<i", b, off)
+            v = b[off + 5:off + 5 + bl]
+            off += 5 + bl
+        elif t == 0x07:
+            v = b[off:off + 12].hex()
+            off += 12
+        elif t == 0x08:
+            v = b[off] == 1
+            off += 1
+        elif t == 0x09 or t == 0x12:
+            (v,) = struct.unpack_from("<q", b, off)
+            off += 8
+        elif t == 0x0A:
+            v = None
+        elif t == 0x10:
+            (v,) = struct.unpack_from("<i", b, off)
+            off += 4
+        elif t == 0x11:
+            (v,) = struct.unpack_from("<Q", b, off)
+            off += 8
+        else:
+            raise ValueError(f"bson: unsupported type 0x{t:02x}")
+        out[k] = v
+    return out, end + 1
+
+
+# --------------------------------------------------------------------------
+# Client
+# --------------------------------------------------------------------------
+
+class MongoError(Exception):
+    def __init__(self, doc: Dict[str, Any]):
+        self.doc = doc
+        self.code = doc.get("code", 0)
+        super().__init__(doc.get("errmsg", "mongodb error"))
+
+
+class MongoClient:
+    def __init__(self, host: str, port: int = DEFAULT_PORT,
+                 database: str = "jepsen", timeout: float = 10.0):
+        self.addr = (host, port)
+        self.database = database
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.req_id = 0
+
+    def connect(self) -> "MongoClient":
+        self.sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self.buf = b""
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    def command(self, doc: Dict[str, Any],
+                database: Optional[str] = None) -> Dict[str, Any]:
+        """Run one command document; raises MongoError when ok != 1."""
+        if self.sock is None:
+            self.connect()
+        doc = dict(doc)
+        doc["$db"] = database or self.database
+        self.req_id += 1
+        body = struct.pack("<i", 0) + b"\x00" + bson_encode(doc)
+        hdr = struct.pack("<iiii", 16 + len(body), self.req_id, 0, OP_MSG)
+        self.sock.sendall(hdr + body)
+        resp = self._read_msg()
+        if resp.get("ok") != 1 and resp.get("ok") != 1.0:
+            raise MongoError(resp)
+        return resp
+
+    # convenience ops used by the suites
+    def find_one(self, coll: str, flt: Dict[str, Any]) -> Optional[Dict]:
+        r = self.command({"find": coll, "filter": flt, "limit": 1})
+        batch = r.get("cursor", {}).get("firstBatch", [])
+        return batch[0] if batch else None
+
+    def upsert(self, coll: str, flt: Dict[str, Any],
+               update: Dict[str, Any]) -> Dict[str, Any]:
+        return self.command({"update": coll, "updates": [
+            {"q": flt, "u": update, "upsert": True}]})
+
+    def find_and_modify(self, coll: str, query: Dict[str, Any],
+                        update: Dict[str, Any]) -> Optional[Dict]:
+        """The CAS primitive: atomically update iff query matches."""
+        r = self.command({"findAndModify": coll, "query": query,
+                          "update": update})
+        return r.get("value")
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_msg(self) -> Dict[str, Any]:
+        hdr = self._read_exact(16)
+        ln, _rid, _rto, opcode = struct.unpack("<iiii", hdr)
+        body = self._read_exact(ln - 16)
+        if opcode != OP_MSG:
+            raise MongoError({"errmsg": f"unexpected opcode {opcode}"})
+        # flagBits(4) + kind byte + doc
+        return bson_decode(body[5:])

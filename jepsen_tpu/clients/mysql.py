@@ -1,0 +1,193 @@
+"""MySQL client/server protocol — text queries.
+
+Used by the galera, percona, mysql-cluster and tidb suites (the reference
+drives these through jdbc/clojure.java.jdbc, e.g. tidb/src/tidb/sql.clj,
+galera/src/jepsen/galera.clj); COM_QUERY with the text resultset covers the
+bank/register/append workloads.  Auth: mysql_native_password (and servers
+configured with no password).  Error numbers are surfaced so suites can
+split retryable conflicts (1213 deadlock, 1205 lock-wait) from definite
+failures.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import Any, List, Optional, Tuple
+
+DEFAULT_PORT = 3306
+
+CLIENT_LONG_PASSWORD = 0x1
+CLIENT_PROTOCOL_41 = 0x200
+CLIENT_TRANSACTIONS = 0x2000
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x80000
+
+
+class MysqlError(Exception):
+    def __init__(self, errno: int, msg: str):
+        super().__init__(f"({errno}) {msg}")
+        self.errno = errno
+
+    @property
+    def retryable(self) -> bool:
+        return self.errno in (1205, 1213, 1290, 2013, 8002, 8022, 9007)
+
+
+class MysqlClient:
+    def __init__(self, host: str, port: int = DEFAULT_PORT,
+                 user: str = "root", password: str = "",
+                 database: str = "", timeout: float = 10.0):
+        self.addr = (host, port)
+        self.user, self.password, self.database = user, password, database
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.seq = 0
+        self.rowcount = 0  # affected rows of the last statement
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "MysqlClient":
+        self.sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self.buf, self.seq = b"", 0
+        pkt = self._read_packet()
+        if pkt[0] == 0xFF:
+            raise _err(pkt)
+        seed = self._parse_handshake(pkt)
+        caps = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 |
+                CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION |
+                CLIENT_PLUGIN_AUTH)
+        if self.database:
+            caps |= 0x8  # CLIENT_CONNECT_WITH_DB
+        auth = _native_password(self.password, seed)
+        body = (struct.pack("<IIB23x", caps, 1 << 24, 0x21)
+                + self.user.encode() + b"\0"
+                + bytes([len(auth)]) + auth
+                + (self.database.encode() + b"\0" if self.database else b"")
+                + b"mysql_native_password\0")
+        self._send_packet(body)
+        pkt = self._read_packet()
+        if pkt[0] == 0xFF:
+            raise _err(pkt)
+        if pkt[0] == 0xFE:  # AuthSwitchRequest -> resend native password
+            plugin, _, rest = pkt[1:].partition(b"\0")
+            seed2 = rest.rstrip(b"\0")
+            self._send_packet(_native_password(self.password, seed2))
+            pkt = self._read_packet()
+            if pkt[0] == 0xFF:
+                raise _err(pkt)
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.seq = 0
+                self._send_packet(b"\x01")  # COM_QUIT
+                self.sock.close()
+            except OSError:
+                pass
+            finally:
+                self.sock = None
+
+    # -- queries -----------------------------------------------------------
+    def query(self, sql: str) -> List[Tuple[Optional[str], ...]]:
+        """COM_QUERY; returns text rows ([] for OK-only responses)."""
+        if self.sock is None:
+            self.connect()
+        self.seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        pkt = self._read_packet()
+        if pkt[0] == 0xFF:
+            raise _err(pkt)
+        if pkt[0] == 0x00:
+            self.rowcount, _ = _lenenc_int(pkt, 1)  # affected_rows
+            return []  # OK packet (no resultset)
+        ncols, _ = _lenenc_int(pkt, 0)
+        for _ in range(ncols):
+            self._read_packet()  # column definitions
+        pkt = self._read_packet()
+        if pkt[0] == 0xFE and len(pkt) < 9:
+            pkt = self._read_packet()  # EOF after columns
+        rows: List[Tuple[Optional[str], ...]] = []
+        while True:
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                self.rowcount = len(rows)
+                return rows  # EOF
+            if pkt[0] == 0xFF:
+                raise _err(pkt)
+            off, vals = 0, []
+            for _ in range(ncols):
+                if pkt[off] == 0xFB:
+                    vals.append(None)
+                    off += 1
+                else:
+                    n, off = _lenenc_int(pkt, off)
+                    vals.append(pkt[off:off + n].decode())
+                    off += n
+            rows.append(tuple(vals))
+            pkt = self._read_packet()
+
+    # -- transport ---------------------------------------------------------
+    def _send_packet(self, body: bytes) -> None:
+        hdr = struct.pack("<I", len(body))[:3] + bytes([self.seq])
+        self.seq = (self.seq + 1) & 0xFF
+        self.sock.sendall(hdr + body)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_packet(self) -> bytes:
+        hdr = self._read_exact(4)
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        return self._read_exact(ln)
+
+    @staticmethod
+    def _parse_handshake(pkt: bytes) -> bytes:
+        # protocol version (1) + server version (nul-str) + thread id (4)
+        off = 1
+        off = pkt.index(b"\0", off) + 1
+        off += 4
+        seed1 = pkt[off:off + 8]
+        off += 8 + 1  # filler
+        off += 2 + 1 + 2 + 2 + 1 + 10  # caps-lo, charset, status, caps-hi,
+        #                                auth-len, reserved
+        rest = pkt[off:]
+        seed2 = rest[:max(13 - 8, 0)] if not rest else rest.split(b"\0")[0]
+        seed2 = seed2[:12]
+        return seed1 + seed2
+
+
+def _native_password(password: str, seed: bytes) -> bytes:
+    if not password:
+        return b""
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    p3 = hashlib.sha1(seed + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, p3))
+
+
+def _lenenc_int(b: bytes, off: int) -> Tuple[int, int]:
+    v = b[off]
+    if v < 0xFB:
+        return v, off + 1
+    if v == 0xFC:
+        return struct.unpack_from("<H", b, off + 1)[0], off + 3
+    if v == 0xFD:
+        return b[off + 1] | (b[off + 2] << 8) | (b[off + 3] << 16), off + 4
+    return struct.unpack_from("<Q", b, off + 1)[0], off + 9
+
+
+def _err(pkt: bytes) -> MysqlError:
+    errno = struct.unpack_from("<H", pkt, 1)[0]
+    msg = pkt[3:].decode(errors="replace")
+    if msg.startswith("#"):
+        msg = msg[6:]  # strip sql-state marker
+    return MysqlError(errno, msg)

@@ -1,0 +1,155 @@
+"""PostgreSQL wire protocol (v3) client — simple query mode.
+
+Used by the postgres-rds, stolon, cockroachdb and yugabyte(YSQL) suites
+(the reference drives these through JDBC, e.g.
+stolon/src/jepsen/stolon/client.clj, cockroachdb/src/jepsen/cockroach/
+client.clj); the simple-query subprotocol is enough for register/bank/
+append workloads: one round trip per statement, text-format results,
+SQLSTATE surfaced for the retry/definite-failure split every suite needs.
+
+Auth: trust, cleartext password, and md5.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_PORT = 5432
+
+
+class PgError(Exception):
+    def __init__(self, fields: Dict[str, str]):
+        self.fields = fields
+        self.sqlstate = fields.get("C", "")
+        self.severity = fields.get("S", "")
+        super().__init__(fields.get("M", "postgres error"))
+
+    @property
+    def retryable(self) -> bool:
+        """Serialization/deadlock failures: txn may be retried; the op
+        definitely did not commit."""
+        return self.sqlstate in ("40001", "40P01", "CR000")
+
+
+class PgClient:
+    def __init__(self, host: str, port: int = DEFAULT_PORT,
+                 user: str = "postgres", database: str = "postgres",
+                 password: str = "", timeout: float = 10.0):
+        self.addr = (host, port)
+        self.user, self.database, self.password = user, database, password
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.rowcount = 0  # affected rows of the last statement
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "PgClient":
+        self.sock = socket.create_connection(self.addr, timeout=self.timeout)
+        params = (f"user\0{self.user}\0database\0{self.database}\0\0"
+                  .encode())
+        payload = struct.pack("!I", 196608) + params  # protocol 3.0
+        self.sock.sendall(struct.pack("!I", len(payload) + 4) + payload)
+        self._auth()
+        return self
+
+    def _auth(self) -> None:
+        while True:
+            t, body = self._read_msg()
+            if t == b"R":
+                (code,) = struct.unpack("!I", body[:4])
+                if code == 0:
+                    continue  # AuthenticationOk
+                if code == 3:  # cleartext
+                    self._send(b"p", self.password.encode() + b"\0")
+                elif code == 5:  # md5
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        self.password.encode() + self.user.encode()
+                    ).hexdigest().encode()
+                    outer = hashlib.md5(inner + salt).hexdigest()
+                    self._send(b"p", b"md5" + outer.encode() + b"\0")
+                else:
+                    raise PgError({"M": f"unsupported auth code {code}",
+                                   "C": "XX000"})
+            elif t == b"E":
+                raise PgError(_error_fields(body))
+            elif t == b"Z":
+                return  # ReadyForQuery
+            # S (ParameterStatus), K (BackendKeyData): ignore
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self._send(b"X", b"")
+                self.sock.close()
+            except OSError:
+                pass
+            finally:
+                self.sock = None
+
+    # -- queries -----------------------------------------------------------
+    def query(self, sql: str) -> List[Tuple[Optional[str], ...]]:
+        """Run one simple query; returns rows as tuples of text values
+        (None for SQL NULL).  ErrorResponse raises PgError after the
+        protocol resyncs on ReadyForQuery."""
+        if self.sock is None:
+            self.connect()
+        self._send(b"Q", sql.encode() + b"\0")
+        rows: List[Tuple[Optional[str], ...]] = []
+        err: Optional[PgError] = None
+        while True:
+            t, body = self._read_msg()
+            if t == b"D":
+                (n,) = struct.unpack("!H", body[:2])
+                off, vals = 2, []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", body[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        vals.append(None)
+                    else:
+                        vals.append(body[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(vals))
+            elif t == b"E":
+                err = PgError(_error_fields(body))
+            elif t == b"C":
+                # CommandComplete tag, e.g. "UPDATE 3" / "SELECT 5"
+                tag = body.rstrip(b"\0").decode()
+                parts = tag.rsplit(" ", 1)
+                self.rowcount = (int(parts[-1])
+                                 if parts[-1].isdigit() else 0)
+            elif t == b"Z":
+                if err is not None:
+                    raise err
+                return rows
+            # T (RowDescription), N (Notice), I (EmptyQuery): ignore
+
+    # -- transport ---------------------------------------------------------
+    def _send(self, t: bytes, payload: bytes) -> None:
+        self.sock.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_msg(self) -> Tuple[bytes, bytes]:
+        hdr = self._read_exact(5)
+        t, ln = hdr[:1], struct.unpack("!I", hdr[1:])[0]
+        return t, self._read_exact(ln - 4)
+
+
+def _error_fields(body: bytes) -> Dict[str, str]:
+    fields: Dict[str, str] = {}
+    for part in body.split(b"\0"):
+        if part:
+            fields[part[:1].decode()] = part[1:].decode(errors="replace")
+    return fields

@@ -1,0 +1,93 @@
+"""Redis serialization protocol (RESP2) client.
+
+Used by the raftis and disque suites (the reference's use jedis/spinach,
+raftis/src/jepsen/raftis.clj, disque/src/jepsen/disque.clj); RESP is also
+what several Redis-compatible stores under test speak.
+
+Blocking, one socket, no pipelining — Jepsen clients are logically
+single-threaded, so a plain request/response loop is the right shape.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, List, Optional, Union
+
+DEFAULT_PORT = 6379
+
+
+class RespError(Exception):
+    """Server returned an error reply (-ERR ...)."""
+
+
+class RespClient:
+    def __init__(self, host: str, port: int = DEFAULT_PORT,
+                 timeout: float = 5.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "RespClient":
+        self.sock = socket.create_connection(self.addr, timeout=self.timeout)
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    # -- protocol ----------------------------------------------------------
+    def call(self, *args: Union[str, bytes, int]) -> Any:
+        """Send one command, read one reply.  Error replies raise."""
+        if self.sock is None:
+            self.connect()
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = (a if isinstance(a, bytes)
+                 else str(a).encode("utf-8"))
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def _read_reply(self) -> Any:
+        line = self._read_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RespError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            return self._read_exact(n)
+        if t == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"bad reply type {line!r}")

@@ -1,0 +1,155 @@
+"""ZooKeeper client — jute wire protocol subset.
+
+The reference's canonical minimal suite drives ZooKeeper through avout's
+distributed atom (zookeeper/src/jepsen/zookeeper.clj:91-104); here the suite
+does the same compare-and-set over versioned znodes directly: ``get_data``
+returns (value, version) and ``set_data`` with an expected version is the
+CAS.  Subset implemented: connect/session, create, getData, setData,
+exists, delete — all the register workload needs.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional, Tuple
+
+DEFAULT_PORT = 2181
+
+# opcodes
+OP_CREATE, OP_DELETE, OP_EXISTS, OP_GETDATA, OP_SETDATA = 1, 2, 3, 4, 5
+OP_CLOSE = -11
+
+# error codes
+ERR_NONODE = -101
+ERR_BADVERSION = -103
+ERR_NODEEXISTS = -110
+
+
+class ZkError(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"zookeeper error {code}")
+        self.code = code
+
+    @property
+    def bad_version(self) -> bool:
+        return self.code == ERR_BADVERSION
+
+    @property
+    def no_node(self) -> bool:
+        return self.code == ERR_NONODE
+
+
+class ZkClient:
+    def __init__(self, host: str, port: int = DEFAULT_PORT,
+                 timeout: float = 10.0, session_timeout_ms: int = 10000):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self.session_timeout_ms = session_timeout_ms
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.xid = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def connect(self) -> "ZkClient":
+        self.sock = socket.create_connection(self.addr, timeout=self.timeout)
+        self.buf, self.xid = b"", 0
+        req = struct.pack("!iqi q", 0, 0, self.session_timeout_ms, 0)
+        req += struct.pack("!i", 16) + b"\0" * 16  # passwd
+        self._send_frame(req)
+        resp = self._read_frame()
+        # ConnectResponse: protoVersion(4) timeOut(4) sessionId(8) pw
+        (self.session_id,) = struct.unpack("!q", resp[8:16])
+        return self
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self._request(OP_CLOSE, b"")
+            except (OSError, ConnectionError, ZkError):
+                pass
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+
+    # -- operations --------------------------------------------------------
+    def create(self, path: str, data: bytes = b"",
+               ephemeral: bool = False) -> str:
+        flags = 1 if ephemeral else 0
+        acl = struct.pack("!i", 1) + struct.pack("!i", 31) \
+            + _s("world") + _s("anyone")
+        payload = _s(path) + _b(data) + acl + struct.pack("!i", flags)
+        resp = self._request(OP_CREATE, payload)
+        n, = struct.unpack("!i", resp[:4])
+        return resp[4:4 + n].decode()
+
+    def get_data(self, path: str) -> Tuple[bytes, int]:
+        """Returns (data, version) — the read half of the CAS."""
+        resp = self._request(OP_GETDATA, _s(path) + b"\0")  # watch=false
+        n, = struct.unpack("!i", resp[:4])
+        data = resp[4:4 + n] if n > 0 else b""
+        off = 4 + max(n, 0)
+        # Stat: czxid mzxid ctime mtime version ...
+        version, = struct.unpack_from("!i", resp, off + 32)
+        return data, version
+
+    def set_data(self, path: str, data: bytes, version: int = -1) -> int:
+        """Write; with ``version`` >= 0 this is compare-and-set (BadVersion
+        on mismatch).  Returns the new version."""
+        payload = _s(path) + _b(data) + struct.pack("!i", version)
+        resp = self._request(OP_SETDATA, payload)
+        new_version, = struct.unpack_from("!i", resp, 32)
+        return new_version
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._request(OP_EXISTS, _s(path) + b"\0")
+            return True
+        except ZkError as e:
+            if e.no_node:
+                return False
+            raise
+
+    def delete(self, path: str, version: int = -1) -> None:
+        self._request(OP_DELETE, _s(path) + struct.pack("!i", version))
+
+    # -- transport ---------------------------------------------------------
+    def _request(self, opcode: int, payload: bytes) -> bytes:
+        if self.sock is None:
+            self.connect()
+        self.xid += 1
+        self._send_frame(struct.pack("!ii", self.xid, opcode) + payload)
+        while True:
+            frame = self._read_frame()
+            xid, _zxid, err = struct.unpack("!iqi", frame[:16])
+            if xid in (-1, -2):  # watch event / ping: not ours
+                continue
+            if err != 0:
+                raise ZkError(err)
+            return frame[16:]
+
+    def _send_frame(self, body: bytes) -> None:
+        self.sock.sendall(struct.pack("!i", len(body)) + body)
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("connection closed")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def _read_frame(self) -> bytes:
+        (n,) = struct.unpack("!i", self._read_exact(4))
+        return self._read_exact(n)
+
+
+def _s(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!i", len(b)) + b
+
+
+def _b(b: bytes) -> bytes:
+    return struct.pack("!i", len(b)) + b

@@ -1,0 +1,40 @@
+"""Queue workload: enqueues/dequeues with a final drain.
+
+Parity: the queue workloads of the disque/rabbitmq suites
+(disque/src/jepsen/disque.clj:280-300, rabbitmq/src/jepsen/rabbitmq.clj)
+checked with checker/total-queue (jepsen/src/jepsen/checker.clj:628):
+every enqueued element should be dequeued exactly once; duplicates and
+losses are counted, unacked in-flight elements tolerated per the queue's
+contract.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import TotalQueueChecker
+
+
+def enq_deq(enq_p: float = 0.5):
+    counter = itertools.count()
+
+    def one():
+        if random.random() < enq_p:
+            return {"f": "enqueue", "value": next(counter)}
+        return {"f": "dequeue"}
+
+    return gen.FnGen(one)
+
+
+def drain():
+    """Each thread drains until exhaustion (disque.clj's :drain op)."""
+    return gen.each_thread(gen.once({"f": "drain"}))
+
+
+def workload(enq_p: float = 0.5) -> Dict[str, Any]:
+    return {"generator": enq_deq(enq_p),
+            "final_generator": drain(),
+            "checker": TotalQueueChecker()}

@@ -1,0 +1,105 @@
+"""Shared suite plumbing: workload x nemesis wiring, sweeps, CLI mains.
+
+Every reference suite repeats the same shape — a workload registry, a
+nemesis registry, a test constructor merging them into the test map, and a
+sweep over the cross product (tidb/src/tidb/core.clj:32-80,
+zookeeper/src/jepsen/zookeeper.clj:112-143, yugabyte's nemeses.clj
+registry).  This module is that shape, factored once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from jepsen_tpu import cli, generator as gen
+from jepsen_tpu import os as jos
+from jepsen_tpu.checker import Stats, compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.nemesis import combined
+
+STANDARD_NEMESES: Dict[str, Callable[[Dict[str, Any]], Any]] = {
+    "none": lambda opts: combined.Package(),
+    "partition": lambda opts: combined.partition_package(opts),
+    "kill": lambda opts: combined.db_package({**opts, "faults": ["kill"]}),
+    "pause": lambda opts: combined.db_package({**opts, "faults": ["pause"]}),
+    "clock": lambda opts: combined.clock_package(opts),
+    "packet": lambda opts: combined.packet_package(opts),
+    "all": lambda opts: combined.nemesis_package(
+        {**opts, "faults": ["partition", "kill", "pause", "clock"]}),
+}
+
+
+def build_test(opts: Dict[str, Any], *, suite: str, db,
+               workloads: Dict[str, Callable],
+               nemeses: Optional[Dict[str, Callable]] = None,
+               os=None) -> Dict[str, Any]:
+    """Construct a full test map from a suite's registries + CLI opts."""
+    nemeses = nemeses or STANDARD_NEMESES
+    workload_name = opts.get("workload") or sorted(workloads)[0]
+    nemesis_name = opts.get("nemesis", "partition")
+    wl = workloads[workload_name](opts)
+    pkg = nemeses[nemesis_name](
+        {"interval": float(opts.get("nemesis_interval", 10.0))})
+
+    time_limit = float(opts.get("time_limit", 60.0))
+    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    parts = [client_gen]
+    if pkg.generator is not None:
+        parts = [gen.any_gen(client_gen,
+                             gen.nemesis(gen.time_limit(time_limit,
+                                                        pkg.generator)))]
+    # final phases synchronize on quiescence so final reads can't race
+    # still-in-flight ops from the main phase
+    if pkg.final_generator is not None:
+        parts.append(gen.synchronize(
+            gen.nemesis(gen.lift(pkg.final_generator))))
+    if wl.get("final_generator") is not None:
+        parts.append(gen.synchronize(
+            gen.clients(gen.lift(wl["final_generator"]))))
+
+    checkers = {"stats": Stats(), "workload": wl["checker"],
+                "perf": Perf(), "timeline": Timeline()}
+    return {**opts,
+            "name": f"{suite}-{workload_name}-{nemesis_name}",
+            "os": os if os is not None else jos.Debian(),
+            "db": db,
+            "client": wl["client"],
+            "nemesis": pkg.nemesis,
+            "generator": parts,
+            "checker": compose(checkers)}
+
+
+def sweep(opts: Dict[str, Any], test_fn: Callable,
+          workloads: Dict[str, Callable],
+          nemeses: Optional[Dict[str, Callable]] = None) -> list:
+    """Workload x nemesis sweep matrix (tidb/core.clj:47-80 pattern)."""
+    nemeses = nemeses or STANDARD_NEMESES
+    return [test_fn({**opts, "workload": w, "nemesis": n})
+            for w in opts.get("workloads", sorted(workloads))
+            for n in opts.get("nemeses", sorted(nemeses))]
+
+
+def suite_opts(workloads, nemeses=None, default_workload=None,
+               extra: Optional[Callable] = None):
+    nemeses = nemeses or STANDARD_NEMESES
+
+    def opt_fn(parser):
+        parser.add_argument(
+            "--workload", choices=sorted(workloads),
+            default=default_workload or sorted(workloads)[0])
+        parser.add_argument("--nemesis", choices=sorted(nemeses),
+                            default="partition")
+        parser.add_argument("--nemesis-interval", type=float, default=10.0)
+        if extra:
+            extra(parser)
+
+    return opt_fn
+
+
+def main(test_fn: Callable, workloads, nemeses=None, prog: str = "jepsen-tpu",
+         extra_opts: Optional[Callable] = None) -> int:
+    return cli.single_test_cmd(
+        test_fn,
+        opt_fn=suite_opts(workloads, nemeses, extra=extra_opts),
+        prog=prog)

@@ -1,0 +1,2 @@
+"""Consul suite — CAS register over the KV HTTP API with the competition
+checker (consul/src/jepsen/consul/register.clj:72, BASELINE config #3)."""

@@ -1,0 +1,68 @@
+"""Consul KV register client.
+
+Parity: consul/src/jepsen/consul/{client,register}.clj — reads decode the
+base64 KV payload, CAS goes through ``?cas=<ModifyIndex>`` (0 = create),
+reads that fail are :fail, mutations that fail indeterminately are :info
+(c/with-errors at client.clj).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import urllib.error
+from typing import Optional, Tuple
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.http import HttpClient, HttpError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+HTTP_PORT = 8500
+
+
+class RegisterClient(jclient.Client):
+    def __init__(self, conn: Optional[HttpClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(HttpClient(
+            node, test.get("db_port", HTTP_PORT), timeout=5.0))
+
+    def _read(self, key) -> Tuple[Optional[int], int]:
+        """-> (value, modify_index); (None, 0) when the key is absent."""
+        try:
+            _, body = self.conn.get(f"/v1/kv/{key}")
+        except HttpError as e:
+            if e.status == 404:
+                return None, 0
+            raise
+        ent = body[0]
+        raw = ent.get("Value")
+        val = json.loads(base64.b64decode(raw)) if raw else None
+        return val, int(ent.get("ModifyIndex", 0))
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        key = f"jepsen/{k}"
+        try:
+            if op.f == "read":
+                val, _ = self._read(key)
+                return op.with_(type=OK, value=(k, val))
+            if op.f == "write":
+                self.conn.put(f"/v1/kv/{key}", raw=json.dumps(v).encode())
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                cur, idx = self._read(key)
+                if cur != old:
+                    return op.with_(type=FAIL)
+                _, res = self.conn.put(f"/v1/kv/{key}?cas={idx}",
+                                       raw=json.dumps(new).encode())
+                return op.with_(type=OK if res else FAIL)
+            raise ValueError(op.f)
+        except (HttpError, urllib.error.URLError, socket.timeout,
+                TimeoutError, ConnectionError) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

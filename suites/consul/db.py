@@ -1,0 +1,76 @@
+"""Consul server install/start.
+
+Parity: consul/src/jepsen/consul/db.clj — binary download, one server
+bootstrapping and the rest joining it, data dir wipe on teardown.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "1.17.0"
+URL = (f"https://releases.hashicorp.com/consul/{VERSION}/"
+       f"consul_{VERSION}_linux_amd64.zip")
+DIR = "/opt/consul"
+DATA = "/opt/consul/data"
+PIDFILE = "/var/run/consul.pid"
+LOGFILE = "/var/log/consul.log"
+HTTP_PORT = 8500
+
+
+class ConsulDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        self.start(test, node)
+        cu.await_tcp_port(s, HTTP_PORT, timeout_s=60)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("rm", "-rf", DATA, LOGFILE)
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        first = test["nodes"][0]
+        args = ["agent", "-server", "-data-dir", DATA,
+                "-bind", node, "-client", "0.0.0.0",
+                "-bootstrap-expect", str(len(test["nodes"]))]
+        if node != first:
+            args += ["-retry-join", first]
+        cu.start_daemon(s, f"{DIR}/consul", *args,
+                        pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "consul")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "consul", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "consul", "CONT")
+
+    def primaries(self, test) -> List[str]:
+        from jepsen_tpu.clients.http import HttpClient
+        for node in test["nodes"]:
+            try:
+                _, leader = HttpClient(node, HTTP_PORT, timeout=2).get(
+                    "/v1/status/leader")
+                if leader:
+                    host = str(leader).split(":")[0].strip('"')
+                    return [host]
+            except Exception:  # noqa: BLE001
+                continue
+        return []
+
+    def setup_primary(self, test, node):
+        pass
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

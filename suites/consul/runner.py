@@ -1,0 +1,50 @@
+"""Consul suite CLI.
+
+Parity: consul/src/jepsen/consul.clj + register.clj: independent CAS
+registers, 10 threads per key, the *competition* linearizability checker
+(register.clj:72 uses knossos.competition; here the device engine races
+the host oracle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.consul.client import RegisterClient
+from suites.consul.db import ConsulDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 200)),
+        threads_per_key=int(opts.get("threads_per_key", 10)),
+        algorithm="competition")
+    return {**wl, "client": RegisterClient()}
+
+
+WORKLOADS = {"register": register_workload}
+
+
+def consul_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="consul", db=ConsulDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, consul_test, WORKLOADS)
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=200)
+    parser.add_argument("--threads-per-key", type=int, default=10)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(consul_test, WORKLOADS, prog="jepsen-tpu-consul",
+                         extra_opts=_extra))

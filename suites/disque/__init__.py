@@ -1,0 +1,3 @@
+"""Disque suite — distributed queue over the RESP-based disque protocol
+(disque/src/jepsen/disque.clj): enqueue/dequeue/drain, total-queue
+checking."""

@@ -1,0 +1,71 @@
+"""Disque queue client: ADDJOB / GETJOB / ACKJOB over RESP.
+
+Parity: disque/src/jepsen/disque.clj:140-260 — enqueue is ADDJOB with a
+replication timeout, dequeue GETJOBs then ACKJOBs, drain loops dequeue
+until exhaustion (returning everything pulled; the checker counts them as
+dequeues).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.resp import RespClient, RespError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+PORT = 7711
+QUEUE = "jepsen"
+TIMEOUT_MS = 100
+DRAIN_BUDGET_S = 10.0
+
+
+class QueueClient(jclient.Client):
+    def __init__(self, conn: Optional[RespClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return QueueClient(RespClient(
+            node, test.get("db_port", PORT), timeout=5.0))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def _dequeue_one(self):
+        jobs = self.conn.call("GETJOB", "TIMEOUT", TIMEOUT_MS,
+                              "FROM", QUEUE)
+        if not jobs:
+            return None
+        _q, jid, body = jobs[0]
+        self.conn.call("ACKJOB", jid)
+        return int(body)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "enqueue":
+                self.conn.call("ADDJOB", QUEUE, str(op.value), TIMEOUT_MS)
+                return op.with_(type=OK)
+            if op.f == "dequeue":
+                v = self._dequeue_one()
+                if v is None:
+                    return op.with_(type=FAIL)
+                return op.with_(type=OK, value=v)
+            if op.f == "drain":
+                out = []
+                deadline = time.monotonic() + DRAIN_BUDGET_S
+                while time.monotonic() < deadline:
+                    v = self._dequeue_one()
+                    if v is None:
+                        return op.with_(type=OK, value=out)
+                    out.append(v)
+                return op.with_(type=INFO, value=out, error="drain-timeout")
+            raise ValueError(op.f)
+        except (RespError, ConnectionError, OSError, socket.timeout,
+                TimeoutError) as e:
+            self.conn.close()
+            if op.f == "dequeue":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

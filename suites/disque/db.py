@@ -1,0 +1,57 @@
+"""Disque install/start (disque/src/jepsen/disque.clj's db: build from the
+pinned release, start on port 7711, CLUSTER MEET the peers)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+URL = "https://github.com/antirez/disque/archive/1.0-rc1.tar.gz"
+DIR = "/opt/disque"
+PIDFILE = "/var/run/disque.pid"
+LOGFILE = "/var/log/disque.log"
+PORT = 7711
+
+
+class DisqueDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        if not cu.exists(s, f"{DIR}/src/disque-server"):
+            cu.install_archive(s, URL, DIR)
+            s.exec("sh", "-c", f"cd {DIR} && make -j2")
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=60)
+        # join the cluster through node 0
+        first = test["nodes"][0]
+        if node != first:
+            s.exec(f"{DIR}/src/disque", "-p", str(PORT),
+                   "cluster", "meet", first, str(PORT))
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("sh", "-c", f"rm -rf {DIR}/*.rdb {LOGFILE} || true")
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(s, f"{DIR}/src/disque-server",
+                        "--port", str(PORT),
+                        "--appendonly", "yes",
+                        pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "disque-server")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "disque-server", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "disque-server", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,34 @@
+"""Disque suite CLI (disque/src/jepsen/disque.clj:280-300: enqueue/dequeue
+mix, final drain, total-queue checker)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.workloads import queue as queue_wl
+
+from suites import common
+from suites.disque.client import QueueClient
+from suites.disque.db import DisqueDB
+
+
+def queue_workload(opts) -> Dict[str, Any]:
+    wl = queue_wl.workload()
+    return {**wl, "client": QueueClient()}
+
+
+WORKLOADS = {"queue": queue_workload}
+
+
+def disque_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="disque", db=DisqueDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, disque_test, WORKLOADS)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(disque_test, WORKLOADS, prog="jepsen-tpu-disque"))

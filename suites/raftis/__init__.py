@@ -1,0 +1,2 @@
+"""Raftis (Raft-replicated Redis) suite — read/write register over RESP
+(raftis/src/jepsen/raftis.clj)."""

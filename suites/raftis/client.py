@@ -1,0 +1,55 @@
+"""Raftis register client — reads/writes one key over RESP.
+
+Parity: raftis/src/jepsen/raftis.clj:30-60 — GET/SET on key "r";
+"no leader" and socket-closed errors are definite fails, other mutation
+errors indeterminate.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.resp import RespClient, RespError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+PORT = 6379
+
+
+class RegisterClient(jclient.Client):
+    def __init__(self, conn: Optional[RespClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(RespClient(
+            node, test.get("db_port", PORT), timeout=5.0))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                v = self.conn.call("GET", "r")
+                return op.with_(type=OK,
+                                value=int(v) if v is not None else None)
+            if op.f == "write":
+                self.conn.call("SET", "r", op.value)
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = op.value
+                r = self.conn.call("CAS", "r", str(old), str(new))
+                return op.with_(type=OK if r == 1 else FAIL)
+            raise ValueError(op.f)
+        except RespError as e:
+            msg = str(e)
+            definite = ("no leader" in msg or "socket closed" in msg
+                        or op.f == "read")
+            return op.with_(type=FAIL if definite else INFO, error=msg)
+        except (ConnectionError, OSError, socket.timeout, TimeoutError) as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

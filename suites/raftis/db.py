@@ -1,0 +1,47 @@
+"""Raftis cluster install/start (raftis/src/jepsen/raftis.clj's db: clone,
+build, run with the peer list)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+REPO = "https://github.com/goraft/raftis.git"
+DIR = "/opt/raftis"
+PIDFILE = "/var/run/raftis.pid"
+LOGFILE = "/var/log/raftis.log"
+PORT = 6379
+
+
+class RaftisDB(jdb.DB, jdb.Kill, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        if not cu.exists(s, DIR):
+            s.exec("git", "clone", REPO, DIR)
+            s.exec("sh", "-c", f"cd {DIR} && go build -o raftis .")
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=60)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("rm", "-rf", f"{DIR}/data", LOGFILE)
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        peers = ",".join(f"{n}:{PORT}" for n in test["nodes"])
+        cu.start_daemon(s, f"{DIR}/raftis",
+                        "-addr", f"{node}:{PORT}", "-peers", peers,
+                        "-data", f"{DIR}/data",
+                        pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "raftis")
+        s.exec("rm", "-f", PIDFILE)
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,47 @@
+"""Raftis suite CLI (raftis/src/jepsen/raftis.clj:70-100: single register,
+mix of reads/writes, linearizable checking)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import linearizable
+from jepsen_tpu.models import get_model
+
+from suites import common
+from suites.raftis.client import RegisterClient
+from suites.raftis.db import RaftisDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    def one():
+        r = random.random()
+        if r < 0.5:
+            return {"f": "read"}
+        if r < 0.8:
+            return {"f": "write", "value": random.randrange(5)}
+        return {"f": "cas",
+                "value": (random.randrange(5), random.randrange(5))}
+
+    return {"client": RegisterClient(),
+            "generator": gen.stagger(0.1, gen.FnGen(one)),
+            "checker": linearizable(get_model("cas-register"))}
+
+
+WORKLOADS = {"register": register_workload}
+
+
+def raftis_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="raftis", db=RaftisDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, raftis_test, WORKLOADS)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(raftis_test, WORKLOADS, prog="jepsen-tpu-raftis"))

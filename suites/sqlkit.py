@@ -1,0 +1,209 @@
+"""Shared SQL suite clients: bank, register, sets, append.
+
+The reference's SQL-family suites (postgres-rds, stolon, cockroachdb, tidb,
+galera, percona, mysql-cluster) repeat the same client shapes over jdbc
+(e.g. cockroachdb/src/jepsen/cockroach/bank.clj, stolon/src/jepsen/stolon/
+append.clj, tidb/src/tidb/sql.clj); here they are factored once over any
+driver exposing ``query(sql) -> rows`` with a ``retryable`` error
+classification (clients/pgwire.py, clients/mysql.py).
+
+All statements are plain standard SQL so the same clients run against real
+servers and the in-process fakes.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+ConnFactory = Callable[[str, Dict[str, Any]], Any]
+
+
+class _SqlClient(jclient.Client):
+    """Common connect/teardown and error conversion."""
+
+    def __init__(self, conn_factory: ConnFactory, conn=None):
+        self.conn_factory = conn_factory
+        self.conn = conn
+
+    def _clone(self, conn):
+        return type(self)(self.conn_factory, conn)
+
+    def open(self, test, node):
+        return self._clone(self.conn_factory(node, test))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def _indeterminate(self, op: Op, e: Exception) -> Op:
+        if op.f == "read":
+            return op.with_(type=FAIL, error=str(e))
+        return op.with_(type=INFO, error=str(e))
+
+    def _definite(self, op: Op, e: Exception) -> Op:
+        return op.with_(type=FAIL, error=str(e))
+
+    def _convert(self, op: Op, e: Exception) -> Op:
+        retryable = getattr(e, "retryable", False)
+        if retryable:
+            # conflict aborts definitely didn't commit
+            return self._definite(op, e)
+        if isinstance(e, (ConnectionError, OSError, socket.timeout,
+                          TimeoutError)):
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return self._indeterminate(op, e)
+
+
+class BankClient(_SqlClient):
+    """Transfers between account rows in one transaction; reads select the
+    whole table (jepsen.tests.bank semantics, cockroach/bank.clj)."""
+
+    def setup(self, test, node):
+        wl = test.get("bank", {})
+        accounts = wl.get("accounts", list(range(8)))
+        total = wl.get("total_amount", 80)
+        per = total // len(accounts)
+        self.conn.query("CREATE TABLE IF NOT EXISTS accounts "
+                        "(id INT PRIMARY KEY, balance INT)")
+        for i, a in enumerate(accounts):
+            amt = per + (total - per * len(accounts) if i == 0 else 0)
+            try:
+                self.conn.query(f"INSERT INTO accounts VALUES ({a}, {amt})")
+            except Exception:  # noqa: BLE001 — exists from another node
+                pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query("SELECT id, balance FROM accounts")
+                return op.with_(type=OK,
+                                value={int(r[0]): int(r[1]) for r in rows})
+            v = op.value
+            frm, to, amt = v["from"], v["to"], v["amount"]
+            self.conn.query("BEGIN")
+            try:
+                rows = self.conn.query(
+                    f"SELECT balance FROM accounts WHERE id = {frm}")
+                if not rows or int(rows[0][0]) < amt:
+                    self.conn.query("ROLLBACK")
+                    return op.with_(type=FAIL, error="insufficient")
+                self.conn.query(f"UPDATE accounts SET balance = balance - "
+                                f"{amt} WHERE id = {frm}")
+                self.conn.query(f"UPDATE accounts SET balance = balance + "
+                                f"{amt} WHERE id = {to}")
+                self.conn.query("COMMIT")
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+            return op.with_(type=OK)
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class RegisterClient(_SqlClient):
+    """Per-key int register row; CAS via conditional UPDATE returning its
+    row count (cockroach/register.clj shape).  Values are (k, v) tuples
+    from the independent lift."""
+
+    def setup(self, test, node):
+        self.conn.query("CREATE TABLE IF NOT EXISTS kv "
+                        "(k INT PRIMARY KEY, val INT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT val FROM kv WHERE k = {k}")
+                val = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                return op.with_(type=OK, value=(k, val))
+            if op.f == "write":
+                self.conn.query(f"UPDATE kv SET val = {v} WHERE k = {k}")
+                if self.conn.rowcount == 0:
+                    self.conn.query(f"INSERT INTO kv VALUES ({k}, {v})")
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                self.conn.query(f"UPDATE kv SET val = {new} "
+                                f"WHERE k = {k} AND val = {old}")
+                return op.with_(type=OK if self.conn.rowcount else FAIL)
+            raise ValueError(op.f)
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class SetClient(_SqlClient):
+    """Unique-row inserts, final full read (cockroach/sets.clj shape)."""
+
+    def setup(self, test, node):
+        self.conn.query("CREATE TABLE IF NOT EXISTS sets (val INT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.query(f"INSERT INTO sets VALUES ({op.value})")
+                return op.with_(type=OK)
+            rows = self.conn.query("SELECT val FROM sets")
+            return op.with_(type=OK, value=[int(r[0]) for r in rows])
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class AppendClient(_SqlClient):
+    """Elle list-append transactions: each mop reads or appends to a
+    text-encoded list row, the whole txn in BEGIN..COMMIT
+    (stolon/src/jepsen/stolon/append.clj shape)."""
+
+    def setup(self, test, node):
+        self.conn.query("CREATE TABLE IF NOT EXISTS append "
+                        "(k INT PRIMARY KEY, vals TEXT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            self.conn.query("BEGIN")
+            try:
+                out = []
+                for f, k, v in op.value:
+                    if f == "r":
+                        rows = self.conn.query(
+                            f"SELECT vals FROM append WHERE k = {k}")
+                        cur = (rows[0][0] or "") if rows else ""
+                        out.append(
+                            ["r", k,
+                             [int(x) for x in cur.split(",") if x] or None])
+                    else:  # append
+                        rows = self.conn.query(
+                            f"SELECT vals FROM append WHERE k = {k}")
+                        if rows:
+                            cur = rows[0][0] or ""
+                            new = f"{cur},{v}" if cur else str(v)
+                            self.conn.query(
+                                f"UPDATE append SET vals = '{new}' "
+                                f"WHERE k = {k}")
+                        else:
+                            self.conn.query(
+                                f"INSERT INTO append VALUES ({k}, '{v}')")
+                        out.append([f, k, v])
+                self.conn.query("COMMIT")
+                return op.with_(type=OK, value=out)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+

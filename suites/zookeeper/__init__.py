@@ -1,0 +1,3 @@
+"""ZooKeeper suite — the reference's minimal canonical test
+(zookeeper/src/jepsen/zookeeper.clj, BASELINE config #2): a linearizable
+compare-and-set register over versioned znodes, checked on device."""

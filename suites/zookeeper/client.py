@@ -1,0 +1,90 @@
+"""ZooKeeper CAS-register client over versioned znodes.
+
+The reference drives this through avout's zk-atom
+(zookeeper/src/jepsen/zookeeper.clj:80-110: read = deref, write = reset!!,
+cas = swap!! comparing current); here the same semantics come from the
+znode version counter: read returns (value, version), cas is
+set_data(version=read-version), retried on BadVersion only for the value
+comparison — a version conflict where the value still matches is retried,
+a value mismatch is a definite :fail.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.zk import ZkClient, ZkError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+CAS_RETRIES = 16
+
+
+class RegisterClient(jclient.Client):
+    def __init__(self, conn: Optional[ZkClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(ZkClient(node, port=test.get("db_port", 2181),
+                                       timeout=5.0))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def _path(self, k) -> str:
+        return f"/jepsen-r{k}"
+
+    def _ensure(self, path):
+        try:
+            self.conn.create(path, b"")
+        except ZkError as e:
+            if e.code != -110:  # NodeExists is fine
+                raise
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        path = self._path(k)
+        try:
+            if op.f == "read":
+                try:
+                    data, _ = self.conn.get_data(path)
+                except ZkError as e:
+                    if e.no_node:
+                        return op.with_(type=OK, value=(k, None))
+                    raise
+                return op.with_(
+                    type=OK, value=(k, int(data) if data else None))
+            if op.f == "write":
+                self._ensure(path)
+                self.conn.set_data(path, str(v).encode(), version=-1)
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                for _ in range(CAS_RETRIES):
+                    try:
+                        data, ver = self.conn.get_data(path)
+                    except ZkError as e:
+                        if e.no_node:
+                            return op.with_(type=FAIL)
+                        raise
+                    cur = int(data) if data else None
+                    if cur != old:
+                        return op.with_(type=FAIL)
+                    try:
+                        self.conn.set_data(path, str(new).encode(),
+                                           version=ver)
+                        return op.with_(type=OK)
+                    except ZkError as e:
+                        if not e.bad_version:
+                            raise
+                        # lost the race; re-read and re-compare
+                return op.with_(type=FAIL, error="cas-retries-exhausted")
+            raise ValueError(op.f)
+        except (ConnectionError, OSError, socket.timeout, TimeoutError,
+                ZkError) as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

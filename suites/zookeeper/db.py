@@ -1,0 +1,72 @@
+"""ZooKeeper ensemble install/start on test nodes.
+
+Parity: the db reify in zookeeper/src/jepsen/zookeeper.clj:41-73 — apt
+packages, per-node myid from the node's index, zoo.cfg with the server.N
+ensemble lines, service restart; logs snarfed from /var/log/zookeeper.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+CONF = "/etc/zookeeper/conf"
+LOG = "/var/log/zookeeper/zookeeper.log"
+
+ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir=/var/lib/zookeeper
+clientPort=2181
+maxClientCnxns=0
+"""
+
+
+def node_id(test, node) -> int:
+    return test["nodes"].index(node)
+
+
+class ZookeeperDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def __init__(self, version: str = "3.4.13-2"):
+        self.version = version
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("apt-get", "install", "-y",
+               f"zookeeper={self.version}", f"zookeeper-bin={self.version}",
+               f"zookeeperd={self.version}")
+        s.exec("sh", "-c", f"echo {node_id(test, node)} > {CONF}/myid")
+        servers = "\n".join(
+            f"server.{i}={n}:2888:3888"
+            for i, n in enumerate(test["nodes"]))
+        cu.write_file(s, ZOO_CFG + servers + "\n", f"{CONF}/zoo.cfg")
+        s.exec("service", "zookeeper", "stop")
+        s.exec("service", "zookeeper", "start")
+        cu.await_tcp_port(s, 2181, timeout_s=60)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        try:
+            s.exec("service", "zookeeper", "stop")
+        except Exception:  # noqa: BLE001 — may not be installed yet
+            pass
+        s.exec("sh", "-c",
+               "rm -rf /var/lib/zookeeper/version-* /var/log/zookeeper/*")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec("service", "zookeeper", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "QuorumPeerMain")
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "QuorumPeerMain", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "QuorumPeerMain", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOG]

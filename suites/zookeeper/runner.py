@@ -1,0 +1,51 @@
+"""ZooKeeper suite CLI.
+
+Parity: zookeeper/src/jepsen/zookeeper.clj:112-143 (zk-test merging
+noop-test, mix of r/w/cas staggered, partition-random-node nemesis,
+per-key knossos linearizable checking — here the device engine).
+
+    python -m suites.zookeeper.runner test --node n1 ... [--dummy-ssh]
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.zookeeper.client import RegisterClient
+from suites.zookeeper.db import ZookeeperDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 200)),
+        threads_per_key=2)
+    return {**wl, "client": RegisterClient()}
+
+
+WORKLOADS = {"register": register_workload}
+
+
+def zk_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="zookeeper",
+                             db=ZookeeperDB(opts.get("version", "3.4.13-2")),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, zk_test, WORKLOADS)
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=200)
+    parser.add_argument("--version", default="3.4.13-2")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(zk_test, WORKLOADS, prog="jepsen-tpu-zookeeper",
+                         extra_opts=_extra))

@@ -1,0 +1,681 @@
+"""In-process fake database servers speaking real wire protocols.
+
+The reference tests its full pipeline with dummy remotes and in-process
+clients (test strategy, SURVEY.md §4); these fakes extend that to the wire
+clients: each listens on an ephemeral localhost port and implements just
+enough of its protocol, backed by honest (or deliberately faulty) Python
+state, so suites are testable end-to-end with zero external databases.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class _ThreadedServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def start_server(handler_cls, state) -> Tuple[_ThreadedServer, int]:
+    srv = _ThreadedServer(("127.0.0.1", 0), handler_cls)
+    srv.state = state
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("closed")
+        out += chunk
+    return out
+
+
+# --------------------------------------------------------------------------
+# RESP (Redis)
+# --------------------------------------------------------------------------
+
+class RedisState:
+    def __init__(self):
+        self.kv: Dict[bytes, bytes] = {}
+        self.lists: Dict[bytes, List[bytes]] = {}
+        self.lock = threading.Lock()
+
+
+class FakeRedisHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        st: RedisState = self.server.state
+        while True:
+            try:
+                args = self._read_command()
+            except (ConnectionError, ValueError):
+                return
+            if args is None:
+                return
+            cmd = args[0].upper()
+            with st.lock:
+                self._dispatch(st, cmd, args)
+
+    def _read_command(self) -> Optional[List[bytes]]:
+        line = self.rfile.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError("inline commands unsupported")
+        n = int(line[1:])
+        args = []
+        for _ in range(n):
+            hdr = self.rfile.readline()
+            ln = int(hdr[1:])
+            args.append(_recv_exact_file(self.rfile, ln))
+            self.rfile.read(2)
+        return args
+
+    def _dispatch(self, st, cmd, args):
+        w = self.wfile.write
+        if cmd == b"PING":
+            w(b"+PONG\r\n")
+        elif cmd == b"SET":
+            st.kv[args[1]] = args[2]
+            w(b"+OK\r\n")
+        elif cmd == b"GET":
+            v = st.kv.get(args[1])
+            w(b"$-1\r\n" if v is None
+              else b"$%d\r\n%s\r\n" % (len(v), v))
+        elif cmd == b"EVAL" or cmd == b"CAS":
+            # CAS key old new (test extension; raftis uses Lua EVAL)
+            key, old, new = args[-3], args[-2], args[-1]
+            if st.kv.get(key) == old:
+                st.kv[key] = new
+                w(b":1\r\n")
+            else:
+                w(b":0\r\n")
+        elif cmd == b"LPUSH":
+            st.lists.setdefault(args[1], []).insert(0, args[2])
+            w(b":%d\r\n" % len(st.lists[args[1]]))
+        elif cmd == b"RPUSH":
+            st.lists.setdefault(args[1], []).append(args[2])
+            w(b":%d\r\n" % len(st.lists[args[1]]))
+        elif cmd == b"LPOP" or cmd == b"RPOP":
+            lst = st.lists.get(args[1], [])
+            if not lst:
+                w(b"$-1\r\n")
+            else:
+                v = lst.pop(0) if cmd == b"LPOP" else lst.pop()
+                w(b"$%d\r\n%s\r\n" % (len(v), v))
+        elif cmd == b"LRANGE":
+            lst = st.lists.get(args[1], [])
+            lo, hi = int(args[2]), int(args[3])
+            if hi == -1:
+                hi = len(lst) - 1
+            sel = lst[lo:hi + 1]
+            w(b"*%d\r\n" % len(sel))
+            for v in sel:
+                w(b"$%d\r\n%s\r\n" % (len(v), v))
+        elif cmd in (b"ADDJOB",):  # disque-style
+            st.lists.setdefault(args[1], []).append(args[2])
+            jid = b"D-" + hashlib.md5(args[2]).hexdigest()[:12].encode()
+            w(b"$%d\r\n%s\r\n" % (len(jid), jid))
+        elif cmd == b"GETJOB":
+            # GETJOB [TIMEOUT ms] FROM <q>
+            q = args[-1]
+            lst = st.lists.get(q, [])
+            if not lst:
+                w(b"*-1\r\n")
+            else:
+                v = lst.pop(0)
+                jid = b"D-" + hashlib.md5(v).hexdigest()[:12].encode()
+                w(b"*1\r\n*3\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n$%d\r\n%s\r\n"
+                  % (len(q), q, len(jid), jid, len(v), v))
+        elif cmd == b"ACKJOB":
+            w(b":1\r\n")
+        elif cmd == b"CLUSTER":
+            w(b"+OK\r\n")
+        else:
+            w(b"-ERR unknown command\r\n")
+
+
+def _recv_exact_file(f, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = f.read(n - len(out))
+        if not chunk:
+            raise ConnectionError("closed")
+        out += chunk
+    return out
+
+
+# --------------------------------------------------------------------------
+# Postgres wire
+# --------------------------------------------------------------------------
+
+class SqlState:
+    """Dict-registers with a pluggable SQL interpreter.
+
+    exec_fn(state, sql) -> (rows, affected-count, error-fields-or-None)
+    """
+
+    def __init__(self, exec_fn: Callable):
+        self.kv: Dict[Any, Any] = {}
+        self.lock = threading.Lock()
+        self.exec_fn = exec_fn
+
+
+class FakePgHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        st: SqlState = self.server.state
+        sock = self.request
+        try:
+            # startup
+            (ln,) = struct.unpack("!I", _recv_exact(sock, 4))
+            _recv_exact(sock, ln - 4)
+            sock.sendall(b"R" + struct.pack("!II", 8, 0))        # AuthOk
+            sock.sendall(b"Z" + struct.pack("!I", 5) + b"I")     # Ready
+            while True:
+                t = _recv_exact(sock, 1)
+                (ln,) = struct.unpack("!I", _recv_exact(sock, 4))
+                body = _recv_exact(sock, ln - 4)
+                if t == b"X":
+                    return
+                if t != b"Q":
+                    continue
+                sql = body.rstrip(b"\0").decode()
+                with st.lock:
+                    rows, affected, err = st.exec_fn(st, sql)
+                if err is not None:
+                    payload = b""
+                    for k, v in err.items():
+                        payload += k.encode() + v.encode() + b"\0"
+                    payload += b"\0"
+                    sock.sendall(b"E" + struct.pack("!I", 4 + len(payload))
+                                 + payload)
+                else:
+                    for row in rows:
+                        cells = b""
+                        for cell in row:
+                            if cell is None:
+                                cells += struct.pack("!i", -1)
+                            else:
+                                cb = str(cell).encode()
+                                cells += struct.pack("!i", len(cb)) + cb
+                        payload = struct.pack("!H", len(row)) + cells
+                        sock.sendall(b"D" + struct.pack(
+                            "!I", 4 + len(payload)) + payload)
+                    verb = sql.strip().split()[0].upper() if sql.strip() \
+                        else "SELECT"
+                    n = len(rows) if rows else affected
+                    done = f"{verb} {n}".encode() + b"\0"
+                    sock.sendall(b"C" + struct.pack("!I", 4 + len(done))
+                                 + done)
+                sock.sendall(b"Z" + struct.pack("!I", 5) + b"I")
+        except (ConnectionError, OSError, struct.error):
+            return
+        finally:
+            release = getattr(st, "release_txn", None)
+            if release:
+                release()
+
+
+# --------------------------------------------------------------------------
+# MySQL wire
+# --------------------------------------------------------------------------
+
+class FakeMysqlHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        st: SqlState = self.server.state
+        sock = self.request
+        seq = 0
+
+        def send(body: bytes, s: int):
+            hdr = struct.pack("<I", len(body))[:3] + bytes([s])
+            sock.sendall(hdr + body)
+
+        def read_pkt() -> Tuple[bytes, int]:
+            hdr = _recv_exact(sock, 4)
+            ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+            return _recv_exact(sock, ln), hdr[3]
+
+        try:
+            seed = b"12345678" + b"abcdefghijkl"
+            hs = (b"\x0a" + b"8.0-fake\0" + struct.pack("<I", 1)
+                  + seed[:8] + b"\0"
+                  + struct.pack("<H", 0xFFFF) + b"\x21"
+                  + struct.pack("<H", 2) + struct.pack("<H", 0x000F)
+                  + bytes([21]) + b"\0" * 10
+                  + seed[8:] + b"\0" + b"mysql_native_password\0")
+            send(hs, 0)
+            _resp, s = read_pkt()  # HandshakeResponse (auth unchecked)
+            send(b"\x00\x00\x00\x02\x00\x00\x00", s + 1)  # OK
+            while True:
+                pkt, _s = read_pkt()
+                if pkt[0] == 0x01:  # COM_QUIT
+                    return
+                if pkt[0] != 0x03:
+                    send(b"\x00\x00\x00\x02\x00\x00\x00", 1)
+                    continue
+                sql = pkt[1:].decode()
+                with st.lock:
+                    rows, affected, err = st.exec_fn(st, sql)
+                if err is not None:
+                    errno = int(err.get("errno", 1105))
+                    msg = err.get("M", "error").encode()
+                    send(b"\xff" + struct.pack("<H", errno)
+                         + b"#HY000" + msg, 1)
+                    continue
+                if not rows:
+                    aff = bytes([affected]) if affected < 251 \
+                        else b"\xfc" + struct.pack("<H", affected)
+                    send(b"\x00" + aff + b"\x00" + b"\x02\x00\x00\x00", 1)
+                    continue
+                ncols = len(rows[0])
+                s = 1
+                send(bytes([ncols]), s)
+                for i in range(ncols):
+                    s += 1
+                    name = b"c%d" % i
+                    col = (b"\x03def\x00\x00\x00"
+                           + bytes([len(name)]) + name
+                           + b"\x00" + b"\x0c" + struct.pack("<H", 0x21)
+                           + struct.pack("<I", 255) + b"\xfd"
+                           + struct.pack("<H", 0) + b"\x00" + b"\x00\x00")
+                    send(col, s)
+                s += 1
+                send(b"\xfe\x00\x00\x02\x00", s)  # EOF
+                for row in rows:
+                    s += 1
+                    out = b""
+                    for cell in row:
+                        if cell is None:
+                            out += b"\xfb"
+                        else:
+                            cb = str(cell).encode()
+                            out += bytes([len(cb)]) + cb
+                    send(out, s)
+                s += 1
+                send(b"\xfe\x00\x00\x02\x00", s)  # EOF
+        except (ConnectionError, OSError, struct.error, IndexError):
+            return
+        finally:
+            release = getattr(st, "release_txn", None)
+            if release:
+                release()
+
+
+# --------------------------------------------------------------------------
+# ZooKeeper jute
+# --------------------------------------------------------------------------
+
+class ZkState:
+    def __init__(self):
+        self.nodes: Dict[str, Tuple[bytes, int]] = {}  # path -> (data, ver)
+        self.lock = threading.Lock()
+
+
+class FakeZkHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        st: ZkState = self.server.state
+        sock = self.request
+
+        def read_frame() -> bytes:
+            (n,) = struct.unpack("!i", _recv_exact(sock, 4))
+            return _recv_exact(sock, n)
+
+        def send_frame(b: bytes):
+            sock.sendall(struct.pack("!i", len(b)) + b)
+
+        try:
+            read_frame()  # ConnectRequest
+            send_frame(struct.pack("!iiq", 0, 10000, 0x1234)
+                       + struct.pack("!i", 16) + b"\0" * 16)
+            while True:
+                frame = read_frame()
+                xid, opcode = struct.unpack("!ii", frame[:8])
+                body = frame[8:]
+                with st.lock:
+                    err, payload = self._dispatch(st, opcode, body)
+                send_frame(struct.pack("!iqi", xid, 1, err) + payload)
+                if opcode == -11:
+                    return
+        except (ConnectionError, OSError, struct.error):
+            return
+
+    @staticmethod
+    def _dispatch(st: ZkState, opcode: int, body: bytes):
+        def rd_str(off):
+            (n,) = struct.unpack_from("!i", body, off)
+            return body[off + 4:off + 4 + n].decode(), off + 4 + n
+
+        def rd_buf(off):
+            (n,) = struct.unpack_from("!i", body, off)
+            if n < 0:
+                return b"", off + 4
+            return body[off + 4:off + 4 + n], off + 4 + n
+
+        def stat(version: int) -> bytes:
+            return struct.pack("!qqqqiiiqiiq", 1, 1, 0, 0, version,
+                               0, 0, 0, 0, 0, 1)
+
+        if opcode == 1:  # create
+            path, off = rd_str(0)
+            data, off = rd_buf(off)
+            if path in st.nodes:
+                return -110, b""
+            st.nodes[path] = (data, 0)
+            p = path.encode()
+            return 0, struct.pack("!i", len(p)) + p
+        if opcode == 4:  # getData
+            path, _ = rd_str(0)
+            if path not in st.nodes:
+                return -101, b""
+            data, ver = st.nodes[path]
+            return 0, struct.pack("!i", len(data)) + data + stat(ver)
+        if opcode == 5:  # setData
+            path, off = rd_str(0)
+            data, off = rd_buf(off)
+            (want,) = struct.unpack_from("!i", body, off)
+            if path not in st.nodes:
+                return -101, b""
+            _, ver = st.nodes[path]
+            if want != -1 and want != ver:
+                return -103, b""
+            st.nodes[path] = (data, ver + 1)
+            return 0, stat(ver + 1)
+        if opcode == 3:  # exists
+            path, _ = rd_str(0)
+            if path not in st.nodes:
+                return -101, b""
+            return 0, stat(st.nodes[path][1])
+        if opcode == 2:  # delete
+            path, off = rd_str(0)
+            st.nodes.pop(path, None)
+            return 0, b""
+        if opcode == -11:  # close
+            return 0, b""
+        return -6, b""
+
+
+# --------------------------------------------------------------------------
+# Mongo OP_MSG
+# --------------------------------------------------------------------------
+
+class MongoState:
+    def __init__(self):
+        self.colls: Dict[str, List[Dict[str, Any]]] = {}
+        self.lock = threading.Lock()
+
+
+class FakeMongoHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from jepsen_tpu.clients.mongo import bson_decode, bson_encode
+        st: MongoState = self.server.state
+        sock = self.request
+        try:
+            while True:
+                hdr = _recv_exact(sock, 16)
+                ln, rid, _rto, _op = struct.unpack("<iiii", hdr)
+                body = _recv_exact(sock, ln - 16)
+                cmd = bson_decode(body[5:])
+                with st.lock:
+                    resp = self._dispatch(st, cmd)
+                rb = struct.pack("<i", 0) + b"\x00" + bson_encode(resp)
+                sock.sendall(struct.pack("<iiii", 16 + len(rb),
+                                         1, rid, 2013) + rb)
+        except (ConnectionError, OSError, struct.error):
+            return
+
+    @staticmethod
+    def _matches(doc, q):
+        return all(doc.get(k) == v for k, v in q.items())
+
+    def _dispatch(self, st: MongoState, cmd: Dict[str, Any]):
+        if "find" in cmd:
+            coll = st.colls.get(cmd["find"], [])
+            flt = cmd.get("filter", {})
+            hits = [d for d in coll if self._matches(d, flt)]
+            if cmd.get("limit"):
+                hits = hits[:cmd["limit"]]
+            return {"ok": 1, "cursor": {"id": 0, "firstBatch": hits}}
+        if "insert" in cmd:
+            st.colls.setdefault(cmd["insert"], []).extend(
+                cmd.get("documents", []))
+            return {"ok": 1, "n": len(cmd.get("documents", []))}
+        if "findAndModify" in cmd:  # before "update": fAM carries one too
+            coll = st.colls.setdefault(cmd["findAndModify"], [])
+            hit = next((d for d in coll
+                        if self._matches(d, cmd.get("query", {}))), None)
+            if hit is None:
+                return {"ok": 1, "value": None}
+            before = dict(hit)
+            hit.update(cmd["update"].get("$set", {}))
+            return {"ok": 1, "value": before}
+        if "update" in cmd:
+            coll = st.colls.setdefault(cmd["update"], [])
+            n = 0
+            for u in cmd.get("updates", []):
+                hit = next((d for d in coll
+                            if self._matches(d, u.get("q", {}))), None)
+                if hit is not None:
+                    hit.update(u["u"].get("$set", {}))
+                    n += 1
+                elif u.get("upsert"):
+                    doc = dict(u.get("q", {}))
+                    doc.update(u["u"].get("$set", {}))
+                    coll.append(doc)
+                    n += 1
+            return {"ok": 1, "n": n}
+        if "hello" in cmd or "isMaster" in cmd:
+            return {"ok": 1, "isWritablePrimary": True}
+        return {"ok": 0, "errmsg": f"unknown command {list(cmd)[:1]}",
+                "code": 59}
+
+
+# --------------------------------------------------------------------------
+# Consul KV HTTP
+# --------------------------------------------------------------------------
+
+def start_fake_consul():
+    """Consul KV API subset: GET/PUT /v1/kv/<key> with ?cas=<ModifyIndex>
+    semantics (0 = create-only), base64 values, ModifyIndex bookkeeping."""
+    import base64 as _b64
+    import http.server
+    import json as _json
+    import socketserver as ss
+
+    state = {"kv": {}, "index": 0, "lock": threading.Lock()}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, obj):
+            body = _json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            key = self.path[len("/v1/kv/"):].split("?")[0]
+            with state["lock"]:
+                if key not in state["kv"]:
+                    return self._reply(404, [])
+                val, idx = state["kv"][key]
+                return self._reply(200, [{
+                    "Key": key, "Value": _b64.b64encode(val).decode(),
+                    "ModifyIndex": idx}])
+
+        def do_PUT(self):
+            path, _, q = self.path.partition("?")
+            key = path[len("/v1/kv/"):]
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            cas = None
+            for part in q.split("&"):
+                if part.startswith("cas="):
+                    cas = int(part[4:])
+            with state["lock"]:
+                cur = state["kv"].get(key)
+                if cas is not None:
+                    have = cur[1] if cur else 0
+                    if cas != have:
+                        return self._reply(200, False)
+                state["index"] += 1
+                state["kv"][key] = (body, state["index"])
+                return self._reply(200, True)
+
+    srv = ss.ThreadingTCPServer(("127.0.0.1", 0), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+# --------------------------------------------------------------------------
+# Mini-SQL: enough SQL for the sqlkit clients (bank/register/sets/append)
+# --------------------------------------------------------------------------
+
+import re as _re
+
+
+class _NullLock:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class MiniSqlState:
+    """Serializable toy SQL engine: BEGIN..COMMIT holds a global lock, so
+    every committed transaction is atomic and serial — an honest database
+    for clean-history suite tests.  Statement dialect = what sqlkit emits.
+    """
+
+    def __init__(self):
+        self.accounts: Dict[int, int] = {}
+        self.kv: Dict[int, int] = {}
+        self.sets_rows: List[int] = []
+        self.append_rows: Dict[int, str] = {}
+        self.lock = _NullLock()  # handlers' outer lock: serialization is
+        self.txn = threading.RLock()  # done here, txn-scoped
+        self._holders: Dict[int, int] = {}  # thread id -> depth
+
+    def release_txn(self):
+        tid = threading.get_ident()
+        while self._holders.get(tid, 0) > 0:
+            self._holders[tid] -= 1
+            self.txn.release()
+        self._holders.pop(tid, None)
+
+    def exec_fn(self, st, sql):
+        return self._exec(sql)
+
+    def _exec(self, sql):
+        tid = threading.get_ident()
+        q = sql.strip().rstrip(";")
+        low = q.lower()
+        if low == "begin":
+            self.txn.acquire()
+            self._holders[tid] = self._holders.get(tid, 0) + 1
+            return [], 0, None
+        if low in ("commit", "rollback"):
+            if self._holders.get(tid, 0) > 0:
+                self._holders[tid] -= 1
+                self.txn.release()
+            return [], 0, None
+        if self._holders.get(tid, 0) > 0:
+            return self._stmt(q, low)
+        with self.txn:
+            return self._stmt(q, low)
+
+    def _stmt(self, q, low):
+        if low.startswith("create table"):
+            return [], 0, None
+        m = _re.match(r"select id, balance from accounts$", low)
+        if m:
+            return sorted(self.accounts.items()), 0, None
+        m = _re.match(r"select balance from accounts where id = (\d+)", low)
+        if m:
+            a = int(m.group(1))
+            if a not in self.accounts:
+                return [], 0, None
+            return [(self.accounts[a],)], 0, None
+        m = _re.match(
+            r"update accounts set balance = balance ([+-]) (\d+) "
+            r"where id = (\d+)", low)
+        if m:
+            sign, amt, a = m.group(1), int(m.group(2)), int(m.group(3))
+            if a not in self.accounts:
+                return [], 0, None
+            self.accounts[a] += amt if sign == "+" else -amt
+            return [], 1, None
+        m = _re.match(r"insert into accounts values \((\d+), (\d+)\)", low)
+        if m:
+            a, b = int(m.group(1)), int(m.group(2))
+            if a in self.accounts:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            self.accounts[a] = b
+            return [], 1, None
+        m = _re.match(r"select val from kv where k = (\d+)", low)
+        if m:
+            k = int(m.group(1))
+            if k not in self.kv:
+                return [], 0, None
+            return [(self.kv[k],)], 0, None
+        m = _re.match(r"update kv set val = (\d+) where k = (\d+)"
+                      r"(?: and val = (\d+))?", low)
+        if m:
+            new, k = int(m.group(1)), int(m.group(2))
+            old = m.group(3)
+            if k not in self.kv:
+                return [], 0, None
+            if old is not None and self.kv[k] != int(old):
+                return [], 0, None
+            self.kv[k] = new
+            return [], 1, None
+        m = _re.match(r"insert into kv values \((\d+), (\d+)\)", low)
+        if m:
+            k, v = int(m.group(1)), int(m.group(2))
+            if k in self.kv:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            self.kv[k] = v
+            return [], 1, None
+        m = _re.match(r"insert into sets values \((\d+)\)", low)
+        if m:
+            self.sets_rows.append(int(m.group(1)))
+            return [], 1, None
+        if low == "select val from sets":
+            return [(v,) for v in self.sets_rows], 0, None
+        m = _re.match(r"select vals from append where k = (\d+)", low)
+        if m:
+            k = int(m.group(1))
+            if k not in self.append_rows:
+                return [], 0, None
+            return [(self.append_rows[k],)], 0, None
+        m = _re.match(r"update append set vals = '([^']*)' where k = (\d+)",
+                      low)
+        if m:
+            vals, k = m.group(1), int(m.group(2))
+            if k not in self.append_rows:
+                return [], 0, None
+            self.append_rows[k] = vals
+            return [], 1, None
+        m = _re.match(r"insert into append values \((\d+), '([^']*)'\)", low)
+        if m:
+            k, v = int(m.group(1)), m.group(2)
+            self.append_rows[k] = v
+            return [], 1, None
+        if low == "select 1":
+            return [(1,)], 0, None
+        return [], 0, {"S": "ERROR", "C": "42601",
+                       "M": f"unparsed: {q[:60]}", "errno": "1064"}

@@ -1,0 +1,217 @@
+"""Wire clients against in-process fake servers (SURVEY.md §4 pattern:
+full-stack tests with no external databases)."""
+
+import json
+import threading
+
+import pytest
+
+from jepsen_tpu.clients.mongo import MongoClient, bson_decode, bson_encode
+from jepsen_tpu.clients.mysql import MysqlClient, MysqlError
+from jepsen_tpu.clients.pgwire import PgClient, PgError
+from jepsen_tpu.clients.resp import RespClient, RespError
+from jepsen_tpu.clients.zk import ZkClient, ZkError
+from jepsen_tpu.clients.http import HttpClient, HttpError
+
+from tests.fakes import (
+    FakeMongoHandler, FakeMysqlHandler, FakePgHandler, FakeRedisHandler,
+    FakeZkHandler, MongoState, RedisState, SqlState, ZkState, start_server,
+)
+
+
+class TestResp:
+    @pytest.fixture()
+    def client(self):
+        srv, port = start_server(FakeRedisHandler, RedisState())
+        c = RespClient("127.0.0.1", port)
+        yield c
+        c.close()
+        srv.shutdown()
+
+    def test_set_get(self, client):
+        assert client.call("SET", "x", "1") == "OK"
+        assert client.call("GET", "x") == b"1"
+        assert client.call("GET", "nope") is None
+
+    def test_cas(self, client):
+        client.call("SET", "x", "1")
+        assert client.call("CAS", "x", "1", "2") == 1
+        assert client.call("CAS", "x", "1", "3") == 0
+        assert client.call("GET", "x") == b"2"
+
+    def test_lists_and_error(self, client):
+        client.call("RPUSH", "q", "a")
+        client.call("RPUSH", "q", "b")
+        assert client.call("LRANGE", "q", 0, -1) == [b"a", b"b"]
+        with pytest.raises(RespError):
+            client.call("BOGUS")
+
+
+def _kv_sql(st, sql):
+    """Toy SQL for the fake servers: the register/bank statements the
+    suites issue."""
+    sql = sql.strip().rstrip(";")
+    low = sql.lower()
+    if low.startswith("select val from kv where k = "):
+        k = sql.split("=")[-1].strip().strip("'")
+        v = st.kv.get(k)
+        return ([(v,)] if v is not None else []), 0, None
+    if low.startswith("upsert "):  # upsert k v
+        _, k, v = sql.split()
+        st.kv[k] = v
+        return [], 1, None
+    if low.startswith("cas "):  # cas k old new
+        _, k, old, new = sql.split()
+        if st.kv.get(k) == old:
+            st.kv[k] = new
+            return [(1,)], 1, None
+        return [(0,)], 0, None
+    if low == "select 1":
+        return [(1,)], 0, None
+    if low.startswith("boom"):
+        return [], 0, {"S": "ERROR", "C": "40001", "M": "serialization",
+                       "errno": "1213"}
+    return [], 0, None
+
+
+class TestPgWire:
+    @pytest.fixture()
+    def client(self):
+        srv, port = start_server(FakePgHandler, SqlState(_kv_sql))
+        c = PgClient("127.0.0.1", port)
+        yield c
+        c.close()
+        srv.shutdown()
+
+    def test_roundtrip(self, client):
+        assert client.query("SELECT 1") == [("1",)]
+        client.query("upsert x 5")
+        assert client.query("select val from kv where k = x") == [("5",)]
+
+    def test_cas_and_retryable_error(self, client):
+        client.query("upsert x 1")
+        assert client.query("cas x 1 2") == [("1",)]
+        assert client.query("cas x 1 3") == [("0",)]
+        with pytest.raises(PgError) as ei:
+            client.query("boom")
+        assert ei.value.sqlstate == "40001" and ei.value.retryable
+
+
+class TestMysql:
+    @pytest.fixture()
+    def client(self):
+        srv, port = start_server(FakeMysqlHandler, SqlState(_kv_sql))
+        c = MysqlClient("127.0.0.1", port, user="root", password="secret")
+        yield c
+        c.close()
+        srv.shutdown()
+
+    def test_roundtrip(self, client):
+        assert client.query("SELECT 1") == [("1",)]
+        client.query("upsert x 7")
+        assert client.query("select val from kv where k = x") == [("7",)]
+
+    def test_error_classification(self, client):
+        with pytest.raises(MysqlError) as ei:
+            client.query("boom")
+        assert ei.value.errno == 1213 and ei.value.retryable
+
+
+class TestZk:
+    @pytest.fixture()
+    def client(self):
+        srv, port = start_server(FakeZkHandler, ZkState())
+        c = ZkClient("127.0.0.1", port)
+        yield c
+        c.close()
+        srv.shutdown()
+
+    def test_create_get_set(self, client):
+        client.create("/reg", b"0")
+        data, ver = client.get_data("/reg")
+        assert (data, ver) == (b"0", 0)
+        assert client.set_data("/reg", b"1", version=0) == 1
+        assert client.get_data("/reg") == (b"1", 1)
+
+    def test_cas_semantics(self, client):
+        client.create("/r", b"a")
+        with pytest.raises(ZkError) as ei:
+            client.set_data("/r", b"x", version=7)
+        assert ei.value.bad_version
+        assert client.exists("/r") and not client.exists("/nope")
+
+
+class TestMongo:
+    @pytest.fixture()
+    def client(self):
+        srv, port = start_server(FakeMongoHandler, MongoState())
+        c = MongoClient("127.0.0.1", port)
+        yield c
+        c.close()
+        srv.shutdown()
+
+    def test_bson_roundtrip(self):
+        doc = {"a": 1, "b": "x", "c": [1, 2], "d": {"e": None},
+               "f": True, "g": 2 ** 40}
+        assert bson_decode(bson_encode(doc)) == doc
+
+    def test_insert_find(self, client):
+        client.command({"insert": "regs",
+                        "documents": [{"_id": 1, "val": 5}]})
+        assert client.find_one("regs", {"_id": 1})["val"] == 5
+
+    def test_find_and_modify_cas(self, client):
+        client.command({"insert": "regs",
+                        "documents": [{"_id": 1, "val": 5}]})
+        before = client.find_and_modify(
+            "regs", {"_id": 1, "val": 5}, {"$set": {"val": 6}})
+        assert before["val"] == 5
+        assert client.find_and_modify(
+            "regs", {"_id": 1, "val": 5}, {"$set": {"val": 7}}) is None
+        assert client.find_one("regs", {"_id": 1})["val"] == 6
+
+
+class TestHttp:
+    @pytest.fixture()
+    def client(self):
+        import http.server
+        store = {}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in store:
+                    self._reply(200, store[self.path])
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                store[self.path] = json.loads(self.rfile.read(n) or b"null")
+                self._reply(200, True)
+
+        import socketserver as ss
+        srv = ss.ThreadingTCPServer(("127.0.0.1", 0), H)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield HttpClient("127.0.0.1", srv.server_address[1])
+        srv.shutdown()
+
+    def test_put_get(self, client):
+        st, body = client.put("/kv/x", {"v": 1})
+        assert st == 200
+        st, body = client.get("/kv/x")
+        assert st == 200 and body == {"v": 1}
+        with pytest.raises(HttpError) as ei:
+            client.get("/kv/missing")
+        assert ei.value.status == 404
