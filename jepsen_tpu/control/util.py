@@ -107,22 +107,33 @@ def signal(s: Session, process_name: str, sig: str) -> None:
 
 def start_daemon(s: Session, binary: str, *args,
                  pidfile: str, logfile: str, chdir: Optional[str] = None,
-                 env: Optional[Dict[str, str]] = None) -> None:
+                 env: Optional[Dict[str, str]] = None,
+                 user: Optional[str] = None) -> None:
     """Start a long-running process detached with a pidfile
     (util.clj:311's start-stop-daemon pattern, without requiring the
-    start-stop-daemon binary)."""
+    start-stop-daemon binary).  ``user`` runs the daemon as a service
+    account; the pidfile records the daemon itself (not the sudo wrapper),
+    so stop_daemon's KILL escalation reaches it."""
+    import shlex
+
     from jepsen_tpu.control.core import build_cmd, env_str
     cmd = build_cmd(binary, *args)
     if env:
         cmd = f"env {env_str(env)} {cmd}"
+    if user:
+        inner = f"echo $$ > {pidfile}; exec {cmd}"
+        cmd = f"sudo -n -u {user} bash -c {shlex.quote(inner)}"
     # chdir runs as its own foreground statement: `nohup cd X && cmd` tries
     # to exec the `cd` builtin and short-circuits; `cd X && nohup cmd &`
     # backgrounds the whole list, so $! would be a wrapper subshell instead
     # of the daemon and signals would never reach it.
     prefix = f"cd {chdir} || exit 1; " if chdir else ""
+    # with user=, the sudo'd inner shell wrote its own pid already; writing
+    # $! here would record the sudo wrapper instead (and race the inner echo)
+    record = "true" if user else f"echo $! > {pidfile}"
     script = (f"if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; "
               f"then echo already-running; else "
-              f"{prefix}nohup {cmd} >> {logfile} 2>&1 & echo $! > {pidfile}; "
+              f"{prefix}nohup {cmd} >> {logfile} 2>&1 & {record}; "
               f"fi")
     s.exec("bash", "-c", script)
 

@@ -116,6 +116,19 @@ def _run_case(test) -> History:
     nem = test.get("nemesis") or jnemesis.NoopNemesis()
     test["nemesis"] = nem.setup(test)
     try:
+        # Open one client per node and run its setup! (schema creation
+        # etc.) before any worker dispatch, as in core.clj:176-207.
+        client_proto = test.get("client")
+        if client_proto is not None:
+            for node in (test.get("nodes") or [None]):
+                c = client_proto.open(test, node)
+                try:
+                    c.setup(test)
+                finally:
+                    try:
+                        c.close(test)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("client close after setup")
         logger.info("Running workload")
         return interpreter.run(test)
     finally:

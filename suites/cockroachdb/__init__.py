@@ -1,0 +1,9 @@
+"""cockroachdb suite — register/bank/sets/monotonic/sequential/g2 + more.
+
+Parity: cockroachdb/src/jepsen/cockroach.clj and cockroach/{bank,register,
+sets,monotonic,sequential,comments,adya,nemesis}.clj — the reference's
+largest-surface SQL suite, including its own Ubuntu OS layer
+(cockroachdb/src/jepsen/os/ubuntu.clj) and clock-skew helpers.
+"""
+
+from suites.cockroachdb.runner import WORKLOADS, all_tests, cockroach_test  # noqa: F401

@@ -1,0 +1,85 @@
+"""CockroachDB cluster install/start.
+
+Parity: cockroachdb/src/jepsen/cockroach/auto.clj (binary install, start
+with --join, cluster init once, kill/pause) and cockroach.clj's db.  The
+reference runs on its own Ubuntu OS layer (os/ubuntu.clj); here the suite
+defaults to jepsen_tpu.os.Ubuntu.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "23.1.11"
+URL = (f"https://binaries.cockroachdb.com/"
+       f"cockroach-v{VERSION}.linux-amd64.tgz")
+DIR = "/opt/cockroach"
+STORE = "/opt/cockroach/data"
+PIDFILE = "/var/run/cockroach.pid"
+LOGFILE = "/var/log/cockroach.log"
+SQL_PORT = 26257
+HTTP_PORT = 8080
+
+
+class CockroachDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        # tarball unpacks cockroach-v*/cockroach; normalize to DIR/cockroach
+        s.exec("bash", "-c",
+               f"[ -x {DIR}/cockroach ] || "
+               f"cp {DIR}/cockroach*/cockroach {DIR}/cockroach || true")
+        self.start(test, node)
+        if node == test["nodes"][0]:
+            cu.await_tcp_port(s, SQL_PORT, timeout_s=90)
+            s.exec("bash", "-c",
+                   f"{DIR}/cockroach init --insecure "
+                   f"--host={node}:{SQL_PORT} 2>&1 | "
+                   f"grep -v 'already been initialized' || true")
+        cu.await_tcp_port(s, SQL_PORT, timeout_s=90)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        cu.grepkill(s, "cockroach")
+        s.exec("rm", "-rf", STORE, LOGFILE)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        join = ",".join(f"{n}:{SQL_PORT}" for n in test["nodes"])
+        cu.start_daemon(
+            s, f"{DIR}/cockroach", "start", "--insecure",
+            "--store", STORE,
+            "--listen-addr", f"0.0.0.0:{SQL_PORT}",
+            "--advertise-addr", f"{node}:{SQL_PORT}",
+            "--http-addr", f"0.0.0.0:{HTTP_PORT}",
+            "--join", join,
+            pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "cockroach")
+        s.exec("rm", "-f", PIDFILE)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "cockroach", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "cockroach", "CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        return []  # ranges elect their own leaseholders; no single primary
+
+    def setup_primary(self, test, node):
+        pass
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE, f"{STORE}/logs/cockroach.log"]

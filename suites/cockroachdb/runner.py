@@ -1,0 +1,43 @@
+"""cockroachdb suite CLI.
+
+Parity: cockroachdb/src/jepsen/cockroach.clj's test registry — register,
+bank, sets, monotonic, sequential, comments/adya (G2 anti-dependency
+anomalies; covered by the g2/wr workloads here), plus the standard SQL
+registry.  The reference's own clock nemeses (cockroach/nemesis.clj,
+suite-local adjtime.c/bumptime.c) map to the framework clock package, whose
+C helpers are compiled on the nodes (jepsen_tpu/nemesis/time.py).
+
+    python -m suites.cockroachdb.runner test --node n1 ... \
+        --workload monotonic --nemesis clock
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import os as jos
+from jepsen_tpu.clients.pgwire import PgClient
+
+from suites import sqlextra, sqlsuite
+from suites.cockroachdb.db import SQL_PORT, CockroachDB
+
+
+def conn(node, test):
+    return PgClient(node,
+                    port=int(test.get("db_port", SQL_PORT)),
+                    user=test.get("db_user", "root"),
+                    database=test.get("db_name", "defaultdb")).connect()
+
+
+EXTRA = {
+    "monotonic": lambda opts: sqlextra.monotonic_workload(conn),
+    "sequential": lambda opts: sqlextra.sequential_workload(
+        conn, keys=int(opts.get("keys", 32))),
+}
+
+WORKLOADS, cockroach_test, all_tests, main = sqlsuite.make_suite(
+    "cockroachdb", CockroachDB(), conn, os=jos.Ubuntu(),
+    extra_workloads=EXTRA, default_workload="register")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

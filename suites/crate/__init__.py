@@ -1,0 +1,9 @@
+"""crate suite — CrateDB lost-updates / dirty-read / version-divergence.
+
+Parity: crate/src/jepsen/crate/{core,lost_updates,dirty_read,
+version_divergence}.clj.  The reference drives CrateDB through the
+Elasticsearch transport client; CrateDB also speaks the Postgres wire
+protocol (psql.port 5432), which is the TPU-era transport here.
+"""
+
+from suites.crate.runner import WORKLOADS, all_tests, crate_test  # noqa: F401

@@ -1,0 +1,75 @@
+"""CrateDB install/start.
+
+Parity: crate/src/jepsen/crate/core.clj's db — release tarball, crate
+service user (Crate refuses to run as root), unicast discovery over the
+test nodes, data/log wipe on teardown.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "5.4.3"
+URL = (f"https://cdn.crate.io/downloads/releases/cratedb/x64_linux/"
+       f"crate-{VERSION}.tar.gz")
+DIR = "/opt/crate"
+DATA = "/opt/crate/data"
+PIDFILE = f"{DIR}/crate.pid"  # written by the crate service user
+LOGFILE = "/var/log/crate.log"
+PG_PORT = 5432
+HTTP_PORT = 4200
+TRANSPORT_PORT = 4300
+USER = "crate"
+
+
+class CrateDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        cu.ensure_user(s, USER)
+        s.exec("mkdir", "-p", DATA)
+        s.exec("chown", "-R", f"{USER}:{USER}", DIR)
+        self.start(test, node)
+        cu.await_tcp_port(s, PG_PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("rm", "-rf", DATA, LOGFILE)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        seeds = ",".join(f"{n}:{TRANSPORT_PORT}" for n in test["nodes"])
+        masters = ",".join(test["nodes"])
+        cu.start_daemon(
+            s, f"{DIR}/bin/crate",
+            f"-Cnode.name={node}",
+            f"-Cnetwork.host=0.0.0.0",
+            f"-Cpath.data={DATA}",
+            f"-Cpsql.port={PG_PORT}",
+            f"-Chttp.port={HTTP_PORT}",
+            f"-Ctransport.tcp.port={TRANSPORT_PORT}",
+            f"-Cdiscovery.seed_hosts={seeds}",
+            f"-Ccluster.initial_master_nodes={masters}",
+            pidfile=PIDFILE, logfile=LOGFILE, user=USER)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "crate")
+        s.exec("rm", "-f", PIDFILE)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "crate", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "crate", "CONT")
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,38 @@
+"""crate suite CLI.
+
+Parity: crate/src/jepsen/crate/{lost_updates,dirty_read,
+version_divergence}.clj — lost-updates (RMW set-add on one row, set
+checker), dirty-read (failed writers' values must stay invisible), and the
+standard SQL registry for register/set coverage.
+
+    python -m suites.crate.runner test --node n1 ... --workload lost-updates
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.pgwire import PgClient
+
+from suites import sqlextra, sqlsuite
+from suites.crate.db import PG_PORT, CrateDB
+
+
+def conn(node, test):
+    return PgClient(node,
+                    port=int(test.get("db_port", PG_PORT)),
+                    user=test.get("db_user", "crate"),
+                    database=test.get("db_name", "doc")).connect()
+
+
+EXTRA = {
+    "lost-updates": lambda opts: sqlextra.lost_updates_workload(conn),
+    "dirty-read": lambda opts: sqlextra.dirty_reads_workload(conn),
+}
+
+WORKLOADS, crate_test, all_tests, main = sqlsuite.make_suite(
+    "crate", CrateDB(), conn, extra_workloads=EXTRA,
+    default_workload="lost-updates")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

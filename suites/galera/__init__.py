@@ -1,0 +1,8 @@
+"""galera suite — MariaDB Galera Cluster dirty-reads and bank.
+
+Parity: galera/src/jepsen/{galera.clj,galera/dirty_reads.clj} — writers
+race to set every row in one transaction while readers scan for values
+from failed transactions (dirty_reads.clj:1-6).
+"""
+
+from suites.galera.runner import WORKLOADS, all_tests, galera_test  # noqa: F401

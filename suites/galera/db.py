@@ -1,0 +1,86 @@
+"""MariaDB Galera Cluster install/start.
+
+Parity: galera/src/jepsen/galera.clj's db — mariadb + galera packages,
+wsrep provider config with a gcomm:// address over the test nodes, first
+node bootstraps the cluster, the rest join it.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+SQL_PORT = 3306
+CONF = "/etc/mysql/conf.d/galera.cnf"
+LOGFILE = "/var/log/mysql/error.log"
+DATADIR = "/var/lib/mysql"
+
+
+def cluster_address(test) -> str:
+    return "gcomm://" + ",".join(test["nodes"])
+
+
+def galera_conf(test, node) -> str:
+    return f"""[mysqld]
+bind-address=0.0.0.0
+binlog_format=ROW
+default-storage-engine=innodb
+innodb_autoinc_lock_mode=2
+wsrep_on=ON
+wsrep_provider=/usr/lib/galera/libgalera_smm.so
+wsrep_cluster_name=jepsen
+wsrep_cluster_address={cluster_address(test)}
+wsrep_node_name={node}
+wsrep_node_address={node}
+"""
+
+
+class GaleraDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("env", "DEBIAN_FRONTEND=noninteractive", "apt-get", "install",
+               "-y", "mariadb-server", "galera-4", "rsync")
+        s.exec("service", "mysql", "stop")
+        cu.write_file(s, galera_conf(test, node), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, SQL_PORT, timeout_s=120)
+        if node == test["nodes"][0]:
+            s.exec("mysql", "-e",
+                   "CREATE DATABASE IF NOT EXISTS jepsen; "
+                   "CREATE USER IF NOT EXISTS 'jepsen'@'%' "
+                   "IDENTIFIED BY 'jepsen'; "
+                   "GRANT ALL ON jepsen.* TO 'jepsen'@'%'; "
+                   "FLUSH PRIVILEGES;")
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("bash", "-c", "service mysql stop || true")
+        cu.grepkill(s, "mariadbd|mysqld")
+        s.exec("bash", "-c", f"rm -rf {DATADIR}/grastate.dat {LOGFILE}")
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        if node == test["nodes"][0]:
+            # first node bootstraps a new cluster
+            s.exec("bash", "-c",
+                   "galera_new_cluster || service mysql start")
+        else:
+            s.exec("service", "mysql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "mariadbd|mysqld")
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "mariadbd", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "mariadbd", "CONT")
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,34 @@
+"""galera suite CLI — dirty-reads is the flagship workload.
+
+Parity: galera/src/jepsen/galera/dirty_reads.clj (test- at 107) plus the
+shared SQL registry (bank mirrors the reference's galera bank tests).
+
+    python -m suites.galera.runner test --node n1 ... --workload dirty-reads
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.mysql import MysqlClient
+
+from suites import sqlextra, sqlsuite
+from suites.galera.db import SQL_PORT, GaleraDB
+
+
+def conn(node, test):
+    return MysqlClient(node,
+                       port=int(test.get("db_port", SQL_PORT)),
+                       user=test.get("db_user", "jepsen"),
+                       password=test.get("db_password", "jepsen"),
+                       database=test.get("db_name", "jepsen")).connect()
+
+
+EXTRA = {"dirty-reads": lambda opts: sqlextra.dirty_reads_workload(conn)}
+
+WORKLOADS, galera_test, all_tests, main = sqlsuite.make_suite(
+    "galera", GaleraDB(), conn, extra_workloads=EXTRA,
+    default_workload="dirty-reads")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

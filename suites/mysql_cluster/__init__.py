@@ -1,0 +1,7 @@
+"""mysql-cluster suite — MySQL NDB Cluster bank workload.
+
+Parity: mysql-cluster/src/jepsen/mysql_cluster.clj — management node on
+the first host, NDB data nodes on the rest, SQL (API) nodes everywhere.
+"""
+
+from suites.mysql_cluster.runner import WORKLOADS, all_tests, mysql_cluster_test  # noqa: F401

@@ -1,0 +1,118 @@
+"""MySQL NDB Cluster install/start.
+
+Parity: mysql-cluster/src/jepsen/mysql_cluster.clj — ndb_mgmd on node 1,
+ndbd data nodes, mysqld API nodes with ndbcluster enabled, config.ini
+generated from the test's node list.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "8.0.35"
+URL = (f"https://dev.mysql.com/get/Downloads/MySQL-Cluster-8.0/"
+       f"mysql-cluster-{VERSION}-linux-glibc2.28-x86_64.tar.xz")
+DIR = "/opt/mysql-cluster"
+DATA = f"{DIR}/data"
+SQL_PORT = 3306
+MGM_PORT = 1186
+
+MGMD_PID, MGMD_LOG = f"{DIR}/mgmd.pid", f"{DIR}/mgmd.log"
+NDBD_PID, NDBD_LOG = f"{DIR}/ndbd.pid", f"{DIR}/ndbd.log"
+MYSQLD_PID, MYSQLD_LOG = f"{DIR}/mysqld.pid", f"{DIR}/mysqld.log"
+
+
+def mgm_node(test) -> str:
+    return test["nodes"][0]
+
+
+def config_ini(test) -> str:
+    lines = ["[ndbd default]", "NoOfReplicas=2", "DataMemory=256M", "",
+             "[ndb_mgmd]", f"HostName={mgm_node(test)}",
+             f"DataDir={DATA}/mgmd", ""]
+    for n in test["nodes"]:
+        lines += ["[ndbd]", f"HostName={n}", f"DataDir={DATA}/ndbd", ""]
+    for n in test["nodes"]:
+        lines += ["[mysqld]", f"HostName={n}", ""]
+    return "\n".join(lines)
+
+
+def my_cnf(test) -> str:
+    return (f"[mysqld]\nndbcluster\n"
+            f"ndb-connectstring={mgm_node(test)}\n"
+            f"bind-address=0.0.0.0\nport={SQL_PORT}\n"
+            f"datadir={DATA}/mysqld\n"
+            f"[mysql_cluster]\nndb-connectstring={mgm_node(test)}\n")
+
+
+class MysqlClusterDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("bash", "-c",
+               f"[ -x {DIR}/bin/ndbd ] || "
+               f"cp -r {DIR}/mysql-cluster-*/* {DIR}/ 2>/dev/null || true")
+        s.exec("mkdir", "-p", f"{DATA}/mgmd", f"{DATA}/ndbd",
+               f"{DATA}/mysqld")
+        cu.write_file(s, config_ini(test), f"{DIR}/config.ini")
+        cu.write_file(s, my_cnf(test), f"{DIR}/my.cnf")
+        if node == mgm_node(test):
+            s.exec("bash", "-c",
+                   f"[ -d {DATA}/mysqld/mysql ] || "
+                   f"{DIR}/bin/mysqld --defaults-file={DIR}/my.cnf "
+                   f"--initialize-insecure")
+        self.start(test, node)
+        cu.await_tcp_port(s, SQL_PORT, timeout_s=300)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        for pid in (MYSQLD_PID, NDBD_PID, MGMD_PID):
+            cu.stop_daemon(s, pid)
+        s.exec("rm", "-rf", DATA, MGMD_LOG, NDBD_LOG, MYSQLD_LOG)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        if node == mgm_node(test):
+            cu.start_daemon(s, f"{DIR}/bin/ndb_mgmd",
+                            "--nodaemon",
+                            "-f", f"{DIR}/config.ini",
+                            "--configdir", f"{DATA}/mgmd",
+                            pidfile=MGMD_PID, logfile=MGMD_LOG)
+            cu.await_tcp_port(s, MGM_PORT, timeout_s=60)
+        cu.start_daemon(s, f"{DIR}/bin/ndbd", "--nodaemon",
+                        "-c", mgm_node(test),
+                        pidfile=NDBD_PID, logfile=NDBD_LOG)
+        s.exec("bash", "-c",
+               f"[ -d {DATA}/mysqld/mysql ] || "
+               f"{DIR}/bin/mysqld --defaults-file={DIR}/my.cnf "
+               f"--initialize-insecure")
+        cu.start_daemon(s, f"{DIR}/bin/mysqld",
+                        f"--defaults-file={DIR}/my.cnf",
+                        pidfile=MYSQLD_PID, logfile=MYSQLD_LOG)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("mysqld", "ndbd", "ndb_mgmd"):
+            cu.grepkill(s, pat)
+        for pid in (MYSQLD_PID, NDBD_PID, MGMD_PID):
+            s.exec("rm", "-f", pid)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("mysqld", "ndbd"):
+            cu.signal(s, pat, "STOP")
+
+    def resume(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("mysqld", "ndbd"):
+            cu.signal(s, pat, "CONT")
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [MGMD_LOG, NDBD_LOG, MYSQLD_LOG]

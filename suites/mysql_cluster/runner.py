@@ -1,0 +1,30 @@
+"""mysql-cluster suite CLI.
+
+Parity: mysql-cluster/src/jepsen/mysql_cluster.clj — bank over NDB.
+
+    python -m suites.mysql_cluster.runner test --node n1 ... --workload bank
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.mysql import MysqlClient
+
+from suites import sqlsuite
+from suites.mysql_cluster.db import SQL_PORT, MysqlClusterDB
+
+
+def conn(node, test):
+    return MysqlClient(node,
+                       port=int(test.get("db_port", SQL_PORT)),
+                       user=test.get("db_user", "root"),
+                       password=test.get("db_password", ""),
+                       database=test.get("db_name", "test")).connect()
+
+
+WORKLOADS, mysql_cluster_test, all_tests, main = sqlsuite.make_suite(
+    "mysql-cluster", MysqlClusterDB(), conn, default_workload="bank")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

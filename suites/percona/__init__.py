@@ -1,0 +1,7 @@
+"""percona suite — Percona XtraDB Cluster bank / dirty-reads.
+
+Parity: percona/src/jepsen/{percona.clj,percona/dirty_reads.clj} — same
+anomaly battery as galera over Percona's Galera-based XtraDB Cluster.
+"""
+
+from suites.percona.runner import WORKLOADS, all_tests, percona_test  # noqa: F401

@@ -1,0 +1,82 @@
+"""Percona XtraDB Cluster install/start.
+
+Parity: percona/src/jepsen/percona.clj's db — percona-xtradb-cluster
+packages, wsrep config over the test nodes, bootstrap-first-node.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+SQL_PORT = 3306
+CONF = "/etc/mysql/mysql.conf.d/wsrep.cnf"
+LOGFILE = "/var/log/mysql/error.log"
+
+
+def wsrep_conf(test, node) -> str:
+    addrs = ",".join(test["nodes"])
+    return f"""[mysqld]
+bind-address=0.0.0.0
+binlog_format=ROW
+default-storage-engine=innodb
+innodb_autoinc_lock_mode=2
+wsrep_on=ON
+wsrep_provider=/usr/lib/galera4/libgalera_smm.so
+wsrep_cluster_name=jepsen
+wsrep_cluster_address=gcomm://{addrs}
+wsrep_node_name={node}
+wsrep_node_address={node}
+wsrep_sst_method=rsync
+pxc_strict_mode=PERMISSIVE
+"""
+
+
+class PerconaDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("env", "DEBIAN_FRONTEND=noninteractive", "apt-get", "install",
+               "-y", "percona-xtradb-cluster-server", "rsync")
+        s.exec("bash", "-c", "service mysql stop || true")
+        cu.write_file(s, wsrep_conf(test, node), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, SQL_PORT, timeout_s=180)
+        if node == test["nodes"][0]:
+            s.exec("mysql", "-e",
+                   "CREATE DATABASE IF NOT EXISTS jepsen; "
+                   "CREATE USER IF NOT EXISTS 'jepsen'@'%' "
+                   "IDENTIFIED BY 'jepsen'; "
+                   "GRANT ALL ON jepsen.* TO 'jepsen'@'%'; "
+                   "FLUSH PRIVILEGES;")
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("bash", "-c", "service mysql stop || true")
+        cu.grepkill(s, "mysqld")
+        s.exec("bash", "-c", f"rm -f {LOGFILE}")
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        if node == test["nodes"][0]:
+            s.exec("bash", "-c",
+                   "service mysql bootstrap-pxc || service mysql start")
+        else:
+            s.exec("service", "mysql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "mysqld")
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "mysqld", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "mysqld", "CONT")
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,33 @@
+"""percona suite CLI.
+
+Parity: percona/src/jepsen/percona.clj — bank + dirty-reads over XtraDB.
+
+    python -m suites.percona.runner test --node n1 ... --workload bank
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.mysql import MysqlClient
+
+from suites import sqlextra, sqlsuite
+from suites.percona.db import SQL_PORT, PerconaDB
+
+
+def conn(node, test):
+    return MysqlClient(node,
+                       port=int(test.get("db_port", SQL_PORT)),
+                       user=test.get("db_user", "jepsen"),
+                       password=test.get("db_password", "jepsen"),
+                       database=test.get("db_name", "jepsen")).connect()
+
+
+EXTRA = {"dirty-reads": lambda opts: sqlextra.dirty_reads_workload(conn)}
+
+WORKLOADS, percona_test, all_tests, main = sqlsuite.make_suite(
+    "percona", PerconaDB(), conn, extra_workloads=EXTRA,
+    default_workload="bank")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,8 @@
+"""postgres-rds suite — bank workload against a managed Postgres endpoint.
+
+Parity: postgres-rds/src/jepsen/postgres_rds.clj (bank-client 204,
+bank-checker 235, bank-test 269): the database is externally managed (AWS
+RDS), so the DB layer is lifecycle-noop and clients point at one endpoint.
+"""
+
+from suites.postgres_rds.runner import WORKLOADS, all_tests, postgres_rds_test  # noqa: F401

@@ -1,0 +1,57 @@
+"""Externally-managed Postgres: no install, no teardown.
+
+Parity: postgres-rds/src/jepsen/postgres_rds.clj has no db/DB setup at all —
+tests target a pre-provisioned RDS endpoint; the only responsibilities left
+are connectivity checks and schema reset between runs.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.clients.pgwire import PgClient
+
+
+class RdsPostgresDB(jdb.DB, jdb.LogFiles):
+    """Lifecycle-noop DB wrapper for a managed endpoint.
+
+    ``setup`` verifies the endpoint answers SQL; ``teardown`` drops the
+    workload tables so back-to-back runs start clean (the reference resets
+    its accounts table in client setup, postgres_rds.clj:166-203).
+    """
+
+    def __init__(self, port: int = 5432, user: str = "postgres",
+                 password: str = "", database: str = "postgres"):
+        self.port, self.user = port, user
+        self.password, self.database = password, database
+
+    def _conn(self, test, node) -> PgClient:
+        return PgClient(test.get("db_host", node),
+                        port=int(test.get("db_port", self.port)),
+                        user=test.get("db_user", self.user),
+                        password=test.get("db_password", self.password),
+                        database=test.get("db_name", self.database)).connect()
+
+    def setup(self, test, node):
+        c = self._conn(test, node)
+        try:
+            c.query("SELECT 1")
+        finally:
+            c.close()
+
+    def teardown(self, test, node):
+        if node != test["nodes"][0]:
+            return  # one endpoint behind all "nodes"; drop once
+        c = self._conn(test, node)
+        try:
+            for table in ("accounts", "kv", "sets", "append"):
+                try:
+                    c.query(f"DROP TABLE IF EXISTS {table}")
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            c.close()
+
+    def log_files(self, test, node) -> List[str]:
+        return []  # managed service: no reachable server logs

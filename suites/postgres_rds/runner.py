@@ -1,0 +1,42 @@
+"""postgres-rds suite CLI.
+
+Parity: postgres-rds/src/jepsen/postgres_rds.clj:262-280 (basic-test /
+bank-test). Default workload is bank, as in the reference; the rest of the
+SQL workload registry comes along for free.
+
+    python -m suites.postgres_rds.runner test --node rds-endpoint \
+        --workload bank --nemesis none
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import os as jos
+from jepsen_tpu.clients.pgwire import PgClient
+
+from suites import sqlsuite
+from suites.postgres_rds.db import RdsPostgresDB
+
+
+def conn(node, test):
+    return PgClient(test.get("db_host", node),
+                    port=int(test.get("db_port", 5432)),
+                    user=test.get("db_user", "postgres"),
+                    password=test.get("db_password", ""),
+                    database=test.get("db_name", "postgres")).connect()
+
+
+# A managed endpoint offers no SSH surface for kill/pause/partition — only
+# "none" and packet shaping of the client side make sense; reference runs
+# nemesis-free (postgres_rds.clj:269-280).
+NEMESES = {"none": sqlsuite.common.STANDARD_NEMESES["none"]}
+
+# managed service: no node-level OS surface to prepare (the reference suite
+# has no os/db install at all, postgres_rds.clj)
+WORKLOADS, postgres_rds_test, all_tests, main = sqlsuite.make_suite(
+    "postgres-rds", RdsPostgresDB(), conn, nemeses=NEMESES,
+    os=jos.NoopOS(), default_workload="bank")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

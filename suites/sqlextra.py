@@ -1,0 +1,340 @@
+"""Monotonic and sequential workloads shared by cockroachdb and tidb.
+
+Parity:
+- monotonic: cockroachdb/src/jepsen/cockroach/monotonic.clj (and
+  tidb/src/tidb/monotonic.clj) — each ``add`` transaction reads the current
+  maximum and inserts max+1; under serializability the committed values are
+  exactly 0..n with no gaps or duplicates, and each process's own adds
+  increase (monotonic.clj:110-139, check-monotonic 166).
+- sequential: cockroachdb/src/jepsen/cockroach/sequential.clj (and
+  tidb/src/tidb/sequential.clj) — a key is split over a chain of tables;
+  writers fill the chain in order, readers scan it in reverse, so any read
+  must look like [nil ... nil v ... v]: seeing a later write implies every
+  earlier write is visible (sequential.clj:106-163, trailing-nil? 135).
+
+Both are expressed in plain portable SQL over the sqlkit connection shape.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History, OK, Op
+from jepsen_tpu.workloads import sets
+
+from suites.sqlkit import _SqlClient
+
+# --------------------------------------------------------------------------
+# Monotonic
+# --------------------------------------------------------------------------
+
+
+def monotonic_generator():
+    return gen.mix([gen.repeat({"f": "add"}),
+                    gen.stagger(1.0, gen.repeat({"f": "read"}))])
+
+
+class MonotonicClient(_SqlClient):
+    """add: txn { v = 1 + max(val); insert (v, process) }; read: all rows."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS mono "
+                        "(val INT PRIMARY KEY, proc INT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query("SELECT val, proc FROM mono")
+                return op.with_(type=OK,
+                                value=sorted((int(r[0]), int(r[1]))
+                                             for r in rows))
+            # add
+            self.conn.query("BEGIN")
+            try:
+                rows = self.conn.query("SELECT MAX(val) FROM mono")
+                cur = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else -1
+                v = cur + 1
+                self.conn.query(
+                    f"INSERT INTO mono VALUES ({v}, {op.process})")
+                self.conn.query("COMMIT")
+                return op.with_(type=OK, value=v)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class MonotonicChecker(Checker):
+    """Committed adds must form a contiguous, duplicate-free range, and each
+    process's adds must increase in invocation order
+    (monotonic.clj:166-264's duplicate/reorder analysis)."""
+
+    def check(self, test, history: History, opts=None):
+        adds: List[Op] = [op for op in history
+                          if op.f == "add" and op.type == OK]
+        vals = [op.value for op in adds if op.value is not None]
+        dupes = sorted({v for v in vals if vals.count(v) > 1})
+        gaps = []
+        if vals:
+            expect = set(range(min(vals), max(vals) + 1))
+            gaps = sorted(expect - set(vals))
+        # per-process monotonicity in completion order
+        reorders = []
+        by_proc: Dict[int, int] = {}
+        for op in adds:
+            if op.value is None:
+                continue
+            last = by_proc.get(op.process)
+            if last is not None and op.value <= last:
+                reorders.append({"process": op.process,
+                                 "prev": last, "value": op.value})
+            by_proc[op.process] = op.value
+        # reads: value sets must also be gap/dupe-free prefixes
+        bad_reads = []
+        for op in history:
+            if op.f == "read" and op.type == OK and op.value:
+                rv = [v for v, _p in op.value]
+                if len(set(rv)) != len(rv) or \
+                        sorted(rv) != list(range(min(rv), max(rv) + 1)):
+                    bad_reads.append(op.to_dict())
+        if not adds:
+            return {"valid": UNKNOWN, "error": "no adds completed"}
+        return {"valid": not (dupes or gaps or reorders or bad_reads),
+                "add-count": len(adds),
+                "duplicates": dupes[:10], "gaps": gaps[:10],
+                "reorders": reorders[:10], "bad-reads": bad_reads[:5]}
+
+
+def monotonic_workload(conn_factory) -> Dict[str, Any]:
+    return {"generator": monotonic_generator(),
+            "checker": MonotonicChecker(),
+            "client": MonotonicClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Sequential
+# --------------------------------------------------------------------------
+
+N_TABLES = 5
+
+
+def sequential_generator(keys: int = 32):
+    counter = itertools.count()
+    written: List[int] = []
+
+    def one():
+        if written and random.random() < 0.5:
+            return {"f": "read", "value": random.choice(written)}
+        k = next(counter) % keys
+        written.append(k)
+        return {"f": "write", "value": k}
+
+    return gen.FnGen(one)
+
+
+class SequentialClient(_SqlClient):
+    """write k: insert k into seq0..seqN in order (separate txns, as in
+    sequential.clj:75-104); read k: select from seqN..seq0 in reverse."""
+
+    def setup(self, test):
+        for i in range(N_TABLES):
+            self.conn.query(f"CREATE TABLE IF NOT EXISTS seq{i} "
+                            f"(k INT PRIMARY KEY)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            k = op.value
+            if op.f == "write":
+                for i in range(N_TABLES):
+                    try:
+                        self.conn.query(f"INSERT INTO seq{i} VALUES ({k})")
+                    except Exception as e:  # noqa: BLE001
+                        if not getattr(e, "retryable", False) and \
+                                "duplicate" not in str(e).lower():
+                            raise
+                return op.with_(type=OK)
+            # read in reverse write order
+            seen = []
+            for i in reversed(range(N_TABLES)):
+                rows = self.conn.query(f"SELECT k FROM seq{i} "
+                                       f"WHERE k = {k}")
+                seen.append(int(rows[0][0]) if rows else None)
+            return op.with_(type=OK, value=(k, seen))
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class SequentialChecker(Checker):
+    """A reverse-order read must be nils followed by values: a non-nil
+    followed by a nil means a later write was visible while an earlier one
+    was not (trailing-nil?, sequential.clj:135-163)."""
+
+    def check(self, test, history: History, opts=None):
+        bad = []
+        n = 0
+        for op in history:
+            if op.f != "read" or op.type != OK or op.value is None:
+                continue
+            n += 1
+            _k, seen = op.value
+            saw_value = False
+            for cell in seen:
+                if cell is not None:
+                    saw_value = True
+                elif saw_value:
+                    bad.append(op.to_dict())
+                    break
+        if n == 0:
+            return {"valid": UNKNOWN, "error": "no reads completed"}
+        return {"valid": not bad, "read-count": n, "bad-reads": bad[:10]}
+
+
+def sequential_workload(conn_factory, keys: int = 32) -> Dict[str, Any]:
+    return {"generator": sequential_generator(keys),
+            "checker": SequentialChecker(),
+            "client": SequentialClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Dirty reads (galera/src/jepsen/galera/dirty_reads.clj; also used by the
+# percona and crate suites)
+# --------------------------------------------------------------------------
+
+N_ROWS = 4
+
+
+def dirty_reads_generator():
+    counter = itertools.count(1)
+    return gen.mix([gen.repeat({"f": "read"}),
+                    gen.FnGen(lambda: {"f": "write",
+                                       "value": next(counter)})])
+
+
+class DirtyReadsClient(_SqlClient):
+    """Writers set every row of the table to one unique value in a single
+    transaction; readers scan the table.  A reader observing a *failed*
+    transaction's value is a dirty read (dirty_reads.clj:1-6,54-66)."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS dirty "
+                        "(id INT PRIMARY KEY, x INT)")
+        for i in range(N_ROWS):
+            try:
+                self.conn.query(f"INSERT INTO dirty VALUES ({i}, -1)")
+            except Exception:  # noqa: BLE001 — another node inserted first
+                pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query("SELECT id, x FROM dirty")
+                return op.with_(type=OK,
+                                value=[int(r[1]) for r in rows])
+            x = op.value
+            self.conn.query("BEGIN")
+            try:
+                for i in range(N_ROWS):
+                    self.conn.query(
+                        f"UPDATE dirty SET x = {x} WHERE id = {i}")
+                self.conn.query("COMMIT")
+                return op.with_(type=OK)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class DirtyReadsChecker(Checker):
+    """Any read observing a value written only by a FAILED transaction is a
+    dirty read (dirty_reads.clj:73-96)."""
+
+    def check(self, test, history: History, opts=None):
+        from jepsen_tpu.history import FAIL
+        failed = {op.value for op in history
+                  if op.f == "write" and op.type == FAIL
+                  and op.value is not None}
+        seen = set()
+        n_reads = 0
+        for op in history:
+            if op.f == "read" and op.type == OK and op.value is not None:
+                n_reads += 1
+                seen.update(v for v in op.value if v != -1)
+        dirty = sorted(seen & failed)
+        if n_reads == 0:
+            return {"valid": UNKNOWN, "error": "no reads completed"}
+        return {"valid": not dirty, "read-count": n_reads,
+                "dirty-values": dirty[:10]}
+
+
+def dirty_reads_workload(conn_factory) -> Dict[str, Any]:
+    return {"generator": dirty_reads_generator(),
+            "checker": DirtyReadsChecker(),
+            "client": DirtyReadsClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Lost updates via read-modify-write set (crate/src/jepsen/crate/
+# lost_updates.clj: set-add through an optimistic RMW on one row)
+# --------------------------------------------------------------------------
+
+
+class RmwSetClient(_SqlClient):
+    """add v: transactionally read the elements row, append v, write back;
+    read: parse the row.  Under weak isolation concurrent RMWs silently
+    drop elements — the lost-updates anomaly (lost_updates.clj:56-80)."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS append "
+                        "(k INT PRIMARY KEY, vals TEXT)")
+
+    def _read(self):
+        rows = self.conn.query("SELECT vals FROM append WHERE k = 0")
+        cur = (rows[0][0] or "") if rows else None
+        return cur
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                cur = self._read() or ""
+                return op.with_(
+                    type=OK,
+                    value=[int(x) for x in cur.split(",") if x])
+            v = op.value
+            self.conn.query("BEGIN")
+            try:
+                cur = self._read()
+                if cur is None:
+                    self.conn.query(
+                        f"INSERT INTO append VALUES (0, '{v}')")
+                else:
+                    new = f"{cur},{v}" if cur else str(v)
+                    self.conn.query(f"UPDATE append SET vals = '{new}' "
+                                    f"WHERE k = 0")
+                self.conn.query("COMMIT")
+                return op.with_(type=OK)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+def lost_updates_workload(conn_factory) -> Dict[str, Any]:
+    wl = sets.workload()
+    return {**wl, "client": RmwSetClient(conn_factory)}

@@ -47,6 +47,13 @@ class _SqlClient(jclient.Client):
     def _definite(self, op: Op, e: Exception) -> Op:
         return op.with_(type=FAIL, error=str(e))
 
+    def _upsert_kv(self, k, v) -> None:
+        """UPDATE-then-INSERT upsert on the kv table (shared by the
+        register and txn clients so the two stay in lockstep)."""
+        self.conn.query(f"UPDATE kv SET val = {v} WHERE k = {k}")
+        if self.conn.rowcount == 0:
+            self.conn.query(f"INSERT INTO kv VALUES ({k}, {v})")
+
     def _convert(self, op: Op, e: Exception) -> Op:
         retryable = getattr(e, "retryable", False)
         if retryable:
@@ -65,7 +72,7 @@ class BankClient(_SqlClient):
     """Transfers between account rows in one transaction; reads select the
     whole table (jepsen.tests.bank semantics, cockroach/bank.clj)."""
 
-    def setup(self, test, node):
+    def setup(self, test):
         wl = test.get("bank", {})
         accounts = wl.get("accounts", list(range(8)))
         total = wl.get("total_amount", 80)
@@ -115,7 +122,7 @@ class RegisterClient(_SqlClient):
     row count (cockroach/register.clj shape).  Values are (k, v) tuples
     from the independent lift."""
 
-    def setup(self, test, node):
+    def setup(self, test):
         self.conn.query("CREATE TABLE IF NOT EXISTS kv "
                         "(k INT PRIMARY KEY, val INT)")
 
@@ -129,9 +136,7 @@ class RegisterClient(_SqlClient):
                     else None
                 return op.with_(type=OK, value=(k, val))
             if op.f == "write":
-                self.conn.query(f"UPDATE kv SET val = {v} WHERE k = {k}")
-                if self.conn.rowcount == 0:
-                    self.conn.query(f"INSERT INTO kv VALUES ({k}, {v})")
+                self._upsert_kv(k, v)
                 return op.with_(type=OK)
             if op.f == "cas":
                 old, new = v
@@ -146,7 +151,7 @@ class RegisterClient(_SqlClient):
 class SetClient(_SqlClient):
     """Unique-row inserts, final full read (cockroach/sets.clj shape)."""
 
-    def setup(self, test, node):
+    def setup(self, test):
         self.conn.query("CREATE TABLE IF NOT EXISTS sets (val INT)")
 
     def invoke(self, test, op: Op) -> Op:
@@ -160,12 +165,49 @@ class SetClient(_SqlClient):
             return self._convert(op, e)
 
 
+class TxnClient(_SqlClient):
+    """Generic read/write transactions over the kv table: mops are
+    ``["r", k, None]`` / ``["w", k, v]``, the whole txn in BEGIN..COMMIT.
+    Drives the Elle rw-register, long-fork, and Adya G2/dirty-update
+    workloads (cockroachdb's comments/g2 tests, jepsen.tests.long-fork)."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS kv "
+                        "(k INT PRIMARY KEY, val INT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            self.conn.query("BEGIN")
+            try:
+                out = []
+                for f, k, v in op.value:
+                    if f == "r":
+                        rows = self.conn.query(
+                            f"SELECT val FROM kv WHERE k = {k}")
+                        val = int(rows[0][0]) if rows and rows[0][0] is not \
+                            None else None
+                        out.append(["r", k, val])
+                    else:  # w
+                        self._upsert_kv(k, v)
+                        out.append(["w", k, v])
+                self.conn.query("COMMIT")
+                return op.with_(type=OK, value=out)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
 class AppendClient(_SqlClient):
     """Elle list-append transactions: each mop reads or appends to a
     text-encoded list row, the whole txn in BEGIN..COMMIT
     (stolon/src/jepsen/stolon/append.clj shape)."""
 
-    def setup(self, test, node):
+    def setup(self, test):
         self.conn.query("CREATE TABLE IF NOT EXISTS append "
                         "(k INT PRIMARY KEY, vals TEXT)")
 

@@ -1,0 +1,99 @@
+"""SQL suite factory: one registry of SQL-backed workloads, many suites.
+
+The reference's SQL-family suites (postgres-rds, stolon, cockroachdb, crate,
+yugabyte YSQL, tidb, galera, percona, mysql-cluster) all assemble the same
+workloads — bank (cockroachdb/src/jepsen/cockroach/bank.clj), register
+(cockroach/register.clj), sets (cockroach/sets.clj), Elle list-append
+(stolon/src/jepsen/stolon/append.clj), rw-register / G2 / long-fork
+(cockroach/{comments,adya}.clj, jepsen/src/jepsen/tests/long_fork.clj) —
+over a jdbc connection with per-dialect error classification.  Here the
+workloads are factored once over any ``query(sql)`` connection
+(suites/sqlkit.py); a suite supplies its conn factory + DB + OS.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from jepsen_tpu.workloads import adya, bank, cycle, linearizable_register
+from jepsen_tpu.workloads import long_fork as lf
+from jepsen_tpu.workloads import sets
+
+from suites import common, sqlkit
+
+
+def make_workloads(conn_factory: Callable) -> Dict[str, Callable]:
+    """name -> (opts -> workload dict) over one SQL connection factory."""
+
+    def bank_wl(opts):
+        wl = bank.workload(total=int(opts.get("total_amount", 100)))
+        return {**wl, "client": sqlkit.BankClient(conn_factory)}
+
+    def register_wl(opts):
+        wl = linearizable_register.workload(
+            keys=range(int(opts.get("keys", 8))),
+            ops_per_key=int(opts.get("ops_per_key", 200)),
+            threads_per_key=int(opts.get("threads_per_key", 2)))
+        return {**wl, "client": sqlkit.RegisterClient(conn_factory)}
+
+    def set_wl(opts):
+        wl = sets.workload()
+        return {**wl, "client": sqlkit.SetClient(conn_factory)}
+
+    def append_wl(opts):
+        wl = cycle.append_workload(keys=int(opts.get("keys", 8)))
+        return {**wl, "client": sqlkit.AppendClient(conn_factory)}
+
+    def wr_wl(opts):
+        wl = cycle.wr_workload(keys=int(opts.get("keys", 8)))
+        return {**wl, "client": sqlkit.TxnClient(conn_factory)}
+
+    def long_fork_wl(opts):
+        wl = lf.workload()
+        return {**wl, "client": sqlkit.TxnClient(conn_factory)}
+
+    def g2_wl(opts):
+        wl = adya.g2_workload()
+        return {**wl, "client": sqlkit.TxnClient(conn_factory)}
+
+    return {"bank": bank_wl, "register": register_wl, "set": set_wl,
+            "append": append_wl, "wr": wr_wl, "long-fork": long_fork_wl,
+            "g2": g2_wl}
+
+
+def make_suite(suite: str, db, conn_factory: Callable, os=None,
+               nemeses: Optional[Dict[str, Callable]] = None,
+               extra_workloads: Optional[Dict[str, Callable]] = None,
+               default_workload: str = "register"):
+    """Returns (WORKLOADS, test_fn, all_tests, main)."""
+    workloads = make_workloads(conn_factory)
+    if extra_workloads:
+        workloads.update(extra_workloads)
+
+    def test_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
+        opts = {**opts}
+        opts.setdefault("workload", default_workload)
+        t = common.build_test(opts, suite=suite, db=db,
+                              workloads=workloads, nemeses=nemeses, os=os)
+        # BankClient.setup reads the account/total config from the test map
+        if opts.get("workload") == "bank":
+            t["bank"] = {"accounts": list(range(8)),
+                         "total_amount": int(opts.get("total_amount", 100))}
+        return t
+
+    def all_tests(opts: Dict[str, Any]):
+        return common.sweep(opts, test_fn, workloads, nemeses)
+
+    def main() -> int:
+        return common.main(test_fn, workloads, nemeses,
+                           prog=f"jepsen-tpu-{suite}",
+                           extra_opts=_sql_opts)
+
+    return workloads, test_fn, all_tests, main
+
+
+def _sql_opts(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=200)
+    parser.add_argument("--threads-per-key", type=int, default=2)
+    parser.add_argument("--total-amount", type=int, default=100)

@@ -1,0 +1,9 @@
+"""stolon suite — Postgres HA under stolon (keeper/sentinel/proxy).
+
+Parity: stolon/src/jepsen/stolon/{db,client,append,nemesis}.clj — Elle
+list-append is the flagship workload (append.clj); the DB layer installs
+postgres + the stolon release and runs keeper/sentinel/proxy daemons backed
+by an etcdv3 store (db.clj:85).
+"""
+
+from suites.stolon.runner import WORKLOADS, all_tests, stolon_test  # noqa: F401

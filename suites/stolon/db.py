@@ -1,0 +1,154 @@
+"""Stolon cluster install/start: postgres + keeper/sentinel/proxy + etcd.
+
+Parity: stolon/src/jepsen/stolon/db.clj — postgres from the PGDG apt repo
+(db.clj:45-60, service disabled so stolon owns the lifecycle), stolon
+release tarball, ``--store-backend etcdv3`` (db.clj:85), three daemons with
+their own pid/log files (db.clj:27-37), all running as the postgres user
+(db.clj:24-25).  The etcd store reuses this repo's etcd suite DB.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+from suites.etcd.db import CLIENT_PORT as ETCD_PORT
+from suites.etcd.db import EtcdDB
+
+VERSION = "0.17.0"
+URL = ("https://github.com/sorintlab/stolon/releases/download/"
+       f"v{VERSION}/stolon-v{VERSION}-linux-amd64.tar.gz")
+DIR = "/opt/stolon"
+DATA = "/opt/stolon/data"
+CLUSTER = "jepsen"
+PG_PORT = 5433          # keeper-managed postgres
+PROXY_PORT = 25432      # clients connect here
+PG_USER = "postgres"
+PG_PASSWORD = "pw"
+
+SENTINEL_PID, SENTINEL_LOG = f"{DIR}/sentinel.pid", f"{DIR}/sentinel.log"
+KEEPER_PID, KEEPER_LOG = f"{DIR}/keeper.pid", f"{DIR}/keeper.log"
+PROXY_PID, PROXY_LOG = f"{DIR}/proxy.pid", f"{DIR}/proxy.log"
+
+
+def store_endpoints(test) -> str:
+    return ",".join(f"http://{n}:{ETCD_PORT}" for n in test["nodes"])
+
+
+class StolonDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    """etcd (store) + postgres + stolon daemons on every node."""
+
+    def __init__(self):
+        self.etcd = EtcdDB()
+
+    def _install_postgres(self, s):
+        # PGDG repo install, then hand the service to stolon
+        # (stolon/db.clj:45-60)
+        cu.cached_wget(s, "https://www.postgresql.org/media/keys/ACCC4CF8.asc",
+                       "/tmp/pgdg.asc")
+        s.exec("apt-key", "add", "/tmp/pgdg.asc")
+        cu.write_file(
+            s, "deb http://apt.postgresql.org/pub/repos/apt/ "
+               "bullseye-pgdg main",
+            "/etc/apt/sources.list.d/pgdg.list")
+        s.exec("apt-get", "update")
+        s.exec("env", "DEBIAN_FRONTEND=noninteractive", "apt-get", "install",
+               "-y", "postgresql-12", "postgresql-client-12")
+        s.exec("service", "postgresql", "stop")
+        s.exec("update-rc.d", "postgresql", "disable")
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        self.etcd.setup(test, node)
+        self._install_postgres(s)
+        cu.install_archive(s, URL, DIR)
+        cu.ensure_user(s, PG_USER)
+        s.exec("mkdir", "-p", DATA)
+        s.exec("chown", "-R", f"{PG_USER}:{PG_USER}", DIR)
+        if node == test["nodes"][0]:
+            s.exec(f"{DIR}/bin/stolonctl",
+                   "--cluster-name", CLUSTER,
+                   "--store-backend", "etcdv3",
+                   "--store-endpoints", store_endpoints(test),
+                   "init", "-y",
+                   '{"initMode":"new","pgParameters":'
+                   '{"max_connections":"300"},'
+                   '"proxyCheckInterval":"1s","proxyTimeout":"3s"}')
+        self.start(test, node)
+        cu.await_tcp_port(s, PROXY_PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        for pid in (PROXY_PID, SENTINEL_PID, KEEPER_PID):
+            cu.stop_daemon(s, pid)
+        self.etcd.teardown(test, node)
+        s.exec("rm", "-rf", DATA, KEEPER_LOG, SENTINEL_LOG, PROXY_LOG)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        store = ["--cluster-name", CLUSTER, "--store-backend", "etcdv3",
+                 "--store-endpoints", store_endpoints(test)]
+        cu.start_daemon(s, f"{DIR}/bin/stolon-sentinel", *store,
+                        pidfile=SENTINEL_PID, logfile=SENTINEL_LOG,
+                        user=PG_USER)
+        cu.start_daemon(s, f"{DIR}/bin/stolon-keeper", *store,
+                        "--uid", f"keeper_{node.replace('.', '_')}",
+                        "--data-dir", DATA,
+                        "--pg-listen-address", node,
+                        "--pg-port", str(PG_PORT),
+                        "--pg-su-password", PG_PASSWORD,
+                        "--pg-repl-username", "repl",
+                        "--pg-repl-password", PG_PASSWORD,
+                        pidfile=KEEPER_PID, logfile=KEEPER_LOG, user=PG_USER)
+        cu.start_daemon(s, f"{DIR}/bin/stolon-proxy", *store,
+                        "--listen-address", "0.0.0.0",
+                        "--port", str(PROXY_PORT),
+                        pidfile=PROXY_PID, logfile=PROXY_LOG, user=PG_USER)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("stolon-proxy", "stolon-sentinel", "stolon-keeper",
+                    "postgres"):
+            cu.grepkill(s, pat)
+        for pid in (PROXY_PID, SENTINEL_PID, KEEPER_PID):
+            s.exec("rm", "-f", pid)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("stolon-keeper", "postgres"):
+            cu.signal(s, pat, "STOP")
+
+    def resume(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("stolon-keeper", "postgres"):
+            cu.signal(s, pat, "CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        s = session(test, test["nodes"][0]).sudo()
+        try:
+            out = s.exec(f"{DIR}/bin/stolonctl",
+                         "--cluster-name", CLUSTER,
+                         "--store-backend", "etcdv3",
+                         "--store-endpoints", store_endpoints(test),
+                         "status")
+            for line in out.splitlines():
+                if "master" in line.lower():
+                    for n in test["nodes"]:
+                        if n.replace(".", "_") in line or n in line:
+                            return [n]
+        except Exception:  # noqa: BLE001
+            pass
+        return []
+
+    def setup_primary(self, test, node):
+        pass  # sentinel elects the master
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [KEEPER_LOG, SENTINEL_LOG, PROXY_LOG]

@@ -1,0 +1,34 @@
+"""stolon suite CLI — append (Elle) is the flagship workload.
+
+Parity: stolon/src/jepsen/stolon/append.clj (list-append over jdbc with
+serializable isolation) + nemesis.clj's standard package set.
+
+    python -m suites.stolon.runner test --node n1 ... --workload append
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.pgwire import PgClient
+
+from suites import sqlsuite
+from suites.stolon import db as sdb
+from suites.stolon.db import StolonDB
+
+
+def conn(node, test):
+    # clients go through the local stolon-proxy, which routes to the
+    # elected master (stolon/client.clj:14-26)
+    return PgClient(node,
+                    port=int(test.get("db_port", sdb.PROXY_PORT)),
+                    user=test.get("db_user", sdb.PG_USER),
+                    password=test.get("db_password", sdb.PG_PASSWORD),
+                    database=test.get("db_name", "postgres")).connect()
+
+
+WORKLOADS, stolon_test, all_tests, main = sqlsuite.make_suite(
+    "stolon", StolonDB(), conn, default_workload="append")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

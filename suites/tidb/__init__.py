@@ -1,0 +1,10 @@
+"""tidb suite — the reference's fullest modern suite shape.
+
+Parity: tidb/src/tidb/{core,db,sql,nemesis}.clj + per-workload files
+(bank, register, sets, long_fork, monotonic, sequential, txn): PD/TiKV/
+TiDB three-tier cluster, MySQL-protocol clients, workload-options sweep
+matrices (core.clj:112-174), faketime clock-rate skew support
+(core.clj:344, db.clj:12).
+"""
+
+from suites.tidb.runner import WORKLOADS, all_tests, tidb_test  # noqa: F401

@@ -1,0 +1,146 @@
+"""TiDB cluster install/start: pd-server quorum, tikv-server, tidb-server.
+
+Parity: tidb/src/tidb/db.clj — community tarball, per-component
+pid/log/data files (db.clj:23-41), PD initial-cluster bootstrapping, TiKV
+pointed at the PD quorum, TiDB on top, optional faketime LD_PRELOAD wrapper
+for clock-rate skew (db.clj:12, core.clj:344-346).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu import faketime
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "v7.5.0"
+URL = (f"https://download.pingcap.org/"
+       f"tidb-community-server-{VERSION}-linux-amd64.tar.gz")
+DIR = "/opt/tidb"
+BIN = f"{DIR}/bin"
+PD_PORT, PD_PEER_PORT = 2379, 2380
+KV_PORT = 20160
+SQL_PORT = 4000
+
+PD_PID, PD_LOG = f"{DIR}/pd.pid", f"{DIR}/pd.log"
+KV_PID, KV_LOG = f"{DIR}/kv.pid", f"{DIR}/kv.log"
+DB_PID, DB_LOG = f"{DIR}/db.pid", f"{DIR}/db.log"
+
+
+def pd_name(node: str) -> str:
+    return f"pd-{node.replace('.', '-')}"
+
+
+def initial_cluster(test) -> str:
+    return ",".join(f"{pd_name(n)}=http://{n}:{PD_PEER_PORT}"
+                    for n in test["nodes"])
+
+
+def pd_endpoints(test) -> str:
+    return ",".join(f"{n}:{PD_PORT}" for n in test["nodes"])
+
+
+class TiDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("bash", "-c",
+               f"[ -x {BIN}/pd-server ] || "
+               f"cp -r {DIR}/tidb-community-server-*/* {DIR}/ "
+               f"2>/dev/null || true")
+        if test.get("faketime"):
+            # wrap each server in a clock-rate-skewing LD_PRELOAD script
+            # (tidb/db.clj:12's faketime wrappers; --faketime MAX_RATIO at
+            # core.clj:344-346)
+            import random as _random
+            faketime.install(test, node)
+            ratio = float(test["faketime"])
+            for b in ("pd-server", "tikv-server", "tidb-server"):
+                real = f"{BIN}/{b}"
+                s.exec("bash", "-c",
+                       f"[ -f {real}.real ] || mv {real} {real}.real")
+                faketime.wrap_binary(
+                    test, node, f"{real}.real", real,
+                    offset_s=0.0,
+                    rate=_random.uniform(1.0 / ratio, ratio))
+        self.start(test, node)
+        cu.await_tcp_port(s, SQL_PORT, timeout_s=180)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        for pid in (DB_PID, KV_PID, PD_PID):
+            cu.stop_daemon(s, pid)
+        s.exec("rm", "-rf", f"{DIR}/data", PD_LOG, KV_LOG, DB_LOG)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(
+            s, f"{BIN}/pd-server",
+            "--name", pd_name(node),
+            "--data-dir", f"{DIR}/data/pd",
+            "--client-urls", f"http://0.0.0.0:{PD_PORT}",
+            "--advertise-client-urls", f"http://{node}:{PD_PORT}",
+            "--peer-urls", f"http://0.0.0.0:{PD_PEER_PORT}",
+            "--advertise-peer-urls", f"http://{node}:{PD_PEER_PORT}",
+            "--initial-cluster", initial_cluster(test),
+            pidfile=PD_PID, logfile=PD_LOG)
+        cu.await_tcp_port(s, PD_PORT, timeout_s=120)
+        cu.start_daemon(
+            s, f"{BIN}/tikv-server",
+            "--pd", pd_endpoints(test),
+            "--addr", f"0.0.0.0:{KV_PORT}",
+            "--advertise-addr", f"{node}:{KV_PORT}",
+            "--data-dir", f"{DIR}/data/kv",
+            pidfile=KV_PID, logfile=KV_LOG)
+        cu.start_daemon(
+            s, f"{BIN}/tidb-server",
+            "--store", "tikv",
+            "--path", pd_endpoints(test),
+            "-P", str(SQL_PORT),
+            pidfile=DB_PID, logfile=DB_LOG)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("tidb-server", "tikv-server", "pd-server"):
+            cu.grepkill(s, pat)
+        for pid in (DB_PID, KV_PID, PD_PID):
+            s.exec("rm", "-f", pid)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("tidb-server", "tikv-server", "pd-server"):
+            cu.signal(s, pat, "STOP")
+
+    def resume(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("tidb-server", "tikv-server", "pd-server"):
+            cu.signal(s, pat, "CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        import json
+        import urllib.request
+        for node in test["nodes"]:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{node}:{PD_PORT}/pd/api/v1/leader",
+                        timeout=2) as r:
+                    leader = json.load(r)
+                name = leader.get("name", "")
+                for n in test["nodes"]:
+                    if pd_name(n) == name:
+                        return [n]
+            except Exception:  # noqa: BLE001
+                continue
+        return []
+
+    def setup_primary(self, test, node):
+        pass
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [PD_LOG, KV_LOG, DB_LOG]

@@ -1,0 +1,55 @@
+"""tidb suite CLI — full workload registry + sweep matrices + faketime.
+
+Parity: tidb/src/tidb/core.clj — the workloads table (core.clj:32-45:
+bank, register, sets, append/txn, long-fork, monotonic, sequential),
+``--faketime MAX_RATIO`` clock-rate skew (core.clj:344-346), and the
+all-combinations sweep (core.clj:112-174 all-workload-options) exposed as
+``all_tests`` for ``test-all``.
+
+    python -m suites.tidb.runner test --node n1 ... \
+        --workload register --nemesis kill --faketime 1.05
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.clients.mysql import MysqlClient
+
+from suites import sqlextra, sqlsuite
+from suites.tidb.db import SQL_PORT, TiDB
+
+
+def conn(node, test):
+    return MysqlClient(node,
+                       port=int(test.get("db_port", SQL_PORT)),
+                       user=test.get("db_user", "root"),
+                       password=test.get("db_password", ""),
+                       database=test.get("db_name", "test")).connect()
+
+
+EXTRA = {
+    "monotonic": lambda opts: sqlextra.monotonic_workload(conn),
+    "sequential": lambda opts: sqlextra.sequential_workload(
+        conn, keys=int(opts.get("keys", 32))),
+}
+
+WORKLOADS, tidb_test, all_tests, _main = sqlsuite.make_suite(
+    "tidb", TiDB(), conn, extra_workloads=EXTRA,
+    default_workload="register")
+
+
+def main() -> int:
+    from suites import common
+
+    def extra_opts(parser):
+        sqlsuite._sql_opts(parser)
+        parser.add_argument(
+            "--faketime", type=float, default=None,
+            help="skew server clock rates up to this ratio via libfaketime")
+
+    return common.main(tidb_test, WORKLOADS, prog="jepsen-tpu-tidb",
+                       extra_opts=extra_opts)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

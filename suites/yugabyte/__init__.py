@@ -1,0 +1,9 @@
+"""yugabyte suite — YSQL workload registry with role-aware nemeses.
+
+Parity: yugabyte/src/yugabyte/{core,auto,nemesis,runner}.clj plus the
+ycql/ysql workload dirs (append, bank, counter, set, single/multi-key
+acid, long-fork).  The reference's nemesis registry distinguishes master
+vs tserver kills (nemesis.clj); mirrored here as suite-specific packages.
+"""
+
+from suites.yugabyte.runner import WORKLOADS, all_tests, yugabyte_test  # noqa: F401

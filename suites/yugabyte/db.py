@@ -1,0 +1,136 @@
+"""YugabyteDB cluster install/start: yb-master quorum + yb-tserver per node.
+
+Parity: yugabyte/src/yugabyte/auto.clj — masters on the first (up to) 3
+nodes (master-nodes 57-67), master_addresses strings (74-82), separate
+master/tserver daemons with their own log dirs (25-26), YSQL proxy on the
+tservers.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "2.20.0.0"
+BUILD = "b76"
+URL = (f"https://downloads.yugabyte.com/releases/{VERSION}/"
+       f"yugabyte-{VERSION}-{BUILD}-linux-x86_64.tar.gz")
+DIR = "/opt/yugabyte"
+DATA = "/opt/yugabyte/data"
+MASTER_PID, MASTER_LOG = "/var/run/yb-master.pid", "/var/log/yb-master.log"
+TSERVER_PID, TSERVER_LOG = ("/var/run/yb-tserver.pid",
+                            "/var/log/yb-tserver.log")
+MASTER_RPC_PORT = 7100
+TSERVER_RPC_PORT = 9100
+YSQL_PORT = 5433
+
+
+def master_nodes(test) -> List[str]:
+    """Replication-factor-many masters on the first nodes (auto.clj:57)."""
+    rf = min(3, len(test["nodes"]))
+    return list(test["nodes"])[:rf]
+
+
+def master_addresses(test) -> str:
+    return ",".join(f"{n}:{MASTER_RPC_PORT}" for n in master_nodes(test))
+
+
+class YugabyteDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("bash", "-c",
+               f"[ -x {DIR}/bin/yb-master ] || "
+               f"cp -r {DIR}/yugabyte-*/* {DIR}/ 2>/dev/null || true")
+        s.exec("bash", "-c",
+               f"{DIR}/bin/post_install.sh >/dev/null 2>&1 || true")
+        s.exec("mkdir", "-p", DATA)
+        self.start(test, node)
+        cu.await_tcp_port(s, TSERVER_RPC_PORT, timeout_s=180)
+        cu.await_tcp_port(s, YSQL_PORT, timeout_s=180)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        for pid in (TSERVER_PID, MASTER_PID):
+            cu.stop_daemon(s, pid)
+        s.exec("rm", "-rf", DATA, MASTER_LOG, TSERVER_LOG)
+
+    # -- role-specific lifecycle (auto.clj:51-54) --------------------------
+    def start_master(self, test, node):
+        if node not in master_nodes(test):
+            return
+        s = session(test, node).sudo()
+        cu.start_daemon(
+            s, f"{DIR}/bin/yb-master",
+            "--master_addresses", master_addresses(test),
+            "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
+            "--fs_data_dirs", f"{DATA}/master",
+            "--replication_factor", str(len(master_nodes(test))),
+            pidfile=MASTER_PID, logfile=MASTER_LOG)
+
+    def start_tserver(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(
+            s, f"{DIR}/bin/yb-tserver",
+            "--tserver_master_addrs", master_addresses(test),
+            "--rpc_bind_addresses", f"{node}:{TSERVER_RPC_PORT}",
+            "--fs_data_dirs", f"{DATA}/tserver",
+            "--start_pgsql_proxy",
+            "--pgsql_proxy_bind_address", f"0.0.0.0:{YSQL_PORT}",
+            pidfile=TSERVER_PID, logfile=TSERVER_LOG)
+
+    def stop_master(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "yb-master")
+        s.exec("rm", "-f", MASTER_PID)
+
+    def stop_tserver(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "yb-tserver")
+        s.exec("rm", "-f", TSERVER_PID)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        self.start_master(test, node)
+        self.start_tserver(test, node)
+
+    def kill(self, test, node):
+        self.stop_master(test, node)
+        self.stop_tserver(test, node)
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("yb-master", "yb-tserver"):
+            cu.signal(s, pat, "STOP")
+
+    def resume(self, test, node):
+        s = session(test, node).sudo()
+        for pat in ("yb-master", "yb-tserver"):
+            cu.signal(s, pat, "CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        s = session(test, test["nodes"][0]).sudo()
+        try:
+            out = s.exec(f"{DIR}/bin/yb-admin",
+                         "--master_addresses", master_addresses(test),
+                         "list_all_masters")
+            for line in out.splitlines():
+                if "LEADER" in line:
+                    for n in master_nodes(test):
+                        if n in line:
+                            return [n]
+        except Exception:  # noqa: BLE001
+            pass
+        return []
+
+    def setup_primary(self, test, node):
+        pass
+
+    # -- LogFiles capability -----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        return [MASTER_LOG, TSERVER_LOG]

@@ -1,0 +1,65 @@
+"""yugabyte suite CLI — YSQL registry + role-aware nemesis registry.
+
+Parity: yugabyte/src/yugabyte/nemesis.clj's registry (kill/pause split by
+master vs tserver role, partitions, clock) and core.clj's workload table
+(append, bank, set, long-fork, single/multi-key acid ≈ register/wr here).
+The reference's CI sweep driver (yugabyte/run-jepsen.py:34-59) maps to
+``all_tests`` + ``jepsen_tpu.cli.test_all_cmd``.
+
+    python -m suites.yugabyte.runner test --node n1 ... \
+        --workload append --nemesis kill-master
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.clients.pgwire import PgClient
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.nemesis.faults import NodeStartStopper
+
+from suites import common, sqlsuite
+from suites.yugabyte import db as ydb
+from suites.yugabyte.db import YSQL_PORT, YugabyteDB
+
+
+def conn(node, test):
+    return PgClient(node,
+                    port=int(test.get("db_port", YSQL_PORT)),
+                    user=test.get("db_user", "yugabyte"),
+                    database=test.get("db_name", "yugabyte")).connect()
+
+
+def _role_package(opts, role: str) -> combined.Package:
+    """Kill-and-restart one process role on a random node
+    (yugabyte/nemesis.clj's kill-master / kill-tserver packages)."""
+    db: YugabyteDB = opts.get("_db") or YugabyteDB()
+    stop = getattr(db, f"stop_{role}")
+    start = getattr(db, f"start_{role}")
+
+    def targeter(test, nodes):
+        pool = ydb.master_nodes(test) if role == "master" else nodes
+        return [random.choice(pool)]
+
+    nem = NodeStartStopper(targeter=targeter, stop_fn=stop, start_fn=start)
+    interval = opts.get("interval", 10.0)
+    g = gen.stagger(interval, gen.cycle(gen.lift([
+        {"f": "start", "type": "info"},
+        {"f": "stop", "type": "info"}])))
+    return combined.Package(nemesis=nem, generator=g,
+                            final_generator=[{"f": "stop", "type": "info"}])
+
+
+NEMESES = dict(common.STANDARD_NEMESES)
+NEMESES["kill-master"] = lambda opts: _role_package(opts, "master")
+NEMESES["kill-tserver"] = lambda opts: _role_package(opts, "tserver")
+
+WORKLOADS, yugabyte_test, all_tests, main = sqlsuite.make_suite(
+    "yugabyte", YugabyteDB(), conn, nemeses=NEMESES,
+    default_workload="append")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

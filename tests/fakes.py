@@ -564,6 +564,9 @@ class MiniSqlState:
         self.kv: Dict[int, int] = {}
         self.sets_rows: List[int] = []
         self.append_rows: Dict[int, str] = {}
+        self.mono: Dict[int, int] = {}          # val -> proc
+        self.dirty: Dict[int, int] = {}         # id -> x
+        self.seq: Dict[int, set] = {}           # table idx -> {k}
         self.lock = _NullLock()  # handlers' outer lock: serialization is
         self.txn = threading.RLock()  # done here, txn-scoped
         self._holders: Dict[int, int] = {}  # thread id -> depth
@@ -677,5 +680,62 @@ class MiniSqlState:
             return [], 1, None
         if low == "select 1":
             return [(1,)], 0, None
+        m = _re.match(r"drop table if exists (\w+)", low)
+        if m:
+            t = m.group(1)
+            if t == "accounts":
+                self.accounts.clear()
+            elif t == "kv":
+                self.kv.clear()
+            elif t == "sets":
+                self.sets_rows.clear()
+            elif t == "append":
+                self.append_rows.clear()
+            return [], 0, None
+        # monotonic workload (suites/sqlextra.py)
+        if low == "select max(val) from mono":
+            return [(max(self.mono) if self.mono else None,)], 0, None
+        if low == "select val, proc from mono":
+            return sorted(self.mono.items()), 0, None
+        m = _re.match(r"insert into mono values \((\d+), (\d+)\)", low)
+        if m:
+            v, p = int(m.group(1)), int(m.group(2))
+            if v in self.mono:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            self.mono[v] = p
+            return [], 1, None
+        # dirty-reads workload
+        if low == "select id, x from dirty":
+            return sorted(self.dirty.items()), 0, None
+        m = _re.match(r"insert into dirty values \((\d+), (-?\d+)\)", low)
+        if m:
+            i, x = int(m.group(1)), int(m.group(2))
+            if i in self.dirty:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            self.dirty[i] = x
+            return [], 1, None
+        m = _re.match(r"update dirty set x = (-?\d+) where id = (\d+)", low)
+        if m:
+            x, i = int(m.group(1)), int(m.group(2))
+            if i not in self.dirty:
+                return [], 0, None
+            self.dirty[i] = x
+            return [], 1, None
+        # sequential workload: seq0..seqN tables of keys
+        m = _re.match(r"insert into seq(\d+) values \((\d+)\)", low)
+        if m:
+            t, k = int(m.group(1)), int(m.group(2))
+            rows = self.seq.setdefault(t, set())
+            if k in rows:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            rows.add(k)
+            return [], 1, None
+        m = _re.match(r"select k from seq(\d+) where k = (\d+)", low)
+        if m:
+            t, k = int(m.group(1)), int(m.group(2))
+            return ([(k,)] if k in self.seq.get(t, set()) else []), 0, None
         return [], 0, {"S": "ERROR", "C": "42601",
                        "M": f"unparsed: {q[:60]}", "errno": "1064"}

@@ -1,0 +1,64 @@
+"""MySQL-family suites (tidb, galera, percona, mysql-cluster): wire smoke
+tests over the fake MySQL server + sweep-construction tests."""
+
+import pytest
+
+from tests.fakes import FakeMysqlHandler, MiniSqlState, start_server
+from tests.test_sql_suites import run_wire_test
+
+
+@pytest.fixture()
+def mysql_port():
+    srv, port = start_server(FakeMysqlHandler, MiniSqlState())
+    yield port
+    srv.shutdown()
+
+
+class TestMysqlFamilyWire:
+    def test_tidb_register(self, mysql_port):
+        from suites.tidb.runner import WORKLOADS
+        run_wire_test(
+            WORKLOADS["register"]({"keys": 2, "ops_per_key": 40}),
+            "tidb-register", mysql_port)
+
+    def test_tidb_append(self, mysql_port):
+        from suites.tidb.runner import WORKLOADS
+        run_wire_test(WORKLOADS["append"]({"keys": 4}), "tidb-append",
+                      mysql_port)
+
+    def test_tidb_monotonic(self, mysql_port):
+        from suites.tidb.runner import WORKLOADS
+        run_wire_test(WORKLOADS["monotonic"]({}), "tidb-monotonic",
+                      mysql_port)
+
+    def test_galera_dirty_reads(self, mysql_port):
+        from suites.galera.runner import WORKLOADS
+        run_wire_test(WORKLOADS["dirty-reads"]({}), "galera-dirty-reads",
+                      mysql_port)
+
+    def test_percona_bank(self, mysql_port):
+        from suites.percona.runner import WORKLOADS
+        run_wire_test(WORKLOADS["bank"]({}), "percona-bank", mysql_port)
+
+    def test_mysql_cluster_bank(self, mysql_port):
+        from suites.mysql_cluster.runner import WORKLOADS
+        run_wire_test(WORKLOADS["bank"]({}), "ndb-bank", mysql_port)
+
+
+class TestSuiteConstruction:
+    def test_all_tests_matrices(self):
+        from suites.galera.runner import all_tests as galera
+        from suites.mysql_cluster.runner import all_tests as ndb
+        from suites.percona.runner import all_tests as percona
+        from suites.tidb.runner import all_tests as tidb
+        for fn in (galera, ndb, percona, tidb):
+            tests = fn({"nodes": ["n1", "n2", "n3"]})
+            assert len(tests) >= 7
+            for t in tests:
+                assert t["client"] is not None
+                assert t["checker"] is not None
+
+    def test_tidb_faketime_flag_in_test_map(self):
+        from suites.tidb.runner import tidb_test
+        t = tidb_test({"nodes": ["n1"], "faketime": 1.05})
+        assert t["faketime"] == 1.05
