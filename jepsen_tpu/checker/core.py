@@ -288,8 +288,15 @@ class CounterChecker(Checker):
         lo = hi = 0          # envelope of possibly-applied sums
         applied = 0          # surely applied (ok) sum
         open_adds: Dict[int, int] = {}  # invoke index -> delta
+        open_reads: Dict[int, int] = {}  # invoke index -> lo at invoke
         errors = []
         for i, op in enumerate(history):
+            if op.f == "read" and op.type == INVOKE:
+                # an add completing OK *during* this read is concurrent
+                # with it, so it may legally be missed: the read's lower
+                # bound is the envelope at its invocation, the upper bound
+                # the envelope at its completion (checker.clj:737)
+                open_reads[i] = lo
             if op.f == "add":
                 d = op.value or 0
                 if op.type == INVOKE:
@@ -316,8 +323,9 @@ class CounterChecker(Checker):
                 # INFO: stays open forever (may or may not apply)
             elif op.f == "read" and op.type == OK:
                 v = op.value
-                if v is None or not (lo <= v <= hi):
-                    errors.append({**op.to_dict(), "bounds": [lo, hi]})
+                rd_lo = open_reads.pop(int(pairs[i]), lo)
+                if v is None or not (rd_lo <= v <= hi):
+                    errors.append({**op.to_dict(), "bounds": [rd_lo, hi]})
                 reads.append(v)
         return {"valid": not errors,
                 "reads": len(reads), "errors": errors,

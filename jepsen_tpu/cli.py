@@ -31,6 +31,9 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--ssh-port", type=int, default=22)
     p.add_argument("--dummy-ssh", action="store_true",
                    help="no-op control plane (in-process testing)")
+    p.add_argument("--dummy-ssh-record", action="store_true",
+                   help="record-only control plane: log commands, execute "
+                        "nothing (smoke-tests suite control logic)")
     p.add_argument("--concurrency", "-c", default="1n",
                    help="worker count; '3n' = 3x node count")
     p.add_argument("--time-limit", type=float, default=60.0,
@@ -59,7 +62,8 @@ def test_opts_to_map(args) -> Dict[str, Any]:
                 "password": args.password,
                 "private_key_path": args.ssh_private_key,
                 "port": args.ssh_port,
-                "dummy": args.dummy_ssh},
+                "dummy": "record" if getattr(args, "dummy_ssh_record", False)
+                else args.dummy_ssh},
         "concurrency": args.concurrency,
         "time_limit": args.time_limit,
         "leave_db_running": args.leave_db_running,

@@ -1,0 +1,281 @@
+"""Minimal Aerospike wire-protocol client (AS_MSG, protocol type 3).
+
+Parity: the reference drives Aerospike through the official Java client
+(aerospike/src/aerospike/support.clj:101-133 connect, 389-446 put!/append!/
+fetch/cas!/add!).  This is an independent implementation of the public
+Aerospike binary protocol: an 8-byte proto header (version 2, type 3)
+followed by a 22-byte message header, key fields (namespace / set /
+RIPEMD-160 digest), and bin operations.  CAS is expressed exactly the way
+the Java client's generation-write-policy does it
+(support.clj:359-365): a write with the GENERATION info bit and the
+expected generation in the header.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# RIPEMD-160 (pure Python fallback: OpenSSL 3 ships it only in the legacy
+# provider, so hashlib.new("ripemd160") can raise at runtime).
+# ---------------------------------------------------------------------------
+
+_KL = (0x00000000, 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xA953FD4E)
+_KR = (0x50A28BE6, 0x5C4DD124, 0x6D703EF3, 0x7A6D76E9, 0x00000000)
+
+_RL = (
+    0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+    7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+    3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+    1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+    4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13)
+_RR = (
+    5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+    6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+    15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+    8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+    12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11)
+_SL = (
+    11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+    7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+    11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+    11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+    9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6)
+_SR = (
+    8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+    9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+    9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+    15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+    8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11)
+
+_M32 = 0xFFFFFFFF
+
+
+def _rol(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _M32
+
+
+def _f(j: int, x: int, y: int, z: int) -> int:
+    if j < 16:
+        return x ^ y ^ z
+    if j < 32:
+        return (x & y) | (~x & z)
+    if j < 48:
+        return (x | ~y) ^ z
+    if j < 64:
+        return (x & z) | (y & ~z)
+    return x ^ (y | ~z)
+
+
+def _ripemd160_py(data: bytes) -> bytes:
+    h = [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0]
+    # MD-style padding, little-endian bit length
+    padded = data + b"\x80" + b"\x00" * ((55 - len(data)) % 64)
+    padded += struct.pack("<Q", 8 * len(data))
+    for off in range(0, len(padded), 64):
+        x = struct.unpack("<16I", padded[off:off + 64])
+        al, bl, cl, dl, el = h
+        ar, br, cr, dr, er = h
+        for j in range(80):
+            t = (_rol((al + _f(j, bl, cl, dl) + x[_RL[j]] + _KL[j // 16])
+                      & _M32, _SL[j]) + el) & _M32
+            al, el, dl, cl, bl = el, dl, _rol(cl, 10), bl, t
+            t = (_rol((ar + _f(79 - j, br, cr, dr) + x[_RR[j]]
+                       + _KR[j // 16]) & _M32, _SR[j]) + er) & _M32
+            ar, er, dr, cr, br = er, dr, _rol(cr, 10), br, t
+        h = [(h[1] + cl + dr) & _M32,
+             (h[2] + dl + er) & _M32,
+             (h[3] + el + ar) & _M32,
+             (h[4] + al + br) & _M32,
+             (h[0] + bl + cr) & _M32]
+    return struct.pack("<5I", *h)
+
+
+def ripemd160(data: bytes) -> bytes:
+    try:
+        return hashlib.new("ripemd160", data).digest()
+    except (ValueError, TypeError):
+        return _ripemd160_py(data)
+
+
+# ---------------------------------------------------------------------------
+# Protocol constants
+# ---------------------------------------------------------------------------
+
+PROTO_VERSION = 2
+MSG_TYPE = 3
+MSG_HEADER_SZ = 22
+
+FIELD_NAMESPACE = 0
+FIELD_SETNAME = 1
+FIELD_DIGEST = 4
+
+OP_READ = 1
+OP_WRITE = 2
+OP_INCR = 5
+OP_APPEND = 9
+
+PARTICLE_INTEGER = 1
+PARTICLE_STRING = 3
+
+INFO1_READ = 0x01
+INFO1_GET_ALL = 0x02
+INFO2_WRITE = 0x01
+INFO2_GENERATION = 0x04
+
+RESULT_OK = 0
+RESULT_NOT_FOUND = 2
+RESULT_GENERATION = 3
+
+
+class AerospikeError(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"aerospike result code {code}")
+        self.code = code
+
+
+def key_digest(set_name: str, key: Any) -> bytes:
+    """RIPEMD-160 over set + particle-type byte + key bytes — the digest
+    every official client computes for record addressing."""
+    if isinstance(key, int):
+        kt, kb = PARTICLE_INTEGER, struct.pack(">q", key)
+    else:
+        kt, kb = PARTICLE_STRING, str(key).encode()
+    return ripemd160(set_name.encode() + bytes([kt]) + kb)
+
+
+def _encode_value(v: Any) -> Tuple[int, bytes]:
+    if isinstance(v, bool):
+        raise TypeError("bool bins unsupported")
+    if isinstance(v, int):
+        return PARTICLE_INTEGER, struct.pack(">q", v)
+    return PARTICLE_STRING, str(v).encode()
+
+
+def _decode_value(ptype: int, data: bytes) -> Any:
+    if ptype == PARTICLE_INTEGER:
+        return struct.unpack(">q", data)[0]
+    if ptype == PARTICLE_STRING:
+        return data.decode()
+    return data
+
+
+def _field(ftype: int, data: bytes) -> bytes:
+    return struct.pack(">IB", len(data) + 1, ftype) + data
+
+
+def _op(op_type: int, name: str, value: Any = None) -> bytes:
+    nb = name.encode()
+    if value is None:
+        body = struct.pack(">BBBB", op_type, 0, 0, len(nb)) + nb
+    else:
+        ptype, vb = _encode_value(value)
+        body = struct.pack(">BBBB", op_type, ptype, 0, len(nb)) + nb + vb
+    return struct.pack(">I", len(body)) + body
+
+
+def build_message(info1: int, info2: int, fields: list, ops: list,
+                  generation: int = 0) -> bytes:
+    body = struct.pack(">BBBBBBIIIHH", MSG_HEADER_SZ, info1, info2, 0, 0, 0,
+                       generation, 0, 1000, len(fields), len(ops))
+    body += b"".join(fields) + b"".join(ops)
+    return struct.pack(">Q",
+                       (PROTO_VERSION << 56) | (MSG_TYPE << 48) | len(body)) \
+        + body
+
+
+def parse_message(body: bytes):
+    """→ (result_code, generation, bins) for a single-record response."""
+    (hsz, _i1, _i2, _i3, _u, code, gen, _ttl, _ttl2, n_fields,
+     n_ops) = struct.unpack(">BBBBBBIIIHH", body[:MSG_HEADER_SZ])
+    off = hsz
+    for _ in range(n_fields):
+        (sz,) = struct.unpack(">I", body[off:off + 4])
+        off += 4 + sz
+    bins: Dict[str, Any] = {}
+    for _ in range(n_ops):
+        (sz,) = struct.unpack(">I", body[off:off + 4])
+        _opt, ptype, _ver, nlen = struct.unpack(
+            ">BBBB", body[off + 4:off + 8])
+        name = body[off + 8:off + 8 + nlen].decode()
+        val = body[off + 8 + nlen:off + 4 + sz]
+        bins[name] = _decode_value(ptype, val)
+        off += 4 + sz
+    return code, gen, bins
+
+
+class AerospikeClient:
+    """One socket to one node; issues single-record transactions."""
+
+    def __init__(self, node: str, port: int = 3000,
+                 namespace: str = "jepsen", timeout: float = 5.0):
+        self.namespace = namespace
+        self.sock = socket.create_connection((node, port), timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("aerospike connection closed")
+            buf += chunk
+        return buf
+
+    def _call(self, info1: int, info2: int, set_name: str, key: Any,
+              ops: list, generation: int = 0):
+        fields = [_field(FIELD_NAMESPACE, self.namespace.encode()),
+                  _field(FIELD_SETNAME, set_name.encode()),
+                  _field(FIELD_DIGEST, key_digest(set_name, key))]
+        self.sock.sendall(build_message(info1, info2, fields, ops,
+                                        generation))
+        (header,) = struct.unpack(">Q", self._recv_exact(8))
+        body = self._recv_exact(header & 0xFFFFFFFFFFFF)
+        return parse_message(body)
+
+    # -- record operations (support.clj:389-446 equivalents) --------------
+
+    def put(self, set_name: str, key: Any, bins: Dict[str, Any],
+            generation: Optional[int] = None) -> None:
+        info2 = INFO2_WRITE
+        gen = 0
+        if generation is not None:
+            info2 |= INFO2_GENERATION
+            gen = generation
+        code, _, _ = self._call(
+            0, info2, set_name, key,
+            [_op(OP_WRITE, n, v) for n, v in bins.items()], gen)
+        if code != RESULT_OK:
+            raise AerospikeError(code)
+
+    def get(self, set_name: str, key: Any):
+        """→ (bins, generation) or None when the record doesn't exist."""
+        code, gen, bins = self._call(INFO1_READ | INFO1_GET_ALL, 0,
+                                     set_name, key, [])
+        if code == RESULT_NOT_FOUND:
+            return None
+        if code != RESULT_OK:
+            raise AerospikeError(code)
+        return bins, gen
+
+    def add(self, set_name: str, key: Any, bins: Dict[str, int]) -> None:
+        code, _, _ = self._call(
+            0, INFO2_WRITE, set_name, key,
+            [_op(OP_INCR, n, v) for n, v in bins.items()])
+        if code != RESULT_OK:
+            raise AerospikeError(code)
+
+    def append(self, set_name: str, key: Any, bins: Dict[str, str]) -> None:
+        code, _, _ = self._call(
+            0, INFO2_WRITE, set_name, key,
+            [_op(OP_APPEND, n, v) for n, v in bins.items()])
+        if code != RESULT_OK:
+            raise AerospikeError(code)

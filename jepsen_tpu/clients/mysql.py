@@ -19,6 +19,7 @@ from typing import Any, List, Optional, Tuple
 DEFAULT_PORT = 3306
 
 CLIENT_LONG_PASSWORD = 0x1
+CLIENT_FOUND_ROWS = 0x2  # affected_rows counts matched, not changed, rows
 CLIENT_PROTOCOL_41 = 0x200
 CLIENT_TRANSACTIONS = 0x2000
 CLIENT_SECURE_CONNECTION = 0x8000
@@ -55,9 +56,12 @@ class MysqlClient:
         if pkt[0] == 0xFF:
             raise _err(pkt)
         seed = self._parse_handshake(pkt)
-        caps = (CLIENT_LONG_PASSWORD | CLIENT_PROTOCOL_41 |
-                CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION |
-                CLIENT_PLUGIN_AUTH)
+        # FOUND_ROWS is load-bearing: UPDATE-then-INSERT upserts decide
+        # whether the row exists from affected_rows, which must count
+        # matched rows even when the value is unchanged
+        caps = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS |
+                CLIENT_PROTOCOL_41 | CLIENT_TRANSACTIONS |
+                CLIENT_SECURE_CONNECTION | CLIENT_PLUGIN_AUTH)
         if self.database:
             caps |= 0x8  # CLIENT_CONNECT_WITH_DB
         auth = _native_password(self.password, seed)

@@ -92,7 +92,10 @@ def remote_for(test: Dict[str, Any]) -> Remote:
     r = test.get("remote")
     if r is not None:
         return r
-    if test.get("ssh", {}).get("dummy"):
+    dummy = test.get("ssh", {}).get("dummy")
+    if dummy == "record":
+        return DummyRemote(record_only=True)
+    if dummy:
         return DummyRemote()
     return RetryRemote(SshRemote())
 

@@ -19,6 +19,7 @@ Parity map (SURVEY.md §5.8):
 from __future__ import annotations
 
 import os
+import re
 import subprocess
 import tempfile
 import time
@@ -50,13 +51,17 @@ class DummyRemote(Remote):
     (``record_only=True``) — both modes unlock full-pipeline tests with no
     cluster, like the reference's dummy session."""
 
-    def __init__(self, record_only: bool = False):
+    def __init__(self, record_only: bool = False,
+                 responses: Optional[Dict[str, str]] = None):
+        # responses: regex -> canned stdout for record-only runs whose DB
+        # setup parses command output (roster waits, version probes, …)
         self.record_only = record_only
+        self.responses = responses or {}
         self.log: List[str] = []
         self.host: Optional[str] = None
 
     def connect(self, conn_spec):
-        r = DummyRemote(self.record_only)
+        r = DummyRemote(self.record_only, self.responses)
         r.log = self.log  # shared command journal across nodes
         r.host = conn_spec.get("host")
         return r
@@ -65,7 +70,12 @@ class DummyRemote(Remote):
         full = wrap_context(dict(ctx, sudo=None), cmd)  # no sudo locally
         self.log.append(f"{self.host}: {full}")
         if self.record_only:
-            return CmdResult(cmd=full, exit=0, out="", err="")
+            out = ""
+            for pattern, canned in self.responses.items():
+                if re.search(pattern, full):
+                    out = canned
+                    break
+            return CmdResult(cmd=full, exit=0, out=out, err="")
         return _run(["bash", "-c", full], stdin=stdin)
 
     def upload(self, ctx, local_paths, remote_path):

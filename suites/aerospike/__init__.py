@@ -1,0 +1,2 @@
+"""Aerospike suite (reference: aerospike/ — CAS register, counter, set,
+and pause workloads over the strong-consistency namespace)."""

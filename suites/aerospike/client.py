@@ -1,0 +1,149 @@
+"""Aerospike workload clients.
+
+Parity: aerospike/src/aerospike/cas_register.clj:43-76 (read/write/cas on
+one bin, CAS via fetch + generation-checked write), counter.clj:43-60
+(read/add via the incr op), set.clj:11-41 (string-append a " v" suffix,
+read splits on spaces).  Error taxonomy follows support.clj's with-errors:
+reads fail definitely, mutations are indeterminate on timeout/connection
+errors.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients import aerospike as aswire
+from jepsen_tpu.clients.aerospike import AerospikeClient, AerospikeError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+PORT = 3000
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+
+def connect(test, node) -> AerospikeClient:
+    return AerospikeClient(node, port=int(test.get("db_port", PORT)),
+                           namespace="jepsen", timeout=5.0)
+
+
+class CasRegisterClient(jclient.Client):
+    """Per-key CAS register on set "cats", bin "value"."""
+
+    SET = "cats"
+
+    def __init__(self, conn: Optional[AerospikeClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CasRegisterClient(connect(test, node))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                rec = self.conn.get(self.SET, k)
+                val = rec[0].get("value") if rec else None
+                return op.with_(type=OK, value=(k, val))
+            if op.f == "write":
+                self.conn.put(self.SET, k, {"value": v})
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                rec = self.conn.get(self.SET, k)
+                if rec is None or rec[0].get("value") != old:
+                    return op.with_(type=FAIL, error="precondition")
+                try:
+                    self.conn.put(self.SET, k, {"value": new},
+                                  generation=rec[1])
+                except AerospikeError as e:
+                    if e.code == aswire.RESULT_GENERATION:
+                        return op.with_(type=FAIL, error="generation")
+                    raise
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except AerospikeError as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+
+class CounterClient(jclient.Client):
+    """Counter on set "counters", key "pounce" (counter.clj:43-66)."""
+
+    SET = "counters"
+    KEY = "pounce"
+
+    def __init__(self, conn: Optional[AerospikeClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CounterClient(connect(test, node))
+
+    def setup(self, test):
+        try:
+            self.conn.put(self.SET, self.KEY, {"value": 0})
+        except (AerospikeError, *NET_ERRORS):
+            pass
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rec = self.conn.get(self.SET, self.KEY)
+                return op.with_(type=OK,
+                                value=rec[0].get("value") if rec else 0)
+            if op.f == "add":
+                self.conn.add(self.SET, self.KEY, {"value": op.value})
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (AerospikeError, *NET_ERRORS) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+
+class SetClient(jclient.Client):
+    """Per-key grow-only set: append " v" to a string bin; reads split on
+    whitespace (set.clj:18-36)."""
+
+    SET = "cats"
+
+    def __init__(self, conn: Optional[AerospikeClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SetClient(connect(test, node))
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                rec = self.conn.get(self.SET, k)
+                raw = rec[0].get("value", "") if rec else ""
+                vals = sorted(int(x) for x in str(raw).split() if x)
+                return op.with_(type=OK, value=(k, vals))
+            if op.f == "add":
+                self.conn.append(self.SET, k, {"value": f" {v}"})
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (AerospikeError, *NET_ERRORS) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

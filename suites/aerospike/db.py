@@ -1,0 +1,126 @@
+"""Aerospike server install/config/roster management.
+
+Parity: aerospike/src/aerospike/support.clj — install! (211-255, dpkg of
+server+tools packages), configure! (257-277, templated aerospike.conf with
+heartbeat interval and a strong-consistency namespace), start!/stop!/wipe!
+(279-321), roster management for the SC namespace (154-209:
+roster-set + recluster until all nodes are active), and the asinfo
+revive/recluster admin commands (136-152).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+NAMESPACE = "jepsen"
+PACKAGE_DIR = "/tmp/packages"
+CONF = "/etc/aerospike/aerospike.conf"
+LOGFILE = "/var/log/aerospike.log"
+PORT = 3000
+
+CONF_TEMPLATE = """\
+service {{
+  user root
+  group root
+  pidfile /var/run/aerospike/asd.pid
+  proto-fd-max 15000
+}}
+logging {{
+  file {logfile} {{ context any info }}
+}}
+network {{
+  service {{ address any
+             port {port} }}
+  heartbeat {{ mode mesh
+               port 3002
+{mesh_seeds}
+               interval {heartbeat_interval}
+               timeout 10 }}
+  fabric {{ port 3001 }}
+  info {{ port 3003 }}
+}}
+namespace {namespace} {{
+  replication-factor {replication_factor}
+  default-ttl 0
+  strong-consistency true
+  storage-engine memory {{ data-size 1G }}
+}}
+"""
+
+
+def config(test, node) -> str:
+    seeds = "\n".join(f"               mesh-seed-address-port {n} 3002"
+                      for n in test["nodes"])
+    return CONF_TEMPLATE.format(
+        logfile=LOGFILE, port=PORT, namespace=NAMESPACE,
+        mesh_seeds=seeds,
+        heartbeat_interval=int(test.get("heartbeat_interval", 150)),
+        replication_factor=int(test.get("replication_factor", 3)))
+
+
+def revive(s) -> None:
+    """asinfo -v revive:namespace=… (support.clj:142-147)."""
+    s.exec("asinfo", "-v", f"revive:namespace={NAMESPACE}")
+
+
+def recluster(s) -> None:
+    """asinfo -v recluster: (support.clj:149-152)."""
+    s.exec("asinfo", "-v", "recluster:")
+
+
+class AerospikeDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        if not cu.exists(s, "/usr/bin/asd"):
+            # packages staged on the control node are uploaded then dpkg'd
+            # (support.clj:228-255); --force-confnew keeps our conf
+            s.exec("sh", "-c",
+                   f"dpkg -i --force-confnew {PACKAGE_DIR}/*.deb")
+        cu.write_file(s, config(test, node), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=120)
+        if node == test["nodes"][0]:
+            self._set_roster(s, test)
+
+    def _set_roster(self, s, test) -> None:
+        """Set the SC roster to the observed node list and recluster
+        (support.clj:163-209)."""
+        for _ in range(30):
+            out = s.exec("asinfo", "-v",
+                         f"roster:namespace={NAMESPACE}").strip()
+            observed = ""
+            for part in out.split(":"):
+                if part.startswith("observed_nodes="):
+                    observed = part.split("=", 1)[1]
+            if observed and len(observed.split(",")) == len(test["nodes"]):
+                s.exec("asinfo", "-v",
+                       f"roster-set:namespace={NAMESPACE};nodes={observed}")
+                recluster(s)
+                return
+            time.sleep(1)
+        raise RuntimeError("roster never observed all nodes")
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "asd")
+        s.exec("sh", "-c", f"rm -rf {LOGFILE} /opt/aerospike/data || true")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec("service", "aerospike", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "asd")
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "asd", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "asd", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

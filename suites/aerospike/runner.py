@@ -1,0 +1,233 @@
+"""Aerospike suite CLI: workload registry + the "full" havoc nemesis.
+
+Parity: aerospike/src/aerospike/core.clj:17-78 (workload table
+cas-register/counter/set/pause, workload+nemesis wiring) and nemesis.clj:
+kill-nemesis with a max-dead-nodes cap (17-57), randomized
+kill/restart/revive/recluster schedule (59-101), full-nemesis composing
+kills + random-halves partitions + clock faults (103-121), and the
+heal-everything final generator (130-145).  The pause workload
+(pause.clj:173-233) couples a set workload with a pause/resume nemesis in
+process, net, or clock mode.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import control
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, nemesis as jnem
+from jepsen_tpu.checker.core import CounterChecker, SetChecker
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.nemesis.partition import partition_random_halves
+from jepsen_tpu.nemesis.time import ClockNemesis, clock_gen
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.aerospike import db as asdb
+from suites.aerospike.client import CasRegisterClient, CounterClient, SetClient
+from suites.aerospike.db import AerospikeDB
+
+
+def _nonempty_subset(nodes):
+    return random.sample(nodes, random.randint(1, len(nodes)))
+
+
+class KillNemesis(jnem.Nemesis):
+    """Kill/restart with at most ``max_dead`` simultaneously-dead nodes,
+    plus revive/recluster admin ops (nemesis.clj:17-57)."""
+
+    def __init__(self, signal: str = "KILL", max_dead: int = 2):
+        self.signal = signal
+        self.max_dead = max_dead
+        self.dead: set = set()
+
+    def invoke(self, test, op):
+        nodes = op.value or test["nodes"]
+        results = {}
+        for node in nodes:
+            s = control.session(test, node).sudo()
+            if op.f == "kill":
+                if len(self.dead | {node}) > self.max_dead:
+                    results[node] = "still-alive"
+                    continue
+                self.dead.add(node)
+                cu.grepkill(s, "asd", signal=self.signal)
+                results[node] = "killed"
+            elif op.f == "restart":
+                s.exec("service", "aerospike", "restart")
+                self.dead.discard(node)
+                results[node] = "started"
+            elif op.f == "revive":
+                try:
+                    asdb.revive(s)
+                    results[node] = "revived"
+                except Exception as e:  # noqa: BLE001 — node may be down
+                    results[node] = f"not-running: {e}"
+            elif op.f == "recluster":
+                try:
+                    asdb.recluster(s)
+                    results[node] = "reclustered"
+                except Exception as e:  # noqa: BLE001
+                    results[node] = f"not-running: {e}"
+            else:
+                raise ValueError(op.f)
+        return op.with_(type="info", value=results)
+
+    def fs(self):
+        return ["kill", "restart", "revive", "recluster"]
+
+
+class KillerGen(gen.Generator):
+    """Generator form of killer_gen — needs the test map for node lists."""
+
+    def __init__(self, queue=()):
+        self.queue = list(queue)
+
+    def op(self, test, ctx):
+        queue = self.queue
+        if not queue:
+            queue = random.choice(
+                [[("kill", True)], [("restart", True)],
+                 [("revive", False), ("recluster", False)]])[:]
+        (f, subset), rest = queue[0], queue[1:]
+        nodes = list(test["nodes"])
+        value = _nonempty_subset(nodes) if subset else nodes
+        op = gen.fill_op({"type": "info", "f": f, "value": value}, ctx)
+        if op is gen.PENDING:
+            return (gen.PENDING, self)
+        return (op, KillerGen(rest))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def full_package(opts: Dict[str, Any]) -> combined.Package:
+    """Compose kills + partitions + clock (nemesis.clj:103-145)."""
+    max_dead = int(opts.get("max_dead_nodes", 2))
+    signal = "TERM" if opts.get("clean_kill") else "KILL"
+    killer = KillNemesis(signal=signal, max_dead=max_dead)
+    part = jnem.f_map({"partition-start": "start", "partition-stop": "stop"},
+                      partition_random_halves())
+    members = [killer, part, ClockNemesis()]
+    nem = jnem.Compose(members, [set(killer.fs()),
+                                 {"partition-start", "partition-stop"},
+                                 set(ClockNemesis().fs())])
+
+    parts = []
+    if not opts.get("no_clocks"):
+        parts.append(clock_gen())
+    if not opts.get("no_kills"):
+        parts.append(KillerGen())
+    if not opts.get("no_partitions"):
+        parts.append(gen.cycle(gen.lift(
+            [{"type": "info", "f": "partition-start"},
+             {"type": "info", "f": "partition-stop"}])))
+    interval = float(opts.get("interval", 5.0))
+    g = gen.stagger(interval, gen.mix(parts)) if parts else None
+
+    def restart_all(test, ctx):
+        return {"type": "info", "f": "restart", "value": list(test["nodes"])}
+
+    final = [{"type": "info", "f": "partition-stop"},
+             {"type": "info", "f": "reset-clock"},
+             # bare fns repeat forever; final phases need exactly one
+             gen.once(restart_all),
+             {"type": "info", "f": "revive"},
+             {"type": "info", "f": "recluster"}]
+    return combined.Package(nemesis=nem, generator=g, final_generator=final)
+
+
+NEMESES = dict(common.STANDARD_NEMESES)
+NEMESES["full"] = full_package
+
+
+def cas_register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 150)),
+        threads_per_key=2)
+    return {**wl, "client": CasRegisterClient()}
+
+
+def counter_workload(opts) -> Dict[str, Any]:
+    """100:1 add/read mix (counter.clj:68-76)."""
+    g = gen.mix([gen.repeat({"f": "add", "value": 1}),
+                 gen.stagger(0.1, gen.repeat({"f": "read"}))])
+    return {"client": CounterClient(), "generator": g,
+            "checker": CounterChecker()}
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    """Per-key append-based sets with final reads (set.clj:47-72)."""
+    keys = list(range(int(opts.get("keys", 4))))
+
+    def adds(k):
+        counter = iter(range(10_000))
+        return gen.FnGen(lambda: {"f": "add", "value": next(counter)})
+
+    return {
+        "client": SetClient(),
+        "generator": independent.concurrent_generator(
+            int(opts.get("threads_per_key", 2)), keys, adds),
+        "final_generator": independent.sequential_generator(
+            keys, lambda k: gen.once({"f": "read"})),
+        "checker": independent.checker(SetChecker()),
+    }
+
+
+def pause_workload(opts) -> Dict[str, Any]:
+    """Set workload under a targeted pause/resume nemesis
+    (pause.clj:173-233); mode selects process SIGSTOP, net slowdown, or
+    clock bump."""
+    return set_workload(opts)
+
+
+def pause_package(opts: Dict[str, Any]) -> combined.Package:
+    mode = opts.get("pause_mode", "process")
+    if mode == "net":
+        return combined.packet_package(opts)
+    if mode == "clock":
+        return combined.clock_package(opts)
+    return combined.db_package({**opts, "faults": ["pause"]})
+
+
+WORKLOADS = {
+    "cas-register": cas_register_workload,
+    "counter": counter_workload,
+    "set": set_workload,
+    "pause": pause_workload,
+}
+
+
+def aerospike_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    nemeses = dict(NEMESES)
+    if opts.get("workload") == "pause":
+        # coupled workload+nemesis special case (core.clj:33-40)
+        opts = {**opts, "nemesis": "pause"}
+        nemeses["pause"] = pause_package
+    return common.build_test(opts, suite="aerospike", db=AerospikeDB(),
+                             workloads=WORKLOADS, nemeses=nemeses)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, aerospike_test, WORKLOADS, NEMESES)
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=150)
+    parser.add_argument("--replication-factor", type=int, default=3)
+    parser.add_argument("--max-dead-nodes", type=int, default=2)
+    parser.add_argument("--clean-kill", action="store_true")
+    parser.add_argument("--pause-mode", default="process",
+                        choices=["process", "net", "clock"])
+    parser.add_argument("--heartbeat-interval", type=int, default=150)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(aerospike_test, WORKLOADS, NEMESES,
+                         prog="jepsen-tpu-aerospike", extra_opts=_extra))

@@ -37,7 +37,9 @@ def build_test(opts: Dict[str, Any], *, suite: str, db,
     """Construct a full test map from a suite's registries + CLI opts."""
     nemeses = nemeses or STANDARD_NEMESES
     workload_name = opts.get("workload") or sorted(workloads)[0]
-    nemesis_name = opts.get("nemesis", "partition")
+    default_nemesis = "partition" if "partition" in nemeses \
+        else sorted(nemeses)[0]
+    nemesis_name = opts.get("nemesis") or default_nemesis
     wl = workloads[workload_name](opts)
     pkg = nemeses[nemesis_name](
         {"interval": float(opts.get("nemesis_interval", 10.0))})
@@ -88,9 +90,14 @@ def suite_opts(workloads, nemeses=None, default_workload=None,
         parser.add_argument(
             "--workload", choices=sorted(workloads),
             default=default_workload or sorted(workloads)[0])
-        parser.add_argument("--nemesis", choices=sorted(nemeses),
-                            default="partition")
+        parser.add_argument(
+            "--nemesis", choices=sorted(nemeses),
+            default="partition" if "partition" in nemeses
+            else sorted(nemeses)[0])
         parser.add_argument("--nemesis-interval", type=float, default=10.0)
+        parser.add_argument("--db-port", type=int, default=None,
+                            help="override the client port (clients read "
+                                 "test['db_port'])")
         if extra:
             extra(parser)
 
@@ -98,8 +105,10 @@ def suite_opts(workloads, nemeses=None, default_workload=None,
 
 
 def main(test_fn: Callable, workloads, nemeses=None, prog: str = "jepsen-tpu",
-         extra_opts: Optional[Callable] = None) -> int:
+         extra_opts: Optional[Callable] = None,
+         default_workload: Optional[str] = None) -> int:
     return cli.single_test_cmd(
         test_fn,
-        opt_fn=suite_opts(workloads, nemeses, extra=extra_opts),
+        opt_fn=suite_opts(workloads, nemeses, default_workload,
+                          extra=extra_opts),
         prog=prog)

@@ -59,7 +59,9 @@ class GaleraDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
         s = session(test, node).sudo()
         s.exec("bash", "-c", "service mysql stop || true")
         cu.grepkill(s, "mariadbd|mysqld")
-        s.exec("bash", "-c", f"rm -rf {DATADIR}/grastate.dat {LOGFILE}")
+        # drop workload state too, or the next run's tables start dirty
+        s.exec("bash", "-c",
+               f"rm -rf {DATADIR}/grastate.dat {DATADIR}/jepsen {LOGFILE}")
 
     # -- Kill capability ---------------------------------------------------
     def start(self, test, node):

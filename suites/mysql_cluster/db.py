@@ -30,11 +30,21 @@ def mgm_node(test) -> str:
     return test["nodes"][0]
 
 
+def data_nodes(test) -> List[str]:
+    """ndbd runs on every node but the management node; a single-node test
+    colocates one data node with the mgm daemon."""
+    return test["nodes"][1:] or test["nodes"][:1]
+
+
 def config_ini(test) -> str:
-    lines = ["[ndbd default]", "NoOfReplicas=2", "DataMemory=256M", "",
+    dn = data_nodes(test)
+    # NDB requires the data-node count to be a multiple of NoOfReplicas
+    replicas = 2 if len(dn) % 2 == 0 else 1
+    lines = ["[ndbd default]", f"NoOfReplicas={replicas}",
+             "DataMemory=256M", "",
              "[ndb_mgmd]", f"HostName={mgm_node(test)}",
              f"DataDir={DATA}/mgmd", ""]
-    for n in test["nodes"]:
+    for n in dn:
         lines += ["[ndbd]", f"HostName={n}", f"DataDir={DATA}/ndbd", ""]
     for n in test["nodes"]:
         lines += ["[mysqld]", f"HostName={n}", ""]
@@ -60,11 +70,6 @@ class MysqlClusterDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
                f"{DATA}/mysqld")
         cu.write_file(s, config_ini(test), f"{DIR}/config.ini")
         cu.write_file(s, my_cnf(test), f"{DIR}/my.cnf")
-        if node == mgm_node(test):
-            s.exec("bash", "-c",
-                   f"[ -d {DATA}/mysqld/mysql ] || "
-                   f"{DIR}/bin/mysqld --defaults-file={DIR}/my.cnf "
-                   f"--initialize-insecure")
         self.start(test, node)
         cu.await_tcp_port(s, SQL_PORT, timeout_s=300)
 
@@ -84,9 +89,10 @@ class MysqlClusterDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
                             "--configdir", f"{DATA}/mgmd",
                             pidfile=MGMD_PID, logfile=MGMD_LOG)
             cu.await_tcp_port(s, MGM_PORT, timeout_s=60)
-        cu.start_daemon(s, f"{DIR}/bin/ndbd", "--nodaemon",
-                        "-c", mgm_node(test),
-                        pidfile=NDBD_PID, logfile=NDBD_LOG)
+        if node in data_nodes(test):
+            cu.start_daemon(s, f"{DIR}/bin/ndbd", "--nodaemon",
+                            "-c", mgm_node(test),
+                            pidfile=NDBD_PID, logfile=NDBD_LOG)
         s.exec("bash", "-c",
                f"[ -d {DATA}/mysqld/mysql ] || "
                f"{DIR}/bin/mysqld --defaults-file={DIR}/my.cnf "

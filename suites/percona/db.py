@@ -56,7 +56,8 @@ class PerconaDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
         s = session(test, node).sudo()
         s.exec("bash", "-c", "service mysql stop || true")
         cu.grepkill(s, "mysqld")
-        s.exec("bash", "-c", f"rm -f {LOGFILE}")
+        # drop workload state too, or the next run's tables start dirty
+        s.exec("bash", "-c", f"rm -rf /var/lib/mysql/jepsen {LOGFILE}")
 
     # -- Kill capability ---------------------------------------------------
     def start(self, test, node):

@@ -19,11 +19,12 @@ from __future__ import annotations
 
 import itertools
 import random
+from collections import Counter
 from typing import Any, Dict, List
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker, UNKNOWN
-from jepsen_tpu.history import History, OK, Op
+from jepsen_tpu.history import History, INFO, OK, Op
 from jepsen_tpu.workloads import sets
 
 from suites.sqlkit import _SqlClient
@@ -81,12 +82,20 @@ class MonotonicChecker(Checker):
     def check(self, test, history: History, opts=None):
         adds: List[Op] = [op for op in history
                           if op.f == "add" and op.type == OK]
+        # indeterminate adds may have committed: their would-be values
+        # can't be recovered, so any value is excusable as a gap filler
+        indeterminate = sum(1 for op in history
+                            if op.f == "add" and op.type == INFO)
         vals = [op.value for op in adds if op.value is not None]
-        dupes = sorted({v for v in vals if vals.count(v) > 1})
+        counts = Counter(vals)
+        dupes = sorted(v for v, c in counts.items() if c > 1)
         gaps = []
         if vals:
             expect = set(range(min(vals), max(vals) + 1))
             gaps = sorted(expect - set(vals))
+            # each indeterminate add excuses one hole (interpreter
+            # crash->info semantics: the op may have been applied)
+            gaps = gaps[indeterminate:] if indeterminate else gaps
         # per-process monotonicity in completion order
         reorders = []
         by_proc: Dict[int, int] = {}
@@ -158,8 +167,12 @@ class SequentialClient(_SqlClient):
                     try:
                         self.conn.query(f"INSERT INTO seq{i} VALUES ({k})")
                     except Exception as e:  # noqa: BLE001
-                        if not getattr(e, "retryable", False) and \
-                                "duplicate" not in str(e).lower():
+                        # a duplicate means this row is already present
+                        # (sequential.clj tolerates re-inserts); anything
+                        # else — including definitely-not-applied retryable
+                        # conflicts — must abort the chain, or we'd leave a
+                        # hole the checker reads as a violation
+                        if "duplicate" not in str(e).lower():
                             raise
                 return op.with_(type=OK)
             # read in reverse write order

@@ -75,7 +75,8 @@ class BankClient(_SqlClient):
     def setup(self, test):
         wl = test.get("bank", {})
         accounts = wl.get("accounts", list(range(8)))
-        total = wl.get("total_amount", 80)
+        # default must agree with bank.workload's checker total (100)
+        total = wl.get("total_amount", 100)
         per = total // len(accounts)
         self.conn.query("CREATE TABLE IF NOT EXISTS accounts "
                         "(id INT PRIMARY KEY, balance INT)")

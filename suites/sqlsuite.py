@@ -87,7 +87,8 @@ def make_suite(suite: str, db, conn_factory: Callable, os=None,
     def main() -> int:
         return common.main(test_fn, workloads, nemeses,
                            prog=f"jepsen-tpu-{suite}",
-                           extra_opts=_sql_opts)
+                           extra_opts=_sql_opts,
+                           default_workload=default_workload)
 
     return workloads, test_fn, all_tests, main
 

@@ -47,7 +47,8 @@ def main() -> int:
             help="skew server clock rates up to this ratio via libfaketime")
 
     return common.main(tidb_test, WORKLOADS, prog="jepsen-tpu-tidb",
-                       extra_opts=extra_opts)
+                       extra_opts=extra_opts,
+                       default_workload="register")
 
 
 if __name__ == "__main__":

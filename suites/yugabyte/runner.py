@@ -34,7 +34,7 @@ def conn(node, test):
 def _role_package(opts, role: str) -> combined.Package:
     """Kill-and-restart one process role on a random node
     (yugabyte/nemesis.clj's kill-master / kill-tserver packages)."""
-    db: YugabyteDB = opts.get("_db") or YugabyteDB()
+    db = YugabyteDB()
     stop = getattr(db, f"stop_{role}")
     start = getattr(db, f"start_{role}")
 

@@ -739,3 +739,82 @@ class MiniSqlState:
             return ([(k,)] if k in self.seq.get(t, set()) else []), 0, None
         return [], 0, {"S": "ERROR", "C": "42601",
                        "M": f"unparsed: {q[:60]}", "errno": "1064"}
+
+
+# --------------------------------------------------------------------------
+# Aerospike (AS_MSG protocol type 3) — serves jepsen_tpu.clients.aerospike
+# --------------------------------------------------------------------------
+
+class AerospikeState:
+    """Records keyed by (set, digest): {"bins": {...}, "gen": int}."""
+
+    def __init__(self):
+        self.records: Dict[Tuple[str, bytes], Dict[str, Any]] = {}
+        self.lock = threading.Lock()
+
+
+class FakeAerospikeHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from jepsen_tpu.clients import aerospike as asp
+        st: AerospikeState = self.server.state
+        while True:
+            try:
+                (hdr,) = struct.unpack(">Q", _recv_exact(self.request, 8))
+                body = _recv_exact(self.request, hdr & 0xFFFFFFFFFFFF)
+            except (ConnectionError, OSError):
+                return
+            (hsz, info1, info2, _i3, _u, _rc, gen, _ttl, _txn, n_fields,
+             n_ops) = struct.unpack(">BBBBBBIIIHH", body[:asp.MSG_HEADER_SZ])
+            off = hsz
+            fields = {}
+            for _ in range(n_fields):
+                (sz,) = struct.unpack(">I", body[off:off + 4])
+                fields[body[off + 4]] = body[off + 5:off + 4 + sz]
+                off += 4 + sz
+            ops = []
+            for _ in range(n_ops):
+                (sz,) = struct.unpack(">I", body[off:off + 4])
+                opt, ptype, _ver, nlen = struct.unpack(
+                    ">BBBB", body[off + 4:off + 8])
+                name = body[off + 8:off + 8 + nlen].decode()
+                val = body[off + 8 + nlen:off + 4 + sz]
+                ops.append((opt, ptype, name, val))
+                off += 4 + sz
+            key = (fields.get(asp.FIELD_SETNAME, b"").decode(),
+                   fields.get(asp.FIELD_DIGEST, b""))
+            with st.lock:
+                code, rgen, bins = self._apply(st, asp, key, info1, info2,
+                                               gen, ops)
+            out_ops = [asp._op(asp.OP_READ, n, v) for n, v in bins.items()]
+            resp = struct.pack(">BBBBBBIIIHH", asp.MSG_HEADER_SZ, 0, 0, 0,
+                               0, code, rgen, 0, 0, 0, len(out_ops))
+            resp += b"".join(out_ops)
+            self.request.sendall(struct.pack(
+                ">Q", (asp.PROTO_VERSION << 56) | (asp.MSG_TYPE << 48)
+                | len(resp)) + resp)
+
+    def _apply(self, st, asp, key, info1, info2, gen, ops):
+        rec = st.records.get(key)
+        if info1 & asp.INFO1_READ:
+            if rec is None:
+                return asp.RESULT_NOT_FOUND, 0, {}
+            return asp.RESULT_OK, rec["gen"], dict(rec["bins"])
+        if info2 & asp.INFO2_WRITE:
+            if info2 & asp.INFO2_GENERATION:
+                if rec is None or rec["gen"] != gen:
+                    return asp.RESULT_GENERATION, 0, {}
+            if rec is None:
+                rec = st.records[key] = {"bins": {}, "gen": 0}
+            for opt, ptype, name, val in ops:
+                decoded = asp._decode_value(ptype, val)
+                if opt == asp.OP_WRITE:
+                    rec["bins"][name] = decoded
+                elif opt == asp.OP_INCR:
+                    rec["bins"][name] = rec["bins"].get(name, 0) + decoded
+                elif opt == asp.OP_APPEND:
+                    rec["bins"][name] = rec["bins"].get(name, "") + decoded
+                else:
+                    return 4, 0, {}  # parameter error
+            rec["gen"] += 1
+            return asp.RESULT_OK, rec["gen"], {}
+        return 4, 0, {}

@@ -1,0 +1,143 @@
+"""Key-value / consensus suites (aerospike, logcabin, rethinkdb, ignite):
+wire smoke tests against protocol fakes + construction/control tests.
+
+Pattern: the reference's dummy-remote full-pipeline tests (SURVEY.md §4) —
+real generator -> interpreter -> real wire client -> in-process fake
+server -> history -> workload checker.
+"""
+
+import struct
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen
+from jepsen_tpu.checker import Stats, compose
+
+from tests.fakes import AerospikeState, FakeAerospikeHandler, start_server
+
+
+@pytest.fixture()
+def as_port():
+    srv, port = start_server(FakeAerospikeHandler, AerospikeState())
+    yield port
+    srv.shutdown()
+
+
+def run_wire_test(wl, name, port, time_limit=2.5, concurrency=4, **extra):
+    parts = [gen.time_limit(time_limit, gen.clients(wl["generator"]))]
+    if wl.get("final_generator") is not None:
+        parts.append(gen.synchronize(
+            gen.clients(gen.lift(wl["final_generator"]))))
+    test = {"name": name, "nodes": ["127.0.0.1"], "db_port": port,
+            "remote": control.DummyRemote(record_only=True),
+            "concurrency": concurrency,
+            "client": wl["client"],
+            "generator": parts,
+            "checker": compose({"stats": Stats(),
+                                "workload": wl["checker"]}),
+            **extra}
+    done = core.run(test)
+    assert done["results"]["workload"]["valid"] is True, done["results"]
+    return done
+
+
+class TestAerospikeWire:
+    def test_protocol_roundtrip(self, as_port):
+        from jepsen_tpu.clients.aerospike import AerospikeClient
+        c = AerospikeClient("127.0.0.1", as_port)
+        assert c.get("cats", 1) is None
+        c.put("cats", 1, {"value": 3})
+        bins, g1 = c.get("cats", 1)
+        assert bins == {"value": 3}
+        c.put("cats", 1, {"value": 4}, generation=g1)
+        bins, g2 = c.get("cats", 1)
+        assert bins == {"value": 4} and g2 == g1 + 1
+        # stale generation -> CAS failure
+        from jepsen_tpu.clients.aerospike import (AerospikeError,
+                                                  RESULT_GENERATION)
+        with pytest.raises(AerospikeError) as ei:
+            c.put("cats", 1, {"value": 9}, generation=g1)
+        assert ei.value.code == RESULT_GENERATION
+        c.add("counters", "pounce", {"value": 5})
+        c.add("counters", "pounce", {"value": -2})
+        assert c.get("counters", "pounce")[0] == {"value": 3}
+        c.append("cats", "s", {"value": " 1"})
+        c.append("cats", "s", {"value": " 2"})
+        assert c.get("cats", "s")[0] == {"value": " 1 2"}
+        c.close()
+
+    def test_register_workload_valid(self, as_port):
+        from suites.aerospike.runner import cas_register_workload
+        wl = cas_register_workload({"keys": 2, "ops_per_key": 40,
+                                    "algorithm": "cpu"})
+        run_wire_test(wl, "aerospike-register", as_port)
+
+    def test_counter_workload_valid(self, as_port):
+        from suites.aerospike.runner import counter_workload
+        run_wire_test(counter_workload({}), "aerospike-counter", as_port,
+                      time_limit=1.5)
+
+    def test_set_workload_valid(self, as_port):
+        from suites.aerospike.runner import set_workload
+        run_wire_test(set_workload({"keys": 2}), "aerospike-set", as_port,
+                      time_limit=1.5)
+
+
+class TestAerospikeSuite:
+    def test_construction_and_sweep(self):
+        from suites.aerospike import runner
+        t = runner.aerospike_test({"nodes": ["n1", "n2", "n3"],
+                                   "workload": "cas-register",
+                                   "nemesis": "full"})
+        assert t["name"] == "aerospike-cas-register-full"
+        ts = runner.all_tests({"nodes": ["n1"], "workloads": ["counter"],
+                               "nemeses": ["none", "full"]})
+        assert [x["name"] for x in ts] == ["aerospike-counter-none",
+                                           "aerospike-counter-full"]
+
+    def test_pause_workload_couples_nemesis(self):
+        from suites.aerospike import runner
+        t = runner.aerospike_test({"nodes": ["n1"], "workload": "pause"})
+        assert t["name"] == "aerospike-pause-pause"
+
+    def test_db_control_commands(self):
+        from suites.aerospike.db import AerospikeDB
+        t = {"nodes": ["n1", "n2", "n3"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        db = AerospikeDB()
+        db.start(t, "n1")
+        db.kill(t, "n1")
+        db.pause(t, "n2")
+        db.resume(t, "n2")
+        db.teardown(t, "n3")
+        log = "\n".join(t["remote"].log)
+        assert "service aerospike start" in log
+        assert "pkill -KILL -f asd" in log
+        assert "killall -STOP asd" in log
+        assert "killall -CONT asd" in log
+        control.teardown_sessions(t)
+
+    def test_config_renders_all_mesh_seeds(self):
+        from suites.aerospike.db import config
+        c = config({"nodes": ["n1", "n2"]}, "n1")
+        assert "mesh-seed-address-port n1 3002" in c
+        assert "mesh-seed-address-port n2 3002" in c
+        assert "strong-consistency true" in c
+
+    def test_kill_nemesis_caps_dead_nodes(self):
+        from jepsen_tpu.history import Op
+        from suites.aerospike.runner import KillNemesis
+        t = {"nodes": ["n1", "n2", "n3"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        nem = KillNemesis(max_dead=2).setup(t)
+        op = Op(type="info", f="kill", process="nemesis",
+                value=["n1", "n2", "n3"])
+        res = nem.invoke(t, op)
+        assert sorted(v for v in res.value.values()) == \
+            ["killed", "killed", "still-alive"]
+        res2 = nem.invoke(t, Op(type="info", f="restart", process="nemesis",
+                                value=["n1", "n2", "n3"]))
+        assert set(res2.value.values()) == {"started"}
+        control.teardown_sessions(t)
