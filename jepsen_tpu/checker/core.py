@@ -288,44 +288,53 @@ class CounterChecker(Checker):
         lo = hi = 0          # envelope of possibly-applied sums
         applied = 0          # surely applied (ok) sum
         open_adds: Dict[int, int] = {}  # invoke index -> delta
-        open_reads: Dict[int, int] = {}  # invoke index -> lo at invoke
+        # invoke index -> [min lo, max hi] seen over the read's open window:
+        # an add concurrent with a read (in either direction) may legally
+        # be observed or missed, so a read is acceptable anywhere inside
+        # the widest envelope of its interval (checker.clj:737)
+        open_reads: Dict[int, list] = {}
         errors = []
+
+        def move_envelope(nlo, nhi):
+            nonlocal lo, hi
+            lo, hi = nlo, nhi
+            for w in open_reads.values():
+                w[0] = min(w[0], lo)
+                w[1] = max(w[1], hi)
+
         for i, op in enumerate(history):
             if op.f == "read" and op.type == INVOKE:
-                # an add completing OK *during* this read is concurrent
-                # with it, so it may legally be missed: the read's lower
-                # bound is the envelope at its invocation, the upper bound
-                # the envelope at its completion (checker.clj:737)
-                open_reads[i] = lo
+                open_reads[i] = [lo, hi]
             if op.f == "add":
                 d = op.value or 0
                 if op.type == INVOKE:
                     open_adds[i] = d
                     if d > 0:
-                        hi += d
+                        move_envelope(lo, hi + d)
                     else:
-                        lo += d
+                        move_envelope(lo + d, hi)
                 elif op.type == OK:
                     j = int(pairs[i])
                     d = open_adds.pop(j, d)
                     applied += d
                     if d > 0:
-                        lo += d
+                        move_envelope(lo + d, hi)
                     else:
-                        hi += d
+                        move_envelope(lo, hi + d)
                 elif op.type in (FAIL,):
                     j = int(pairs[i])
                     d = open_adds.pop(j, d)
                     if d > 0:
-                        hi -= d
+                        move_envelope(lo, hi - d)
                     else:
-                        lo -= d
+                        move_envelope(lo - d, hi)
                 # INFO: stays open forever (may or may not apply)
             elif op.f == "read" and op.type == OK:
                 v = op.value
-                rd_lo = open_reads.pop(int(pairs[i]), lo)
-                if v is None or not (rd_lo <= v <= hi):
-                    errors.append({**op.to_dict(), "bounds": [rd_lo, hi]})
+                rd_lo, rd_hi = open_reads.pop(int(pairs[i]), [lo, hi])
+                if v is None or not (rd_lo <= v <= rd_hi):
+                    errors.append({**op.to_dict(),
+                                   "bounds": [rd_lo, rd_hi]})
                 reads.append(v)
         return {"valid": not errors,
                 "reads": len(reads), "errors": errors,

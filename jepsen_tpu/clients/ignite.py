@@ -1,0 +1,201 @@
+"""Minimal Apache Ignite thin-client binary protocol.
+
+Parity: the reference drives Ignite through the Java client
+(ignite/src/jepsen/ignite/register.clj:22-49 cache get/put/replace,
+bank.clj:27-32 transactional getAll).  This is an independent
+implementation of the public "Binary Client Protocol": handshake
+(op 1, version, client code 2), then [len i32][opcode i16][req id i64]
+frames; cache ids are Java String.hashCode of the cache name; values are
+binary-protocol primitives (int 3, long 4, string 9, bool 8, null 101).
+Transactions use OP_TX_START/OP_TX_END (protocol 1.5+) with the
+transactional flag bit on cache operations.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+OP_HANDSHAKE = 1
+
+OP_CACHE_GET = 1000
+OP_CACHE_PUT = 1001
+OP_CACHE_PUT_IF_ABSENT = 1002
+OP_CACHE_GET_ALL = 1003
+OP_CACHE_PUT_ALL = 1004
+OP_CACHE_REPLACE = 1009
+OP_CACHE_REPLACE_IF_EQUALS = 1010
+OP_CACHE_GET_OR_CREATE_WITH_NAME = 1052
+OP_TX_START = 4000
+OP_TX_END = 4001
+
+FLAG_TX = 0x02  # cache op participates in the connection's transaction
+
+TYPE_INT = 3
+TYPE_LONG = 4
+TYPE_BOOL = 8
+TYPE_STRING = 9
+TYPE_NULL = 101
+
+VER = (1, 5, 0)
+
+
+class IgniteError(Exception):
+    pass
+
+
+def cache_id(name: str) -> int:
+    """Java String.hashCode, as the protocol requires."""
+    h = 0
+    for c in name:
+        h = (31 * h + ord(c)) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def enc(v: Any) -> bytes:
+    if v is None:
+        return bytes([TYPE_NULL])
+    if isinstance(v, bool):
+        return struct.pack("<Bb", TYPE_BOOL, 1 if v else 0)
+    if isinstance(v, int):
+        if -(1 << 31) <= v < (1 << 31):
+            return struct.pack("<Bi", TYPE_INT, v)
+        return struct.pack("<Bq", TYPE_LONG, v)
+    if isinstance(v, str):
+        b = v.encode()
+        return struct.pack("<Bi", TYPE_STRING, len(b)) + b
+    raise TypeError(f"can't encode {type(v)}")
+
+
+def dec(buf: bytes, off: int = 0) -> Tuple[Any, int]:
+    t = buf[off]
+    off += 1
+    if t == TYPE_NULL:
+        return None, off
+    if t == TYPE_BOOL:
+        return bool(buf[off]), off + 1
+    if t == TYPE_INT:
+        return struct.unpack_from("<i", buf, off)[0], off + 4
+    if t == TYPE_LONG:
+        return struct.unpack_from("<q", buf, off)[0], off + 8
+    if t == TYPE_STRING:
+        (n,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        return buf[off:off + n].decode(), off + n
+    raise IgniteError(f"can't decode type {t}")
+
+
+class IgniteClient:
+    def __init__(self, node: str, port: int = 10800,
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((node, port), timeout=timeout)
+        self.req_ids = itertools.count(1)
+        self.tx_id: Optional[int] = None
+        self._handshake()
+
+    def _handshake(self) -> None:
+        body = struct.pack("<BhhhB", OP_HANDSHAKE, *VER, 2)
+        self.sock.sendall(struct.pack("<i", len(body)) + body)
+        resp = self._recv_frame()
+        if resp[0] != 1:
+            raise IgniteError(f"handshake rejected: {resp[1:]!r}")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError("ignite connection closed")
+            buf += c
+        return buf
+
+    def _recv_frame(self) -> bytes:
+        (n,) = struct.unpack("<i", self._recv_exact(4))
+        return self._recv_exact(n)
+
+    def _call(self, opcode: int, payload: bytes) -> bytes:
+        rid = next(self.req_ids)
+        body = struct.pack("<hq", opcode, rid) + payload
+        self.sock.sendall(struct.pack("<i", len(body)) + body)
+        resp = self._recv_frame()
+        r_rid, status = struct.unpack_from("<qi", resp)
+        if r_rid != rid:
+            raise IgniteError(f"request id mismatch {r_rid} != {rid}")
+        if status != 0:
+            msg, _ = dec(resp, 12)
+            raise IgniteError(f"status {status}: {msg}")
+        return resp[12:]
+
+    def _cache_header(self, cache: str) -> bytes:
+        if self.tx_id is not None:
+            return struct.pack("<iBi", cache_id(cache), FLAG_TX,
+                               self.tx_id)
+        return struct.pack("<iB", cache_id(cache), 0)
+
+    # -- cache operations --------------------------------------------------
+
+    def get_or_create_cache(self, name: str) -> None:
+        self._call(OP_CACHE_GET_OR_CREATE_WITH_NAME, enc(name))
+
+    def get(self, cache: str, key: Any) -> Any:
+        out = self._call(OP_CACHE_GET, self._cache_header(cache) + enc(key))
+        return dec(out)[0]
+
+    def put(self, cache: str, key: Any, value: Any) -> None:
+        self._call(OP_CACHE_PUT,
+                   self._cache_header(cache) + enc(key) + enc(value))
+
+    def replace_if_equals(self, cache: str, key: Any, old: Any,
+                          new: Any) -> bool:
+        out = self._call(OP_CACHE_REPLACE_IF_EQUALS,
+                         self._cache_header(cache)
+                         + enc(key) + enc(old) + enc(new))
+        return bool(dec(out)[0])
+
+    def get_all(self, cache: str, keys: List[Any]) -> Dict[Any, Any]:
+        payload = self._cache_header(cache) + struct.pack("<i", len(keys))
+        for k in keys:
+            payload += enc(k)
+        out = self._call(OP_CACHE_GET_ALL, payload)
+        (n,) = struct.unpack_from("<i", out)
+        off = 4
+        result = {}
+        for _ in range(n):
+            k, off = dec(out, off)
+            v, off = dec(out, off)
+            result[k] = v
+        return result
+
+    def put_all(self, cache: str, entries: Dict[Any, Any]) -> None:
+        payload = self._cache_header(cache) + struct.pack(
+            "<i", len(entries))
+        for k, v in entries.items():
+            payload += enc(k) + enc(v)
+        self._call(OP_CACHE_PUT_ALL, payload)
+
+    # -- transactions ------------------------------------------------------
+
+    def tx_start(self, concurrency: int = 1, isolation: int = 2,
+                 timeout_ms: int = 5000) -> int:
+        """concurrency: 0 optimistic / 1 pessimistic; isolation:
+        0 read-committed / 1 repeatable-read / 2 serializable
+        (bank.clj:28's txStart arguments)."""
+        out = self._call(OP_TX_START,
+                         struct.pack("<BBq", concurrency, isolation,
+                                     timeout_ms) + enc(None))
+        self.tx_id = struct.unpack_from("<i", out)[0]
+        return self.tx_id
+
+    def tx_end(self, commit: bool) -> None:
+        txid, self.tx_id = self.tx_id, None
+        if txid is None:
+            raise IgniteError("no open transaction")
+        self._call(OP_TX_END, struct.pack("<ib", txid, 1 if commit else 0))

@@ -1,0 +1,219 @@
+"""Minimal RethinkDB (ReQL) wire client: V1_0 handshake with SCRAM-SHA-256
+auth, JSON-serialized query terms.
+
+Parity: the reference drives RethinkDB through the clojure rethinkdb
+driver (rethinkdb/src/jepsen/rethinkdb.clj:97-120 conn/run!,
+document_cas.clj:53-107 insert/update/branch CAS).  This is an independent
+implementation of the public ReQL wire protocol: 0x34c2bdc3 magic, SCRAM
+handshake frames, then [token u64][len u32][json] query frames.  Term type
+codes are the public ql2.proto enum.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+V1_0 = 0x34C2BDC3
+
+# ql2.proto Term::TermType
+DATUM = 1
+MAKE_ARRAY = 2
+DB = 14
+TABLE = 15
+GET = 16
+EQ = 17
+FUNC = 69
+VAR = 10
+GET_FIELD = 31
+BRANCH = 65
+ERROR = 12
+UPDATE = 53
+INSERT = 56
+DB_CREATE = 57
+TABLE_CREATE = 60
+DEFAULT = 92
+STATUS = 175
+RECONFIGURE = 176
+WAIT = 177
+
+START = 1  # Query::QueryType
+
+SUCCESS_ATOM = 1
+SUCCESS_SEQUENCE = 2
+CLIENT_ERROR = 16
+COMPILE_ERROR = 17
+RUNTIME_ERROR = 18
+
+
+class ReqlError(Exception):
+    pass
+
+
+def _scram_hash(password: str, salt: bytes, i: int) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(), salt, i)
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha256).digest()
+
+
+class RethinkClient:
+    """One connection; run(term) executes a ReQL term and returns the
+    decoded result (atom or sequence)."""
+
+    def __init__(self, node: str, port: int = 28015, user: str = "admin",
+                 password: str = "", timeout: float = 10.0):
+        self.sock = socket.create_connection((node, port), timeout=timeout)
+        self.token = 0
+        self._handshake(user, password)
+
+    # -- handshake ---------------------------------------------------------
+
+    def _read_null_terminated(self) -> bytes:
+        out = b""
+        while not out.endswith(b"\0"):
+            c = self.sock.recv(1)
+            if not c:
+                raise ConnectionError("closed during handshake")
+            out += c
+        return out[:-1]
+
+    def _handshake(self, user: str, password: str) -> None:
+        self.sock.sendall(struct.pack("<I", V1_0))
+        hello = json.loads(self._read_null_terminated())
+        if not hello.get("success"):
+            raise ReqlError(str(hello))
+        nonce = base64.b64encode(os.urandom(18)).decode()
+        first_bare = f"n={user},r={nonce}"
+        self.sock.sendall(json.dumps({
+            "protocol_version": 0,
+            "authentication_method": "SCRAM-SHA-256",
+            "authentication": "n,," + first_bare}).encode() + b"\0")
+        resp = json.loads(self._read_null_terminated())
+        if not resp.get("success"):
+            raise ReqlError(str(resp))
+        server_first = resp["authentication"]
+        fields = dict(kv.split("=", 1) for kv in server_first.split(","))
+        r, s, i = fields["r"], fields["s"], int(fields["i"])
+        if not r.startswith(nonce):
+            raise ReqlError("server nonce mismatch")
+        salted = _scram_hash(password, base64.b64decode(s), i)
+        client_key = _hmac(salted, b"Client Key")
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = f"c=biws,r={r}"
+        auth_msg = ",".join([first_bare, server_first,
+                             without_proof]).encode()
+        sig = _hmac(stored, auth_msg)
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+        self.sock.sendall(json.dumps(
+            {"authentication": final}).encode() + b"\0")
+        resp = json.loads(self._read_null_terminated())
+        if not resp.get("success"):
+            raise ReqlError(str(resp))
+        server_sig = _hmac(_hmac(salted, b"Server Key"), auth_msg)
+        fields = dict(kv.split("=", 1)
+                      for kv in resp["authentication"].split(","))
+        if base64.b64decode(fields["v"]) != server_sig:
+            raise ReqlError("bad server signature")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- queries -----------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed")
+            buf += chunk
+        return buf
+
+    def run(self, term: Any, optargs: Optional[Dict[str, Any]] = None):
+        self.token += 1
+        q = json.dumps([START, term, optargs or {}]).encode()
+        self.sock.sendall(struct.pack("<QI", self.token, len(q)) + q)
+        token, ln = struct.unpack("<QI", self._recv_exact(12))
+        resp = json.loads(self._recv_exact(ln))
+        t = resp.get("t")
+        if t in (SUCCESS_ATOM, SUCCESS_SEQUENCE):
+            r = resp.get("r", [])
+            return r[0] if t == SUCCESS_ATOM else r
+        raise ReqlError(f"type {t}: {resp.get('r')}")
+
+
+# -- term builders ---------------------------------------------------------
+
+def db(name: str):
+    return [DB, [name]]
+
+
+def table(dbname: str, tname: str, read_mode: Optional[str] = None):
+    t = [TABLE, [db(dbname), tname]]
+    if read_mode:
+        t = [TABLE, [db(dbname), tname], {"read_mode": read_mode}]
+    return t
+
+
+def get(tbl, key):
+    return [GET, [tbl, key]]
+
+
+def get_field(row, name, default=None):
+    """row[name] with a fallback for missing rows/fields — always wrapped
+    in DEFAULT, mirroring (term :DEFAULT [(r/get-field row "val") nil])
+    (document_cas.clj:83-86)."""
+    return [DEFAULT, [[GET_FIELD, [row, name]], default]]
+
+
+def insert(tbl, doc: Dict[str, Any], conflict: str = "error"):
+    return [INSERT, [tbl, {k: v for k, v in doc.items()}],
+            {"conflict": conflict}]
+
+
+def update_cas(row, field: str, old, new):
+    """row.update(fn(r): branch(r[field] == old, {field: new},
+    error("abort"))) — the reference's CAS shape
+    (document_cas.clj:93-102)."""
+    var = [VAR, [1]]
+    body = [BRANCH, [[EQ, [[GET_FIELD, [var, field]], old]],
+                     {field: new},
+                     [ERROR, ["abort"]]]]
+    fn = [FUNC, [[MAKE_ARRAY, [1]], body]]
+    return [UPDATE, [row, fn]]
+
+
+def db_create(name: str):
+    return [DB_CREATE, [name]]
+
+
+def table_create(dbname: str, tname: str, **opts):
+    return [TABLE_CREATE, [db(dbname), tname], opts or {}]
+
+
+def status(dbname: str, tname: str):
+    return [STATUS, [table(dbname, tname)]]
+
+
+def reconfigure(dbname: str, tname: str, shards: int,
+                replicas: Dict[str, int], primary_tag: str):
+    return [RECONFIGURE, [table(dbname, tname)],
+            {"shards": shards, "replicas": replicas,
+             "primary_replica_tag": primary_tag}]
+
+
+def wait_table(dbname: str, tname: str):
+    return [WAIT, [table(dbname, tname)],
+            {"wait_for": "all_replicas_ready"}]

@@ -78,7 +78,18 @@ class AerospikeDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
         s = session(test, node).sudo()
         if not cu.exists(s, "/usr/bin/asd"):
             # packages staged on the control node are uploaded then dpkg'd
-            # (support.clj:228-255); --force-confnew keeps our conf
+            # (support.clj:211-255: local-packages dir -> remote dir);
+            # --force-confnew keeps our conf
+            import glob
+            local = test.get("local_package_dir", "packages")
+            debs = sorted(glob.glob(f"{local}/*.deb"))
+            if not debs:
+                raise RuntimeError(
+                    f"no aerospike .deb packages staged in {local!r}; "
+                    "set test['local_package_dir'] "
+                    "(support.clj:211-226 semantics)")
+            s.exec("mkdir", "-p", PACKAGE_DIR)
+            s.upload(debs, PACKAGE_DIR)
             s.exec("sh", "-c",
                    f"dpkg -i --force-confnew {PACKAGE_DIR}/*.deb")
         cu.write_file(s, config(test, node), CONF)

@@ -20,8 +20,9 @@ from jepsen_tpu import generator as gen
 from jepsen_tpu import independent, nemesis as jnem
 from jepsen_tpu.checker.core import CounterChecker, SetChecker
 from jepsen_tpu.control import util as cu
+from jepsen_tpu import net as jnet
 from jepsen_tpu.nemesis import combined
-from jepsen_tpu.nemesis.partition import partition_random_halves
+from jepsen_tpu.nemesis.partition import Partitioner
 from jepsen_tpu.nemesis.time import ClockNemesis, clock_gen
 from jepsen_tpu.workloads import linearizable_register
 
@@ -109,8 +110,14 @@ def full_package(opts: Dict[str, Any]) -> combined.Package:
     max_dead = int(opts.get("max_dead_nodes", 2))
     signal = "TERM" if opts.get("clean_kill") else "KILL"
     killer = KillNemesis(signal=signal, max_dead=max_dead)
-    part = jnem.f_map({"partition-start": "start", "partition-stop": "stop"},
-                      partition_random_halves())
+
+    def halves(nodes):
+        ns = list(nodes)
+        random.shuffle(ns)
+        return jnet.complete_grudge(jnet.bisect(ns))
+
+    part = Partitioner(halves, start_f="partition-start",
+                       stop_f="partition-stop")
     members = [killer, part, ClockNemesis()]
     nem = jnem.Compose(members, [set(killer.fs()),
                                  {"partition-start", "partition-stop"},
@@ -166,7 +173,13 @@ def set_workload(opts) -> Dict[str, Any]:
 
     def adds(k):
         counter = iter(range(10_000))
-        return gen.FnGen(lambda: {"f": "add", "value": next(counter)})
+
+        def one():
+            v = next(counter, None)
+            # exhaustion must surface as None, not StopIteration
+            return None if v is None else {"f": "add", "value": v}
+
+        return gen.FnGen(one)
 
     return {
         "client": SetClient(),

@@ -41,8 +41,9 @@ def build_test(opts: Dict[str, Any], *, suite: str, db,
         else sorted(nemeses)[0]
     nemesis_name = opts.get("nemesis") or default_nemesis
     wl = workloads[workload_name](opts)
+    # nemesis factories see all suite opts (max_dead_nodes, pause_mode, …)
     pkg = nemeses[nemesis_name](
-        {"interval": float(opts.get("nemesis_interval", 10.0))})
+        {**opts, "interval": float(opts.get("nemesis_interval", 10.0))})
 
     time_limit = float(opts.get("time_limit", 60.0))
     client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))

@@ -1,0 +1,2 @@
+"""Apache Ignite suite (reference: ignite/ — register and transactional
+bank workloads over cache operations)."""

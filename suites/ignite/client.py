@@ -1,0 +1,152 @@
+"""Ignite workload clients.
+
+Parity: ignite/src/jepsen/ignite/register.clj:22-49 (cache get / put /
+replace(old,new) on cache "REGISTER") and bank.clj:22-78 (n accounts in
+cache "ACCOUNTS", transactional read-all and transfer with configurable
+concurrency/isolation — txStart…commit around getAll/puts).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.ignite import IgniteClient, IgniteError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+THIN_PORT = 10800
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+CONCURRENCY = {"optimistic": 0, "pessimistic": 1}
+ISOLATION = {"read-committed": 0, "repeatable-read": 1, "serializable": 2}
+
+
+def connect(test, node) -> IgniteClient:
+    return IgniteClient(node, port=int(test.get("db_port", THIN_PORT)))
+
+
+class RegisterClient(jclient.Client):
+    CACHE = "REGISTER"
+
+    def __init__(self, conn: Optional[IgniteClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        c = connect(test, node)
+        c.get_or_create_cache(self.CACHE)
+        return RegisterClient(c)
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        key = f"k{k}"
+        try:
+            if op.f == "read":
+                return op.with_(type=OK,
+                                value=(k, self.conn.get(self.CACHE, key)))
+            if op.f == "write":
+                self.conn.put(self.CACHE, key, v)
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                ok = self.conn.replace_if_equals(self.CACHE, key, old, new)
+                return op.with_(type=OK if ok else FAIL)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except IgniteError as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+
+class BankClient(jclient.Client):
+    """Transfers and read-alls inside explicit transactions
+    (bank.clj:27-78)."""
+
+    CACHE = "ACCOUNTS"
+
+    def __init__(self, concurrency: str = "pessimistic",
+                 isolation: str = "serializable",
+                 conn: Optional[IgniteClient] = None):
+        self.concurrency = concurrency
+        self.isolation = isolation
+        self.conn = conn
+
+    def open(self, test, node):
+        c = connect(test, node)
+        c.get_or_create_cache(self.CACHE)
+        return BankClient(self.concurrency, self.isolation, c)
+
+    def setup(self, test):
+        wl = test.get("bank", {})
+        accounts = wl.get("accounts", list(range(10)))
+        total = wl.get("total_amount", 100)
+        per = total // len(accounts)
+        existing = self.conn.get_all(self.CACHE, accounts)
+        if len(existing) < len(accounts):
+            self.conn.put_all(self.CACHE, {
+                a: per + (total - per * len(accounts) if i == 0 else 0)
+                for i, a in enumerate(accounts)})
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _tx(self):
+        self.conn.tx_start(CONCURRENCY[self.concurrency],
+                           ISOLATION[self.isolation])
+
+    def invoke(self, test, op: Op) -> Op:
+        accounts = test.get("bank", {}).get("accounts", list(range(10)))
+        try:
+            if op.f == "read":
+                self._tx()
+                try:
+                    vals = self.conn.get_all(self.CACHE, accounts)
+                    self.conn.tx_end(commit=True)
+                except BaseException:
+                    # commit may have cleared tx_id before failing
+                    if self.conn.tx_id is not None:
+                        self.conn.tx_end(commit=False)
+                    raise
+                return op.with_(type=OK, value=dict(sorted(vals.items())))
+            if op.f == "transfer":
+                v = op.value
+                frm, to, amt = v["from"], v["to"], v["amount"]
+                self._tx()
+                try:
+                    cur = self.conn.get_all(self.CACHE, [frm, to])
+                    if cur.get(frm, 0) < amt:
+                        self.conn.tx_end(commit=False)
+                        return op.with_(type=FAIL,
+                                        error="insufficient funds")
+                    self.conn.put_all(self.CACHE, {
+                        frm: cur.get(frm, 0) - amt,
+                        to: cur.get(to, 0) + amt})
+                    self.conn.tx_end(commit=True)
+                except BaseException:
+                    if self.conn.tx_id is not None:
+                        self.conn.tx_end(commit=False)
+                    raise
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except IgniteError as e:
+            # tx conflicts / timeouts definitely rolled back
+            if "status" in str(e) and op.f == "transfer":
+                return op.with_(type=FAIL, error=str(e))
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

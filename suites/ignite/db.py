@@ -1,0 +1,117 @@
+"""Apache Ignite install/config/start.
+
+Parity: ignite/src/jepsen/ignite.clj — download the binary distribution,
+render an IgniteConfiguration XML with a static-IP discovery finder over
+the test's nodes (configure/configure-client), start ignite.sh as a
+daemon, stop via grepkill (nemesis.clj's kill-node start-stopper).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "2.16.0"
+URL = (f"https://archive.apache.org/dist/ignite/{VERSION}/"
+       f"apache-ignite-{VERSION}-bin.zip")
+DIR = "/opt/ignite"
+CONF = f"{DIR}/config/jepsen.xml"
+LOGFILE = "/var/log/ignite.log"
+PIDFILE = "/var/run/ignite.pid"
+THIN_PORT = 10800
+DISCO_PORT = 47500
+
+XML = """\
+<?xml version="1.0" encoding="UTF-8"?>
+<beans xmlns="http://www.springframework.org/schema/beans"
+       xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance"
+       xsi:schemaLocation="http://www.springframework.org/schema/beans
+           http://www.springframework.org/schema/beans/spring-beans.xsd">
+  <bean class="org.apache.ignite.configuration.IgniteConfiguration">
+    <property name="clientConnectorConfiguration">
+      <bean class="org.apache.ignite.configuration.\
+ClientConnectorConfiguration">
+        <property name="port" value="{thin_port}"/>
+        <property name="thinClientEnabled" value="true"/>
+      </bean>
+    </property>
+{pds}
+    <property name="discoverySpi">
+      <bean class="org.apache.ignite.spi.discovery.tcp.TcpDiscoverySpi">
+        <property name="ipFinder">
+          <bean class="org.apache.ignite.spi.discovery.tcp.ipfinder.vm.\
+TcpDiscoveryVmIpFinder">
+            <property name="addresses">
+              <list>
+{addresses}
+              </list>
+            </property>
+          </bean>
+        </property>
+      </bean>
+    </property>
+  </bean>
+</beans>
+"""
+
+PDS_XML = """\
+    <property name="dataStorageConfiguration">
+      <bean class="org.apache.ignite.configuration.\
+DataStorageConfiguration">
+        <property name="defaultDataRegionConfiguration">
+          <bean class="org.apache.ignite.configuration.\
+DataRegionConfiguration">
+            <property name="persistenceEnabled" value="true"/>
+          </bean>
+        </property>
+      </bean>
+    </property>
+"""
+
+
+def config(test) -> str:
+    addresses = "\n".join(
+        f'                <value>{n}:{DISCO_PORT}..{DISCO_PORT + 2}</value>'
+        for n in test["nodes"])
+    return XML.format(thin_port=THIN_PORT, addresses=addresses,
+                      pds=PDS_XML if test.get("pds") else "")
+
+
+class IgniteDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("bash", "-c",
+               f"[ -x {DIR}/bin/ignite.sh ] || "
+               f"cp -r {DIR}/apache-ignite-*/* {DIR}/ 2>/dev/null || true")
+        cu.write_file(s, config(test), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, THIN_PORT, timeout_s=180)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "ignite")
+        s.exec("sh", "-c", f"rm -rf {DIR}/work {LOGFILE} {PIDFILE}")
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(s, f"{DIR}/bin/ignite.sh", CONF,
+                        pidfile=PIDFILE, logfile=LOGFILE,
+                        env={"IGNITE_HOME": DIR})
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "ignite")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "ignite", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "ignite", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,72 @@
+"""Ignite suite CLI.
+
+Parity: ignite/src/jepsen/ignite/runner.clj's test matrix (register +
+bank across concurrency/isolation modes) and nemesis.clj (kill-node
+start-stopper, random-halves partitions).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.ignite.client import BankClient, RegisterClient
+from suites.ignite.db import IgniteDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 100)),
+        threads_per_key=2)
+    return {**wl, "client": RegisterClient()}
+
+
+def bank_workload(opts) -> Dict[str, Any]:
+    wl = bank_wl.workload(accounts=list(range(10)))
+    return {**wl, "client": BankClient(
+        concurrency=opts.get("tx_concurrency", "pessimistic"),
+        isolation=opts.get("tx_isolation", "serializable"))}
+
+
+WORKLOADS = {"register": register_workload, "bank": bank_workload}
+
+
+def ignite_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    t = common.build_test(opts, suite="ignite", db=IgniteDB(),
+                          workloads=WORKLOADS)
+    if opts.get("workload") == "bank":
+        t["bank"] = {"accounts": list(range(10)),
+                     "total_amount": int(opts.get("total_amount", 100))}
+    return t
+
+
+def all_tests(opts: Dict[str, Any]):
+    """runner.clj's sweep: workloads x tx modes x nemeses."""
+    out = []
+    for w in opts.get("workloads", sorted(WORKLOADS)):
+        for n in opts.get("nemeses", sorted(common.STANDARD_NEMESES)):
+            out.append(ignite_test({**opts, "workload": w, "nemesis": n}))
+    return out
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=100)
+    parser.add_argument("--total-amount", type=int, default=100)
+    parser.add_argument("--pds", action="store_true",
+                        help="enable native persistence")
+    parser.add_argument("--tx-concurrency", default="pessimistic",
+                        choices=["optimistic", "pessimistic"])
+    parser.add_argument("--tx-isolation", default="serializable",
+                        choices=["read-committed", "repeatable-read",
+                                 "serializable"])
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(ignite_test, WORKLOADS,
+                         prog="jepsen-tpu-ignite", extra_opts=_extra))

@@ -1,0 +1,2 @@
+"""LogCabin (Raft) suite (reference: logcabin/ — CAS register driven
+through the node-side TreeOps CLI)."""

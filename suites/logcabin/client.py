@@ -1,0 +1,99 @@
+"""LogCabin CAS-register client: drives the node-side TreeOps CLI over the
+control plane.
+
+Parity: logcabin/src/jepsen/logcabin.clj:152-246 — reads/writes/CAS on a
+tree path via `TreeOps read|write` with JSON-encoded values; CAS is a
+conditioned write (`-p path:value`), and a failed condition surfaces as
+the documented exception message, which maps to :fail.  Timeouts map to
+:fail for reads and CAS (the tool reports "Client-specified timeout
+elapsed" only when nothing was applied) and :info for writes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.control import session
+from jepsen_tpu.control.core import RemoteCommandFailed
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites.logcabin.db import TREEOPS, cluster_addrs
+
+OP_TIMEOUT_S = 3
+KEY = "/jepsen"
+
+CAS_FAIL_RE = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Path '.*' has value "
+    r"'.*', not '.*' as required")
+TIMEOUT_RE = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Client-specified "
+    r"timeout elapsed")
+
+
+class CasClient(jclient.Client):
+    def __init__(self, node: Optional[str] = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CasClient(node)
+
+    def setup(self, test):
+        try:
+            self._write(test, json.dumps(None))
+        except RemoteCommandFailed:
+            pass
+
+    def _session(self, test):
+        return session(test, self.node).sudo()
+
+    def _treeops(self, test) -> str:
+        return test.get("treeops_bin", TREEOPS)
+
+    def _read(self, test) -> str:
+        return self._session(test).exec(
+            "sh", "-c",
+            f"{self._treeops(test)} -c {cluster_addrs(test)} -q "
+            f"-t {OP_TIMEOUT_S} read {KEY}")
+
+    def _write(self, test, value: str, cond: Optional[str] = None) -> None:
+        p = f"-p '{KEY}:{cond}' " if cond is not None else ""
+        self._session(test).exec(
+            "sh", "-c",
+            f"echo -n '{value}' | {self._treeops(test)} "
+            f"-c {cluster_addrs(test)} -q {p}-t {OP_TIMEOUT_S} "
+            f"write {KEY}")
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                raw = self._read(test).strip()
+                return op.with_(type=OK,
+                                value=json.loads(raw) if raw else None)
+            if op.f == "write":
+                self._write(test, json.dumps(op.value))
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = op.value
+                try:
+                    self._write(test, json.dumps(new),
+                                cond=json.dumps(old))
+                except RemoteCommandFailed as e:
+                    msg = (getattr(e, "result", None) and
+                           e.result.err or str(e)).strip()
+                    if CAS_FAIL_RE.search(msg):
+                        return op.with_(type=FAIL, error="precondition")
+                    raise
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except RemoteCommandFailed as e:
+            msg = (getattr(e, "result", None) and e.result.err
+                   or str(e)).strip()
+            if TIMEOUT_RE.search(msg):
+                return op.with_(type=FAIL if op.f == "read" else INFO,
+                                error="timeout")
+            if op.f == "read":
+                return op.with_(type=FAIL, error=msg[:200])
+            return op.with_(type=INFO, error=msg[:200])

@@ -1,0 +1,94 @@
+"""LogCabin build/bootstrap/reconfigure.
+
+Parity: logcabin/src/jepsen/logcabin.clj:23-150 — git clone + scons build,
+per-node config (serverId from the node's index, listenAddresses),
+bootstrap on node 1, start everywhere, then the Reconfigure tool on node 1
+grows the cluster to all nodes; stop is grepkill + pidfile removal.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+REPO = "https://github.com/logcabin/logcabin.git"
+PORT = 5254
+CONF = "/root/logcabin.conf"
+LOGFILE = "/root/logcabin.log"
+PIDFILE = "/root/logcabin.pid"
+STORE = "/root/storage"
+BIN = "/root/LogCabin"
+RECONFIG = "/root/Reconfigure"
+TREEOPS = "/root/TreeOps"
+
+
+def server_id(test, node) -> int:
+    return test["nodes"].index(node) + 1
+
+
+def server_addr(node) -> str:
+    return f"{node}:{PORT}"
+
+
+def cluster_addrs(test) -> str:
+    return ",".join(server_addr(n) for n in test["nodes"])
+
+
+class LogCabinDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        if not cu.exists(s, BIN):
+            s.exec("apt-get", "install", "-y", "git", "g++", "scons",
+                   "protobuf-compiler", "libprotobuf-dev",
+                   "libcrypto++-dev")
+            s.exec("sh", "-c",
+                   f"[ -d /logcabin ] || git clone --depth 1 {REPO} "
+                   f"/logcabin")
+            s.exec("sh", "-c",
+                   "cd /logcabin && git submodule update --init && scons")
+            s.exec("sh", "-c",
+                   "cp -f /logcabin/build/LogCabin "
+                   "/logcabin/build/Examples/Reconfigure "
+                   "/logcabin/build/Examples/TreeOps /root/")
+        cu.write_file(s,
+                      f"serverId = {server_id(test, node)}\n"
+                      f"listenAddresses = {server_addr(node)}\n",
+                      CONF)
+        s.exec("rm", "-rf", LOGFILE)
+        if node == test["nodes"][0]:
+            # bootstrap the initial single-server cluster (logcabin.clj:79)
+            s.exec("sh", "-c",
+                   f"cd /root && {BIN} -c {CONF} -l {LOGFILE} --bootstrap")
+        self.start(test, node)
+        if node == test["nodes"][0]:
+            addrs = " ".join(server_addr(n) for n in test["nodes"])
+            s.exec("sh", "-c",
+                   f"cd /root && {RECONFIG} -c {cluster_addrs(test)} "
+                   f"set {addrs}")
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "LogCabin")
+        s.exec("rm", "-rf", PIDFILE, STORE)
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               f"cd /root && {BIN} -c {CONF} -d -l {LOGFILE} -p {PIDFILE}")
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "LogCabin")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "LogCabin", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "LogCabin", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,58 @@
+"""LogCabin suite CLI.
+
+Parity: logcabin/src/jepsen/logcabin.clj's cas-register test: a single
+CAS register at /jepsen checked for linearizability, under partitions
+(the reference's default nemesis battery).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.models import get_model
+
+from suites import common
+from suites.logcabin.client import CasClient
+from suites.logcabin.db import LogCabinDB
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    """One global register (the reference uses a single /jepsen path, not
+    an independent keyspace)."""
+    n = int(opts.get("ops", 300))
+    g = gen.limit(n, gen.mix([
+        gen.FnGen(lambda: {"f": "read"}),
+        gen.FnGen(lambda: {"f": "write", "value": random.randrange(5)}),
+        gen.FnGen(lambda: {"f": "cas",
+                           "value": [random.randrange(5),
+                                     random.randrange(5)]})]))
+    return {"client": CasClient(),
+            "generator": gen.stagger(1 / 10, g),
+            "checker": linearizable(get_model("cas-register"),
+                                    opts.get("algorithm")),
+            "model": get_model("cas-register")}
+
+
+WORKLOADS = {"cas-register": register_workload}
+
+
+def logcabin_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="logcabin", db=LogCabinDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, logcabin_test, WORKLOADS)
+
+
+def _extra(parser):
+    parser.add_argument("--ops", type=int, default=300)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(logcabin_test, WORKLOADS,
+                         prog="jepsen-tpu-logcabin", extra_opts=_extra))

@@ -1,0 +1,2 @@
+"""RethinkDB suite (reference: rethinkdb/ — document CAS under partitions
+and topology reconfiguration)."""

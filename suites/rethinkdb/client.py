@@ -1,0 +1,112 @@
+"""RethinkDB document-CAS client and table bootstrap.
+
+Parity: rethinkdb/src/jepsen/rethinkdb/document_cas.clj:53-110 — one
+document per key in db "jepsen" table "cas"; read via row["val"] with a
+nil default, write via insert with conflict=update, CAS via an update
+branch that errors unless the current value matches.  Table creation sets
+write_acks and read_mode (31-49 set-write-acks!).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients import rethinkdb as rq
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+DB = "jepsen"
+TABLE = "cas"
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+
+def connect(test, node) -> rq.RethinkClient:
+    return rq.RethinkClient(node,
+                            port=int(test.get("db_port", rq_port(test))),
+                            user=test.get("db_user", "admin"),
+                            password=test.get("db_password", ""))
+
+
+def rq_port(test) -> int:
+    return int(test.get("db_port", 28015))
+
+
+class DocumentCasClient(jclient.Client):
+    _table_lock = threading.Lock()
+    _table_made = False
+
+    def __init__(self, write_acks: str = "majority",
+                 read_mode: str = "majority",
+                 conn: Optional[rq.RethinkClient] = None):
+        self.write_acks = write_acks
+        self.read_mode = read_mode
+        self.conn = conn
+
+    def open(self, test, node):
+        c = DocumentCasClient(self.write_acks, self.read_mode,
+                              connect(test, node))
+        return c
+
+    def setup(self, test):
+        with DocumentCasClient._table_lock:
+            if DocumentCasClient._table_made:
+                return
+            try:
+                self.conn.run(rq.db_create(DB))
+            except rq.ReqlError:
+                pass  # exists
+            try:
+                self.conn.run(rq.table_create(
+                    DB, TABLE, replicas=len(test.get("nodes", [])) or 1,
+                    write_acks=self.write_acks))
+            except rq.ReqlError:
+                pass
+            try:
+                self.conn.run(rq.wait_table(DB, TABLE))
+            except rq.ReqlError:
+                pass
+            DocumentCasClient._table_made = True
+
+    def teardown(self, test):
+        DocumentCasClient._table_made = False
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        tbl = rq.table(DB, TABLE, read_mode=self.read_mode)
+        row = rq.get(tbl, k)
+        try:
+            if op.f == "read":
+                val = self.conn.run(rq.get_field(row, "val"))
+                return op.with_(type=OK, value=(k, val))
+            if op.f == "write":
+                self.conn.run(rq.insert(rq.table(DB, TABLE),
+                                        {"id": k, "val": v},
+                                        conflict="update"))
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                try:
+                    res = self.conn.run(rq.update_cas(row, "val", old, new))
+                except rq.ReqlError as e:
+                    if "abort" in str(e):
+                        return op.with_(type=FAIL, error="precondition")
+                    raise
+                ok = (res.get("errors", 1) == 0 and
+                      res.get("replaced", 0) == 1)
+                return op.with_(type=OK if ok else FAIL)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self.conn.close()
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except rq.ReqlError as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

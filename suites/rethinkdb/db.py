@@ -1,0 +1,68 @@
+"""RethinkDB install/config/start.
+
+Parity: rethinkdb/src/jepsen/rethinkdb.clj:52-95 — apt install from the
+rethinkdb repo, /etc/rethinkdb/instances.d/jepsen.conf with join= lines
+for every node plus server-name/server-tag set to the node name (the
+reconfigure nemesis addresses primaries by server tag), service start,
+log at /var/log/rethinkdb.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+LOGFILE = "/var/log/rethinkdb"
+CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
+CLIENT_PORT = 28015
+CLUSTER_PORT = 29015
+
+
+def config(test, node) -> str:
+    joins = "\n".join(f"join={n}:{CLUSTER_PORT}" for n in test["nodes"])
+    return (f"bind=all\nlog-file={LOGFILE}\n\n{joins}\n\n"
+            f"server-name={node}\nserver-tag={node}\n")
+
+
+class RethinkDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        if not cu.exists(s, "/usr/bin/rethinkdb"):
+            s.exec("sh", "-c",
+                   "echo 'deb https://download.rethinkdb.com/repository/"
+                   "debian-bullseye bullseye main' "
+                   "> /etc/apt/sources.list.d/rethinkdb.list")
+            s.exec("sh", "-c",
+                   "wget -qO- https://download.rethinkdb.com/repository/"
+                   "raw/pubkey.gpg | apt-key add -")
+            s.exec("apt-get", "update")
+            s.exec("apt-get", "install", "-y", "rethinkdb")
+        s.exec("sh", "-c", f"touch {LOGFILE} && "
+                           f"chown rethinkdb:rethinkdb {LOGFILE} || true")
+        cu.write_file(s, config(test, node), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, CLIENT_PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "rethinkdb")
+        s.exec("sh", "-c",
+               f"rm -rf /var/lib/rethinkdb/jepsen {LOGFILE}")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec("service", "rethinkdb", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "rethinkdb")
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "rethinkdb", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "rethinkdb", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

@@ -1,0 +1,123 @@
+"""RethinkDB suite CLI.
+
+Parity: rethinkdb/src/jepsen/rethinkdb/document_cas.clj:129-185 (cas-test
+with write/read mode matrix, cas-reconfigure-test) and rethinkdb.clj:
+180-231 (reconfigure! + reconfigure-nemesis: random replica subset,
+random primary, addressed by server tag).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnem
+from jepsen_tpu.clients import rethinkdb as rq
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.rethinkdb.client import DB, TABLE, DocumentCasClient, connect
+from suites.rethinkdb.db import RethinkDB
+
+
+class ReconfigureNemesis(jnem.Nemesis):
+    """Randomly reshape the table's replica set (rethinkdb.clj:196-231)."""
+
+    def invoke(self, test, op):
+        nodes = list(test["nodes"])
+        size = random.randint(1, len(nodes))
+        replicas = random.sample(nodes, size)
+        primary = random.choice(replicas)
+        last_err = None
+        for _ in range(10):
+            try:
+                conn = connect(test, primary)
+                try:
+                    res = conn.run(rq.reconfigure(
+                        DB, TABLE, shards=1,
+                        replicas={n: 1 for n in replicas},
+                        primary_tag=primary))
+                    if res.get("reconfigured") != 1:
+                        raise rq.ReqlError(f"reconfigured={res}")
+                    return op.with_(type="info",
+                                    value={"replicas": replicas,
+                                           "primary": primary})
+                finally:
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 — unreachable servers
+                last_err = e
+        return op.with_(type="info", error=str(last_err))
+
+    def fs(self):
+        return ["reconfigure"]
+
+
+def reconfigure_package(opts: Dict[str, Any]) -> combined.Package:
+    """start/stop partitions interposed with reconfigures
+    (cas-reconfigure-test's generator, document_cas.clj:160-180)."""
+    part = combined.partition_package(opts)
+    nem = jnem.Compose([ReconfigureNemesis(), part.nemesis],
+                       [{"reconfigure"},
+                        {"start-partition", "stop-partition"}])
+    interval = float(opts.get("interval", 5.0))
+    g = gen.stagger(interval, gen.cycle(gen.lift([
+        {"type": "info", "f": "start-partition"},
+        {"type": "info", "f": "reconfigure"},
+        {"type": "info", "f": "stop-partition"},
+        {"type": "info", "f": "reconfigure"}])))
+    return combined.Package(
+        nemesis=nem, generator=g,
+        final_generator=[{"type": "info", "f": "stop-partition"}])
+
+
+NEMESES = dict(common.STANDARD_NEMESES)
+NEMESES["reconfigure"] = reconfigure_package
+
+
+def cas_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 60)),
+        threads_per_key=2)
+    return {**wl, "client": DocumentCasClient(
+        write_acks=opts.get("write_acks", "majority"),
+        read_mode=opts.get("read_mode", "majority"))}
+
+
+WORKLOADS = {"document-cas": cas_workload}
+
+
+def rethinkdb_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="rethinkdb", db=RethinkDB(),
+                             workloads=WORKLOADS, nemeses=NEMESES)
+
+
+def all_tests(opts: Dict[str, Any]):
+    """Write/read-mode matrix x nemeses (document_cas.clj:129's
+    cas-test variants)."""
+    out = []
+    for wa, rm in opts.get("modes", [("majority", "majority"),
+                                     ("majority", "single"),
+                                     ("single", "majority")]):
+        for n in opts.get("nemeses", sorted(NEMESES)):
+            out.append(rethinkdb_test({**opts, "workload": "document-cas",
+                                       "write_acks": wa, "read_mode": rm,
+                                       "nemesis": n}))
+    return out
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=60)
+    parser.add_argument("--write-acks", default="majority",
+                        choices=["majority", "single"])
+    parser.add_argument("--read-mode", default="majority",
+                        choices=["majority", "single", "outdated"])
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(rethinkdb_test, WORKLOADS, NEMESES,
+                         prog="jepsen-tpu-rethinkdb", extra_opts=_extra))

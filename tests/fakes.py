@@ -818,3 +818,316 @@ class FakeAerospikeHandler(socketserver.BaseRequestHandler):
             rec["gen"] += 1
             return asp.RESULT_OK, rec["gen"], {}
         return 4, 0, {}
+
+
+# --------------------------------------------------------------------------
+# Ignite thin-client protocol — serves jepsen_tpu.clients.ignite
+# --------------------------------------------------------------------------
+
+class IgniteState:
+    def __init__(self):
+        self.caches: Dict[int, Dict[Any, Any]] = {}
+        self.lock = threading.Lock()
+        self.next_tx = 1
+
+
+class FakeIgniteHandler(socketserver.BaseRequestHandler):
+    """Serializable by construction: the global lock is held for a whole
+    transaction, so committed histories are strictly serializable."""
+
+    def handle(self):
+        from jepsen_tpu.clients import ignite as ig
+        st: IgniteState = self.server.state
+        # handshake
+        try:
+            body = self._frame()
+        except ConnectionError:
+            return
+        assert body[0] == ig.OP_HANDSHAKE
+        self.request.sendall(struct.pack("<ib", 1, 1))
+        self.tx: Optional[Dict] = None
+        while True:
+            try:
+                body = self._frame()
+            except (ConnectionError, OSError):
+                if self.tx is not None:
+                    st.lock.release()
+                return
+            opcode, rid = struct.unpack_from("<hq", body)
+            payload = body[10:]
+            try:
+                out = self._dispatch(ig, st, opcode, payload)
+                resp = struct.pack("<qi", rid, 0) + out
+            except Exception as e:  # noqa: BLE001
+                resp = struct.pack("<qi", rid, 1) + ig.enc(str(e))
+            self.request.sendall(struct.pack("<i", len(resp)) + resp)
+
+    def _frame(self) -> bytes:
+        (n,) = struct.unpack("<i", _recv_exact(self.request, 4))
+        return _recv_exact(self.request, n)
+
+    def _dispatch(self, ig, st, opcode, payload):
+        if opcode == ig.OP_CACHE_GET_OR_CREATE_WITH_NAME:
+            name, _ = ig.dec(payload)
+            with st.lock:
+                st.caches.setdefault(ig.cache_id(name), {})
+            return b""
+        if opcode == ig.OP_TX_START:
+            st.lock.acquire()  # whole-tx mutual exclusion
+            self.tx = {"id": st.next_tx, "view": {}, "writes": {}}
+            st.next_tx += 1
+            # view = union of caches keyed by (cid, key)
+            self.tx["snapshot"] = {cid: dict(c)
+                                   for cid, c in st.caches.items()}
+            return struct.pack("<i", self.tx["id"])
+        if opcode == ig.OP_TX_END:
+            txid, commit = struct.unpack_from("<ib", payload)
+            assert self.tx is not None and self.tx["id"] == txid
+            if commit:
+                for (cid, k), v in self.tx["writes"].items():
+                    st.caches.setdefault(cid, {})[k] = v
+            self.tx = None
+            st.lock.release()
+            return b""
+
+        in_tx = self.tx is not None
+        cid, flags = struct.unpack_from("<iB", payload)
+        off = 5
+        if flags & ig.FLAG_TX:
+            off += 4
+        rest = payload[off:]
+
+        def read(cache, key):
+            if in_tx and (cache, key) in self.tx["writes"]:
+                return self.tx["writes"][(cache, key)]
+            return st.caches.get(cache, {}).get(key)
+
+        def write(cache, key, val):
+            if in_tx:
+                self.tx["writes"][(cache, key)] = val
+            else:
+                st.caches.setdefault(cache, {})[key] = val
+
+        lock = st.lock if not in_tx else _NullLock()
+        with lock:
+            if opcode == ig.OP_CACHE_GET:
+                k, _ = ig.dec(rest)
+                return ig.enc(read(cid, k))
+            if opcode == ig.OP_CACHE_PUT:
+                k, o = ig.dec(rest)
+                v, _ = ig.dec(rest, o)
+                write(cid, k, v)
+                return b""
+            if opcode == ig.OP_CACHE_REPLACE_IF_EQUALS:
+                k, o = ig.dec(rest)
+                old, o = ig.dec(rest, o)
+                new, _ = ig.dec(rest, o)
+                if read(cid, k) == old:
+                    write(cid, k, new)
+                    return ig.enc(True)
+                return ig.enc(False)
+            if opcode == ig.OP_CACHE_GET_ALL:
+                (n,) = struct.unpack_from("<i", rest)
+                off2, out, count = 4, b"", 0
+                for _ in range(n):
+                    k, off2 = ig.dec(rest, off2)
+                    v = read(cid, k)
+                    if v is not None:
+                        out += ig.enc(k) + ig.enc(v)
+                        count += 1
+                return struct.pack("<i", count) + out
+            if opcode == ig.OP_CACHE_PUT_ALL:
+                (n,) = struct.unpack_from("<i", rest)
+                off2 = 4
+                for _ in range(n):
+                    k, off2 = ig.dec(rest, off2)
+                    v, off2 = ig.dec(rest, off2)
+                    write(cid, k, v)
+                return b""
+        raise ValueError(f"unhandled opcode {opcode}")
+
+
+# --------------------------------------------------------------------------
+# RethinkDB ReQL protocol — serves jepsen_tpu.clients.rethinkdb
+# --------------------------------------------------------------------------
+
+class RethinkState:
+    def __init__(self):
+        self.dbs: Dict[str, Dict[str, Dict[Any, Dict]]] = {}
+        self.lock = threading.Lock()
+        self.reconfigures: List[Dict] = []
+
+
+class FakeRethinkHandler(socketserver.BaseRequestHandler):
+    PASSWORD = ""
+
+    def handle(self):
+        import base64 as b64
+        import hashlib
+        import hmac as hm
+        import json as js
+        import os as o
+        from jepsen_tpu.clients import rethinkdb as rq
+        st: RethinkState = self.server.state
+        try:
+            magic = struct.unpack("<I", _recv_exact(self.request, 4))[0]
+            assert magic == rq.V1_0
+            self._send_json({"success": True, "min_protocol_version": 0,
+                             "max_protocol_version": 0,
+                             "server_version": "fake"})
+            first = js.loads(self._read_nul())
+            client_first = first["authentication"]
+            first_bare = client_first.split(",", 2)[2]
+            cnonce = dict(kv.split("=", 1)
+                          for kv in first_bare.split(","))["r"]
+            snonce = cnonce + b64.b64encode(o.urandom(9)).decode()
+            salt = o.urandom(16)
+            i = 4096
+            server_first = (f"r={snonce},"
+                            f"s={b64.b64encode(salt).decode()},i={i}")
+            self._send_json({"success": True,
+                             "authentication": server_first})
+            final = js.loads(self._read_nul())["authentication"]
+            fields = dict(kv.split("=", 1) for kv in final.split(","))
+            without_proof = f"c=biws,r={snonce}"
+            auth_msg = ",".join([first_bare, server_first,
+                                 without_proof]).encode()
+            salted = hashlib.pbkdf2_hmac("sha256",
+                                         self.PASSWORD.encode(), salt, i)
+            ck = hm.new(salted, b"Client Key", hashlib.sha256).digest()
+            sig = hm.new(hashlib.sha256(ck).digest(), auth_msg,
+                         hashlib.sha256).digest()
+            proof = bytes(a ^ b for a, b in zip(ck, sig))
+            if b64.b64decode(fields["p"]) != proof:
+                self._send_json({"success": False, "error": "bad proof"})
+                return
+            sk = hm.new(salted, b"Server Key", hashlib.sha256).digest()
+            ssig = hm.new(sk, auth_msg, hashlib.sha256).digest()
+            self._send_json({"success": True, "authentication":
+                             f"v={b64.b64encode(ssig).decode()}"})
+        except (ConnectionError, OSError, AssertionError):
+            return
+        while True:
+            try:
+                token, ln = struct.unpack(
+                    "<QI", _recv_exact(self.request, 12))
+                q = js.loads(_recv_exact(self.request, ln))
+            except (ConnectionError, OSError):
+                return
+            with st.lock:
+                try:
+                    r = self._eval(rq, st, q[1])
+                    resp = {"t": rq.SUCCESS_ATOM, "r": [r]}
+                except Exception as e:  # noqa: BLE001
+                    resp = {"t": rq.RUNTIME_ERROR, "r": [str(e)]}
+            out = js.dumps(resp).encode()
+            self.request.sendall(struct.pack("<QI", token, len(out)) + out)
+
+    def _send_json(self, obj):
+        import json as js
+        self.request.sendall(js.dumps(obj).encode() + b"\0")
+
+    def _read_nul(self) -> bytes:
+        out = b""
+        while not out.endswith(b"\0"):
+            c = self.request.recv(1)
+            if not c:
+                raise ConnectionError("closed")
+            out += c
+        return out[:-1]
+
+    # -- tiny ReQL evaluator ----------------------------------------------
+
+    def _eval(self, rq, st, term, scope=None):
+        scope = scope or {}
+        if not isinstance(term, list):
+            if isinstance(term, dict):
+                return {k: self._eval(rq, st, v, scope)
+                        for k, v in term.items()}
+            return term
+        tt, args = term[0], term[1] if len(term) > 1 else []
+        opt = term[2] if len(term) > 2 else {}
+        if tt == rq.DB:
+            return ("db", args[0])
+        if tt == rq.DB_CREATE:
+            st.dbs.setdefault(args[0], {})
+            return {"dbs_created": 1}
+        if tt == rq.TABLE_CREATE:
+            _, dbname = self._eval(rq, st, args[0], scope)
+            st.dbs.setdefault(dbname, {}).setdefault(args[1], {})
+            return {"tables_created": 1}
+        if tt == rq.TABLE:
+            _, dbname = self._eval(rq, st, args[0], scope)
+            return ("table", dbname, args[1])
+        if tt == rq.GET:
+            _, dbname, tname = self._eval(rq, st, args[0], scope)
+            key = self._eval(rq, st, args[1], scope)
+            return ("row", dbname, tname, key)
+        if tt == rq.GET_FIELD:
+            row = self._eval(rq, st, args[0], scope)
+            if isinstance(row, tuple) and row[0] == "row":
+                _, dbname, tname, key = row
+                doc = st.dbs.get(dbname, {}).get(tname, {}).get(key)
+                if doc is None:
+                    raise ValueError("No attribute on null row")
+                row = doc
+            field = self._eval(rq, st, args[1], scope)
+            if field not in row:
+                raise ValueError(f"No attribute `{field}`")
+            return row[field]
+        if tt == rq.DEFAULT:
+            try:
+                v = self._eval(rq, st, args[0], scope)
+                return v
+            except ValueError:
+                return self._eval(rq, st, args[1], scope)
+        if tt == rq.INSERT:
+            _, dbname, tname = self._eval(rq, st, args[0], scope)
+            doc = self._eval(rq, st, args[1], scope)
+            tbl = st.dbs.setdefault(dbname, {}).setdefault(tname, {})
+            key = doc["id"]
+            if key in tbl and opt.get("conflict") != "update":
+                return {"inserted": 0, "errors": 1,
+                        "first_error": "Duplicate primary key"}
+            existed = key in tbl
+            tbl.setdefault(key, {}).update(doc)
+            return ({"replaced": 1, "errors": 0} if existed
+                    else {"inserted": 1, "errors": 0})
+        if tt == rq.UPDATE:
+            row = self._eval(rq, st, args[0], scope)
+            _, dbname, tname, key = row
+            tbl = st.dbs.setdefault(dbname, {}).setdefault(tname, {})
+            doc = tbl.get(key)
+            if doc is None:
+                return {"skipped": 1, "replaced": 0, "errors": 0}
+            fn = args[1]
+            assert fn[0] == rq.FUNC
+            var_ids = fn[1][0][1]
+            body = fn[1][1]
+            patch = self._eval(rq, st, body,
+                               {**scope, var_ids[0]: dict(doc)})
+            changed = any(doc.get(k) != v for k, v in patch.items())
+            doc.update(patch)
+            return {"replaced": 1 if changed else 0,
+                    "unchanged": 0 if changed else 1, "errors": 0}
+        if tt == rq.VAR:
+            return scope[args[0]]
+        if tt == rq.EQ:
+            a = self._eval(rq, st, args[0], scope)
+            b = self._eval(rq, st, args[1], scope)
+            return a == b
+        if tt == rq.BRANCH:
+            cond = self._eval(rq, st, args[0], scope)
+            return self._eval(rq, st, args[1 if cond else 2], scope)
+        if tt == rq.ERROR:
+            raise ValueError(self._eval(rq, st, args[0], scope))
+        if tt == rq.MAKE_ARRAY:
+            return [self._eval(rq, st, a, scope) for a in args]
+        if tt == rq.STATUS:
+            return {"shards": [{"primary_replicas": ["n1"]}]}
+        if tt == rq.RECONFIGURE:
+            st.reconfigures.append(opt)
+            return {"reconfigured": 1}
+        if tt == rq.WAIT:
+            return {"ready": 1}
+        raise ValueError(f"unhandled term {tt}")

@@ -153,6 +153,34 @@ class TestUniqueAndCounter:
         assert r["valid"] is False
         assert r["errors"][0]["bounds"] == [1, 1]
 
+    def test_counter_concurrent_add_may_be_missed(self):
+        # an add that completes during the read is concurrent: the read
+        # may observe pre-add state (checker.clj:737 envelope semantics)
+        h = History([
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(1, INVOKE, "read"),
+            mk(0, INVOKE, "add", 1), mk(0, OK, "add", 1),
+            mk(1, OK, "read", 1),
+        ])
+        assert CounterChecker().check(T, h)["valid"] is True
+
+    def test_counter_concurrent_negative_add_both_ways(self):
+        # missed negative add concurrent with the read
+        h = History([
+            mk(0, INVOKE, "add", -5),
+            mk(1, INVOKE, "read"),
+            mk(0, OK, "add", -5),
+            mk(1, OK, "read", 0),
+        ])
+        assert CounterChecker().check(T, h)["valid"] is True
+        # observed negative add invoked during the read
+        h2 = History([
+            mk(1, INVOKE, "read"),
+            mk(0, INVOKE, "add", -5), mk(0, OK, "add", -5),
+            mk(1, OK, "read", -5),
+        ])
+        assert CounterChecker().check(T, h2)["valid"] is True
+
 
 class TestLinearizableFacade:
     H_GOOD = History([

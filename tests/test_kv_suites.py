@@ -141,3 +141,240 @@ class TestAerospikeSuite:
                                 value=["n1", "n2", "n3"]))
         assert set(res2.value.values()) == {"started"}
         control.teardown_sessions(t)
+
+
+# --------------------------------------------------------------------------
+# RethinkDB
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def rethink_port():
+    from tests.fakes import FakeRethinkHandler, RethinkState
+    srv, port = start_server(FakeRethinkHandler, RethinkState())
+    srv.state_ref = srv.state
+    yield port, srv.state
+    srv.shutdown()
+
+
+class TestRethinkWire:
+    def test_protocol_and_cas(self, rethink_port):
+        port, _ = rethink_port
+        from jepsen_tpu.clients import rethinkdb as rq
+        c = rq.RethinkClient("127.0.0.1", port)
+        c.run(rq.db_create("jepsen"))
+        c.run(rq.table_create("jepsen", "cas"))
+        tbl = rq.table("jepsen", "cas")
+        c.run(rq.insert(tbl, {"id": 1, "val": 3}, conflict="update"))
+        row = rq.get(rq.table("jepsen", "cas", read_mode="majority"), 1)
+        assert c.run(rq.get_field(row, "val")) == 3
+        res = c.run(rq.update_cas(row, "val", 3, 4))
+        assert res["replaced"] == 1
+        assert c.run(rq.get_field(row, "val")) == 4
+        with pytest.raises(rq.ReqlError, match="abort"):
+            c.run(rq.update_cas(row, "val", 3, 5))
+        missing = rq.get(tbl, 99)
+        assert c.run(rq.get_field(missing, "val")) is None
+        c.close()
+
+    def test_document_cas_workload_valid(self, rethink_port):
+        port, _ = rethink_port
+        from suites.rethinkdb.client import DocumentCasClient
+        from suites.rethinkdb.runner import cas_workload
+        DocumentCasClient._table_made = False
+        wl = cas_workload({"keys": 2, "ops_per_key": 40,
+                           "algorithm": "cpu"})
+        run_wire_test(wl, "rethinkdb-cas", port)
+
+    def test_reconfigure_nemesis(self, rethink_port):
+        port, state = rethink_port
+        from jepsen_tpu.history import Op
+        from suites.rethinkdb.runner import ReconfigureNemesis
+        t = {"nodes": ["127.0.0.1"], "db_port": port}
+        nem = ReconfigureNemesis().setup(t)
+        res = nem.invoke(t, Op(type="info", f="reconfigure",
+                               process="nemesis"))
+        assert res.value["primary"] == "127.0.0.1"
+        assert state.reconfigures and \
+            state.reconfigures[0]["shards"] == 1
+
+
+class TestRethinkSuite:
+    def test_construction_and_matrix(self):
+        from suites.rethinkdb import runner
+        t = runner.rethinkdb_test({"nodes": ["n1"],
+                                   "workload": "document-cas",
+                                   "nemesis": "reconfigure"})
+        assert t["name"] == "rethinkdb-document-cas-reconfigure"
+        ts = runner.all_tests({"nodes": ["n1"], "nemeses": ["none"],
+                               "modes": [("majority", "majority"),
+                                         ("single", "majority")]})
+        assert len(ts) == 2
+
+    def test_db_config(self):
+        from suites.rethinkdb.db import config
+        c = config({"nodes": ["n1", "n2"]}, "n2")
+        assert "join=n1:29015" in c and "join=n2:29015" in c
+        assert "server-tag=n2" in c
+
+
+# --------------------------------------------------------------------------
+# Ignite
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def ignite_port():
+    from tests.fakes import FakeIgniteHandler, IgniteState
+    srv, port = start_server(FakeIgniteHandler, IgniteState())
+    yield port
+    srv.shutdown()
+
+
+class TestIgniteWire:
+    def test_cache_ops_and_tx(self, ignite_port):
+        from jepsen_tpu.clients.ignite import IgniteClient
+        c = IgniteClient("127.0.0.1", ignite_port)
+        c.get_or_create_cache("REGISTER")
+        assert c.get("REGISTER", "k") is None
+        c.put("REGISTER", "k", 3)
+        assert c.get("REGISTER", "k") == 3
+        assert c.replace_if_equals("REGISTER", "k", 3, 4) is True
+        assert c.replace_if_equals("REGISTER", "k", 3, 5) is False
+        assert c.get("REGISTER", "k") == 4
+        # transactions: rollback leaves state untouched
+        c.tx_start()
+        c.put("REGISTER", "k", 9)
+        assert c.get("REGISTER", "k") == 9
+        c.tx_end(commit=False)
+        assert c.get("REGISTER", "k") == 4
+        c.tx_start()
+        c.put_all("REGISTER", {"a": 1, "b": 2})
+        c.tx_end(commit=True)
+        assert c.get_all("REGISTER", ["a", "b", "zz"]) == {"a": 1, "b": 2}
+        c.close()
+
+    def test_register_workload_valid(self, ignite_port):
+        from suites.ignite.runner import register_workload
+        wl = register_workload({"keys": 2, "ops_per_key": 40,
+                                "algorithm": "cpu"})
+        run_wire_test(wl, "ignite-register", ignite_port)
+
+    def test_bank_workload_valid(self, ignite_port):
+        from suites.ignite.runner import bank_workload
+        wl = bank_workload({})
+        run_wire_test(wl, "ignite-bank", ignite_port, time_limit=2.0,
+                      bank={"accounts": list(range(10)),
+                            "total_amount": 100})
+
+
+class TestIgniteSuite:
+    def test_cache_id_java_hashcode(self):
+        from jepsen_tpu.clients.ignite import cache_id
+        assert cache_id("REGISTER") == 92413603  # Java "REGISTER".hashCode()
+        assert cache_id("") == 0
+
+    def test_construction(self):
+        from suites.ignite import runner
+        t = runner.ignite_test({"nodes": ["n1"], "workload": "bank",
+                                "nemesis": "kill"})
+        assert t["name"] == "ignite-bank-kill"
+        assert t["bank"]["total_amount"] == 100
+
+    def test_db_config_lists_nodes(self):
+        from suites.ignite.db import config
+        c = config({"nodes": ["n1", "n2"]})
+        assert "n1:47500..47502" in c and "n2:47500..47502" in c
+        assert "persistenceEnabled" not in c
+        assert "persistenceEnabled" in config({"nodes": ["n1"],
+                                               "pds": True})
+
+
+# --------------------------------------------------------------------------
+# LogCabin
+# --------------------------------------------------------------------------
+
+FAKE_TREEOPS = r'''#!/usr/bin/env python3
+import fcntl, json, sys, os
+STATE = os.environ.get("TREEOPS_STATE", "/tmp/treeops-state.json")
+args = sys.argv[1:]
+cond = None
+mode = None
+path = None
+i = 0
+while i < len(args):
+    a = args[i]
+    if a == "-c": i += 2; continue
+    if a == "-q": i += 1; continue
+    if a == "-t": i += 2; continue
+    if a == "-p": cond = args[i+1]; i += 2; continue
+    if a in ("read", "write"): mode = a; path = args[i+1]; i += 2; continue
+    i += 1
+with open(STATE + ".lock", "w") as lk:
+    fcntl.flock(lk, fcntl.LOCK_EX)
+    try:
+        with open(STATE) as f:
+            tree = json.load(f)
+    except (IOError, ValueError):
+        tree = {}
+    if mode == "read":
+        sys.stdout.write(tree.get(path, ""))
+        sys.exit(0)
+    value = sys.stdin.read()
+    if cond is not None:
+        cpath, _, cval = cond.partition(":")
+        cur = tree.get(cpath, "")
+        if cur != cval:
+            sys.stderr.write(
+                "Exiting due to LogCabin::Client::Exception: Path '%s' "
+                "has value '%s', not '%s' as required\n"
+                % (cpath, cur, cval))
+            sys.exit(1)
+    tree[path] = value
+    with open(STATE, "w") as f:
+        json.dump(tree, f)
+'''
+
+
+@pytest.fixture()
+def treeops(tmp_path, monkeypatch):
+    bin_path = tmp_path / "TreeOps"
+    bin_path.write_text(FAKE_TREEOPS)
+    bin_path.chmod(0o755)
+    monkeypatch.setenv("TREEOPS_STATE", str(tmp_path / "state.json"))
+    return str(bin_path)
+
+
+class TestLogCabinSuite:
+    def test_register_workload_valid(self, treeops):
+        from suites.logcabin.runner import register_workload
+        wl = register_workload({"ops": 120, "algorithm": "cpu"})
+        parts = [gen.time_limit(3.0, gen.clients(wl["generator"]))]
+        test = {"name": "logcabin-register", "nodes": ["127.0.0.1"],
+                "remote": control.DummyRemote(),  # local exec
+                "treeops_bin": treeops,
+                "concurrency": 3,
+                "client": wl["client"],
+                "generator": parts,
+                "checker": wl["checker"]}
+        done = core.run(test)
+        assert done["results"]["valid"] is True, done["results"]
+
+    def test_db_control_commands(self):
+        from suites.logcabin.db import LogCabinDB
+        t = {"nodes": ["n1", "n2"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        db = LogCabinDB()
+        db.setup(t, "n1")
+        db.kill(t, "n1")
+        log = "\n".join(t["remote"].log)
+        assert "--bootstrap" in log
+        assert "Reconfigure -c n1:5254,n2:5254 set" in log
+        assert "pkill -KILL -f LogCabin" in log
+        control.teardown_sessions(t)
+
+    def test_construction(self):
+        from suites.logcabin import runner
+        t = runner.logcabin_test({"nodes": ["n1"],
+                                  "workload": "cas-register",
+                                  "nemesis": "partition"})
+        assert t["name"] == "logcabin-cas-register-partition"
