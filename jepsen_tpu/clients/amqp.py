@@ -1,0 +1,231 @@
+"""Minimal AMQP 0-9-1 client (RabbitMQ).
+
+Parity: the reference drives RabbitMQ through langohr
+(rabbitmq/src/jepsen/rabbitmq.clj:127-175: queue declare/purge, publish
+with publisher confirms, basic.get with auto-ack, basic.reject).  This is
+an independent implementation of the public AMQP 0-9-1 framing: AMQP\\0\\0\\9\\1
+preamble, method/header/body frames terminated by 0xCE, PLAIN auth.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+FRAME_END = 0xCE
+
+# class ids
+CONNECTION = 10
+CHANNEL = 20
+QUEUE = 50
+BASIC = 60
+CONFIRM = 85
+
+# (class, method) ids
+CONN_START = (10, 10)
+CONN_START_OK = (10, 11)
+CONN_TUNE = (10, 30)
+CONN_TUNE_OK = (10, 31)
+CONN_OPEN = (10, 40)
+CONN_OPEN_OK = (10, 41)
+CONN_CLOSE = (10, 50)
+CONN_CLOSE_OK = (10, 51)
+CH_OPEN = (20, 10)
+CH_OPEN_OK = (20, 11)
+CH_CLOSE = (20, 40)
+CH_CLOSE_OK = (20, 41)
+Q_DECLARE = (50, 10)
+Q_DECLARE_OK = (50, 11)
+Q_PURGE = (50, 30)
+Q_PURGE_OK = (50, 31)
+B_PUBLISH = (60, 40)
+B_GET = (60, 70)
+B_GET_OK = (60, 71)
+B_GET_EMPTY = (60, 72)
+B_ACK = (60, 80)
+B_REJECT = (60, 90)
+B_NACK = (60, 120)
+CONFIRM_SELECT = (85, 10)
+CONFIRM_SELECT_OK = (85, 11)
+
+
+class AmqpError(Exception):
+    pass
+
+
+def _short_str(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _long_str(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def _read_short_str(buf: bytes, off: int) -> Tuple[str, int]:
+    n = buf[off]
+    return buf[off + 1:off + 1 + n].decode(), off + 1 + n
+
+
+class AmqpClient:
+    """One connection, one channel — enough for the queue/semaphore
+    workloads."""
+
+    def __init__(self, node: str, port: int = 5672, user: str = "guest",
+                 password: str = "guest", vhost: str = "/",
+                 timeout: float = 10.0):
+        self.sock = socket.create_connection((node, port), timeout=timeout)
+        self.confirming = False
+        self.publish_seq = 0
+        self._open(user, password, vhost)
+
+    # -- framing -----------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            if not c:
+                raise ConnectionError("amqp connection closed")
+            buf += c
+        return buf
+
+    def _send_frame(self, ftype: int, channel: int, payload: bytes) -> None:
+        self.sock.sendall(struct.pack(">BHI", ftype, channel, len(payload))
+                          + payload + bytes([FRAME_END]))
+
+    def _recv_frame(self) -> Tuple[int, int, bytes]:
+        ftype, channel, size = struct.unpack(">BHI", self._recv_exact(7))
+        payload = self._recv_exact(size)
+        if self._recv_exact(1)[0] != FRAME_END:
+            raise AmqpError("bad frame end")
+        return ftype, channel, payload
+
+    def _send_method(self, channel: int, cm: Tuple[int, int],
+                     args: bytes = b"") -> None:
+        self._send_frame(FRAME_METHOD, channel,
+                         struct.pack(">HH", *cm) + args)
+
+    def _recv_method(self, expect=None) -> Tuple[Tuple[int, int], bytes]:
+        while True:
+            ftype, _ch, payload = self._recv_frame()
+            if ftype == FRAME_HEARTBEAT:
+                continue
+            if ftype != FRAME_METHOD:
+                raise AmqpError(f"unexpected frame type {ftype}")
+            cm = struct.unpack(">HH", payload[:4])
+            if cm == CONN_CLOSE or cm == CH_CLOSE:
+                code = struct.unpack(">H", payload[4:6])[0]
+                text, _ = _read_short_str(payload, 6)
+                raise AmqpError(f"closed by server ({code}): {text}")
+            if expect is not None and cm not in expect:
+                raise AmqpError(f"expected {expect}, got {cm}")
+            return cm, payload[4:]
+
+    # -- connection lifecycle ---------------------------------------------
+
+    def _open(self, user: str, password: str, vhost: str) -> None:
+        self.sock.sendall(b"AMQP\x00\x00\x09\x01")
+        self._recv_method(expect=[CONN_START])
+        plain = f"\0{user}\0{password}".encode()
+        self._send_method(0, CONN_START_OK,
+                          struct.pack(">I", 0)  # empty client-properties
+                          + _short_str("PLAIN") + _long_str(plain)
+                          + _short_str("en_US"))
+        _, args = self._recv_method(expect=[CONN_TUNE])
+        channel_max, frame_max, _hb = struct.unpack(">HIH", args)
+        self._send_method(0, CONN_TUNE_OK,
+                          struct.pack(">HIH", channel_max or 1,
+                                      frame_max or 131072, 0))
+        self._send_method(0, CONN_OPEN, _short_str(vhost) + b"\x00\x00")
+        self._recv_method(expect=[CONN_OPEN_OK])
+        self._send_method(1, CH_OPEN, b"\x00")
+        self._recv_method(expect=[CH_OPEN_OK])
+
+    def close(self) -> None:
+        try:
+            self._send_method(0, CONN_CLOSE,
+                              struct.pack(">H", 200) + _short_str("bye")
+                              + struct.pack(">HH", 0, 0))
+            self._recv_method(expect=[CONN_CLOSE_OK])
+        except (OSError, AmqpError, ConnectionError):
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- queue operations --------------------------------------------------
+
+    def queue_declare(self, queue: str, durable: bool = True) -> None:
+        flags = 0b10 if durable else 0  # bit1 durable
+        self._send_method(
+            1, Q_DECLARE,
+            struct.pack(">H", 0) + _short_str(queue)
+            + bytes([flags]) + struct.pack(">I", 0))
+        self._recv_method(expect=[Q_DECLARE_OK])
+
+    def queue_purge(self, queue: str) -> int:
+        self._send_method(1, Q_PURGE,
+                          struct.pack(">H", 0) + _short_str(queue)
+                          + b"\x00")
+        _, args = self._recv_method(expect=[Q_PURGE_OK])
+        return struct.unpack(">I", args[:4])[0]
+
+    def confirm_select(self) -> None:
+        self._send_method(1, CONFIRM_SELECT, b"\x00")
+        self._recv_method(expect=[CONFIRM_SELECT_OK])
+        self.confirming = True
+        self.publish_seq = 0
+
+    def publish(self, queue: str, body: bytes,
+                wait_confirm: bool = True) -> bool:
+        """Publish to the default exchange; with confirms on, block for the
+        broker ack (rabbitmq.clj:152-166)."""
+        self._send_method(1, B_PUBLISH,
+                          struct.pack(">H", 0) + _short_str("")
+                          + _short_str(queue) + bytes([0b01]))  # mandatory
+        # content header: delivery-mode=2 (persistent)
+        props = struct.pack(">H", 0x1000) + bytes([2])
+        self._send_frame(FRAME_HEADER, 1,
+                         struct.pack(">HHQ", BASIC, 0, len(body)) + props)
+        if body:
+            self._send_frame(FRAME_BODY, 1, body)
+        if not (self.confirming and wait_confirm):
+            return True
+        self.publish_seq += 1
+        cm, args = self._recv_method(expect=[B_ACK, B_NACK])
+        tag, _flags = struct.unpack(">QB", args[:9])
+        return cm == B_ACK
+
+    def get(self, queue: str, no_ack: bool = True):
+        """basic.get → (delivery_tag, body) or None when empty."""
+        self._send_method(1, B_GET,
+                          struct.pack(">H", 0) + _short_str(queue)
+                          + bytes([1 if no_ack else 0]))
+        cm, args = self._recv_method(expect=[B_GET_OK, B_GET_EMPTY])
+        if cm == B_GET_EMPTY:
+            return None
+        (tag,) = struct.unpack(">Q", args[:8])
+        # header frame then body frames
+        ftype, _ch, payload = self._recv_frame()
+        if ftype != FRAME_HEADER:
+            raise AmqpError("expected content header")
+        (body_size,) = struct.unpack(">Q", payload[4:12])
+        body = b""
+        while len(body) < body_size:
+            ftype, _ch, chunk = self._recv_frame()
+            if ftype != FRAME_BODY:
+                raise AmqpError("expected content body")
+            body += chunk
+        return tag, body
+
+    def reject(self, delivery_tag: int, requeue: bool = True) -> None:
+        self._send_method(1, B_REJECT,
+                          struct.pack(">QB", delivery_tag,
+                                      1 if requeue else 0))

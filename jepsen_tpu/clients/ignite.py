@@ -39,7 +39,11 @@ TYPE_BOOL = 8
 TYPE_STRING = 9
 TYPE_NULL = 101
 
-VER = (1, 5, 0)
+VER = (1, 6, 0)  # TX ops need 1.6 (Ignite 2.8+)
+
+# response header flags (protocol >= 1.4)
+RFLAG_ERROR = 0x01
+RFLAG_TOPOLOGY_CHANGED = 0x02
 
 
 class IgniteError(Exception):
@@ -122,17 +126,25 @@ class IgniteClient:
         return self._recv_exact(n)
 
     def _call(self, opcode: int, payload: bytes) -> bytes:
+        """Response framing per protocol >= 1.4: [req id i64][flags i16];
+        a topology-changed flag is followed by the affinity version
+        (i64+i32), an error flag by [status i32][message]."""
         rid = next(self.req_ids)
         body = struct.pack("<hq", opcode, rid) + payload
         self.sock.sendall(struct.pack("<i", len(body)) + body)
         resp = self._recv_frame()
-        r_rid, status = struct.unpack_from("<qi", resp)
+        (r_rid,) = struct.unpack_from("<q", resp)
         if r_rid != rid:
             raise IgniteError(f"request id mismatch {r_rid} != {rid}")
-        if status != 0:
-            msg, _ = dec(resp, 12)
+        (flags,) = struct.unpack_from("<h", resp, 8)
+        off = 10
+        if flags & RFLAG_TOPOLOGY_CHANGED:
+            off += 12  # affinity topology version: i64 + i32
+        if flags & RFLAG_ERROR:
+            (status,) = struct.unpack_from("<i", resp, off)
+            msg, _ = dec(resp, off + 4)
             raise IgniteError(f"status {status}: {msg}")
-        return resp[12:]
+        return resp[off:]
 
     def _cache_header(self, cache: str) -> bytes:
         if self.tx_id is not None:

@@ -18,3 +18,7 @@ from jepsen_tpu.models.register import (  # noqa: F401
 from jepsen_tpu.models.collections import (  # noqa: F401
     FIFOQueue, MultiRegister, Mutex, SetModel, UnorderedQueue,
 )
+from jepsen_tpu.models.locks import (  # noqa: F401
+    AcquiredPermits, FencedMutex, OwnerAwareMutex, ReentrantFencedMutex,
+    ReentrantMutex,
+)

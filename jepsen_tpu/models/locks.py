@@ -1,0 +1,200 @@
+"""Distributed-lock model family (host tier).
+
+Parity: the hazelcast suite's checker models
+(hazelcast/src/jepsen/hazelcast.clj:511-651): reentrant, owner-aware,
+fenced, and reentrant-fenced mutexes plus the multi-permit semaphore.
+Op values are dicts {"client": name, "fence": int} (the reference routes
+client UUIDs through a uid->name map; here clients stamp their name into
+the op value directly).  Fence 0 is "no fence observed"
+(hazelcast.clj:55 invalid-fence).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.models.base import Model, inconsistent, register_model
+
+INVALID_FENCE = 0
+REENTRANT_ACQUIRE_CAP = 2  # hazelcast.clj:53
+NUM_PERMITS = 2            # hazelcast.clj:54
+
+
+def op_client(op: Op) -> Optional[str]:
+    v = op.value
+    if isinstance(v, dict):
+        return v.get("client")
+    return v if isinstance(v, str) else None
+
+
+def op_fence(op: Op) -> int:
+    v = op.value
+    if isinstance(v, dict):
+        return v.get("fence") or INVALID_FENCE
+    return INVALID_FENCE
+
+
+@dataclass(frozen=True)
+class OwnerAwareMutex(Model):
+    """Non-reentrant mutex that knows who holds it
+    (hazelcast.clj:538-559)."""
+
+    owner: Optional[str] = None
+
+    def step(self, op: Op):
+        client = op_client(op)
+        if client is None:
+            return inconsistent("no owner!")
+        if op.f == "acquire":
+            if self.owner is None:
+                return OwnerAwareMutex(client)
+            return inconsistent(f"{client} cannot acquire: {self}")
+        if op.f == "release":
+            if self.owner is None or self.owner != client:
+                return inconsistent(f"{client} cannot release: {self}")
+            return OwnerAwareMutex(None)
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclass(frozen=True)
+class ReentrantMutex(Model):
+    """Mutex re-acquirable up to a cap by its owner
+    (hazelcast.clj:515-535)."""
+
+    owner: Optional[str] = None
+    lock_count: int = 0
+
+    def step(self, op: Op):
+        client = op_client(op)
+        if client is None:
+            return inconsistent("no owner!")
+        if op.f == "acquire":
+            if self.lock_count < REENTRANT_ACQUIRE_CAP and \
+                    (self.owner is None or self.owner == client):
+                return ReentrantMutex(client, self.lock_count + 1)
+            return inconsistent(f"{client} cannot acquire: {self}")
+        if op.f == "release":
+            if self.owner is None or self.owner != client:
+                return inconsistent(f"{client} cannot release: {self}")
+            return ReentrantMutex(None if self.lock_count == 1
+                                  else self.owner, self.lock_count - 1)
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclass(frozen=True)
+class FencedMutex(Model):
+    """Mutex whose acquires carry monotonically-increasing fencing tokens
+    (hazelcast.clj:565-588)."""
+
+    owner: Optional[str] = None
+    lock_fence: int = INVALID_FENCE
+
+    def step(self, op: Op):
+        client = op_client(op)
+        fence = op_fence(op)
+        if client is None:
+            return inconsistent("no owner!")
+        if op.f == "acquire":
+            if self.owner is not None:
+                return inconsistent(f"{client} cannot acquire: {self}")
+            if fence == INVALID_FENCE:
+                return FencedMutex(client, self.lock_fence)
+            if fence > self.lock_fence:
+                return FencedMutex(client, fence)
+            return inconsistent(
+                f"{client} fence {fence} not above {self.lock_fence}")
+        if op.f == "release":
+            if self.owner is None or self.owner != client:
+                return inconsistent(f"{client} cannot release: {self}")
+            return FencedMutex(None, self.lock_fence)
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclass(frozen=True)
+class ReentrantFencedMutex(Model):
+    """Reentrant fenced mutex tracking the highest observed fence
+    (hazelcast.clj:590-628)."""
+
+    owner: Optional[str] = None
+    lock_count: int = 0
+    current_fence: int = INVALID_FENCE
+    highest_fence: int = INVALID_FENCE
+
+    def step(self, op: Op):
+        client = op_client(op)
+        fence = op_fence(op)
+        if client is None:
+            return inconsistent("no owner!")
+        if op.f == "acquire":
+            if self.owner is None:
+                if fence == INVALID_FENCE or fence > self.highest_fence:
+                    return ReentrantFencedMutex(
+                        client, 1, fence, max(fence, self.highest_fence))
+                return inconsistent(
+                    f"{client} fence {fence} not above "
+                    f"{self.highest_fence}")
+            if self.owner != client or \
+                    self.lock_count == REENTRANT_ACQUIRE_CAP:
+                return inconsistent(f"{client} cannot acquire: {self}")
+            if self.current_fence == INVALID_FENCE:
+                if fence == INVALID_FENCE or fence > self.highest_fence:
+                    return ReentrantFencedMutex(
+                        client, self.lock_count + 1, fence,
+                        max(fence, self.highest_fence))
+                return inconsistent(f"{client} cannot reacquire: {self}")
+            if fence == INVALID_FENCE or fence == self.current_fence:
+                return ReentrantFencedMutex(
+                    client, self.lock_count + 1, self.current_fence,
+                    self.highest_fence)
+            return inconsistent(f"{client} cannot reacquire: {self}")
+        if op.f == "release":
+            if self.owner is None or self.owner != client:
+                return inconsistent(f"{client} cannot release: {self}")
+            if self.lock_count == 1:
+                return ReentrantFencedMutex(None, 0, INVALID_FENCE,
+                                            self.highest_fence)
+            return ReentrantFencedMutex(self.owner, self.lock_count - 1,
+                                        self.current_fence,
+                                        self.highest_fence)
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclass(frozen=True)
+class AcquiredPermits(Model):
+    """Semaphore with a bounded permit pool, tracked per client
+    (hazelcast.clj:630-651)."""
+
+    acquired: Tuple[Tuple[str, int], ...] = ()
+    permits: int = NUM_PERMITS
+
+    def _get(self, client: str) -> int:
+        return dict(self.acquired).get(client, 0)
+
+    def _with(self, client: str, n: int) -> "AcquiredPermits":
+        d = dict(self.acquired)
+        d[client] = n
+        return AcquiredPermits(tuple(sorted(d.items())), self.permits)
+
+    def step(self, op: Op):
+        client = op_client(op)
+        if client is None:
+            return inconsistent("no owner!")
+        total = sum(dict(self.acquired).values())
+        if op.f == "acquire":
+            if total < self.permits:
+                return self._with(client, self._get(client) + 1)
+            return inconsistent(f"{client} cannot acquire: {self}")
+        if op.f == "release":
+            if self._get(client) > 0:
+                return self._with(client, self._get(client) - 1)
+            return inconsistent(f"{client} cannot release: {self}")
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+register_model("owner-aware-mutex")(lambda: OwnerAwareMutex())
+register_model("reentrant-mutex")(lambda: ReentrantMutex())
+register_model("fenced-mutex")(lambda: FencedMutex())
+register_model("reentrant-fenced-mutex")(lambda: ReentrantFencedMutex())
+register_model("acquired-permits")(lambda: AcquiredPermits())

@@ -17,7 +17,8 @@ from jepsen_tpu import generator as gen
 from jepsen_tpu import nemesis as jnemesis
 from jepsen_tpu.nemesis import Nemesis
 from jepsen_tpu.nemesis.faults import KillNemesis, PauseNemesis
-from jepsen_tpu.nemesis.partition import PacketNemesis, Partitioner
+from jepsen_tpu.nemesis.partition import (PacketNemesis, Partitioner,
+                                          random_halves_grudge)
 from jepsen_tpu.nemesis.time import ClockNemesis, clock_gen
 from jepsen_tpu import net as jnet
 
@@ -78,9 +79,7 @@ def partition_package(opts: Optional[Dict] = None) -> Package:
     def random_grudge(nodes):
         kind = random.choice(["halves", "one", "majorities-ring"])
         if kind == "halves":
-            ns = list(nodes)
-            random.shuffle(ns)
-            return jnet.complete_grudge(jnet.bisect(ns))
+            return random_halves_grudge(nodes)
         if kind == "one":
             return jnet.complete_grudge(
                 jnet.split_one(random.choice(list(nodes)), nodes))

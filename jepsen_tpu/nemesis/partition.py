@@ -65,12 +65,16 @@ def partition_halves() -> Nemesis:
         jnet.bisect(nodes)))
 
 
+def random_halves_grudge(nodes):
+    """Shuffled bisection grudge — the canonical random-halves partition
+    (nemesis.clj:198's shuffle + bisect)."""
+    ns = list(nodes)
+    random.shuffle(ns)
+    return jnet.complete_grudge(jnet.bisect(ns))
+
+
 def partition_random_halves() -> Nemesis:
-    def grudge(nodes):
-        ns = list(nodes)
-        random.shuffle(ns)
-        return jnet.complete_grudge(jnet.bisect(ns))
-    return Partitioner(grudge)
+    return Partitioner(random_halves_grudge)
 
 
 def partition_random_node() -> Nemesis:

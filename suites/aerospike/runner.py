@@ -20,9 +20,8 @@ from jepsen_tpu import generator as gen
 from jepsen_tpu import independent, nemesis as jnem
 from jepsen_tpu.checker.core import CounterChecker, SetChecker
 from jepsen_tpu.control import util as cu
-from jepsen_tpu import net as jnet
 from jepsen_tpu.nemesis import combined
-from jepsen_tpu.nemesis.partition import Partitioner
+from jepsen_tpu.nemesis.partition import Partitioner, random_halves_grudge
 from jepsen_tpu.nemesis.time import ClockNemesis, clock_gen
 from jepsen_tpu.workloads import linearizable_register
 
@@ -110,13 +109,7 @@ def full_package(opts: Dict[str, Any]) -> combined.Package:
     max_dead = int(opts.get("max_dead_nodes", 2))
     signal = "TERM" if opts.get("clean_kill") else "KILL"
     killer = KillNemesis(signal=signal, max_dead=max_dead)
-
-    def halves(nodes):
-        ns = list(nodes)
-        random.shuffle(ns)
-        return jnet.complete_grudge(jnet.bisect(ns))
-
-    part = Partitioner(halves, start_f="partition-start",
+    part = Partitioner(random_halves_grudge, start_f="partition-start",
                        stop_f="partition-stop")
     members = [killer, part, ClockNemesis()]
     nem = jnem.Compose(members, [set(killer.fs()),

@@ -1,0 +1,3 @@
+"""Hazelcast suite (reference: hazelcast/ — CP-subsystem locks,
+semaphores, atomics, CRDT maps, and queues; the richest lock-model
+family in the reference)."""

@@ -1,0 +1,295 @@
+"""Hazelcast workload clients over the node-side HTTP bridge.
+
+Parity: hazelcast/src/jepsen/hazelcast.clj's client zoo — map/crdt-map
+CAS-loop sets (453-493), CP locks plain and fenced (334-448), CP
+semaphore (373-410), atomic long/reference CAS registers (146-231),
+flake-id/atomic-long unique-id generators (146-264), and the queue client
+(266-317).  Lock/semaphore ops stamp the bridge connection's client UUID
+(and fence, when the lock is fenced) into op.value — the shape the lock
+model family keys on (jepsen_tpu/models/locks.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import urllib.error
+import urllib.request
+from typing import Any, Optional, Tuple
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+BRIDGE_PORT = 5801
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+class Bridge:
+    """One bridge session = one HazelcastInstance on its own thread
+    node-side, so lock/semaphore ownership is per harness client — the
+    same topology as the reference's one-instance-per-client
+    (hazelcast.clj:119-144)."""
+
+    def __init__(self, node: str, port: int, timeout: float = 35.0):
+        self.base = f"http://{node}:{port}"
+        self.timeout = timeout
+        self.session = None
+        _, payload = self.call("/connect")
+        self.session, self.uid = payload.split(",", 1)
+
+    def call(self, path: str, **params) -> Tuple[bool, str]:
+        """→ (ok?, payload); raises on transport errors and bridge
+        exceptions ("err:" responses)."""
+        if self.session is not None:
+            params["session"] = self.session
+        q = "&".join(f"{k}={v}" for k, v in params.items())
+        url = f"{self.base}{path}" + (f"?{q}" if q else "")
+        with urllib.request.urlopen(url, timeout=self.timeout) as r:
+            body = r.read().decode()
+        if body.startswith("ok:"):
+            return True, body[3:]
+        if body.startswith("fail:"):
+            return False, body[5:]
+        raise BridgeError(body)
+
+
+class BridgeError(Exception):
+    pass
+
+
+def connect(test, node) -> Bridge:
+    return Bridge(node, int(test.get("db_port", BRIDGE_PORT)))
+
+
+class _BridgeClient(jclient.Client):
+    def __init__(self, conn: Optional[Bridge] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return type(self)(connect(test, node))
+
+    def _fail_or_info(self, op: Op, e: Exception) -> Op:
+        if op.f == "read":
+            return op.with_(type=FAIL, error=str(e))
+        return op.with_(type=INFO, error=str(e))
+
+
+class MapSetClient(_BridgeClient):
+    """CAS-loop grow-only set in one map entry (hazelcast.clj:453-493)."""
+
+    def __init__(self, conn=None, crdt: bool = False):
+        super().__init__(conn)
+        self.crdt = crdt
+        self.name = "jepsen.crdt-map" if crdt else "jepsen.map"
+
+    def open(self, test, node):
+        return MapSetClient(connect(test, node), self.crdt)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                ok, why = self.conn.call("/map/add", name=self.name,
+                                         v=op.value)
+                return op.with_(type=OK if ok else FAIL,
+                                error=None if ok else why)
+            if op.f == "read":
+                ok, payload = self.conn.call("/map/read", name=self.name)
+                vals = [int(x) for x in payload.split(",") if x]
+                return op.with_(type=OK, value=vals)
+            raise ValueError(op.f)
+        except (BridgeError, *NET_ERRORS) as e:
+            return self._fail_or_info(op, e)
+
+
+class LockClient(_BridgeClient):
+    """Plain CP lock; op values carry the client uid
+    (hazelcast.clj:412-448)."""
+
+    def __init__(self, conn=None, name: str = "jepsen.lock"):
+        super().__init__(conn)
+        self.name = name
+
+    def open(self, test, node):
+        return LockClient(connect(test, node), self.name)
+
+    def invoke(self, test, op: Op) -> Op:
+        val = {"client": self.conn.uid}
+        try:
+            if op.f == "acquire":
+                ok, why = self.conn.call("/lock/acquire", name=self.name)
+                return op.with_(type=OK if ok else FAIL, value=val,
+                                error=None if ok else why)
+            if op.f == "release":
+                ok, why = self.conn.call("/lock/release", name=self.name)
+                return op.with_(type=OK if ok else FAIL, value=val,
+                                error=None if ok else why)
+            raise ValueError(op.f)
+        except BridgeError as e:
+            # IllegalMonitorState etc.: definite failures
+            return op.with_(type=FAIL, value=val, error=str(e))
+        except NET_ERRORS as e:
+            return op.with_(type=INFO, value=val, error=str(e))
+
+
+class FencedLockClient(_BridgeClient):
+    """CP fenced lock: acquires return fencing tokens
+    (hazelcast.clj:334-371)."""
+
+    def __init__(self, conn=None, name: str = "jepsen.cpLock1"):
+        super().__init__(conn)
+        self.name = name
+
+    def open(self, test, node):
+        return FencedLockClient(connect(test, node), self.name)
+
+    def invoke(self, test, op: Op) -> Op:
+        val = {"client": self.conn.uid}
+        try:
+            if op.f == "acquire":
+                ok, payload = self.conn.call("/fencedlock/acquire",
+                                             name=self.name)
+                if not ok:
+                    return op.with_(type=FAIL, value=val, error=payload)
+                return op.with_(type=OK,
+                                value={**val, "fence": int(payload)})
+            if op.f == "release":
+                ok, why = self.conn.call("/fencedlock/release",
+                                         name=self.name)
+                return op.with_(type=OK if ok else FAIL, value=val,
+                                error=None if ok else why)
+            raise ValueError(op.f)
+        except BridgeError as e:
+            return op.with_(type=FAIL, value=val, error=str(e))
+        except NET_ERRORS as e:
+            return op.with_(type=INFO, value=val, error=str(e))
+
+
+class SemaphoreClient(_BridgeClient):
+    """CP semaphore with 2 permits (hazelcast.clj:373-410)."""
+
+    NAME = "jepsen.semaphore"
+
+    def setup(self, test):
+        try:
+            self.conn.call("/sem/init", name=self.NAME, permits=2)
+        except (BridgeError, *NET_ERRORS):
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        val = {"client": self.conn.uid}
+        try:
+            ok, why = self.conn.call(f"/sem/{op.f}", name=self.NAME)
+            return op.with_(type=OK if ok else FAIL, value=val,
+                            error=None if ok else why)
+        except BridgeError as e:
+            return op.with_(type=FAIL, value=val, error=str(e))
+        except NET_ERRORS as e:
+            return op.with_(type=INFO, value=val, error=str(e))
+
+
+class CasLongClient(_BridgeClient):
+    """IAtomicLong as a CAS register (hazelcast.clj:190-209)."""
+
+    NAME = "jepsen.cas-long"
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                _, v = self.conn.call("/along/read", name=self.NAME)
+                return op.with_(type=OK, value=int(v))
+            if op.f == "write":
+                self.conn.call("/along/set", name=self.NAME, v=op.value)
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = op.value
+                ok, _ = self.conn.call("/along/cas", name=self.NAME,
+                                       old=old, new=new)
+                return op.with_(type=OK if ok else FAIL)
+            raise ValueError(op.f)
+        except (BridgeError, *NET_ERRORS) as e:
+            return self._fail_or_info(op, e)
+
+
+class CasReferenceClient(_BridgeClient):
+    """IAtomicReference as a CAS register over strings
+    (hazelcast.clj:211-231)."""
+
+    NAME = "jepsen.cas-ref"
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                _, v = self.conn.call("/aref/read", name=self.NAME)
+                return op.with_(type=OK, value=int(v) if v else None)
+            if op.f == "write":
+                _, cur = self.conn.call("/aref/read", name=self.NAME)
+                # write via cas loop on the reference (211-231 uses .set;
+                # a blind set is fine through the bridge)
+                ok, _ = self.conn.call("/aref/cas", name=self.NAME,
+                                       old=cur, new=op.value)
+                if not ok:
+                    return op.with_(type=INFO, error="write-race")
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = op.value
+                ok, _ = self.conn.call("/aref/cas", name=self.NAME,
+                                       old=old, new=new)
+                return op.with_(type=OK if ok else FAIL)
+            raise ValueError(op.f)
+        except (BridgeError, *NET_ERRORS) as e:
+            return self._fail_or_info(op, e)
+
+
+class IdGenClient(_BridgeClient):
+    """Unique-id generation via IAtomicLong or FlakeIdGenerator
+    (hazelcast.clj:146-264)."""
+
+    def __init__(self, conn=None, kind: str = "flake"):
+        super().__init__(conn)
+        self.kind = kind
+
+    def open(self, test, node):
+        return IdGenClient(connect(test, node), self.kind)
+
+    def invoke(self, test, op: Op) -> Op:
+        assert op.f == "generate"
+        try:
+            if self.kind == "flake":
+                _, v = self.conn.call("/idgen/next", name="jepsen.idgen")
+            else:
+                _, v = self.conn.call("/along/inc", name="jepsen.along-id")
+            return op.with_(type=OK, value=int(v))
+        except (BridgeError, *NET_ERRORS) as e:
+            return op.with_(type=INFO, error=str(e))
+
+
+class QueueClient(_BridgeClient):
+    """IQueue offer/poll + drain (hazelcast.clj:266-317)."""
+
+    NAME = "jepsen.queue"
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "enqueue":
+                ok, why = self.conn.call("/queue/offer", name=self.NAME,
+                                         v=op.value)
+                return op.with_(type=OK if ok else FAIL,
+                                error=None if ok else why)
+            if op.f == "dequeue":
+                ok, v = self.conn.call("/queue/poll", name=self.NAME)
+                if not ok:
+                    return op.with_(type=FAIL, error=v)
+                return op.with_(type=OK, value=int(v))
+            if op.f == "drain":
+                out = []
+                while True:
+                    ok, v = self.conn.call("/queue/poll", name=self.NAME,
+                                           timeout=100)
+                    if not ok:
+                        return op.with_(type=OK, value=out)
+                    out.append(int(v))
+            raise ValueError(op.f)
+        except (BridgeError, *NET_ERRORS) as e:
+            if op.f in ("dequeue", "drain"):
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

@@ -1,0 +1,126 @@
+"""Hazelcast server + bridge install.
+
+Parity: hazelcast/src/jepsen/hazelcast.clj:34-117 — the reference builds
+a custom server uberjar (build-server!) with the suite's Java merge
+policy, uploads it, and runs it with a per-node config.  Here: install
+the Hazelcast distribution, render hazelcast.xml (tcp-ip members, CP
+subsystem sized to the cluster, crdt-map with the suite's
+SetUnionMergePolicy), compile the suite's Java sources on-node against
+the distribution jars (the same strategy nemesis.time uses for its C
+helpers), and run server + HTTP bridge as daemons.
+"""
+
+from __future__ import annotations
+
+from os import path
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "5.3.6"
+URL = (f"https://repo1.maven.org/maven2/com/hazelcast/hazelcast-distribution/"
+       f"{VERSION}/hazelcast-distribution-{VERSION}.tar.gz")
+DIR = "/opt/hazelcast"
+CONF = f"{DIR}/config/jepsen.xml"
+LOGFILE = "/var/log/hazelcast.log"
+PIDFILE = "/var/run/hazelcast.pid"
+BRIDGE_LOG = "/var/log/hz-bridge.log"
+BRIDGE_PID = "/var/run/hz-bridge.pid"
+MEMBER_PORT = 5701
+BRIDGE_PORT = 5801
+
+RESOURCES = path.join(path.dirname(__file__), "resources")
+
+XML = """\
+<?xml version="1.0" encoding="UTF-8"?>
+<hazelcast xmlns="http://www.hazelcast.com/schema/config">
+  <cluster-name>jepsen</cluster-name>
+  <network>
+    <port auto-increment="false">{port}</port>
+    <join>
+      <multicast enabled="false"/>
+      <tcp-ip enabled="true">
+{members}
+      </tcp-ip>
+    </join>
+  </network>
+  <cp-subsystem>
+    <cp-member-count>{cp_members}</cp-member-count>
+  </cp-subsystem>
+  <map name="jepsen.crdt-map">
+    <merge-policy>jepsen.hazelcast_server.SetUnionMergePolicy\
+</merge-policy>
+  </map>
+  <lock name="jepsen.lock.no-quorum">
+    <quorum-ref>none</quorum-ref>
+  </lock>
+</hazelcast>
+"""
+
+
+def config(test) -> str:
+    members = "\n".join(f"        <member>{n}</member>"
+                        for n in test["nodes"])
+    return XML.format(port=MEMBER_PORT, members=members,
+                      cp_members=min(len(test["nodes"]), 7) if
+                      len(test["nodes"]) >= 3 else 0)
+
+
+class HazelcastDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("bash", "-c",
+               f"[ -d {DIR}/lib ] || "
+               f"cp -r {DIR}/hazelcast-{VERSION}/* {DIR}/ "
+               f"2>/dev/null || true")
+        cu.write_file(s, config(test), CONF)
+        # compile the suite's Java against the distribution jars
+        s.exec("mkdir", "-p", f"{DIR}/jepsen-classes")
+        s.upload([path.join(RESOURCES, "JepsenBridge.java"),
+                  path.join(RESOURCES, "SetUnionMergePolicy.java")],
+                 f"{DIR}/jepsen-classes/")
+        s.exec("bash", "-c",
+               f"cd {DIR}/jepsen-classes && mkdir -p jepsen/hazelcast_server"
+               f" && cp SetUnionMergePolicy.java jepsen/hazelcast_server/"
+               f" && javac -cp '{DIR}/lib/*' JepsenBridge.java "
+               f"jepsen/hazelcast_server/SetUnionMergePolicy.java")
+        self.start(test, node)
+        cu.await_tcp_port(s, MEMBER_PORT, timeout_s=180)
+        cu.await_tcp_port(s, BRIDGE_PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.stop_daemon(s, BRIDGE_PID)
+        cu.stop_daemon(s, PIDFILE)
+        s.exec("sh", "-c", f"rm -rf {LOGFILE} {BRIDGE_LOG} "
+                           f"{DIR}/cp-data || true")
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cp = f"{DIR}/lib/*:{DIR}/jepsen-classes"
+        cu.start_daemon(s, "java", "-cp", cp,
+                        f"-Dhazelcast.config={CONF}",
+                        "com.hazelcast.core.server.HazelcastMemberStarter",
+                        pidfile=PIDFILE, logfile=LOGFILE)
+        cu.start_daemon(s, "java", "-cp", cp, "JepsenBridge",
+                        f"{node}:{MEMBER_PORT}", str(BRIDGE_PORT),
+                        pidfile=BRIDGE_PID, logfile=BRIDGE_LOG)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "HazelcastMemberStarter")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "HazelcastMemberStarter",
+                  "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "HazelcastMemberStarter",
+                  "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE, BRIDGE_LOG]

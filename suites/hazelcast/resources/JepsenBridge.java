@@ -1,0 +1,329 @@
+// HTTP bridge exposing Hazelcast client operations to the test harness.
+//
+// Parity note: the reference suite drives Hazelcast through the official
+// Java client in-process (hazelcast/src/jepsen/hazelcast.clj:119-448) and
+// already ships suite-local Java (SetUnionMergePolicy).  This bridge is the
+// same idea taken one step further: the harness is not JVM-hosted, so each
+// db node runs this sidecar (compiled on-node against the distribution
+// jars, like the reference compiles its C helpers on-node) and the Python
+// client speaks plain HTTP to it.  One endpoint per operation the
+// reference's clients perform.
+//
+// Endpoints (all GET, query params; response "ok:<value>" or "fail:<why>"):
+//   /map/add?name=&v=        CAS-loop add of v into a sorted long-array set
+//   /map/read?name=          comma-separated sorted values
+//   /lock/acquire?name=&wait=  ILock/CP lock tryLock
+//   /lock/release?name=
+//   /fencedlock/acquire?name=  -> ok:<fence>
+//   /fencedlock/release?name=
+//   /sem/init?name=&permits=
+//   /sem/acquire?name=   /sem/release?name=
+//   /along/inc?name=     IAtomicLong incrementAndGet -> ok:<v>
+//   /along/read?name=    /along/cas?name=&old=&new=
+//   /aref/cas?name=&old=&new=   IAtomicReference (string payloads)
+//   /aref/read?name=
+//   /idgen/next?name=    FlakeIdGenerator newId -> ok:<v>
+//   /queue/offer?name=&v=   /queue/poll?name=&timeout=ms
+//   /uid                 client UUID (models key on it)
+
+import com.hazelcast.client.HazelcastClient;
+import com.hazelcast.client.config.ClientConfig;
+import com.hazelcast.core.HazelcastInstance;
+import com.hazelcast.cp.IAtomicLong;
+import com.hazelcast.cp.IAtomicReference;
+import com.hazelcast.cp.lock.FencedLock;
+import com.hazelcast.collection.IQueue;
+import com.hazelcast.cp.ISemaphore;
+import com.hazelcast.flakeidgen.FlakeIdGenerator;
+import com.hazelcast.map.IMap;
+import com.sun.net.httpserver.HttpExchange;
+import com.sun.net.httpserver.HttpServer;
+
+import java.io.IOException;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.util.Arrays;
+import java.util.HashMap;
+import java.util.Map;
+import java.util.concurrent.Callable;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.concurrent.ExecutorService;
+import java.util.concurrent.Executors;
+import java.util.concurrent.TimeUnit;
+
+public class JepsenBridge {
+    // Lock ownership in Hazelcast is per client instance + thread, so each
+    // harness client gets its own HazelcastInstance pinned to a dedicated
+    // thread (the reference gives every Jepsen client its own instance,
+    // hazelcast.clj:119-144).
+    static final class Session {
+        final HazelcastInstance hz;
+        final ExecutorService exec;
+        Session(HazelcastInstance hz) {
+            this.hz = hz;
+            this.exec = Executors.newSingleThreadExecutor();
+        }
+    }
+
+    static final Map<String, Session> sessions = new ConcurrentHashMap<>();
+    static String memberAddr;
+
+    public static void main(String[] args) throws Exception {
+        memberAddr = args[0];
+        int port = Integer.parseInt(args[1]);
+        HttpServer srv = HttpServer.create(new InetSocketAddress(port), 64);
+        srv.createContext("/", JepsenBridge::handle);
+        srv.setExecutor(Executors.newFixedThreadPool(32));
+        srv.start();
+        System.out.println("bridge listening on " + port);
+    }
+
+    static Session connectSession() {
+        ClientConfig config = new ClientConfig();
+        config.getNetworkConfig().addAddress(memberAddr);
+        config.getConnectionStrategyConfig().getConnectionRetryConfig()
+              .setClusterConnectTimeoutMillis(30000);
+        return new Session(HazelcastClient.newHazelcastClient(config));
+    }
+
+    static <T> T onSession(Session s, Callable<T> task) throws Exception {
+        return s.exec.submit(task).get(30, TimeUnit.SECONDS);
+    }
+
+    static Map<String, String> params(HttpExchange ex) {
+        Map<String, String> out = new HashMap<>();
+        String q = ex.getRequestURI().getRawQuery();
+        if (q == null) return out;
+        for (String kv : q.split("&")) {
+            int i = kv.indexOf('=');
+            if (i > 0) out.put(kv.substring(0, i), kv.substring(i + 1));
+        }
+        return out;
+    }
+
+    static void reply(HttpExchange ex, int code, String body)
+            throws IOException {
+        byte[] b = body.getBytes();
+        ex.sendResponseHeaders(code, b.length);
+        try (OutputStream os = ex.getResponseBody()) { os.write(b); }
+    }
+
+    static void handle(HttpExchange ex) throws IOException {
+        String path = ex.getRequestURI().getPath();
+        Map<String, String> p = params(ex);
+        String name = p.get("name");
+        try {
+            if (path.equals("/connect")) {
+                Session s = connectSession();
+                String sid = java.util.UUID.randomUUID().toString();
+                sessions.put(sid, s);
+                reply(ex, 200, "ok:" + sid + ","
+                      + s.hz.getLocalEndpoint().getUuid());
+                return;
+            }
+            final Session s = sessions.get(p.get("session"));
+            if (s == null) {
+                reply(ex, 400, "err:unknown session");
+                return;
+            }
+            switch (path) {
+                case "/map/add": {
+                    final long v = Long.parseLong(p.get("v"));
+                    final String mapName = name;
+                    boolean won = onSession(s, () -> {
+                        IMap<String, long[]> m = s.hz.getMap(mapName);
+                        long[] cur = m.get("hi");
+                        if (cur == null)
+                            return m.putIfAbsent("hi", new long[]{v}) == null;
+                        long[] next = Arrays.copyOf(cur, cur.length + 1);
+                        next[cur.length] = v;
+                        Arrays.sort(next);
+                        return m.replace("hi", cur, next);
+                    });
+                    reply(ex, 200, won ? "ok:" : "fail:cas");
+                    return;
+                }
+                case "/map/read": {
+                    final String mapName = name;
+                    long[] cur = onSession(s, () -> {
+                        IMap<String, long[]> m = s.hz.getMap(mapName);
+                        return m.get("hi");
+                    });
+                    StringBuilder sb = new StringBuilder("ok:");
+                    if (cur != null)
+                        for (int i = 0; i < cur.length; i++) {
+                            if (i > 0) sb.append(',');
+                            sb.append(cur[i]);
+                        }
+                    reply(ex, 200, sb.toString());
+                    return;
+                }
+                case "/lock/acquire": {
+                    final long wait = Long.parseLong(
+                        p.getOrDefault("wait", "5000"));
+                    final String lockName = name;
+                    boolean got = onSession(s, () ->
+                        s.hz.getCPSubsystem().getLock(lockName)
+                         .tryLock(wait, TimeUnit.MILLISECONDS));
+                    reply(ex, 200, got ? "ok:" : "fail:timeout");
+                    return;
+                }
+                case "/lock/release": {
+                    final String lockName = name;
+                    onSession(s, () -> {
+                        s.hz.getCPSubsystem().getLock(lockName).unlock();
+                        return null;
+                    });
+                    reply(ex, 200, "ok:");
+                    return;
+                }
+                case "/fencedlock/acquire": {
+                    final long wait = Long.parseLong(
+                        p.getOrDefault("wait", "5000"));
+                    final String lockName = name;
+                    long fence = onSession(s, () ->
+                        s.hz.getCPSubsystem().getLock(lockName)
+                         .tryLockAndGetFence(wait, TimeUnit.MILLISECONDS));
+                    if (fence == FencedLock.INVALID_FENCE)
+                        reply(ex, 200, "fail:timeout");
+                    else reply(ex, 200, "ok:" + fence);
+                    return;
+                }
+                case "/fencedlock/release": {
+                    final String lockName = name;
+                    onSession(s, () -> {
+                        s.hz.getCPSubsystem().getLock(lockName).unlock();
+                        return null;
+                    });
+                    reply(ex, 200, "ok:");
+                    return;
+                }
+                case "/sem/init": {
+                    final int permits = Integer.parseInt(p.get("permits"));
+                    final String semName = name;
+                    onSession(s, () -> {
+                        s.hz.getCPSubsystem().getSemaphore(semName)
+                         .init(permits);
+                        return null;
+                    });
+                    reply(ex, 200, "ok:");
+                    return;
+                }
+                case "/sem/acquire": {
+                    final long wait = Long.parseLong(
+                        p.getOrDefault("wait", "5000"));
+                    final String semName = name;
+                    boolean got = onSession(s, () ->
+                        s.hz.getCPSubsystem().getSemaphore(semName)
+                         .tryAcquire(1, wait, TimeUnit.MILLISECONDS));
+                    reply(ex, 200, got ? "ok:" : "fail:timeout");
+                    return;
+                }
+                case "/sem/release": {
+                    final String semName = name;
+                    onSession(s, () -> {
+                        s.hz.getCPSubsystem().getSemaphore(semName)
+                         .release();
+                        return null;
+                    });
+                    reply(ex, 200, "ok:");
+                    return;
+                }
+                case "/along/inc": {
+                    final String aName = name;
+                    long v = onSession(s, () ->
+                        s.hz.getCPSubsystem().getAtomicLong(aName)
+                         .incrementAndGet());
+                    reply(ex, 200, "ok:" + v);
+                    return;
+                }
+                case "/along/read": {
+                    final String aName = name;
+                    long v = onSession(s, () ->
+                        s.hz.getCPSubsystem().getAtomicLong(aName).get());
+                    reply(ex, 200, "ok:" + v);
+                    return;
+                }
+                case "/along/set": {
+                    final String aName = name;
+                    final long v = Long.parseLong(p.get("v"));
+                    onSession(s, () -> {
+                        s.hz.getCPSubsystem().getAtomicLong(aName).set(v);
+                        return null;
+                    });
+                    reply(ex, 200, "ok:");
+                    return;
+                }
+                case "/along/cas": {
+                    final String aName = name;
+                    final long oldV = Long.parseLong(p.get("old"));
+                    final long newV = Long.parseLong(p.get("new"));
+                    boolean ok = onSession(s, () ->
+                        s.hz.getCPSubsystem().getAtomicLong(aName)
+                         .compareAndSet(oldV, newV));
+                    reply(ex, 200, ok ? "ok:" : "fail:cas");
+                    return;
+                }
+                case "/aref/read": {
+                    final String aName = name;
+                    String v = onSession(s, () -> {
+                        IAtomicReference<String> a =
+                            s.hz.getCPSubsystem().getAtomicReference(aName);
+                        return a.get();
+                    });
+                    reply(ex, 200, "ok:" + (v == null ? "" : v));
+                    return;
+                }
+                case "/aref/cas": {
+                    final String aName = name;
+                    final String oldV = p.getOrDefault("old", "");
+                    final String newV = p.get("new");
+                    boolean ok = onSession(s, () -> {
+                        IAtomicReference<String> a =
+                            s.hz.getCPSubsystem().getAtomicReference(aName);
+                        return a.compareAndSet(
+                            oldV.isEmpty() ? null : oldV, newV);
+                    });
+                    reply(ex, 200, ok ? "ok:" : "fail:cas");
+                    return;
+                }
+                case "/idgen/next": {
+                    final String gName = name;
+                    long v = onSession(s, () ->
+                        s.hz.getFlakeIdGenerator(gName).newId());
+                    reply(ex, 200, "ok:" + v);
+                    return;
+                }
+                case "/queue/offer": {
+                    final String qName = name;
+                    final long v = Long.parseLong(p.get("v"));
+                    boolean ok = onSession(s, () -> {
+                        IQueue<Long> q = s.hz.getQueue(qName);
+                        return q.offer(v, 5000, TimeUnit.MILLISECONDS);
+                    });
+                    reply(ex, 200, ok ? "ok:" : "fail:full");
+                    return;
+                }
+                case "/queue/poll": {
+                    final String qName = name;
+                    final long timeout = Long.parseLong(
+                        p.getOrDefault("timeout", "10"));
+                    Long v = onSession(s, () -> {
+                        IQueue<Long> q = s.hz.getQueue(qName);
+                        return q.poll(timeout, TimeUnit.MILLISECONDS);
+                    });
+                    reply(ex, 200, v == null ? "fail:empty" : "ok:" + v);
+                    return;
+                }
+                default:
+                    reply(ex, 404, "fail:unknown " + path);
+            }
+        } catch (Exception e) {
+            try {
+                Throwable cause = e.getCause() != null ? e.getCause() : e;
+                reply(ex, 500, "err:" + cause.getClass().getSimpleName()
+                      + ": " + cause.getMessage());
+            } catch (IOException ignored) { }
+        }
+    }
+}

@@ -1,0 +1,42 @@
+// Split-brain merge policy that unions long-array "sets" instead of
+// picking a winner — divergent map halves merge losslessly.
+//
+// Parity: the reference ships the same idea against the legacy
+// MapMergePolicy SPI (hazelcast/server/java/jepsen/hazelcast/server/
+// SetUnionMergePolicy.java); this is an independent implementation
+// against the Hazelcast 5.x SplitBrainMergePolicy SPI.
+
+package jepsen.hazelcast_server;
+
+import com.hazelcast.spi.merge.MergingValue;
+import com.hazelcast.spi.merge.SplitBrainMergePolicy;
+import com.hazelcast.nio.ObjectDataInput;
+import com.hazelcast.nio.ObjectDataOutput;
+
+import java.io.IOException;
+import java.util.TreeSet;
+
+public class SetUnionMergePolicy
+        implements SplitBrainMergePolicy<long[], MergingValue<long[]>,
+                                         long[]> {
+
+    @Override
+    public long[] merge(MergingValue<long[]> merging,
+                        MergingValue<long[]> existing) {
+        TreeSet<Long> union = new TreeSet<>();
+        if (merging != null && merging.getDeserializedValue() != null)
+            for (long v : merging.getDeserializedValue()) union.add(v);
+        if (existing != null && existing.getDeserializedValue() != null)
+            for (long v : existing.getDeserializedValue()) union.add(v);
+        long[] out = new long[union.size()];
+        int i = 0;
+        for (long v : union) out[i++] = v;
+        return out;
+    }
+
+    @Override
+    public void writeData(ObjectDataOutput out) throws IOException { }
+
+    @Override
+    public void readData(ObjectDataInput in) throws IOException { }
+}

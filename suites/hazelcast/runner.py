@@ -1,0 +1,127 @@
+"""Hazelcast suite CLI — the reference's full workload registry.
+
+Parity: hazelcast/src/jepsen/hazelcast.clj:652-760 — map/crdt-map sets,
+plain and no-quorum locks (mutex model), non-reentrant/reentrant CP and
+fenced locks (the owner-aware / reentrant / fenced / reentrant-fenced
+mutex models), CP semaphore (acquired-permits model), unique-id
+generators, CAS long/reference registers, and the queue.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import SetChecker, UniqueIds
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.models import get_model
+from jepsen_tpu.workloads import queue as queue_wl
+
+from suites import common
+from suites.hazelcast import client as hc
+from suites.hazelcast.db import HazelcastDB
+
+
+def _adds():
+    state = iter(range(10 ** 9))
+    return gen.FnGen(lambda: {"f": "add", "value": next(state)})
+
+
+def _map_workload(opts, crdt: bool) -> Dict[str, Any]:
+    return {"client": hc.MapSetClient(crdt=crdt),
+            "generator": gen.stagger(1 / 10, _adds()),
+            "final_generator": gen.each_thread(gen.once({"f": "read"})),
+            "checker": SetChecker()}
+
+
+def _lock_gen(stagger_s: float):
+    return gen.stagger(stagger_s, gen.each_thread(gen.cycle(gen.lift(
+        [{"f": "acquire"}, {"f": "release"}]))))
+
+
+def _reentrant_gen(stagger_s: float):
+    return gen.stagger(stagger_s, gen.each_thread(gen.cycle(gen.lift(
+        [{"f": "acquire"}, {"f": "acquire"},
+         {"f": "release"}, {"f": "release"}]))))
+
+
+def _lock_workload(opts, name: str, model: str, reentrant: bool = False,
+                   fenced: bool = False,
+                   stagger_s: float = 0.5) -> Dict[str, Any]:
+    client = hc.FencedLockClient(name=name) if fenced \
+        else hc.LockClient(name=name)
+    g = _reentrant_gen(stagger_s) if reentrant else _lock_gen(stagger_s)
+    return {"client": client, "generator": g,
+            "checker": linearizable(get_model(model),
+                                    opts.get("algorithm"))}
+
+
+def _register_gen():
+    return gen.mix([
+        gen.FnGen(lambda: {"f": "read"}),
+        gen.FnGen(lambda: {"f": "write", "value": random.randrange(5)}),
+        gen.FnGen(lambda: {"f": "cas",
+                           "value": [random.randrange(5),
+                                     random.randrange(5)]})])
+
+
+WORKLOADS = {
+    "map": lambda o: _map_workload(o, crdt=False),
+    "crdt-map": lambda o: _map_workload(o, crdt=True),
+    "lock": lambda o: _lock_workload(
+        o, "jepsen.lock", "mutex", stagger_s=0.1),
+    "lock-no-quorum": lambda o: _lock_workload(
+        o, "jepsen.lock.no-quorum", "mutex", stagger_s=0.1),
+    "non-reentrant-cp-lock": lambda o: _lock_workload(
+        o, "jepsen.cpLock1", "owner-aware-mutex"),
+    "reentrant-cp-lock": lambda o: _lock_workload(
+        o, "jepsen.cpLock2", "reentrant-mutex", reentrant=True),
+    "non-reentrant-fenced-lock": lambda o: _lock_workload(
+        o, "jepsen.cpLock1", "fenced-mutex", fenced=True, stagger_s=1.0),
+    "reentrant-fenced-lock": lambda o: _lock_workload(
+        o, "jepsen.cpLock2", "reentrant-fenced-mutex", reentrant=True,
+        fenced=True, stagger_s=1.0),
+    "cp-semaphore": lambda o: {
+        "client": hc.SemaphoreClient(),
+        "generator": _lock_gen(0.5),
+        "checker": linearizable(get_model("acquired-permits"),
+                                o.get("algorithm"))},
+    "cp-cas-long": lambda o: {
+        # IAtomicLong starts at 0, not nil (hazelcast.clj:163-167)
+        "client": hc.CasLongClient(),
+        "generator": gen.stagger(1 / 10, _register_gen()),
+        "checker": linearizable(get_model("cas-register", init=0),
+                                o.get("algorithm"))},
+    "cp-cas-reference": lambda o: {
+        "client": hc.CasReferenceClient(),
+        "generator": gen.stagger(1 / 10, _register_gen()),
+        "checker": linearizable(get_model("cas-register"),
+                                o.get("algorithm"))},
+    "cp-id-gen-long": lambda o: {
+        "client": hc.IdGenClient(kind="along"),
+        "generator": gen.stagger(0.5, gen.repeat({"f": "generate"})),
+        "checker": UniqueIds()},
+    "id-gen": lambda o: {
+        "client": hc.IdGenClient(kind="flake"),
+        "generator": gen.stagger(0.5, gen.repeat({"f": "generate"})),
+        "checker": UniqueIds()},
+    "queue": lambda o: {**queue_wl.workload(),
+                        "client": hc.QueueClient()},
+}
+
+
+def hazelcast_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="hazelcast", db=HazelcastDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, hazelcast_test, WORKLOADS)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(hazelcast_test, WORKLOADS,
+                         prog="jepsen-tpu-hazelcast",
+                         default_workload="lock"))

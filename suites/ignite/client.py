@@ -29,13 +29,27 @@ def connect(test, node) -> IgniteClient:
 class RegisterClient(jclient.Client):
     CACHE = "REGISTER"
 
-    def __init__(self, conn: Optional[IgniteClient] = None):
+    def __init__(self, conn: Optional[IgniteClient] = None,
+                 node: Optional[str] = None):
         self.conn = conn
+        self.node = node
 
     def open(self, test, node):
         c = connect(test, node)
         c.get_or_create_cache(self.CACHE)
-        return RegisterClient(c)
+        return RegisterClient(c, node)
+
+    def _reconnect(self, test):
+        """A dead socket must not poison every later op on this worker —
+        the interpreter only swaps clients after an INFO crash."""
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.conn = connect(test, self.node)
+        except Exception:  # noqa: BLE001 — node may be down; retry later
+            pass
 
     def close(self, test):
         if self.conn:
@@ -57,7 +71,7 @@ class RegisterClient(jclient.Client):
                 return op.with_(type=OK if ok else FAIL)
             raise ValueError(op.f)
         except NET_ERRORS as e:
-            self.conn.close()
+            self._reconnect(test)
             if op.f == "read":
                 return op.with_(type=FAIL, error=str(e))
             return op.with_(type=INFO, error=str(e))
@@ -75,15 +89,27 @@ class BankClient(jclient.Client):
 
     def __init__(self, concurrency: str = "pessimistic",
                  isolation: str = "serializable",
-                 conn: Optional[IgniteClient] = None):
+                 conn: Optional[IgniteClient] = None,
+                 node: Optional[str] = None):
         self.concurrency = concurrency
         self.isolation = isolation
         self.conn = conn
+        self.node = node
 
     def open(self, test, node):
         c = connect(test, node)
         c.get_or_create_cache(self.CACHE)
-        return BankClient(self.concurrency, self.isolation, c)
+        return BankClient(self.concurrency, self.isolation, c, node)
+
+    def _reconnect(self, test):
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.conn = connect(test, self.node)
+        except Exception:  # noqa: BLE001 — node may be down; retry later
+            pass
 
     def setup(self, test):
         wl = test.get("bank", {})
@@ -139,7 +165,7 @@ class BankClient(jclient.Client):
                 return op.with_(type=OK)
             raise ValueError(op.f)
         except NET_ERRORS as e:
-            self.conn.close()
+            self._reconnect(test)
             if op.f == "read":
                 return op.with_(type=FAIL, error=str(e))
             return op.with_(type=INFO, error=str(e))

@@ -108,10 +108,12 @@ class IgniteDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
         s.exec("rm", "-f", PIDFILE)
 
     def pause(self, test, node):
-        cu.signal(session(test, node).sudo(), "ignite", "STOP")
+        # the server process is a JVM named "java"; match the full
+        # cmdline (the ignite config path) like kill() does
+        session(test, node).sudo().exec("pkill", "-STOP", "-f", "ignite")
 
     def resume(self, test, node):
-        cu.signal(session(test, node).sudo(), "ignite", "CONT")
+        session(test, node).sudo().exec("pkill", "-CONT", "-f", "ignite")
 
     def log_files(self, test, node) -> List[str]:
         return [LOGFILE]

@@ -5,8 +5,8 @@ Parity: logcabin/src/jepsen/logcabin.clj:152-246 — reads/writes/CAS on a
 tree path via `TreeOps read|write` with JSON-encoded values; CAS is a
 conditioned write (`-p path:value`), and a failed condition surfaces as
 the documented exception message, which maps to :fail.  Timeouts map to
-:fail for reads and CAS (the tool reports "Client-specified timeout
-elapsed" only when nothing was applied) and :info for writes.
+:fail for reads and :info for mutations (a timed-out write or CAS may
+still have been applied).
 """
 
 from __future__ import annotations

@@ -37,7 +37,7 @@ def cluster_addrs(test) -> str:
     return ",".join(server_addr(n) for n in test["nodes"])
 
 
-class LogCabinDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+class LogCabinDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
     def setup(self, test, node):
         s = session(test, node).sudo()
         if not cu.exists(s, BIN):
@@ -63,11 +63,16 @@ class LogCabinDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
             s.exec("sh", "-c",
                    f"cd /root && {BIN} -c {CONF} -l {LOGFILE} --bootstrap")
         self.start(test, node)
-        if node == test["nodes"][0]:
-            addrs = " ".join(server_addr(n) for n in test["nodes"])
-            s.exec("sh", "-c",
-                   f"cd /root && {RECONFIG} -c {cluster_addrs(test)} "
-                   f"set {addrs}")
+
+    def setup_primary(self, test, node):
+        """Grow the bootstrapped single-server cluster to every node —
+        runs after all per-node setups complete (logcabin.clj:135-140's
+        post-synchronize reconfigure)."""
+        s = session(test, node).sudo()
+        addrs = " ".join(server_addr(n) for n in test["nodes"])
+        s.exec("sh", "-c",
+               f"cd /root && {RECONFIG} -c {cluster_addrs(test)} "
+               f"set {addrs}")
 
     def teardown(self, test, node):
         s = session(test, node).sudo()

@@ -1,0 +1,2 @@
+"""RabbitMQ suite (reference: rabbitmq/ — mirrored queue and
+distributed-semaphore workloads over AMQP)."""

@@ -1,0 +1,162 @@
+"""RabbitMQ workload clients.
+
+Parity: rabbitmq/src/jepsen/rabbitmq.clj:103-175 (QueueClient: publish
+with confirms, basic.get auto-ack dequeue, drain loop) and 177-255
+(Semaphore: one message as the mutex token; acquire = unacked basic.get,
+release = basic.reject with requeue).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.amqp import AmqpClient, AmqpError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+QUEUE = "jepsen.queue"
+SEM_QUEUE = "jepsen.semaphore"
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+
+def connect(test, node) -> AmqpClient:
+    return AmqpClient(node, port=int(test.get("db_port", 5672)))
+
+
+class QueueClient(jclient.Client):
+    def __init__(self, conn: Optional[AmqpClient] = None,
+                 node: Optional[str] = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return QueueClient(connect(test, node), node)
+
+    def setup(self, test):
+        self.conn.queue_declare(QUEUE, durable=True)
+        self.conn.confirm_select()
+
+    def _reconnect(self, test):
+        """The reference opens a fresh channel per op (with-ch,
+        rabbitmq.clj:119-125); we reconnect lazily after failures."""
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.conn = connect(test, self.node)
+            self.conn.confirm_select()
+        except Exception:  # noqa: BLE001 — node may be down; retry next op
+            pass
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _dequeue(self, op: Op) -> Op:
+        # auto-ack: a crash after the get loses the message honestly
+        # (rabbitmq.clj:106-117's dequeue semantics)
+        got = self.conn.get(QUEUE, no_ack=True)
+        if got is None:
+            return op.with_(type=FAIL, error="empty")
+        _tag, body = got
+        return op.with_(type=OK, value=json.loads(body))
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "enqueue":
+                ok = self.conn.publish(QUEUE,
+                                       json.dumps(op.value).encode())
+                return op.with_(type=OK if ok else FAIL)
+            if op.f == "dequeue":
+                return self._dequeue(op)
+            if op.f == "drain":
+                out = []
+                while True:
+                    r = self._dequeue(op)
+                    if r.type != OK:
+                        return op.with_(type=OK, value=out)
+                    out.append(r.value)
+            raise ValueError(op.f)
+        except (AmqpError, *NET_ERRORS) as e:
+            self._reconnect(test)
+            if op.f in ("dequeue", "drain"):
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+
+class SemaphoreClient(jclient.Client):
+    """One persistent message is the lock token (rabbitmq.clj:177-255)."""
+
+    _seed_lock = threading.Lock()
+    _seeded = False
+
+    def __init__(self, conn: Optional[AmqpClient] = None,
+                 node: Optional[str] = None):
+        self.conn = conn
+        self.node = node
+        self.tag: Optional[int] = None
+        self.tag_lock = threading.Lock()
+
+    def open(self, test, node):
+        return SemaphoreClient(connect(test, node), node)
+
+    def setup(self, test):
+        self.conn.queue_declare(SEM_QUEUE, durable=True)
+        with SemaphoreClient._seed_lock:
+            if not SemaphoreClient._seeded:
+                self.conn.confirm_select()
+                self.conn.queue_purge(SEM_QUEUE)
+                if not self.conn.publish(SEM_QUEUE, b""):
+                    raise RuntimeError(
+                        "couldn't enqueue initial semaphore message")
+                SemaphoreClient._seeded = True
+
+    def teardown(self, test):
+        SemaphoreClient._seeded = False
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _reopen(self, test):
+        # dropping the connection requeues any unacked token server-side
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self.tag = None
+        try:
+            self.conn = connect(test, self.node)
+        except Exception:  # noqa: BLE001 — node may be down
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        with self.tag_lock:
+            try:
+                if op.f == "acquire":
+                    if self.tag is not None:
+                        return op.with_(type=FAIL, error="already-held")
+                    got = self.conn.get(SEM_QUEUE, no_ack=False)
+                    if got is None:
+                        return op.with_(type=FAIL)
+                    self.tag = got[0]
+                    return op.with_(type=OK)
+                if op.f == "release":
+                    if self.tag is None:
+                        return op.with_(type=FAIL, error="not-held")
+                    tag, self.tag = self.tag, None
+                    try:
+                        self.conn.reject(tag, requeue=True)
+                    except (AmqpError, *NET_ERRORS):
+                        # release succeeds either way: a broken channel
+                        # requeues the unacked token (rabbitmq.clj:232-254)
+                        self._reopen(test)
+                    return op.with_(type=OK)
+                raise ValueError(op.f)
+            except (AmqpError, *NET_ERRORS) as e:
+                self._reopen(test)
+                return op.with_(type=FAIL, error=str(e))

@@ -1,0 +1,74 @@
+"""RabbitMQ install/cluster.
+
+Parity: rabbitmq/src/jepsen/rabbitmq.clj:24-101 — deb install with
+erlang, shared erlang cookie "jepsen-rabbitmq", cluster join of every
+node to node 1 via rabbitmqctl join_cluster, ha-maj mirroring policy on
+jepsen.* queues, teardown nukes beam/epmd and the mnesia dir.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+COOKIE = "jepsen-rabbitmq"
+COOKIE_FILE = "/var/lib/rabbitmq/.erlang.cookie"
+LOGDIR = "/var/log/rabbitmq"
+AMQP_PORT = 5672
+
+HA_POLICY = ('{"ha-mode": "exactly", "ha-params": 3, '
+             '"ha-sync-mode": "automatic"}')
+
+
+class RabbitDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "dpkg-query -l rabbitmq-server >/dev/null 2>&1 || "
+               "apt-get install -y erlang-nox rabbitmq-server")
+        # shared cookie before clustering (rabbitmq.clj:42-50)
+        s.exec("sh", "-c",
+               f"[ -f {COOKIE_FILE} ] && "
+               f"[ \"$(cat {COOKIE_FILE})\" = '{COOKIE}' ] || "
+               f"{{ service rabbitmq-server stop || true; "
+               f"echo '{COOKIE}' > {COOKIE_FILE}; "
+               f"chown rabbitmq:rabbitmq {COOKIE_FILE}; "
+               f"chmod 600 {COOKIE_FILE}; }}")
+        self.start(test, node)
+        cu.await_tcp_port(s, AMQP_PORT, timeout_s=120)
+        first = test["nodes"][0]
+        if node != first:
+            s.exec("rabbitmqctl", "stop_app")
+            s.exec("rabbitmqctl", "join_cluster", f"rabbit@{first}")
+            s.exec("rabbitmqctl", "start_app")
+        # mirror jepsen.* queues across 3 nodes (rabbitmq.clj:82-88)
+        s.exec("rabbitmqctl", "set_policy", "ha-maj", "jepsen.",
+               HA_POLICY)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c", "killall -9 beam.smp epmd || true")
+        s.exec("rm", "-rf", "/var/lib/rabbitmq/mnesia/")
+        s.exec("sh", "-c", "service rabbitmq-server stop || true")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec(
+            "sh", "-c",
+            "service rabbitmq-server status >/dev/null 2>&1 || "
+            "service rabbitmq-server start")
+
+    def kill(self, test, node):
+        session(test, node).sudo().exec(
+            "sh", "-c", "killall -9 beam.smp epmd || true")
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "beam.smp", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "beam.smp", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [f"{LOGDIR}/rabbit@{node}.log"]

@@ -1,0 +1,54 @@
+"""RabbitMQ suite CLI.
+
+Parity: rabbitmq/src/jepsen/rabbitmq.clj — queue workload (enqueue/
+dequeue mix + drain, total-queue checker) and the distributed-semaphore
+mutex workload (acquire/release, linearizable against the mutex model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.models import get_model
+from jepsen_tpu.workloads import queue as queue_wl
+
+from suites import common
+from suites.rabbitmq.client import QueueClient, SemaphoreClient
+from suites.rabbitmq.db import RabbitDB
+
+
+def queue_workload(opts) -> Dict[str, Any]:
+    wl = queue_wl.workload()
+    return {**wl, "client": QueueClient()}
+
+
+def mutex_workload(opts) -> Dict[str, Any]:
+    """Each process alternates acquire/release
+    (the reference's semaphore client drives exactly this shape)."""
+    g = gen.each_thread(gen.cycle(gen.lift([
+        {"f": "acquire"}, {"f": "release"}])))
+    return {"client": SemaphoreClient(),
+            "generator": gen.stagger(1 / 2, g),
+            "checker": linearizable(get_model("mutex"),
+                                    opts.get("algorithm"))}
+
+
+WORKLOADS = {"queue": queue_workload, "mutex": mutex_workload}
+
+
+def rabbitmq_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="rabbitmq", db=RabbitDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, rabbitmq_test, WORKLOADS)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(rabbitmq_test, WORKLOADS,
+                         prog="jepsen-tpu-rabbitmq",
+                         default_workload="queue"))

@@ -39,15 +39,28 @@ class DocumentCasClient(jclient.Client):
 
     def __init__(self, write_acks: str = "majority",
                  read_mode: str = "majority",
-                 conn: Optional[rq.RethinkClient] = None):
+                 conn: Optional[rq.RethinkClient] = None,
+                 node: Optional[str] = None):
         self.write_acks = write_acks
         self.read_mode = read_mode
         self.conn = conn
+        self.node = node
 
     def open(self, test, node):
-        c = DocumentCasClient(self.write_acks, self.read_mode,
-                              connect(test, node))
-        return c
+        return DocumentCasClient(self.write_acks, self.read_mode,
+                                 connect(test, node), node)
+
+    def _reconnect(self, test):
+        """A dead socket must not poison every later op on this worker —
+        the interpreter only swaps clients after an INFO crash."""
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.conn = connect(test, self.node)
+        except Exception:  # noqa: BLE001 — node may be down; retry later
+            pass
 
     def setup(self, test):
         with DocumentCasClient._table_lock:
@@ -102,7 +115,7 @@ class DocumentCasClient(jclient.Client):
                 return op.with_(type=OK if ok else FAIL)
             raise ValueError(op.f)
         except NET_ERRORS as e:
-            self.conn.close()
+            self._reconnect(test)
             if op.f == "read":
                 return op.with_(type=FAIL, error=str(e))
             return op.with_(type=INFO, error=str(e))

@@ -27,11 +27,13 @@ class ReconfigureNemesis(jnem.Nemesis):
 
     def invoke(self, test, op):
         nodes = list(test["nodes"])
-        size = random.randint(1, len(nodes))
-        replicas = random.sample(nodes, size)
-        primary = random.choice(replicas)
         last_err = None
         for _ in range(10):
+            # re-sample topology every attempt: retrying one dead primary
+            # ten times would waste the whole op under partitions
+            size = random.randint(1, len(nodes))
+            replicas = random.sample(nodes, size)
+            primary = random.choice(replicas)
             try:
                 conn = connect(test, primary)
                 try:

@@ -1,0 +1,2 @@
+"""RobustIRC suite (reference: robustirc/ — Raft-replicated IRC network;
+message-log set workload over the robustsession HTTP protocol)."""

@@ -1,0 +1,116 @@
+"""RobustIRC robustsession client.
+
+Parity: robustirc/src/jepsen/robustirc.clj:103-180 — POST
+/robustirc/v1/session for {Sessionid, Sessionauth}; NICK/USER/JOIN on
+setup; :add posts "TOPIC #jepsen :<n>" with a random ClientMessageId;
+:read streams /messages from lastseen 0.0 and extracts topic integers.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+PORT = 13001
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+class RobustSession:
+    def __init__(self, node: str, port: int = PORT, timeout: float = 8.0,
+                 scheme: str = "https"):
+        self.node = node
+        self.base = f"{scheme}://{node}:{port}/robustirc/v1"
+        self.timeout = timeout
+        self.ctx = ssl.create_default_context()
+        self.ctx.check_hostname = False
+        self.ctx.verify_mode = ssl.CERT_NONE
+        r = self._req("POST", "/session")
+        self.sid = r["Sessionid"]
+        self.auth = r["Sessionauth"]
+
+    def _req(self, method: str, path: str, body: Optional[Dict] = None,
+             auth: bool = False, raw: bool = False):
+        req = urllib.request.Request(
+            self.base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json",
+                     **({"X-Session-Auth": self.auth} if auth else {})})
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    context=self.ctx) as resp:
+            data = resp.read()
+        if raw:
+            return data
+        return json.loads(data) if data else {}
+
+    def post_message(self, data: str) -> None:
+        msgid = random.randrange(1, 2 ** 31)
+        self._req("POST", f"/{self.sid}/message",
+                  {"Data": data, "ClientMessageId": msgid}, auth=True)
+
+    def read_messages(self) -> List[Dict[str, Any]]:
+        raw = self._req("GET", f"/{self.sid}/messages?lastseen=0.0",
+                        auth=True, raw=True)
+        out = []
+        dec = json.JSONDecoder()
+        s = raw.decode()
+        i = 0
+        while i < len(s):
+            while i < len(s) and s[i] in " \r\n\t":
+                i += 1
+            if i >= len(s):
+                break
+            obj, j = dec.raw_decode(s, i)
+            out.append(obj)
+            i = j
+        return out
+
+
+def topic_values(messages: List[Dict[str, Any]]) -> List[int]:
+    """Extract ints from TOPIC lines (robustirc.clj:139-152)."""
+    out = []
+    for m in messages:
+        parts = str(m.get("Data", "")).split(" ")
+        if len(parts) > 1 and parts[1] == "TOPIC":
+            tail = str(m["Data"]).rsplit(":", 1)[-1]
+            try:
+                out.append(int(tail))
+            except ValueError:
+                pass
+    return out
+
+
+class SetClient(jclient.Client):
+    def __init__(self, sess: Optional[RobustSession] = None):
+        self.sess = sess
+
+    def open(self, test, node):
+        sess = RobustSession(node, port=int(test.get("db_port", PORT)),
+                             scheme=test.get("db_scheme", "https"))
+        sess.post_message(f"NICK n{random.randrange(10**6)}")
+        sess.post_message("USER j j j j")
+        sess.post_message("JOIN #jepsen")
+        return SetClient(sess)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.sess.post_message(f"TOPIC #jepsen :{op.value}")
+                return op.with_(type=OK)
+            if op.f == "read":
+                vals = sorted(set(topic_values(
+                    self.sess.read_messages())))
+                return op.with_(type=OK, value=vals)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))

@@ -1,0 +1,53 @@
+"""RobustIRC suite CLI.
+
+Parity: robustirc/src/jepsen/robustirc.clj:186-217 — the set workload
+(TOPIC adds, one final read of the message log) under random-halves
+partitions.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import SetChecker
+
+from suites import common
+from suites.robustirc.client import SetClient
+from suites.robustirc.db import RobustIrcDB
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    counter = itertools.count()
+    return {"client": SetClient(),
+            "generator": gen.stagger(
+                1 / 10, gen.FnGen(lambda: {"f": "add",
+                                           "value": next(counter)})),
+            "final_generator": gen.once({"f": "read"}),
+            "checker": SetChecker()}
+
+
+WORKLOADS = {"set": set_workload}
+
+
+def robustirc_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="robustirc", db=RobustIrcDB(),
+                             workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, robustirc_test, WORKLOADS)
+
+
+def _extra(parser):
+    parser.add_argument("--db-scheme", default="https",
+                        choices=["https", "http"],
+                        help="robustsession transport (real networks "
+                             "are TLS)")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(robustirc_test, WORKLOADS,
+                         prog="jepsen-tpu-robustirc", extra_opts=_extra))

@@ -857,9 +857,10 @@ class FakeIgniteHandler(socketserver.BaseRequestHandler):
             payload = body[10:]
             try:
                 out = self._dispatch(ig, st, opcode, payload)
-                resp = struct.pack("<qi", rid, 0) + out
+                resp = struct.pack("<qh", rid, 0) + out
             except Exception as e:  # noqa: BLE001
-                resp = struct.pack("<qi", rid, 1) + ig.enc(str(e))
+                resp = struct.pack("<qhi", rid, ig.RFLAG_ERROR, 1) \
+                    + ig.enc(str(e))
             self.request.sendall(struct.pack("<i", len(resp)) + resp)
 
     def _frame(self) -> bytes:
@@ -1131,3 +1132,360 @@ class FakeRethinkHandler(socketserver.BaseRequestHandler):
         if tt == rq.WAIT:
             return {"ready": 1}
         raise ValueError(f"unhandled term {tt}")
+
+
+# --------------------------------------------------------------------------
+# AMQP 0-9-1 (RabbitMQ) — serves jepsen_tpu.clients.amqp
+# --------------------------------------------------------------------------
+
+class AmqpState:
+    def __init__(self):
+        self.queues: Dict[str, List[bytes]] = {}
+        # delivery tag -> (queue, body) for unacked messages per connection
+        self.lock = threading.Lock()
+
+
+class FakeAmqpHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from jepsen_tpu.clients import amqp as aq
+        st: AmqpState = self.server.state
+        self.unacked: Dict[int, Tuple[str, bytes]] = {}
+        self.next_tag = 1
+        self.confirming = False
+        try:
+            assert _recv_exact(self.request, 8) == b"AMQP\x00\x00\x09\x01"
+            self._method(0, aq.CONN_START,
+                         bytes([0, 9]) + struct.pack(">I", 0)
+                         + struct.pack(">I", 5) + b"PLAIN"
+                         + struct.pack(">I", 5) + b"en_US")
+            self._expect(aq.CONN_START_OK)
+            self._method(0, aq.CONN_TUNE, struct.pack(">HIH", 1, 131072, 0))
+            self._expect(aq.CONN_TUNE_OK)
+            self._expect(aq.CONN_OPEN)
+            self._method(0, aq.CONN_OPEN_OK, b"\x00")
+            self._expect(aq.CH_OPEN)
+            self._method(1, aq.CH_OPEN_OK, struct.pack(">I", 0))
+            while True:
+                cm, args = self._expect(None)
+                if not self._dispatch(aq, st, cm, args):
+                    return
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            # dropped connection requeues unacked messages
+            with st.lock:
+                for q, body in self.unacked.values():
+                    st.queues.setdefault(q, []).insert(0, body)
+
+    def _send_frame(self, ftype, ch, payload):
+        self.request.sendall(struct.pack(">BHI", ftype, ch, len(payload))
+                             + payload + b"\xce")
+
+    def _method(self, ch, cm, args=b""):
+        self._send_frame(1, ch, struct.pack(">HH", *cm) + args)
+
+    def _recv_frame(self):
+        ftype, ch, size = struct.unpack(
+            ">BHI", _recv_exact(self.request, 7))
+        payload = _recv_exact(self.request, size)
+        assert _recv_exact(self.request, 1) == b"\xce"
+        return ftype, ch, payload
+
+    def _expect(self, cm):
+        ftype, _ch, payload = self._recv_frame()
+        assert ftype == 1, f"frame type {ftype}"
+        got = struct.unpack(">HH", payload[:4])
+        if cm is not None:
+            assert got == cm, f"expected {cm}, got {got}"
+        return got, payload[4:]
+
+    def _short_str(self, buf, off):
+        n = buf[off]
+        return buf[off + 1:off + 1 + n].decode(), off + 1 + n
+
+    def _dispatch(self, aq, st, cm, args) -> bool:
+        if cm == aq.CONN_CLOSE:
+            self._method(0, aq.CONN_CLOSE_OK)
+            return False
+        if cm == aq.Q_DECLARE:
+            q, off = self._short_str(args, 2)
+            with st.lock:
+                st.queues.setdefault(q, [])
+            self._method(1, aq.Q_DECLARE_OK,
+                         bytes([len(q)]) + q.encode()
+                         + struct.pack(">II", 0, 0))
+            return True
+        if cm == aq.Q_PURGE:
+            q, _ = self._short_str(args, 2)
+            with st.lock:
+                n = len(st.queues.get(q, []))
+                st.queues[q] = []
+            self._method(1, aq.Q_PURGE_OK, struct.pack(">I", n))
+            return True
+        if cm == aq.CONFIRM_SELECT:
+            self.confirming = True
+            self._method(1, aq.CONFIRM_SELECT_OK)
+            return True
+        if cm == aq.B_PUBLISH:
+            _x, off = self._short_str(args, 2)
+            rk, off = self._short_str(args, off)
+            # content header
+            ftype, _ch, payload = self._recv_frame()
+            assert ftype == 2
+            (body_size,) = struct.unpack(">Q", payload[4:12])
+            body = b""
+            while len(body) < body_size:
+                ftype, _ch, chunk = self._recv_frame()
+                assert ftype == 3
+                body += chunk
+            with st.lock:
+                st.queues.setdefault(rk, []).append(body)
+            if self.confirming:
+                self._method(1, aq.B_ACK, struct.pack(">QB", 1, 0))
+            return True
+        if cm == aq.B_GET:
+            q, off = self._short_str(args, 2)
+            no_ack = bool(args[off])
+            with st.lock:
+                items = st.queues.setdefault(q, [])
+                body = items.pop(0) if items else None
+            if body is None:
+                self._method(1, aq.B_GET_EMPTY, b"\x00")
+                return True
+            tag = self.next_tag
+            self.next_tag += 1
+            if not no_ack:
+                self.unacked[tag] = (q, body)
+            self._method(1, aq.B_GET_OK,
+                         struct.pack(">QB", tag, 0)
+                         + bytes([0]) + bytes([len(q)]) + q.encode()
+                         + struct.pack(">I", 0))
+            props = struct.pack(">H", 0)
+            self._send_frame(2, 1, struct.pack(">HHQ", 60, 0, len(body))
+                             + props)
+            if body:
+                self._send_frame(3, 1, body)
+            return True
+        if cm == aq.B_REJECT:
+            tag, requeue = struct.unpack(">QB", args[:9])
+            entry = self.unacked.pop(tag, None)
+            if entry and requeue:
+                with st.lock:
+                    st.queues.setdefault(entry[0], []).insert(0, entry[1])
+            return True
+        if cm == aq.B_ACK:
+            tag = struct.unpack(">Q", args[:8])[0]
+            self.unacked.pop(tag, None)
+            return True
+        raise AssertionError(f"unhandled method {cm}")
+
+
+# --------------------------------------------------------------------------
+# Hazelcast bridge (HTTP) — serves suites.hazelcast.client.Bridge
+# --------------------------------------------------------------------------
+
+def start_fake_hz_bridge():
+    """In-process stand-in for JepsenBridge.java: same endpoints, same
+    ok:/fail: responses, linearizable by a global lock."""
+    import http.server
+    import itertools as it
+    import uuid
+    from urllib.parse import parse_qs, urlparse
+
+    state = {
+        "maps": {}, "locks": {}, "fences": it.count(1),
+        "sems": {}, "alongs": {}, "arefs": {}, "queues": {},
+        "idgen": it.count(1), "lock_counts": {}, "session_uids": {},
+    }
+    lock = threading.Lock()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            p = {k: v[0] for k, v in parse_qs(u.query).items()}
+            name = p.get("name", "")
+            uid = state["session_uids"].get(p.get("session", ""))
+            with lock:
+                body = self._route(u.path, p, name, uid)
+            b = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def _route(self, path, p, name, uid):
+            s = state
+            if path == "/connect":
+                sid = uuid.uuid4().hex
+                cuid = uuid.uuid4().hex
+                s["session_uids"][sid] = cuid
+                return "ok:" + sid + "," + cuid
+            if uid is None:
+                return "err:unknown session"
+            if path == "/map/add":
+                cur = s["maps"].setdefault(name, None)
+                v = int(p["v"])
+                if cur is None:
+                    s["maps"][name] = [v]
+                    return "ok:"
+                nxt = sorted(set(cur) | {v})
+                s["maps"][name] = nxt
+                return "ok:"
+            if path == "/map/read":
+                cur = s["maps"].get(name) or []
+                return "ok:" + ",".join(str(x) for x in cur)
+            if path in ("/lock/acquire", "/fencedlock/acquire"):
+                owner = s["locks"].get(name)
+                cnt = s["lock_counts"].get(name, 0)
+                if owner is None or (owner == uid and cnt < 2):
+                    s["locks"][name] = uid
+                    s["lock_counts"][name] = cnt + 1
+                    if path.startswith("/fencedlock") and cnt == 0:
+                        fence = next(s["fences"])
+                        s.setdefault("curfence", {})[name] = fence
+                    if path.startswith("/fencedlock"):
+                        return "ok:" + str(s["curfence"][name])
+                    return "ok:"
+                return "fail:timeout"
+            if path in ("/lock/release", "/fencedlock/release"):
+                if s["locks"].get(name) != uid:
+                    return "err:IllegalMonitorStateException: not owner"
+                s["lock_counts"][name] -= 1
+                if s["lock_counts"][name] == 0:
+                    s["locks"][name] = None
+                return "ok:"
+            if path == "/sem/init":
+                s["sems"].setdefault(name,
+                                     {"permits": int(p["permits"]),
+                                      "held": {}})
+                return "ok:"
+            if path == "/sem/acquire":
+                sem = s["sems"][name]
+                if sum(sem["held"].values()) < sem["permits"]:
+                    sem["held"][uid] = sem["held"].get(uid, 0) + 1
+                    return "ok:"
+                return "fail:timeout"
+            if path == "/sem/release":
+                sem = s["sems"][name]
+                if sem["held"].get(uid, 0) > 0:
+                    sem["held"][uid] -= 1
+                    return "ok:"
+                return "err:IllegalState: not held"
+            if path == "/along/inc":
+                s["alongs"][name] = s["alongs"].get(name, 0) + 1
+                return "ok:" + str(s["alongs"][name])
+            if path == "/along/read":
+                return "ok:" + str(s["alongs"].get(name, 0))
+            if path == "/along/set":
+                s["alongs"][name] = int(p["v"])
+                return "ok:"
+            if path == "/along/cas":
+                if s["alongs"].get(name, 0) == int(p["old"]):
+                    s["alongs"][name] = int(p["new"])
+                    return "ok:"
+                return "fail:cas"
+            if path == "/aref/read":
+                v = s["arefs"].get(name)
+                return "ok:" + ("" if v is None else str(v))
+            if path == "/aref/cas":
+                old = p.get("old", "")
+                cur = s["arefs"].get(name)
+                if (cur is None and old == "") or \
+                        (cur is not None and str(cur) == old):
+                    s["arefs"][name] = p["new"]
+                    return "ok:"
+                return "fail:cas"
+            if path == "/idgen/next":
+                return "ok:" + str(next(s["idgen"]))
+            if path == "/queue/offer":
+                s["queues"].setdefault(name, []).append(int(p["v"]))
+                return "ok:"
+            if path == "/queue/poll":
+                items = s["queues"].setdefault(name, [])
+                if not items:
+                    return "fail:empty"
+                return "ok:" + str(items.pop(0))
+            return "fail:unknown " + path
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], state
+
+
+# --------------------------------------------------------------------------
+# RobustIRC robustsession (HTTP) — serves suites.robustirc.client
+# --------------------------------------------------------------------------
+
+def start_fake_robustirc():
+    import http.server
+    import json as js
+    import uuid
+    from urllib.parse import urlparse
+
+    state = {"sessions": {}, "log": []}
+    lock = threading.Lock()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, obj, raw=None):
+            b = raw if raw is not None else js.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def do_POST(self):
+            path = urlparse(self.path).path
+            n = int(self.headers.get("Content-Length") or 0)
+            body = js.loads(self.rfile.read(n)) if n else {}
+            with lock:
+                if path == "/robustirc/v1/session":
+                    sid = uuid.uuid4().hex
+                    auth = uuid.uuid4().hex
+                    state["sessions"][sid] = auth
+                    self._reply({"Sessionid": sid, "Sessionauth": auth})
+                    return
+                sid = path.split("/")[3]
+                if state["sessions"].get(sid) != \
+                        self.headers.get("X-Session-Auth"):
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                data = body["Data"]
+                # the server's message stream carries full IRC lines with
+                # a sender prefix (":nick!user@host TOPIC #chan :v")
+                if data.startswith("TOPIC "):
+                    data = ":n1!j@jepsen " + data
+                state["log"].append({"Data": data})
+                self._reply({})
+
+        def do_GET(self):
+            path = urlparse(self.path).path
+            with lock:
+                sid = path.split("/")[3]
+                if state["sessions"].get(sid) != \
+                        self.headers.get("X-Session-Auth"):
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                raw = "\n".join(js.dumps(m) for m in state["log"]).encode()
+            self._reply(None, raw=raw)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1], state

@@ -365,6 +365,7 @@ class TestLogCabinSuite:
         control.setup_sessions(t)
         db = LogCabinDB()
         db.setup(t, "n1")
+        db.setup_primary(t, "n1")
         db.kill(t, "n1")
         log = "\n".join(t["remote"].log)
         assert "--bootstrap" in log

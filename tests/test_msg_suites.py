@@ -1,0 +1,240 @@
+"""Queue/messaging suites (rabbitmq, hazelcast, robustirc): wire smoke
+tests against protocol fakes + construction/control tests."""
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen
+from jepsen_tpu.checker import Stats, compose
+
+from tests.fakes import (AmqpState, FakeAmqpHandler, start_fake_hz_bridge,
+                         start_fake_robustirc, start_server)
+from tests.test_kv_suites import run_wire_test
+
+
+# --------------------------------------------------------------------------
+# RabbitMQ
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def amqp_port():
+    srv, port = start_server(FakeAmqpHandler, AmqpState())
+    yield port
+    srv.shutdown()
+
+
+class TestAmqpWire:
+    def test_protocol_roundtrip(self, amqp_port):
+        from jepsen_tpu.clients.amqp import AmqpClient
+        c = AmqpClient("127.0.0.1", amqp_port)
+        c.queue_declare("jepsen.queue")
+        c.confirm_select()
+        assert c.publish("jepsen.queue", b"[1]") is True
+        got = c.get("jepsen.queue", no_ack=True)
+        assert got is not None and got[1] == b"[1]"
+        assert c.get("jepsen.queue") is None
+        # unacked + reject requeues
+        c.publish("jepsen.queue", b"[2]")
+        tag, body = c.get("jepsen.queue", no_ack=False)
+        assert body == b"[2]"
+        c.reject(tag, requeue=True)
+        assert c.get("jepsen.queue")[1] == b"[2]"
+        assert c.queue_purge("jepsen.queue") == 0
+        c.close()
+
+    def test_queue_workload_valid(self, amqp_port):
+        from suites.rabbitmq.runner import queue_workload
+        run_wire_test(queue_workload({}), "rabbitmq-queue", amqp_port,
+                      time_limit=2.0)
+
+    def test_mutex_workload_valid(self, amqp_port):
+        from suites.rabbitmq.client import SemaphoreClient
+        from suites.rabbitmq.runner import mutex_workload
+        SemaphoreClient._seeded = False
+        wl = mutex_workload({"algorithm": "cpu"})
+        run_wire_test(wl, "rabbitmq-mutex", amqp_port, time_limit=2.5)
+
+
+class TestRabbitSuite:
+    def test_construction(self):
+        from suites.rabbitmq import runner
+        t = runner.rabbitmq_test({"nodes": ["n1", "n2", "n3"],
+                                  "workload": "queue",
+                                  "nemesis": "partition"})
+        assert t["name"] == "rabbitmq-queue-partition"
+
+    def test_db_control_commands(self):
+        from suites.rabbitmq.db import RabbitDB
+        t = {"nodes": ["n1", "n2"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        db = RabbitDB()
+        db.setup(t, "n2")
+        db.kill(t, "n2")
+        log = "\n".join(t["remote"].log)
+        assert "join_cluster rabbit@n1" in log
+        assert "set_policy ha-maj" in log
+        assert "killall -9 beam.smp epmd" in log
+        control.teardown_sessions(t)
+
+
+# --------------------------------------------------------------------------
+# Hazelcast
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def hz_bridge():
+    srv, port, state = start_fake_hz_bridge()
+    yield port, state
+    srv.shutdown()
+
+
+class TestHazelcastBridge:
+    def test_sessions_get_distinct_uids(self, hz_bridge):
+        port, _ = hz_bridge
+        from suites.hazelcast.client import Bridge
+        b1 = Bridge("127.0.0.1", port)
+        b2 = Bridge("127.0.0.1", port)
+        assert b1.uid != b2.uid
+
+    def test_lock_ownership(self, hz_bridge):
+        port, _ = hz_bridge
+        from suites.hazelcast.client import Bridge
+        b1 = Bridge("127.0.0.1", port)
+        b2 = Bridge("127.0.0.1", port)
+        assert b1.call("/lock/acquire", name="l")[0] is True
+        assert b2.call("/lock/acquire", name="l")[0] is False
+        # release by non-owner is a bridge exception
+        from suites.hazelcast.client import BridgeError
+        with pytest.raises(BridgeError):
+            b2.call("/lock/release", name="l")
+        assert b1.call("/lock/release", name="l")[0] is True
+        assert b2.call("/lock/acquire", name="l")[0] is True
+
+    def test_fences_increase(self, hz_bridge):
+        port, _ = hz_bridge
+        from suites.hazelcast.client import Bridge
+        b = Bridge("127.0.0.1", port)
+        ok, f1 = b.call("/fencedlock/acquire", name="fl")
+        b.call("/fencedlock/release", name="fl")
+        ok, f2 = b.call("/fencedlock/acquire", name="fl")
+        assert int(f2) > int(f1)
+
+    @pytest.mark.parametrize("workload", [
+        "map", "lock", "non-reentrant-cp-lock", "reentrant-cp-lock",
+        "non-reentrant-fenced-lock", "reentrant-fenced-lock",
+        "cp-semaphore", "cp-cas-long", "cp-cas-reference",
+        "cp-id-gen-long", "id-gen", "queue"])
+    def test_workloads_valid(self, hz_bridge, workload):
+        port, _ = hz_bridge
+        from suites.hazelcast.runner import WORKLOADS
+        wl = WORKLOADS[workload]({"algorithm": "cpu"})
+        run_wire_test(wl, f"hazelcast-{workload}", port, time_limit=2.0,
+                      concurrency=3)
+
+
+class TestHazelcastSuite:
+    def test_registry_covers_reference(self):
+        from suites.hazelcast.runner import WORKLOADS
+        # hazelcast.clj:652-760's registry
+        for w in ["map", "crdt-map", "lock", "lock-no-quorum",
+                  "non-reentrant-cp-lock", "reentrant-cp-lock",
+                  "non-reentrant-fenced-lock", "reentrant-fenced-lock",
+                  "cp-semaphore", "cp-id-gen-long", "id-gen",
+                  "cp-cas-long", "cp-cas-reference", "queue"]:
+            assert w in WORKLOADS, w
+
+    def test_db_config(self):
+        from suites.hazelcast.db import config
+        c = config({"nodes": ["n1", "n2", "n3"]})
+        assert "<member>n2</member>" in c
+        assert "<cp-member-count>3</cp-member-count>" in c
+        assert "SetUnionMergePolicy" in c
+
+
+class TestLockModels:
+    def test_fenced_mutex_rejects_stale_fence(self):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.models import get_model
+        from jepsen_tpu.models.base import Inconsistent
+        m = get_model("fenced-mutex")
+        m = m.step(Op(process=0, type="invoke", f="acquire",
+                      value={"client": "a", "fence": 5}))
+        m = m.step(Op(process=0, type="invoke", f="release",
+                      value={"client": "a"}))
+        bad = m.step(Op(process=1, type="invoke", f="acquire",
+                        value={"client": "b", "fence": 4}))
+        assert isinstance(bad, Inconsistent)
+
+    def test_reentrant_cap(self):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.models import get_model
+        from jepsen_tpu.models.base import Inconsistent
+        m = get_model("reentrant-mutex")
+        a = {"client": "a"}
+        m = m.step(Op(process=0, type="invoke", f="acquire", value=a))
+        m = m.step(Op(process=0, type="invoke", f="acquire", value=a))
+        assert isinstance(
+            m.step(Op(process=0, type="invoke", f="acquire", value=a)),
+            Inconsistent)
+
+    def test_semaphore_permits(self):
+        from jepsen_tpu.history import Op
+        from jepsen_tpu.models import get_model
+        from jepsen_tpu.models.base import Inconsistent
+        m = get_model("acquired-permits")
+        m = m.step(Op(process=0, type="invoke", f="acquire",
+                      value={"client": "a"}))
+        m = m.step(Op(process=1, type="invoke", f="acquire",
+                      value={"client": "b"}))
+        assert isinstance(
+            m.step(Op(process=2, type="invoke", f="acquire",
+                      value={"client": "c"})), Inconsistent)
+        m = m.step(Op(process=0, type="invoke", f="release",
+                      value={"client": "a"}))
+        assert not isinstance(
+            m.step(Op(process=2, type="invoke", f="acquire",
+                      value={"client": "c"})), Inconsistent)
+
+
+# --------------------------------------------------------------------------
+# RobustIRC
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def robustirc():
+    srv, port, state = start_fake_robustirc()
+    yield port, state
+    srv.shutdown()
+
+
+class TestRobustIrc:
+    def test_session_protocol(self, robustirc):
+        port, state = robustirc
+        from suites.robustirc.client import RobustSession, topic_values
+        s = RobustSession("127.0.0.1", port=port, scheme="http")
+        s.post_message("NICK a")
+        s.post_message("TOPIC #jepsen :1")
+        s.post_message("TOPIC #jepsen :2")
+        msgs = s.read_messages()
+        assert topic_values(msgs) == [1, 2]
+
+    def test_set_workload_valid(self, robustirc):
+        port, _ = robustirc
+        from suites.robustirc.runner import set_workload
+        wl = set_workload({})
+        run_wire_test(wl, "robustirc-set", port, time_limit=2.0,
+                      db_scheme="http")
+
+    def test_db_control_commands(self):
+        from suites.robustirc.db import RobustIrcDB
+        t = {"nodes": ["n1", "n2"],
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        db = RobustIrcDB()
+        db.setup(t, "n1")
+        db.setup(t, "n2")
+        log = "\n".join(t["remote"].log)
+        assert "-singlenode" in log
+        assert "-join=n1:13001" in log
+        assert "subjectAltName=DNS:n1,DNS:n2" in log
+        control.teardown_sessions(t)
