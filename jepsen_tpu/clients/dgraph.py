@@ -1,0 +1,146 @@
+"""Dgraph HTTP transaction client.
+
+Parity: the reference drives Dgraph over gRPC
+(dgraph/src/jepsen/dgraph/client.clj:52-457: open/txn/mutate!/query/
+upsert!/commit with TxnConflictException handling).  This is an
+independent implementation over Dgraph's public HTTP API, which exposes
+the same transaction model: /query returns a start_ts, /mutate?startTs=N
+buffers writes and returns touched keys/preds, /commit?startTs=N
+performs the OCC commit and signals conflicts ("Transaction has been
+aborted") — which map to definite failures, like TxnConflictException.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+ALPHA_HTTP_PORT = 8080
+
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+class DgraphError(Exception):
+    pass
+
+
+class TxnConflict(DgraphError):
+    """OCC abort — definitely not applied (client.clj:96-110)."""
+
+
+class DgraphClient:
+    def __init__(self, node: str, port: int = ALPHA_HTTP_PORT,
+                 timeout: float = 10.0):
+        self.base = f"http://{node}:{port}"
+        self.timeout = timeout
+
+    def _req(self, path: str, body: bytes, content_type: str) -> Dict:
+        req = urllib.request.Request(
+            self.base + path, data=body,
+            headers={"Content-Type": content_type})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                out = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            raise DgraphError(e.read().decode(errors="replace")) from e
+        errs = out.get("errors")
+        if errs:
+            msg = "; ".join(e.get("message", "") for e in errs)
+            if "aborted" in msg.lower() or "conflict" in msg.lower():
+                raise TxnConflict(msg)
+            raise DgraphError(msg)
+        return out
+
+    def alter_schema(self, schema: str) -> None:
+        self._req("/alter", json.dumps({"schema": schema}).encode(),
+                  "application/json")
+
+    def query(self, q: str, start_ts: Optional[int] = None,
+              read_only: bool = False) -> "QueryResult":
+        path = "/query"
+        params = []
+        if start_ts:
+            params.append(f"startTs={start_ts}")
+        if read_only:
+            params.append("ro=true")
+        if params:
+            path += "?" + "&".join(params)
+        out = self._req(path, q.encode(), "application/dql")
+        txn = (out.get("extensions") or {}).get("txn") or {}
+        return QueryResult(out.get("data") or {}, txn.get("start_ts"))
+
+    def mutate(self, start_ts: int, set_json: Optional[List] = None,
+               delete_json: Optional[List] = None) -> Dict[str, Any]:
+        """Buffer mutations in the transaction; returns {uids, keys,
+        preds}."""
+        body: Dict[str, Any] = {}
+        if set_json:
+            body["set"] = set_json
+        if delete_json:
+            body["delete"] = delete_json
+        out = self._req(f"/mutate?startTs={start_ts}",
+                        json.dumps(body).encode(), "application/json")
+        data = out.get("data") or {}
+        ext = (out.get("extensions") or {}).get("txn") or {}
+        return {"uids": data.get("uids") or {},
+                "keys": ext.get("keys") or [],
+                "preds": ext.get("preds") or []}
+
+    def commit(self, start_ts: int, keys: List[str],
+               preds: List[str]) -> None:
+        self._req(f"/commit?startTs={start_ts}",
+                  json.dumps({"keys": keys, "preds": preds}).encode(),
+                  "application/json")
+
+    def mutate_now(self, set_json: Optional[List] = None,
+                   delete_json: Optional[List] = None) -> Dict[str, Any]:
+        """commitNow one-shot mutation."""
+        body: Dict[str, Any] = {}
+        if set_json:
+            body["set"] = set_json
+        if delete_json:
+            body["delete"] = delete_json
+        out = self._req("/mutate?commitNow=true",
+                        json.dumps(body).encode(), "application/json")
+        return (out.get("data") or {})
+
+
+class QueryResult:
+    def __init__(self, data: Dict[str, Any], start_ts: Optional[int]):
+        self.data = data
+        self.start_ts = start_ts
+
+
+class Txn:
+    """Read-modify-write transaction helper mirroring client.clj's
+    with-txn/upsert! flow."""
+
+    def __init__(self, client: DgraphClient):
+        self.c = client
+        self.start_ts: Optional[int] = None
+        self.keys: List[str] = []
+        self.preds: List[str] = []
+
+    def query(self, q: str) -> Dict[str, Any]:
+        r = self.c.query(q, start_ts=self.start_ts)
+        if self.start_ts is None:
+            self.start_ts = r.start_ts
+        return r.data
+
+    def mutate(self, set_json: Optional[List] = None,
+               delete_json: Optional[List] = None) -> Dict[str, Any]:
+        if self.start_ts is None:
+            # a txn may start with a mutation: draw a ts from a no-op query
+            self.query("{ q(func: uid(0x1)) { uid } }")
+        r = self.c.mutate(self.start_ts, set_json, delete_json)
+        self.keys.extend(r["keys"])
+        self.preds.extend(r["preds"])
+        return r
+
+    def commit(self) -> None:
+        if self.start_ts is not None and (self.keys or self.preds):
+            self.c.commit(self.start_ts, self.keys, self.preds)

@@ -1,0 +1,144 @@
+"""Minimal FaunaDB FQL JSON client.
+
+Parity: the reference drives FaunaDB through the official Java driver
+(faunadb/src/jepsen/faunadb/client.clj:1-441, query.clj's FQL DSL).
+This is an independent implementation of the public FQL 2.x JSON wire
+form: one POST / per query (each query is one transaction), HTTP basic
+auth with the secret as username, expressions as operator-keyed JSON
+({"get": ref}, {"if": c, "then": t, "else": e}, {"let": ..., "in": ...}).
+Targets FaunaDB Enterprise 2.5.x — the version the reference tested.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+PORT = 8443
+SECRET = "secret"  # faunadb/auto.clj's default root key
+
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+class FaunaError(Exception):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"fauna {status}: {str(body)[:200]}")
+        self.status = status
+        self.body = body
+
+
+class AbortError(FaunaError):
+    """Explicit transaction abort() — definitely not applied."""
+
+
+class FaunaClient:
+    def __init__(self, node: str, port: int = PORT,
+                 secret: str = SECRET, timeout: float = 10.0,
+                 scheme: str = "http"):
+        self.base = f"{scheme}://{node}:{port}"
+        self.auth = base64.b64encode(f"{secret}:".encode()).decode()
+        self.timeout = timeout
+
+    def query(self, expr: Any) -> Any:
+        req = urllib.request.Request(
+            self.base + "/", data=json.dumps(expr).encode(),
+            headers={"Authorization": f"Basic {self.auth}",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                out = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            try:
+                parsed = json.loads(body)
+            except ValueError:
+                parsed = body
+            if "transaction aborted" in str(parsed) or \
+                    "abort" in str(parsed):
+                raise AbortError(e.code, parsed) from e
+            raise FaunaError(e.code, parsed) from e
+        return out.get("resource")
+
+
+# -- expression builders (query.clj's DSL shapes) ---------------------------
+
+def ref(cls: str, id_) -> Dict[str, Any]:
+    return {"@ref": f"classes/{cls}/{id_}"}
+
+
+def create_class(name: str) -> Dict[str, Any]:
+    return {"create_class": {"object": {"name": name}}}
+
+
+def create(cls: str, id_, data: Dict[str, Any]) -> Dict[str, Any]:
+    return {"create": ref(cls, id_),
+            "params": {"object": {"data": {"object": data}}}}
+
+
+def get(r) -> Dict[str, Any]:
+    return {"get": r}
+
+
+def update(r, data: Dict[str, Any]) -> Dict[str, Any]:
+    return {"update": r,
+            "params": {"object": {"data": {"object": data}}}}
+
+
+def delete(r) -> Dict[str, Any]:
+    return {"delete": r}
+
+
+def exists(r) -> Dict[str, Any]:
+    return {"exists": r}
+
+
+def select(path, from_, default=None) -> Dict[str, Any]:
+    out = {"select": path, "from": from_}
+    if default is not None:
+        out["default"] = default
+    return out
+
+
+def equals(*args) -> Dict[str, Any]:
+    return {"equals": list(args)}
+
+
+def if_(cond, then, else_) -> Dict[str, Any]:
+    return {"if": cond, "then": then, "else": else_}
+
+
+def abort(msg: str) -> Dict[str, Any]:
+    return {"abort": msg}
+
+
+def do(*exprs) -> Dict[str, Any]:
+    return {"do": list(exprs)}
+
+
+def let(bindings: Dict[str, Any], in_) -> Dict[str, Any]:
+    return {"let": bindings, "in": in_}
+
+
+def var(name: str) -> Dict[str, Any]:
+    return {"var": name}
+
+
+def add(*args) -> Dict[str, Any]:
+    return {"add": list(args)}
+
+
+def subtract(*args) -> Dict[str, Any]:
+    return {"subtract": list(args)}
+
+
+def lt(*args) -> Dict[str, Any]:
+    return {"lt": list(args)}
+
+
+def time_() -> Dict[str, Any]:
+    return {"time": "now"}

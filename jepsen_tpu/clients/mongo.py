@@ -153,6 +153,11 @@ class MongoClient:
         resp = self._read_msg()
         if resp.get("ok") != 1 and resp.get("ok") != 1.0:
             raise MongoError(resp)
+        # write commands report per-document and write-concern failures
+        # in an ok:1 reply; treating those as success would fabricate
+        # acknowledged-but-never-applied writes
+        if resp.get("writeErrors") or resp.get("writeConcernError"):
+            raise MongoError(resp)
         return resp
 
     # convenience ops used by the suites
@@ -172,6 +177,35 @@ class MongoClient:
         r = self.command({"findAndModify": coll, "query": query,
                           "update": update})
         return r.get("value")
+
+    def update(self, coll: str, flt: Dict[str, Any],
+               update: Dict[str, Any], upsert: bool = False,
+               write_concern: Optional[str] = None) -> int:
+        """Update matching docs; returns n matched.  write_concern is
+        "majority"/"1"/… (mongodb_smartos/document_cas.clj's
+        WriteConcern variants)."""
+        cmd: Dict[str, Any] = {"update": coll, "updates": [
+            {"q": flt, "u": update, "upsert": upsert}]}
+        if write_concern:
+            w: Any = int(write_concern) if write_concern.isdigit() \
+                else write_concern
+            cmd["writeConcern"] = {"w": w}
+        r = self.command(cmd)
+        return int(r.get("n", 0))
+
+    def insert(self, coll: str, doc: Dict[str, Any],
+               write_concern: Optional[str] = None) -> None:
+        cmd: Dict[str, Any] = {"insert": coll, "documents": [doc]}
+        if write_concern:
+            w: Any = int(write_concern) if write_concern.isdigit() \
+                else write_concern
+            cmd["writeConcern"] = {"w": w}
+        self.command(cmd)
+
+    def delete(self, coll: str, flt: Dict[str, Any]) -> int:
+        r = self.command({"delete": coll,
+                          "deletes": [{"q": flt, "limit": 0}]})
+        return int(r.get("n", 0))
 
     def _read_exact(self, n: int) -> bytes:
         while len(self.buf) < n:

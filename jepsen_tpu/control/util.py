@@ -95,9 +95,21 @@ def ensure_user(s: Session, username: str) -> None:
         s.exec("useradd", "--create-home", username)
 
 
+def self_safe_pattern(pattern: str) -> str:
+    """Bracket the first alphanumeric char ("asd" -> "[a]sd") so the
+    pkill regex can't match the wrapper shell whose own cmdline contains
+    the pattern — otherwise `bash -c 'pkill -f asd'` SIGKILLs itself."""
+    if "[" in pattern:
+        return pattern
+    for i, c in enumerate(pattern):
+        if c.isalnum():
+            return f"{pattern[:i]}[{c}]{pattern[i + 1:]}"
+    return pattern
+
+
 def grepkill(s: Session, pattern: str, signal: str = "KILL") -> None:
-    """Kill processes matching a pattern (util.clj:286)."""
-    s.exec_result("pkill", f"-{signal}", "-f", pattern)
+    """Kill processes whose cmdline matches a pattern (util.clj:286)."""
+    s.exec_result("pkill", f"-{signal}", "-f", self_safe_pattern(pattern))
 
 
 def signal(s: Session, process_name: str, sig: str) -> None:

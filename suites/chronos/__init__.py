@@ -1,0 +1,2 @@
+"""Chronos suite (reference: chronos/ — Mesos task scheduler: do
+scheduled jobs actually run when promised?)."""

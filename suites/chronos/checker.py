@@ -1,0 +1,105 @@
+"""Chronos schedule checker: match actual runs to expected targets.
+
+Parity: chronos/src/jepsen/chronos/checker.clj — each job implies a
+sequence of target windows [start, start+epsilon+forgiveness]; every
+target that must have begun before the final read needs a distinct
+completed run starting inside its window.  The reference solves the
+general case with the loco constraint solver (checker.clj:117-190); for
+point-runs-in-interval-targets, greedy matching on targets sorted by
+deadline (earliest-feasible run first) finds a perfect matching whenever
+one exists, so no solver dependency is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.history import History, OK
+
+EPSILON_FORGIVENESS = 5.0  # checker.clj:26-28
+
+
+def job_targets(read_time: float, job: Dict[str, Any]) -> List[Tuple]:
+    """[(start, deadline)] for every run that must have begun by the
+    read (checker.clj:30-47)."""
+    finish = read_time - job["epsilon"] - job["duration"]
+    out = []
+    t = job["start"]
+    for _ in range(job["count"]):
+        if t >= finish:
+            break
+        out.append((t, t + job["epsilon"] + EPSILON_FORGIVENESS))
+        t += job["interval"]
+    return out
+
+
+def match_targets(targets: List[Tuple], run_starts: List[float]):
+    """Greedy bipartite matching: targets by deadline, each takes the
+    earliest unused feasible run.  → (solution, unmatched_targets)."""
+    runs = sorted(run_starts)
+    used = [False] * len(runs)
+    solution = []
+    unmatched = []
+    for start, deadline in sorted(targets, key=lambda t: t[1]):
+        pick = None
+        for i, r in enumerate(runs):
+            if used[i] or r < start:
+                continue
+            if r > deadline:
+                break
+            pick = i
+            break
+        if pick is None:
+            unmatched.append((start, deadline))
+        else:
+            used[pick] = True
+            solution.append(((start, deadline), runs[pick]))
+    return solution, unmatched
+
+
+class ChronosChecker(Checker):
+    """Checks every submitted job's runs against its schedule
+    (checker.clj:192-240's solution map)."""
+
+    def check(self, test, history: History, opts=None):
+        jobs = [op.value for op in history
+                if op.f == "add-job" and op.type == OK]
+        reads = [op for op in history
+                 if op.f == "read" and op.type == OK]
+        if not reads:
+            return {"valid": UNKNOWN, "error": "no final read"}
+        read = reads[-1]
+        read_time = read.extra.get("read_time") or (read.time or 0) / 1e9
+        runs = read.value or []
+
+        by_name: Dict[Any, List[Dict]] = {}
+        for r in runs:
+            by_name.setdefault(r["name"], []).append(r)
+
+        results = {}
+        valid = True
+        extra_total, incomplete_total = 0, 0
+        for job in jobs:
+            jruns = by_name.get(job["name"], [])
+            complete = [r for r in jruns if r.get("end") is not None]
+            incomplete = [r for r in jruns if r.get("end") is None]
+            targets = job_targets(read_time, job)
+            sol, unmatched = match_targets(
+                targets, [r["start"] for r in complete])
+            ok = not unmatched
+            valid = valid and ok
+            extra_total += len(complete) - len(sol)
+            incomplete_total += len(incomplete)
+            results[job["name"]] = {
+                "valid": ok,
+                "targets": len(targets),
+                "solved": len(sol),
+                "unmatched": unmatched[:8],
+                "extra-runs": len(complete) - len(sol),
+                "incomplete-runs": len(incomplete)}
+        return {"valid": valid if jobs else UNKNOWN,
+                "job-count": len(jobs),
+                "extra-runs": extra_total,
+                "incomplete-runs": incomplete_total,
+                "jobs": results}

@@ -1,0 +1,113 @@
+"""Chronos client: submit ISO8601 repeating jobs; read run records.
+
+Parity: chronos/src/jepsen/chronos.clj:86-190 — add-job posts an
+iso8601 job whose command logs its name/start/end into a tempfile under
+job-dir; read collects those files from every node over the control
+plane and parses them into run records {node, name, start, end}.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import urllib.error
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import control
+from jepsen_tpu.clients.http import HttpClient, HttpError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites.chronos.db import JOB_DIR, PORT
+
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+def iso8601(t: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
+
+
+def parse_time(s: str) -> Optional[float]:
+    """date -u -Ins output (commas normalized, chronos.clj:143-149)."""
+    if not s:
+        return None
+    s = s.replace(",", ".")
+    base, _, rest = s.partition(".")
+    try:
+        t = time.mktime(time.strptime(base, "%Y-%m-%dT%H:%M:%S")) \
+            - time.timezone
+        frac = rest.split("+")[0].split("Z")[0]
+        return t + (float(f"0.{frac}") if frac else 0.0)
+    except ValueError:
+        return None
+
+
+def job_json(job: Dict[str, Any]) -> Dict[str, Any]:
+    """chronos.clj:119-133's job->json: the command logs name and
+    timestamps into a tempfile."""
+    command = (f"MEW=$(mktemp -p {JOB_DIR}); "
+               f"echo \"{job['name']}\" >> $MEW; "
+               f"date -u -Ins >> $MEW; "
+               f"sleep {job['duration']}; "
+               f"date -u -Ins >> $MEW;")
+    return {"name": str(job["name"]),
+            "command": command,
+            "schedule": (f"R{job['count']}/{iso8601(job['start'])}"
+                         f"/PT{job['interval']}S"),
+            "scheduleTimeZone": "UTC",
+            "owner": "jepsen@jepsen.io",
+            "epsilon": f"PT{job['epsilon']}S",
+            "mem": 1, "disk": 1, "cpus": 0.001, "async": False}
+
+
+def read_runs(test) -> List[Dict[str, Any]]:
+    """Collect and parse every run file from every node
+    (chronos.clj:151-170)."""
+    def per_node(t, node):
+        s = control.session(t, node)
+        files = s.exec("sh", "-c",
+                       f"ls {JOB_DIR} 2>/dev/null || true").split()
+        out = []
+        for f in files:
+            body = s.exec("sh", "-c", f"cat {JOB_DIR}{f} || true")
+            lines = body.split("\n")
+            if not lines or not lines[0].strip():
+                continue
+            out.append({"node": node,
+                        "name": int(lines[0]),
+                        "start": parse_time(lines[1].strip()
+                                            if len(lines) > 1 else ""),
+                        "end": parse_time(lines[2].strip()
+                                          if len(lines) > 2 else "")})
+        return out
+
+    runs: List[Dict[str, Any]] = []
+    for vals in control.on_nodes(test, per_node).values():
+        runs.extend(vals)
+    return [r for r in runs if r["start"] is not None]
+
+
+class ChronosClient(jclient.Client):
+    def __init__(self, node: Optional[str] = None):
+        self.node = node
+
+    def open(self, test, node):
+        return ChronosClient(node)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add-job":
+                c = HttpClient(self.node,
+                               int(test.get("db_port", PORT)),
+                               timeout=20.0)
+                c.post("/scheduler/iso8601", job_json(op.value))
+                return op.with_(type=OK)
+            if op.f == "read":
+                runs = read_runs(test)
+                return op.with_(type=OK, value=runs,
+                                extra={"read_time": time.time()})
+            raise ValueError(op.f)
+        except (HttpError, *NET_ERRORS) as e:
+            return op.with_(type=FAIL if op.f == "read" else INFO,
+                            error=str(e)[:200])

@@ -1,0 +1,64 @@
+"""Chronos + Mesos + Zookeeper install.
+
+Parity: chronos/src/jepsen/chronos.clj:40-85 (chronos deb over the
+mesosphere layer, schedule_horizon=1, job-dir) and jepsen.mesosphere
+(zookeeper + mesos master/slave on every node).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+PORT = 4400  # chronos.clj:25: "docs say 8080 but the package binds 4400"
+JOB_DIR = "/tmp/chronos-test/"
+MESOS_MASTER_PORT = 5050
+
+
+def zk_connect(test) -> str:
+    return "zk://" + ",".join(f"{n}:2181" for n in test["nodes"]) \
+        + "/mesos"
+
+
+class ChronosDB(jdb.DB, jdb.Kill, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "dpkg-query -l chronos >/dev/null 2>&1 || "
+               "apt-get install -y zookeeper mesos chronos")
+        # mesos zk coordination + quorum
+        cu.write_file(s, zk_connect(test), "/etc/mesos/zk")
+        cu.write_file(s, str(len(test["nodes"]) // 2 + 1),
+                      "/etc/mesos-master/quorum")
+        # lower the scheduler horizon (chronos.clj:40-45)
+        s.exec("mkdir", "-p", "/etc/chronos/conf", JOB_DIR)
+        cu.write_file(s, "1", "/etc/chronos/conf/schedule_horizon")
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=240)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        for svc in ("chronos", "mesos-master", "mesos-slave"):
+            s.exec("sh", "-c", f"service {svc} stop || true")
+        cu.grepkill(s, "chronos")
+        s.exec("rm", "-rf", JOB_DIR)
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        for svc in ("zookeeper", "mesos-master", "mesos-slave",
+                    "chronos"):
+            s.exec("sh", "-c",
+                   f"service {svc} status >/dev/null 2>&1 || "
+                   f"service {svc} start")
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "chronos")
+        cu.grepkill(s, "mesos-master")
+
+    def log_files(self, test, node) -> List[str]:
+        return ["/var/log/mesos/mesos-master.INFO",
+                "/var/log/messages"]

@@ -1,0 +1,113 @@
+"""Chronos suite CLI.
+
+Parity: chronos/src/jepsen/chronos.clj:174-270 — random repeating jobs
+(non-overlapping intervals so runs can't collide), a resurrection-hub
+nemesis that restarts crashed mesos/chronos daemons alongside
+random-halves partitions, and a final read of the run logs checked
+against the schedule.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict
+
+from jepsen_tpu import control
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnem
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.nemesis.partition import (Partitioner,
+                                          random_halves_grudge)
+
+from suites import common
+from suites.chronos.checker import EPSILON_FORGIVENESS, ChronosChecker
+from suites.chronos.client import ChronosClient
+from suites.chronos.db import ChronosDB
+
+
+class ResurrectionHub(jnem.Nemesis):
+    """Restart every mesos/chronos daemon (chronos.clj:220-240's
+    resurrection-hub) and route partition ops to the partitioner."""
+
+    def __init__(self, db: ChronosDB):
+        self.db = db
+        self.part = Partitioner(random_halves_grudge)
+
+    def setup(self, test):
+        self.part = self.part.setup(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "resurrect":
+            def revive(t, node):
+                self.db.start(t, node)
+                return "resurrected"
+            return op.with_(type="info",
+                            value=control.on_nodes(test, revive))
+        return self.part.invoke(test, op)
+
+    def teardown(self, test):
+        self.part.teardown(test)
+
+    def fs(self):
+        return ["resurrect", *self.part.fs()]
+
+
+def hub_package(opts: Dict[str, Any]) -> combined.Package:
+    db = opts.get("_db") or ChronosDB()
+    interval = float(opts.get("interval", 30.0))
+    g = gen.stagger(interval, gen.cycle(gen.lift([
+        {"f": "start-partition", "type": "info"},
+        {"f": "stop-partition", "type": "info"},
+        {"f": "resurrect", "type": "info"}])))
+    return combined.Package(
+        nemesis=ResurrectionHub(db), generator=g,
+        final_generator=[{"f": "stop-partition", "type": "info"},
+                         {"f": "resurrect", "type": "info"}])
+
+
+NEMESES = dict(common.STANDARD_NEMESES)
+NEMESES["hub"] = hub_package
+
+
+def jobs_workload(opts) -> Dict[str, Any]:
+    """Random non-overlapping repeating jobs (chronos.clj:174-196)."""
+    counter = iter(range(1, 10 ** 9))
+    head_start = float(opts.get("head_start", 10.0))
+
+    def one():
+        duration = random.randint(0, 9)
+        epsilon = 10 + random.randint(0, 19)
+        interval = (1 + duration + epsilon + EPSILON_FORGIVENESS
+                    + random.randint(0, 29))
+        return {"f": "add-job",
+                "value": {"name": next(counter),
+                          "start": time.time() + head_start,
+                          "count": 1 + random.randint(0, 98),
+                          "duration": duration,
+                          "epsilon": epsilon,
+                          "interval": int(interval)}}
+
+    return {"client": ChronosClient(),
+            "generator": gen.stagger(30.0, gen.FnGen(one)),
+            "final_generator": gen.once({"f": "read"}),
+            "checker": ChronosChecker()}
+
+
+WORKLOADS = {"jobs": jobs_workload}
+
+
+def chronos_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="chronos", db=ChronosDB(),
+                             workloads=WORKLOADS, nemeses=NEMESES)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, chronos_test, WORKLOADS, NEMESES)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(chronos_test, WORKLOADS, NEMESES,
+                         prog="jepsen-tpu-chronos"))

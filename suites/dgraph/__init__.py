@@ -1,0 +1,2 @@
+"""Dgraph suite (reference: dgraph/ — transactional graph database:
+bank, upsert, delete, sequential, register, and set workloads)."""

@@ -1,0 +1,259 @@
+"""Dgraph workload clients over HTTP transactions.
+
+Parity: the reference's per-workload clients — bank.clj:36-140 (account
+nodes with key/amount predicates, transactional transfers; the
+reference stripes across 7 predicates for contention, we use one,
+citing the simplification), upsert.clj (query-then-insert races on an
+@upsert index), delete.clj (read/insert/delete mix), sequential.clj
+(per-key counters read monotonically), linearizable_register.clj
+(registers keyed by an indexed predicate), set.clj (values under one
+predicate).  Txn conflicts are definite failures
+(client.clj:96-110's TxnConflictException).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.dgraph import (ALPHA_HTTP_PORT, DgraphClient,
+                                       DgraphError, NET_ERRORS, Txn,
+                                       TxnConflict)
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+SCHEMA = """\
+key: int @index(int) @upsert .
+amount: int .
+type: string @index(exact) .
+value: int .
+"""
+
+
+def connect(test, node) -> DgraphClient:
+    return DgraphClient(node, int(test.get("db_port", ALPHA_HTTP_PORT)))
+
+
+class _DgraphBase(jclient.Client):
+    def __init__(self, conn: Optional[DgraphClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return type(self)(connect(test, node))
+
+    def setup(self, test):
+        try:
+            self.conn.alter_schema(SCHEMA)
+        except (DgraphError, *NET_ERRORS):
+            pass
+
+    def _convert(self, op: Op, e: Exception) -> Op:
+        if isinstance(e, TxnConflict):
+            return op.with_(type=FAIL, error="txn-conflict")
+        if op.f == "read":
+            return op.with_(type=FAIL, error=str(e)[:200])
+        return op.with_(type=INFO, error=str(e)[:200])
+
+
+def find_by_key(txn: Txn, k) -> Optional[Dict[str, Any]]:
+    data = txn.query(
+        '{ q(func: eq(key, %d)) { uid key amount value } }' % int(k))
+    q = data.get("q") or []
+    return q[0] if q else None
+
+
+class BankClient(_DgraphBase):
+    """Accounts are nodes {type: account, key, amount}
+    (bank.clj:36-140, single-predicate layout)."""
+
+    def setup(self, test):
+        super().setup(test)
+        wl = test.get("bank", {})
+        accounts = wl.get("accounts", list(range(8)))
+        total = wl.get("total_amount", 100)
+        per = total // len(accounts)
+        try:
+            txn = Txn(self.conn)
+            if not (txn.query('{ q(func: eq(type, "account")) { uid } }')
+                    .get("q")):
+                sets = []
+                for i, a in enumerate(accounts):
+                    amt = per + (total - per * len(accounts)
+                                 if i == 0 else 0)
+                    sets.append({"uid": f"_:a{a}", "type": "account",
+                                 "key": a, "amount": amt})
+                txn.mutate(set_json=sets)
+                txn.commit()
+        except (DgraphError, *NET_ERRORS):
+            pass  # seeded by another client / node down
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            txn = Txn(self.conn)
+            if op.f == "read":
+                data = txn.query(
+                    '{ q(func: eq(type, "account")) { key amount } }')
+                vals = {r["key"]: r["amount"]
+                        for r in data.get("q", [])}
+                return op.with_(type=OK, value=vals)
+            if op.f == "transfer":
+                v = op.value
+                frm = find_by_key(txn, v["from"])
+                to = find_by_key(txn, v["to"])
+                if frm is None or to is None:
+                    return op.with_(type=FAIL, error="missing account")
+                if frm["amount"] < v["amount"]:
+                    return op.with_(type=FAIL,
+                                    error="insufficient funds")
+                txn.mutate(set_json=[
+                    {"uid": frm["uid"],
+                     "amount": frm["amount"] - v["amount"]},
+                    {"uid": to["uid"],
+                     "amount": to["amount"] + v["amount"]}])
+                txn.commit()
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class UpsertClient(_DgraphBase):
+    """Racing query-then-insert upserts per key; reads return the uids
+    holding the key (upsert.clj)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            txn = Txn(self.conn)
+            if op.f == "upsert":
+                if find_by_key(txn, k) is not None:
+                    return op.with_(type=FAIL, error="exists")
+                txn.mutate(set_json=[{"uid": "_:n", "key": int(k)}])
+                txn.commit()
+                return op.with_(type=OK)
+            if op.f == "read":
+                data = txn.query(
+                    '{ q(func: eq(key, %d)) { uid } }' % int(k))
+                uids = [r["uid"] for r in data.get("q", [])]
+                return op.with_(type=OK, value=(k, uids))
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class DeleteClient(_DgraphBase):
+    """read / upsert-insert / delete mix per key (delete.clj): reads must
+    see whole records or nothing."""
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            txn = Txn(self.conn)
+            rec = find_by_key(txn, k)
+            if op.f == "read":
+                if rec is None:
+                    return op.with_(type=OK, value=(k, None))
+                return op.with_(type=OK,
+                                value=(k, {f: rec.get(f)
+                                           for f in ("key", "value")}))
+            if op.f == "insert":
+                if rec is not None:
+                    return op.with_(type=FAIL, error="exists")
+                txn.mutate(set_json=[{"uid": "_:n", "key": int(k),
+                                      "value": int(v or 0)}])
+                txn.commit()
+                return op.with_(type=OK)
+            if op.f == "delete":
+                if rec is None:
+                    return op.with_(type=FAIL, error="missing")
+                txn.mutate(delete_json=[{"uid": rec["uid"]}])
+                txn.commit()
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class SequentialClient(_DgraphBase):
+    """Per-key counters incremented transactionally; successive reads by
+    one process must be monotonic (sequential.clj)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            txn = Txn(self.conn)
+            rec = find_by_key(txn, k)
+            if op.f == "inc":
+                if rec is None:
+                    txn.mutate(set_json=[{"uid": "_:n", "key": int(k),
+                                          "value": 1}])
+                else:
+                    txn.mutate(set_json=[{"uid": rec["uid"],
+                                          "value": rec["value"] + 1}])
+                txn.commit()
+                return op.with_(type=OK)
+            if op.f == "read":
+                return op.with_(
+                    type=OK,
+                    value=(k, rec["value"] if rec else 0))
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class RegisterClient(_DgraphBase):
+    """Independent CAS registers on {key, value} nodes
+    (linearizable_register.clj)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            txn = Txn(self.conn)
+            rec = find_by_key(txn, k)
+            if op.f == "read":
+                return op.with_(type=OK,
+                                value=(k, rec["value"] if rec else None))
+            if op.f == "write":
+                if rec is None:
+                    txn.mutate(set_json=[{"uid": "_:n", "key": int(k),
+                                          "value": int(v)}])
+                else:
+                    txn.mutate(set_json=[{"uid": rec["uid"],
+                                          "value": int(v)}])
+                txn.commit()
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                if rec is None or rec.get("value") != old:
+                    return op.with_(type=FAIL, error="precondition")
+                txn.mutate(set_json=[{"uid": rec["uid"],
+                                      "value": int(new)}])
+                txn.commit()
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class SetClient(_DgraphBase):
+    """Grow-only set: each element is a node {type: element, value}
+    (set.clj)."""
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            txn = Txn(self.conn)
+            if op.f == "add":
+                txn.mutate(set_json=[{"uid": "_:n", "type": "element",
+                                      "value": int(op.value)}])
+                txn.commit()
+                return op.with_(type=OK)
+            if op.f == "read":
+                data = txn.query(
+                    '{ q(func: eq(type, "element")) { value } }')
+                return op.with_(type=OK,
+                                value=sorted(r["value"]
+                                             for r in data.get("q", [])))
+            raise ValueError(op.f)
+        except (TxnConflict, DgraphError, *NET_ERRORS) as e:
+            return self._convert(op, e)

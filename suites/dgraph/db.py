@@ -1,0 +1,97 @@
+"""Dgraph install: one zero group + one alpha per node.
+
+Parity: dgraph/src/jepsen/dgraph/support.clj — binary download, dgraph
+zero on node 1 (peers follow), dgraph alpha on every node pointed at the
+zeros, ports 5080/6080 (zero) and 7080/8080/9080 (alpha).  Kill/pause
+target alpha and zero separately (nemesis.clj's kill-alpha/kill-zero).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "23.1.0"
+URL = (f"https://github.com/dgraph-io/dgraph/releases/download/"
+       f"v{VERSION}/dgraph-linux-amd64.tar.gz")
+DIR = "/opt/dgraph"
+BIN = f"{DIR}/dgraph"
+ZERO_PORT = 5080
+ALPHA_HTTP = 8080
+ZERO_PID, ZERO_LOG = "/var/run/dgraph-zero.pid", "/var/log/dgraph-zero.log"
+ALPHA_PID, ALPHA_LOG = ("/var/run/dgraph-alpha.pid",
+                        "/var/log/dgraph-alpha.log")
+
+
+def zero_node(test) -> str:
+    return test["nodes"][0]
+
+
+class DgraphDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        cu.install_archive(s, URL, DIR)
+        s.exec("mkdir", "-p", f"{DIR}/data")
+        self.start_zero(test, node)
+        self.start_alpha(test, node)
+        cu.await_tcp_port(s, ALPHA_HTTP, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "dgraph")
+        s.exec("sh", "-c",
+               f"rm -rf {DIR}/data {ZERO_PID} {ALPHA_PID} "
+               f"{ZERO_LOG} {ALPHA_LOG}")
+
+    # -- role-level start/stop (nemesis.clj kill-alpha / kill-zero) -------
+
+    def start_zero(self, test, node):
+        s = session(test, node).sudo()
+        idx = test["nodes"].index(node) + 1
+        args = ["zero", "--my", f"{node}:{ZERO_PORT}",
+                "--raft", f"idx={idx}",
+                "--wal", f"{DIR}/data/zw"]
+        if node != zero_node(test):
+            args += ["--peer", f"{zero_node(test)}:{ZERO_PORT}"]
+        cu.start_daemon(s, BIN, *args, chdir=DIR,
+                        pidfile=ZERO_PID, logfile=ZERO_LOG)
+
+    def start_alpha(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(s, BIN, "alpha",
+                        "--my", f"{node}:7080",
+                        "--zero", f"{zero_node(test)}:{ZERO_PORT}",
+                        "--postings", f"{DIR}/data/p",
+                        "--wal", f"{DIR}/data/w",
+                        "--security", "whitelist=0.0.0.0/0",
+                        chdir=DIR, pidfile=ALPHA_PID, logfile=ALPHA_LOG)
+
+    def stop_zero(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "dgraph zero")
+        s.exec("rm", "-f", ZERO_PID)
+
+    def stop_alpha(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "dgraph alpha")
+        s.exec("rm", "-f", ALPHA_PID)
+
+    def start(self, test, node):
+        self.start_zero(test, node)
+        self.start_alpha(test, node)
+
+    def kill(self, test, node):
+        self.stop_alpha(test, node)
+        self.stop_zero(test, node)
+
+    def pause(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "dgraph", signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "dgraph", signal="CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [ZERO_LOG, ALPHA_LOG]

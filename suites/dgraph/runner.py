@@ -1,0 +1,192 @@
+"""Dgraph suite CLI: workload + nemesis registries.
+
+Parity: dgraph/src/jepsen/dgraph/core.clj:28-45's workload registry
+(bank, upsert, delete, sequential, linearizable-register, set — types/wr
+variants covered by the shared sql/elle kits elsewhere) and
+nemesis.clj's kill-alpha / kill-zero / partition / clock options.
+Checkers: upsert.clj:40-70 (at most one uid per key), delete.clj:80-88
+(reads see whole records or nothing), sequential.clj:180-235 (per-process
+monotonic reads per key).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.checker.core import Checker, SetChecker
+from jepsen_tpu.history import History, INVOKE, OK
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.nemesis.faults import NodeStartStopper
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.dgraph import client as dc
+from suites.dgraph.db import DgraphDB
+
+
+class UpsertChecker(Checker):
+    """Each key must resolve to at most one uid (upsert.clj:40-70)."""
+
+    def check(self, test, history: History, opts=None):
+        bad = [op.to_dict() for op in history
+               if op.type == OK and op.f == "read"
+               and op.value is not None and len(op.value) > 1]
+        upserts = sum(1 for op in history
+                      if op.type == OK and op.f == "upsert")
+        return {"valid": not bad, "ok-upserts": upserts,
+                "bad-reads": bad[:16]}
+
+
+class DeleteChecker(Checker):
+    """Reads must see whole records: a record with a key but a missing
+    value is a partial visibility anomaly (delete.clj:80-88)."""
+
+    def check(self, test, history: History, opts=None):
+        bad = [op.to_dict() for op in history
+               if op.type == OK and op.f == "read"
+               and op.value is not None
+               and (op.value.get("key") is None) !=
+                   (op.value.get("value") is None)]
+        return {"valid": not bad, "bad-reads": bad[:16]}
+
+
+class SequentialChecker(Checker):
+    """Per-process reads of one key must be non-decreasing
+    (sequential.clj:180-235)."""
+
+    def check(self, test, history: History, opts=None):
+        last: Dict[Any, int] = {}
+        bad = []
+        for op in history:
+            if op.type == OK and op.f == "read" and op.value is not None:
+                prev = last.get(op.process)
+                if prev is not None and op.value < prev:
+                    bad.append({**op.to_dict(), "prev": prev})
+                last[op.process] = op.value
+        return {"valid": not bad, "nonmonotonic": bad[:16]}
+
+
+def _role_package(opts, role: str) -> combined.Package:
+    """Kill/restart one dgraph role on a random node
+    (nemesis.clj's kill-alpha / kill-zero)."""
+    db = DgraphDB()
+    stop = getattr(db, f"stop_{role}")
+    start = getattr(db, f"start_{role}")
+    nem = NodeStartStopper(
+        targeter=lambda test, nodes: [random.choice(list(nodes))],
+        stop_fn=stop, start_fn=start)
+    g = gen.stagger(opts.get("interval", 10.0), gen.cycle(gen.lift([
+        {"f": "start", "type": "info"},
+        {"f": "stop", "type": "info"}])))
+    return combined.Package(nemesis=nem, generator=g,
+                            final_generator=[{"f": "stop",
+                                              "type": "info"}])
+
+
+NEMESES = dict(common.STANDARD_NEMESES)
+NEMESES["kill-alpha"] = lambda o: _role_package(o, "alpha")
+NEMESES["kill-zero"] = lambda o: _role_package(o, "zero")
+
+
+def bank_workload(opts) -> Dict[str, Any]:
+    wl = bank_wl.workload()
+    return {**wl, "client": dc.BankClient()}
+
+
+def upsert_workload(opts) -> Dict[str, Any]:
+    keys = list(range(int(opts.get("keys", 8))))
+    return {
+        "client": dc.UpsertClient(),
+        "generator": independent.concurrent_generator(
+            2, keys,
+            lambda k: gen.phases(
+                gen.each_thread(gen.once({"f": "upsert"})),
+                gen.each_thread(gen.once({"f": "read"})))),
+        "checker": independent.checker(UpsertChecker())}
+
+
+def delete_workload(opts) -> Dict[str, Any]:
+    keys = list(range(int(opts.get("keys", 8))))
+
+    def per_key(k):
+        return gen.limit(int(opts.get("ops_per_key", 100)), gen.mix([
+            gen.repeat({"f": "read"}),
+            gen.FnGen(lambda: {"f": "insert",
+                               "value": random.randrange(100)}),
+            gen.repeat({"f": "delete"})]))
+
+    return {"client": dc.DeleteClient(),
+            "generator": independent.concurrent_generator(2, keys,
+                                                          per_key),
+            "checker": independent.checker(DeleteChecker())}
+
+
+def sequential_workload(opts) -> Dict[str, Any]:
+    keys = list(range(int(opts.get("keys", 8))))
+
+    def per_key(k):
+        return gen.limit(int(opts.get("ops_per_key", 100)), gen.mix([
+            gen.repeat({"f": "inc"}), gen.repeat({"f": "read"})]))
+
+    return {"client": dc.SequentialClient(),
+            "generator": independent.concurrent_generator(2, keys,
+                                                          per_key),
+            "checker": independent.checker(SequentialChecker())}
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 80)),
+        threads_per_key=2)
+    return {**wl, "client": dc.RegisterClient()}
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    counter = iter(range(10 ** 9))
+    return {"client": dc.SetClient(),
+            "generator": gen.stagger(
+                1 / 20, gen.FnGen(lambda: {"f": "add",
+                                           "value": next(counter)})),
+            "final_generator": gen.once({"f": "read"}),
+            "checker": SetChecker()}
+
+
+WORKLOADS = {
+    "bank": bank_workload,
+    "upsert": upsert_workload,
+    "delete": delete_workload,
+    "sequential": sequential_workload,
+    "linearizable-register": register_workload,
+    "set": set_workload,
+}
+
+
+def dgraph_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    t = common.build_test(opts, suite="dgraph", db=DgraphDB(),
+                          workloads=WORKLOADS, nemeses=NEMESES)
+    if opts.get("workload") == "bank":
+        t["bank"] = {"accounts": list(range(8)),
+                     "total_amount": int(opts.get("total_amount", 100))}
+    return t
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, dgraph_test, WORKLOADS, NEMESES)
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=100)
+    parser.add_argument("--total-amount", type=int, default=100)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(dgraph_test, WORKLOADS, NEMESES,
+                         prog="jepsen-tpu-dgraph", extra_opts=_extra,
+                         default_workload="bank"))

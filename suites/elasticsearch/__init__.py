@@ -1,0 +1,2 @@
+"""Elasticsearch suite (reference: elasticsearch/ — set and dirty-read
+workloads probing lost updates and uncommitted visibility)."""

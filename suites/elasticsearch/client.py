@@ -1,0 +1,122 @@
+"""Elasticsearch REST clients.
+
+Parity: elasticsearch/src/jepsen/elasticsearch/sets.clj (create docs into
+an index; final search-all read) and dirty_read.clj:30-104 (write a doc
+with a known id, read it back by id, strong-read = refresh + search-all).
+"""
+
+from __future__ import annotations
+
+import socket
+import urllib.error
+from typing import List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.http import HttpClient, HttpError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+HTTP_PORT = 9200
+INDEX = "jepsen"
+NET_ERRORS = (urllib.error.URLError, ConnectionError, OSError,
+              socket.timeout, TimeoutError)
+
+
+def connect(test, node) -> HttpClient:
+    return HttpClient(node, int(test.get("db_port", HTTP_PORT)),
+                      timeout=10.0)
+
+
+def search_all_ids(conn: HttpClient, index: str) -> List[int]:
+    """Search every document id, paging with search_after so reads past
+    the 10k result window can't silently truncate (the reference's
+    full-index search, elasticsearch/core.clj:125-151)."""
+    out: List[int] = []
+    after = None
+    while True:
+        body = {"size": 1000, "query": {"match_all": {}},
+                "_source": ["id"], "sort": [{"_id": "asc"}]}
+        if after is not None:
+            body["search_after"] = after
+        _, r = conn.post(f"/{index}/_search", body)
+        hits = (r.get("hits") or {}).get("hits") or []
+        if not hits:
+            break
+        out.extend(int(h["_source"]["id"]) for h in hits)
+        after = hits[-1].get("sort")
+        if after is None:  # server without sort support: one page only
+            break
+    return sorted(out)
+
+
+class SetClient(jclient.Client):
+    """Insert docs as set elements; read = refresh + search-all
+    (sets.clj:29-100)."""
+
+    def __init__(self, conn: Optional[HttpClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        c = connect(test, node)
+        try:
+            c.put(f"/{INDEX}")
+        except (HttpError, *NET_ERRORS):
+            pass  # already exists / node down; setup retried by writes
+        return SetClient(c)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.post(f"/{INDEX}/_doc/{op.value}",
+                               {"id": op.value})
+                return op.with_(type=OK)
+            if op.f == "read":
+                self.conn.post(f"/{INDEX}/_refresh")
+                return op.with_(type=OK,
+                                value=search_all_ids(self.conn, INDEX))
+            raise ValueError(op.f)
+        except (HttpError, *NET_ERRORS) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e)[:200])
+            return op.with_(type=INFO, error=str(e)[:200])
+
+
+class DirtyReadClient(jclient.Client):
+    """write / read-by-id / strong-read (dirty_read.clj:52-104)."""
+
+    def __init__(self, conn: Optional[HttpClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        c = connect(test, node)
+        try:
+            c.put(f"/{INDEX}")
+        except (HttpError, *NET_ERRORS):
+            pass
+        return DirtyReadClient(c)
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self.conn.post(f"/{INDEX}/_doc/{op.value}",
+                               {"id": op.value})
+                return op.with_(type=OK)
+            if op.f == "read":
+                try:
+                    _, r = self.conn.get(f"/{INDEX}/_doc/{op.value}")
+                except HttpError as e:
+                    if e.status == 404:
+                        return op.with_(type=FAIL)
+                    raise
+                return op.with_(type=OK if r.get("found") else FAIL)
+            if op.f == "refresh":
+                self.conn.post(f"/{INDEX}/_refresh")
+                return op.with_(type=OK)
+            if op.f == "strong-read":
+                self.conn.post(f"/{INDEX}/_refresh")
+                return op.with_(type=OK,
+                                value=search_all_ids(self.conn, INDEX))
+            raise ValueError(op.f)
+        except (HttpError, *NET_ERRORS) as e:
+            if op.f in ("read", "strong-read"):
+                return op.with_(type=FAIL, error=str(e)[:200])
+            return op.with_(type=INFO, error=str(e)[:200])

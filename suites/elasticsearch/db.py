@@ -1,0 +1,67 @@
+"""Elasticsearch install/config.
+
+Parity: elasticsearch/src/jepsen/elasticsearch/core.clj:212-296 — deb
+install, elasticsearch.yml with unicast discovery over the test's nodes
+and a cluster name, service start, teardown nukes the data dir.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+VERSION = "7.17.9"
+URL = (f"https://artifacts.elastic.co/downloads/elasticsearch/"
+       f"elasticsearch-{VERSION}-amd64.deb")
+CONF = "/etc/elasticsearch/elasticsearch.yml"
+LOGFILE = "/var/log/elasticsearch/jepsen.log"
+DATA = "/var/lib/elasticsearch"
+HTTP_PORT = 9200
+
+
+def config(test, node) -> str:
+    hosts = ", ".join(f'"{n}"' for n in test["nodes"])
+    return (f"cluster.name: jepsen\n"
+            f"node.name: {node}\n"
+            f"network.host: 0.0.0.0\n"
+            f"http.port: {HTTP_PORT}\n"
+            f"discovery.seed_hosts: [{hosts}]\n"
+            f"cluster.initial_master_nodes: [{hosts}]\n")
+
+
+class ElasticsearchDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "dpkg-query -l elasticsearch >/dev/null 2>&1 || "
+               f"{{ wget -nv -O /tmp/es.deb {URL} && "
+               "dpkg -i --force-confnew /tmp/es.deb; }")
+        cu.write_file(s, config(test, node), CONF)
+        self.start(test, node)
+        cu.await_tcp_port(s, HTTP_PORT, timeout_s=240)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "elasticsearch")
+        s.exec("sh", "-c", f"rm -rf {DATA}/* || true")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec("service", "elasticsearch",
+                                        "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "elasticsearch")
+
+    def pause(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "elasticsearch",
+                    signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "elasticsearch",
+                    signal="CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return ["/var/log/elasticsearch/jepsen.log"]

@@ -1,0 +1,98 @@
+"""Elasticsearch suite CLI.
+
+Parity: elasticsearch/src/jepsen/elasticsearch — set workload
+(sets.clj) and the dirty-read workload + checker (dirty_read.clj:
+106-156: dirty = reads never visible in any strong read; lost =
+acknowledged writes missing from every strong read; nodes must agree).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, List
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, SetChecker
+from jepsen_tpu.history import History, OK
+
+from suites import common
+from suites.elasticsearch.client import DirtyReadClient, SetClient
+from suites.elasticsearch.db import ElasticsearchDB
+
+
+class DirtyReadChecker(Checker):
+    """dirty_read.clj:106-156's set algebra."""
+
+    def check(self, test, history: History, opts=None):
+        ok = [op for op in history if op.type == OK]
+        writes = {op.value for op in ok if op.f == "write"}
+        reads = {op.value for op in ok if op.f == "read"}
+        strong = [set(op.value or []) for op in ok
+                  if op.f == "strong-read"]
+        if not strong:
+            return {"valid": "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = sorted(reads - on_some)
+        lost = sorted(writes - on_some)
+        some_lost = sorted(writes - on_all)
+        nodes_agree = on_all == on_some
+        return {"valid": nodes_agree and not dirty and not lost,
+                "nodes-agree": nodes_agree,
+                "read-count": len(reads),
+                "on-all-count": len(on_all),
+                "on-some-count": len(on_some),
+                "not-on-all": sorted(on_some - on_all)[:32],
+                "dirty-count": len(dirty), "dirty": dirty[:32],
+                "lost-count": len(lost), "lost": lost[:32],
+                "some-lost-count": len(some_lost)}
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    counter = itertools.count()
+    return {"client": SetClient(),
+            "generator": gen.stagger(
+                1 / 50, gen.FnGen(lambda: {"f": "add",
+                                           "value": next(counter)})),
+            "final_generator": gen.once({"f": "read"}),
+            "checker": SetChecker()}
+
+
+def dirty_read_workload(opts) -> Dict[str, Any]:
+    """Writers stream increasing ids; readers probe recent writes; every
+    worker ends with a strong read (dirty_read.clj:158-189)."""
+    counter = itertools.count()
+    in_flight: List[int] = []
+
+    def one():
+        if in_flight and random.random() < 0.5:
+            return {"f": "read", "value": random.choice(in_flight[-10:])}
+        v = next(counter)
+        in_flight.append(v)
+        return {"f": "write", "value": v}
+
+    return {"client": DirtyReadClient(),
+            "generator": gen.stagger(1 / 50, gen.FnGen(one)),
+            "final_generator": gen.each_thread(gen.lift(
+                [gen.once({"f": "refresh"}),
+                 gen.once({"f": "strong-read"})])),
+            "checker": DirtyReadChecker()}
+
+
+WORKLOADS = {"set": set_workload, "dirty-read": dirty_read_workload}
+
+
+def elasticsearch_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(opts, suite="elasticsearch",
+                             db=ElasticsearchDB(), workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, elasticsearch_test, WORKLOADS)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(elasticsearch_test, WORKLOADS,
+                         prog="jepsen-tpu-elasticsearch"))

@@ -1,0 +1,3 @@
+"""FaunaDB suite (reference: faunadb/ — the largest reference suite:
+register, bank, set, and monotonic workloads over single-query FQL
+transactions)."""

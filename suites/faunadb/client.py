@@ -1,0 +1,197 @@
+"""FaunaDB workload clients — every op is one FQL transaction.
+
+Parity: faunadb/src/jepsen/faunadb/register.clj (per-key register
+instances, CAS via if/equals/abort), bank.clj:43-140 (account instances,
+transfers as let + balance check + two updates), set.clj (element
+instances, strong read = map get over refs), monotonic.clj (a register
+incremented transactionally; reads return [ts, value] pairs that must be
+monotonic together).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients import fauna as fq
+from jepsen_tpu.clients.fauna import (AbortError, FaunaClient, FaunaError,
+                                      NET_ERRORS)
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+REGISTERS = "registers"
+ACCOUNTS = "accounts"
+ELEMENTS = "elements"
+COUNTERS = "counters"
+
+
+def connect(test, node) -> FaunaClient:
+    return FaunaClient(node, int(test.get("db_port", fq.PORT)),
+                       scheme=test.get("db_scheme", "http"))
+
+
+class _FaunaBase(jclient.Client):
+    CLASS: str = ""
+
+    def __init__(self, conn: Optional[FaunaClient] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return type(self)(connect(test, node))
+
+    def setup(self, test):
+        try:
+            self.conn.query(fq.create_class(self.CLASS))
+        except (FaunaError, *NET_ERRORS):
+            pass  # exists
+
+    def _convert(self, op: Op, e: Exception) -> Op:
+        if isinstance(e, AbortError):
+            return op.with_(type=FAIL, error="abort")
+        if op.f == "read":
+            return op.with_(type=FAIL, error=str(e)[:200])
+        return op.with_(type=INFO, error=str(e)[:200])
+
+
+def _value_of(r, default=None):
+    return fq.select(["data", "value"], fq.get(r), default=default)
+
+
+class RegisterClient(_FaunaBase):
+    CLASS = REGISTERS
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        r = fq.ref(self.CLASS, k)
+        try:
+            if op.f == "read":
+                val = self.conn.query(
+                    fq.if_(fq.exists(r), _value_of(r), None))
+                return op.with_(type=OK, value=(k, val))
+            if op.f == "write":
+                self.conn.query(
+                    fq.if_(fq.exists(r),
+                           fq.update(r, {"value": v}),
+                           fq.create(self.CLASS, k, {"value": v})))
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                self.conn.query(
+                    fq.if_(fq.equals(
+                        fq.if_(fq.exists(r), _value_of(r), None), old),
+                        fq.update(r, {"value": new}),
+                        fq.abort("cas failed")))
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class BankClient(_FaunaBase):
+    CLASS = ACCOUNTS
+
+    def setup(self, test):
+        super().setup(test)
+        wl = test.get("bank", {})
+        accounts = wl.get("accounts", list(range(8)))
+        total = wl.get("total_amount", 100)
+        per = total // len(accounts)
+        for i, a in enumerate(accounts):
+            amt = per + (total - per * len(accounts) if i == 0 else 0)
+            try:
+                self.conn.query(fq.if_(
+                    fq.exists(fq.ref(self.CLASS, a)), None,
+                    fq.create(self.CLASS, a, {"balance": amt})))
+            except (FaunaError, *NET_ERRORS):
+                pass
+
+    def invoke(self, test, op: Op) -> Op:
+        accounts = test.get("bank", {}).get("accounts", list(range(8)))
+        try:
+            if op.f == "read":
+                vals = self.conn.query(
+                    [fq.select(["data", "balance"],
+                               fq.get(fq.ref(self.CLASS, a)))
+                     for a in accounts])
+                return op.with_(type=OK,
+                                value=dict(zip(accounts, vals)))
+            if op.f == "transfer":
+                v = op.value
+                frm = fq.ref(self.CLASS, v["from"])
+                to = fq.ref(self.CLASS, v["to"])
+                bal = fq.select(["data", "balance"], fq.get(frm))
+                self.conn.query(fq.let(
+                    {"b": bal},
+                    fq.if_(fq.lt(fq.var("b"), v["amount"]),
+                           fq.abort("insufficient funds"),
+                           fq.do(
+                               fq.update(frm, {"balance": fq.subtract(
+                                   fq.var("b"), v["amount"])}),
+                               fq.let({"b2": fq.select(
+                                   ["data", "balance"], fq.get(to))},
+                                   fq.update(to, {"balance": fq.add(
+                                       fq.var("b2"), v["amount"])}))))))
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class SetClient(_FaunaBase):
+    CLASS = ELEMENTS
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                self.conn.query(fq.create(self.CLASS, op.value,
+                                          {"value": op.value}))
+                return op.with_(type=OK)
+            if op.f == "read":
+                # strong read: one txn over all candidate refs
+                # (set.clj's strong-read mode); the generator stamps the
+                # add counter's bound into the op
+                n = op.value if isinstance(op.value, int) \
+                    else test.get("set_read_upper", 10_000)
+                vals = self.conn.query(
+                    [fq.if_(fq.exists(fq.ref(self.CLASS, i)),
+                            _value_of(fq.ref(self.CLASS, i)), None)
+                     for i in range(n)])
+                return op.with_(type=OK,
+                                value=sorted(v for v in vals
+                                             if v is not None))
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class MonotonicClient(_FaunaBase):
+    """A counter incremented by 1; reads return [register value] so the
+    checker can demand that successive reads never go backwards
+    (monotonic.clj)."""
+
+    CLASS = COUNTERS
+    KEY = 0
+
+    def setup(self, test):
+        super().setup(test)
+        try:
+            self.conn.query(fq.if_(
+                fq.exists(fq.ref(self.CLASS, self.KEY)), None,
+                fq.create(self.CLASS, self.KEY, {"value": 0})))
+        except (FaunaError, *NET_ERRORS):
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        r = fq.ref(self.CLASS, self.KEY)
+        try:
+            if op.f == "inc":
+                val = self.conn.query(fq.let(
+                    {"v": _value_of(r)},
+                    fq.do(fq.update(r, {"value": fq.add(fq.var("v"), 1)}),
+                          fq.add(fq.var("v"), 1))))
+                return op.with_(type=OK, value=val)
+            if op.f == "read":
+                return op.with_(type=OK, value=self.conn.query(
+                    _value_of(r)))
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)

@@ -1,0 +1,63 @@
+"""FaunaDB Enterprise install.
+
+Parity: faunadb/src/jepsen/faunadb/auto.clj — deb install from the
+faunadb repo, faunadb.yml with the cluster's replicas and the shared
+root key "secret", init on node 1 then join, log replication topology
+(topology.clj's replica placement).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.clients.fauna import PORT, SECRET
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+CONF = "/etc/faunadb.yml"
+LOGFILE = "/var/log/faunadb/core.log"
+
+
+def config(test, node) -> str:
+    return (f"auth_root_key: {SECRET}\n"
+            f"network_broadcast_address: {node}\n"
+            f"network_listen_address: 0.0.0.0\n"
+            f"network_coordinator_http_address: 0.0.0.0\n"
+            f"storage_data_path: /var/lib/faunadb\n")
+
+
+class FaunaDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "dpkg-query -l faunadb >/dev/null 2>&1 || "
+               "apt-get install -y faunadb")
+        cu.write_file(s, config(test, node), CONF)
+        first = test["nodes"][0]
+        if node == first:
+            s.exec("faunadb-admin", "init")
+        else:
+            s.exec("faunadb-admin", "join", first)
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=300)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "faunadb")
+        s.exec("sh", "-c", "rm -rf /var/lib/faunadb/* || true")
+
+    def start(self, test, node):
+        session(test, node).sudo().exec("service", "faunadb", "start")
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "faunadb")
+
+    def pause(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "faunadb", signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "faunadb", signal="CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

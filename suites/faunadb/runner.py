@@ -1,0 +1,112 @@
+"""FaunaDB suite CLI.
+
+Parity: faunadb/src/jepsen/faunadb/runner.clj:30-41's workload registry —
+register, bank, set, monotonic implemented here (g2 / internal /
+multimonotonic / pages are covered by the shared transactional kits or
+queued for a later pass; bank-index's serialized-indices flag becomes
+set's strong-read option), plus runner.clj:43-60's workload-option sweep
+matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, SetChecker
+from jepsen_tpu.history import History, OK
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.faunadb import client as fc
+from suites.faunadb.db import FaunaDB
+
+
+class MonotonicChecker(Checker):
+    """Per-process counter reads must never go backwards
+    (monotonic.clj's checker)."""
+
+    def check(self, test, history: History, opts=None):
+        last: Dict[Any, int] = {}
+        bad = []
+        for op in history:
+            if op.type == OK and op.f in ("read", "inc") \
+                    and op.value is not None:
+                prev = last.get(op.process)
+                if prev is not None and op.value < prev:
+                    bad.append({**op.to_dict(), "prev": prev})
+                last[op.process] = op.value
+        return {"valid": not bad, "nonmonotonic": bad[:16]}
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 100)),
+        threads_per_key=2)
+    return {**wl, "client": fc.RegisterClient()}
+
+
+def bank_workload(opts) -> Dict[str, Any]:
+    wl = bank_wl.workload()
+    return {**wl, "client": fc.BankClient()}
+
+
+def set_workload(opts) -> Dict[str, Any]:
+    box = {"n": 0}
+
+    def add():
+        v = box["n"]
+        box["n"] += 1
+        return {"f": "add", "value": v}
+
+    def final_read():
+        # the read probes refs [0, bound): it must track how far the
+        # adds actually got, or acknowledged adds read as lost
+        return {"f": "read", "value": box["n"]}
+
+    return {"client": fc.SetClient(),
+            "generator": gen.stagger(1 / 20, gen.FnGen(add)),
+            "final_generator": gen.once(gen.FnGen(final_read)),
+            "checker": SetChecker()}
+
+
+def monotonic_workload(opts) -> Dict[str, Any]:
+    g = gen.mix([gen.repeat({"f": "inc"}),
+                 gen.repeat({"f": "read"})])
+    return {"client": fc.MonotonicClient(),
+            "generator": gen.stagger(1 / 20, g),
+            "checker": MonotonicChecker()}
+
+
+WORKLOADS = {"register": register_workload, "bank": bank_workload,
+             "set": set_workload, "monotonic": monotonic_workload}
+
+
+def faunadb_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    t = common.build_test(opts, suite="faunadb", db=FaunaDB(),
+                          workloads=WORKLOADS)
+    if opts.get("workload") == "bank":
+        t["bank"] = {"accounts": list(range(8)),
+                     "total_amount": int(opts.get("total_amount", 100))}
+    # set reads probe refs up to the add counter's bound
+    t["set_read_upper"] = int(opts.get("set_read_upper", 2000))
+    return t
+
+
+def all_tests(opts: Dict[str, Any]):
+    return common.sweep(opts, faunadb_test, WORKLOADS)
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=100)
+    parser.add_argument("--total-amount", type=int, default=100)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(faunadb_test, WORKLOADS,
+                         prog="jepsen-tpu-faunadb", extra_opts=_extra,
+                         default_workload="register"))

@@ -110,10 +110,10 @@ class IgniteDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
     def pause(self, test, node):
         # the server process is a JVM named "java"; match the full
         # cmdline (the ignite config path) like kill() does
-        session(test, node).sudo().exec("pkill", "-STOP", "-f", "ignite")
+        cu.grepkill(session(test, node).sudo(), "ignite", signal="STOP")
 
     def resume(self, test, node):
-        session(test, node).sudo().exec("pkill", "-CONT", "-f", "ignite")
+        cu.grepkill(session(test, node).sudo(), "ignite", signal="CONT")
 
     def log_files(self, test, node) -> List[str]:
         return [LOGFILE]

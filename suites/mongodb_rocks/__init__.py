@@ -1,0 +1,2 @@
+"""MongoDB-with-RocksDB suite (reference: mongodb-rocks/ — a logger/queue
+perf workload comparing storage engines)."""

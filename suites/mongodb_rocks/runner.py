@@ -1,0 +1,181 @@
+"""MongoDB-RocksDB suite: logger perf workload.
+
+Parity: mongodb-rocks/src/jepsen/mongodb_rocks.clj — mongod with a
+pluggable storage engine (--storageEngine rocksdb), a 100 KiB-payload
+insert + oldest-first find-and-remove workload at high concurrency, and
+a latency/throughput (perf) verdict rather than a consistency checker.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.clients.mongo import MongoClient, MongoError
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+from jepsen_tpu import db as jdb
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites import common
+
+PORT = 27017
+PAYLOAD = "x" * (100 * 1024)  # mongodb_rocks.clj:85's 100 KiB payload
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+
+class MongoRocksDB(jdb.DB, jdb.Kill, jdb.LogFiles):
+    """Single-node mongod with a selectable storage engine
+    (mongodb_rocks.clj:29-70)."""
+
+    DATA = "/var/mongodb-rocks"
+    LOGFILE = "/var/log/mongodb-rocks.log"
+    PIDFILE = "/var/run/mongod-rocks.pid"
+
+    def __init__(self, engine: str = "wiredTiger"):
+        self.engine = engine
+
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "command -v mongod >/dev/null 2>&1 || "
+               "apt-get install -y mongodb-server")
+        s.exec("mkdir", "-p", self.DATA)
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=120)
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "mongod")
+        s.exec("sh", "-c", f"rm -rf {self.DATA}/* {self.LOGFILE} || true")
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(s, "mongod", "--dbpath", self.DATA,
+                        "--port", str(PORT), "--bind_ip_all",
+                        "--storageEngine",
+                        test.get("storage_engine", self.engine),
+                        pidfile=self.PIDFILE, logfile=self.LOGFILE)
+
+    def kill(self, test, node):
+        cu.grepkill(session(test, node).sudo(), "mongod")
+
+    def log_files(self, test, node) -> List[str]:
+        return [self.LOGFILE]
+
+
+class LoggerClient(jclient.Client):
+    """Insert timestamped payloads; delete = remove the oldest
+    (mongodb_rocks.clj:86-123)."""
+
+    COLL = "logger"
+
+    def __init__(self, conn: Optional[MongoClient] = None,
+                 node: Optional[str] = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return LoggerClient(
+            MongoClient(node, int(test.get("db_port", PORT))).connect(),
+            node)
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "write":
+                self.conn.insert(self.COLL,
+                                 {"_id": op.value,
+                                  "time": int(_time.time() * 1000),
+                                  "payload": PAYLOAD})
+                return op.with_(type=OK)
+            if op.f == "delete":
+                r = self.conn.command({"findAndModify": self.COLL,
+                                       "query": {},
+                                       "sort": {"time": 1},
+                                       "remove": True})
+                doc = r.get("value")
+                if doc is None:
+                    return op.with_(type=FAIL)
+                return op.with_(type=OK, value=doc.get("_id"))
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            try:
+                self.conn.close()
+                self.conn = MongoClient(
+                    self.node, int(test.get("db_port", PORT))).connect()
+            except Exception:  # noqa: BLE001
+                pass
+            if op.f == "delete":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except MongoError as e:
+            if op.f == "delete":
+                return op.with_(type=FAIL, error=str(e)[:200])
+            return op.with_(type=INFO, error=str(e)[:200])
+
+
+class ThroughputChecker(Checker):
+    """Perf verdict: the logger test has no consistency model — it
+    reports write/delete throughput (mongodb_rocks.clj:157-165)."""
+
+    def check(self, test, history, opts=None):
+        oks = [op for op in history if op.type == OK]
+        if not oks:
+            return {"valid": UNKNOWN, "error": "no completed ops"}
+        t0 = min(op.time for op in oks)
+        t1 = max(op.time for op in oks)
+        dt = max((t1 - t0) / 1e9, 1e-9)
+        return {"valid": True,
+                "writes": sum(1 for o in oks if o.f == "write"),
+                "deletes": sum(1 for o in oks if o.f == "delete"),
+                "throughput-hz": round(len(oks) / dt, 2)}
+
+
+def logger_workload(opts) -> Dict[str, Any]:
+    def write():
+        return {"f": "write",
+                "value": f"{int(_time.time())}-oempa_"
+                         f"{random.randrange(2**31)}"}
+
+    g = gen.mix([gen.FnGen(write), gen.FnGen(write),
+                 gen.repeat({"f": "delete"})])
+    return {"client": LoggerClient(), "generator": g,
+            "checker": ThroughputChecker()}
+
+
+WORKLOADS = {"logger": logger_workload}
+
+
+def mongodb_rocks_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    return common.build_test(
+        opts, suite="mongodb-rocks",
+        db=MongoRocksDB(opts.get("storage_engine", "wiredTiger")),
+        workloads=WORKLOADS)
+
+
+def all_tests(opts: Dict[str, Any]):
+    """Engine comparison sweep (mongodb_rocks.clj's rocksdb-vs-wiredtiger
+    point)."""
+    return [mongodb_rocks_test({**opts, "storage_engine": e,
+                                "nemesis": opts.get("nemesis", "none")})
+            for e in opts.get("engines", ["wiredTiger", "rocksdb"])]
+
+
+def _extra(parser):
+    parser.add_argument("--storage-engine", default="wiredTiger")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(mongodb_rocks_test, WORKLOADS,
+                         prog="jepsen-tpu-mongodb-rocks",
+                         extra_opts=_extra))

@@ -1,0 +1,2 @@
+"""MongoDB-on-SmartOS suite (reference: mongodb-smartos/ — document CAS
+across write-concern variants and the two-phase transfer workload)."""

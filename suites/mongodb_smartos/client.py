@@ -1,0 +1,186 @@
+"""MongoDB workload clients.
+
+Parity: mongodb-smartos/src/jepsen/mongodb_smartos/document_cas.clj:40-84
+(one document as a register: read by _id, write = update-by-id, CAS =
+update with {_id, value: old} filter checking n) and transfer.clj:43-170
+(the classic two-phase-commit transfer over txns + accounts collections
+with pendingTxns guards).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.clients.mongo import MongoClient, MongoError
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+PORT = 27017
+NET_ERRORS = (ConnectionError, OSError, socket.timeout, TimeoutError)
+
+
+def connect(test, node) -> MongoClient:
+    return MongoClient(node, int(test.get("db_port", PORT))).connect()
+
+
+class _MongoBase(jclient.Client):
+    def __init__(self, conn: Optional[MongoClient] = None,
+                 node: Optional[str] = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(connect(test, node), node)
+
+    def close(self, test):
+        if self.conn:
+            self.conn.close()
+
+    def _reconnect(self, test):
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.conn = connect(test, self.node)
+        except Exception:  # noqa: BLE001 — node may be down
+            pass
+
+
+class DocumentCasClient(_MongoBase):
+    """Per-key register documents (document_cas.clj:40-84), lifted over
+    the independent keyspace."""
+
+    COLL = "jepsen"
+
+    def __init__(self, conn=None, node=None,
+                 write_concern: str = "majority"):
+        super().__init__(conn, node)
+        self.write_concern = write_concern
+
+    def open(self, test, node):
+        return DocumentCasClient(connect(test, node), node,
+                                 test.get("write_concern",
+                                          self.write_concern))
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "read":
+                doc = self.conn.find_one(self.COLL, {"_id": k})
+                return op.with_(type=OK,
+                                value=(k, doc.get("value")
+                                       if doc else None))
+            if op.f == "write":
+                self.conn.update(self.COLL, {"_id": k},
+                                 {"_id": k, "value": v}, upsert=True,
+                                 write_concern=self.write_concern)
+                return op.with_(type=OK)
+            if op.f == "cas":
+                old, new = v
+                n = self.conn.update(self.COLL,
+                                     {"_id": k, "value": old},
+                                     {"_id": k, "value": new},
+                                     write_concern=self.write_concern)
+                return op.with_(type=OK if n == 1 else FAIL)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self._reconnect(test)
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except MongoError as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e)[:200])
+            return op.with_(type=INFO, error=str(e)[:200])
+
+
+READ_FS = ("read", "partial-read")
+
+
+class TransferClient(_MongoBase):
+    """Two-phase-commit transfers (transfer.clj:43-170): create a txn
+    doc, apply $inc to both accounts guarded by pendingTxns, then clear.
+    Reads sum the accounts collection."""
+
+    ACCTS = "accounts"
+    TXNS = "txns"
+
+    def __init__(self, conn=None, node=None,
+                 write_concern: str = "majority"):
+        super().__init__(conn, node)
+        self.write_concern = write_concern
+
+    def setup(self, test):
+        wl = test.get("bank", {})
+        accounts = wl.get("accounts", list(range(8)))
+        total = wl.get("total_amount", 100)
+        per = total // len(accounts)
+        for i, a in enumerate(accounts):
+            amt = per + (total - per * len(accounts) if i == 0 else 0)
+            try:
+                self.conn.insert(self.ACCTS,
+                                 {"_id": a, "balance": amt,
+                                  "pendingTxns": []})
+            except MongoError:
+                pass  # another node seeded it
+
+    def _transfer(self, v: Dict[str, Any]) -> None:
+        wc = self.write_concern
+        txn_id = f"t{random.randrange(16**12):012x}"
+        self.conn.insert(self.TXNS,
+                         {"_id": txn_id, "state": "pending",
+                          "from": v["from"], "to": v["to"],
+                          "amount": v["amount"]}, write_concern=wc)
+        self.conn.update(self.ACCTS,
+                         {"_id": v["from"],
+                          "pendingTxns": {"$ne": txn_id}},
+                         {"$inc": {"balance": -v["amount"]},
+                          "$push": {"pendingTxns": txn_id}},
+                         write_concern=wc)
+        self.conn.update(self.ACCTS,
+                         {"_id": v["to"],
+                          "pendingTxns": {"$ne": txn_id}},
+                         {"$inc": {"balance": v["amount"]},
+                          "$push": {"pendingTxns": txn_id}},
+                         write_concern=wc)
+        self.conn.update(self.TXNS, {"_id": txn_id, "state": "pending"},
+                         {"$set": {"state": "applied"}},
+                         write_concern=wc)
+        for acct in (v["from"], v["to"]):
+            self.conn.update(self.ACCTS,
+                             {"_id": acct, "pendingTxns": txn_id},
+                             {"$pull": {"pendingTxns": txn_id}},
+                             write_concern=wc)
+        self.conn.update(self.TXNS, {"_id": txn_id, "state": "applied"},
+                         {"$set": {"state": "done"}}, write_concern=wc)
+
+    def invoke(self, test, op: Op) -> Op:
+        accounts = test.get("bank", {}).get("accounts", list(range(8)))
+        try:
+            if op.f in ("read", "partial-read"):
+                # partial-read only sees accounts with no transaction in
+                # flight (transfer.clj:159-165) — the sound read mode
+                flt = {"pendingTxns": {"$size": 0}} \
+                    if op.f == "partial-read" else {}
+                r = self.conn.command({"find": self.ACCTS, "filter": flt,
+                                       "limit": len(accounts) + 1})
+                docs = r.get("cursor", {}).get("firstBatch", [])
+                return op.with_(type=OK,
+                                value={d["_id"]: d["balance"]
+                                       for d in docs})
+            if op.f == "transfer":
+                self._transfer(op.value)
+                return op.with_(type=OK)
+            raise ValueError(op.f)
+        except NET_ERRORS as e:
+            self._reconnect(test)
+            if op.f in READ_FS:
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+        except MongoError as e:
+            if op.f in READ_FS:
+                return op.with_(type=FAIL, error=str(e)[:200])
+            return op.with_(type=INFO, error=str(e)[:200])

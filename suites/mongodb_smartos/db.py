@@ -1,0 +1,87 @@
+"""MongoDB replica-set install on SmartOS.
+
+Parity: mongodb-smartos/src/jepsen/mongodb_smartos/core.clj:40-250 —
+pkgin install, mongod --replSet over the test's nodes, replica-set
+initiate from node 1 with all members, wait for a primary.  Runs on the
+SmartOS OS layer (jepsen_tpu.os.SmartOS).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.clients.mongo import MongoClient, MongoError
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+PORT = 27017
+REPLSET = "jepsen"
+DATA = "/var/mongodb"
+LOGFILE = "/var/log/mongodb.log"
+PIDFILE = "/var/run/mongod.pid"
+
+
+class MongoSmartOSDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary,
+                     jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node).sudo()
+        s.exec("sh", "-c",
+               "command -v mongod >/dev/null 2>&1 || "
+               "pkgin -y install mongodb")
+        s.exec("mkdir", "-p", DATA)
+        self.start(test, node)
+        cu.await_tcp_port(s, PORT, timeout_s=120)
+
+    def setup_primary(self, test, node):
+        """replSetInitiate with every member, then wait for a primary
+        (core.clj:128-250)."""
+        members = [{"_id": i, "host": f"{n}:{PORT}"}
+                   for i, n in enumerate(test["nodes"])]
+        c = MongoClient(node, int(test.get("db_port", PORT)))
+        try:
+            try:
+                c.command({"replSetInitiate": {"_id": REPLSET,
+                                               "members": members}},
+                          database="admin")
+            except MongoError as e:
+                if "already initialized" not in str(e):
+                    raise
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = c.command({"replSetGetStatus": 1}, database="admin")
+                if any(m.get("stateStr") == "PRIMARY"
+                       for m in st.get("members", [])):
+                    return
+                time.sleep(1)
+            raise RuntimeError("no primary elected")
+        finally:
+            c.close()
+
+    def teardown(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "mongod")
+        s.exec("sh", "-c", f"rm -rf {DATA}/* {LOGFILE} || true")
+
+    def start(self, test, node):
+        s = session(test, node).sudo()
+        cu.start_daemon(s, "mongod",
+                        "--dbpath", DATA, "--port", str(PORT),
+                        "--bind_ip_all", "--replSet", REPLSET,
+                        pidfile=PIDFILE, logfile=LOGFILE)
+
+    def kill(self, test, node):
+        s = session(test, node).sudo()
+        cu.grepkill(s, "mongod")
+        s.exec("rm", "-f", PIDFILE)
+
+    def pause(self, test, node):
+        cu.signal(session(test, node).sudo(), "mongod", "STOP")
+
+    def resume(self, test, node):
+        cu.signal(session(test, node).sudo(), "mongod", "CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        return [LOGFILE]

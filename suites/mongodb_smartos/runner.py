@@ -1,0 +1,147 @@
+"""MongoDB-on-SmartOS suite CLI.
+
+Parity: mongodb-smartos/src/jepsen/mongodb_smartos/document_cas.clj:
+101-140's write-concern test matrix (majority / no-read-majority /
+journaled / fsync-safe / unacknowledged-ish variants) and transfer.clj's
+two-phase bank.  Runs on the SmartOS OS layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import random
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu import os as jos
+from jepsen_tpu.checker.linearizable import linearizable
+from jepsen_tpu.models.base import Model, inconsistent
+from jepsen_tpu.workloads import linearizable_register
+
+from suites import common
+from suites.mongodb_smartos.client import DocumentCasClient, TransferClient
+from suites.mongodb_smartos.db import MongoSmartOSDB
+
+WRITE_CONCERNS = ["majority", "1", "journaled"]
+
+
+def register_workload(opts) -> Dict[str, Any]:
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 8))),
+        ops_per_key=int(opts.get("ops_per_key", 100)),
+        threads_per_key=2)
+    return {**wl, "client": DocumentCasClient(
+        write_concern=opts.get("write_concern", "majority"))}
+
+
+def no_read_register_workload(opts) -> Dict[str, Any]:
+    """Writes and CAS only — mongo without linearizable reads
+    (document_cas.clj:108-115)."""
+    wl = register_workload(opts)
+    return {**wl, "generator": gen.gen_filter(
+        lambda op: op.f != "read", wl["generator"])}
+
+
+class AccountsModel(Model):
+    """Transfers between a fixed account map; partial reads must agree
+    with the modeled balances for the accounts they see
+    (transfer.clj:190-220's Accounts model)."""
+
+    def __init__(self, accts: Dict[int, int]):
+        self.accts = dict(accts)
+
+    def step(self, op):
+        v = op.value
+        if op.f == "read":
+            if v == self.accts:
+                return self
+            return inconsistent(f"can't read {v} from {self.accts}")
+        if op.f == "partial-read":
+            for acct, balance in (v or {}).items():
+                if self.accts.get(acct) != balance:
+                    return inconsistent(
+                        f"{v} isn't consistent with {self.accts}")
+            return self
+        if op.f == "transfer":
+            next_ = dict(self.accts)
+            next_[v["from"]] -= v["amount"]
+            next_[v["to"]] += v["amount"]
+            return AccountsModel(next_)
+        return inconsistent(f"unknown f {op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, AccountsModel) and \
+            self.accts == other.accts
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.accts.items())))
+
+
+def transfer_workload(opts) -> Dict[str, Any]:
+    """partial-read + different-account transfers under the Accounts
+    linearizability model (transfer.clj:255-281's partial-read and
+    diff-account tests; raw reads are known-broken on mongo and kept as
+    the transfer-read variant)."""
+    accounts = list(range(int(opts.get("n_accounts", 3))))
+    per = int(opts.get("starting_balance", 10))
+    read_f = opts.get("transfer_read_f", "partial-read")
+
+    def xfer():
+        frm, to = random.sample(accounts, 2)
+        return {"f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": random.randint(0, 4)}}
+
+    g = gen.mix([gen.repeat({"f": read_f}), gen.FnGen(xfer)])
+    model = AccountsModel({a: per for a in accounts})
+    return {"client": TransferClient(
+                write_concern=opts.get("write_concern", "majority")),
+            "generator": gen.stagger(1 / 20, g),
+            "checker": linearizable(model, opts.get("algorithm", "cpu")),
+            "accounts": accounts,
+            "total": per * len(accounts)}
+
+
+WORKLOADS = {"document-cas": register_workload,
+             "document-cas-no-read": no_read_register_workload,
+             "transfer": transfer_workload}
+
+
+def mongodb_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    t = common.build_test(opts, suite="mongodb-smartos",
+                          db=MongoSmartOSDB(), workloads=WORKLOADS,
+                          os=jos.Smartos())
+    if opts.get("workload") == "transfer":
+        n = int(opts.get("n_accounts", 3))
+        per = int(opts.get("starting_balance", 10))
+        t["bank"] = {"accounts": list(range(n)),
+                     "total_amount": per * n}
+    return t
+
+
+def all_tests(opts: Dict[str, Any]):
+    """Write-concern x workload matrix (document_cas.clj:101-140)."""
+    out = []
+    for wc in opts.get("write_concerns", WRITE_CONCERNS):
+        for w in opts.get("workloads", sorted(WORKLOADS)):
+            out.append(mongodb_test({**opts, "workload": w,
+                                     "write_concern": wc,
+                                     "nemesis": opts.get("nemesis",
+                                                         "partition")}))
+    return out
+
+
+def _extra(parser):
+    parser.add_argument("--keys", type=int, default=8)
+    parser.add_argument("--ops-per-key", type=int, default=100)
+    parser.add_argument("--write-concern", default="majority",
+                        choices=WRITE_CONCERNS)
+    parser.add_argument("--total-amount", type=int, default=100)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(common.main(mongodb_test, WORKLOADS,
+                         prog="jepsen-tpu-mongodb-smartos",
+                         extra_opts=_extra,
+                         default_workload="document-cas"))

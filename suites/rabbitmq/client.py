@@ -33,7 +33,13 @@ class QueueClient(jclient.Client):
         self.node = node
 
     def open(self, test, node):
-        return QueueClient(connect(test, node), node)
+        c = QueueClient(connect(test, node), node)
+        # confirms must be on for the WORKER connection: setup() only runs
+        # on throwaway per-node clients, and an unconfirmed publish
+        # reported OK would fabricate data-loss verdicts
+        c.conn.queue_declare(QUEUE, durable=True)
+        c.conn.confirm_select()
+        return c
 
     def setup(self, test):
         self.conn.queue_declare(QUEUE, durable=True)

@@ -57,18 +57,36 @@ class RobustSession:
                   {"Data": data, "ClientMessageId": msgid}, auth=True)
 
     def read_messages(self) -> List[Dict[str, Any]]:
-        raw = self._req("GET", f"/{self.sid}/messages?lastseen=0.0",
-                        auth=True, raw=True)
+        """Stream /messages incrementally: the endpoint long-polls, so
+        read until the backlog stops flowing and keep what arrived
+        (robustirc.clj:126-137's read-all with a timeout)."""
+        req = urllib.request.Request(
+            self.base + f"/{self.sid}/messages?lastseen=0.0",
+            headers={"X-Session-Auth": self.auth})
+        raw = b""
+        try:
+            with urllib.request.urlopen(req, timeout=2.0,
+                                        context=self.ctx) as resp:
+                while True:
+                    chunk = resp.read(65536)
+                    if not chunk:
+                        break
+                    raw += chunk
+        except (TimeoutError, socket.timeout, OSError):
+            pass  # long-poll idle: the backlog is whatever we got
         out = []
         dec = json.JSONDecoder()
-        s = raw.decode()
+        s = raw.decode(errors="replace")
         i = 0
         while i < len(s):
             while i < len(s) and s[i] in " \r\n\t":
                 i += 1
             if i >= len(s):
                 break
-            obj, j = dec.raw_decode(s, i)
+            try:
+                obj, j = dec.raw_decode(s, i)
+            except ValueError:
+                break  # trailing partial object from the cutoff
             out.append(obj)
             i = j
         return out

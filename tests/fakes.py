@@ -434,7 +434,38 @@ class FakeMongoHandler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _matches(doc, q):
-        return all(doc.get(k) == v for k, v in q.items())
+        for k, v in q.items():
+            if isinstance(v, dict) and "$ne" in v:
+                field = doc.get(k)
+                if isinstance(field, list):
+                    if v["$ne"] in field:
+                        return False
+                elif field == v["$ne"]:
+                    return False
+            elif isinstance(v, dict) and "$size" in v:
+                if len(doc.get(k) or []) != v["$size"]:
+                    return False
+            elif doc.get(k) != v:
+                return False
+        return True
+
+    @staticmethod
+    def _apply_update(hit, u):
+        """$set/$inc/$push/$pull operators, or whole-doc replacement."""
+        if any(k.startswith("$") for k in u):
+            for k, v in u.get("$set", {}).items():
+                hit[k] = v
+            for k, v in u.get("$inc", {}).items():
+                hit[k] = hit.get(k, 0) + v
+            for k, v in u.get("$push", {}).items():
+                hit.setdefault(k, []).append(v)
+            for k, v in u.get("$pull", {}).items():
+                hit[k] = [x for x in hit.get(k, []) if x != v]
+        else:
+            keep_id = hit.get("_id")
+            hit.clear()
+            hit.update(u)
+            hit.setdefault("_id", keep_id)
 
     def _dispatch(self, st: MongoState, cmd: Dict[str, Any]):
         if "find" in cmd:
@@ -445,17 +476,28 @@ class FakeMongoHandler(socketserver.BaseRequestHandler):
                 hits = hits[:cmd["limit"]]
             return {"ok": 1, "cursor": {"id": 0, "firstBatch": hits}}
         if "insert" in cmd:
-            st.colls.setdefault(cmd["insert"], []).extend(
-                cmd.get("documents", []))
+            coll = st.colls.setdefault(cmd["insert"], [])
+            for doc in cmd.get("documents", []):
+                if "_id" in doc and any(d.get("_id") == doc["_id"]
+                                        for d in coll):
+                    return {"ok": 0, "errmsg": "E11000 duplicate key",
+                            "code": 11000}
+                coll.append(doc)
             return {"ok": 1, "n": len(cmd.get("documents", []))}
         if "findAndModify" in cmd:  # before "update": fAM carries one too
             coll = st.colls.setdefault(cmd["findAndModify"], [])
-            hit = next((d for d in coll
-                        if self._matches(d, cmd.get("query", {}))), None)
+            hits = [d for d in coll
+                    if self._matches(d, cmd.get("query", {}))]
+            for k, direction in (cmd.get("sort") or {}).items():
+                hits.sort(key=lambda d: d.get(k), reverse=direction < 0)
+            hit = hits[0] if hits else None
             if hit is None:
                 return {"ok": 1, "value": None}
             before = dict(hit)
-            hit.update(cmd["update"].get("$set", {}))
+            if cmd.get("remove"):
+                coll.remove(hit)
+            else:
+                self._apply_update(hit, cmd.get("update", {}))
             return {"ok": 1, "value": before}
         if "update" in cmd:
             coll = st.colls.setdefault(cmd["update"], [])
@@ -464,14 +506,28 @@ class FakeMongoHandler(socketserver.BaseRequestHandler):
                 hit = next((d for d in coll
                             if self._matches(d, u.get("q", {}))), None)
                 if hit is not None:
-                    hit.update(u["u"].get("$set", {}))
+                    self._apply_update(hit, u["u"])
                     n += 1
                 elif u.get("upsert"):
-                    doc = dict(u.get("q", {}))
-                    doc.update(u["u"].get("$set", {}))
+                    doc = {k: v for k, v in u.get("q", {}).items()
+                           if not isinstance(v, dict)}
+                    self._apply_update(doc, u["u"])
                     coll.append(doc)
                     n += 1
             return {"ok": 1, "n": n}
+        if "delete" in cmd:
+            coll = st.colls.setdefault(cmd["delete"], [])
+            n = 0
+            for d in cmd.get("deletes", []):
+                hits = [x for x in coll
+                        if self._matches(x, d.get("q", {}))]
+                for h in hits:
+                    coll.remove(h)
+                n += len(hits)
+            return {"ok": 1, "n": n}
+        if "replSetInitiate" in cmd or "replSetGetStatus" in cmd:
+            return {"ok": 1,
+                    "members": [{"stateStr": "PRIMARY"}]}
         if "hello" in cmd or "isMaster" in cmd:
             return {"ok": 1, "isWritablePrimary": True}
         return {"ok": 0, "errmsg": f"unknown command {list(cmd)[:1]}",
@@ -1489,3 +1545,453 @@ def start_fake_robustirc():
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, srv.server_address[1], state
+
+
+# --------------------------------------------------------------------------
+# Generic threaded HTTP fake scaffolding
+# --------------------------------------------------------------------------
+
+def _start_http(handler_factory):
+    import http.server
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = Server(("127.0.0.1", 0), handler_factory)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+# --------------------------------------------------------------------------
+# Elasticsearch REST — serves suites.elasticsearch.client
+# --------------------------------------------------------------------------
+
+def start_fake_elasticsearch():
+    import http.server
+    import json as js
+    from urllib.parse import urlparse
+
+    state = {"indices": {}}  # index -> {doc_id: doc}; docs visible on refresh
+    lock = threading.Lock()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, obj):
+            b = js.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def do_PUT(self):
+            parts = urlparse(self.path).path.strip("/").split("/")
+            with lock:
+                if len(parts) == 1:
+                    created = parts[0] not in state["indices"]
+                    state["indices"].setdefault(
+                        parts[0], {"docs": {}, "visible": set()})
+                    if created:
+                        self._reply(200, {"acknowledged": True})
+                    else:
+                        self._reply(400, {"error": {"type":
+                                          "resource_already_exists"}})
+
+        def do_POST(self):
+            parts = urlparse(self.path).path.strip("/").split("/")
+            n = int(self.headers.get("Content-Length") or 0)
+            body = js.loads(self.rfile.read(n)) if n else {}
+            with lock:
+                idx = state["indices"].setdefault(
+                    parts[0], {"docs": {}, "visible": set()})
+                if len(parts) >= 2 and parts[1] == "_doc":
+                    idx["docs"][parts[2]] = body
+                    self._reply(201, {"result": "created"})
+                    return
+                if len(parts) >= 2 and parts[1] == "_refresh":
+                    idx["visible"] = set(idx["docs"])
+                    self._reply(200, {"_shards": {"failed": 0}})
+                    return
+                if len(parts) >= 2 and parts[1] == "_search":
+                    hits = [{"_id": d, "_source": idx["docs"][d]}
+                            for d in sorted(idx["visible"])]
+                    self._reply(200, {"hits": {"hits": hits}})
+                    return
+            self._reply(404, {"error": "unknown"})
+
+        def do_GET(self):
+            parts = urlparse(self.path).path.strip("/").split("/")
+            with lock:
+                idx = state["indices"].get(parts[0], {"docs": {},
+                                                      "visible": set()})
+                if len(parts) >= 3 and parts[1] == "_doc":
+                    # GET by id is realtime (sees unrefreshed docs)
+                    doc = idx["docs"].get(parts[2])
+                    if doc is None:
+                        self._reply(404, {"found": False})
+                    else:
+                        self._reply(200, {"found": True, "_source": doc})
+                    return
+            self._reply(404, {"error": "unknown"})
+
+    srv, port = _start_http(Handler)
+    return srv, port, state
+
+
+# --------------------------------------------------------------------------
+# Dgraph HTTP — serves jepsen_tpu.clients.dgraph (OCC transactions)
+# --------------------------------------------------------------------------
+
+def start_fake_dgraph():
+    import http.server
+    import json as js
+    import re as _re
+    from urllib.parse import parse_qs, urlparse
+
+    state = {
+        "store": {},        # uid -> {pred: value}
+        "next_uid": 1,
+        "next_ts": 1,
+        "txns": {},         # start_ts -> {"writes": [...], "deletes": []}
+        "commit_log": [],   # (commit_ts, {(uid) written})
+    }
+    lock = threading.Lock()
+
+    def q_eval(q):
+        """Answers the suite's templated queries."""
+        m = _re.search(r'eq\(type, "(\w+)"\)', q)
+        if m:
+            t = m.group(1)
+            fields = _re.findall(r"\b(uid|key|amount|value)\b",
+                                 q.split("{", 2)[2])
+            out = []
+            for uid, doc in sorted(state["store"].items()):
+                if doc.get("type") == t:
+                    rec = {}
+                    for f in fields:
+                        if f == "uid":
+                            rec["uid"] = uid
+                        elif f in doc:
+                            rec[f] = doc[f]
+                    out.append(rec)
+            return out
+        m = _re.search(r"eq\(key, (\d+)\)", q)
+        if m:
+            k = int(m.group(1))
+            out = []
+            for uid, doc in sorted(state["store"].items()):
+                if doc.get("key") == k:
+                    rec = {"uid": uid}
+                    for f in ("key", "amount", "value"):
+                        if f in doc:
+                            rec[f] = doc[f]
+                    out.append(rec)
+            return out
+        return []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, obj):
+            b = js.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def do_POST(self):
+            u = urlparse(self.path)
+            qs = {k: v[0] for k, v in parse_qs(u.query).items()}
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n).decode() if n else ""
+            with lock:
+                if u.path == "/alter":
+                    self._reply({"data": {"code": "Success"}})
+                    return
+                if u.path == "/query":
+                    ts = int(qs.get("startTs") or 0)
+                    if not ts:
+                        ts = state["next_ts"]
+                        state["next_ts"] += 1
+                        state["txns"][ts] = {"writes": [], "deletes": [],
+                                             "touched": set()}
+                    self._reply({"data": {"q": q_eval(raw)},
+                                 "extensions": {"txn": {"start_ts": ts}}})
+                    return
+                if u.path == "/mutate":
+                    body = js.loads(raw) if raw else {}
+                    if qs.get("commitNow"):
+                        uids = self._apply(body, None)
+                        self._reply({"data": {"uids": uids},
+                                     "extensions": {"txn": {}}})
+                        return
+                    ts = int(qs["startTs"])
+                    txn = state["txns"].setdefault(
+                        ts, {"writes": [], "deletes": [],
+                             "touched": set()})
+                    keys = []
+                    for doc in body.get("set", []):
+                        txn["writes"].append(doc)
+                        keys.append(str(doc.get("uid")))
+                    for doc in body.get("delete", []):
+                        txn["deletes"].append(doc)
+                        keys.append(str(doc.get("uid")))
+                    self._reply({"data": {"uids": {}},
+                                 "extensions": {"txn":
+                                                {"keys": keys,
+                                                 "preds": ["key",
+                                                           "value",
+                                                           "amount"]}}})
+                    return
+                if u.path == "/commit":
+                    ts = int(qs["startTs"])
+                    txn = state["txns"].pop(ts, None)
+                    if txn is None:
+                        self._reply({"errors": [
+                            {"message": "Transaction has been aborted"}]})
+                        return
+                    # OCC: conflict when a uid this txn writes was
+                    # committed by another txn after our start_ts; the
+                    # @upsert index makes ("key", v) part of the conflict
+                    # set too, so racing inserts of one key abort
+                    def conflict_keys(docs):
+                        out = set()
+                        for d in docs:
+                            uid = str(d.get("uid", ""))
+                            if not uid.startswith("_:"):
+                                out.add(uid)
+                            if "key" in d:
+                                out.add(("key", d["key"]))
+                        return out
+
+                    mine = conflict_keys(txn["writes"] + txn["deletes"])
+                    for commit_ts, keys in state["commit_log"]:
+                        if commit_ts > ts and mine & keys:
+                            self._reply({"errors": [{"message":
+                                "Transaction has been aborted"}]})
+                            return
+                    uids = self._apply({"set": txn["writes"],
+                                        "delete": txn["deletes"]}, ts)
+                    commit_ts = state["next_ts"]
+                    state["next_ts"] += 1
+                    written = conflict_keys(txn["writes"]
+                                            + txn["deletes"])
+                    written |= {str(d.get("uid")) for d in
+                                txn["writes"] + txn["deletes"]}
+                    written |= set(uids.values())
+                    state["commit_log"].append((commit_ts, written))
+                    self._reply({"data": {"code": "Success"}})
+                    return
+            self._reply({"errors": [{"message": f"unknown {u.path}"}]})
+
+        def _apply(self, body, ts):
+            uids = {}
+            for doc in body.get("set", []):
+                uid = str(doc.get("uid", ""))
+                if uid.startswith("_:"):
+                    new = f"0x{state['next_uid']:x}"
+                    state["next_uid"] += 1
+                    uids[uid[2:]] = new
+                    uid = new
+                rec = state["store"].setdefault(uid, {})
+                for k, v in doc.items():
+                    if k != "uid":
+                        rec[k] = v
+            for doc in body.get("delete", []):
+                state["store"].pop(str(doc.get("uid")), None)
+            return uids
+
+    srv, port = _start_http(Handler)
+    return srv, port, state
+
+
+# --------------------------------------------------------------------------
+# FaunaDB FQL — serves jepsen_tpu.clients.fauna (one query = one txn)
+# --------------------------------------------------------------------------
+
+def start_fake_fauna():
+    import http.server
+    import json as js
+
+    state = {"classes": {}}   # class -> {id: {data}}
+    lock = threading.Lock()
+
+    class Abort(Exception):
+        pass
+
+    def ref_parts(r):
+        _c, cls, id_ = r["@ref"].split("/")
+        return cls, id_
+
+    def ev(expr, env):
+        if isinstance(expr, list):
+            return [ev(e, env) for e in expr]
+        if not isinstance(expr, dict):
+            return expr
+        if "@ref" in expr:
+            return expr
+        if "object" in expr:
+            return {k: ev(v, env) for k, v in expr["object"].items()}
+        if "var" in expr:
+            return env[expr["var"]]
+        if "let" in expr:
+            env2 = dict(env)
+            for k, v in expr["let"].items():
+                env2[k] = ev(v, env2)
+            return ev(expr["in"], env2)
+        if "if" in expr:
+            return ev(expr["then"] if ev(expr["if"], env)
+                      else expr["else"], env)
+        if "do" in expr:
+            out = None
+            for e in expr["do"]:
+                out = ev(e, env)
+            return out
+        if "abort" in expr:
+            raise Abort(ev(expr["abort"], env))
+        if "equals" in expr:
+            vals = [ev(a, env) for a in expr["equals"]]
+            return all(v == vals[0] for v in vals)
+        if "add" in expr:
+            return sum(ev(a, env) for a in expr["add"])
+        if "subtract" in expr:
+            vals = [ev(a, env) for a in expr["subtract"]]
+            out = vals[0]
+            for v in vals[1:]:
+                out -= v
+            return out
+        if "lt" in expr:
+            vals = [ev(a, env) for a in expr["lt"]]
+            return all(a < b for a, b in zip(vals, vals[1:]))
+        if "exists" in expr:
+            cls, id_ = ref_parts(ev(expr["exists"], env))
+            return id_ in state["classes"].get(cls, {})
+        if "create_class" in expr:
+            params = ev(expr["create_class"], env)
+            name = params["name"]
+            if name in state["classes"]:
+                raise FaunaHttpError(400, "instance already exists")
+            state["classes"][name] = {}
+            return {"name": name}
+        if "create" in expr:
+            cls, id_ = ref_parts(ev(expr["create"], env))
+            data = ev(expr["params"], env)["data"]
+            insts = state["classes"].setdefault(cls, {})
+            if id_ in insts:
+                raise FaunaHttpError(400, "instance already exists")
+            insts[id_] = data
+            return {"data": data}
+        if "update" in expr:
+            cls, id_ = ref_parts(ev(expr["update"], env))
+            data = ev(expr["params"], env)["data"]
+            inst = state["classes"].setdefault(cls, {}).get(id_)
+            if inst is None:
+                raise FaunaHttpError(404, "instance not found")
+            inst.update(data)
+            return {"data": dict(inst)}
+        if "delete" in expr:
+            cls, id_ = ref_parts(ev(expr["delete"], env))
+            state["classes"].setdefault(cls, {}).pop(id_, None)
+            return None
+        if "get" in expr:
+            cls, id_ = ref_parts(ev(expr["get"], env))
+            inst = state["classes"].setdefault(cls, {}).get(id_)
+            if inst is None:
+                raise FaunaHttpError(404, "instance not found")
+            return {"data": dict(inst)}
+        if "select" in expr:
+            path = expr["select"]
+            obj = ev(expr["from"], env)
+            try:
+                for p in path:
+                    obj = obj[p]
+                return obj
+            except (KeyError, TypeError):
+                if "default" in expr:
+                    return ev(expr["default"], env)
+                raise FaunaHttpError(404, "value not found")
+        raise FaunaHttpError(400, f"unknown expr {list(expr)[:1]}")
+
+    class FaunaHttpError(Exception):
+        def __init__(self, code, msg):
+            super().__init__(msg)
+            self.code = code
+            self.msg = msg
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _reply(self, code, obj):
+            b = js.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            expr = js.loads(self.rfile.read(n)) if n else {}
+            # queries are transactions: all-or-nothing under the lock
+            with lock:
+                snapshot = js.loads(js.dumps(state["classes"]))
+                try:
+                    out = ev(expr, {})
+                except Abort as e:
+                    state["classes"].clear()
+                    state["classes"].update(snapshot)
+                    self._reply(400, {"errors": [
+                        {"code": "transaction aborted",
+                         "description": str(e)}]})
+                    return
+                except FaunaHttpError as e:
+                    state["classes"].clear()
+                    state["classes"].update(snapshot)
+                    self._reply(e.code, {"errors": [
+                        {"code": "bad request",
+                         "description": e.msg}]})
+                    return
+            self._reply(200, {"resource": out})
+
+    srv, port = _start_http(Handler)
+    return srv, port, state
+
+
+# --------------------------------------------------------------------------
+# Chronos HTTP — records submitted jobs
+# --------------------------------------------------------------------------
+
+def start_fake_chronos():
+    import http.server
+    import json as js
+
+    state = {"jobs": []}
+    lock = threading.Lock()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = js.loads(self.rfile.read(n)) if n else {}
+            with lock:
+                state["jobs"].append(body)
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def do_GET(self):
+            with lock:
+                b = js.dumps(state["jobs"]).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(b)))
+            self.end_headers()
+            self.wfile.write(b)
+
+    srv, port = _start_http(Handler)
+    return srv, port, state

@@ -1,0 +1,322 @@
+"""Document/search suites (mongodb, elasticsearch, dgraph, faunadb,
+chronos): wire smoke tests against protocol fakes + checker tests."""
+
+import time
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen
+from jepsen_tpu.history import History, Op
+
+from tests.fakes import (FakeMongoHandler, MongoState,
+                         start_fake_chronos, start_fake_dgraph,
+                         start_fake_elasticsearch, start_fake_fauna,
+                         start_server)
+from tests.test_kv_suites import run_wire_test
+
+
+# --------------------------------------------------------------------------
+# MongoDB
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def mongo_port():
+    srv, port = start_server(FakeMongoHandler, MongoState())
+    yield port
+    srv.shutdown()
+
+
+class TestMongoSuites:
+    def test_document_cas_workload_valid(self, mongo_port):
+        from suites.mongodb_smartos.runner import register_workload
+        wl = register_workload({"keys": 2, "ops_per_key": 40,
+                                "algorithm": "cpu"})
+        run_wire_test(wl, "mongo-cas", mongo_port)
+
+    def test_transfer_workload_valid(self, mongo_port):
+        # partial-read mode: only pending-free accounts, linearizable
+        # against the Accounts model (mongo's sound read variant)
+        from suites.mongodb_smartos.runner import transfer_workload
+        run_wire_test(transfer_workload({"algorithm": "cpu"}),
+                      "mongo-transfer", mongo_port,
+                      time_limit=2.0, concurrency=2,
+                      bank={"accounts": list(range(3)),
+                            "total_amount": 30})
+
+    def test_no_read_workload_has_no_reads(self):
+        from suites.mongodb_smartos.runner import \
+            no_read_register_workload
+        from jepsen_tpu.generator import testkit
+        wl = no_read_register_workload({"keys": 2, "ops_per_key": 30})
+        hist = testkit.simulate({"nodes": ["n1"], "concurrency": 4},
+                                gen.limit(40, wl["generator"]))
+        fs = {op.f for op in hist}
+        assert "read" not in fs and fs & {"write", "cas"}
+
+    def test_rocks_logger_workload(self, mongo_port):
+        from suites.mongodb_rocks.runner import logger_workload
+        done = run_wire_test(logger_workload({}), "mongo-logger",
+                             mongo_port, time_limit=1.5)
+        assert done["results"]["workload"]["throughput-hz"] > 0
+
+    def test_smartos_replset_init(self, mongo_port):
+        from suites.mongodb_smartos.db import MongoSmartOSDB
+        t = {"nodes": ["127.0.0.1"], "db_port": mongo_port,
+             "remote": control.DummyRemote(record_only=True)}
+        control.setup_sessions(t)
+        MongoSmartOSDB().setup_primary(t, "127.0.0.1")
+        control.teardown_sessions(t)
+
+
+# --------------------------------------------------------------------------
+# Elasticsearch
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def es_port():
+    srv, port, state = start_fake_elasticsearch()
+    yield port, state
+    srv.shutdown()
+
+
+class TestElasticsearch:
+    def test_set_workload_valid(self, es_port):
+        from suites.elasticsearch.runner import set_workload
+        run_wire_test(set_workload({}), "es-set", es_port[0],
+                      time_limit=1.5)
+
+    def test_dirty_read_workload_valid(self, es_port):
+        from suites.elasticsearch.runner import dirty_read_workload
+        run_wire_test(dirty_read_workload({}), "es-dirty-read",
+                      es_port[0], time_limit=1.5)
+
+    def test_dirty_read_checker_flags_lost_writes(self):
+        from suites.elasticsearch.runner import DirtyReadChecker
+        h = History([
+            Op(process=0, type="invoke", f="write", value=1, time=0),
+            Op(process=0, type="ok", f="write", value=1, time=1),
+            Op(process=0, type="invoke", f="strong-read", time=2),
+            Op(process=0, type="ok", f="strong-read", value=[], time=3),
+        ])
+        r = DirtyReadChecker().check({}, h)
+        assert r["valid"] is False and r["lost"] == [1]
+
+    def test_dirty_read_checker_flags_dirty_reads(self):
+        from suites.elasticsearch.runner import DirtyReadChecker
+        h = History([
+            Op(process=0, type="invoke", f="read", value=5, time=0),
+            Op(process=0, type="ok", f="read", value=5, time=1),
+            Op(process=0, type="invoke", f="strong-read", time=2),
+            Op(process=0, type="ok", f="strong-read", value=[], time=3),
+        ])
+        r = DirtyReadChecker().check({}, h)
+        assert r["valid"] is False and r["dirty"] == [5]
+
+
+# --------------------------------------------------------------------------
+# Dgraph
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def dgraph_port():
+    srv, port, state = start_fake_dgraph()
+    yield port, state
+    srv.shutdown()
+
+
+class TestDgraph:
+    def test_txn_conflict_detected(self, dgraph_port):
+        port, _ = dgraph_port
+        from jepsen_tpu.clients.dgraph import (DgraphClient, Txn,
+                                               TxnConflict)
+        c = DgraphClient("127.0.0.1", port)
+        t0 = Txn(c)
+        t0.mutate(set_json=[{"uid": "_:n", "key": 1, "value": 1}])
+        t0.commit()
+        # two racing read-modify-write txns on the same uid
+        t1, t2 = Txn(c), Txn(c)
+        r1 = t1.query('{ q(func: eq(key, 1)) { uid key value } }')
+        r2 = t2.query('{ q(func: eq(key, 1)) { uid key value } }')
+        uid = r1["q"][0]["uid"]
+        t1.mutate(set_json=[{"uid": uid, "value": 10}])
+        t1.commit()
+        t2.mutate(set_json=[{"uid": uid, "value": 20}])
+        with pytest.raises(TxnConflict):
+            t2.commit()
+
+    @pytest.mark.parametrize("workload,kw", [
+        ("bank", {}),
+        ("upsert", {"keys": 2}),
+        ("delete", {"keys": 2, "ops_per_key": 30}),
+        ("sequential", {"keys": 2, "ops_per_key": 30}),
+        ("linearizable-register", {"keys": 2, "ops_per_key": 40}),
+        ("set", {})])
+    def test_workloads_valid(self, dgraph_port, workload, kw):
+        port, _ = dgraph_port
+        from suites.dgraph.runner import WORKLOADS
+        wl = WORKLOADS[workload]({"algorithm": "cpu", **kw})
+        extra = {"bank": {"accounts": list(range(8)),
+                          "total_amount": 100}} \
+            if workload == "bank" else {}
+        run_wire_test(wl, f"dgraph-{workload}", port, time_limit=2.0,
+                      concurrency=4, **extra)
+
+    def test_sequential_checker_flags_regression(self):
+        from suites.dgraph.runner import SequentialChecker
+        h = History([
+            Op(process=0, type="invoke", f="read", time=0),
+            Op(process=0, type="ok", f="read", value=5, time=1),
+            Op(process=0, type="invoke", f="read", time=2),
+            Op(process=0, type="ok", f="read", value=3, time=3),
+        ])
+        assert SequentialChecker().check({}, h)["valid"] is False
+
+
+# --------------------------------------------------------------------------
+# FaunaDB
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def fauna_port():
+    srv, port, state = start_fake_fauna()
+    yield port, state
+    srv.shutdown()
+
+
+class TestFauna:
+    def test_fql_roundtrip(self, fauna_port):
+        port, _ = fauna_port
+        from jepsen_tpu.clients import fauna as fq
+        from jepsen_tpu.clients.fauna import AbortError, FaunaClient
+        c = FaunaClient("127.0.0.1", port)
+        c.query(fq.create_class("registers"))
+        c.query(fq.create("registers", 1, {"value": 3}))
+        r = fq.ref("registers", 1)
+        assert c.query(fq.select(["data", "value"], fq.get(r))) == 3
+        # CAS via if/equals/abort
+        c.query(fq.if_(fq.equals(
+            fq.select(["data", "value"], fq.get(r)), 3),
+            fq.update(r, {"value": 4}), fq.abort("cas failed")))
+        assert c.query(fq.select(["data", "value"], fq.get(r))) == 4
+        with pytest.raises(AbortError):
+            c.query(fq.if_(fq.equals(
+                fq.select(["data", "value"], fq.get(r)), 3),
+                fq.update(r, {"value": 5}), fq.abort("cas failed")))
+
+    @pytest.mark.parametrize("workload,kw", [
+        ("register", {"keys": 2, "ops_per_key": 40}),
+        ("bank", {}),
+        ("set", {}),
+        ("monotonic", {})])
+    def test_workloads_valid(self, fauna_port, workload, kw):
+        port, _ = fauna_port
+        from suites.faunadb.runner import WORKLOADS
+        wl = WORKLOADS[workload]({"algorithm": "cpu", **kw})
+        extra = {"set_read_upper": 300}
+        if workload == "bank":
+            extra["bank"] = {"accounts": list(range(8)),
+                             "total_amount": 100}
+        run_wire_test(wl, f"fauna-{workload}", port, time_limit=1.5,
+                      concurrency=4, **extra)
+
+
+# --------------------------------------------------------------------------
+# Chronos
+# --------------------------------------------------------------------------
+
+class TestChronosChecker:
+    def job(self, **kw):
+        return {"name": 1, "start": 1000.0, "count": 3, "duration": 2,
+                "epsilon": 10, "interval": 60, **kw}
+
+    def test_all_targets_satisfied(self):
+        from suites.chronos.checker import ChronosChecker
+        job = self.job()
+        runs = [{"name": 1, "start": s, "end": s + 2, "node": "n1"}
+                for s in (1001.0, 1061.0, 1121.0)]
+        h = History([
+            Op(process=0, type="invoke", f="add-job", value=job, time=0),
+            Op(process=0, type="ok", f="add-job", value=job, time=1),
+            Op(process=0, type="invoke", f="read", time=2),
+            Op(process=0, type="ok", f="read", value=runs, time=3,
+               extra={"read_time": 1200.0}),
+        ])
+        r = ChronosChecker().check({}, h)
+        assert r["valid"] is True, r
+
+    def test_missed_target_flagged(self):
+        from suites.chronos.checker import ChronosChecker
+        job = self.job()
+        runs = [{"name": 1, "start": 1001.0, "end": 1003.0,
+                 "node": "n1"}]  # second/third runs never happened
+        h = History([
+            Op(process=0, type="invoke", f="add-job", value=job, time=0),
+            Op(process=0, type="ok", f="add-job", value=job, time=1),
+            Op(process=0, type="invoke", f="read", time=2),
+            Op(process=0, type="ok", f="read", value=runs, time=3,
+               extra={"read_time": 1200.0}),
+        ])
+        r = ChronosChecker().check({}, h)
+        assert r["valid"] is False
+        assert r["jobs"][1]["solved"] == 1
+
+    def test_incomplete_runs_dont_count(self):
+        from suites.chronos.checker import job_targets, match_targets
+        job = self.job(count=1)
+        targets = job_targets(1200.0, job)
+        assert len(targets) == 1
+        sol, unmatched = match_targets(targets, [])
+        assert unmatched and not sol
+
+    def test_greedy_matching_is_maximal(self):
+        from suites.chronos.checker import match_targets
+        # two overlapping targets, two runs: greedy must satisfy both
+        targets = [(0, 20), (10, 30)]
+        sol, unmatched = match_targets(targets, [15.0, 16.0])
+        assert not unmatched and len(sol) == 2
+
+
+class TestChronosClient:
+    def test_job_json_schedule(self):
+        from suites.chronos.client import job_json
+        j = job_json({"name": 7, "start": 0.0, "count": 5,
+                      "duration": 3, "epsilon": 12, "interval": 45})
+        assert j["schedule"].startswith("R5/")
+        assert j["schedule"].endswith("/PT45S")
+        assert j["epsilon"] == "PT12S"
+        assert "echo \"7\"" in j["command"]
+
+    def test_add_job_posts(self):
+        srv, port, state = start_fake_chronos()
+        try:
+            from suites.chronos.client import ChronosClient
+            from jepsen_tpu.history import Op as HOp
+            c = ChronosClient("127.0.0.1")
+            t = {"db_port": port}
+            op = HOp(process=0, type="invoke", f="add-job",
+                     value={"name": 1, "start": time.time(), "count": 2,
+                            "duration": 1, "epsilon": 10,
+                            "interval": 30})
+            res = c.invoke(t, op)
+            assert res.type == "ok"
+            assert state["jobs"][0]["name"] == "1"
+        finally:
+            srv.shutdown()
+
+    def test_read_runs_parses_files(self, tmp_path, monkeypatch):
+        import suites.chronos.client as cc
+        # fabricate run files under a temp job dir, read via local exec
+        monkeypatch.setattr(cc, "JOB_DIR", str(tmp_path) + "/")
+        (tmp_path / "mew1").write_text(
+            "3\n2026-07-30T01:02:03,123456+00:00\n"
+            "2026-07-30T01:02:05,500000+00:00\n")
+        (tmp_path / "mew2").write_text(
+            "4\n2026-07-30T02:00:00,000000+00:00\n")  # incomplete
+        t = {"nodes": ["n1"], "remote": control.DummyRemote()}
+        control.setup_sessions(t)
+        runs = cc.read_runs(t)
+        control.teardown_sessions(t)
+        by_name = {r["name"]: r for r in runs}
+        assert by_name[3]["end"] is not None
+        assert by_name[4]["end"] is None
+        assert abs(by_name[3]["end"] - by_name[3]["start"] - 2.377) < 0.01

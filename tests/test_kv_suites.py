@@ -113,7 +113,7 @@ class TestAerospikeSuite:
         db.teardown(t, "n3")
         log = "\n".join(t["remote"].log)
         assert "service aerospike start" in log
-        assert "pkill -KILL -f asd" in log
+        assert "pkill -KILL -f '[a]sd'" in log
         assert "killall -STOP asd" in log
         assert "killall -CONT asd" in log
         control.teardown_sessions(t)
@@ -370,7 +370,7 @@ class TestLogCabinSuite:
         log = "\n".join(t["remote"].log)
         assert "--bootstrap" in log
         assert "Reconfigure -c n1:5254,n2:5254 set" in log
-        assert "pkill -KILL -f LogCabin" in log
+        assert "pkill -KILL -f '[L]ogCabin'" in log
         control.teardown_sessions(t)
 
     def test_construction(self):

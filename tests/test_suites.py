@@ -70,7 +70,7 @@ class TestEtcdSuite:
         db.teardown(t, "n3")
         log = "\n".join(t["remote"].log)
         assert "--initial-cluster n1=http://n1:2380" in log
-        assert "pkill -KILL -f etcd" in log
+        assert "pkill -KILL -f '[e]tcd'" in log
         assert "killall -STOP etcd" in log
         assert "killall -CONT etcd" in log
         assert "rm -rf /opt/etcd/data" in log
