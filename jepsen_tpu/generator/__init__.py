@@ -62,9 +62,22 @@ class Context:
     free_threads: frozenset
     workers: Tuple[Tuple[Any, Any], ...]  # ((thread, process), ...)
 
-    # -- derived ----------------------------------------------------------
+    # -- derived (cached per immutable context; caches are dropped by
+    # _clone so functional updates can't serve stale views) ---------------
     def worker_map(self) -> Dict[Any, Any]:
-        return dict(self.workers)
+        wm = self.__dict__.get("_wm")
+        if wm is None:
+            wm = self.__dict__["_wm"] = dict(self.workers)
+        return wm
+
+    def _clone(self, **kw) -> "Context":
+        new = object.__new__(Context)
+        d = new.__dict__
+        d["time"] = self.time
+        d["free_threads"] = self.free_threads
+        d["workers"] = self.workers
+        d.update(kw)
+        return new
 
     def all_threads(self) -> List[Any]:
         return [t for t, _ in self.workers]
@@ -83,7 +96,11 @@ class Context:
         return [wm[t] for t in self.sorted_free_threads()]
 
     def sorted_free_threads(self) -> List[Any]:
-        return sorted(self.free_threads, key=_thread_key)
+        sf = self.__dict__.get("_sfree")
+        if sf is None:
+            sf = self.__dict__["_sfree"] = sorted(self.free_threads,
+                                                  key=_thread_key)
+        return sf
 
     def some_free_process(self) -> Optional[Any]:
         """A uniformly random free process (fair scheduling; the reference
@@ -92,31 +109,38 @@ class Context:
         Client threads are preferred; the nemesis only receives ops when the
         context is restricted to it (via the nemesis() wrapper) — unwrapped
         workload generators never land on the nemesis thread."""
-        free = self.sorted_free_threads()
-        has_client_workers = any(t != NEMESIS for t, _ in self.workers)
-        if has_client_workers:
-            pool = [t for t in free if t != NEMESIS]
-        else:
-            pool = free
+        pool = self.__dict__.get("_pool")
+        if pool is None:
+            free = self.sorted_free_threads()
+            if any(t != NEMESIS for t, _ in self.workers):
+                pool = [t for t in free if t != NEMESIS]
+            else:
+                pool = free
+            self.__dict__["_pool"] = pool
         if not pool:
             return None
         return self.worker_map()[RNG.choice(pool)]
 
     # -- functional updates ----------------------------------------------
     def with_time(self, time: int) -> "Context":
-        return replace(self, time=time)
+        # keeps free_threads/workers: caches may be rebuilt but stay valid
+        new = self._clone(time=time)
+        for k in ("_wm", "_sfree", "_pool"):
+            if k in self.__dict__:
+                new.__dict__[k] = self.__dict__[k]
+        return new
 
     def busy_thread(self, thread) -> "Context":
-        return replace(self, free_threads=self.free_threads - {thread})
+        return self._clone(free_threads=self.free_threads - {thread})
 
     def free_thread(self, thread) -> "Context":
-        return replace(self, free_threads=self.free_threads | {thread})
+        return self._clone(free_threads=self.free_threads | {thread})
 
     def with_next_process(self, thread) -> "Context":
         """Replace thread's process with its next incarnation (crashed
         process semantics: p' = p + (#client threads), generator.clj:519-529)."""
         n = len([t for t, _ in self.workers if t != NEMESIS])
-        wm = self.worker_map()
+        wm = dict(self.worker_map())  # never mutate the shared cache
         p = wm[thread]
         wm[thread] = p + n if isinstance(p, int) else p
         return replace(self, workers=tuple(sorted(wm.items(), key=lambda kv: _thread_key(kv[0]))))
@@ -173,33 +197,44 @@ def lift(g: GenLike) -> Optional[Generator]:
     raise TypeError(f"can't lift {type(g)} into a Generator")
 
 
+_OP_STD_FIELDS = ("process", "type", "f", "value", "time")
+
+
 def fill_op(template: Union[Dict, Op], ctx: Context):
     """Complete an op template with time/process from the context; returns
-    PENDING if it needs a free process and none exists."""
-    if isinstance(template, Op):
-        op = template
-        d_process = op.process
-        op = op.with_(time=ctx.time)
-    else:
-        d = dict(template)
-        d_process = d.get("process")
-        op = Op(process=d_process,
-                type=d.get("type", INVOKE),
-                f=d.get("f"),
-                value=d.get("value"),
-                time=ctx.time,
-                extra={k: v for k, v in d.items()
-                       if k not in ("process", "type", "f", "value", "time")})
-    if op.process is None:
-        p = ctx.some_free_process()
-        if p is None:
+    PENDING if it needs a free process and none exists.  The process is
+    resolved *before* any Op is built — dispatch-blocked draws are the
+    scheduler's common case and must stay allocation-free."""
+    d_process = template.process if isinstance(template, Op) \
+        else template.get("process")
+    if d_process is None:
+        process = ctx.some_free_process()
+        if process is None:
             return PENDING
-        op = op.with_(process=p)
     else:
         # A fixed process must be free to dispatch.
-        t = ctx.process_thread(op.process)
+        t = ctx.process_thread(d_process)
         if t is None or t not in ctx.free_threads:
             return PENDING
+        process = d_process
+    if isinstance(template, Op):
+        return template.with_(time=ctx.time, process=process)
+    op = object.__new__(Op)
+    od = op.__dict__
+    od["process"] = process
+    od["type"] = template.get("type", INVOKE)
+    od["f"] = template.get("f")
+    od["value"] = template.get("value")
+    od["time"] = ctx.time
+    od["index"] = None
+    od["error"] = None
+    extra = None
+    for k in template:
+        if k not in _OP_STD_FIELDS:
+            if extra is None:
+                extra = {}
+            extra[k] = template[k]
+    od["extra"] = extra if extra is not None else {}
     return op
 
 
@@ -306,7 +341,12 @@ class _Wrap(Generator):
     def update(self, test, ctx, event):
         if self.gen is None:
             return self
-        return self._new(self.gen.update(test, ctx, event))
+        g2 = self.gen.update(test, ctx, event)
+        # identity propagation: most generators ignore updates, so the
+        # common completion event must not clone the whole wrapper chain
+        if g2 is self.gen:
+            return self
+        return self._new(g2)
 
 
 class Validate(_Wrap):
@@ -443,7 +483,10 @@ class OnThreads(_Wrap):
         if t is None or not self.pred(t):
             return self
         sub = ctx.restrict(self._threads(ctx))
-        return self._new(self.gen.update(test, sub, event))
+        g2 = self.gen.update(test, sub, event)
+        if g2 is self.gen:
+            return self
+        return self._new(g2)
 
 
 def on_threads(pred, gen):
@@ -503,7 +546,10 @@ class Any(Generator):
         return (v, Any(*gens))
 
     def update(self, test, ctx, event):
-        return Any(*[g.update(test, ctx, event) for g in self.gens])
+        gens2 = [g.update(test, ctx, event) for g in self.gens]
+        if all(a is b for a, b in zip(gens2, self.gens)):
+            return self
+        return Any(*gens2)
 
 
 def any_gen(*gens):
@@ -658,11 +704,24 @@ class Mix(Generator):
         self.gens = [lift(g) for g in gens if g is not None]
 
     def op(self, test, ctx):
+        # one uniform draw covers the common case; only if that child
+        # can't produce do we pay for shuffling the rest (keeps fallback
+        # selection uniform, unlike plain rotation)
         gens = list(self.gens)
-        order = list(range(len(gens)))
-        RNG.shuffle(order)
+        n = len(gens)
+        order = [RNG.randrange(n) if n > 1 else 0]
+        rest = None
         pending = False
-        for i in order:
+        k = 0
+        while k < len(order) or rest is None:
+            if k >= len(order):
+                rest = [i for i in range(n) if i != order[0]]
+                RNG.shuffle(rest)
+                order.extend(rest)
+                if k >= len(order):
+                    break
+            i = order[k]
+            k += 1
             r = gens[i].op(test, ctx)
             if r is None:
                 gens2 = gens[:i] + gens[i + 1:]

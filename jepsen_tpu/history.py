@@ -26,7 +26,7 @@ encoding delegated to the model (see jepsen_tpu.models.base).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,6 +46,10 @@ TYPE_CODE = {t: i for i, t in enumerate(TYPES)}
 # Reserved logical process for the nemesis, mirroring the reference where the
 # nemesis runs as the :nemesis process (jepsen/src/jepsen/generator.clj:1105).
 NEMESIS = "nemesis"
+
+
+_OP_FIELDS = frozenset(
+    ("process", "type", "f", "value", "time", "index", "error", "extra"))
 
 
 @dataclass
@@ -84,10 +88,18 @@ class Op:
         return self.type == INFO
 
     def with_(self, **kw) -> "Op":
+        # hand-rolled copy: dataclasses.replace re-runs __init__ and is
+        # the scheduler's hottest call (hundreds of thousands per run)
         extra = kw.pop("extra", None)
-        new = replace(self, **kw)
+        if not kw.keys() <= _OP_FIELDS:
+            raise TypeError(
+                f"unknown Op fields: {sorted(kw.keys() - _OP_FIELDS)}")
+        new = object.__new__(Op)
+        d = self.__dict__.copy()
+        d.update(kw)
         if extra:
-            new.extra = {**self.extra, **extra}
+            d["extra"] = {**self.extra, **extra}
+        new.__dict__ = d
         return new
 
     def to_dict(self) -> Dict[str, Any]:

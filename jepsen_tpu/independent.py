@@ -159,7 +159,13 @@ class ConcurrentGenerator(gen.Generator):
                     pending = True
                     c.active[gi] = g2
                     break
-                c.active[gi] = g2
+                if g2 is None:
+                    # key exhausted via a final (op, None) draw (limit's
+                    # shape): free the group so the next draw advances it
+                    # to the next unclaimed key instead of parking forever
+                    del c.active[gi]
+                else:
+                    c.active[gi] = g2
                 return (v, c)
         if pending:
             return (gen.PENDING, c)
@@ -202,7 +208,13 @@ class IndependentChecker(Checker):
         results: Dict[Any, Dict[str, Any]] = {}
 
         inner = self.inner
-        if isinstance(inner, Linearizable) and inner._jax_model() is not None:
+        # only the pure-device algorithms take the batched engine; an
+        # explicit host algorithm stays off the device, and "competition"
+        # must race host+device per key rather than be hijacked
+        # (checker.clj:199-202's algorithm switch semantics)
+        wants_device = isinstance(inner, Linearizable) and \
+            inner.algorithm in (None, "tpu")
+        if wants_device and inner._jax_model() is not None:
             from jepsen_tpu.parallel import check_batch
             jm = inner._jax_model()
             rs = check_batch(jm, [subs[k] for k in keys], mesh=self.mesh,

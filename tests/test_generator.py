@@ -210,3 +210,24 @@ class TestPerf:
         assert n == 20_000
         rate = n / dt
         assert rate > 5_000, f"scheduler too slow: {rate:.0f} ops/s"
+
+
+class TestConcurrentGeneratorRotation:
+    """Regression: with fewer thread groups than keys, a key finishing
+    via a final (op, None) draw (limit's exhaustion shape) must free its
+    group for the next key — this once parked the group forever and the
+    interpreter span on PENDING without terminating."""
+
+    def test_groups_rotate_through_all_keys(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu import independent
+        from jepsen_tpu.generator import testkit
+
+        g = independent.concurrent_generator(
+            2, [0, 1, 2, 3, 4],
+            lambda k: gen.limit(6, gen.repeat({"f": "write", "value": k})))
+        hist = testkit.simulate({"nodes": ["n1"], "concurrency": 4}, g)
+        keys = {op.value[0] for op in hist if op.f == "write"}
+        assert keys == {0, 1, 2, 3, 4}
+        invokes = [op for op in hist if op.type == "invoke"]
+        assert len(invokes) == 5 * 6
