@@ -142,3 +142,27 @@ def lt(*args) -> Dict[str, Any]:
 
 def time_() -> Dict[str, Any]:
     return {"time": "now"}
+
+
+def create_index(name: str, source_class: str,
+                 values_field: str = "value",
+                 serialized: bool = True) -> Dict[str, Any]:
+    """Index over a class's instances, emitting one data field
+    (pages.clj's elements index; `serialized` mirrors the
+    serialized-indices workload option)."""
+    return {"create_index": {"object": {
+        "name": name,
+        "source": {"@ref": f"classes/{source_class}"},
+        "values": [{"object": {"field": ["data", values_field]}}],
+        "serialized": serialized}}}
+
+
+def match(index: str) -> Dict[str, Any]:
+    return {"match": {"@ref": f"indexes/{index}"}}
+
+
+def paginate(set_expr, size: int, after=None) -> Dict[str, Any]:
+    out = {"paginate": set_expr, "size": size}
+    if after is not None:
+        out["after"] = after
+    return out

@@ -709,6 +709,8 @@ class Mix(Generator):
         # selection uniform, unlike plain rotation)
         gens = list(self.gens)
         n = len(gens)
+        if n == 0:
+            return None
         order = [RNG.randrange(n) if n > 1 else 0]
         rest = None
         pending = False

@@ -195,3 +195,81 @@ class MonotonicClient(_FaunaBase):
             raise ValueError(op.f)
         except (AbortError, FaunaError, *NET_ERRORS) as e:
             return self._convert(op, e)
+
+
+class PagesClient(_FaunaBase):
+    """Grouped adds in one transaction; reads paginate the elements
+    index in small pages while writes race (pages.clj:26-91): a read
+    must be a union of complete add-groups."""
+
+    CLASS = "pages"
+    INDEX = "pages-values"
+    PAGE_SIZE = 5
+
+    def setup(self, test):
+        super().setup(test)
+        try:
+            self.conn.query(fq.create_index(self.INDEX, self.CLASS))
+        except (FaunaError, *NET_ERRORS):
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "add":
+                group = op.value
+                self.conn.query(fq.do(*[
+                    fq.create(self.CLASS, v, {"value": v})
+                    for v in group]))
+                return op.with_(type=OK)
+            if op.f == "read":
+                out: List[int] = []
+                after = None
+                while True:
+                    page = self.conn.query(
+                        fq.paginate(fq.match(self.INDEX),
+                                    self.PAGE_SIZE, after=after))
+                    out.extend(page.get("data", []))
+                    after = page.get("after")
+                    if after is None:
+                        break
+                return op.with_(type=OK, value=out)
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)
+
+
+class MultiRegisterClient(_FaunaBase):
+    """Several registers; each increment bumps one register in its own
+    transaction, reads snapshot all of them in one transaction
+    (multimonotonic.clj:76-111).  Observed states must form a
+    componentwise-monotonic chain."""
+
+    CLASS = "multiregisters"
+    N = 4
+
+    def setup(self, test):
+        super().setup(test)
+        for i in range(self.N):
+            try:
+                self.conn.query(fq.if_(
+                    fq.exists(fq.ref(self.CLASS, i)), None,
+                    fq.create(self.CLASS, i, {"value": 0})))
+            except (FaunaError, *NET_ERRORS):
+                pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "inc":
+                r = fq.ref(self.CLASS, op.value)
+                self.conn.query(fq.update(r, {"value": fq.add(
+                    fq.select(["data", "value"], fq.get(r)), 1)}))
+                return op.with_(type=OK)
+            if op.f == "read":
+                vals = self.conn.query(
+                    [fq.select(["data", "value"],
+                               fq.get(fq.ref(self.CLASS, i)))
+                     for i in range(self.N)])
+                return op.with_(type=OK, value=vals)
+            raise ValueError(op.f)
+        except (AbortError, FaunaError, *NET_ERRORS) as e:
+            return self._convert(op, e)

@@ -1,11 +1,11 @@
 """FaunaDB suite CLI.
 
 Parity: faunadb/src/jepsen/faunadb/runner.clj:30-41's workload registry —
-register, bank, set, monotonic implemented here (g2 / internal /
-multimonotonic / pages are covered by the shared transactional kits or
-queued for a later pass; bank-index's serialized-indices flag becomes
-set's strong-read option), plus runner.clj:43-60's workload-option sweep
-matrices.
+register, bank, set, monotonic, pages (paginated index reads racing
+grouped adds), and multimonotonic (componentwise-monotonic register
+vectors); g2/internal are covered by the shared transactional kits.
+bank-index's serialized-indices flag is the index's `serialized`
+option.
 """
 
 from __future__ import annotations
@@ -80,8 +80,92 @@ def monotonic_workload(opts) -> Dict[str, Any]:
             "checker": MonotonicChecker()}
 
 
+class PagesChecker(Checker):
+    """Every ok read must be a union of complete add-groups: no torn
+    groups, no duplicates (pages.clj:93-145)."""
+
+    def check(self, test, history: History, opts=None):
+        invoked, failed = {}, set()
+        for op in history:
+            if op.f != "add":
+                continue
+            if op.type == "invoke":
+                for v in op.value:
+                    invoked[v] = frozenset(op.value)
+            elif op.type == "fail":
+                failed.update(op.value)
+        errs = []
+        for op in history:
+            if op.f != "read" or op.type != OK:
+                continue
+            seen = op.value or []
+            if len(set(seen)) != len(seen):
+                errs.append({**op.to_dict(), "error": "duplicates"})
+                continue
+            sset = set(seen)
+            for v in seen:
+                group = invoked.get(v)
+                if group is None:
+                    errs.append({**op.to_dict(),
+                                 "error": f"phantom element {v}"})
+                    break
+                if v in failed:
+                    errs.append({**op.to_dict(),
+                                 "error": f"failed add {v} visible"})
+                    break
+                if not group <= sset:
+                    errs.append({**op.to_dict(),
+                                 "error": f"torn group {sorted(group)}"})
+                    break
+        return {"valid": not errs, "errors": errs[:16]}
+
+
+class MultiMonotonicChecker(Checker):
+    """Observed register vectors must form a componentwise-monotonic
+    chain — a state with one register ahead and another behind some
+    other state is a fractured timeline (multimonotonic.clj:152-253)."""
+
+    def check(self, test, history: History, opts=None):
+        states = [tuple(op.value) for op in history
+                  if op.f == "read" and op.type == OK and op.value]
+        ordered = sorted(set(states), key=sum)
+        bad = []
+        for a, b in zip(ordered, ordered[1:]):
+            if not all(x <= y for x, y in zip(a, b)):
+                bad.append({"earlier": list(a), "later": list(b)})
+        return {"valid": not bad, "states": len(ordered),
+                "incomparable": bad[:16]}
+
+
+def pages_workload(opts) -> Dict[str, Any]:
+    counter = iter(range(0, 10 ** 9, 3))
+
+    def add():
+        base = next(counter)
+        return {"f": "add", "value": [base, base + 1, base + 2]}
+
+    g = gen.mix([gen.FnGen(add), gen.repeat({"f": "read"})])
+    return {"client": fc.PagesClient(),
+            "generator": gen.stagger(1 / 10, g),
+            "checker": PagesChecker()}
+
+
+def multimonotonic_workload(opts) -> Dict[str, Any]:
+    import random as _r
+    g = gen.mix([
+        gen.FnGen(lambda: {"f": "inc",
+                           "value": _r.randrange(
+                               fc.MultiRegisterClient.N)}),
+        gen.repeat({"f": "read"})])
+    return {"client": fc.MultiRegisterClient(),
+            "generator": gen.stagger(1 / 20, g),
+            "checker": MultiMonotonicChecker()}
+
+
 WORKLOADS = {"register": register_workload, "bank": bank_workload,
-             "set": set_workload, "monotonic": monotonic_workload}
+             "set": set_workload, "monotonic": monotonic_workload,
+             "pages": pages_workload,
+             "multimonotonic": multimonotonic_workload}
 
 
 def faunadb_test(opts: Dict[str, Any]) -> Dict[str, Any]:

@@ -1870,6 +1870,28 @@ def start_fake_fauna():
         if "exists" in expr:
             cls, id_ = ref_parts(ev(expr["exists"], env))
             return id_ in state["classes"].get(cls, {})
+        if "create_index" in expr:
+            params = ev(expr["create_index"], env)
+            cls = params["source"]["@ref"].split("/")[1]
+            field = params["values"][0]["field"][-1]
+            state.setdefault("indexes", {})[params["name"]] = (cls, field)
+            return {"name": params["name"]}
+        if "paginate" in expr:
+            m = expr["paginate"]
+            idx_name = m["match"]["@ref"].split("/")[1]
+            cls, field = state.get("indexes", {})[idx_name]
+            vals = sorted(d.get(field) for d in
+                          state["classes"].get(cls, {}).values()
+                          if d.get(field) is not None)
+            after = expr.get("after")
+            if after is not None:
+                vals = [v for v in vals if v >= after]
+            size = expr.get("size", 64)
+            page, rest = vals[:size], vals[size:]
+            out = {"data": page}
+            if rest:
+                out["after"] = rest[0]
+            return out
         if "create_class" in expr:
             params = ev(expr["create_class"], env)
             name = params["name"]

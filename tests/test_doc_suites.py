@@ -320,3 +320,41 @@ class TestChronosClient:
         assert by_name[3]["end"] is not None
         assert by_name[4]["end"] is None
         assert abs(by_name[3]["end"] - by_name[3]["start"] - 2.377) < 0.01
+
+
+class TestFaunaPagesAndMulti:
+    def test_pages_workload_valid(self, fauna_port):
+        port, _ = fauna_port
+        from suites.faunadb.runner import WORKLOADS
+        run_wire_test(WORKLOADS["pages"]({}), "fauna-pages", port,
+                      time_limit=1.5, concurrency=4)
+
+    def test_multimonotonic_workload_valid(self, fauna_port):
+        port, _ = fauna_port
+        from suites.faunadb.runner import WORKLOADS
+        run_wire_test(WORKLOADS["multimonotonic"]({}), "fauna-multi",
+                      port, time_limit=1.5, concurrency=4)
+
+    def test_pages_checker_flags_torn_group(self):
+        from suites.faunadb.runner import PagesChecker
+        h = History([
+            Op(process=0, type="invoke", f="add", value=[0, 1, 2],
+               time=0),
+            Op(process=0, type="ok", f="add", value=[0, 1, 2], time=1),
+            Op(process=1, type="invoke", f="read", time=2),
+            Op(process=1, type="ok", f="read", value=[0, 1], time=3),
+        ])
+        r = PagesChecker().check({}, h)
+        assert r["valid"] is False and "torn" in r["errors"][0]["error"]
+
+    def test_multimonotonic_checker_flags_fracture(self):
+        from suites.faunadb.runner import MultiMonotonicChecker
+        h = History([
+            Op(process=0, type="invoke", f="read", time=0),
+            Op(process=0, type="ok", f="read", value=[1, 0, 0, 0],
+               time=1),
+            Op(process=1, type="invoke", f="read", time=2),
+            Op(process=1, type="ok", f="read", value=[0, 2, 0, 0],
+               time=3),
+        ])
+        assert MultiMonotonicChecker().check({}, h)["valid"] is False
