@@ -200,16 +200,26 @@ class MonotonicClient(_FaunaBase):
 class PagesClient(_FaunaBase):
     """Grouped adds in one transaction; reads paginate the elements
     index in small pages while writes race (pages.clj:26-91): a read
-    must be a union of complete add-groups."""
+    must be a union of complete add-groups.  ``serialized`` toggles the
+    index's serialized flag — the reference's serialized-indices sweep
+    dimension (runner.clj:46-52)."""
 
     CLASS = "pages"
     INDEX = "pages-values"
     PAGE_SIZE = 5
 
+    def __init__(self, conn=None, serialized: bool = True):
+        super().__init__(conn)
+        self.serialized = serialized
+
+    def open(self, test, node):
+        return PagesClient(connect(test, node), self.serialized)
+
     def setup(self, test):
         super().setup(test)
         try:
-            self.conn.query(fq.create_index(self.INDEX, self.CLASS))
+            self.conn.query(fq.create_index(
+                self.INDEX, self.CLASS, serialized=self.serialized))
         except (FaunaError, *NET_ERRORS):
             pass
 

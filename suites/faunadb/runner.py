@@ -121,20 +121,35 @@ class PagesChecker(Checker):
 
 
 class MultiMonotonicChecker(Checker):
-    """Observed register vectors must form a componentwise-monotonic
-    chain — a state with one register ahead and another behind some
-    other state is a fractured timeline (multimonotonic.clj:152-253)."""
+    """Registers are increment-only, so two invariants hold
+    (multimonotonic.clj:152-253): observed vectors must be mutually
+    comparable (no fractured snapshots — one register ahead, another
+    behind), and each process's successive reads must never go backwards
+    in any component (no time-travel/stale reads)."""
 
     def check(self, test, history: History, opts=None):
-        states = [tuple(op.value) for op in history
-                  if op.f == "read" and op.type == OK and op.value]
-        ordered = sorted(set(states), key=sum)
-        bad = []
+        reads = [(op.process, tuple(op.value)) for op in history
+                 if op.f == "read" and op.type == OK and op.value]
+        # temporal: per-process monotonicity in completion order
+        last: Dict[Any, tuple] = {}
+        stale = []
+        for proc, st in reads:
+            prev = last.get(proc)
+            if prev is not None and any(x < y
+                                        for x, y in zip(st, prev)):
+                stale.append({"process": proc, "earlier": list(prev),
+                              "later": list(st)})
+            last[proc] = st
+        # spatial: all observed states form a chain (checking successive
+        # sum-sorted pairs is complete: a total order exists iff every
+        # such pair is componentwise ordered)
+        ordered = sorted({st for _, st in reads}, key=sum)
+        frac = []
         for a, b in zip(ordered, ordered[1:]):
             if not all(x <= y for x, y in zip(a, b)):
-                bad.append({"earlier": list(a), "later": list(b)})
-        return {"valid": not bad, "states": len(ordered),
-                "incomparable": bad[:16]}
+                frac.append({"earlier": list(a), "later": list(b)})
+        return {"valid": not (stale or frac), "states": len(ordered),
+                "nonmonotonic": stale[:16], "incomparable": frac[:16]}
 
 
 def pages_workload(opts) -> Dict[str, Any]:
@@ -145,7 +160,8 @@ def pages_workload(opts) -> Dict[str, Any]:
         return {"f": "add", "value": [base, base + 1, base + 2]}
 
     g = gen.mix([gen.FnGen(add), gen.repeat({"f": "read"})])
-    return {"client": fc.PagesClient(),
+    return {"client": fc.PagesClient(
+                serialized=bool(opts.get("serialized_indices", True))),
             "generator": gen.stagger(1 / 10, g),
             "checker": PagesChecker()}
 
@@ -187,6 +203,10 @@ def _extra(parser):
     parser.add_argument("--keys", type=int, default=8)
     parser.add_argument("--ops-per-key", type=int, default=100)
     parser.add_argument("--total-amount", type=int, default=100)
+    parser.add_argument("--no-serialized-indices", dest="serialized_indices",
+                        action="store_false", default=True,
+                        help="build the pages index non-serialized "
+                             "(runner.clj:46-52's sweep dimension)")
 
 
 if __name__ == "__main__":

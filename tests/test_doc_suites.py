@@ -358,3 +358,17 @@ class TestFaunaPagesAndMulti:
                time=3),
         ])
         assert MultiMonotonicChecker().check({}, h)["valid"] is False
+
+    def test_multimonotonic_checker_flags_stale_read(self):
+        # per-process time-travel: later read goes backwards
+        from suites.faunadb.runner import MultiMonotonicChecker
+        h = History([
+            Op(process=0, type="invoke", f="read", time=0),
+            Op(process=0, type="ok", f="read", value=[3, 3, 3, 3],
+               time=1),
+            Op(process=0, type="invoke", f="read", time=2),
+            Op(process=0, type="ok", f="read", value=[1, 1, 1, 1],
+               time=3),
+        ])
+        r = MultiMonotonicChecker().check({}, h)
+        assert r["valid"] is False and r["nonmonotonic"]
