@@ -142,3 +142,15 @@ def test_all_cmd(tests_fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]],
     print(json.dumps({"failures": summary["failures"],
                       "unknown": summary["unknown"]}))
     return summary["exit"]
+
+
+def _main() -> int:
+    """`python -m jepsen_tpu.cli` — suite-less entry point: analyze a
+    stored run with its persisted checker config unavailable (stats-only
+    re-check) or serve the results browser (cli.clj:521's -main)."""
+    return single_test_cmd(lambda opts: dict(opts), prog="jepsen-tpu")
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -80,3 +80,16 @@ class TestWeb:
             assert zipdata[:2] == b"PK"
         finally:
             httpd.shutdown()
+
+
+class TestModuleMain:
+    def test_suiteless_serve_and_analyze(self, tmp_path):
+        """`python -m jepsen_tpu.cli` works without a suite module
+        (tutorial chapter 1's analyze example)."""
+        import subprocess
+        import sys
+        r = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0
+        assert "analyze" in r.stdout and "serve" in r.stdout
