@@ -146,11 +146,23 @@ def test_all_cmd(tests_fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]],
 
 def _main() -> int:
     """`python -m jepsen_tpu.cli` — suite-less entry point: analyze a
-    stored run with its persisted checker config unavailable (stats-only
-    re-check) or serve the results browser (cli.clj:521's -main)."""
-    return single_test_cmd(lambda opts: dict(opts), prog="jepsen-tpu")
+    stored run (stats-only: the persisted test map carries no checker
+    objects) or serve the results browser (cli.clj:521's -main).
+    Running a *test* needs a suite module's test function — refuse it
+    rather than report an empty workload as valid."""
+    def test_fn(opts: Dict[str, Any]) -> Dict[str, Any]:
+        if "checker" not in opts:
+            from jepsen_tpu.checker import Stats
+            opts = {**opts, "checker": Stats()}
+        return opts
+
+    if sys.argv[1:2] == ["test"]:
+        print("jepsen-tpu: `test` needs a suite runner "
+              "(python -m suites.<name>.runner test ...); the bare module "
+              "only supports analyze/serve", file=sys.stderr)
+        return 2
+    return single_test_cmd(test_fn, prog="jepsen-tpu")
 
 
 if __name__ == "__main__":
-    import sys
     sys.exit(_main())

@@ -83,13 +83,36 @@ class TestWeb:
 
 
 class TestModuleMain:
-    def test_suiteless_serve_and_analyze(self, tmp_path):
-        """`python -m jepsen_tpu.cli` works without a suite module
-        (tutorial chapter 1's analyze example)."""
+    def test_suiteless_analyze_runs_stats(self, tmp_path):
+        """`python -m jepsen_tpu.cli analyze` re-checks a stored run with
+        the Stats checker (tutorial chapter 1's example)."""
+        import json
+        import subprocess
+        import sys
+
+        from jepsen_tpu import core
+        from jepsen_tpu.checker import Stats
+        from jepsen_tpu.history import Op
+
+        done = core.run({
+            "name": "mm", "nodes": [], "concurrency": 1,
+            "store_base": str(tmp_path),
+            "generator": [{"f": "noop"}],
+            "checker": Stats()})
+        r = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "analyze",
+             done["store_dir"]],
+            capture_output=True, text=True, timeout=120, cwd="/root/repo")
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["valid"] is True and "by-f" in out.get("stats", out)
+
+    def test_suiteless_test_refused(self):
         import subprocess
         import sys
         r = subprocess.run(
-            [sys.executable, "-m", "jepsen_tpu.cli", "--help"],
-            capture_output=True, text=True, timeout=60)
-        assert r.returncode == 0
-        assert "analyze" in r.stdout and "serve" in r.stdout
+            [sys.executable, "-m", "jepsen_tpu.cli", "test",
+             "--dummy-ssh"],
+            capture_output=True, text=True, timeout=60, cwd="/root/repo")
+        assert r.returncode == 2
+        assert "suite runner" in r.stderr
