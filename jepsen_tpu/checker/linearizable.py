@@ -14,13 +14,34 @@ algorithms are:
 
 from __future__ import annotations
 
+import atexit
 import threading
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from jepsen_tpu.checker import wgl_cpu, wgl_tpu
 from jepsen_tpu.checker.core import Checker, UNKNOWN
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel, Model
+
+
+# Losing competition racers still draining after their verdict was beaten.
+# Joined (bounded) at interpreter exit: tearing down XLA under a daemon
+# thread mid-dispatch aborts the process ("FATAL: exception not rethrown"),
+# while a plain non-daemon thread would hang exit forever if a tunneled
+# device transfer wedges.  Cancellation makes the join fast in practice —
+# losers exit at their next chunk boundary / closure round.
+_stragglers: List[threading.Thread] = []
+_stragglers_lock = threading.Lock()
+
+
+@atexit.register
+def _drain_stragglers(timeout: float = 30.0) -> None:
+    import time
+    deadline = time.monotonic() + timeout
+    with _stragglers_lock:
+        ts = list(_stragglers)
+    for t in ts:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 class Linearizable(Checker):
@@ -82,38 +103,76 @@ class Linearizable(Checker):
             res["render-error"] = str(e)
 
     def _competition(self, test, history):
-        """Race the device engine and the host oracle; first definite verdict
-        wins (knossos.competition parity)."""
+        """Race the device engine and the host oracle; the first *definite*
+        verdict (valid True/False) wins and the loser is cancelled.  An
+        UNKNOWN from one racer — e.g. the CPU oracle exploding early — must
+        NOT mask a definite answer still coming from the other; only when
+        both racers finish indefinite does the race report unknown.
+        Parity: knossos.competition via checker.clj:199-202, which takes the
+        first non-:unknown analysis and cancels the losing future."""
         jm, cm = self._jax_model(), self._cpu_model()
         if jm is None or cm is None:
             # only one tier available: no race
             self2 = Linearizable(self.model, None, **self.engine_opts)
             return self2.check(test, history)
         done = threading.Event()
-        results: Dict[str, Any] = {}
+        cancel = threading.Event()
+        lock = threading.Lock()
+        results: Dict[str, Any] = {"indefinite": {}}
+
+        def post(solver: str, r: Dict[str, Any]) -> None:
+            definite = r.get("valid") in (True, False)
+            with lock:
+                if definite and "winner" not in results:
+                    results["winner"] = {**r, "solver": solver}
+                    cancel.set()   # stop the loser's search
+                    done.set()
+                elif definite:
+                    # A second definite verdict: surface disagreement (a
+                    # solver bug!) instead of silently discarding it.
+                    w = results["winner"]
+                    if w.get("valid") != r.get("valid"):
+                        w["disagreement"] = {**r, "solver": solver}
+                else:
+                    results["indefinite"][solver] = r
+                    if len(results["indefinite"]) == 2:
+                        done.set()  # both indefinite: race is over anyway
 
         def run_tpu():
             try:
-                r = wgl_tpu.check(jm, history, **self.engine_opts)
+                r = wgl_tpu.check(jm, history, cancel=cancel,
+                                  **self.engine_opts)
             except Exception as e:  # noqa: BLE001
                 r = {"valid": UNKNOWN, "error": str(e)}
-            results.setdefault("winner", {**r, "solver": "tpu"})
-            done.set()
+            post("tpu", r)
 
         def run_cpu():
             try:
-                r = wgl_cpu.check(cm, history)
+                r = wgl_cpu.check(cm, history, cancel=cancel)
+            except wgl_cpu.Cancelled:
+                r = {"valid": UNKNOWN, "cancelled": True}
+            except wgl_cpu.SearchExploded as e:
+                r = {"valid": UNKNOWN, "error": str(e)}
             except Exception as e:  # noqa: BLE001
                 r = {"valid": UNKNOWN, "error": str(e)}
-            results.setdefault("winner", {**r, "solver": "cpu"})
-            done.set()
+            post("cpu", r)
 
         ts = [threading.Thread(target=run_tpu, daemon=True),
               threading.Thread(target=run_cpu, daemon=True)]
         for t in ts:
             t.start()
         done.wait()
-        return results["winner"]
+        cancel.set()  # both-indefinite path never set it
+        for t in ts:  # losers usually exit within one chunk/closure round
+            t.join(timeout=0.2)
+        with _stragglers_lock:
+            _stragglers.extend(t for t in ts if t.is_alive())
+        with lock:
+            if "winner" in results:
+                return results["winner"]
+            # Both solvers indefinite: report the combined unknown.
+            return {"valid": UNKNOWN, "solver": "competition",
+                    "solvers": dict(results["indefinite"])}
 
 
 def linearizable(model, algorithm: Optional[str] = None, **kw) -> Checker:

@@ -31,11 +31,17 @@ Config = Tuple[int, Model]  # (pending-window bitmask, model state)
 
 def check(model: Model, history: History,
           prepared: Optional[PreparedHistory] = None,
-          max_configs: int = 2_000_000) -> Dict[str, Any]:
+          max_configs: int = 2_000_000,
+          cancel=None) -> Dict[str, Any]:
     """Decide linearizability of ``history`` against ``model``.
 
     Returns a knossos-shaped analysis map: ``{"valid": bool, ...}`` with the
-    failing op and a sample of final configurations on refutation."""
+    failing op and a sample of final configurations on refutation.
+
+    ``cancel`` is an optional :class:`threading.Event`; when another solver
+    in a competition race has already produced a definite verdict, the losing
+    search aborts at the next RETURN event by raising :class:`Cancelled`
+    (knossos.competition cancels the losing future, checker.clj:199-202)."""
     p = prepared if prepared is not None else prepare(history)
     window: Dict[int, Op] = {}         # slot -> pending op
     configs: Set[Config] = {(0, model)}
@@ -47,7 +53,7 @@ def check(model: Model, history: History,
             window[slot] = p.ops[op_id]
             continue
         # RETURN: expand closure, then prune on the returning op's bit.
-        configs = _closure(configs, window, max_configs)
+        configs = _closure(configs, window, max_configs, cancel)
         n_explored += len(configs)
         bit = 1 << slot
         survivors = {(mask & ~bit, m) for (mask, m) in configs if mask & bit}
@@ -72,10 +78,14 @@ def check(model: Model, history: History,
 
 
 def _closure(configs: Set[Config], window: Dict[int, Op],
-             max_configs: int) -> Set[Config]:
+             max_configs: int, cancel=None) -> Set[Config]:
     seen = set(configs)
     frontier = configs
     while frontier:
+        # Closure is the dominant cost (up to max_configs states), so a
+        # cancelled race must abort here, not just at RETURN boundaries.
+        if cancel is not None and cancel.is_set():
+            raise Cancelled()
         new: Set[Config] = set()
         for mask, m in frontier:
             for slot, op in window.items():
@@ -101,6 +111,10 @@ class SearchExploded(Exception):
     def __init__(self, n):
         super().__init__(f"configuration set exceeded budget at {n}")
         self.n = n
+
+
+class Cancelled(Exception):
+    """Search aborted because a competing solver already won the race."""
 
 
 def _render_configs(configs: Set[Config], window: Dict[int, Op], limit: int):

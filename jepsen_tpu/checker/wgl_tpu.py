@@ -255,7 +255,7 @@ def check(model: JaxModel, history: Optional[History] = None,
           prepared: Optional[PreparedHistory] = None,
           capacity: int = 1024, max_capacity: int = 65536,
           chunk: int = 512, max_window: int = 4096,
-          explain: bool = True) -> Dict[str, Any]:
+          explain: bool = True, cancel=None) -> Dict[str, Any]:
     """Decide linearizability on device.  Retries with larger configuration
     capacity on overflow; falls back to ``valid: "unknown"`` past
     ``max_capacity``.  On refutation, optionally re-derives a witness on the
@@ -272,7 +272,12 @@ def check(model: JaxModel, history: Optional[History] = None,
     peak — so the coarser adaptation is theoretical on these workloads;
     pass chunk=256 explicitly on directly-attached devices if adaptation
     matters more than polls.  Pure-throughput batch checking with no
-    mid-stream adaptation (check_batch) uses larger chunks."""
+    mid-stream adaptation (check_batch) uses larger chunks.
+
+    ``cancel`` is an optional :class:`threading.Event` polled at chunk
+    boundaries; when a competing solver already produced a definite verdict
+    the driver stops dispatching and returns ``valid: "unknown"`` with
+    ``cancelled: True`` (knossos.competition loser cancellation)."""
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
     window = _round_window(p.window)
@@ -295,6 +300,11 @@ def check(model: JaxModel, history: Optional[History] = None,
     # least one chunk), so the loop pops at least once and failed/overflow/
     # carry are always (re)assigned before use below.
     while True:
+        # Poll cancellation before refilling the pipeline, so a lost race
+        # doesn't dispatch up to LOOKAHEAD more chunks of discarded work.
+        if cancel is not None and cancel.is_set():
+            return {"valid": "unknown", "analyzer": "wgl-tpu",
+                    "cancelled": True}
         while len(inflight) < LOOKAHEAD and next_ci < n_chunks:
             prev = carry
             carry, flags = run_chunk(

@@ -210,6 +210,67 @@ class TestLinearizableFacade:
         assert r["valid"] is True
         assert r["solver"] in ("cpu", "tpu")
 
+    def test_competition_unknown_racer_does_not_mask_definite(self, monkeypatch):
+        # checker.clj:199-202: the first *definite* verdict wins.  A fast
+        # SearchExploded from the CPU oracle must not become the answer while
+        # the device engine is still about to refute the history.
+        import importlib
+        lin_mod = importlib.import_module("jepsen_tpu.checker.linearizable")
+        cpu_mod = importlib.import_module("jepsen_tpu.checker.wgl_cpu")
+
+        def exploding_cpu(model, history, cancel=None, **kw):
+            raise cpu_mod.SearchExploded(999)
+
+        monkeypatch.setattr(lin_mod.wgl_cpu, "check", exploding_cpu)
+        c = linearizable(get_model("cas-register"), algorithm="competition",
+                         capacity=64, chunk=16)
+        r = c.check(T, self.H_BAD)
+        assert r["valid"] is False
+        assert r["solver"] == "tpu"
+
+    def test_competition_both_unknown(self, monkeypatch):
+        import importlib
+        lin_mod = importlib.import_module("jepsen_tpu.checker.linearizable")
+        cpu_mod = importlib.import_module("jepsen_tpu.checker.wgl_cpu")
+
+        def exploding_cpu(model, history, cancel=None, **kw):
+            raise cpu_mod.SearchExploded(999)
+
+        def unknown_tpu(model, history, cancel=None, **kw):
+            return {"valid": UNKNOWN, "error": "capacity exceeded"}
+
+        monkeypatch.setattr(lin_mod.wgl_cpu, "check", exploding_cpu)
+        monkeypatch.setattr(lin_mod.wgl_tpu, "check", unknown_tpu)
+        c = linearizable(get_model("cas-register"), algorithm="competition")
+        r = c.check(T, self.H_GOOD)
+        assert r["valid"] == UNKNOWN
+        assert set(r["solvers"]) == {"cpu", "tpu"}
+
+    def test_competition_cancels_loser(self, monkeypatch):
+        # The losing solver's search must be told to stop (knossos cancels
+        # the losing future) rather than burning CPU to completion.
+        import importlib
+        import threading
+
+        lin_mod = importlib.import_module("jepsen_tpu.checker.linearizable")
+
+        seen = {}
+        finished = threading.Event()
+
+        def slow_cpu(model, history, cancel=None, **kw):
+            seen["cancel"] = cancel
+            cancel.wait(timeout=10)
+            finished.set()
+            raise lin_mod.wgl_cpu.Cancelled()
+
+        monkeypatch.setattr(lin_mod.wgl_cpu, "check", slow_cpu)
+        c = linearizable(get_model("cas-register"), algorithm="competition",
+                         capacity=64, chunk=16)
+        r = c.check(T, self.H_GOOD)
+        assert r["valid"] is True and r["solver"] == "tpu"
+        assert finished.wait(timeout=10)
+        assert seen["cancel"].is_set()
+
     def test_host_model_cannot_run_tpu(self):
         c = linearizable(CASRegister(), algorithm="tpu")
         assert c.check(T, self.H_GOOD)["valid"] == UNKNOWN
