@@ -284,6 +284,13 @@ class CounterChecker(Checker):
 
     def check(self, test, history, opts=None):
         pairs = history.pair_index()
+        # Adds whose completion is FAIL definitively did not apply: the
+        # reference removes them before computing bounds (checker.clj
+        # counter's remove-failed preprocessing), so they must never widen
+        # any read's envelope — not even a read concurrent with them.
+        failed_invokes = {int(pairs[i]) for i, op in enumerate(history)
+                          if op.f == "add" and op.type == FAIL
+                          and int(pairs[i]) >= 0}
         reads = []
         lo = hi = 0          # envelope of possibly-applied sums
         applied = 0          # surely applied (ok) sum
@@ -308,6 +315,8 @@ class CounterChecker(Checker):
             if op.f == "add":
                 d = op.value or 0
                 if op.type == INVOKE:
+                    if i in failed_invokes:
+                        continue  # never applied; widens nothing
                     open_adds[i] = d
                     if d > 0:
                         move_envelope(lo, hi + d)
@@ -322,12 +331,9 @@ class CounterChecker(Checker):
                     else:
                         move_envelope(lo, hi + d)
                 elif op.type in (FAIL,):
-                    j = int(pairs[i])
-                    d = open_adds.pop(j, d)
-                    if d > 0:
-                        move_envelope(lo, hi - d)
-                    else:
-                        move_envelope(lo - d, hi)
+                    # Envelope was never widened for this add (pre-scan);
+                    # nothing to narrow.
+                    open_adds.pop(int(pairs[i]), None)
                 # INFO: stays open forever (may or may not apply)
             elif op.f == "read" and op.type == OK:
                 v = op.value

@@ -129,10 +129,20 @@ class Linearizable(Checker):
                     done.set()
                 elif definite:
                     # A second definite verdict: surface disagreement (a
-                    # solver bug!) instead of silently discarding it.
+                    # solver bug!) instead of silently discarding it.  The
+                    # winner dict may already be returned to the caller, so
+                    # never mutate it here — attach if the race is still
+                    # open, log otherwise.
                     w = results["winner"]
                     if w.get("valid") != r.get("valid"):
-                        w["disagreement"] = {**r, "solver": solver}
+                        if results.get("returned"):
+                            import logging
+                            logging.getLogger(__name__).error(
+                                "solver disagreement after verdict: "
+                                "%s=%r vs %s=%r", w.get("solver"),
+                                w.get("valid"), solver, r.get("valid"))
+                        else:
+                            w["disagreement"] = {**r, "solver": solver}
                 else:
                     results["indefinite"][solver] = r
                     if len(results["indefinite"]) == 2:
@@ -166,10 +176,13 @@ class Linearizable(Checker):
         for t in ts:  # losers usually exit within one chunk/closure round
             t.join(timeout=0.2)
         with _stragglers_lock:
+            _stragglers[:] = [t for t in _stragglers if t.is_alive()]
             _stragglers.extend(t for t in ts if t.is_alive())
         with lock:
+            results["returned"] = True
             if "winner" in results:
-                return results["winner"]
+                # Snapshot: a straggler must not mutate the caller's dict.
+                return dict(results["winner"])
             # Both solvers indefinite: report the combined unknown.
             return {"valid": UNKNOWN, "solver": "competition",
                     "solvers": dict(results["indefinite"])}

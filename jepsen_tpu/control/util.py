@@ -96,15 +96,33 @@ def ensure_user(s: Session, username: str) -> None:
 
 
 def self_safe_pattern(pattern: str) -> str:
-    """Bracket the first alphanumeric char ("asd" -> "[a]sd") so the
-    pkill regex can't match the wrapper shell whose own cmdline contains
-    the pattern — otherwise `bash -c 'pkill -f asd'` SIGKILLs itself."""
-    if "[" in pattern:
-        return pattern
+    """Bracket the first alphanumeric char of every ``|``-branch
+    ("a|b" -> "[a]|[b]") so no branch of the pkill regex can match the
+    wrapper shell whose own cmdline contains the pattern — otherwise
+    `bash -c 'pkill -f asd'` SIGKILLs itself.  Branches already starting
+    with a character class are left alone."""
+
+    def safe_branch(b: str) -> str:
+        for i, c in enumerate(b):
+            if c == "[":
+                return b  # already bracketed
+            if c.isalnum():
+                return f"{b[:i]}[{c}]{b[i + 1:]}"
+        return b
+
+    # Split only on top-level "|": a "|" inside a character class (e.g.
+    # "[a|b]c") is a literal, and splitting there would corrupt the regex.
+    branches, depth, start = [], 0, 0
     for i, c in enumerate(pattern):
-        if c.isalnum():
-            return f"{pattern[:i]}[{c}]{pattern[i + 1:]}"
-    return pattern
+        if c == "[":
+            depth += 1
+        elif c == "]":
+            depth = max(0, depth - 1)
+        elif c == "|" and depth == 0:
+            branches.append(pattern[start:i])
+            start = i + 1
+    branches.append(pattern[start:])
+    return "|".join(safe_branch(b) for b in branches)
 
 
 def grepkill(s: Session, pattern: str, signal: str = "KILL") -> None:

@@ -80,16 +80,26 @@ class QueueClient(jclient.Client):
             if op.f == "dequeue":
                 return self._dequeue(op)
             if op.f == "drain":
+                # Messages are consumed with no_ack=True: once fetched they
+                # are gone from the queue, so an error mid-drain must NOT
+                # discard what was already collected (the queue checker would
+                # report false data loss).  The reference's drain! always
+                # completes :ok with the accumulated values
+                # (rabbitmq.clj:119-131, dequeue! converts errors inside).
                 out = []
                 while True:
-                    r = self._dequeue(op)
+                    try:
+                        r = self._dequeue(op)
+                    except (AmqpError, *NET_ERRORS) as e:
+                        self._reconnect(test)
+                        return op.with_(type=OK, value=out, error=str(e))
                     if r.type != OK:
                         return op.with_(type=OK, value=out)
                     out.append(r.value)
             raise ValueError(op.f)
         except (AmqpError, *NET_ERRORS) as e:
             self._reconnect(test)
-            if op.f in ("dequeue", "drain"):
+            if op.f == "dequeue":
                 return op.with_(type=FAIL, error=str(e))
             return op.with_(type=INFO, error=str(e))
 

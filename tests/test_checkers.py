@@ -164,6 +164,28 @@ class TestUniqueAndCounter:
         ])
         assert CounterChecker().check(T, h)["valid"] is True
 
+    def test_counter_failed_add_never_widens_concurrent_read(self):
+        # checker.clj counter removes definitively-failed adds before
+        # computing bounds: a read overlapping an add that FAILs must not
+        # keep the failed delta in its acceptable window.
+        h = History([
+            mk(0, INVOKE, "add", 5),
+            mk(1, INVOKE, "read"),
+            mk(0, FAIL, "add", 5),
+            mk(1, OK, "read", 5),
+        ])
+        r = CounterChecker().check(T, h)
+        assert r["valid"] is False
+        assert r["errors"][0]["bounds"] == [0, 0]
+        # control: same shape but the add succeeds -> read may see it
+        h2 = History([
+            mk(0, INVOKE, "add", 5),
+            mk(1, INVOKE, "read"),
+            mk(0, OK, "add", 5),
+            mk(1, OK, "read", 5),
+        ])
+        assert CounterChecker().check(T, h2)["valid"] is True
+
     def test_counter_concurrent_negative_add_both_ways(self):
         # missed negative add concurrent with the read
         h = History([

@@ -103,6 +103,21 @@ class TestUtil:
         assert cu.exists(sess, f) and cu.exists(sess, d)
         sess.exec("rm", "-rf", f, d)
 
+    def test_self_safe_pattern_brackets_every_branch(self):
+        # galera's grepkill(s, "mariadbd|mysqld"): every |-branch must be
+        # bracketed, or the unprotected branch still matches the wrapper
+        # shell's own cmdline and pkill SIGKILLs itself.
+        assert cu.self_safe_pattern("asd") == "[a]sd"
+        assert cu.self_safe_pattern("mariadbd|mysqld") == "[m]ariadbd|[m]ysqld"
+        # a branch already starting with a class is left alone; others
+        # are still protected
+        assert cu.self_safe_pattern("[a]bc|def") == "[a]bc|[d]ef"
+        assert cu.self_safe_pattern("--flag") == "--[f]lag"
+        assert cu.self_safe_pattern("||") == "||"
+        # "|" inside a character class is literal: not a branch boundary
+        assert cu.self_safe_pattern("[a|b]c") == "[a|b]c"
+        assert cu.self_safe_pattern("[a|b]c|def") == "[a|b]c|[d]ef"
+
     def test_daemon_lifecycle(self, sess, tmp_path):
         pidfile = str(tmp_path / "d.pid")
         logfile = str(tmp_path / "d.log")

@@ -46,6 +46,35 @@ class TestAmqpWire:
         run_wire_test(queue_workload({}), "rabbitmq-queue", amqp_port,
                       time_limit=2.0)
 
+    def test_drain_keeps_partial_values_on_error(self):
+        # Messages are auto-acked: once fetched they are gone from the
+        # queue, so an AMQP error mid-drain must return the values already
+        # collected (as OK), not FAIL — else the queue checker reports
+        # false data loss (rabbitmq.clj:119-131 drain! semantics).
+        from jepsen_tpu.clients.amqp import AmqpError
+        from jepsen_tpu.history import INVOKE
+        from jepsen_tpu.history import Op
+        from suites.rabbitmq.client import QueueClient
+
+        class FlakyConn:
+            def __init__(self):
+                self.msgs = [b"1", b"2"]
+
+            def get(self, q, no_ack=False):
+                if self.msgs:
+                    return (1, self.msgs.pop(0))
+                raise AmqpError("channel blown")
+
+            def close(self):
+                pass
+
+        c = QueueClient(FlakyConn(), "n1")
+        op = Op(process=0, type=INVOKE, f="drain")
+        r = c.invoke({"db_port": 1}, op)
+        assert r.type == "ok"
+        assert r.value == [1, 2]
+        assert "channel blown" in (r.error or "")
+
     def test_mutex_workload_valid(self, amqp_port):
         from suites.rabbitmq.client import SemaphoreClient
         from suites.rabbitmq.runner import mutex_workload
