@@ -68,6 +68,15 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     dedup synchronizes via all_gather.
     """
     assert window > 0
+    try:
+        # All three engine paths (single-chip, sharded, batched) build here;
+        # enabling the persistent compilation cache at this shared layer
+        # turns repeat compiles of any engine shape into disk loads.
+        # Best-effort: a read-only fs must not break checking.
+        from jepsen_tpu.ops.cache import enable_compilation_cache
+        enable_compilation_cache()
+    except Exception:  # noqa: BLE001
+        pass
     W, MW, S, C = window, (window + 31) // 32, model.state_size, capacity
     step = model.step
 

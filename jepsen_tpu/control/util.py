@@ -112,13 +112,15 @@ def self_safe_pattern(pattern: str) -> str:
 
     # Split only on top-level "|": a "|" inside a character class (e.g.
     # "[a|b]c") is a literal, and splitting there would corrupt the regex.
-    branches, depth, start = [], 0, 0
+    # Classes don't nest — a "[" inside a class is a literal — so track a
+    # boolean, not a depth counter.
+    branches, in_class, start = [], False, 0
     for i, c in enumerate(pattern):
-        if c == "[":
-            depth += 1
-        elif c == "]":
-            depth = max(0, depth - 1)
-        elif c == "|" and depth == 0:
+        if c == "[" and not in_class:
+            in_class = True
+        elif c == "]" and in_class:
+            in_class = False
+        elif c == "|" and not in_class:
             branches.append(pattern[start:i])
             start = i + 1
     branches.append(pattern[start:])

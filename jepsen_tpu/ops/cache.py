@@ -1,0 +1,50 @@
+"""Persistent XLA compilation cache.
+
+The device engine's first compile costs tens of seconds (≈100 s for the
+full capacity-escalation ladder on a tunneled TPU) while the 10k-op check
+itself runs in ~18 s — every fresh process paid 6x the work in compiles.
+JAX ships a persistent cache (serialized executables keyed by HLO +
+compile options + platform); enabling it makes the second process's
+"compile" a disk load.
+
+The reference has no counterpart (knossos is a JVM library, warmed by the
+JIT per-process); this is a TPU-native concern.  Cache lives under
+``store/cache/xla`` by default so it ships with the run archive workflow
+and is wiped by the same housekeeping that prunes old runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_enabled = False
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (default
+    ``$JEPSEN_TPU_CACHE or store/cache/xla``).  Idempotent; safe to call
+    before or after the first trace.  Returns the directory used."""
+    global _enabled
+    import jax
+
+    if jax.default_backend() == "cpu" and "JEPSEN_TPU_CACHE_CPU" not in os.environ:
+        # CPU AOT cache entries embed exact machine features and XLA warns
+        # they may SIGILL on a host whose feature set differs (virtual-mesh
+        # test runs move between machines); CPU compiles are cheap, so only
+        # accelerator executables are worth persisting.
+        return ""
+    d = (cache_dir
+         or os.environ.get("JEPSEN_TPU_CACHE")
+         or os.path.join("store", "cache", "xla"))
+    d = os.path.abspath(d)
+    if _enabled and jax.config.jax_compilation_cache_dir == d:
+        return d
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # Cache everything: engine shapes compile in 1-40 s each, and even
+    # sub-second helper kernels add up across the escalation ladder.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = True
+    return d

@@ -43,6 +43,40 @@ def check_batch(model: JaxModel,
     preps = [prepare(h, model) for h in histories]
     window = _round_window(max(p.window for p in preps))
     evs = [events_array(p, chunk) for p in preps]
+
+    # Per-lane capacity adaptivity: most lanes (short per-key histories)
+    # finish at the starting capacity; only the lanes that actually
+    # overflowed are regrouped into a smaller batch and re-run at an
+    # escalated capacity — one deep lane no longer makes every lane pay
+    # the O(C·W) closure cost of the rare worst case.
+    out: List[Optional[Dict[str, Any]]] = [None] * len(evs)
+    lanes = list(range(len(evs)))
+    cap = capacity
+    while lanes:
+        res = _run_lanes(model, [evs[i] for i in lanes],
+                         [preps[i] for i in lanes],
+                         window, cap, mesh, axis, chunk)
+        retry = []
+        for lane, r in zip(lanes, res):
+            if r is None:
+                retry.append(lane)
+            else:
+                out[lane] = r
+        if not retry or cap >= max_capacity:
+            for lane in retry:
+                out[lane] = {"valid": "unknown", "analyzer": "wgl-tpu-batch",
+                             "error": f"capacity exceeded at {cap}"}
+            break
+        lanes = retry
+        cap = min(cap * 8, max_capacity)
+    return out  # type: ignore[return-value]
+
+
+def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
+               mesh: Optional[Mesh], axis: str,
+               chunk: int) -> List[Optional[Dict[str, Any]]]:
+    """One vmapped pass over a set of lanes at a fixed capacity.  Returns a
+    result per lane, or None where the lane overflowed (caller escalates)."""
     emax = max(e.shape[0] for e in evs)
     b = len(evs)
     bpad = b
@@ -54,39 +88,31 @@ def check_batch(model: JaxModel,
     for i, e in enumerate(evs):
         batch[i, :e.shape[0]] = e
 
-    cap = capacity
-    while True:
-        carry0, vrun = _batched_runner_simple(model, window, cap)
-        c0 = carry0()
+    carry0, vrun = _batched_runner_simple(model, window, cap)
+    c0 = carry0()
+    carry = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (bpad,) + x.shape), c0)
+    if mesh is not None:
         carry = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (bpad,) + x.shape), c0)
-        if mesh is not None:
-            sh_b = NamedSharding(mesh, P(axis))
-            carry = jax.tree.map(
-                lambda x: jax.device_put(
-                    x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))),
-                carry)
-            batch_dev = jax.device_put(
-                jnp.asarray(batch), NamedSharding(mesh, P(axis, None, None)))
-        else:
-            batch_dev = jnp.asarray(batch)
-        n_chunks = emax // chunk
-        for ci in range(n_chunks):
-            carry, _ = vrun(carry, batch_dev[:, ci * chunk:(ci + 1) * chunk])
-        overflow = np.asarray(carry[8])[:b]
-        if overflow.any() and cap < max_capacity:
-            cap = min(cap * 8, max_capacity)
-            continue
-        break
+            lambda x: jax.device_put(
+                x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))),
+            carry)
+        batch_dev = jax.device_put(
+            jnp.asarray(batch), NamedSharding(mesh, P(axis, None, None)))
+    else:
+        batch_dev = jnp.asarray(batch)
+    n_chunks = emax // chunk
+    for ci in range(n_chunks):
+        carry, _ = vrun(carry, batch_dev[:, ci * chunk:(ci + 1) * chunk])
 
+    overflow = np.asarray(carry[8])[:b]
     failed = np.asarray(carry[6])[:b]
     failed_op = np.asarray(carry[7])[:b]
     explored = np.asarray(carry[9])[:b]
-    out = []
+    out: List[Optional[Dict[str, Any]]] = []
     for i in range(b):
         if overflow[i]:
-            out.append({"valid": "unknown", "analyzer": "wgl-tpu-batch",
-                        "error": f"capacity exceeded at {cap}"})
+            out.append(None)
         elif failed[i]:
             out.append({"valid": False, "analyzer": "wgl-tpu-batch",
                         "op": preps[i].ops[int(failed_op[i])].to_dict(),

@@ -8,10 +8,18 @@ configurations (vmapped model steps), candidates are exchanged with
 all_gather over ICI, every device deduplicates the global set identically
 (replicated sort), and keeps its deterministic slice.  Failure/overflow flags
 are psum-reduced so all shards agree.
+
+The host driver mirrors the single-chip lessons (wgl_tpu.check): LOOKAHEAD
+chunks stay in flight so the per-chunk flags transfer overlaps device
+compute (chunk-boundary polls dominate on tunneled/DCN-attached hosts), an
+overflow resumes from the pre-chunk snapshot at a peak-informed capacity
+instead of restarting the whole history, and the engine drops back to a
+cheaper per-round shape once a crash-burst's transient demand passes.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Dict, Optional
 
 import jax
@@ -21,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import events_array, make_engine
+from jepsen_tpu.checker.wgl_tpu import LOOKAHEAD, events_array, make_engine
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
@@ -55,6 +63,67 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
     return fn
 
 
+def _initial_carry(model, window, cap, n, mesh, axis):
+    MW, S = (window + 31) // 32, model.state_size
+    gcap = cap * n
+
+    def put(x, spec):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+    return (
+        put(np.zeros((gcap, MW), np.uint32), P(axis)),
+        put(np.tile(model.init_state_array()[None], (gcap, 1)), P(axis)),
+        put(np.arange(gcap) == 0, P(axis)),
+        put(np.zeros((window, 3), np.int32), P()),
+        put(np.zeros(window, bool), P()),
+        put(np.bool_(False), P()),
+        put(np.bool_(False), P()),
+        put(np.int32(-1), P()),
+        put(np.bool_(False), P()),
+        put(np.int32(0), P()),
+        put(np.int32(0), P()),
+        put(np.int32(1), P()),
+    )
+
+
+def _resize_carry_sharded(carry, n, old_cap, new_cap, mesh, axis):
+    """Re-lay a chunk-boundary carry for a different per-shard capacity.
+
+    Shard i's rows live at global slice [i*cap, (i+1)*cap): a plain global
+    pad/truncate would migrate rows across shards, so resize per-shard —
+    grow pads each shard's block with dead rows; shrink compacts the global
+    live set and deals it round-robin so shards stay balanced for the next
+    closure's all_gather.  Host-side: resizes are rare (one per escalation
+    step / burst decay), and the buffers are MBs."""
+    mask = np.asarray(carry[0]).reshape(n, old_cap, -1)
+    states = np.asarray(carry[1]).reshape(n, old_cap, -1)
+    valid = np.asarray(carry[2]).reshape(n, old_cap)
+
+    nm = np.zeros((n, new_cap, mask.shape[2]), mask.dtype)
+    ns = np.zeros((n, new_cap, states.shape[2]), states.dtype)
+    nv = np.zeros((n, new_cap), bool)
+    if new_cap >= old_cap:
+        nm[:, :old_cap] = mask
+        ns[:, :old_cap] = states
+        nv[:, :old_cap] = valid
+    else:
+        # round-robin deal: global live row j -> shard j % n, slot j // n
+        idx, sh = np.divmod(np.arange(n * new_cap), n)
+        live = np.flatnonzero(valid.reshape(-1))[:n * new_cap]
+        k = len(live)
+        fm, fs = mask.reshape(n * old_cap, -1), states.reshape(n * old_cap, -1)
+        nm[sh[:k], idx[:k]] = fm[live]
+        ns[sh[:k], idx[:k]] = fs[live]
+        nv[sh[:k], idx[:k]] = True
+
+    def put(x):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(axis)))
+
+    return (put(nm.reshape(n * new_cap, -1)),
+            put(ns.reshape(n * new_cap, -1)),
+            put(nv.reshape(n * new_cap))) + tuple(carry[3:])
+
+
 def check_sharded(model: JaxModel,
                   history: Optional[History] = None,
                   prepared: Optional[PreparedHistory] = None,
@@ -73,42 +142,77 @@ def check_sharded(model: JaxModel,
     ev = events_array(p, chunk)
     n_chunks = ev.shape[0] // chunk
     n = mesh.shape[axis]
-    MW, S = (window + 31) // 32, model.state_size
+
+    def put_repl(x):
+        return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P()))
 
     cap = capacity_per_shard
+    run = _sharded_runner(model, window, cap, mesh, axis)
+    carry = _initial_carry(model, window, cap, n, mesh, axis)
+    recent_peaks: deque = deque(maxlen=4)
+    inflight: deque = deque()  # (ci, carry_before, carry_after, flags)
+    next_ci = 0
+    failed = overflow = False
+    done = carry
+    # Pipelined dispatch (see wgl_tpu.check): speculation past a failure or
+    # overflow is safe because the failed/overflow lanes gate all updates in
+    # event_step — speculative chunks are simply discarded on resume.
+    # Pipelining pays where the device→host flags transfer has real latency
+    # (tunneled TPU, DCN-attached pod); on the host-platform CPU mesh the
+    # transfer is a memcpy and extra in-flight chunks only cost memory
+    # (measured ~20% slower), so keep the pipeline depth at 1 there.
+    lookahead = (LOOKAHEAD
+                 if mesh.devices.flat[0].platform != "cpu" else 1)
     while True:
-        run = _sharded_runner(model, window, cap, mesh, axis)
-        gcap = cap * n
-        shard_rows = NamedSharding(mesh, P(axis))
-
-        def put(x, spec):
-            return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
-
-        carry = (
-            put(np.zeros((gcap, MW), np.uint32), P(axis)),
-            put(np.tile(model.init_state_array()[None], (gcap, 1)), P(axis)),
-            put(np.arange(gcap) == 0, P(axis)),
-            put(np.zeros((window, 3), np.int32), P()),
-            put(np.zeros(window, bool), P()),
-            put(np.bool_(False), P()),
-            put(np.bool_(False), P()),
-            put(np.int32(-1), P()),
-            put(np.bool_(False), P()),
-            put(np.int32(0), P()),
-            put(np.int32(0), P()),
-            put(np.int32(1), P()),
-        )
-        failed = overflow = False
-        for ci in range(n_chunks):
-            carry, flags = run(carry, put(ev[ci * chunk:(ci + 1) * chunk], P()))
-            fl = np.asarray(flags)
-            failed, overflow = bool(fl[0]), bool(fl[1])
-            if failed or overflow:
-                break
+        while len(inflight) < lookahead and next_ci < n_chunks:
+            prev = carry
+            carry, flags = run(carry, put_repl(ev[next_ci * chunk:
+                                                  (next_ci + 1) * chunk]))
+            inflight.append((next_ci, prev, carry, flags))
+            next_ci += 1
+        if not inflight:
+            break
+        ci, prev, after, flags = inflight.popleft()
+        fl = np.asarray(flags)
+        failed, overflow = bool(fl[0]), bool(fl[1])
+        peak = int(fl[2])  # global (psum'd) distinct-config high-water mark
         if overflow and cap < max_capacity_per_shard:
-            cap = min(cap * 8, max_capacity_per_shard)
+            # Escalate straight to a capacity the observed global peak says
+            # is enough (peak may itself be clipped, so the loop can escalate
+            # again), and resume from the pre-chunk snapshot: no restart.
+            old = cap
+            while cap < max_capacity_per_shard and cap * n < 2 * peak:
+                cap = min(cap * 4, max_capacity_per_shard)
+            if cap == old:
+                cap = min(old * 4, max_capacity_per_shard)
+            recent_peaks.clear()
+            inflight.clear()
+            run = _sharded_runner(model, window, cap, mesh, axis)
+            carry = _resize_carry_sharded(prev, n, old, cap, mesh, axis)
+            next_ci = ci
+            overflow = False
             continue
-        break
+        done = after
+        if failed or overflow:
+            break
+        recent_peaks.append(peak)
+        if cap > capacity_per_shard and len(recent_peaks) == 4:
+            # Transient crash-burst demand has passed: drop back to a
+            # cheaper-per-round engine once 2x the recent global peak fits.
+            need = 2 * max(recent_peaks)
+            target = cap
+            while (target > capacity_per_shard
+                   and (target // 4) * n >= need):
+                target //= 4
+            if target < cap:
+                old = cap
+                cap = target
+                recent_peaks.clear()
+                inflight.clear()
+                run = _sharded_runner(model, window, cap, mesh, axis)
+                carry = _resize_carry_sharded(done, n, old, cap, mesh, axis)
+                next_ci = ci + 1
+    carry = done
 
     explored = int(carry[9])
     if overflow:

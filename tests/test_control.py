@@ -117,6 +117,8 @@ class TestUtil:
         # "|" inside a character class is literal: not a branch boundary
         assert cu.self_safe_pattern("[a|b]c") == "[a|b]c"
         assert cu.self_safe_pattern("[a|b]c|def") == "[a|b]c|[d]ef"
+        # "[" inside a class is a literal, not a nested class opener
+        assert cu.self_safe_pattern("[[]x|foo") == "[[]x|[f]oo"
 
     def test_daemon_lifecycle(self, sess, tmp_path):
         pidfile = str(tmp_path / "d.pid")

@@ -66,6 +66,99 @@ class TestSharded:
         assert r["valid"] is False
         assert r["op"]["index"] == cpu["op"]["index"]
 
+    def test_sharded_grow_resumes_from_snapshot(self, model):
+        # Start far below the history's real capacity need: the driver must
+        # escalate (resuming from the chunk-boundary snapshot, not
+        # restarting) and still reach the oracle's verdict.
+        mesh = make_mesh((1, 4))
+        h = cas_register_history(200, concurrency=6, crash_p=0.04, seed=13)
+        r = check_sharded(model, h, mesh=mesh, capacity_per_shard=4,
+                          chunk=64)
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] == cpu["valid"]
+        assert r["capacity"] > 4 * 4  # escalated beyond the initial global
+
+    def test_sharded_grow_refutes_like_oracle(self, model):
+        mesh = make_mesh((1, 2))
+        h = corrupt_reads(
+            cas_register_history(200, concurrency=6, crash_p=0.04, seed=21),
+            n=1, seed=5)
+        r = check_sharded(model, h, mesh=mesh, capacity_per_shard=4,
+                          chunk=64)
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is False and cpu["valid"] is False
+        assert r["op"]["index"] == cpu["op"]["index"]
+
+    def test_resize_carry_preserves_live_set(self, model):
+        # Grow then shrink must preserve exactly the live configurations,
+        # laid out so shard i's rows stay in shard i's slice (grow) or are
+        # dealt round-robin (shrink) — a plain global pad would migrate
+        # rows across shards.
+        import numpy as np
+        from jepsen_tpu.parallel.sharded import _resize_carry_sharded
+        n, cap = 2, 4
+        mesh = make_mesh((1, n))
+        rng = np.random.default_rng(0)
+        mask = rng.integers(0, 2**8, (n * cap, 1)).astype(np.uint32)
+        states = rng.integers(0, 5, (n * cap, 1)).astype(np.int32)
+        valid = np.array([1, 0, 1, 0, 0, 1, 0, 0], bool)
+        carry = (jax.numpy.asarray(mask), jax.numpy.asarray(states),
+                 jax.numpy.asarray(valid), "w", "a", "d", "f", "fo",
+                 "o", "e", "r", "p")
+        live = {(int(m), int(s)) for m, s, v in
+                zip(mask[:, 0], states[:, 0], valid) if v}
+
+        def live_set(c):
+            m = np.asarray(c[0]); s = np.asarray(c[1]); v = np.asarray(c[2])
+            return {(int(m[i, 0]), int(s[i, 0]))
+                    for i in range(len(v)) if v[i]}
+
+        grown = _resize_carry_sharded(carry, n, cap, 8, mesh, "model")
+        assert live_set(grown) == live
+        assert grown[3:] == carry[3:]
+        # grow keeps shard-local rows in the shard's slice
+        gm = np.asarray(grown[0]).reshape(n, 8, 1)
+        gv = np.asarray(grown[2]).reshape(n, 8)
+        for sh in range(n):
+            old_rows = {int(m) for m, v in
+                        zip(mask.reshape(n, cap, 1)[sh, :, 0],
+                            valid.reshape(n, cap)[sh]) if v}
+            new_rows = {int(gm[sh, i, 0]) for i in range(8) if gv[sh, i]}
+            assert new_rows == old_rows
+        shrunk = _resize_carry_sharded(grown, n, 8, 2, mesh, "model")
+        assert live_set(shrunk) == live  # 3 live rows fit in 2x2=4
+        # asymmetric: new_cap != n (regression: swapped divmod indexed
+        # shard by row number and crashed whenever new_cap > n)
+        shrunk3 = _resize_carry_sharded(grown, n, 8, 3, mesh, "model")
+        assert live_set(shrunk3) == live
+        # round-robin deal balances shards: 3 live rows over 2 shards
+        v3 = np.asarray(shrunk3[2]).reshape(n, 3)
+        assert sorted(v3.sum(axis=1).tolist()) == [1, 2]
+
+    def test_batch_escalates_only_overflowing_lanes(self, model, monkeypatch):
+        # One crash-heavy lane overflows the starting capacity; the retry
+        # pass must contain only that lane, not the whole batch.
+        import jepsen_tpu.parallel.batch as batch_mod
+        calls = []
+        orig = batch_mod._run_lanes
+
+        def spy(model, evs, preps, window, cap, mesh, axis, chunk):
+            calls.append((len(evs), cap))
+            return orig(model, evs, preps, window, cap, mesh, axis, chunk)
+
+        monkeypatch.setattr(batch_mod, "_run_lanes", spy)
+        easy = [cas_register_history(60, concurrency=3, crash_p=0.0, seed=s)
+                for s in range(3)]
+        hard = cas_register_history(200, concurrency=6, crash_p=0.05, seed=3)
+        rs = check_batch(model, easy + [hard], capacity=32, chunk=64)
+        expect = [wgl_cpu.check(CASRegister(), h)["valid"]
+                  for h in easy + [hard]]
+        assert [r["valid"] for r in rs] == expect
+        assert calls[0] == (4, 32)
+        assert len(calls) >= 2
+        for n_lanes, cap in calls[1:]:
+            assert n_lanes < 4 and cap > 32
+
     def test_sharded_agrees_with_single_device(self, model):
         mesh = make_mesh((2, 4))
         h = cas_register_history(150, concurrency=6, crash_p=0.02, seed=11)
