@@ -294,6 +294,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     n_chunks = ev.shape[0] // chunk
 
     cap = capacity
+    max_cap_reached = cap  # diagnostics: how far escalation actually went
     carry0, run_chunk = _get_run_chunk(model, window, cap)
     carry = carry0()
     recent_peaks: deque = deque(maxlen=4)  # per-chunk high-water marks
@@ -333,6 +334,7 @@ def check(model: JaxModel, history: Optional[History] = None,
             # the snapshot: no restart, no re-search of the prefix.
             while cap < max_capacity and cap < 2 * peak:
                 cap = min(cap * 4, max_capacity)
+            max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
             _, run_chunk = _get_run_chunk(model, window, cap)
@@ -354,6 +356,9 @@ def check(model: JaxModel, history: Optional[History] = None,
             target = cap
             while target > capacity and target // 4 >= need:
                 target //= 4
+            # an escalation clamped to max_capacity can sit off the
+            # power-of-4 lattice; never shrink below the configured floor
+            target = max(target, capacity)
             if target < cap:
                 cap = target
                 recent_peaks.clear()
@@ -372,12 +377,14 @@ def check(model: JaxModel, history: Optional[History] = None,
         return {"valid": True, "analyzer": "wgl-tpu",
                 "configs-explored": explored,
                 "closure-rounds": int(carry[10]),
-                "window": p.window, "capacity": cap}
+                "window": p.window, "capacity": cap,
+                "max-capacity-reached": max_cap_reached}
     failed_op = p.ops[int(carry[7])]
     res: Dict[str, Any] = {"valid": False, "analyzer": "wgl-tpu",
                            "op": failed_op.to_dict(),
                            "configs-explored": explored,
-                           "window": p.window, "capacity": cap}
+                           "window": p.window, "capacity": cap,
+                           "max-capacity-reached": max_cap_reached}
     if explain and history is not None and model.cpu_model is not None:
         res["witness"] = _cpu_witness(model, history, failed_op)
     return res

@@ -64,7 +64,7 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
 
 
 def _initial_carry(model, window, cap, n, mesh, axis):
-    MW, S = (window + 31) // 32, model.state_size
+    MW = (window + 31) // 32
     gcap = cap * n
 
     def put(x, spec):
@@ -204,6 +204,9 @@ def check_sharded(model: JaxModel,
             while (target > capacity_per_shard
                    and (target // 4) * n >= need):
                 target //= 4
+            # an escalation clamped to max_capacity can sit off the
+            # power-of-4 lattice; never shrink below the configured floor
+            target = max(target, capacity_per_shard)
             if target < cap:
                 old = cap
                 cap = target

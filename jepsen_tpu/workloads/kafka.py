@@ -46,6 +46,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker, UNKNOWN
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.elle.list_append import classify_cycle
 from jepsen_tpu.history import FAIL, History, OK
 
 
@@ -211,13 +213,138 @@ class KafkaChecker(Checker):
                   if o not in observed[k]
                   and not (observed[k] and max(observed[k]) > o)]
 
+        # Pass 3: transaction dependency graph over the log (the reference's
+        # elle-style cycle pass, kafka.clj:110-2049) — catches cycles the
+        # per-mop offset/order analyses above cannot (e.g. two txns each
+        # polling the other's send: G1c on the log).
+        cycles = _graph_pass(history)
+        for c in cycles:
+            anomalies[c["type"]].append(c)
+
         hard = {k: v for k, v in anomalies.items()}
+        # Graded unseen accounting (kafka.clj's unseen: per-partition counts,
+        # informational unless nothing was ever polled at all).
+        per_part: Dict[Any, Dict[str, int]] = {}
+        for (k, o), v in sends_ok.items():
+            d = per_part.setdefault(k, {"acked": 0, "observed": 0,
+                                        "unseen": 0})
+            d["acked"] += 1
+            if o in observed[k]:
+                d["observed"] += 1
+            else:
+                d["unseen"] += 1
         return {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
                           else not hard),
                 "anomaly-types": sorted(hard),
                 "anomalies": {k: v[:8] for k, v in hard.items()},
                 "sends": len(sends_ok), "polls": n_polls,
-                "unseen-count": len(unseen), "unseen": unseen[:8]}
+                "unseen-count": len(unseen), "unseen": unseen[:8],
+                "unseen-by-partition": {
+                    k: d for k, d in sorted(per_part.items())
+                    if d["unseen"]}}
+
+
+def _graph_pass(history: History) -> List[Dict[str, Any]]:
+    """Elle-style dependency cycles over the log (kafka.clj:110-2049).
+
+    Edges between OK transactions:
+      ww      — writer of a partition's offset -> writer of the next known
+                offset of that partition (the log's version order is the
+                offset order, so this is exact);
+      wr      — writer of (k, offset) -> each txn that polled that record
+                (self-reads of a txn's own sends are precommitted reads,
+                legitimate, and excluded with all self-edges);
+      process — consecutive OK txns of one process.
+
+    Cycles over {ww, wr} are typed with elle's classifier (G0 ww-only,
+    G1c otherwise — no rw edges exist on a log, polls read explicit
+    offsets).  Cycles that additionally need process edges are typed
+    ``process-<base>`` (kafka.clj's process-order anomaly family)."""
+    # Same shape predicate as the offset analyses (passes 1-2): any OK op
+    # whose value contains send/poll mops is a transaction — histories
+    # loaded from external logs may not tag f="txn".  Control ops (assign/
+    # subscribe: value is a partition list) contain no mops and drop out.
+    oks: List[Tuple[int, Any]] = []
+    for i, op in enumerate(history):
+        if op.type == OK and isinstance(op.value, (list, tuple)) \
+                and any(isinstance(m, (list, tuple)) and m
+                        and m[0] in ("send", "poll") for m in op.value):
+            oks.append((i, op))
+    writer_of: Dict[Tuple[Any, int], int] = {}  # (k, offset) -> tid
+    for tid, (_, op) in enumerate(oks):
+        for mop in op.value:
+            if isinstance(mop, (list, tuple)) and mop and mop[0] == "send":
+                k, ov = mop[1], mop[2]
+                if isinstance(ov, (list, tuple)) and len(ov) == 2:
+                    writer_of[(k, ov[0])] = tid
+
+    g = Graph()
+    for tid in range(len(oks)):
+        g.add_node(tid)
+    # ww: offset order of each partition, over offsets with known writers
+    by_part: Dict[Any, List[int]] = defaultdict(list)
+    for (k, o) in writer_of:
+        by_part[k].append(o)
+    for k, offs in by_part.items():
+        offs.sort()
+        for o1, o2 in zip(offs, offs[1:]):
+            a, b = writer_of[(k, o1)], writer_of[(k, o2)]
+            if a != b:
+                g.add_edge(a, b, "ww")
+    # wr: sender -> poller of the same record
+    for tid, (_, op) in enumerate(oks):
+        for mop in op.value:
+            if isinstance(mop, (list, tuple)) and mop and mop[0] == "poll" \
+                    and isinstance(mop[1], dict):
+                for k, recs in mop[1].items():
+                    for o, _v in recs:
+                        w = writer_of.get((k, o))
+                        if w is not None and w != tid:
+                            g.add_edge(w, tid, "wr")
+    # process order
+    last_of_process: Dict[Any, int] = {}
+    for tid, (_, op) in enumerate(oks):
+        prev = last_of_process.get(op.process)
+        if prev is not None:
+            g.add_edge(prev, tid, "process")
+        last_of_process[op.process] = tid
+
+    out: List[Dict[str, Any]] = []
+    seen_cycles = set()
+
+    def scan(graph: Graph):
+        for comp in sccs(graph):
+            if len(comp) < 2:
+                continue
+            cyc = find_cycle(graph, comp)
+            if not cyc:
+                continue
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue  # same txn set already reported from the ww+wr scan
+            seen_cycles.add(key)
+            kinds = cycle_edge_kinds(graph, cyc)
+            base_kinds = [ks - {"process"} for ks in kinds]
+            if all(bk for bk in base_kinds):
+                typ = classify_cycle(base_kinds)
+            else:
+                # at least one step exists only by process order; process
+                # edges type like ww for severity (write-order family)
+                typ = "process-" + classify_cycle(
+                    [bk or {"ww"} for bk in base_kinds])
+            out.append({
+                "type": typ,
+                "cycle": [_txn_brief(oks[t][1]) for t in cyc],
+                "edges": [sorted(ks) for ks in kinds],
+            })
+
+    scan(g.filter_kinds({"ww", "wr"}))  # pure log cycles first (G0/G1c)
+    scan(g)                             # then cycles needing process order
+    return out
+
+
+def _txn_brief(op) -> Dict[str, Any]:
+    return {"process": op.process, "index": op.index, "value": op.value}
 
 
 def workload(partitions: int = 4) -> Dict[str, Any]:

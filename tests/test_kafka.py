@@ -142,6 +142,62 @@ class TestKafkaTxnSends:
         assert r["valid"] is True, r
 
 
+class TestKafkaGraphCycles:
+    """Elle-style txn dependency cycles over the log (kafka.clj:110-2049) —
+    anomalies the per-mop offset/order analyses cannot see."""
+
+    def test_g1c_mutual_reads(self):
+        # T1 polls T2's send and T2 polls T1's send: a wr-wr cycle (G1c on
+        # the log).  Every per-mop analysis passes — only the graph pass
+        # catches it.
+        h = (ok(0, [["send", 0, [0, 1]], ["poll", {1: [[0, 2]]}]]) +
+             ok(1, [["send", 1, [0, 2]], ["poll", {0: [[0, 1]]}]]))
+        r = check(h)
+        assert "G1c" in r["anomaly-types"], r
+        assert r["valid"] is False
+
+    def test_g0_write_order_cycle(self):
+        # T1 wrote before T2 on partition 0, T2 before T1 on partition 1:
+        # ww-ww cycle (G0).
+        h = (ok(0, [["send", 0, [0, 1]], ["send", 1, [1, 2]]]) +
+             ok(1, [["send", 1, [0, 3]], ["send", 0, [1, 4]]]))
+        r = check(h)
+        assert "G0" in r["anomaly-types"], r
+
+    def test_process_cycle(self):
+        # p1's first txn polls a record that (transitively, via wr) depends
+        # on p1's *second* txn: consistency requires its own future.
+        h = (ok(1, [["poll", {1: [[0, 20]]}]]) +
+             ok(1, [["send", 0, [0, 10]]]) +
+             ok(2, [["send", 1, [0, 20]], ["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert any(t.startswith("process-") for t in r["anomaly-types"]), r
+
+    def test_no_cycle_on_clean_pipeline(self):
+        # plain producer->consumer flow plus same-process resends: acyclic
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(1, [["poll", {0: [[0, 10], [1, 11]]}]]) +
+             ok(1, [["poll", {0: []}]]))
+        r = check(h)
+        assert r["valid"] is True, r
+
+    def test_precommitted_self_read_is_legal(self):
+        # a txn polling its own send is a precommitted read, not a cycle
+        h = ok(0, [["send", 0, [0, 10]], ["poll", {0: [[0, 10]]}]])
+        r = check(h)
+        assert r["anomaly-types"] == [], r
+
+    def test_unseen_graded_by_partition(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 1, [0, 20]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert r["valid"] is True
+        assert r["unseen-by-partition"] == {
+            1: {"acked": 1, "observed": 0, "unseen": 1}}
+
+
 class TestKafkaSkipEvidence:
     def test_skip_evidenced_only_by_later_poll(self):
         # offset 1's send was never acked, but a later poll proves it
