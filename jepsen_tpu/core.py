@@ -144,8 +144,31 @@ def analyze(test, history: History) -> Dict[str, Any]:
     checker: Optional[Checker] = test.get("checker")
     if checker is None:
         return {"valid": True, "note": "no checker configured"}
-    return check_safe(checker, test, history,
-                      {"store_dir": test.get("store_dir")})
+    results = check_safe(checker, test, history,
+                         {"store_dir": test.get("store_dir")})
+    if results.get("valid") is False:
+        _failure_artifacts(test, history)
+    return results
+
+
+def _failure_artifacts(test, history: History) -> None:
+    """A failing run always gets human-inspectable artifacts — timeline and
+    perf plots — even when the test composed no Timeline/Perf checker
+    (checker.clj:207-211 renders on invalid analyses).  Best-effort; never
+    masks the verdict."""
+    d = test.get("store_dir")
+    if not d:
+        return
+    import os as _os
+    try:
+        if not _os.path.exists(_os.path.join(d, "timeline.html")):
+            from jepsen_tpu.checker.timeline import Timeline
+            Timeline().check(test, history, {"store_dir": d})
+        if not _os.path.exists(_os.path.join(d, "latency-raw.png")):
+            from jepsen_tpu.checker.perf import Perf
+            Perf().check(test, history, {"store_dir": d})
+    except Exception:  # noqa: BLE001
+        logger.exception("failure-artifact rendering")
 
 
 def _snarf_logs(test) -> None:

@@ -177,4 +177,7 @@ def check(history: History, consistency_models: Sequence[str] = ("serializable",
     return {"valid": valid,
             "anomaly-types": sorted(anomalies),
             "anomalies": {k: v[:8] for k, v in anomalies.items()},
+            # complete map for artifact rendering; popped by
+            # elle.render.write_artifacts so results stay small
+            "anomalies-full": dict(anomalies),
             "count": len(oks)}

@@ -1,0 +1,162 @@
+"""Anomaly-graph artifacts: an ``elle/`` directory humans can inspect.
+
+Parity: the reference's cycle checkers write an ``elle/`` directory of
+anomaly files and graphviz cycle plots into the store dir
+(jepsen/src/jepsen/tests/cycle.clj:9-16, cycle/append.clj:15-21 — elle's
+``:directory`` option).  Here each cycle anomaly gets:
+
+- ``<type>.txt``     — cycles listed step by step with their edge kinds
+                       (elle's explained-cycle text format);
+- ``<type>-<i>.svg`` — a self-contained circular-layout digraph (no
+                       graphviz dependency; same spirit as checker/render);
+- ``anomalies.json`` — the complete untruncated anomaly map.
+
+Rendering is best-effort and must never mask a verdict (the callers wrap
+it like Linearizable._render does for linear.svg).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+# anomaly entries carrying these keys are dependency cycles
+_CYCLE_KEYS = ("cycle", "edges")
+
+
+def write_artifacts(test, res: Dict[str, Any], opts) -> None:
+    """On an invalid analysis, write the ``elle/`` anomaly-graph directory
+    into the store dir (tests/cycle.clj:9-16 elle :directory parity).
+
+    The artifacts are written from ``res["anomalies-full"]`` when present —
+    the whole point of the directory is to preserve what the in-memory
+    result truncates — and that key is popped afterwards so results.json
+    stays small.  Best-effort: artifact trouble must never mask the
+    verdict."""
+    full = res.pop("anomalies-full", None)
+    if res.get("valid") is True or not (full or res.get("anomalies")):
+        return
+    d = (opts or {}).get("store_dir") or (test or {}).get("store_dir")
+    if not d:
+        return
+    try:
+        path = write_anomaly_dir(
+            d, {**res, "anomalies": full or res.get("anomalies")})
+        if path:
+            res["anomaly-dir"] = path
+    except Exception as e:  # noqa: BLE001
+        res["anomaly-dir-error"] = str(e)
+
+
+def write_anomaly_dir(store_dir: str, analysis: Dict[str, Any],
+                      subdir: str = "elle") -> Optional[str]:
+    """Write the ``elle/`` artifact directory for a checker analysis.
+    Returns the directory path, or None when there is nothing to write."""
+    anomalies = analysis.get("anomalies") or {}
+    if not anomalies:
+        return None
+    d = os.path.join(store_dir, subdir)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "anomalies.json"), "w") as f:
+        json.dump(anomalies, f, indent=2, default=repr)
+    for typ, entries in anomalies.items():
+        cycles = [e for e in entries if isinstance(e, dict)
+                  and all(k in e for k in _CYCLE_KEYS)]
+        if not cycles:
+            continue
+        with open(os.path.join(d, f"{typ}.txt"), "w") as f:
+            f.write(f"{len(cycles)} {typ} cycle(s)\n\n")
+            for i, c in enumerate(cycles):
+                f.write(f"--- cycle {i} ---\n")
+                f.write(_explain_cycle(c))
+                f.write("\n")
+        for i, c in enumerate(cycles):
+            svg = cycle_svg(c, title=f"{typ} #{i}")
+            with open(os.path.join(d, f"{typ}-{i}.svg"), "w") as f:
+                f.write(svg)
+    return d
+
+
+def _node_label(n: Any, limit: int = 48) -> str:
+    if isinstance(n, dict):  # _txn_brief-shaped
+        core = n.get("value", n)
+        s = f"p{n.get('process', '?')} {core}"
+    else:
+        s = str(n)
+    return s if len(s) <= limit else s[:limit - 1] + "…"
+
+
+def _explain_cycle(c: Dict[str, Any]) -> str:
+    """elle-style step listing: T1 -[ww]-> T2 -[wr]-> ... -> T1."""
+    nodes: List[Any] = list(c["cycle"])
+    edges: List[Any] = list(c["edges"])
+    out = []
+    for i, e in enumerate(edges):
+        a = _node_label(nodes[i])
+        b = _node_label(nodes[(i + 1) % len(nodes)])
+        kinds = ",".join(e) if isinstance(e, (list, tuple, set)) else str(e)
+        out.append(f"  {a}\n    -[{kinds}]->\n  {b}\n")
+    return "".join(out)
+
+
+def cycle_svg(c: Dict[str, Any], title: str = "cycle",
+              size: int = 480) -> str:
+    """Self-contained SVG of one dependency cycle, nodes on a circle."""
+    nodes: List[Any] = list(c["cycle"])
+    # drop a duplicated closing node ([T0, T1, T0] -> [T0, T1])
+    if len(nodes) > 1 and nodes[0] == nodes[-1]:
+        nodes = nodes[:-1]
+    edges: List[Any] = list(c["edges"])
+    n = max(1, len(nodes))
+    cx = cy = size / 2
+    r = size / 2 - 70
+    pos = []
+    for i in range(n):
+        a = 2 * math.pi * i / n - math.pi / 2
+        pos.append((cx + r * math.cos(a), cy + r * math.sin(a)))
+
+    def esc(s: str) -> str:
+        return (s.replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;").replace('"', "&quot;"))
+
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{size}" '
+        f'height="{size}" font-family="monospace" font-size="11">',
+        '<defs><marker id="arr" viewBox="0 0 10 10" refX="9" refY="5" '
+        'markerWidth="7" markerHeight="7" orient="auto-start-reverse">'
+        '<path d="M 0 0 L 10 5 L 0 10 z" fill="#c0392b"/></marker></defs>',
+        f'<text x="{cx}" y="18" text-anchor="middle" font-size="14" '
+        f'fill="#333">{esc(title)}</text>',
+    ]
+    box_w, box_h = 120, 28
+    for i in range(n):
+        x1, y1 = pos[i]
+        x2, y2 = pos[(i + 1) % n]
+        # retract ends to the node boxes
+        dx, dy = x2 - x1, y2 - y1
+        L = math.hypot(dx, dy) or 1.0
+        pad = box_h * 1.2
+        sx, sy = x1 + dx / L * pad, y1 + dy / L * pad
+        ex, ey = x2 - dx / L * pad, y2 - dy / L * pad
+        kinds = edges[i] if i < len(edges) else []
+        kl = ",".join(kinds) if isinstance(kinds, (list, tuple, set)) \
+            else str(kinds)
+        parts.append(
+            f'<line x1="{sx:.1f}" y1="{sy:.1f}" x2="{ex:.1f}" y2="{ey:.1f}" '
+            'stroke="#c0392b" stroke-width="1.5" marker-end="url(#arr)"/>')
+        mx, my = (sx + ex) / 2, (sy + ey) / 2
+        parts.append(f'<text x="{mx:.1f}" y="{my - 4:.1f}" '
+                     f'text-anchor="middle" fill="#c0392b">{esc(kl)}</text>')
+    for i, (x, y) in enumerate(pos):
+        label = _node_label(nodes[i], limit=20)
+        parts.append(
+            f'<rect x="{x - box_w / 2:.1f}" y="{y - box_h / 2:.1f}" '
+            f'width="{box_w}" height="{box_h}" rx="6" fill="#ecf0f1" '
+            'stroke="#7f8c8d"/>')
+        parts.append(f'<text x="{x:.1f}" y="{y + 4:.1f}" '
+                     f'text-anchor="middle" fill="#2c3e50">'
+                     f'{esc(label)}</text>')
+    parts.append("</svg>")
+    return "\n".join(parts)

@@ -174,6 +174,9 @@ def check(history: History, realtime: bool = False,
     return {"valid": not anomalies,
             "anomaly-types": sorted(anomalies),
             "anomalies": {k: v[:8] for k, v in anomalies.items()},
+            # complete map for artifact rendering; popped by
+            # elle.render.write_artifacts so results stay small
+            "anomalies-full": dict(anomalies),
             "count": len(oks)}
 
 

@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker
 from jepsen_tpu.elle import list_append, rw_register
+from jepsen_tpu.elle.render import write_artifacts
 from jepsen_tpu.history import History
 
 
@@ -58,7 +59,9 @@ class AppendChecker(Checker):
         self.realtime = realtime
 
     def check(self, test, history: History, opts=None):
-        return list_append.check(history, realtime=self.realtime)
+        res = list_append.check(history, realtime=self.realtime)
+        write_artifacts(test, res, opts)
+        return res
 
 
 class WrChecker(Checker):
@@ -70,9 +73,11 @@ class WrChecker(Checker):
         self.linearizable_keys = linearizable_keys
 
     def check(self, test, history: History, opts=None):
-        return rw_register.check(history, realtime=self.realtime,
-                                 sequential_keys=self.sequential_keys,
-                                 linearizable_keys=self.linearizable_keys)
+        res = rw_register.check(history, realtime=self.realtime,
+                                sequential_keys=self.sequential_keys,
+                                linearizable_keys=self.linearizable_keys)
+        write_artifacts(test, res, opts)
+        return res
 
 
 def append_workload(keys: int = 8, **kw) -> Dict[str, Any]:

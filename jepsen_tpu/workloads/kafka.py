@@ -233,15 +233,19 @@ class KafkaChecker(Checker):
                 d["observed"] += 1
             else:
                 d["unseen"] += 1
-        return {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
-                          else not hard),
-                "anomaly-types": sorted(hard),
-                "anomalies": {k: v[:8] for k, v in hard.items()},
-                "sends": len(sends_ok), "polls": n_polls,
-                "unseen-count": len(unseen), "unseen": unseen[:8],
-                "unseen-by-partition": {
-                    k: d for k, d in sorted(per_part.items())
-                    if d["unseen"]}}
+        res = {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
+                         else not hard),
+               "anomaly-types": sorted(hard),
+               "anomalies": {k: v[:8] for k, v in hard.items()},
+               "anomalies-full": hard,
+               "sends": len(sends_ok), "polls": n_polls,
+               "unseen-count": len(unseen), "unseen": unseen[:8],
+               "unseen-by-partition": {
+                   k: d for k, d in sorted(per_part.items())
+                   if d["unseen"]}}
+        from jepsen_tpu.elle.render import write_artifacts
+        write_artifacts(test, res, opts)
+        return res
 
 
 def _graph_pass(history: History) -> List[Dict[str, Any]]:
@@ -312,31 +316,53 @@ def _graph_pass(history: History) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     seen_cycles = set()
 
+    def subgraph(graph: Graph, nodes) -> Graph:
+        sg = Graph()
+        for a in nodes:
+            sg.add_node(a)
+            for b in graph.succs(a):
+                if b in nodes:
+                    for kind in graph.edge_kinds(a, b):
+                        sg.add_edge(a, b, kind)
+        return sg
+
     def scan(graph: Graph):
+        # find_cycle yields one (shortest) cycle per SCC; an SCC can merge
+        # several distinct cycles (e.g. a ww/wr 2-cycle bridged to a
+        # process-order cycle), so after reporting a cycle, peel its nodes
+        # off and re-search the remainder — node-disjoint cycles in one
+        # component are all reported.
         for comp in sccs(graph):
-            if len(comp) < 2:
-                continue
-            cyc = find_cycle(graph, comp)
-            if not cyc:
-                continue
-            key = frozenset(cyc)
-            if key in seen_cycles:
-                continue  # same txn set already reported from the ww+wr scan
-            seen_cycles.add(key)
-            kinds = cycle_edge_kinds(graph, cyc)
-            base_kinds = [ks - {"process"} for ks in kinds]
-            if all(bk for bk in base_kinds):
-                typ = classify_cycle(base_kinds)
-            else:
-                # at least one step exists only by process order; process
-                # edges type like ww for severity (write-order family)
-                typ = "process-" + classify_cycle(
-                    [bk or {"ww"} for bk in base_kinds])
-            out.append({
-                "type": typ,
-                "cycle": [_txn_brief(oks[t][1]) for t in cyc],
-                "edges": [sorted(ks) for ks in kinds],
-            })
+            remaining = set(comp)
+            while len(remaining) >= 2:
+                sub = subgraph(graph, remaining)
+                cyc = None
+                for c in sccs(sub):
+                    if len(c) >= 2:
+                        cyc = find_cycle(sub, c)
+                        if cyc:
+                            break
+                if not cyc:
+                    break
+                remaining -= set(cyc)
+                key = frozenset(cyc)
+                if key in seen_cycles:
+                    continue  # already reported from the ww+wr scan
+                seen_cycles.add(key)
+                kinds = cycle_edge_kinds(graph, cyc)
+                base_kinds = [ks - {"process"} for ks in kinds]
+                if all(bk for bk in base_kinds):
+                    typ = classify_cycle(base_kinds)
+                else:
+                    # at least one step exists only by process order;
+                    # process edges type like ww for severity
+                    typ = "process-" + classify_cycle(
+                        [bk or {"ww"} for bk in base_kinds])
+                out.append({
+                    "type": typ,
+                    "cycle": [_txn_brief(oks[t][1]) for t in cyc],
+                    "edges": [sorted(ks) for ks in kinds],
+                })
 
     scan(g.filter_kinds({"ww", "wr"}))  # pure log cycles first (G0/G1c)
     scan(g)                             # then cycles needing process order

@@ -1,0 +1,82 @@
+"""Human-inspectable analysis artifacts on failing runs.
+
+Parity: the reference writes an elle anomaly directory into the store dir
+(tests/cycle.clj:9-16) and renders linear.svg on invalid linearizability
+analyses (checker.clj:207-211); failing runs must leave timeline/perf
+artifacts even when the test composed no Timeline/Perf checker.
+"""
+
+import os
+
+import pytest
+
+from jepsen_tpu.history import History, INVOKE, OK, Op
+from jepsen_tpu.workloads.cycle import AppendChecker
+from jepsen_tpu.workloads.kafka import KafkaChecker
+
+
+def ok(p, f, mops):
+    return [Op(process=p, type=INVOKE, f=f, value=mops),
+            Op(process=p, type=OK, f=f, value=mops)]
+
+
+class TestElleArtifacts:
+    def test_append_g1c_writes_dir(self, tmp_path):
+        h = History(ok(0, "txn", [["append", 0, 1], ["r", 1, [2]]]) +
+                    ok(1, "txn", [["append", 1, 2], ["r", 0, [1]]]))
+        r = AppendChecker().check({"store_dir": str(tmp_path)}, h)
+        assert r["valid"] is False
+        d = tmp_path / "elle"
+        assert (d / "anomalies.json").exists()
+        assert (d / "G1c.txt").exists()
+        svg = (d / "G1c-0.svg").read_text()
+        assert svg.startswith("<svg") and "wr" in svg
+        txt = (d / "G1c.txt").read_text()
+        assert "-[wr]->" in txt
+
+    def test_kafka_cycle_writes_dir(self, tmp_path):
+        h = History(
+            ok(0, "txn", [["send", 0, [0, 1]], ["poll", {1: [[0, 2]]}]]) +
+            ok(1, "txn", [["send", 1, [0, 2]], ["poll", {0: [[0, 1]]}]]))
+        r = KafkaChecker().check({"store_dir": str(tmp_path)}, h)
+        assert r["valid"] is False and "G1c" in r["anomaly-types"]
+        assert (tmp_path / "elle" / "G1c.txt").exists()
+        assert (tmp_path / "elle" / "G1c-0.svg").exists()
+
+    def test_valid_analysis_writes_nothing(self, tmp_path):
+        h = History(ok(0, "txn", [["append", 0, 1]]) +
+                    ok(1, "txn", [["r", 0, [1]]]))
+        r = AppendChecker().check({"store_dir": str(tmp_path)}, h)
+        assert r["valid"] is True
+        assert not (tmp_path / "elle").exists()
+
+
+class TestFailureArtifacts:
+    def test_failing_run_always_gets_timeline_and_perf(self):
+        """A failing run's store dir carries linear.svg + timeline + perf
+        plots even when the test composed no Timeline/Perf checker
+        (core.analyze renders them on invalid results)."""
+        from jepsen_tpu import control, core, generator as gen
+        from jepsen_tpu.workloads import linearizable_register
+        from suites.demo.runner import MockClient, MockStore
+
+        wl = linearizable_register.workload(
+            keys=range(2), ops_per_key=60, threads_per_key=2,
+            algorithm="cpu")
+        test = {"name": "artifacts-on-failure", "nodes": ["n1"],
+                "remote": control.DummyRemote(record_only=True),
+                "client": MockClient(MockStore(bug="stale-reads")),
+                "concurrency": 4,
+                "generator": gen.time_limit(
+                    3.0, gen.clients(wl["generator"])),
+                "checker": wl["checker"]}  # no Timeline/Perf composed
+        done = core.run(test)
+        assert done["results"]["valid"] is False
+        d = done["store_dir"]
+        assert os.path.exists(os.path.join(d, "timeline.html"))
+        assert os.path.exists(os.path.join(d, "latency-raw.png"))
+        assert os.path.exists(os.path.join(d, "rate-raw.png"))
+        # linear.svg lives next to the per-key analysis that failed
+        svgs = [os.path.join(r, fn) for r, _, fs in os.walk(d)
+                for fn in fs if fn == "linear.svg"]
+        assert svgs, f"no linear.svg under {d}"

@@ -173,6 +173,22 @@ class TestKafkaGraphCycles:
         r = check(h)
         assert any(t.startswith("process-") for t in r["anomaly-types"]), r
 
+    def test_merged_scc_reports_both_cycles(self):
+        # A wr 2-cycle (T0<->T1) bridged into the same full-graph SCC as a
+        # distinct process-order cycle: peeling must report both, not just
+        # the shortest (regression: SCC dedup dropped the process cycle).
+        h = (ok(0, [["send", 0, [0, 1]], ["poll", {1: [[0, 2]]}]]) +   # T0
+             ok(1, [["send", 1, [0, 2]], ["poll", {0: [[0, 1]]}]]) +   # T1
+             # process cycle: p2's first txn polls a record depending on
+             # p2's second txn (via T4)
+             ok(2, [["poll", {3: [[0, 40]]}]]) +                       # T2
+             ok(2, [["send", 2, [0, 30]],                              # T3
+                    ["poll", {0: [[0, 1]]}]]) +   # bridge: reads T0's send
+             ok(3, [["send", 3, [0, 40]], ["poll", {2: [[0, 30]]}]]))  # T4
+        r = check(h)
+        assert "G1c" in r["anomaly-types"], r
+        assert any(t.startswith("process-") for t in r["anomaly-types"]), r
+
     def test_no_cycle_on_clean_pipeline(self):
         # plain producer->consumer flow plus same-process resends: acyclic
         h = (ok(0, [["send", 0, [0, 10]]]) +
