@@ -260,11 +260,19 @@ def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
     return ev
 
 
+#: Configuration budget for the CPU witness re-derivation on refuted
+#: histories (knossos-style final-paths cost cap; checker.clj:213-216
+#: truncates for the same reason).  Exceeding it degrades the result to
+#: ``witness: {"error": ...}`` — the refutation verdict itself stands.
+WITNESS_BUDGET = 200_000
+
+
 def check(model: JaxModel, history: Optional[History] = None,
           prepared: Optional[PreparedHistory] = None,
           capacity: int = 1024, max_capacity: int = 65536,
           chunk: int = 512, max_window: int = 4096,
-          explain: bool = True, cancel=None) -> Dict[str, Any]:
+          explain: bool = True, cancel=None,
+          witness_budget: int = WITNESS_BUDGET) -> Dict[str, Any]:
     """Decide linearizability on device.  Retries with larger configuration
     capacity on overflow; falls back to ``valid: "unknown"`` past
     ``max_capacity``.  On refutation, optionally re-derives a witness on the
@@ -386,7 +394,8 @@ def check(model: JaxModel, history: Optional[History] = None,
                            "window": p.window, "capacity": cap,
                            "max-capacity-reached": max_cap_reached}
     if explain and history is not None and model.cpu_model is not None:
-        res["witness"] = _cpu_witness(model, history, failed_op)
+        res["witness"] = _cpu_witness(model, history, failed_op,
+                                      witness_budget)
     return res
 
 
@@ -427,7 +436,8 @@ def _shrink_carry(carry, new_capacity: int):
             jnp.asarray(valid2)) + tuple(carry[3:])
 
 
-def _cpu_witness(model: JaxModel, history: History, failed_op) -> Dict[str, Any]:
+def _cpu_witness(model: JaxModel, history: History, failed_op,
+                 budget: int = WITNESS_BUDGET) -> Dict[str, Any]:
     """Re-run the CPU oracle on the prefix ending at the failing op's
     completion for a knossos-style final-configs report."""
     from jepsen_tpu.checker import wgl_cpu
@@ -442,6 +452,6 @@ def _cpu_witness(model: JaxModel, history: History, failed_op) -> Dict[str, Any]
         return {"error": "failing op not found in history"}
     prefix = History(h.ops[:cut + 1])
     try:
-        return wgl_cpu.check(model.cpu_model(), prefix, max_configs=200_000)
+        return wgl_cpu.check(model.cpu_model(), prefix, max_configs=budget)
     except wgl_cpu.SearchExploded:
         return {"error": "witness search exceeded budget"}

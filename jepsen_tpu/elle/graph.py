@@ -32,6 +32,18 @@ class Graph:
     def edge_kinds(self, a, b) -> Set[str]:
         return self.out.get(a, {}).get(b, set())
 
+    def subgraph(self, nodes: Iterable[Any]) -> "Graph":
+        """Node-induced subgraph (edge kinds dropped — cycle *search* never
+        reads kinds; report kinds from the full graph)."""
+        ns = set(nodes)
+        g = Graph()
+        g.nodes = ns
+        for a in ns:
+            for b in self.succs(a):
+                if b in ns:
+                    g.add_edge(a, b, "")
+        return g
+
     def filter_kinds(self, kinds: Iterable[str]) -> "Graph":
         ks = set(kinds)
         g = Graph()

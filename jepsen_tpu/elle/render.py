@@ -25,6 +25,11 @@ from typing import Any, Dict, List, Optional
 # anomaly entries carrying these keys are dependency cycles
 _CYCLE_KEYS = ("cycle", "edges")
 
+# SVGs rendered per anomaly type.  The text listing and anomalies.json stay
+# complete (that's the point of the directory); only the per-cycle plots are
+# capped so a pathological run can't spray thousands of files.
+MAX_SVGS_PER_TYPE = 64
+
 
 def write_artifacts(test, res: Dict[str, Any], opts) -> None:
     """On an invalid analysis, write the ``elle/`` anomaly-graph directory
@@ -72,7 +77,7 @@ def write_anomaly_dir(store_dir: str, analysis: Dict[str, Any],
                 f.write(f"--- cycle {i} ---\n")
                 f.write(_explain_cycle(c))
                 f.write("\n")
-        for i, c in enumerate(cycles):
+        for i, c in enumerate(cycles[:MAX_SVGS_PER_TYPE]):
             svg = cycle_svg(c, title=f"{typ} #{i}")
             with open(os.path.join(d, f"{typ}-{i}.svg"), "w") as f:
                 f.write(svg)

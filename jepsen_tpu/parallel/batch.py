@@ -42,6 +42,10 @@ def check_batch(model: JaxModel,
     from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
     window = _round_window(max(p.window for p in preps))
+    # Clamp the chunk to the longest lane (rounded to 128) so short per-key
+    # histories don't pay a scan over thousands of NOP-padding events.
+    longest = max(len(p) for p in preps)
+    chunk = min(chunk, max(128, ((longest + 127) // 128) * 128))
     evs = [events_array(p, chunk) for p in preps]
 
     # Per-lane capacity adaptivity: most lanes (short per-key histories)

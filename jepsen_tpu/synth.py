@@ -116,6 +116,21 @@ def cas_register_history(n_ops: int,
     return History(history)
 
 
+def doomed_cas_padding(n: int, start_process: int = 9000,
+                       base_expect: int = 7777) -> List[Op]:
+    """``n`` crashed CAS ops whose expected value (``base_expect + i``) lies
+    outside any value :func:`cas_register_history` writes (its domain is
+    ``range(values)``, small): they hold pending-window slots forever but can
+    never be linearized from a reachable state, so they widen the engine's
+    window — per-closure-round cost is O(capacity * window) — without
+    multiplying the configuration set.  Interleave with a workload history
+    (reindex=True) to build wide-window-yet-decidable benchmark tiers."""
+    return ([Op(process=start_process + i, type=INVOKE, f="cas",
+                value=[base_expect + i, 1]) for i in range(n)]
+            + [Op(process=start_process + i, type=INFO, f="cas", value=None)
+               for i in range(n)])
+
+
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
                   values: int = 5) -> History:
     """Flip the observed value of ``n`` ok-reads to a value that was never

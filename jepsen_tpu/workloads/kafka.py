@@ -316,16 +316,6 @@ def _graph_pass(history: History) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     seen_cycles = set()
 
-    def subgraph(graph: Graph, nodes) -> Graph:
-        sg = Graph()
-        for a in nodes:
-            sg.add_node(a)
-            for b in graph.succs(a):
-                if b in nodes:
-                    for kind in graph.edge_kinds(a, b):
-                        sg.add_edge(a, b, kind)
-        return sg
-
     def scan(graph: Graph):
         # find_cycle yields one (shortest) cycle per SCC; an SCC can merge
         # several distinct cycles (e.g. a ww/wr 2-cycle bridged to a
@@ -335,7 +325,7 @@ def _graph_pass(history: History) -> List[Dict[str, Any]]:
         for comp in sccs(graph):
             remaining = set(comp)
             while len(remaining) >= 2:
-                sub = subgraph(graph, remaining)
+                sub = graph.subgraph(remaining)
                 cyc = None
                 for c in sccs(sub):
                     if len(c) >= 2:

@@ -1,0 +1,150 @@
+"""Hard-regime device-engine tests: the territory where CPU knossos dies.
+
+Three axes, per the round-1 review:
+  - wide pending windows (>= 64 slots): candidate-row cost is O(C*W) per
+    closure round, so these prove the engine's per-round cost model;
+  - capacity escalation driven by crash-bursts (each forever-pending crashed
+    write of a distinct value doubles the reachable configuration set) up to
+    and past the configured ceiling;
+  - refuted crash-heavy histories: the failed-op mapping and the CPU-witness
+    budget fallback (knossos truncates final paths for the same reason,
+    jepsen/src/jepsen/checker.clj:213-216).
+
+Construction notes: the pending window is the *peak simultaneous pending*
+count, and the closure expands over every active slot — so a wide window is
+only tractable when most pending ops cannot be linearized from any reachable
+state (a crashed CAS whose expected value is outside the written domain never
+matches, so it forks nothing).  Crash-bursts of distinct-value writes are the
+opposite: 2^k masks times up-to-k+1 states.
+"""
+
+import pytest
+
+from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+from jepsen_tpu.history import History, INVOKE, OK, FAIL, INFO, Op
+from jepsen_tpu.models import CASRegister, get_model
+from jepsen_tpu.synth import (cas_register_history, corrupt_reads,
+                              doomed_cas_padding)
+
+
+def mk(process, type_, f, value=None):
+    return Op(process=process, type=type_, f=f, value=value)
+
+
+def crash_burst(k, start_process=2000, base_value=100):
+    """k crashed writes of distinct values: each doubles the reachable
+    configuration set (in-window vs linearized), and states multiply too."""
+    out = []
+    for i in range(k):
+        out.append(mk(start_process + i, INVOKE, "write", base_value + i))
+        out.append(mk(start_process + i, INFO, "write", None))
+    return out
+
+
+class TestWideWindow:
+    @pytest.mark.parametrize("pad", [56, 120])
+    def test_wide_window_valid(self, pad):
+        # Window = pad doomed slots + live workload concurrency. The engine
+        # must report the wide window and still agree with the oracle.
+        work = cas_register_history(150, concurrency=6, crash_p=0.0, seed=3)
+        h = History(doomed_cas_padding(pad) + [o.with_() for o in work],
+                    reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=256, chunk=128)
+        assert r["valid"] is True
+        assert r["window"] >= pad + 2
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert cpu["valid"] is True
+
+    def test_wide_window_refuted(self):
+        work = corrupt_reads(
+            cas_register_history(150, concurrency=6, crash_p=0.0, seed=5),
+            n=1, seed=5)
+        h = History(doomed_cas_padding(56) + [o.with_() for o in work],
+                    reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=256, chunk=128)
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] is cpu["valid"] is False
+        assert r["op"]["index"] == cpu["op"]["index"]
+
+
+class TestCapacityEscalation:
+    def test_escalates_and_concludes(self):
+        # 10 pending distinct writes -> ~2^10 masks x up-to-11 states, far
+        # over the starting capacity of 64; the driver must escalate (resume,
+        # not restart) and still conclude.  A later read of a burst value is
+        # explained by a ghost write taking effect.
+        burst = crash_burst(10)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 104),
+                mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
+                mk(0, INVOKE, "read"), mk(0, OK, "read", 50)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=64, chunk=64,
+                          max_capacity=65536)
+        assert r["valid"] is True
+        assert r["max-capacity-reached"] > 64
+        cpu = wgl_cpu.check(CASRegister(), h)
+        assert cpu["valid"] is True
+
+    def test_ceiling_reached_degrades_to_unknown(self):
+        # 18 pending distinct writes need >= 2^18 configurations; with the
+        # ceiling at 4096 the engine must give up cleanly: verdict unknown
+        # with the capacity named, never a wrong True/False.
+        burst = crash_burst(18)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 117)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=1024, chunk=64,
+                          max_capacity=4096)
+        assert r["valid"] == "unknown"
+        assert "4096" in r["error"]
+
+    def test_oracle_budget_matches(self):
+        # Same explosion on the host tier: the oracle raises SearchExploded
+        # rather than answering wrong.
+        burst = crash_burst(18)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 117)]
+        h = History(burst + tail, reindex=True)
+        with pytest.raises(wgl_cpu.SearchExploded):
+            wgl_cpu.check(CASRegister(), h, max_configs=50_000)
+
+
+class TestCrashHeavyRefutation:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_failed_op_matches_oracle(self, seed):
+        h = corrupt_reads(
+            cas_register_history(600, concurrency=8, crash_p=0.02, seed=seed),
+            n=2, seed=seed)
+        model = get_model("cas-register")
+        cpu = wgl_cpu.check(CASRegister(), h)
+        tpu = wgl_tpu.check(model, h, capacity=256, chunk=256)
+        assert cpu["valid"] == tpu["valid"]
+        if cpu["valid"] is False:
+            assert cpu["op"]["index"] == tpu["op"]["index"]
+
+    def test_witness_budget_exceeded(self):
+        # The refutation verdict must survive a witness search that blows its
+        # budget: the result degrades to witness: {"error": ...} (the device
+        # verdict stands on its own).
+        burst = crash_burst(10)
+        tail = [mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
+                mk(0, INVOKE, "read"), mk(0, OK, "read", 9999)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=64, chunk=64,
+                          witness_budget=100)
+        assert r["valid"] is False
+        assert r["witness"] == {"error": "witness search exceeded budget"}
+
+    def test_witness_within_budget(self):
+        burst = crash_burst(10)
+        tail = [mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
+                mk(0, INVOKE, "read"), mk(0, OK, "read", 9999)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=64, chunk=64)
+        assert r["valid"] is False
+        assert r["witness"]["valid"] is False
+        assert r["witness"]["final-configs"]
