@@ -58,6 +58,9 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
             try:
                 history = _run_case(test)
             finally:
+                # Logs must come off the nodes BEFORE teardown wipes them
+                # (core.clj:143-163 with-log-snarfing wraps the db phase).
+                _snarf_logs_safe(test)
                 _teardown_db(test, final=True)
             test["history"] = history
             store.save_1(test, history)
@@ -68,10 +71,10 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
             return test
         finally:
             if has_cluster:
-                try:
-                    _snarf_logs(test)
-                except Exception:  # noqa: BLE001
-                    logger.exception("downloading node logs")
+                # Failed OS/DB setup never reaches the in-run snarf site;
+                # those logs matter most for diagnosis, so snarf here too
+                # (idempotent via the _logs_snarfed flag).
+                _snarf_logs_safe(test)
                 control.teardown_sessions(test)
     finally:
         store.stop_logging(log_handler)
@@ -169,6 +172,18 @@ def _failure_artifacts(test, history: History) -> None:
             Perf().check(test, history, {"store_dir": d})
     except Exception:  # noqa: BLE001
         logger.exception("failure-artifact rendering")
+
+
+def _snarf_logs_safe(test) -> None:
+    """Snarf at most once per run, never raising (shutdown-hook spirit of
+    core.clj:143-163: log download must not mask the real failure)."""
+    if test.get("_logs_snarfed"):
+        return
+    try:
+        _snarf_logs(test)
+        test["_logs_snarfed"] = True
+    except Exception:  # noqa: BLE001
+        logger.exception("downloading node logs")
 
 
 def _snarf_logs(test) -> None:

@@ -15,6 +15,7 @@ for CPU parallelism maps directly onto the ``data`` mesh axis.
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -221,9 +222,29 @@ class IndependentChecker(Checker):
                              **{k: v for k, v in inner.engine_opts.items()
                                 if k in ("capacity", "max_capacity", "chunk")})
             results = dict(zip(keys, rs))
+            # Refuted keys are rare and precious: re-derive them through the
+            # full single-history checker so they carry a witness and a
+            # linear.svg in their own result dir (the reference's per-key
+            # result dirs + knossos render, independent.clj:266-317,
+            # checker.clj:207-211).  The batched pass already paid for the
+            # common case; this pays only for failures.
+            for k, r in results.items():
+                if r.get("valid") is False:
+                    rech = check_safe(inner, test, subs[k],
+                                      self._key_opts(opts, k))
+                    if rech.get("valid") is False:
+                        results[k] = rech
+                    else:
+                        # A crashed or disagreeing re-derivation must never
+                        # soften a definite refutation to unknown/true.
+                        r["recheck"] = {"valid": rech.get("valid"),
+                                        "note": "re-derivation did not "
+                                                "confirm; batch refutation "
+                                                "stands"}
         else:
             with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
-                futs = {k: ex.submit(check_safe, inner, test, subs[k], opts)
+                futs = {k: ex.submit(check_safe, inner, test, subs[k],
+                                     self._key_opts(opts, k))
                         for k in keys}
                 results = {k: f.result() for k, f in futs.items()}
 
@@ -232,6 +253,20 @@ class IndependentChecker(Checker):
                 "key-count": len(keys),
                 "results": results,
                 "failures": sorted(bad, key=repr)}
+
+    @staticmethod
+    def _key_opts(opts, k):
+        """Per-key result dir under independent/<key>/ so sub-checker
+        artifacts (linear.svg, timelines) never collide across keys."""
+        d = (opts or {}).get("store_dir")
+        if not d:
+            return opts
+        kd = os.path.join(d, "independent", str(k))
+        try:
+            os.makedirs(kd, exist_ok=True)
+        except OSError:
+            return opts
+        return {**opts, "store_dir": kd}
 
 
 def checker(inner: Checker, mesh=None) -> Checker:

@@ -107,10 +107,23 @@ def save_1(test: Dict[str, Any], history: History) -> None:
 
 
 def save_2(test: Dict[str, Any], results: Dict[str, Any]) -> None:
-    """Phase 2: persist analysis results (store.clj:439)."""
+    """Phase 2: persist analysis results (store.clj:439): the full
+    results.json plus a block-indexed results.jtsf whose tiny ``valid``
+    block and per-key blocks can be read lazily (the reference's
+    BlockRef/PartialMap lazy-results design, store/format.clj:97-120) —
+    browsing a thousand runs' verdicts never loads a thousand big maps."""
     d = test["store_dir"]
     with open(os.path.join(d, "results.json"), "w") as f:
         json.dump(results, f, indent=2, default=str)
+    try:
+        from jepsen_tpu.store import format as _fmt
+        with _fmt.Writer(os.path.join(d, "results.jtsf")) as w:
+            w.append_named_json("valid", {"valid": results.get("valid"),
+                                          "keys": sorted(results)})
+            for k, v in results.items():
+                w.append_named_json(f"results/{k}", v)
+    except Exception:  # noqa: BLE001 - results.json is authoritative
+        pass
 
 
 def load_test(path: str) -> Dict[str, Any]:
@@ -136,6 +149,50 @@ def load_results(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+class LazyResults:
+    """Mapping-shaped lazy view over a run's results.jtsf: the verdict and
+    key list load eagerly (one tiny block); each sub-result loads on first
+    access with a single seek (PartialMap role, store/format.clj:113-120)."""
+
+    def __init__(self, path: str):
+        from jepsen_tpu.store import format as _fmt
+        self._store = _fmt.LazyStore(path)
+        head = self._store.read_json("valid")
+        self.valid = head.get("valid")
+        self._keys = head.get("keys") or []
+        self._cache: Dict[str, Any] = {}
+
+    def keys(self):
+        return list(self._keys)
+
+    def __contains__(self, k):
+        return k in self._keys
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def __getitem__(self, k):
+        if k not in self._cache:
+            self._cache[k] = self._store.read_json(f"results/{k}")
+        return self._cache[k]
+
+    def get(self, k, default=None):
+        return self[k] if k in self._keys else default
+
+
+def load_results_lazy(path: str) -> "LazyResults | Dict[str, Any]":
+    """Lazy results view when the run has a results.jtsf; falls back to the
+    eager JSON load for older runs."""
+    d = os.path.realpath(path)
+    p = os.path.join(d, "results.jtsf")
+    if os.path.exists(p):
+        try:
+            return LazyResults(p)
+        except Exception:  # noqa: BLE001 - fall back to the JSON blob
+            pass
+    return load_results(path)
+
+
 def runs(base: str = BASE) -> List[Dict[str, Any]]:
     """All stored runs with verdicts, newest first (for CLI/web browsing)."""
     out = []
@@ -150,8 +207,18 @@ def runs(base: str = BASE) -> List[Dict[str, Any]]:
             if stamp == "latest" or not os.path.isdir(d):
                 continue
             entry = {"name": name, "time": stamp, "dir": d, "valid": None}
+            lp = os.path.join(d, "results.jtsf")
             rp = os.path.join(d, "results.json")
-            if os.path.exists(rp):
+            if os.path.exists(lp):
+                # One tiny block read per run instead of parsing the whole
+                # results blob (which can hold per-key maps for 10^3 keys).
+                try:
+                    from jepsen_tpu.store import format as _fmt
+                    entry["valid"] = _fmt.LazyStore(lp).read_json(
+                        "valid").get("valid")
+                except Exception:  # noqa: BLE001
+                    pass
+            if entry["valid"] is None and os.path.exists(rp):
                 try:
                     with open(rp) as f:
                         entry["valid"] = json.load(f).get("valid")

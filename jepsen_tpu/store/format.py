@@ -30,7 +30,8 @@ MAGIC = b"JTSF0001"
 
 TAG_JSON = 1
 TAG_BYTES = 2
-TAG_OPS = 3  # one JSONL chunk of ops
+TAG_OPS = 3    # one JSONL chunk of ops
+TAG_INDEX = 4  # JSON {name: block-header offset} — BlockRef indirection
 
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
@@ -69,10 +70,25 @@ def _native_lib() -> Optional[ctypes.CDLL]:
 
 
 class Writer:
-    """Append blocks to a store file (native engine when available)."""
+    """Append blocks to a store file (native engine when available).
+
+    Blocks may be *named* via :meth:`append_named`; on close, a TAG_INDEX
+    block mapping name -> block-header byte offset is appended.  Readers can
+    then seek straight to a named block without touching anything else — the
+    role of the reference's BlockRef indirection (store/format.clj:97-110).
+    Append-only: re-opening and appending writes a fresh index whose entries
+    shadow the previous one (last index wins), so earlier data is never
+    rewritten."""
 
     def __init__(self, path: str, native: Optional[bool] = None):
         self.path = path
+        try:
+            sz = os.path.getsize(path)
+        except OSError:
+            sz = 0
+        # Byte offset of the next block header (magic occupies [0, 8)).
+        self._off = sz if sz > 0 else len(MAGIC)
+        self._index: dict = {}
         lib = _native_lib() if native in (None, True) else None
         if native is True and lib is None:
             raise RuntimeError("native store engine unavailable")
@@ -92,7 +108,9 @@ class Writer:
     def engine(self) -> str:
         return "native" if self._lib is not None else "python"
 
-    def append(self, payload: bytes, tag: int = TAG_BYTES) -> None:
+    def append(self, payload: bytes, tag: int = TAG_BYTES) -> int:
+        """Append one block; returns its header byte offset."""
+        off = self._off
         if self._lib is not None:
             rc = self._lib.jtsf_append(self._h, tag, payload, len(payload))
             if rc != 0:
@@ -102,9 +120,22 @@ class Writer:
             self._f.write(struct.pack("<II", len(payload), crc))
             self._f.write(bytes([tag]))
             self._f.write(payload)
+        self._off += 9 + len(payload)
+        return off
 
-    def append_json(self, value: Any) -> None:
-        self.append(json.dumps(value, default=str).encode(), TAG_JSON)
+    def append_json(self, value: Any) -> int:
+        return self.append(json.dumps(value, default=str).encode(), TAG_JSON)
+
+    def append_named(self, name: str, payload: bytes,
+                     tag: int = TAG_BYTES) -> int:
+        """Append a block reachable by name via the closing index."""
+        off = self.append(payload, tag)
+        self._index[name] = off
+        return off
+
+    def append_named_json(self, name: str, value: Any) -> int:
+        return self.append_named(
+            name, json.dumps(value, default=str).encode(), TAG_JSON)
 
     def flush(self) -> None:
         if self._lib is not None:
@@ -113,6 +144,9 @@ class Writer:
             self._f.flush()
 
     def close(self) -> None:
+        if self._index:
+            self.append(json.dumps(self._index).encode(), TAG_INDEX)
+            self._index = {}
         if self._lib is not None:
             if self._h:
                 self._lib.jtsf_close(self._h)
@@ -154,6 +188,81 @@ def read_blocks(path: str) -> Iterator[Tuple[int, bytes]]:
                 raise CorruptBlock(i)
             yield tag, payload
             i += 1
+
+
+def _scan_headers(path: str) -> Iterator[Tuple[int, int, int]]:
+    """Yield (offset, tag, length) for every block, reading headers only —
+    payloads are skipped with seeks, so this is cheap even for huge files."""
+    with open(path, "rb") as f:
+        if f.read(8) != MAGIC:
+            raise CorruptBlock(-1)
+        i = 0
+        off = 8
+        while True:
+            hdr = f.read(9)
+            if not hdr:
+                return
+            if len(hdr) != 9:
+                raise CorruptBlock(i)
+            length = struct.unpack("<I", hdr[:4])[0]
+            yield off, hdr[8], length
+            f.seek(length, 1)
+            off += 9 + length
+            i += 1
+
+
+def read_block_at(path: str, offset: int) -> Tuple[int, bytes]:
+    """Read (and CRC-check) the single block whose header starts at
+    ``offset`` — the BlockRef dereference: no other payload is touched."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        hdr = f.read(9)
+        if len(hdr) != 9:
+            raise CorruptBlock(-1)
+        length, crc = struct.unpack("<II", hdr[:8])
+        tag = hdr[8]
+        payload = f.read(length)
+    if len(payload) != length or \
+            (zlib.crc32(bytes([tag]) + payload) & 0xFFFFFFFF) != crc:
+        raise CorruptBlock(-1)
+    return tag, payload
+
+
+def read_index(path: str) -> dict:
+    """Name -> offset map from the *last* TAG_INDEX block (later appends
+    shadow earlier indices).  Header-skip scan: payloads are not read."""
+    last = None
+    for off, tag, _length in _scan_headers(path):
+        if tag == TAG_INDEX:
+            last = off
+    if last is None:
+        return {}
+    _tag, payload = read_block_at(path, last)
+    return json.loads(payload.decode())
+
+
+class LazyStore:
+    """Named-block view over a store file: ``names()`` is cheap, each
+    ``read(name)`` seeks to exactly one block.  The PartialMap role from
+    the reference (store/format.clj:113-120): consumers pull the small
+    blocks (a verdict) without paying for the big ones (per-key results,
+    plots, histories)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._index = read_index(path)
+
+    def names(self):
+        return sorted(self._index)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def read(self, name: str) -> bytes:
+        return read_block_at(self.path, self._index[name])[1]
+
+    def read_json(self, name: str) -> Any:
+        return json.loads(self.read(name).decode())
 
 
 def verify(path: str) -> int:

@@ -8,8 +8,6 @@ zip export of a run directory.  Stdlib http.server — no framework needed.
 from __future__ import annotations
 
 import html
-import io
-import json
 import os
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -79,23 +77,77 @@ def make_handler(base: str):
                     f"<li><a href='{html.escape(name + ('/' if os.path.isdir(os.path.join(p, name)) else ''))}'>"
                     f"{html.escape(name)}</a></li>" for name in entries)
                 return self._send(200, f"<ul>{items}</ul>".encode())
-            with open(p, "rb") as f:
-                data = f.read()
-            ctype = ("application/json" if p.endswith(".json")
-                     else "text/plain; charset=utf-8")
-            return self._send(200, data, ctype)
+            # Stream the file (run dirs hold pcaps and logs of arbitrary
+            # size; never buffer them whole).
+            import mimetypes
+            ctype = (mimetypes.guess_type(p)[0]
+                     or "text/plain; charset=utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(os.path.getsize(p)))
+            self.end_headers()
+            try:
+                with open(p, "rb") as f:
+                    while True:
+                        buf = f.read(1 << 20)
+                        if not buf:
+                            break
+                        self.wfile.write(buf)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-download
 
         def _zip(self, rel: str):
+            """Stream a zip of the run dir: ZipFile writes straight into an
+            unseekable wrapper over the socket (data-descriptor mode), and
+            each member is copied in 1 MiB pieces — a run with gigabytes of
+            tcpdump pcaps needs constant memory, not a BytesIO of the whole
+            archive (the reference streams too, web.clj:175)."""
             p = self._safe(rel)
             if p is None or not os.path.isdir(p):
                 return self._send(404, b"not found")
-            buf = io.BytesIO()
-            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-                for root, _, files in os.walk(p):
-                    for fn in files:
-                        full = os.path.join(root, fn)
-                        z.write(full, os.path.relpath(full, p))
-            return self._send(200, buf.getvalue(), "application/zip")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            # No Content-Length: close-delimited body (HTTP/1.0), so no
+            # chunked framing is needed — but the close must then happen.
+            self.close_connection = True
+            self.end_headers()
+
+            wfile = self.wfile
+
+            class _Unseekable:
+                # zipfile probes seek/tell; hiding them selects the
+                # streaming (data descriptor) zip variant.
+                def write(self, b):
+                    wfile.write(b)
+                    return len(b)
+
+                def flush(self):
+                    wfile.flush()
+
+            try:
+                with zipfile.ZipFile(_Unseekable(), "w",
+                                     zipfile.ZIP_DEFLATED) as z:
+                    for root, _, files in os.walk(p):
+                        for fn in sorted(files):
+                            full = os.path.join(root, fn)
+                            arc = os.path.relpath(full, p)
+                            try:
+                                src = open(full, "rb")
+                            except OSError:
+                                continue
+                            zi = zipfile.ZipInfo(arc)
+                            zi.compress_type = zipfile.ZIP_DEFLATED
+                            # force_zip64: sizes are unknown up front in
+                            # data-descriptor mode and pcaps can pass 4 GiB
+                            with src, z.open(zi, "w",
+                                             force_zip64=True) as dst:
+                                while True:
+                                    buf = src.read(1 << 20)
+                                    if not buf:
+                                        break
+                                    dst.write(buf)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-download
 
     return Handler
 

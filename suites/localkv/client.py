@@ -1,0 +1,101 @@
+"""localkv wire client: real TCP, length-prefixed JSON frames.
+
+Error mapping follows the reference's client discipline (e.g.
+zookeeper.clj:91-104, and every suite client here): failed reads are safe
+to report FAIL (a read that didn't happen constrains nothing), mutations
+whose fate is unknown become INFO, and replies the server marks
+``definite`` may FAIL.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites.localkv.server import recv_frame, send_frame
+
+
+class ConnectFailed(Exception):
+    """Connection could not even be established: the request was never
+    sent, so the op definitely did not happen (definite FAIL for any op —
+    without this distinction every mutation against a killed node becomes a
+    forever-pending indeterminate ghost and the configuration space of the
+    linearizability search doubles per attempt)."""
+
+
+class Conn:
+    def __init__(self, port: int, timeout: float = 2.0):
+        self.port = port
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+
+    def call(self, msg):
+        if self.sock is None:
+            try:
+                self.sock = socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=self.timeout)
+            except OSError as e:
+                raise ConnectFailed(str(e)) from e
+        try:
+            send_frame(self.sock, msg)
+            reply = recv_frame(self.sock)
+        except OSError:
+            self.close()
+            raise
+        if reply is None:
+            self.close()
+            raise ConnectionError("server closed connection")
+        return reply
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+
+class RegisterClient(jclient.Client):
+    """Per-key register ops (read/write/cas) against the node's server."""
+
+    def __init__(self, conn: Optional[Conn] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(Conn(test["localkv_ports"][node]))
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        key = f"r{k}"
+        try:
+            if op.f == "read":
+                reply = self.conn.call({"op": "read", "key": key})
+                if reply.get("ok"):
+                    return op.with_(type=OK, value=(k, reply.get("value")))
+                return op.with_(type=FAIL, error=reply.get("error"))
+            if op.f == "write":
+                reply = self.conn.call({"op": "write", "key": key,
+                                        "value": v})
+            else:  # cas
+                old, new = v
+                reply = self.conn.call({"op": "cas", "key": key,
+                                        "old": old, "new": new})
+            if reply.get("ok"):
+                return op.with_(type=OK)
+            if reply.get("definite"):
+                return op.with_(type=FAIL, error=reply.get("error"))
+            return op.with_(type=INFO, error=reply.get("error"))
+        except ConnectFailed as e:
+            return op.with_(type=FAIL, error=str(e))
+        except (OSError, socket.timeout) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()

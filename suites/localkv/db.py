@@ -1,0 +1,96 @@
+"""localkv DB layer: real daemon lifecycle on each "node".
+
+Every command here executes for real (the runner uses a non-record
+DummyRemote, the local-exec transport): ``start_daemon`` forks an actual
+``python server.py`` with a pidfile and logfile, ``kill`` delivers a real
+SIGKILL via pkill, pause/resume are real SIGSTOP/SIGCONT, and log snarfing
+downloads the server's actual WAL and stdout log.  Nodes are logical names
+mapped to 127.0.0.1 ports — the same one-host topology as the reference's
+docker environment (docker/README.md:12-29), with the network layer being
+the real loopback stack.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+SERVER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "server.py")
+
+
+def port_of(test, node: str) -> int:
+    return test["localkv_ports"][node]
+
+
+def marker(test, node: str) -> str:
+    """Distinctive argv tag so grepkill targets exactly this daemon."""
+    return f"localkv-{node}-p{port_of(test, node)}"
+
+
+def data_dir(test, node: str) -> str:
+    return os.path.join(test.get("localkv_dir", "/tmp/jepsen-localkv"),
+                        marker(test, node))
+
+
+class LocalKvDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        s.exec("mkdir", "-p", d)
+        self.start(test, node)
+        cu.await_tcp_port(s, port_of(test, node), timeout_s=30)
+
+    def teardown(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        cu.stop_daemon(s, os.path.join(d, "server.pid"))
+        cu.grepkill(s, marker(test, node))
+        if not test.get("leave_db_running"):
+            s.exec("rm", "-rf", d)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        nodes = test["nodes"]
+        primary = f"{nodes[0]}:{port_of(test, nodes[0])}"
+        peers = ",".join(f"{n}:{port_of(test, n)}" for n in nodes[1:])
+        args = [SERVER,
+                "--node", node,
+                "--port", str(port_of(test, node)),
+                "--primary", primary,
+                "--peers", peers,
+                "--data", d,
+                "--marker", marker(test, node)]
+        if test.get("localkv_unsafe"):
+            args += ["--local-reads",
+                     "--repl-delay", str(test.get("repl_delay", 0.05))]
+        cu.start_daemon(s, sys.executable, *args,
+                        pidfile=os.path.join(d, "server.pid"),
+                        logfile=os.path.join(d, "server.log"))
+
+    def kill(self, test, node):
+        s = session(test, node)
+        cu.grepkill(s, marker(test, node))
+        s.exec("rm", "-f", os.path.join(data_dir(test, node), "server.pid"))
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="CONT")
+
+    # -- Primary capability ------------------------------------------------
+    def primaries(self, test) -> List[str]:
+        return [test["nodes"][0]]
+
+    # -- LogFiles capability ----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        d = data_dir(test, node)
+        return [os.path.join(d, "server.log"), os.path.join(d, "wal.jsonl")]

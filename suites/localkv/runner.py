@@ -1,0 +1,109 @@
+"""localkv suite CLI — real-process end-to-end runs on one host.
+
+    python -m suites.localkv.runner test --time-limit 10
+    python -m suites.localkv.runner test --unsafe --time-limit 10
+
+Unlike the dummy-remote pipeline tests, nothing here is faked: servers are
+real OS processes serving real TCP sockets, faults are real signals, and
+the histories the checker judges came over the wire.  ``--unsafe`` turns on
+follower local reads with a replication delay, which the linearizability
+checker must refute; the default mode must verify.  This is the in-repo
+stand-in for the reference's one-host docker cluster runs
+(docker/README.md:12-29) in environments with no docker daemon or DB
+binaries — see REALRUN.md.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict
+
+from jepsen_tpu import cli, generator as gen
+from jepsen_tpu.checker import Stats, compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.workloads import linearizable_register
+
+from suites.localkv.client import RegisterClient
+from suites.localkv.db import LocalKvDB
+
+
+def free_ports(n: int):
+    """Ask the OS for n distinct free TCP ports."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+NEMESES = {
+    "none": lambda opts: combined.Package(),
+    "kill": lambda opts: combined.db_package({**opts, "faults": ["kill"]}),
+    "pause": lambda opts: combined.db_package({**opts, "faults": ["pause"]}),
+    "kill+pause": lambda opts: combined.db_package(
+        {**opts, "faults": ["kill", "pause"]}),
+}
+
+
+def localkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    nodes = opts.get("nodes") or ["n1", "n2", "n3"]
+    ports = free_ports(len(nodes))
+    unsafe = bool(opts.get("unsafe"))
+    nemesis_name = opts.get("nemesis", "kill")
+    pkg = NEMESES[nemesis_name](
+        {"interval": float(opts.get("nemesis_interval", 3.0))})
+
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 4))),
+        ops_per_key=int(opts.get("ops_per_key", 150)),
+        threads_per_key=2)
+
+    time_limit = float(opts.get("time_limit", 10.0))
+    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    parts = [client_gen]
+    if pkg.generator is not None:
+        parts = [gen.any_gen(client_gen,
+                             gen.nemesis(gen.time_limit(time_limit,
+                                                        pkg.generator)))]
+    if pkg.final_generator is not None:
+        parts.append(gen.synchronize(gen.nemesis(gen.lift(pkg.final_generator))))
+
+    return {**opts,
+            "name": ("localkv-unsafe" if unsafe else "localkv")
+                    + f"-{nemesis_name}",
+            "nodes": nodes,
+            "localkv_ports": dict(zip(nodes, ports)),
+            "localkv_unsafe": unsafe,
+            "remote": DummyRemote(),  # local-exec: commands really run
+            "db": LocalKvDB(),
+            "client": RegisterClient(),
+            "nemesis": pkg.nemesis,
+            "generator": parts,
+            "checker": compose({"stats": Stats(),
+                                "workload": wl["checker"],
+                                "perf": Perf(),
+                                "timeline": Timeline()})}
+
+
+def _suite_opts(parser):
+    parser.add_argument("--unsafe", action="store_true",
+                        help="follower local reads + replication delay "
+                             "(must be refuted)")
+    parser.add_argument("--nemesis", default="kill",
+                        choices=sorted(NEMESES))
+    parser.add_argument("--keys", type=int, default=4)
+    parser.add_argument("--ops-per-key", type=int, default=150)
+    parser.add_argument("--nemesis-interval", type=float, default=3.0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli.single_test_cmd(localkv_test, opt_fn=_suite_opts,
+                                 prog="jepsen-tpu-localkv"))

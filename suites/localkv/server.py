@@ -1,0 +1,253 @@
+"""localkv server — a real replicated KV store in a standalone process.
+
+This is the system-under-test for the suite's *real-process* end-to-end
+runs: N of these run as independent OS daemons (started over the control
+plane with pidfiles, killed with real SIGKILL), speak a length-prefixed
+JSON protocol over real TCP sockets, replicate asynchronously, and persist
+a write-ahead log that survives crashes.
+
+Topology: static primary (first node of the roster).  Followers forward
+every mutation to the primary; the primary serializes ops under a lock,
+appends to its WAL before acking, and replicates to followers
+asynchronously.  Two read modes:
+
+- default: reads are forwarded to the primary too -> linearizable (single
+  serialization point, ack after apply);
+- ``--local-reads``: a follower answers reads from its own (asynchronously
+  maintained, hence stale) replica -> NOT linearizable; with
+  ``--repl-delay`` the staleness window is wide enough that a short Jepsen
+  run reliably refutes it.
+
+Stdlib only; runnable as a bare script (the DB layer invokes it via
+``python server.py ...`` on each "node").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">I", hdr)
+    if length > 1 << 20:
+        raise ValueError("frame too large")
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return json.loads(data.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+class Store:
+    """Keyed registers + write-ahead log; replay on restart."""
+
+    def __init__(self, wal_path: str):
+        self.kv = {}
+        self.seq = 0
+        self.lock = threading.Lock()
+        self.wal_path = wal_path
+        if os.path.exists(wal_path):
+            with open(wal_path) as f:
+                for line in f:
+                    if line.strip():
+                        rec = json.loads(line)
+                        self.kv[rec["k"]] = rec["v"]
+                        self.seq = rec["s"]
+        self.wal = open(wal_path, "a")
+
+    def log(self, key, value) -> int:
+        self.seq += 1
+        self.wal.write(json.dumps({"k": key, "v": value, "s": self.seq}) + "\n")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+        return self.seq
+
+
+class Replicator(threading.Thread):
+    """Async replication to one peer: at-least-once per live connection,
+    reconnect on error, bounded queue (drops oldest when a peer is dead —
+    this is the asynchrony --local-reads exposes)."""
+
+    def __init__(self, peer_addr, delay: float):
+        super().__init__(daemon=True)
+        self.peer = peer_addr
+        self.delay = delay
+        self.q: queue.Queue = queue.Queue(maxsize=10000)
+        self.sock = None
+
+    def submit(self, msg) -> None:
+        try:
+            self.q.put_nowait(msg)
+        except queue.Full:
+            pass
+
+    def run(self) -> None:
+        while True:
+            msg = self.q.get()
+            if self.delay:
+                time.sleep(self.delay)
+            for _ in range(2):
+                try:
+                    if self.sock is None:
+                        self.sock = socket.create_connection(self.peer,
+                                                             timeout=2)
+                    send_frame(self.sock, msg)
+                    recv_frame(self.sock)
+                    break
+                except OSError:
+                    try:
+                        if self.sock:
+                            self.sock.close()
+                    except OSError:
+                        pass
+                    self.sock = None
+
+
+class Server:
+    def __init__(self, opts):
+        self.node = opts.node
+        self.port = opts.port
+        self.is_primary = opts.node == opts.primary.split(":")[0]
+        self.primary_addr = ("127.0.0.1", int(opts.primary.split(":")[1]))
+        self.local_reads = opts.local_reads
+        os.makedirs(opts.data, exist_ok=True)
+        self.store = Store(os.path.join(opts.data, "wal.jsonl"))
+        self.repls = []
+        if self.is_primary:
+            for peer in filter(None, opts.peers.split(",")):
+                _n, p = peer.split(":")
+                r = Replicator(("127.0.0.1", int(p)), opts.repl_delay)
+                r.start()
+                self.repls.append(r)
+
+    # -- op handling -------------------------------------------------------
+
+    def handle(self, msg):
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "node": self.node,
+                    "primary": self.is_primary}
+        if op == "repl":
+            with self.store.lock:
+                self.store.kv[msg["key"]] = msg["value"]
+            return {"ok": True}
+        if op == "read" and (self.is_primary or self.local_reads):
+            with self.store.lock:
+                return {"ok": True, "value": self.store.kv.get(msg["key"])}
+        if not self.is_primary:
+            return self.forward(msg)
+        # primary mutation path: serialize, WAL, ack, replicate async
+        with self.store.lock:
+            key = msg["key"]
+            cur = self.store.kv.get(key)
+            if op == "write":
+                value = msg["value"]
+            elif op == "cas":
+                if cur != msg["old"]:
+                    return {"ok": False, "error": "cas-mismatch",
+                            "definite": True}
+                value = msg["new"]
+            else:
+                return {"ok": False, "error": f"bad op {op!r}",
+                        "definite": True}
+            self.store.kv[key] = value
+            self.store.log(key, value)
+        for r in self.repls:
+            r.submit({"op": "repl", "key": key, "value": value})
+        return {"ok": True}
+
+    def forward(self, msg):
+        """Relay to the primary.  A connect failure is definite (the op
+        never reached the primary); a mid-flight failure is indeterminate."""
+        try:
+            sock = socket.create_connection(self.primary_addr, timeout=2)
+        except OSError as e:
+            return {"ok": False, "error": f"primary-unreachable: {e}",
+                    "definite": True}
+        try:
+            with sock:
+                send_frame(sock, msg)
+                reply = recv_frame(sock)
+            if reply is None:
+                raise OSError("primary closed mid-reply")
+            return reply
+        except OSError as e:
+            return {"ok": False, "error": f"forward-failed: {e}",
+                    "indeterminate": True}
+
+    # -- serving -----------------------------------------------------------
+
+    def serve(self) -> None:
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_frame(self.request)
+                    except (OSError, ValueError):
+                        return
+                    if msg is None:
+                        return
+                    try:
+                        reply = outer.handle(msg)
+                    except Exception as e:  # noqa: BLE001
+                        reply = {"ok": False, "error": repr(e),
+                                 "indeterminate": True}
+                    try:
+                        send_frame(self.request, reply)
+                    except OSError:
+                        return
+
+        class TS(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        with TS(("127.0.0.1", self.port), Handler) as srv:
+            print(f"localkv {self.node} serving on {self.port} "
+                  f"(primary={self.is_primary})", flush=True)
+            srv.serve_forever()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--primary", required=True, help="node:port of primary")
+    ap.add_argument("--peers", default="", help="node:port,... of followers")
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--local-reads", action="store_true")
+    ap.add_argument("--repl-delay", type=float, default=0.0)
+    ap.add_argument("--marker", default="", help="argv tag for grepkill")
+    Server(ap.parse_args(argv)).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

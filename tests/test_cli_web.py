@@ -74,12 +74,36 @@ class TestWeb:
                 f"http://127.0.0.1:{port}/files/cli-suite/"
                 f"{runs[0]['time']}/").read().decode()
             assert "history.jsonl" in files
-            zipdata = urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/zip/cli-suite/"
-                f"{runs[0]['time']}").read()
+            # Zip export streams (close-delimited, no Content-Length) and
+            # must still be a well-formed archive containing the run files.
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/zip/cli-suite/{runs[0]['time']}")
+            assert resp.headers.get("Content-Length") is None
+            zipdata = resp.read()
             assert zipdata[:2] == b"PK"
+            import io
+            import zipfile
+            with zipfile.ZipFile(io.BytesIO(zipdata)) as z:
+                names = z.namelist()
+                assert "results.json" in names
+                assert z.read("history.jsonl")  # members decompress cleanly
         finally:
             httpd.shutdown()
+
+    def test_lazy_results_view(self, tmp_path):
+        base = str(tmp_path / "store")
+        t = suite_test_fn({"nodes": [], "store_base": base,
+                           "concurrency": 2})
+        done = core.run(t)
+        lazy = store.load_results_lazy(done["store_dir"])
+        eager = store.load_results(done["store_dir"])
+        assert isinstance(lazy, store.LazyResults)
+        assert lazy.valid is True
+        assert sorted(lazy.keys()) == sorted(eager.keys())
+        for k in eager:  # every sub-key round-trips through its own block
+            assert lazy[k] == eager[k]
+        # runs() verdicts come from the tiny valid block
+        assert store.runs(base)[0]["valid"] is True
 
 
 class TestModuleMain:

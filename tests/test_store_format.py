@@ -60,6 +60,42 @@ class TestFormat:
         with pytest.raises(fmt.CorruptBlock):
             fmt.verify(p)
 
+    def test_named_blocks_lazy_read(self, tmp_path):
+        p = str(tmp_path / "f.jtsf")
+        with fmt.Writer(p) as w:
+            w.append(b"unnamed filler " * 100)
+            w.append_named("small", b"tiny")
+            w.append_named_json("big", {"k": list(range(500))})
+        s = fmt.LazyStore(p)
+        assert s.names() == ["big", "small"]
+        assert s.read("small") == b"tiny"
+        assert s.read_json("big")["k"][499] == 499
+
+    def test_index_last_wins_after_append(self, tmp_path):
+        p = str(tmp_path / "f.jtsf")
+        with fmt.Writer(p) as w:
+            w.append_named("a", b"one")
+        with fmt.Writer(p) as w:
+            w.append_named("a", b"two")
+            w.append_named("b", b"three")
+        s = fmt.LazyStore(p)
+        assert s.read("a") == b"two" and s.read("b") == b"three"
+        # both engines agree on offsets: native writer, python reader
+        with fmt.Writer(str(tmp_path / "n.jtsf"), native=True) as w:
+            w.append(b"x" * 37)
+            w.append_named("n", b"payload")
+        assert fmt.LazyStore(str(tmp_path / "n.jtsf")).read("n") == b"payload"
+
+    def test_read_block_at_detects_corruption(self, tmp_path):
+        p = str(tmp_path / "f.jtsf")
+        with fmt.Writer(p, native=False) as w:
+            off = w.append_named("x", b"sensitive")
+        data = bytearray(open(p, "rb").read())
+        data[off + 10] ^= 0xFF  # flip a payload bit in the named block
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(fmt.CorruptBlock):
+            fmt.read_block_at(p, off)
+
     def test_history_chunks(self, tmp_path):
         h = cas_register_history(500, concurrency=4, seed=1)
         p = str(tmp_path / "h.jtsf")
