@@ -7,6 +7,8 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
 
   easy     10k ops, window ~12            (round-1 headline, comparability)
   hard     10k ops, window >= 64, crash-heavy: capacity escalation territory
+  ceiling  ghost-write burst that must blow past max capacity: clean,
+           *timed* degradation to an unknown verdict at the 65536 ceiling
   refuted  10k ops with corrupted reads: early-exit on the failing prefix
   batch    check_batch throughput over short per-key histories -> hist/sec
 
@@ -34,6 +36,13 @@ TARGET_S = 60.0
 CHUNK = 512
 BATCH_N = 16 if SMOKE else 96
 BATCH_OPS = 200
+
+
+def progress(msg: str) -> None:
+    """Phase marker on stderr so a long bench run is diagnosable live (the
+    JSON contract allows only the one final stdout line)."""
+    print(f"[bench +{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def timed_runs(fn, n):
@@ -105,7 +114,7 @@ def main():
     from jepsen_tpu.models import CASRegister, get_model
     from jepsen_tpu.parallel.batch import check_batch
     from jepsen_tpu.synth import (cas_register_history, corrupt_reads,
-                                  doomed_cas_padding)
+                                  doomed_cas_padding, ghost_write_burst)
     from jepsen_tpu.history import History
 
     model = get_model("cas-register")
@@ -114,25 +123,36 @@ def main():
     easy = cas_register_history(N_OPS, concurrency=8, crash_p=0.0003,
                                 seed=2026)
     # Hard tier: 48 never-linearizable crashed CAS ops pin the window >= 64
-    # (per-round cost is O(capacity * window)), and a crash-heavy seed forces
-    # capacity escalation (each pending crashed write doubles the reachable
-    # configuration set).
-    n_pad, hard_conc = (16, 8) if SMOKE else (48, 16)
+    # (per-round cost is O(capacity * window)), and crashes drive capacity
+    # escalation (each pending crashed write doubles the reachable
+    # configuration set) — sized so the search still CONCLUDES below the
+    # ceiling; unbounded ghost pileups get their own ceiling tier below.
+    n_pad, hard_conc = (16, 8) if SMOKE else (48, 10)
     pad = doomed_cas_padding(n_pad)
     hard_work = cas_register_history(N_OPS, concurrency=hard_conc,
-                                     crash_p=0.0012, seed=11)
+                                     crash_p=0.0008, seed=11)
     hard = History(pad + list(hard_work), reindex=True)
+    # Ceiling tier: 18 pending ghost writes need >= 2^18 configurations —
+    # past any ceiling here; measures how fast the engine escalates through
+    # the whole capacity ladder and degrades cleanly to unknown.
+    ceiling = History(
+        ghost_write_burst(4 if SMOKE else 18)
+        + list(cas_register_history(200, concurrency=4, crash_p=0.0,
+                                    seed=3)),
+        reindex=True)
     refuted = corrupt_reads(
-        cas_register_history(N_OPS, concurrency=8, crash_p=0.001, seed=4),
+        cas_register_history(N_OPS, concurrency=8, crash_p=0.0005, seed=4),
         n=2, seed=4)
 
     prep_easy = prepare(easy, model)
     prep_hard = prepare(hard, model)
+    prep_ceiling = prepare(ceiling, model)
     prep_refuted = prepare(refuted, model)
 
     # --- warm-up: compile each engine shape the tiers can reach ------------
+    progress("warm-up compiles")
     warm = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)
-    for prep in (prep_easy, prep_hard, prep_refuted):
+    for prep in (prep_easy, prep_hard, prep_ceiling, prep_refuted):
         window = wgl_tpu._round_window(prep.window)
         wp = prepare(warm, model)
         wp.window = max(wp.window, window)
@@ -150,6 +170,7 @@ def main():
     setup_s = round(time.time() - t_setup, 1)
 
     # --- CPU baseline (measured, this machine) -----------------------------
+    progress(f"cpu baseline (timeout {CPU_TIMEOUT_S:.0f}s per size)")
     cpu = cpu_tier(CASRegister(), {
         "200": cas_register_history(200, concurrency=8, crash_p=0.003,
                                     seed=1),
@@ -160,24 +181,36 @@ def main():
 
     # --- device tiers ------------------------------------------------------
     easy_cap, hard_cap = (4096, 4096) if SMOKE else (16384, 65536)
+    progress("easy tier")
     r_easy, easy_runs = timed_runs(
         lambda: wgl_tpu.check(model, easy, prepared=prep_easy, capacity=1024,
                               chunk=CHUNK, max_capacity=easy_cap), 3)
     assert r_easy["valid"] is True, r_easy
+    progress("hard tier")
     r_hard, hard_runs = timed_runs(
         lambda: wgl_tpu.check(model, hard, prepared=prep_hard, capacity=1024,
                               chunk=CHUNK, max_capacity=hard_cap), 2)
+    progress("ceiling tier")
+    r_ceil, ceil_runs = timed_runs(
+        lambda: wgl_tpu.check(model, ceiling, prepared=prep_ceiling,
+                              capacity=1024, chunk=CHUNK,
+                              max_capacity=hard_cap), 1)
+    if not SMOKE:
+        assert r_ceil["valid"] == "unknown", r_ceil
+    progress("refuted tier")
     r_ref, ref_runs = timed_runs(
         lambda: wgl_tpu.check(model, refuted, prepared=prep_refuted,
                               capacity=1024, chunk=CHUNK, explain=False), 2)
     assert r_ref["valid"] is False, r_ref
 
+    progress("batch tier")
     t0 = time.time()
     batch_res = check_batch(model, batch_hs)
     batch_wall = time.time() - t0
     n_false = sum(1 for r in batch_res if r["valid"] is False)
     assert n_false == BATCH_N // 4, [r["valid"] for r in batch_res]
 
+    progress("second-process setup probe")
     setup2_s = second_process_setup()
 
     wall = statistics.median(easy_runs)
@@ -206,6 +239,10 @@ def main():
                      "max_capacity_reached": r_hard.get(
                          "max-capacity-reached"),
                      "error": r_hard.get("error")},
+            "ceiling": {"runs": ceil_runs, "window": prep_ceiling.window,
+                        "valid": r_ceil["valid"],
+                        "configs_explored": r_ceil.get("configs-explored"),
+                        "error": r_ceil.get("error")},
             "refuted": {"runs": ref_runs,
                         "failed_op_index": r_ref["op"]["index"],
                         "configs_explored": r_ref.get("configs-explored")},

@@ -51,10 +51,23 @@ class PreparedHistory:
     a: np.ndarray           # int32 operand
     b: np.ndarray           # int32 operand
     op_id: np.ndarray       # int32, index into ``ops`` (invocation order)
+    ghost: np.ndarray       # int32 0/1: ENTER of an op that never returns
+                            # (info/crashed) — enables ghost-bit subsumption
+    gcls: np.ndarray        # int32: ghost equivalence class (slot of the
+                            # first ghost with the same (f,a,b) encoding);
+                            # -1 for non-ghost events.  Same-encoding ghosts
+                            # are interchangeable, so engines canonicalize
+                            # a config's ghost bits to per-class counts.
+    grank: np.ndarray       # int32: this ghost's index within its class
+    gpos: np.ndarray        # int32: compact ghost bit position, grouped by
+                            # class (class offset + rank) — ghost state
+                            # packs into ceil(n_ghosts/32) sort words
+                            # instead of ceil(window/32)
     # Scalars / host-side:
     window: int             # number of slots ever needed (max concurrency)
     ops: List[Op]           # participating ops, invocation order
     crashed_slots: Tuple[int, ...]  # slots held forever by info ops
+    n_ghosts: int = 0       # total crashed ops (= compact ghost bits)
 
     @property
     def n_ops(self) -> int:
@@ -80,13 +93,14 @@ def prepare(history: History,
     h = history.client_ops().complete()
     pairs = h.pair_index()
 
-    events: List[Tuple[int, int, int, int, int, int]] = []
+    events: List[Tuple[int, ...]] = []
     ops: List[Op] = []
     free: List[int] = []
     next_slot = 0
     slot_of: dict = {}      # history position of invoke -> slot
     opid_of: dict = {}      # history position of invoke -> op_id
     crashed: List[int] = []
+    gclasses: dict = {}     # (f, a, b) -> [ghost slots, in enter order]
     pure_fs: Set[int] = set(model.pure_read_fs) if model else set()
 
     def alloc_slot() -> int:
@@ -115,15 +129,29 @@ def prepare(history: History,
             s = alloc_slot()
             slot_of[i] = s
             opid_of[i] = len(ops)
-            events.append((EV_ENTER, s, f, a, b, len(ops)))
-            ops.append(op)
             if ctype == INFO:
+                # Class key: the op's semantics.  With a model, the int32
+                # encoding; without (host tier), the raw (f, value) — the
+                # all-zero placeholder encodings must not merge classes.
+                key = (f, a, b) if model is not None else (op.f,
+                                                          repr(op.value))
+                members = gclasses.setdefault(key, [])
+                cls, rank = (members[0] if members else s), len(members)
+                members.append(s)
+                # gpos (col 9) is a placeholder here; class-grouped compact
+                # positions are assigned once all class sizes are known.
+                events.append((EV_ENTER, s, f, a, b, len(ops), 1, cls, rank,
+                               0))
                 crashed.append(s)
+            else:
+                events.append((EV_ENTER, s, f, a, b, len(ops), 0, -1, 0, 0))
+            ops.append(op)
         elif op.type == OK:
             j = pairs[i]
             if j in slot_of:
                 s = slot_of[j]
-                events.append((EV_RETURN, s, 0, 0, 0, opid_of[j]))
+                events.append((EV_RETURN, s, 0, 0, 0, opid_of[j], 0, -1, 0,
+                               0))
                 free.append(s)
         # FAIL completions: pair already skipped. INFO completions: op stays.
 
@@ -132,9 +160,23 @@ def prepare(history: History,
             f"history needs {next_slot} pending-window slots "
             f"(> max {max_window}); raise max_window or shard the history")
 
-    cols = np.array(events, np.int32).reshape(-1, 6)
+    # Compact ghost positions: classes get contiguous ranges in discovery
+    # order, each ghost at (class offset + rank).
+    offsets: dict = {}
+    off = 0
+    for key, members in gclasses.items():
+        offsets[key] = off
+        off += len(members)
+    class_off = {members[0]: offsets[key]
+                 for key, members in gclasses.items()}
+    events = [e[:9] + (class_off[e[7]] + e[8],) if e[6] else e
+              for e in events]
+
+    cols = np.array(events, np.int32).reshape(-1, 10)
     return PreparedHistory(
         kind=cols[:, 0], slot=cols[:, 1], f=cols[:, 2],
-        a=cols[:, 3], b=cols[:, 4], op_id=cols[:, 5],
+        a=cols[:, 3], b=cols[:, 4], op_id=cols[:, 5], ghost=cols[:, 6],
+        gcls=cols[:, 7], grank=cols[:, 8], gpos=cols[:, 9],
         window=next_slot, ops=ops, crashed_slots=tuple(crashed),
+        n_ghosts=off,
     )

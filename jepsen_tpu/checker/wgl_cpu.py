@@ -45,15 +45,21 @@ def check(model: Model, history: History,
     p = prepared if prepared is not None else prepare(history)
     window: Dict[int, Op] = {}         # slot -> pending op
     configs: Set[Config] = {(0, model)}
+    ghost_mask = 0                     # slots held by ops that never return
+    gclasses: Dict[int, List[int]] = {}  # class id -> member slots, in order
     n_explored = 0
 
     for e in range(len(p)):
         kind, slot, op_id = int(p.kind[e]), int(p.slot[e]), int(p.op_id[e])
         if kind == EV_ENTER:
             window[slot] = p.ops[op_id]
+            if int(p.ghost[e]):
+                ghost_mask |= 1 << slot
+                gclasses.setdefault(int(p.gcls[e]), []).append(slot)
             continue
         # RETURN: expand closure, then prune on the returning op's bit.
-        configs = _closure(configs, window, max_configs, cancel)
+        configs = _closure(configs, window, max_configs, cancel,
+                           ghost_mask, gclasses)
         n_explored += len(configs)
         bit = 1 << slot
         survivors = {(mask & ~bit, m) for (mask, m) in configs if mask & bit}
@@ -78,15 +84,57 @@ def check(model: Model, history: History,
 
 
 def _closure(configs: Set[Config], window: Dict[int, Op],
-             max_configs: int, cancel=None) -> Set[Config]:
-    seen = set(configs)
-    frontier = configs
+             max_configs: int, cancel=None,
+             ghost_mask: int = 0,
+             gclasses: Optional[Dict[int, List[int]]] = None) -> Set[Config]:
+    """BFS closure with ghost-bit subsumption: a config is skipped when the
+    set already holds one with the same non-ghost mask and state whose
+    ghost bitset is a subset — ghost ops (crashed, never returning) are
+    never consulted by pruning, and the kept config can re-derive the
+    skipped one at any later closure.  Same-encoding ghosts are further
+    canonicalized to per-class counts (they are interchangeable).
+    Collapses the 2^crashes blowup to O(crashes) (mirrors the device
+    engine's subsumption dedup)."""
+    # (non-ghost mask, model) -> kept ghost bitsets (approximate antichain)
+    groups: Dict[Tuple[int, Model], List[int]] = {}
+    n = 0
+
+    def canonical(g: int) -> int:
+        for members in (gclasses or {}).values():
+            cnt = sum(1 for s in members if g & (1 << s))
+            for i, s in enumerate(members):
+                if i < cnt:
+                    g |= 1 << s
+                else:
+                    g &= ~(1 << s)
+        return g
+
+    def try_add(mask: int, m: Model) -> bool:
+        nonlocal n
+        g = canonical(mask & ghost_mask)
+        key = (mask & ~ghost_mask, m)
+        kept = groups.get(key)
+        if kept is None:
+            groups[key] = [g]
+            n += 1
+            return True
+        for k in kept:
+            if k & ~g == 0:  # k ⊆ g: subsumed (or exact duplicate)
+                return False
+        kept.append(g)
+        n += 1
+        return True
+
+    frontier: List[Config] = []
+    for mask, m in configs:
+        if try_add(mask, m):
+            frontier.append((mask, m))
     while frontier:
         # Closure is the dominant cost (up to max_configs states), so a
         # cancelled race must abort here, not just at RETURN boundaries.
         if cancel is not None and cancel.is_set():
             raise Cancelled()
-        new: Set[Config] = set()
+        new: List[Config] = []
         for mask, m in frontier:
             for slot, op in window.items():
                 bit = 1 << slot
@@ -95,14 +143,12 @@ def _closure(configs: Set[Config], window: Dict[int, Op],
                 m2 = m.step(op)
                 if isinstance(m2, Inconsistent):
                     continue
-                c2 = (mask | bit, m2)
-                if c2 not in seen:
-                    seen.add(c2)
-                    new.add(c2)
-                    if len(seen) > max_configs:
-                        raise SearchExploded(len(seen))
+                if try_add(mask | bit, m2):
+                    new.append((mask | bit, m2))
+                    if n > max_configs:
+                        raise SearchExploded(n)
         frontier = new
-    return seen
+    return {(bm | g, m) for (bm, m), gs in groups.items() for g in gs}
 
 
 class SearchExploded(Exception):

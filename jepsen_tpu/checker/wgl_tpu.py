@@ -14,10 +14,22 @@ fixed-shape device buffers:
   vmap applies the model step to every (configuration × pending op) pair —
   [C, W] parallel model steps per round — then the union is deduplicated and
   compacted by a multi-key sort (ops/dedup.py).  Closure repeats to fixpoint
-  (count-stable), then configurations lacking the returning op are pruned.
+  (no genuinely-new kept candidate), then configurations lacking the
+  returning op are pruned.
 - Closure is skipped when the set is already closed: pruning on a bit
   preserves closedness (expansions of a surviving configuration also carried
   the bit), so closure is only needed after new ENTERs — the ``dirty`` flag.
+- **Ghost subsumption** (the algorithmic contribution that moves the
+  practical ceiling): slots held by *ghost* ops — crashed/info ops that
+  never return — are never consulted by pruning, so (a) ghosts with equal
+  op encodings are interchangeable and a config's ghost bits canonicalize
+  to per-class counts, and (b) a config is dropped when one with the same
+  non-ghost mask and state holds a subset of its ghost bits (it has a
+  superset of the dropped config's futures and can re-derive it at any
+  later closure).  Classic configuration search pays 2^crashes — the
+  precise regime where the reference's knossos dies and histories must be
+  kept short (jepsen/src/jepsen/independent.clj:1-7); with subsumption the
+  cost is the antichain of ghost-count vectors, typically O(crashes).
 
 Single-history frontier sharding across a device mesh lives in
 jepsen_tpu.parallel; this module is mesh-agnostic but takes an optional
@@ -51,21 +63,28 @@ EV_NOP = 2
 LOOKAHEAD = 2
 
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-#          overflow, explored, rounds, peak)
+#          overflow, explored, rounds, peak, ghosts)
 # peak is the high-water mark of the distinct-configuration count since the
 # driver last reset it: the capacity the search *actually* needed, which the
 # host reads at chunk boundaries to pick the cheapest sufficient engine.
+# ghosts is the uint32[MW] bitmask of window slots held by ops that never
+# return (crashed/info ops): closure dedup subsumes on it (see closure).
 
 
 def make_engine(model: JaxModel, window: int, capacity: int,
-                axis_name: Optional[str] = None, num_shards: int = 1):
+                axis_name: Optional[str] = None, num_shards: int = 1,
+                gwords: int = 1):
     """Build the jittable (carry0, event_step, run_chunk) triple.
 
     ``window`` may be any positive slot count (candidate-row count — and so
     closure sort cost — scales with it, so callers pass the tightest window
     the history needs).  With ``axis_name``, buffers are device-local shards
     of a global set of ``capacity * num_shards`` configurations and closure
-    dedup synchronizes via all_gather.
+    dedup synchronizes via all_gather.  ``gwords`` is the number of compact
+    ghost words (>= ceil(n_ghosts / 32) for the history being checked):
+    ghost subsumption state sorts as ``gwords`` columns, not ceil(W/32) —
+    keeping the big variadic sort narrow (wide sorts at high capacity have
+    crashed the TPU compiler).
     """
     assert window > 0
     try:
@@ -102,7 +121,68 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     def global_sum(x):
         return lax.psum(x, axis_name) if axis_name else x
 
-    def closure(mask, states, valid, win_ops, active, overflow):
+    # Per-slot word index / shift for 2D bit extraction (the [N, W, MW]
+    # broadcast form would materialize gigabytes at large C*(W+1)).
+    GW = gwords
+    word_of = jnp.arange(W) // 32
+    shift_of = (jnp.arange(W) % 32).astype(jnp.uint32)
+
+    def canonical_compact(mask_words, win_ops):
+        """Canonical *compact* ghost state per row: same-encoding ghosts
+        are interchangeable (identical step functions, none ever returns),
+        so only the per-class COUNT of linearized ghosts matters.  The
+        canonical form sets, for each class, the first ``count`` bits of
+        the class's contiguous range in a ceil(n_ghosts/32)-word compact
+        layout (prep assigns ``gpos`` = class offset + rank)."""
+        cls = win_ops[:, 3]                  # [W] class id (slot) or -1
+        rank = win_ops[:, 4]                 # [W] rank within class
+        gpos = win_ops[:, 5]                 # [W] compact bit position
+        is_g = cls >= 0
+        bits = (jnp.take(mask_words, word_of, axis=1)
+                >> shift_of[None, :]) & 1
+        # counts[n, c] = number of class-c ghost bits set in row n (matmul
+        # on the MXU; counts <= W, exact in float32)
+        onehot = ((cls[None, :] == jnp.arange(W)[:, None]) &
+                  is_g[None, :]).astype(jnp.float32)       # [W(cls), W(slot)]
+        counts = bits.astype(jnp.float32) @ onehot.T       # [N, W]
+        cnt_for_slot = jnp.take(counts, jnp.clip(cls, 0, W - 1), axis=1)
+        cbits = (is_g[None, :] & (rank[None, :].astype(jnp.float32)
+                                  < cnt_for_slot)).astype(jnp.uint32)
+        out = []
+        for j in range(GW):
+            w = jnp.where(is_g & (gpos // 32 == j),
+                          jnp.left_shift(jnp.uint32(1),
+                                         (gpos % 32).astype(jnp.uint32)),
+                          jnp.uint32(0))
+            out.append((cbits * w[None, :]).sum(1, dtype=jnp.uint32))
+        return jnp.stack(out, axis=-1)                     # [N, GW]
+
+    def expand_compact(compact, win_ops):
+        """Inverse of :func:`canonical_compact`: slot-space ghost words
+        from a compact row (bit gpos[s] -> slot bit s)."""
+        cls = win_ops[:, 3]
+        gpos = win_ops[:, 5]
+        is_g = cls >= 0
+        word = jnp.take(compact, jnp.clip(gpos // 32, 0, GW - 1), axis=1)
+        bits = ((word >> (gpos % 32).astype(jnp.uint32)[None, :]) & 1) \
+            * is_g[None, :].astype(jnp.uint32)
+        out = []
+        for i in range(MW):
+            sl = slice(32 * i, min(32 * i + 32, W))
+            powers = (jnp.uint32(1) << shift_of[sl])
+            out.append((bits[:, sl] * powers[None, :]).sum(
+                1, dtype=jnp.uint32))
+        return jnp.stack(out, axis=-1)                     # [N, MW]
+
+    def closure(mask, states, valid, win_ops, active, ghosts, overflow):
+        # Dedup treats the ghost-slot part of the mask as a *subsumption*
+        # column, not an identity column: ghost ops never return, so their
+        # bits are never consulted by pruning, and a config whose ghost set
+        # contains another's (same non-ghost mask, same state) has a subset
+        # of its futures and is re-derivable from it at any later closure.
+        # Together with per-class canonicalization this turns the
+        # 2^crashes configuration blowup that kills knossos into
+        # O(crashes) — see BENCH ghost tiers.
         count0 = global_sum(valid.sum())
 
         def cond(c):
@@ -119,24 +199,35 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             all_mask = jnp.concatenate([mask, cand_mask.reshape(C * W, MW)])
             all_states = jnp.concatenate([states, cand_states.reshape(C * W, S)])
             all_valid = jnp.concatenate([valid, cand_valid.reshape(C * W)])
+            origin = jnp.concatenate([jnp.zeros(C, jnp.int32),
+                                      jnp.ones(C * W, jnp.int32)])
             if axis_name is not None:
                 all_mask = lax.all_gather(all_mask, axis_name, tiled=True)
                 all_states = lax.all_gather(all_states, axis_name, tiled=True)
                 all_valid = lax.all_gather(all_valid, axis_name, tiled=True)
-            cols = ([all_mask[:, i] for i in range(MW)]
+                origin = lax.all_gather(origin, axis_name, tiled=True)
+            keyed = all_mask & ~ghosts[None, :]
+            gpart = canonical_compact(all_mask & ghosts[None, :], win_ops)
+            cols = ([keyed[:, i] for i in range(MW)]
                     + [all_states[:, i] for i in range(S)])
+            gcols = [gpart[:, i] for i in range(GW)]
             gcap = C * num_shards
-            out_cols, out_valid, total, ovf2 = sort_dedup_compact(
-                cols, all_valid, gcap)
-            new_mask = jnp.stack(out_cols[:MW], -1)
-            new_states = jnp.stack(out_cols[MW:], -1)
+            out_cols, out_valid, total, ovf2, new_rows = \
+                sort_dedup_compact(cols, all_valid, gcap,
+                                   ghost_cols=gcols, origin=origin)
+            new_keyed = jnp.stack(out_cols[:MW], -1)
+            new_states = jnp.stack(out_cols[MW:MW + S], -1)
+            new_compact = jnp.stack(out_cols[MW + S:], -1)
+            new_mask = new_keyed | expand_compact(new_compact, win_ops)
             if axis_name is not None:
                 start = lax.axis_index(axis_name) * C
                 new_mask = lax.dynamic_slice_in_dim(new_mask, start, C)
                 new_states = lax.dynamic_slice_in_dim(new_states, start, C)
                 out_valid = lax.dynamic_slice_in_dim(out_valid, start, C)
-            changed = total > count
-            return (new_mask, new_states, out_valid, total, changed,
+            # Fixpoint signal: a kept candidate, NOT a count delta —
+            # subsumption can drop an existing row in the round that adds a
+            # new one, leaving the count level while the set moved.
+            return (new_mask, new_states, out_valid, total, new_rows,
                     ovf | ovf2, it + 1)
 
         init = (mask, states, valid, count0, jnp.bool_(True), overflow,
@@ -147,26 +238,35 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-         overflow, explored, rounds, peak) = carry
-        kind, slot, f, a, b, op_id = (ev[0], ev[1], ev[2], ev[3], ev[4], ev[5])
+         overflow, explored, rounds, peak, ghosts) = carry
+        kind, slot, f, a, b, op_id, is_ghost, gcls, grank, gpos = (
+            ev[0], ev[1], ev[2], ev[3], ev[4], ev[5], ev[6], ev[7], ev[8],
+            ev[9])
         alive = ~failed & ~overflow
 
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak) = c
-            win_ops2 = win_ops.at[slot].set(jnp.stack([f, a, b]))
+             overflow, explored, rounds, peak, ghosts) = c
+            win_ops2 = win_ops.at[slot].set(
+                jnp.stack([f, a, b, gcls, grank, gpos]))
             active2 = active.at[slot].set(True)
+            # A crashed op holds its slot forever; its bit becomes a
+            # subsumption column in closure dedup.  (Slots of crashed ops
+            # are never freed, so the bit can't later mean a live op.)
+            ghosts2 = jnp.where(is_ghost == 1,
+                                ghosts | slot_bitmask(slot), ghosts)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
-                    failed, failed_op, overflow, explored, rounds, peak)
+                    failed, failed_op, overflow, explored, rounds, peak,
+                    ghosts2)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak) = c
+             overflow, explored, rounds, peak, ghosts) = c
 
             def with_closure(args):
                 mask, states, valid, overflow, explored, rounds, peak = args
                 mask, states, valid, count, overflow, iters = closure(
-                    mask, states, valid, win_ops, active, overflow)
+                    mask, states, valid, win_ops, active, ghosts, overflow)
                 return (mask, states, valid, overflow, explored + count,
                         rounds + iters, jnp.maximum(peak, count))
 
@@ -184,7 +284,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             active2 = active.at[slot].set(False)
             return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
                     failed | newly_failed, failed_op2, overflow, explored,
-                    rounds, peak)
+                    rounds, peak, ghosts)
 
         new_carry = lax.cond(
             alive,
@@ -192,13 +292,18 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             lambda c: c, carry)
         return new_carry, None
 
+    def _init_win_ops(w):
+        # columns: f, a, b, ghost-class (-1 = not a ghost), ghost-rank,
+        # compact ghost bit position
+        return jnp.zeros((w, 6), jnp.int32).at[:, 3].set(-1)
+
     def carry0():
         states = jnp.tile(jnp.asarray(model.init_state_array())[None, :], (C, 1))
         return (jnp.zeros((C, MW), jnp.uint32),            # mask
                 states,                                    # states
                 jnp.arange(C) == 0 if axis_name is None    # valid: one config
                 else None,                                 # (set by caller)
-                jnp.zeros((W, 3), jnp.int32),              # win_ops
+                _init_win_ops(W),                          # win_ops
                 jnp.zeros(W, dtype=bool),                  # active
                 jnp.bool_(False),                          # dirty
                 jnp.bool_(False),                          # failed
@@ -206,7 +311,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.bool_(False),                          # overflow
                 jnp.int32(0),                              # explored
                 jnp.int32(0),                              # closure rounds
-                jnp.int32(1))                              # peak config count
+                jnp.int32(1),                              # peak config count
+                jnp.zeros(MW, jnp.uint32))                 # ghost slots
 
     def run_chunk(carry, events):
         # Reset the peak to the live count on entry (device-side: the host
@@ -214,7 +320,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # and pack the scalars the host polls into ONE int32 vector so a
         # chunk boundary costs a single device→host transfer.
         live0 = global_sum(carry[2].sum()).astype(jnp.int32)
-        carry = carry[:11] + (live0,)
+        carry = carry[:11] + (live0,) + carry[12:]
         carry, _ = lax.scan(event_step, carry, events)
         flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
                            carry[8].astype(jnp.int32),   # overflow
@@ -229,16 +335,32 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 # ---------------------------------------------------------------------------
 
 _ENGINE_CACHE: Dict[Tuple, Any] = {}
+_SLICE_CACHE: Dict[int, Any] = {}
 
 
-def _get_run_chunk(model: JaxModel, window: int, capacity: int):
+def _chunk_slicer(chunk: int, axis: int = 0):
+    """Jitted device-side slicer (index traced, not baked): one compile per
+    (chunk size, axis), zero host->device payload per dispatch.  Static
+    python slice bounds would instead compile one slice op per chunk
+    *index*."""
+    key = (chunk, axis)
+    if key not in _SLICE_CACHE:
+        _SLICE_CACHE[key] = jax.jit(
+            lambda buf, i: lax.dynamic_slice_in_dim(buf, i, chunk, axis))
+    return _SLICE_CACHE[key]
+
+
+def _get_run_chunk(model: JaxModel, window: int, capacity: int,
+                   gwords: int = 1):
     # Same-named registry models share step semantics; keying on the name +
     # initial state (not the closure id) lets every get_model() call reuse
     # one compiled engine.
     key = (model.name, model.state_size,
-           tuple(model.init_state_array().tolist()), window, capacity)
+           tuple(model.init_state_array().tolist()), window, capacity,
+           gwords)
     if key not in _ENGINE_CACHE:
-        carry0, _, run_chunk = make_engine(model, window, capacity)
+        carry0, _, run_chunk = make_engine(model, window, capacity,
+                                           gwords=gwords)
         # No donation: the overflow-resume path re-uses the chunk-boundary
         # carry snapshot after the call, and the buffers are small anyway.
         _ENGINE_CACHE[key] = (carry0, jax.jit(run_chunk))
@@ -246,10 +368,10 @@ def _get_run_chunk(model: JaxModel, window: int, capacity: int):
 
 
 def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
-    """[E_padded, 6] int32 event stream, NOP-padded to a chunk multiple."""
+    """[E_padded, 10] int32 event stream, NOP-padded to a chunk multiple."""
     e = len(p)
     ep = max(chunk, ((e + chunk - 1) // chunk) * chunk)
-    ev = np.full((ep, 6), 0, np.int32)
+    ev = np.full((ep, 10), 0, np.int32)
     ev[:, 0] = EV_NOP
     ev[:e, 0] = p.kind
     ev[:e, 1] = p.slot
@@ -257,7 +379,16 @@ def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
     ev[:e, 3] = p.a
     ev[:e, 4] = p.b
     ev[:e, 5] = p.op_id
+    ev[:e, 6] = p.ghost
+    ev[:e, 7] = p.gcls
+    ev[:e, 8] = p.grank
+    ev[:e, 9] = p.gpos
     return ev
+
+
+def ghost_words(p: PreparedHistory) -> int:
+    """Compact ghost words an engine needs for this history."""
+    return max(1, (int(p.n_ghosts) + 31) // 32)
 
 
 #: Configuration budget for the CPU witness re-derivation on refuted
@@ -272,7 +403,8 @@ def check(model: JaxModel, history: Optional[History] = None,
           capacity: int = 1024, max_capacity: int = 65536,
           chunk: int = 512, max_window: int = 4096,
           explain: bool = True, cancel=None,
-          witness_budget: int = WITNESS_BUDGET) -> Dict[str, Any]:
+          witness_budget: int = WITNESS_BUDGET,
+          growth: int = 4) -> Dict[str, Any]:
     """Decide linearizability on device.  Retries with larger configuration
     capacity on overflow; falls back to ``valid: "unknown"`` past
     ``max_capacity``.  On refutation, optionally re-derives a witness on the
@@ -300,10 +432,17 @@ def check(model: JaxModel, history: Optional[History] = None,
     window = _round_window(p.window)
     ev = events_array(p, chunk)
     n_chunks = ev.shape[0] // chunk
+    # One host->device transfer for the whole stream; per-chunk slices then
+    # happen device-side.  A per-chunk jnp.asarray would be a blocking
+    # ~12 KB RPC per dispatch — on a tunneled device that synchronous
+    # transfer, not compute, dominated the easy-history wall-clock.
+    ev_dev = jnp.asarray(ev)
+    slice_chunk = _chunk_slicer(chunk)
 
+    gw = ghost_words(p)
     cap = capacity
     max_cap_reached = cap  # diagnostics: how far escalation actually went
-    carry0, run_chunk = _get_run_chunk(model, window, cap)
+    carry0, run_chunk = _get_run_chunk(model, window, cap, gw)
     carry = carry0()
     recent_peaks: deque = deque(maxlen=4)  # per-chunk high-water marks
     # Pipelined dispatch: keep LOOKAHEAD chunks in flight so the (possibly
@@ -326,7 +465,7 @@ def check(model: JaxModel, history: Optional[History] = None,
         while len(inflight) < LOOKAHEAD and next_ci < n_chunks:
             prev = carry
             carry, flags = run_chunk(
-                carry, jnp.asarray(ev[next_ci * chunk:(next_ci + 1) * chunk]))
+                carry, slice_chunk(ev_dev, next_ci * chunk))
             inflight.append((next_ci, prev, carry, flags))
             next_ci += 1
         if not inflight:
@@ -341,11 +480,11 @@ def check(model: JaxModel, history: Optional[History] = None,
             # been clipped — so the loop can escalate again) and resume from
             # the snapshot: no restart, no re-search of the prefix.
             while cap < max_capacity and cap < 2 * peak:
-                cap = min(cap * 4, max_capacity)
+                cap = min(cap * growth, max_capacity)
             max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
-            _, run_chunk = _get_run_chunk(model, window, cap)
+            _, run_chunk = _get_run_chunk(model, window, cap, gw)
             carry = _grow_carry(prev, cap)
             next_ci = ci
             overflow = False
@@ -362,8 +501,8 @@ def check(model: JaxModel, history: Optional[History] = None,
             # cheaper-per-round engine (discarding speculative chunks).
             need = 2 * max(recent_peaks)
             target = cap
-            while target > capacity and target // 4 >= need:
-                target //= 4
+            while target > capacity and target // growth >= need:
+                target //= growth
             # an escalation clamped to max_capacity can sit off the
             # power-of-4 lattice; never shrink below the configured floor
             target = max(target, capacity)
@@ -371,7 +510,7 @@ def check(model: JaxModel, history: Optional[History] = None,
                 cap = target
                 recent_peaks.clear()
                 inflight.clear()
-                _, run_chunk = _get_run_chunk(model, window, cap)
+                _, run_chunk = _get_run_chunk(model, window, cap, gw)
                 carry = _shrink_carry(after, cap)
                 next_ci = ci + 1
     carry = done

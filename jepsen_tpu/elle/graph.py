@@ -60,6 +60,30 @@ class Graph:
         return len(self.nodes)
 
 
+def peeled_cycles(g: Graph):
+    """Yield node-disjoint cycles across the whole graph.
+
+    ``find_cycle`` recovers one (shortest) cycle per SCC, but one SCC can
+    merge several distinct anomalies (e.g. a ww 2-cycle bridged to a wr
+    cycle).  After yielding a cycle, its nodes are peeled off and the
+    remainder re-searched, so every node-disjoint cycle in a component is
+    reported (the coverage elle's checkers get from per-SCC re-search)."""
+    for comp in sccs(g):
+        remaining = set(comp)
+        while len(remaining) >= 2:
+            sub = g.subgraph(remaining)
+            cyc = None
+            for c in sccs(sub):
+                if len(c) >= 2:
+                    cyc = find_cycle(sub, c)
+                    if cyc:
+                        break
+            if not cyc:
+                break
+            remaining -= set(cyc)
+            yield cyc
+
+
 def sccs(g: Graph) -> List[List[Any]]:
     """Iterative Tarjan; returns nontrivial SCCs (size >= 2)."""
     index: Dict[Any, int] = {}

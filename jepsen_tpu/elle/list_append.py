@@ -25,7 +25,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
 from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
 
 CYCLE_SEVERITY = ["G0", "G1c", "G-single", "G2-item"]
@@ -162,11 +162,8 @@ def check(history: History, consistency_models: Sequence[str] = ("serializable",
                 if inv2 >= 0 and i1 < inv2:
                     g.add_edge(t1, t2, "realtime")
 
-    # cycles
-    for comp in sccs(g):
-        cyc = find_cycle(g, comp)
-        if not cyc:
-            continue
+    # cycles: peel every node-disjoint cycle out of each SCC
+    for cyc in peeled_cycles(g):
         kinds = cycle_edge_kinds(g, cyc)
         label = classify_cycle(kinds)
         anomalies[label].append({

@@ -38,7 +38,7 @@ import bisect
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
 from jepsen_tpu.elle.list_append import classify_cycle
 from jepsen_tpu.history import FAIL, History, INFO, OK, Op
 from jepsen_tpu.txn import READ_FS, WRITE_FS
@@ -162,10 +162,7 @@ def check(history: History, realtime: bool = False,
                     if inv2 >= 0 and i1 < inv2:
                         g.add_edge(t1, t2, "realtime")
 
-    for comp in sccs(g):
-        cyc = find_cycle(g, comp)
-        if not cyc:
-            continue
+    for cyc in peeled_cycles(g):
         kinds = cycle_edge_kinds(g, cyc)
         anomalies[classify_cycle(kinds)].append({
             "cycle": [txn_of[t] for t in cyc],

@@ -1,0 +1,86 @@
+/* Oscillate the realtime clock, paced by the MONOTONIC clock.
+ *
+ * Usage: strobe-time-mono <delta-ms> <period-ms> <duration-ms>
+ *
+ * The plain strobe-time sleeps a relative period each flip, so loop
+ * overhead and scheduling jitter accumulate phase drift over long strobes.
+ * This variant captures a realtime<->monotonic correspondence once, then
+ * flips on ABSOLUTE monotonic deadlines (clock_nanosleep TIMER_ABSTIME)
+ * and recomputes the target realtime from the monotonic clock at every
+ * flip — the strobe stays phase-accurate for its whole duration however
+ * noisy the scheduler is.  Role of the reference's
+ * resources/strobe-time-experiment.c (independent implementation).
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static const long long NS = 1000000000LL;
+
+static long long to_ns(struct timespec t) {
+  return t.tv_sec * NS + t.tv_nsec;
+}
+
+static struct timespec from_ns(long long ns) {
+  struct timespec t;
+  t.tv_sec = ns / NS;
+  t.tv_nsec = ns % NS;
+  if (t.tv_nsec < 0) {
+    t.tv_nsec += NS;
+    t.tv_sec -= 1;
+  }
+  return t;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-ms>\n",
+            argv[0]);
+    return 2;
+  }
+  long long delta_ns = atoll(argv[1]) * 1000000LL;
+  long long period_ns = atoll(argv[2]) * 1000000LL;
+  long long duration_ns = atoll(argv[3]) * 1000000LL;
+  if (period_ns <= 0) {
+    fprintf(stderr, "period must be positive\n");
+    return 2;
+  }
+
+  struct timespec mono, real;
+  if (clock_gettime(CLOCK_MONOTONIC, &mono) != 0 ||
+      clock_gettime(CLOCK_REALTIME, &real) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+  /* true realtime = monotonic + base, by this one-shot correspondence */
+  long long base = to_ns(real) - to_ns(mono);
+  long long start = to_ns(mono);
+  long long end = start + duration_ns;
+
+  int phase = 1;
+  for (long long deadline = start; deadline < end;
+       deadline += period_ns, phase = !phase) {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    /* derive the target from the monotonic clock, not from the (already
+       strobed) realtime clock, so errors never compound */
+    long long target = to_ns(now) + base + (phase ? delta_ns : 0);
+    struct timespec t = from_ns(target);
+    if (clock_settime(CLOCK_REALTIME, &t) != 0) {
+      perror("clock_settime");
+      return 1;
+    }
+    struct timespec d = from_ns(deadline + period_ns);
+    clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &d, NULL);
+  }
+
+  /* leave the clock on the true timeline */
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  struct timespec t = from_ns(to_ns(now) + base);
+  if (clock_settime(CLOCK_REALTIME, &t) != 0) {
+    perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}

@@ -27,7 +27,7 @@ def install_tools(test) -> None:
     def inst(t, node):
         s = session(t, node).sudo()
         s.exec("mkdir", "-p", REMOTE_DIR)
-        for name in ("bump-time", "strobe-time"):
+        for name in ("bump-time", "strobe-time", "strobe-time-mono"):
             src = os.path.join(NATIVE_DIR, f"{name}.c")
             session(t, node).upload(src, f"/tmp/{name}.c")
             s.exec("gcc", "-O2", "-o", f"{REMOTE_DIR}/{name}",
@@ -53,9 +53,12 @@ def bump_time(test, node: str, delta_ms: int) -> None:
 
 
 def strobe_time(test, node: str, delta_ms: int, period_ms: int,
-                duration_ms: int) -> None:
+                duration_ms: int, mono: bool = False) -> None:
+    """``mono=True`` uses the monotonic-paced variant (phase-accurate over
+    long strobes; the reference's strobe-time-experiment role)."""
+    binary = "strobe-time-mono" if mono else "strobe-time"
     session(test, node).sudo().exec(
-        f"{REMOTE_DIR}/strobe-time", str(delta_ms), str(period_ms),
+        f"{REMOTE_DIR}/{binary}", str(delta_ms), str(period_ms),
         str(duration_ms))
 
 

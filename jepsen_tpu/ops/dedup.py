@@ -22,28 +22,95 @@ import jax.numpy as jnp
 def sort_dedup_compact(cols: Sequence[jnp.ndarray],
                        valid: jnp.ndarray,
                        capacity: int,
-                       ) -> Tuple[List[jnp.ndarray], jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Deduplicate rows described by ``cols`` (each [N], int dtypes) among
-    entries where ``valid`` is True; compact the distinct rows into buffers of
-    ``capacity`` rows.
+                       ghost_cols: Sequence[jnp.ndarray] = (),
+                       origin: jnp.ndarray = None,
+                       ):
+    """Deduplicate rows described by ``cols`` (+ ``ghost_cols``, each [N],
+    int dtypes) among entries where ``valid`` is True; compact the distinct
+    rows into buffers of ``capacity`` rows.
 
-    Returns ``(out_cols, out_valid, total, overflow)`` where ``total`` is the
-    number of distinct valid rows (may exceed capacity — then ``overflow`` is
-    True and the surplus rows were dropped).
+    ``ghost_cols`` enable *subsumption*: rows agreeing on every ``cols``
+    entry form a group, and a member is dropped when the group's head (the
+    sort-first member) has a ghost bitset that is a subset of the member's
+    (checked word-wise: ``head & ~row == 0``).  Soundness (see
+    checker/wgl_tpu.py): ghost bits mark pending ops that never return, so
+    they are never consulted by pruning; a config whose ghost set contains
+    the head's is reachable from the head again at any later closure, and
+    the head has a superset of its futures.  Without ``ghost_cols`` this is
+    plain exact dedup.
+
+    ``origin`` (optional, int32 [N], 1 = newly-generated candidate) is
+    carried as a payload; when given, the return gains a fifth element
+    ``new_rows``: True iff any *kept* row is a candidate.  This — not a
+    count delta — is the sound fixpoint signal for a closure loop, because
+    subsumption can drop existing rows in the same round that adds new
+    ones, leaving the count unchanged while the set moved.
+
+    Returns ``(out_cols, out_valid, total, overflow[, new_rows])`` —
+    ``out_cols`` in the order ``[*cols, *ghost_cols]``; ``total`` is the
+    number of kept rows (may exceed capacity — then ``overflow`` is True
+    and the surplus rows were dropped).
     """
     n = valid.shape[0]
-    # Key 0: invalid rows sort after all valid rows.
+    n_key = len(cols)
+    # Key 0: invalid rows sort after all valid rows.  Ghost columns sort
+    # ascending after the group key, so a numerically-minimal ghost set
+    # (e.g. the empty set) heads its group.  The stable sort keeps an
+    # existing row ahead of an identical candidate, so exact-dup keeps the
+    # existing one and ``new_rows`` stays quiet.
     inv = (~valid).astype(jnp.int32)
-    operands = [inv] + [c for c in cols]
-    sorted_ops = jax.lax.sort(tuple(operands), num_keys=len(operands))
-    s_inv, s_cols = sorted_ops[0], list(sorted_ops[1:])
+    operands = [inv] + list(cols) + list(ghost_cols)
+    if origin is not None:
+        operands.append(origin)
+    sorted_ops = jax.lax.sort(tuple(operands),
+                              num_keys=1 + n_key + len(ghost_cols))
+    s_inv = sorted_ops[0]
+    s_cols = list(sorted_ops[1:1 + n_key])
+    s_ghost = list(sorted_ops[1 + n_key:1 + n_key + len(ghost_cols)])
+    s_origin = sorted_ops[-1] if origin is not None else None
     s_valid = s_inv == 0
 
     same_as_prev = jnp.ones(n, dtype=bool)
     for c in s_cols:
         same_as_prev &= c == jnp.roll(c, 1)
     same_as_prev = same_as_prev.at[0].set(False)
-    keep = s_valid & ~(same_as_prev & jnp.roll(s_valid, 1))
+    exact_same = same_as_prev
+    for c in s_ghost:
+        exact_same &= c == jnp.roll(c, 1)
+    drop = exact_same & jnp.roll(s_valid, 1)
+
+    if s_ghost:
+        # Group head per row: the index where the row's group starts.
+        # (cumsum + scatter/gather, NOT lax.cummax — cummax nested inside
+        # scan/while_loop control flow has crashed the TPU compiler at
+        # ~1M-row shapes; cumsum is already exercised by the compaction.)
+        is_head = s_valid & ~(same_as_prev & jnp.roll(s_valid, 1))
+        idx = jnp.arange(n)
+        seg = jnp.cumsum(is_head.astype(jnp.int32)) - 1
+        head_buf = jnp.zeros(n + 1, jnp.int32).at[
+            jnp.where(is_head, seg, n)].set(idx, mode="drop")
+        head_of = head_buf[jnp.clip(seg, 0, n - 1)]
+        in_group = s_valid & (head_of != idx) & (seg >= 0)
+        # Probe several earlier in-group rows: ANY earlier row with a
+        # subset ghost bitset justifies the drop (its own drop reason, if
+        # dropped, chains down to a kept subset).  A subset sorts before
+        # its supersets, so probing the head plus a few nearby offsets
+        # catches most dominated rows; leftovers only cost capacity.
+        import os as _os
+        probes = [jnp.maximum(head_of, 0)]
+        n_probes = int(_os.environ.get("JTPU_PROBES", "5"))
+        for off in (1, 2, 4, 8, 16)[:n_probes]:
+            probes.append(jnp.maximum(idx - off,
+                                      jnp.maximum(head_of, 0)))
+        subsumed = jnp.zeros(n, dtype=bool)
+        for pr in probes:
+            hit = in_group & (pr != idx)
+            for c in s_ghost:
+                hit &= (c[pr] & ~c) == 0
+            subsumed |= hit
+        drop = drop | subsumed
+
+    keep = s_valid & ~drop
 
     pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
     total = pos[-1] + 1
@@ -51,8 +118,11 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
     dest = jnp.where(keep & (pos < capacity), pos, capacity)
 
     out_cols = []
-    for c in s_cols:
+    for c in s_cols + s_ghost:
         buf = jnp.zeros(capacity + 1, dtype=c.dtype)
         out_cols.append(buf.at[dest].set(c, mode="drop")[:capacity])
     out_valid = jnp.arange(capacity) < jnp.minimum(total, capacity)
-    return out_cols, out_valid, total, overflow
+    if origin is None:
+        return out_cols, out_valid, total, overflow
+    new_rows = jnp.any(keep & (s_origin == 1))
+    return out_cols, out_valid, total, overflow, new_rows

@@ -17,7 +17,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import EV_NOP, events_array, make_engine
+from jepsen_tpu.checker.wgl_tpu import (EV_NOP, events_array, ghost_words,
+                                        make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
@@ -53,13 +54,14 @@ def check_batch(model: JaxModel,
     # overflowed are regrouped into a smaller batch and re-run at an
     # escalated capacity — one deep lane no longer makes every lane pay
     # the O(C·W) closure cost of the rare worst case.
+    gw = max(ghost_words(p) for p in preps)
     out: List[Optional[Dict[str, Any]]] = [None] * len(evs)
     lanes = list(range(len(evs)))
     cap = capacity
     while lanes:
         res = _run_lanes(model, [evs[i] for i in lanes],
                          [preps[i] for i in lanes],
-                         window, cap, mesh, axis, chunk)
+                         window, cap, mesh, axis, chunk, gw)
         retry = []
         for lane, r in zip(lanes, res):
             if r is None:
@@ -77,8 +79,8 @@ def check_batch(model: JaxModel,
 
 
 def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
-               mesh: Optional[Mesh], axis: str,
-               chunk: int) -> List[Optional[Dict[str, Any]]]:
+               mesh: Optional[Mesh], axis: str, chunk: int,
+               gwords: int = 1) -> List[Optional[Dict[str, Any]]]:
     """One vmapped pass over a set of lanes at a fixed capacity.  Returns a
     result per lane, or None where the lane overflowed (caller escalates)."""
     emax = max(e.shape[0] for e in evs)
@@ -87,12 +89,12 @@ def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
     if mesh is not None:
         n = mesh.shape[axis]
         bpad = ((b + n - 1) // n) * n
-    batch = np.full((bpad, emax, 6), 0, np.int32)
+    batch = np.full((bpad, emax, 10), 0, np.int32)
     batch[:, :, 0] = EV_NOP
     for i, e in enumerate(evs):
         batch[i, :e.shape[0]] = e
 
-    carry0, vrun = _batched_runner_simple(model, window, cap)
+    carry0, vrun = _batched_runner_simple(model, window, cap, gwords)
     c0 = carry0()
     carry = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (bpad,) + x.shape), c0)
@@ -105,9 +107,11 @@ def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
             jnp.asarray(batch), NamedSharding(mesh, P(axis, None, None)))
     else:
         batch_dev = jnp.asarray(batch)
+    from jepsen_tpu.checker.wgl_tpu import _chunk_slicer
+    slice_chunk = _chunk_slicer(chunk, axis=1)
     n_chunks = emax // chunk
     for ci in range(n_chunks):
-        carry, _ = vrun(carry, batch_dev[:, ci * chunk:(ci + 1) * chunk])
+        carry, _ = vrun(carry, slice_chunk(batch_dev, ci * chunk))
 
     overflow = np.asarray(carry[8])[:b]
     failed = np.asarray(carry[6])[:b]
@@ -127,12 +131,15 @@ def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
     return out
 
 
-def _batched_runner_simple(model: JaxModel, window: int, capacity: int):
+def _batched_runner_simple(model: JaxModel, window: int, capacity: int,
+                           gwords: int = 1):
     key = ("batchv", model.name, model.state_size,
-           tuple(model.init_state_array().tolist()), window, capacity)
+           tuple(model.init_state_array().tolist()), window, capacity,
+           gwords)
     if key in _CACHE:
         return _CACHE[key]
-    carry0, _, run_chunk = make_engine(model, window, capacity)
+    carry0, _, run_chunk = make_engine(model, window, capacity,
+                                       gwords=gwords)
     vrun = jax.jit(jax.vmap(run_chunk))
     _CACHE[key] = (carry0, vrun)
     return _CACHE[key]

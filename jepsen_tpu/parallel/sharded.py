@@ -29,7 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import LOOKAHEAD, events_array, make_engine
+from jepsen_tpu.checker.wgl_tpu import (LOOKAHEAD, _chunk_slicer,
+                                        events_array, ghost_words,
+                                        make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
@@ -37,23 +39,25 @@ _CACHE: Dict[Any, Any] = {}
 
 
 def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
-                    mesh: Mesh, axis: str):
+                    mesh: Mesh, axis: str, gwords: int = 1):
     key = ("shard", model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window,
-           capacity_per_shard, id(mesh), axis)
+           capacity_per_shard, id(mesh), axis, gwords)
     if key in _CACHE:
         return _CACHE[key]
     n = mesh.shape[axis]
     _, _, run_chunk = make_engine(model, window, capacity_per_shard,
-                                  axis_name=axis, num_shards=n)
+                                  axis_name=axis, num_shards=n,
+                                  gwords=gwords)
     # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
-    #               dirty, failed, failed_op, overflow, explored, rounds, peak)
+    #               dirty, failed, failed_op, overflow, explored, rounds,
+    #               peak, ghosts) — ghosts is per-slot, hence replicated.
     sharded = P(axis)
     repl = P()
     in_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                 repl, repl, repl, repl), repl)
+                 repl, repl, repl, repl, repl), repl)
     out_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                  repl, repl, repl, repl), repl)
+                  repl, repl, repl, repl, repl), repl)
     # check_vma=False: closure dedup sorts the *gathered* global row set, so
     # every shard computes bit-identical "replicated" scalars (counts, flags),
     # but the varying-axes checker can't prove that post-all_gather.
@@ -74,7 +78,9 @@ def _initial_carry(model, window, cap, n, mesh, axis):
         put(np.zeros((gcap, MW), np.uint32), P(axis)),
         put(np.tile(model.init_state_array()[None], (gcap, 1)), P(axis)),
         put(np.arange(gcap) == 0, P(axis)),
-        put(np.zeros((window, 3), np.int32), P()),
+        put(np.concatenate([np.zeros((window, 3), np.int32),
+                            np.full((window, 1), -1, np.int32),
+                            np.zeros((window, 2), np.int32)], axis=1), P()),
         put(np.zeros(window, bool), P()),
         put(np.bool_(False), P()),
         put(np.bool_(False), P()),
@@ -83,6 +89,7 @@ def _initial_carry(model, window, cap, n, mesh, axis):
         put(np.int32(0), P()),
         put(np.int32(0), P()),
         put(np.int32(1), P()),
+        put(np.zeros(MW, np.uint32), P()),
     )
 
 
@@ -146,8 +153,15 @@ def check_sharded(model: JaxModel,
     def put_repl(x):
         return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P()))
 
+    # Whole event stream uploaded once (replicated); chunks are sliced
+    # device-side — a per-chunk host->device put is a blocking RPC on
+    # tunneled/DCN-attached hosts (see wgl_tpu.check).
+    ev_dev = put_repl(ev)
+    slice_chunk = _chunk_slicer(chunk)
+
+    gw = ghost_words(p)
     cap = capacity_per_shard
-    run = _sharded_runner(model, window, cap, mesh, axis)
+    run = _sharded_runner(model, window, cap, mesh, axis, gw)
     carry = _initial_carry(model, window, cap, n, mesh, axis)
     recent_peaks: deque = deque(maxlen=4)
     inflight: deque = deque()  # (ci, carry_before, carry_after, flags)
@@ -166,8 +180,7 @@ def check_sharded(model: JaxModel,
     while True:
         while len(inflight) < lookahead and next_ci < n_chunks:
             prev = carry
-            carry, flags = run(carry, put_repl(ev[next_ci * chunk:
-                                                  (next_ci + 1) * chunk]))
+            carry, flags = run(carry, slice_chunk(ev_dev, next_ci * chunk))
             inflight.append((next_ci, prev, carry, flags))
             next_ci += 1
         if not inflight:
@@ -187,7 +200,7 @@ def check_sharded(model: JaxModel,
                 cap = min(old * 4, max_capacity_per_shard)
             recent_peaks.clear()
             inflight.clear()
-            run = _sharded_runner(model, window, cap, mesh, axis)
+            run = _sharded_runner(model, window, cap, mesh, axis, gw)
             carry = _resize_carry_sharded(prev, n, old, cap, mesh, axis)
             next_ci = ci
             overflow = False
@@ -212,7 +225,7 @@ def check_sharded(model: JaxModel,
                 cap = target
                 recent_peaks.clear()
                 inflight.clear()
-                run = _sharded_runner(model, window, cap, mesh, axis)
+                run = _sharded_runner(model, window, cap, mesh, axis, gw)
                 carry = _resize_carry_sharded(done, n, old, cap, mesh, axis)
                 next_ci = ci + 1
     carry = done

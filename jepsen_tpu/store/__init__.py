@@ -209,6 +209,7 @@ def runs(base: str = BASE) -> List[Dict[str, Any]]:
             entry = {"name": name, "time": stamp, "dir": d, "valid": None}
             lp = os.path.join(d, "results.jtsf")
             rp = os.path.join(d, "results.json")
+            read_ok = False
             if os.path.exists(lp):
                 # One tiny block read per run instead of parsing the whole
                 # results blob (which can hold per-key maps for 10^3 keys).
@@ -216,9 +217,10 @@ def runs(base: str = BASE) -> List[Dict[str, Any]]:
                     from jepsen_tpu.store import format as _fmt
                     entry["valid"] = _fmt.LazyStore(lp).read_json(
                         "valid").get("valid")
+                    read_ok = True  # a None verdict is a real verdict
                 except Exception:  # noqa: BLE001
                     pass
-            if entry["valid"] is None and os.path.exists(rp):
+            if not read_ok and os.path.exists(rp):
                 try:
                     with open(rp) as f:
                         entry["valid"] = json.load(f).get("valid")

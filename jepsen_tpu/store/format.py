@@ -88,7 +88,16 @@ class Writer:
             sz = 0
         # Byte offset of the next block header (magic occupies [0, 8)).
         self._off = sz if sz > 0 else len(MAGIC)
+        # Reopening preserves reachability of earlier named blocks: the
+        # closing index must be a superset of the previous one, so preload
+        # it (new names then shadow old ones).
         self._index: dict = {}
+        self._index_dirty = False
+        if sz > len(MAGIC):
+            try:
+                self._index = read_index(path)
+            except (OSError, ValueError, CorruptBlock):
+                pass
         lib = _native_lib() if native in (None, True) else None
         if native is True and lib is None:
             raise RuntimeError("native store engine unavailable")
@@ -131,6 +140,7 @@ class Writer:
         """Append a block reachable by name via the closing index."""
         off = self.append(payload, tag)
         self._index[name] = off
+        self._index_dirty = True
         return off
 
     def append_named_json(self, name: str, value: Any) -> int:
@@ -144,9 +154,10 @@ class Writer:
             self._f.flush()
 
     def close(self) -> None:
-        if self._index:
+        if self._index_dirty:
             self.append(json.dumps(self._index).encode(), TAG_INDEX)
             self._index = {}
+            self._index_dirty = False
         if self._lib is not None:
             if self._h:
                 self._lib.jtsf_close(self._h)

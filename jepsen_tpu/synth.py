@@ -131,6 +131,21 @@ def doomed_cas_padding(n: int, start_process: int = 9000,
                for i in range(n)])
 
 
+def ghost_write_burst(k: int, start_process: int = 2000,
+                      base_value: int = 100) -> List[Op]:
+    """``k`` crashed writes of distinct values: each one stays pending
+    forever and may or may not have taken effect, so each roughly doubles
+    the reachable configuration set (masks) and multiplies states — the
+    capacity driver for escalation/ceiling tests and bench tiers."""
+    out = []
+    for i in range(k):
+        out.append(Op(process=start_process + i, type=INVOKE, f="write",
+                      value=base_value + i))
+        out.append(Op(process=start_process + i, type=INFO, f="write",
+                      value=None))
+    return out
+
+
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
                   values: int = 5) -> History:
     """Flip the observed value of ``n`` ok-reads to a value that was never

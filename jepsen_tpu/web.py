@@ -82,17 +82,25 @@ def make_handler(base: str):
             import mimetypes
             ctype = (mimetypes.guess_type(p)[0]
                      or "text/plain; charset=utf-8")
+            size = os.path.getsize(p)
             self.send_response(200)
             self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(os.path.getsize(p)))
+            self.send_header("Content-Length", str(size))
             self.end_headers()
             try:
+                # Cap at the announced length: live files (jepsen.log of a
+                # run in progress) grow mid-stream, and extra bytes would
+                # desync a keep-alive connection.
+                remaining = size
                 with open(p, "rb") as f:
-                    while True:
-                        buf = f.read(1 << 20)
+                    while remaining > 0:
+                        buf = f.read(min(1 << 20, remaining))
                         if not buf:
                             break
+                        remaining -= len(buf)
                         self.wfile.write(buf)
+                if remaining:  # truncated under us; close() resyncs client
+                    self.close_connection = True
             except (BrokenPipeError, ConnectionResetError):
                 pass  # client went away mid-download
 

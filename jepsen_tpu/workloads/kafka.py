@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker, UNKNOWN
-from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, find_cycle, sccs
+from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
 from jepsen_tpu.elle.list_append import classify_cycle
 from jepsen_tpu.history import FAIL, History, OK
 
@@ -317,42 +317,25 @@ def _graph_pass(history: History) -> List[Dict[str, Any]]:
     seen_cycles = set()
 
     def scan(graph: Graph):
-        # find_cycle yields one (shortest) cycle per SCC; an SCC can merge
-        # several distinct cycles (e.g. a ww/wr 2-cycle bridged to a
-        # process-order cycle), so after reporting a cycle, peel its nodes
-        # off and re-search the remainder — node-disjoint cycles in one
-        # component are all reported.
-        for comp in sccs(graph):
-            remaining = set(comp)
-            while len(remaining) >= 2:
-                sub = graph.subgraph(remaining)
-                cyc = None
-                for c in sccs(sub):
-                    if len(c) >= 2:
-                        cyc = find_cycle(sub, c)
-                        if cyc:
-                            break
-                if not cyc:
-                    break
-                remaining -= set(cyc)
-                key = frozenset(cyc)
-                if key in seen_cycles:
-                    continue  # already reported from the ww+wr scan
-                seen_cycles.add(key)
-                kinds = cycle_edge_kinds(graph, cyc)
-                base_kinds = [ks - {"process"} for ks in kinds]
-                if all(bk for bk in base_kinds):
-                    typ = classify_cycle(base_kinds)
-                else:
-                    # at least one step exists only by process order;
-                    # process edges type like ww for severity
-                    typ = "process-" + classify_cycle(
-                        [bk or {"ww"} for bk in base_kinds])
-                out.append({
-                    "type": typ,
-                    "cycle": [_txn_brief(oks[t][1]) for t in cyc],
-                    "edges": [sorted(ks) for ks in kinds],
-                })
+        for cyc in peeled_cycles(graph):
+            key = frozenset(cyc)
+            if key in seen_cycles:
+                continue  # already reported from the ww+wr scan
+            seen_cycles.add(key)
+            kinds = cycle_edge_kinds(graph, cyc)
+            base_kinds = [ks - {"process"} for ks in kinds]
+            if all(bk for bk in base_kinds):
+                typ = classify_cycle(base_kinds)
+            else:
+                # at least one step exists only by process order;
+                # process edges type like ww for severity
+                typ = "process-" + classify_cycle(
+                    [bk or {"ww"} for bk in base_kinds])
+            out.append({
+                "type": typ,
+                "cycle": [_txn_brief(oks[t][1]) for t in cyc],
+                "edges": [sorted(ks) for ks in kinds],
+            })
 
     scan(g.filter_kinds({"ww", "wr"}))  # pure log cycles first (G0/G1c)
     scan(g)                             # then cycles needing process order

@@ -49,6 +49,22 @@ class TestGraph:
         g.add_edge(2, 3, "wr")
         assert graph.sccs(g) == []
 
+    def test_peeled_cycles_disjoint_cycles_one_scc(self):
+        # Two node-disjoint 2-cycles bridged into a single SCC: a
+        # one-cycle-per-SCC scan reports only one anomaly; peeling
+        # reports both.
+        g = graph.Graph()
+        g.add_edge(1, 2, "ww")
+        g.add_edge(2, 1, "ww")
+        g.add_edge(3, 4, "ww")
+        g.add_edge(4, 3, "ww")
+        g.add_edge(2, 3, "ww")  # bridges
+        g.add_edge(4, 1, "ww")
+        assert len(graph.sccs(g)) == 1
+        cycles = list(graph.peeled_cycles(g))
+        covered = set().union(*(set(c) for c in cycles))
+        assert len(cycles) == 2 and covered == {1, 2, 3, 4}
+
 
 class TestListAppend:
     def test_clean_history_valid(self):

@@ -1,0 +1,35 @@
+"""The node-side C helpers must at least compile and parse argv — they are
+gcc-compiled on real nodes at nemesis setup (nemesis/time.py install_tools),
+so a syntax error or usage regression would only surface mid-test on a
+cluster."""
+
+import os
+import subprocess
+
+import pytest
+
+from jepsen_tpu.nemesis.faults import NATIVE_DIR
+
+HELPERS = ["bump-time.c", "strobe-time.c", "strobe-time-mono.c"]
+
+
+@pytest.mark.parametrize("src", HELPERS)
+def test_compiles_and_rejects_bad_usage(tmp_path, src):
+    binary = str(tmp_path / src[:-2])
+    subprocess.run(["gcc", "-O2", "-o", binary,
+                    os.path.join(NATIVE_DIR, src)],
+                   check=True, capture_output=True)
+    # no args -> usage error, never touches the clock
+    p = subprocess.run([binary], capture_output=True, text=True)
+    assert p.returncode == 2
+    assert "usage" in p.stderr
+
+
+def test_strobe_rejects_nonpositive_period(tmp_path):
+    binary = str(tmp_path / "stm")
+    subprocess.run(["gcc", "-O2", "-o", binary,
+                    os.path.join(NATIVE_DIR, "strobe-time-mono.c")],
+                   check=True, capture_output=True)
+    p = subprocess.run([binary, "100", "0", "1000"],
+                       capture_output=True, text=True)
+    assert p.returncode == 2

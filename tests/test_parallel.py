@@ -142,9 +142,10 @@ class TestSharded:
         calls = []
         orig = batch_mod._run_lanes
 
-        def spy(model, evs, preps, window, cap, mesh, axis, chunk):
+        def spy(model, evs, preps, window, cap, mesh, axis, chunk, *a):
             calls.append((len(evs), cap))
-            return orig(model, evs, preps, window, cap, mesh, axis, chunk)
+            return orig(model, evs, preps, window, cap, mesh, axis, chunk,
+                        *a)
 
         monkeypatch.setattr(batch_mod, "_run_lanes", spy)
         easy = [cas_register_history(60, concurrency=3, crash_p=0.0, seed=s)

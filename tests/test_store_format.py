@@ -80,6 +80,23 @@ class TestFormat:
             w.append_named("b", b"three")
         s = fmt.LazyStore(p)
         assert s.read("a") == b"two" and s.read("b") == b"three"
+
+    def test_reopen_preserves_unrewritten_names(self, tmp_path):
+        # A later session appending new names must not unlink earlier ones:
+        # the closing index merges the preloaded previous index.
+        p = str(tmp_path / "f.jtsf")
+        with fmt.Writer(p) as w:
+            w.append_named("a", b"one")
+        with fmt.Writer(p) as w:
+            w.append_named("b", b"two")
+        s = fmt.LazyStore(p)
+        assert s.names() == ["a", "b"]
+        assert s.read("a") == b"one" and s.read("b") == b"two"
+        # reopen without naming anything: no redundant index block
+        n_before = fmt.verify(p)
+        with fmt.Writer(p) as w:
+            w.append(b"unnamed")
+        assert fmt.verify(p) == n_before + 1
         # both engines agree on offsets: native writer, python reader
         with fmt.Writer(str(tmp_path / "n.jtsf"), native=True) as w:
             w.append(b"x" * 37)

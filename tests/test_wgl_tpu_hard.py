@@ -24,21 +24,12 @@ from jepsen_tpu.checker import wgl_cpu, wgl_tpu
 from jepsen_tpu.history import History, INVOKE, OK, FAIL, INFO, Op
 from jepsen_tpu.models import CASRegister, get_model
 from jepsen_tpu.synth import (cas_register_history, corrupt_reads,
-                              doomed_cas_padding)
+                              doomed_cas_padding,
+                              ghost_write_burst as crash_burst)
 
 
 def mk(process, type_, f, value=None):
     return Op(process=process, type=type_, f=f, value=value)
-
-
-def crash_burst(k, start_process=2000, base_value=100):
-    """k crashed writes of distinct values: each doubles the reachable
-    configuration set (in-window vs linearized), and states multiply too."""
-    out = []
-    for i in range(k):
-        out.append(mk(start_process + i, INVOKE, "write", base_value + i))
-        out.append(mk(start_process + i, INFO, "write", None))
-    return out
 
 
 class TestWideWindow:
@@ -69,14 +60,24 @@ class TestWideWindow:
         assert r["op"]["index"] == cpu["op"]["index"]
 
 
+def live_write_burst(k, start_process=3000, base_value=200):
+    """k *live* concurrent writes (all pending at once, all completing):
+    the intrinsically exponential regime — every subset x last-writer is a
+    distinct configuration and, unlike crashed ghosts, the bits get checked
+    at the RETURNs, so subsumption cannot collapse them."""
+    return ([mk(start_process + i, INVOKE, "write", base_value + i)
+             for i in range(k)]
+            + [mk(start_process + i, OK, "write", base_value + i)
+               for i in range(k)])
+
+
 class TestCapacityEscalation:
     def test_escalates_and_concludes(self):
-        # 10 pending distinct writes -> ~2^10 masks x up-to-11 states, far
-        # over the starting capacity of 64; the driver must escalate (resume,
-        # not restart) and still conclude.  A later read of a burst value is
-        # explained by a ghost write taking effect.
-        burst = crash_burst(10)
-        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 104),
+        # 10 concurrent live writes -> ~2^10 masks x up-to-11 states at the
+        # first RETURN's closure, far over the starting capacity of 64; the
+        # driver must escalate (resume, not restart) and still conclude.
+        burst = live_write_burst(10)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 204),
                 mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
                 mk(0, INVOKE, "read"), mk(0, OK, "read", 50)]
         h = History(burst + tail, reindex=True)
@@ -89,12 +90,12 @@ class TestCapacityEscalation:
         assert cpu["valid"] is True
 
     def test_ceiling_reached_degrades_to_unknown(self):
-        # 18 pending distinct writes need >= 2^18 configurations; with the
+        # 16 concurrent live writes need >= 2^16 configurations; with the
         # ceiling at 4096 the engine must give up cleanly: verdict unknown
         # with the capacity named, never a wrong True/False.
-        burst = crash_burst(18)
-        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 117)]
-        h = History(burst + tail, reindex=True)
+        burst = live_write_burst(16)
+        h = History(burst + [mk(0, INVOKE, "read"),
+                             mk(0, OK, "read", 215)], reindex=True)
         model = get_model("cas-register")
         r = wgl_tpu.check(model, h, capacity=1024, chunk=64,
                           max_capacity=4096)
@@ -104,11 +105,63 @@ class TestCapacityEscalation:
     def test_oracle_budget_matches(self):
         # Same explosion on the host tier: the oracle raises SearchExploded
         # rather than answering wrong.
-        burst = crash_burst(18)
-        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 117)]
-        h = History(burst + tail, reindex=True)
+        burst = live_write_burst(16)
+        h = History(burst + [mk(0, INVOKE, "read"),
+                             mk(0, OK, "read", 215)], reindex=True)
         with pytest.raises(wgl_cpu.SearchExploded):
-            wgl_cpu.check(CASRegister(), h, max_configs=50_000)
+            wgl_cpu.check(CASRegister(), h, max_configs=20_000)
+
+
+class TestGhostSubsumption:
+    """Crashed (never-returning) ops used to multiply the configuration set
+    by 2^crashes — the regime where knossos dies.  Ghost-bit subsumption
+    collapses it to O(crashes): configs differing only in ghost bits with
+    equal state are covered by the minimal-ghost representative, because
+    ghost bits are never consulted at any RETURN."""
+
+    def test_ghost_burst_collapses(self):
+        # 18 ghost writes: pre-subsumption this needs >= 2^18 configs (the
+        # old ceiling test); now a 256-config engine never even escalates.
+        burst = crash_burst(18)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 117),
+                mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
+                mk(0, INVOKE, "read"), mk(0, OK, "read", 50)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=256, chunk=64,
+                          max_capacity=256)
+        assert r["valid"] is True
+        assert r["max-capacity-reached"] == 256
+        cpu = wgl_cpu.check(CASRegister(), h, max_configs=10_000)
+        assert cpu["valid"] is True
+
+    def test_ghost_burst_refutation_still_caught(self):
+        # Subsumption must not weaken refutation: a read of a value no
+        # ghost or live write ever wrote stays invalid, and both engines
+        # agree on the failing op.
+        burst = crash_burst(12)
+        tail = [mk(0, INVOKE, "read"), mk(0, OK, "read", 9999)]
+        h = History(burst + tail, reindex=True)
+        model = get_model("cas-register")
+        r = wgl_tpu.check(model, h, capacity=256, chunk=64, explain=False)
+        cpu = wgl_cpu.check(CASRegister(), h, max_configs=10_000)
+        assert r["valid"] is cpu["valid"] is False
+        assert r["op"]["index"] == cpu["op"]["index"]
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_crashy_differential(self, seed):
+        # Heavy crash rates: verdicts (and failing ops) must keep matching
+        # the oracle with subsumption active in both engines.
+        h = cas_register_history(400, concurrency=6, crash_p=0.03,
+                                 seed=seed)
+        if seed % 2:
+            h = corrupt_reads(h, n=1, seed=seed)
+        model = get_model("cas-register")
+        cpu = wgl_cpu.check(CASRegister(), h)
+        tpu = wgl_tpu.check(model, h, capacity=256, chunk=128)
+        assert cpu["valid"] == tpu["valid"]
+        if cpu["valid"] is False:
+            assert cpu["op"]["index"] == tpu["op"]["index"]
 
 
 class TestCrashHeavyRefutation:
@@ -128,23 +181,23 @@ class TestCrashHeavyRefutation:
         # The refutation verdict must survive a witness search that blows its
         # budget: the result degrades to witness: {"error": ...} (the device
         # verdict stands on its own).
-        burst = crash_burst(10)
+        burst = live_write_burst(10)
         tail = [mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
                 mk(0, INVOKE, "read"), mk(0, OK, "read", 9999)]
         h = History(burst + tail, reindex=True)
         model = get_model("cas-register")
-        r = wgl_tpu.check(model, h, capacity=64, chunk=64,
+        r = wgl_tpu.check(model, h, capacity=16384, chunk=64,
                           witness_budget=100)
         assert r["valid"] is False
         assert r["witness"] == {"error": "witness search exceeded budget"}
 
     def test_witness_within_budget(self):
-        burst = crash_burst(10)
+        burst = live_write_burst(10)
         tail = [mk(0, INVOKE, "write", 50), mk(0, OK, "write", 50),
                 mk(0, INVOKE, "read"), mk(0, OK, "read", 9999)]
         h = History(burst + tail, reindex=True)
         model = get_model("cas-register")
-        r = wgl_tpu.check(model, h, capacity=64, chunk=64)
+        r = wgl_tpu.check(model, h, capacity=16384, chunk=64)
         assert r["valid"] is False
         assert r["witness"]["valid"] is False
         assert r["witness"]["final-configs"]
