@@ -5,12 +5,23 @@ CAS-register histories; target <60 s on TPU.  No published CPU figure exists,
 so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
 200 / 1k / 10k-op histories under a timeout, and reports the device tiers:
 
-  easy     10k ops, window ~12            (round-1 headline, comparability)
-  hard     10k ops, window >= 64, crash-heavy: capacity escalation territory
-  ceiling  ghost-write burst that must blow past max capacity: clean,
-           *timed* degradation to an unknown verdict at the 65536 ceiling
-  refuted  10k ops with corrupted reads: early-exit on the failing prefix
-  batch    check_batch throughput over short per-key histories -> hist/sec
+  easy      10k ops, window ~12           (round-1 headline, comparability)
+  hard      10k ops, window >= 64, crash-heavy: capacity escalation territory
+  ceiling   ghost-write burst that must blow past max capacity: clean,
+            *timed* degradation to an unknown verdict at the 65536 ceiling
+  refuted   10k ops with corrupted reads: early-exit on the failing prefix
+  batch     check_batch throughput over short per-key histories -> hist/sec
+  ablation  ghost-subsumption on vs off (JTPU_SUBSUME=0) on a ghost burst
+            that concludes in O(crashes) configs with subsumption and needs
+            ~2^crashes without — the measured evidence for the claim in
+            checker/wgl_tpu.py:22-32
+
+**Isolation:** every tier runs in its own subprocess with its own timeout; a
+tier that crashes the TPU worker (or hangs) degrades to a per-tier
+``{"status": "crashed"|"timeout"}`` entry and can never zero the artifact —
+the round-2 bench died in shared warm-up and shipped no number at all.
+Compiles amortize across the subprocesses via the persistent compilation
+cache (jepsen_tpu/ops/cache.py).
 
 Headline value = MEDIAN of the easy-tier runs (all runs disclosed);
 vs_baseline = measured CPU 10k wall / device wall (a lower bound when the
@@ -20,6 +31,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 Env: JTPU_BENCH_SMOKE=1 shrinks every tier for a CPU-backend smoke run.
 """
 
+import argparse
 import json
 import os
 import statistics
@@ -36,6 +48,21 @@ TARGET_S = 60.0
 CHUNK = 512
 BATCH_N = 16 if SMOKE else 96
 BATCH_OPS = 200
+RESULT_TAG = "JTPU_TIER_RESULT "
+
+# Per-tier wall-clock budgets (orchestrator kills a tier past its budget and
+# records status=timeout instead of hanging the whole artifact).
+TIER_TIMEOUT_S = {
+    "easy": 300 if SMOKE else 1500,
+    "cpu": 120 if SMOKE else 1100,
+    "hard": 300 if SMOKE else 2400,
+    "ceiling": 300 if SMOKE else 1500,
+    "refuted": 300 if SMOKE else 1200,
+    "batch": 300 if SMOKE else 1200,
+    "ablation_on": 300 if SMOKE else 900,
+    "ablation_off": 300 if SMOKE else 900,
+    "setup2": 300 if SMOKE else 700,
+}
 
 
 def progress(msg: str) -> None:
@@ -54,18 +81,146 @@ def timed_runs(fn, n):
     return r, runs
 
 
-def cpu_tier(model_cpu, histories):
-    """Measure the CPU oracle on each history with a hard timeout — this is
-    the 'CPU knossos' baseline the device tier is claimed against."""
+def emit(data: dict) -> None:
+    """Tier-worker result line (stdout; orchestrator greps for the tag)."""
+    print(RESULT_TAG + json.dumps(data), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Shared history builders (deterministic — workers rebuild identical inputs)
+# ---------------------------------------------------------------------------
+
+
+def build_easy():
+    from jepsen_tpu.synth import cas_register_history
+    return cas_register_history(N_OPS, concurrency=8, crash_p=0.0003,
+                                seed=2026)
+
+
+def build_hard():
+    # 48 never-linearizable crashed CAS ops pin the window >= 64 (per-round
+    # cost is O(capacity * window)), and crashes drive capacity escalation
+    # (each pending crashed write doubles the reachable configuration set)
+    # — sized so the search still CONCLUDES below the ceiling; unbounded
+    # ghost pileups get their own ceiling tier.
+    from jepsen_tpu.history import History
+    from jepsen_tpu.synth import cas_register_history, doomed_cas_padding
+    n_pad, conc = (16, 8) if SMOKE else (48, 10)
+    pad = doomed_cas_padding(n_pad)
+    work = cas_register_history(N_OPS, concurrency=conc, crash_p=0.0008,
+                                seed=11)
+    return History(pad + list(work), reindex=True)
+
+
+def build_ceiling():
+    # 18 pending ghost writes need >= 2^18 *states* — the writes are
+    # distinct values, so ghost subsumption cannot collapse configurations
+    # that end in different final values; this blows past any ceiling here
+    # and measures how fast the engine escalates through the whole capacity
+    # ladder and degrades cleanly to unknown.
+    from jepsen_tpu.history import History
+    from jepsen_tpu.synth import cas_register_history, ghost_write_burst
+    return History(
+        ghost_write_burst(4 if SMOKE else 18)
+        + list(cas_register_history(200, concurrency=4, crash_p=0.0, seed=3)),
+        reindex=True)
+
+
+def build_refuted():
+    from jepsen_tpu.synth import cas_register_history, corrupt_reads
+    return corrupt_reads(
+        cas_register_history(N_OPS, concurrency=8, crash_p=0.0005, seed=4),
+        n=2, seed=4)
+
+
+def build_ablation():
+    # Concludes (valid) with ghost subsumption at O(crashes) configurations;
+    # without it (JTPU_SUBSUME=0) the same history needs ~2^12 configs.
+    # Writes here REUSE values from the work history's domain, so configs
+    # with the same final value but different linearized-ghost subsets are
+    # exactly the subsumption-collapsible family.
+    from jepsen_tpu.history import History
+    from jepsen_tpu.synth import cas_register_history, ghost_write_burst
+    k = 4 if SMOKE else 12
+    burst = ghost_write_burst(k, base_value=0)
+    for i, op in enumerate(burst):  # fold values into the tiny work domain
+        if op.value is not None:
+            burst[i] = op.with_(value=op.value % 3)
+    return History(
+        burst + list(cas_register_history(800, concurrency=4, crash_p=0.0,
+                                          seed=5)),
+        reindex=True)
+
+
+def build_batch():
+    from jepsen_tpu.synth import cas_register_history, corrupt_reads
+    hs = [cas_register_history(BATCH_OPS, concurrency=6, crash_p=0.005,
+                               seed=100 + i) for i in range(BATCH_N)]
+    for i in range(0, BATCH_N, 4):  # quarter refuted: mixed verdict stream
+        hs[i] = corrupt_reads(hs[i], n=1, seed=i)
+    return hs
+
+
+# ---------------------------------------------------------------------------
+# Warm-up: AOT-compile exactly the engine shapes a tier's run can reach
+# ---------------------------------------------------------------------------
+
+
+def warm_shapes(model, window, caps, gw, chunk=CHUNK):
+    """Compile every (window, capacity, gwords, chunk) engine an escalating
+    check() on this tier could request, by running each on one all-NOP
+    chunk of the size the driver will really dispatch at that capacity
+    (chunk shrinks as capacity grows — wgl_tpu.chunk_for_capacity).  NOP
+    events take the identity branch of the event switch — no closure, no
+    search — so unlike round 2's run-a-real-history warm-up this cannot
+    blow up on the history itself, and the call path leaves the jit
+    dispatch cache hot for the timed runs."""
+    import jax
+    import jax.numpy as jnp
+    from jepsen_tpu.checker import wgl_tpu
+    for cap in caps:
+        cc = wgl_tpu.chunk_for_capacity(cap, chunk)
+        ev = jnp.full((cc, 10), 0, jnp.int32).at[:, 0].set(wgl_tpu.EV_NOP)
+        carry0, run_chunk = wgl_tpu._get_run_chunk(model, window, cap, gw)
+        carry, flags = run_chunk(carry0(), ev)
+        jax.block_until_ready(flags)
+
+
+def cap_ladder(start, max_cap, growth=4):
+    caps = [start]
+    while caps[-1] < max_cap:
+        caps.append(min(caps[-1] * growth, max_cap))
+    return caps
+
+
+# ---------------------------------------------------------------------------
+# Tier workers (each runs in its own subprocess)
+# ---------------------------------------------------------------------------
+
+
+def tier_cpu():
+    """Measure the CPU oracle with a hard timeout — this is the 'CPU
+    knossos' baseline the device tier is claimed against."""
     from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.synth import cas_register_history
+    model = CASRegister()
     out = {}
-    for name, h in histories.items():
+    hs = {
+        "200": cas_register_history(200, concurrency=8, crash_p=0.003,
+                                    seed=1),
+        "1k": cas_register_history(1000, concurrency=8, crash_p=0.001,
+                                   seed=2),
+        "10k": build_easy(),
+    }
+    for name, h in hs.items():
+        progress(f"cpu {name}")
         cancel = threading.Event()
         timer = threading.Timer(CPU_TIMEOUT_S, cancel.set)
         timer.start()
         t0 = time.time()
         try:
-            r = wgl_cpu.check(model_cpu, h, cancel=cancel)
+            r = wgl_cpu.check(model, h, cancel=cancel)
             out[name] = {"wall_s": round(time.time() - t0, 3),
                          "valid": r["valid"],
                          "configs_explored": r.get("configs-explored")}
@@ -77,184 +232,240 @@ def cpu_tier(model_cpu, histories):
                          "exploded_at": e.n}
         finally:
             timer.cancel()
-    return out
+    emit(out)
 
 
-def second_process_setup():
-    """Time a fresh process warming one engine shape: with the persistent
-    compilation cache this is a disk load, not a recompile."""
-    code = (
-        "import time; t0=time.time()\n"
-        "from jepsen_tpu.checker import wgl_tpu\n"
-        "from jepsen_tpu.models import get_model\n"
-        "from jepsen_tpu.synth import cas_register_history\n"
-        "m = get_model('cas-register')\n"
-        "h = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)\n"
-        "r = wgl_tpu.check(m, h, capacity=1024, chunk=%d)\n"
-        "assert r['valid'] is True\n"
-        "print('SETUP_S', round(time.time()-t0, 1))\n" % CHUNK)
+def _device_tier(history, *, capacity, max_capacity, runs, explain=True):
+    from jepsen_tpu.checker import wgl_tpu
+    from jepsen_tpu.checker.prep import prepare
+    from jepsen_tpu.models import get_model
+    model = get_model("cas-register")
+    prep = prepare(history, model)
+    window = wgl_tpu._round_window(prep.window)
+    gw = wgl_tpu.ghost_words(prep)
+    progress(f"warm window={window} gw={gw} caps={cap_ladder(capacity, max_capacity)}")
+    t0 = time.time()
+    warm_shapes(model, window, cap_ladder(capacity, max_capacity), gw)
+    warm_s = round(time.time() - t0, 1)
+    progress("timed runs")
+    r, walls = timed_runs(
+        lambda: wgl_tpu.check(model, history, prepared=prep,
+                              capacity=capacity, chunk=CHUNK,
+                              max_capacity=max_capacity, explain=explain),
+        runs)
+    return r, walls, {"window": prep.window, "gwords": gw, "warm_s": warm_s}
+
+
+def tier_easy():
+    easy_cap = 4096 if SMOKE else 16384
+    r, walls, meta = _device_tier(build_easy(), capacity=1024,
+                                  max_capacity=easy_cap, runs=3)
+    assert r["valid"] is True, r
+    emit({"runs": walls, "valid": r["valid"],
+          "configs_explored": r.get("configs-explored"),
+          "max_capacity_reached": r.get("max-capacity-reached"), **meta})
+
+
+def tier_hard():
+    hard_cap = 4096 if SMOKE else 65536
+    r, walls, meta = _device_tier(build_hard(), capacity=1024,
+                                  max_capacity=hard_cap, runs=2)
+    emit({"runs": walls, "valid": r["valid"],
+          "configs_explored": r.get("configs-explored"),
+          "max_capacity_reached": r.get("max-capacity-reached"),
+          "error": r.get("error"), **meta})
+
+
+def tier_ceiling():
+    hard_cap = 4096 if SMOKE else 65536
+    r, walls, meta = _device_tier(build_ceiling(), capacity=1024,
+                                  max_capacity=hard_cap, runs=1)
+    if not SMOKE:
+        assert r["valid"] == "unknown", r
+    emit({"runs": walls, "valid": r["valid"],
+          "configs_explored": r.get("configs-explored"),
+          "error": r.get("error"), **meta})
+
+
+def tier_refuted():
+    r, walls, meta = _device_tier(build_refuted(), capacity=1024,
+                                  max_capacity=4096 if SMOKE else 16384,
+                                  runs=2, explain=False)
+    assert r["valid"] is False, r
+    emit({"runs": walls, "failed_op_index": r["op"]["index"],
+          "configs_explored": r.get("configs-explored"), **meta})
+
+
+def tier_ablation():
+    """Run under JTPU_SUBSUME=1 (orchestrator tier ablation_on) and =0
+    (ablation_off); the off-run measures the classic 2^crashes regime the
+    subsumption claim is about."""
+    from jepsen_tpu.ops import dedup
+    max_cap = 4096 if SMOKE else 65536
+    r, walls, meta = _device_tier(build_ablation(), capacity=256,
+                                  max_capacity=max_cap, runs=2)
+    emit({"runs": walls, "valid": r["valid"], "subsume": dedup.SUBSUME,
+          "configs_explored": r.get("configs-explored"),
+          "max_capacity_reached": r.get("max-capacity-reached"),
+          "error": r.get("error"), **meta})
+
+
+def tier_batch():
+    from jepsen_tpu.models import get_model
+    from jepsen_tpu.parallel.batch import check_batch
+    model = get_model("cas-register")
+    hs = build_batch()
+    progress("batch warm (full batch size — jit keys on the batch dim)")
+    check_batch(model, hs)
+    progress("batch timed run")
+    t0 = time.time()
+    res = check_batch(model, hs)
+    wall = time.time() - t0
+    n_false = sum(1 for r in res if r["valid"] is False)
+    assert n_false == BATCH_N // 4, [r["valid"] for r in res]
+    emit({"n_histories": BATCH_N, "ops_each": BATCH_OPS,
+          "wall_s": round(wall, 3),
+          "histories_per_sec": round(BATCH_N / wall, 1)})
+
+
+def tier_setup2():
+    """Fresh-process cold-start: with the persistent compilation cache this
+    is a disk load, not a recompile."""
+    t0 = time.time()
+    from jepsen_tpu.checker import wgl_tpu
+    from jepsen_tpu.models import get_model
+    from jepsen_tpu.synth import cas_register_history
+    m = get_model("cas-register")
+    h = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)
+    r = wgl_tpu.check(m, h, capacity=1024, chunk=CHUNK)
+    assert r["valid"] is True
+    emit({"setup_s": round(time.time() - t0, 1)})
+
+
+TIER_FNS = {
+    "cpu": tier_cpu,
+    "easy": tier_easy,
+    "hard": tier_hard,
+    "ceiling": tier_ceiling,
+    "refuted": tier_refuted,
+    "batch": tier_batch,
+    "ablation_on": tier_ablation,
+    "ablation_off": tier_ablation,
+    "setup2": tier_setup2,
+}
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator
+# ---------------------------------------------------------------------------
+
+
+def run_tier(name: str) -> dict:
+    """Run one tier in a subprocess; never raises.  Returns
+    {"status": ok|crashed|timeout, "wall_s", ...data or stderr tail}."""
+    env = dict(os.environ)
+    if name == "ablation_on":
+        env["JTPU_SUBSUME"] = "1"
+    elif name == "ablation_off":
+        env["JTPU_SUBSUME"] = "0"
+    t0 = time.time()
+    stderr_tail: list = []
+
+    def pump_stderr(pipe):
+        # Stream the worker's progress() markers through live (a hung tier
+        # must be diagnosable while it hangs), keeping a tail for the
+        # artifact when the tier crashes.
+        for line in pipe:
+            print(line, end="", file=sys.stderr, flush=True)
+            stderr_tail.append(line)
+            del stderr_tail[:-40]
+        pipe.close()
+
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tier", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    t = threading.Thread(target=pump_stderr, args=(p.stderr,), daemon=True)
+    t.start()
+    timed_out = threading.Event()
+
+    def on_timeout():
+        timed_out.set()
+        p.kill()
+
+    timer = threading.Timer(TIER_TIMEOUT_S[name], on_timeout)
+    timer.start()
     try:
-        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=600,
-                           cwd=os.path.dirname(os.path.abspath(__file__)))
-        for line in p.stdout.splitlines():
-            if line.startswith("SETUP_S"):
-                return float(line.split()[1])
-        print("second_process_setup failed rc=%d: %s"
-              % (p.returncode, p.stderr[-2000:]), file=sys.stderr)
-    except subprocess.TimeoutExpired:
-        print("second_process_setup timed out", file=sys.stderr)
-    return None
+        out = p.stdout.read()
+        p.wait()
+    finally:
+        timer.cancel()
+    t.join(timeout=5)
+    if timed_out.is_set():
+        return {"status": "timeout", "wall_s": round(time.time() - t0, 1),
+                "timeout_s": TIER_TIMEOUT_S[name]}
+    wall = round(time.time() - t0, 1)
+    for line in reversed(out.splitlines()):
+        if line.startswith(RESULT_TAG):
+            data = json.loads(line[len(RESULT_TAG):])
+            return {"status": "ok", "wall_s": wall, **data}
+    return {"status": "crashed", "wall_s": wall, "rc": p.returncode,
+            "stderr_tail": "".join(stderr_tail)[-1500:]}
 
 
 def main():
-    t_setup = time.time()
-    from jepsen_tpu.checker import wgl_tpu
-    from jepsen_tpu.checker.prep import prepare
-    from jepsen_tpu.models import CASRegister, get_model
-    from jepsen_tpu.parallel.batch import check_batch
-    from jepsen_tpu.synth import (cas_register_history, corrupt_reads,
-                                  doomed_cas_padding, ghost_write_burst)
-    from jepsen_tpu.history import History
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tier", choices=sorted(TIER_FNS))
+    args = ap.parse_args()
+    if args.tier:
+        TIER_FNS[args.tier]()
+        return 0
 
-    model = get_model("cas-register")
+    tiers = {}
+    # Easy (the headline) runs FIRST so later-tier failures can't starve it
+    # of its time budget; cpu next (the denominator); the rest follow.
+    for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
+                 "ablation_on", "ablation_off", "setup2"):
+        progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
+        tiers[name] = run_tier(name)
+        progress(f"tier {name}: {tiers[name].get('status')} "
+                 f"in {tiers[name].get('wall_s')}s")
 
-    # --- histories ---------------------------------------------------------
-    easy = cas_register_history(N_OPS, concurrency=8, crash_p=0.0003,
-                                seed=2026)
-    # Hard tier: 48 never-linearizable crashed CAS ops pin the window >= 64
-    # (per-round cost is O(capacity * window)), and crashes drive capacity
-    # escalation (each pending crashed write doubles the reachable
-    # configuration set) — sized so the search still CONCLUDES below the
-    # ceiling; unbounded ghost pileups get their own ceiling tier below.
-    n_pad, hard_conc = (16, 8) if SMOKE else (48, 10)
-    pad = doomed_cas_padding(n_pad)
-    hard_work = cas_register_history(N_OPS, concurrency=hard_conc,
-                                     crash_p=0.0008, seed=11)
-    hard = History(pad + list(hard_work), reindex=True)
-    # Ceiling tier: 18 pending ghost writes need >= 2^18 configurations —
-    # past any ceiling here; measures how fast the engine escalates through
-    # the whole capacity ladder and degrades cleanly to unknown.
-    ceiling = History(
-        ghost_write_burst(4 if SMOKE else 18)
-        + list(cas_register_history(200, concurrency=4, crash_p=0.0,
-                                    seed=3)),
-        reindex=True)
-    refuted = corrupt_reads(
-        cas_register_history(N_OPS, concurrency=8, crash_p=0.0005, seed=4),
-        n=2, seed=4)
-
-    prep_easy = prepare(easy, model)
-    prep_hard = prepare(hard, model)
-    prep_ceiling = prepare(ceiling, model)
-    prep_refuted = prepare(refuted, model)
-
-    # --- warm-up: compile each engine shape the tiers can reach ------------
-    progress("warm-up compiles")
-    warm = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)
-    for prep in (prep_easy, prep_hard, prep_ceiling, prep_refuted):
-        window = wgl_tpu._round_window(prep.window)
-        wp = prepare(warm, model)
-        wp.window = max(wp.window, window)
-        for cap in (1024, 4096) if SMOKE else (1024, 4096, 16384, 65536):
-            r = wgl_tpu.check(model, warm, prepared=wp, capacity=cap,
-                              chunk=CHUNK)
-            assert r["valid"] is True, r
-    batch_hs = [cas_register_history(BATCH_OPS, concurrency=6, crash_p=0.005,
-                                     seed=100 + i) for i in range(BATCH_N)]
-    for i in range(0, BATCH_N, 4):  # quarter refuted: mixed verdict stream
-        batch_hs[i] = corrupt_reads(batch_hs[i], n=1, seed=i)
-    # Warm at full batch size: jit keys on the leading batch dim, so a
-    # partial warm-up would leave a compile inside the timed region.
-    check_batch(model, batch_hs)
-    setup_s = round(time.time() - t_setup, 1)
-
-    # --- CPU baseline (measured, this machine) -----------------------------
-    progress(f"cpu baseline (timeout {CPU_TIMEOUT_S:.0f}s per size)")
-    cpu = cpu_tier(CASRegister(), {
-        "200": cas_register_history(200, concurrency=8, crash_p=0.003,
-                                    seed=1),
-        "1k": cas_register_history(1000, concurrency=8, crash_p=0.001,
-                                   seed=2),
-        "10k": easy,
-    })
-
-    # --- device tiers ------------------------------------------------------
-    easy_cap, hard_cap = (4096, 4096) if SMOKE else (16384, 65536)
-    progress("easy tier")
-    r_easy, easy_runs = timed_runs(
-        lambda: wgl_tpu.check(model, easy, prepared=prep_easy, capacity=1024,
-                              chunk=CHUNK, max_capacity=easy_cap), 3)
-    assert r_easy["valid"] is True, r_easy
-    progress("hard tier")
-    r_hard, hard_runs = timed_runs(
-        lambda: wgl_tpu.check(model, hard, prepared=prep_hard, capacity=1024,
-                              chunk=CHUNK, max_capacity=hard_cap), 2)
-    progress("ceiling tier")
-    r_ceil, ceil_runs = timed_runs(
-        lambda: wgl_tpu.check(model, ceiling, prepared=prep_ceiling,
-                              capacity=1024, chunk=CHUNK,
-                              max_capacity=hard_cap), 1)
-    if not SMOKE:
-        assert r_ceil["valid"] == "unknown", r_ceil
-    progress("refuted tier")
-    r_ref, ref_runs = timed_runs(
-        lambda: wgl_tpu.check(model, refuted, prepared=prep_refuted,
-                              capacity=1024, chunk=CHUNK, explain=False), 2)
-    assert r_ref["valid"] is False, r_ref
-
-    progress("batch tier")
-    t0 = time.time()
-    batch_res = check_batch(model, batch_hs)
-    batch_wall = time.time() - t0
-    n_false = sum(1 for r in batch_res if r["valid"] is False)
-    assert n_false == BATCH_N // 4, [r["valid"] for r in batch_res]
-
-    progress("second-process setup probe")
-    setup2_s = second_process_setup()
-
-    wall = statistics.median(easy_runs)
-    cpu10k = cpu["10k"]
-    cpu_wall = cpu10k["wall_s"]
+    easy = tiers["easy"]
+    wall = (statistics.median(easy["runs"])
+            if easy.get("status") == "ok" else None)
+    cpu10k = tiers["cpu"].get("10k") or {}
+    cpu_wall = cpu10k.get("wall_s")
     vs_lower_bound = bool(cpu10k.get("timeout") or cpu10k.get("exploded_at"))
 
     print(json.dumps({
         "metric": "cas_register_10k_op_linearizability_check_wall_s",
-        "value": round(wall, 3),
+        "value": round(wall, 3) if wall else None,
         "unit": "s",
-        "vs_baseline": round(cpu_wall / wall, 2),
+        "vs_baseline": (round(cpu_wall / wall, 2)
+                        if wall and cpu_wall else None),
         "extra": {
             "n_ops": N_OPS,
             "timing": "median-of-3",
+            "tier_isolation": "per-tier subprocess + timeout",
             "vs_baseline_is_lower_bound": vs_lower_bound,
-            "vs_target_60s": round(TARGET_S / wall, 2),
-            "cpu_baseline": cpu,
-            "easy": {"runs": easy_runs, "window": prep_easy.window,
-                     "configs_explored": r_easy.get("configs-explored"),
-                     "max_capacity_reached": r_easy.get(
-                         "max-capacity-reached")},
-            "hard": {"runs": hard_runs, "window": prep_hard.window,
-                     "valid": r_hard["valid"],
-                     "configs_explored": r_hard.get("configs-explored"),
-                     "max_capacity_reached": r_hard.get(
-                         "max-capacity-reached"),
-                     "error": r_hard.get("error")},
-            "ceiling": {"runs": ceil_runs, "window": prep_ceiling.window,
-                        "valid": r_ceil["valid"],
-                        "configs_explored": r_ceil.get("configs-explored"),
-                        "error": r_ceil.get("error")},
-            "refuted": {"runs": ref_runs,
-                        "failed_op_index": r_ref["op"]["index"],
-                        "configs_explored": r_ref.get("configs-explored")},
-            "batch": {"n_histories": BATCH_N, "ops_each": BATCH_OPS,
-                      "wall_s": round(batch_wall, 3),
-                      "histories_per_sec": round(BATCH_N / batch_wall, 1)},
+            "vs_target_60s": round(TARGET_S / wall, 2) if wall else None,
+            "cpu_baseline": tiers["cpu"],
+            "easy": easy,
+            "hard": tiers["hard"],
+            "ceiling": tiers["ceiling"],
+            "refuted": tiers["refuted"],
+            "batch": tiers["batch"],
+            "ablation": {"on": tiers["ablation_on"],
+                         "off": tiers["ablation_off"],
+                         "claim": "ghost subsumption: 2^crashes -> "
+                                  "O(crashes) configs (wgl_tpu.py:22-32)"},
+            "second_process_setup": tiers["setup2"],
             "chunk": CHUNK,
-            "setup_and_compile_s": setup_s,
-            "second_process_setup_s": setup2_s,
             "analyzer": "wgl-tpu",
         },
     }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -66,8 +66,18 @@ class Compose(Checker):
                                  opts)
                     for n in names}
             results = {n: f.result() for n, f in futs.items()}
-        return {"valid": merge_valid([r.get("valid") for r in results.values()]),
-                **results}
+        out = {"valid": merge_valid([r.get("valid")
+                                     for r in results.values()]),
+               **results}
+        # Surface crashed sub-checkers at the top level: an `unknown`
+        # verdict must say *which* checker raised *what* without anyone
+        # spelunking the nested result map (the reference prints the
+        # exception at checker.clj:74; here it also persists in results).
+        crashed = {n: r["traceback"] for n, r in results.items()
+                   if r.get("valid") == UNKNOWN and "traceback" in r}
+        if crashed:
+            out["errors"] = crashed
+        return out
 
 
 def compose(checkers: Dict[str, Checker]) -> Checker:
@@ -95,15 +105,19 @@ class Stats(Checker):
                 continue
             by_f[op.f][op.type] += 1
             total[op.type] += 1
-        valid = True
-        for f, c in by_f.items():
-            if c[OK] == 0 and (c[FAIL] > 0 or c[INFO] > 0):
-                valid = UNKNOWN  # nothing ever worked for this f
-        return {"valid": valid,
-                "count": sum(total.values()),
-                "ok-count": total[OK], "fail-count": total[FAIL],
-                "info-count": total[INFO],
-                "by-f": {f: dict(c) for f, c in by_f.items()}}
+        never = sorted(str(f) for f, c in by_f.items()
+                       if c[OK] == 0 and (c[FAIL] > 0 or c[INFO] > 0))
+        out = {"valid": UNKNOWN if never else True,
+               "count": sum(total.values()),
+               "ok-count": total[OK], "fail-count": total[FAIL],
+               "info-count": total[INFO],
+               "by-f": {f: dict(c) for f, c in by_f.items()}}
+        if never:
+            # say WHY, in the result itself: an unexplained `unknown` from a
+            # composed checker is exactly the verdict class this framework
+            # exists to catch in others
+            out["error"] = f"no ok operations for f in {never}"
+        return out
 
 
 class UnhandledExceptions(Checker):

@@ -1,0 +1,135 @@
+"""Second host-tier solver: memoized depth-first linearization search.
+
+Algorithmically distinct from :mod:`jepsen_tpu.checker.wgl_cpu` (which
+carries the FULL configuration set breadth-first through the history —
+knossos's WGL role): this solver walks the event stream depth-first,
+committing to one linearization choice at a time and backtracking on
+contradiction, with every visited ``(event, linearized-set, model)`` state
+memoized so no subtree is explored twice.  That is the knossos ``linear``
+role — the reference races linear vs wgl inside ``competition``
+(jepsen/src/jepsen/checker.clj:199-202), and racing two different
+algorithms both diversifies performance (DFS typically touches a tiny
+fraction of WGL's frontier on *valid* histories, since ops usually
+linearize in completion order) and cross-validates each against the other.
+
+Verdict-equivalence with the BFS oracle: both decide reachability over the
+same state graph — states are ``(event index, applied-pending bitmask,
+model state)``, DFS just orders the exploration differently and prunes
+visited states instead of deduplicating a frontier.  Ghosts (crashed ops
+that never return) may be applied or not; a fully consumed event stream is
+a witness (pending ghosts are optional, like the BFS oracle's final
+argument).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.checker.prep import (EV_ENTER, EV_RETURN, PreparedHistory,
+                                     prepare)
+from jepsen_tpu.checker.wgl_cpu import Cancelled, SearchExploded
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models.base import Inconsistent, Model
+
+
+def check(model: Model, history: History,
+          prepared: Optional[PreparedHistory] = None,
+          max_states: int = 2_000_000,
+          cancel=None) -> Dict[str, Any]:
+    """Decide linearizability by memoized DFS.  Returns a knossos-shaped
+    analysis map; raises :class:`SearchExploded` past ``max_states`` visited
+    states and :class:`Cancelled` when a competing solver already won."""
+    p = prepared if prepared is not None else prepare(history)
+    n = len(p)
+    if n == 0:
+        return {"valid": True, "analyzer": "linear-cpu",
+                "states-explored": 0}
+
+    # Per-event window reconstruction: slot -> Op at each RETURN event, and
+    # the op entering/returning at each event.  DFS backtracks across event
+    # indices, so the window must be addressable by event, not maintained
+    # incrementally the way the forward-only BFS driver does it.
+    window: Dict[int, Op] = {}
+    pending_at: List[Optional[List[Tuple[int, Op]]]] = [None] * n
+    ret_slot: List[int] = [0] * n
+    ret_op: List[Optional[Op]] = [None] * n
+    for e in range(n):
+        kind, slot, op_id = int(p.kind[e]), int(p.slot[e]), int(p.op_id[e])
+        if kind == EV_ENTER:
+            window[slot] = p.ops[op_id]
+        elif kind == EV_RETURN:
+            pending_at[e] = sorted(window.items())
+            ret_slot[e] = slot
+            ret_op[e] = p.ops[op_id]
+            del window[slot]
+
+    visited: set = set()
+    # Deepest stuck RETURN, for the refutation report.
+    deepest_e = -1
+
+    # Explicit stack of (event, mask, model, choice iterator).  A frame's
+    # iterator yields successor states lazily; exhausting it backtracks.
+    def successors(e: int, mask: int, m: Model):
+        """Lazily yield next states from (e, mask, m)."""
+        kind = int(p.kind[e])
+        if kind == EV_ENTER:
+            yield (e + 1, mask, m)
+            return
+        if kind != EV_RETURN:
+            yield (e + 1, mask, m)
+            return
+        slot = ret_slot[e]
+        bit = 1 << slot
+        if mask & bit:
+            # already linearized: consume the return, retire the bit
+            yield (e + 1, mask & ~bit, m)
+            return
+        # Must linearize more pending ops before this return can pass.
+        # Heuristic: try the returning op itself first — on valid histories
+        # ops overwhelmingly linearize in completion order, which is what
+        # makes the DFS fast where BFS pays for the whole frontier.
+        ordered = sorted(pending_at[e], key=lambda kv: kv[0] != slot)
+        for s, op in ordered:
+            b = 1 << s
+            if mask & b:
+                continue
+            m2 = m.step(op)
+            if isinstance(m2, Inconsistent):
+                continue
+            yield (e, mask | b, m2)
+
+    start = (0, 0, model)
+    visited.add(start)
+    stack: List[Tuple[int, int, Model, Any]] = [
+        (0, 0, model, successors(0, 0, model))]
+    steps = 0
+    while stack:
+        steps += 1
+        if (steps & 0xFFF) == 0 and cancel is not None and cancel.is_set():
+            raise Cancelled()
+        e, mask, m, it = stack[-1]
+        if int(p.kind[e]) == EV_RETURN:
+            deepest_e = max(deepest_e, e)
+        advanced = False
+        for nxt in it:
+            ne, nmask, nm = nxt
+            if ne >= n:
+                return {"valid": True, "analyzer": "linear-cpu",
+                        "states-explored": len(visited)}
+            key = (ne, nmask, nm)
+            if key in visited:
+                continue
+            visited.add(key)
+            if len(visited) > max_states:
+                raise SearchExploded(len(visited))
+            stack.append((ne, nmask, nm, successors(ne, nmask, nm)))
+            advanced = True
+            break
+        if not advanced:
+            stack.pop()
+
+    bad = ret_op[deepest_e] if deepest_e >= 0 else None
+    return {"valid": False, "analyzer": "linear-cpu",
+            "op": bad.to_dict() if bad is not None else None,
+            "states-explored": len(visited),
+            "deepest-event": deepest_e}

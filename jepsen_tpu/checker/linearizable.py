@@ -5,10 +5,13 @@ on ``:algorithm`` to knossos's linear/wgl/competition solvers.  Here the
 algorithms are:
 
 - ``"tpu"``          — the device engine (wgl_tpu), requires a JaxModel;
-- ``"cpu"``/"linear"/"wgl" — the host oracle (wgl_cpu), any Model;
-- ``"competition"``  — race both on two threads, first verdict wins
-  (knossos.competition parity; also the fallback tier for models with no
-  device encoding, SURVEY.md §7 hard-parts);
+- ``"cpu"``/``"wgl"`` — the host BFS oracle (wgl_cpu), any Model;
+- ``"linear"``       — the memoized DFS solver (linear_cpu), any Model —
+  the knossos ``linear`` role, algorithmically distinct from wgl;
+- ``"competition"``  — race device + both host solvers on threads, first
+  definite verdict wins (knossos.competition parity — the reference races
+  its two CPU algorithms the same way; also the fallback tier for models
+  with no device encoding, SURVEY.md §7 hard-parts);
 - default: "tpu" when the model has a device tier, else "cpu".
 """
 
@@ -18,7 +21,7 @@ import atexit
 import threading
 from typing import Any, Dict, List, Optional, Union
 
-from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+from jepsen_tpu.checker import linear_cpu, wgl_cpu, wgl_tpu
 from jepsen_tpu.checker.core import Checker, UNKNOWN
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel, Model
@@ -74,8 +77,9 @@ class Linearizable(Checker):
         elif algo in ("cpu", "linear", "wgl"):
             if cm is None:
                 return {"valid": UNKNOWN, "error": "no host-tier model"}
+            solver = linear_cpu if algo == "linear" else wgl_cpu
             try:
-                res = wgl_cpu.check(cm, history)
+                res = solver.check(cm, history)
             except wgl_cpu.SearchExploded as e:
                 return {"valid": UNKNOWN, "error": str(e)}
         elif algo == "competition":
@@ -103,18 +107,25 @@ class Linearizable(Checker):
             res["render-error"] = str(e)
 
     def _competition(self, test, history):
-        """Race the device engine and the host oracle; the first *definite*
-        verdict (valid True/False) wins and the loser is cancelled.  An
-        UNKNOWN from one racer — e.g. the CPU oracle exploding early — must
-        NOT mask a definite answer still coming from the other; only when
-        both racers finish indefinite does the race report unknown.
-        Parity: knossos.competition via checker.clj:199-202, which takes the
-        first non-:unknown analysis and cancels the losing future."""
+        """Race the device engine and BOTH host solvers (BFS wgl + DFS
+        linear — three algorithmically distinct searches); the first
+        *definite* verdict (valid True/False) wins and the losers are
+        cancelled.  An UNKNOWN from one racer — e.g. a host solver
+        exploding early — must NOT mask a definite answer still coming from
+        another; only when every racer finishes indefinite does the race
+        report unknown.  Parity: knossos.competition via
+        checker.clj:199-202, which races knossos's linear and wgl solvers
+        the same way, takes the first non-:unknown analysis and cancels the
+        losing futures."""
         jm, cm = self._jax_model(), self._cpu_model()
+        if jm is None and cm is None:
+            return {"valid": UNKNOWN, "error": "no model tier available"}
         if jm is None or cm is None:
-            # only one tier available: no race
-            self2 = Linearizable(self.model, None, **self.engine_opts)
-            return self2.check(test, history)
+            # only one tier available: no cross-tier race (a cm-only model
+            # still races its two host algorithms below when jm is None)
+            if cm is None:
+                self2 = Linearizable(self.model, None, **self.engine_opts)
+                return self2.check(test, history)
         done = threading.Event()
         cancel = threading.Event()
         lock = threading.Lock()
@@ -145,8 +156,8 @@ class Linearizable(Checker):
                             w["disagreement"] = {**r, "solver": solver}
                 else:
                     results["indefinite"][solver] = r
-                    if len(results["indefinite"]) == 2:
-                        done.set()  # both indefinite: race is over anyway
+                    if len(results["indefinite"]) == n_racers:
+                        done.set()  # all indefinite: race is over anyway
 
         def run_tpu():
             try:
@@ -156,19 +167,28 @@ class Linearizable(Checker):
                 r = {"valid": UNKNOWN, "error": str(e)}
             post("tpu", r)
 
-        def run_cpu():
-            try:
-                r = wgl_cpu.check(cm, history, cancel=cancel)
-            except wgl_cpu.Cancelled:
-                r = {"valid": UNKNOWN, "cancelled": True}
-            except wgl_cpu.SearchExploded as e:
-                r = {"valid": UNKNOWN, "error": str(e)}
-            except Exception as e:  # noqa: BLE001
-                r = {"valid": UNKNOWN, "error": str(e)}
-            post("cpu", r)
+        def run_host(name, solver):
+            def go():
+                try:
+                    r = solver.check(cm, history, cancel=cancel)
+                except wgl_cpu.Cancelled:
+                    r = {"valid": UNKNOWN, "cancelled": True}
+                except wgl_cpu.SearchExploded as e:
+                    r = {"valid": UNKNOWN, "error": str(e)}
+                except Exception as e:  # noqa: BLE001
+                    r = {"valid": UNKNOWN, "error": str(e)}
+                post(name, r)
+            return go
 
-        ts = [threading.Thread(target=run_tpu, daemon=True),
-              threading.Thread(target=run_cpu, daemon=True)]
+        ts = []
+        if jm is not None:
+            ts.append(threading.Thread(target=run_tpu, daemon=True))
+        if cm is not None:
+            ts.append(threading.Thread(target=run_host("cpu", wgl_cpu),
+                                       daemon=True))
+            ts.append(threading.Thread(target=run_host("linear", linear_cpu),
+                                       daemon=True))
+        n_racers = len(ts)
         for t in ts:
             t.start()
         done.wait()

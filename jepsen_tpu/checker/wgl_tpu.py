@@ -62,6 +62,21 @@ EV_NOP = 2
 # transfer of chunk i overlaps with the device computing chunk i+1.
 LOOKAHEAD = 2
 
+# Closure expansion runs the window in blocks of this many slots; a block
+# whose candidates are all invalid (inactive slots, op already held, model
+# step refuses) skips its sort+dedup entirely via lax.cond.  Real windows
+# are wide (crashed ops pin slots forever) but *live* slots cluster in a
+# few blocks, so this cuts per-closure sorted rows from C*(W+1) to C*(B+1)
+# per active block — both the dominant cost at high capacity and the reason
+# a chunk's XLA program could outlive the TPU worker's watchdog.
+EXPAND_BLOCK = 8
+
+
+def engine_window(window: int) -> int:
+    """The padded slot count an engine built for ``window`` actually uses."""
+    return ((window + EXPAND_BLOCK - 1) // EXPAND_BLOCK) * EXPAND_BLOCK
+
+
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
 #          overflow, explored, rounds, peak, ghosts)
 # peak is the high-water mark of the distinct-configuration count since the
@@ -87,6 +102,12 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     crashed the TPU compiler).
     """
     assert window > 0
+    # The closure expands the window in fixed blocks (see closure); pad the
+    # slot count to a block multiple — surplus slots are never active, so
+    # their blocks always take the skip branch.  Callers building
+    # window-shaped carries outside carry0 (parallel.sharded) must use
+    # engine_window() for the same padding.
+    window = engine_window(window)
     try:
         # All three engine paths (single-chip, sharded, batched) build here;
         # enabling the persistent compilation cache at this shared layer
@@ -184,26 +205,22 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # 2^crashes configuration blowup that kills knossos into
         # O(crashes) — see BENCH ghost tiers.
         count0 = global_sum(valid.sum())
+        n_blocks = (W + EXPAND_BLOCK - 1) // EXPAND_BLOCK
 
-        def cond(c):
-            _, _, _, _, changed, ovf, it = c
-            return changed & ~ovf & (it < W + 1)
-
-        def body(c):
-            mask, states, valid, count, _, ovf, it = c
-            cand_states, ok = expand(states, win_ops)
-            has = ((mask[:, None, :] & slot_masks[None, :, :]) != 0).any(-1)
-            cand_valid = valid[:, None] & active[None, :] & ~has & ok
-            cand_mask = mask[:, None, :] | slot_masks[None, :, :]
-
-            all_mask = jnp.concatenate([mask, cand_mask.reshape(C * W, MW)])
-            all_states = jnp.concatenate([states, cand_states.reshape(C * W, S)])
-            all_valid = jnp.concatenate([valid, cand_valid.reshape(C * W)])
+        def merge_rows(mask, states, valid, cand_mask, cand_states,
+                       cand_valid, count, ovf):
+            """Dedup/compact the union of the existing set and one block's
+            candidate rows; returns the new set + fixpoint/overflow."""
+            nc = cand_valid.shape[0]
+            all_mask = jnp.concatenate([mask, cand_mask])
+            all_states = jnp.concatenate([states, cand_states])
+            all_valid = jnp.concatenate([valid, cand_valid])
             origin = jnp.concatenate([jnp.zeros(C, jnp.int32),
-                                      jnp.ones(C * W, jnp.int32)])
+                                      jnp.ones(nc, jnp.int32)])
             if axis_name is not None:
                 all_mask = lax.all_gather(all_mask, axis_name, tiled=True)
-                all_states = lax.all_gather(all_states, axis_name, tiled=True)
+                all_states = lax.all_gather(all_states, axis_name,
+                                            tiled=True)
                 all_valid = lax.all_gather(all_valid, axis_name, tiled=True)
                 origin = lax.all_gather(origin, axis_name, tiled=True)
             keyed = all_mask & ~ghosts[None, :]
@@ -224,11 +241,57 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 new_mask = lax.dynamic_slice_in_dim(new_mask, start, C)
                 new_states = lax.dynamic_slice_in_dim(new_states, start, C)
                 out_valid = lax.dynamic_slice_in_dim(out_valid, start, C)
+            return new_mask, new_states, out_valid, total, new_rows, \
+                ovf | ovf2
+
+        def cond(c):
+            _, _, _, _, changed, ovf, it = c
+            return changed & ~ovf & (it < W + 1)
+
+        B = EXPAND_BLOCK
+
+        def block(b, acc):
+            # One compiled block body, indexed dynamically — a python
+            # unroll of W/B cond'd sort+dedup graphs made TPU compiles
+            # pathologically long; fori_loop keeps the graph one block big.
+            mask, states, valid, count, changed, ovf = acc
+            lo = b * B
+            wo = lax.dynamic_slice_in_dim(win_ops, lo, B)     # [B, 6]
+            smb = lax.dynamic_slice_in_dim(slot_masks, lo, B)
+            act = lax.dynamic_slice_in_dim(active, lo, B)
+            cand_states, ok = expand(states, wo)              # [C, B, S]
+            has = ((mask[:, None, :] & smb[None, :, :]) != 0).any(-1)
+            cand_valid = valid[:, None] & act[None, :] & ~has & ok
+            # Uniform across shards (global any) so every device takes
+            # the same cond branch.
+            some = global_sum(cand_valid.sum()) > 0
+
+            def do(args):
+                mask, states, valid, count, ovf = args
+                cand_mask = (mask[:, None, :] | smb[None, :, :]) \
+                    .reshape(C * B, MW)
+                return merge_rows(mask, states, valid, cand_mask,
+                                  cand_states.reshape(C * B, S),
+                                  cand_valid.reshape(C * B),
+                                  count, ovf)
+
+            def skip(args):
+                mask, states, valid, count, ovf = args
+                return (mask, states, valid, count, jnp.bool_(False), ovf)
+
+            mask, states, valid, count, new_rows, ovf = lax.cond(
+                some, do, skip, (mask, states, valid, count, ovf))
+            return (mask, states, valid, count, changed | new_rows, ovf)
+
+        def body(c):
+            mask, states, valid, count, _, ovf, it = c
+            mask, states, valid, count, changed, ovf = lax.fori_loop(
+                0, n_blocks, block,
+                (mask, states, valid, count, jnp.bool_(False), ovf))
             # Fixpoint signal: a kept candidate, NOT a count delta —
             # subsumption can drop an existing row in the round that adds a
             # new one, leaving the count level while the set moved.
-            return (new_mask, new_states, out_valid, total, new_rows,
-                    ovf | ovf2, it + 1)
+            return (mask, states, valid, count, changed, ovf, it + 1)
 
         init = (mask, states, valid, count0, jnp.bool_(True), overflow,
                 jnp.int32(0))
@@ -355,9 +418,10 @@ def _get_run_chunk(model: JaxModel, window: int, capacity: int,
     # Same-named registry models share step semantics; keying on the name +
     # initial state (not the closure id) lets every get_model() call reuse
     # one compiled engine.
+    from jepsen_tpu.ops import dedup as _dedup
     key = (model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
-           gwords)
+           gwords, _dedup.N_PROBES, _dedup.WIDE_SORT_ROWS, _dedup.SUBSUME)
     if key not in _ENGINE_CACHE:
         carry0, _, run_chunk = make_engine(model, window, capacity,
                                            gwords=gwords)
@@ -389,6 +453,24 @@ def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:
 def ghost_words(p: PreparedHistory) -> int:
     """Compact ghost words an engine needs for this history."""
     return max(1, (int(p.n_ghosts) + 31) // 32)
+
+
+#: Per-dispatch work budget, in capacity x events units.  One chunk's XLA
+#: program must finish well inside the TPU worker's watchdog (a ~60 s
+#: program gets the worker killed — the round-2 bench death); per-event
+#: closure cost scales with capacity, so the driver shrinks the chunk as it
+#: escalates.  512 events at capacity 1024 is the measured-comfortable
+#: baseline shape.
+CHUNK_WORK_BUDGET = 512 * 1024
+
+
+def chunk_for_capacity(capacity: int, base_chunk: int) -> int:
+    """Events per dispatch at ``capacity``: the largest power-of-two chunk
+    <= base_chunk whose capacity x chunk work fits the budget (floor 8)."""
+    c = base_chunk
+    while c > 8 and c * capacity > CHUNK_WORK_BUDGET:
+        c //= 2
+    return max(8, c)
 
 
 #: Configuration budget for the CPU witness re-derivation on refuted
@@ -430,18 +512,31 @@ def check(model: JaxModel, history: Optional[History] = None,
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
     window = _round_window(p.window)
-    ev = events_array(p, chunk)
-    n_chunks = ev.shape[0] // chunk
+    # Pad the event stream to a chunk multiple PLUS one chunk-sized NOP
+    # cushion: progress is tracked in *event* units (chunk size changes
+    # with capacity — see chunk_for_capacity, always dividing down from
+    # ``chunk``), and the cushion guarantees any in-bounds dispatch offset
+    # can slice a full chunk without clamping back into (and re-applying!)
+    # real events.  Trailing NOPs are inert.  Small-chunk callers keep
+    # their small streams — padding to a fixed 512 would multiply
+    # dispatches on short histories, and per-dispatch host polls are the
+    # dominant cost on tunneled devices.
+    base = chunk
+    ev = events_array(p, base)
+    n_events = ev.shape[0]
+    ev = np.concatenate([ev, ev[:1].repeat(base, axis=0) * 0])
+    ev[n_events:, 0] = EV_NOP
     # One host->device transfer for the whole stream; per-chunk slices then
     # happen device-side.  A per-chunk jnp.asarray would be a blocking
     # ~12 KB RPC per dispatch — on a tunneled device that synchronous
     # transfer, not compute, dominated the easy-history wall-clock.
     ev_dev = jnp.asarray(ev)
-    slice_chunk = _chunk_slicer(chunk)
 
     gw = ghost_words(p)
     cap = capacity
     max_cap_reached = cap  # diagnostics: how far escalation actually went
+    cur_chunk = chunk_for_capacity(cap, chunk)
+    slice_chunk = _chunk_slicer(cur_chunk)
     carry0, run_chunk = _get_run_chunk(model, window, cap, gw)
     carry = carry0()
     recent_peaks: deque = deque(maxlen=4)  # per-chunk high-water marks
@@ -451,26 +546,24 @@ def check(model: JaxModel, history: Optional[History] = None,
     # failed/overflow lane is set, event_step gates all updates, so
     # speculative chunks past a failure compute nothing wrong — they are
     # simply discarded on resume.
-    inflight: deque = deque()  # (ci, carry_before, carry_after, flags)
-    next_ci = 0
-    # n_chunks >= 1 always (events_array pads to a chunk multiple of at
-    # least one chunk), so the loop pops at least once and failed/overflow/
-    # carry are always (re)assigned before use below.
+    inflight: deque = deque()  # (pos, carry_before, carry_after, flags)
+    pos = 0
+    # n_events >= 512 always, so the loop pops at least once and failed/
+    # overflow/carry are always (re)assigned before use below.
     while True:
         # Poll cancellation before refilling the pipeline, so a lost race
         # doesn't dispatch up to LOOKAHEAD more chunks of discarded work.
         if cancel is not None and cancel.is_set():
             return {"valid": "unknown", "analyzer": "wgl-tpu",
                     "cancelled": True}
-        while len(inflight) < LOOKAHEAD and next_ci < n_chunks:
+        while len(inflight) < LOOKAHEAD and pos < n_events:
             prev = carry
-            carry, flags = run_chunk(
-                carry, slice_chunk(ev_dev, next_ci * chunk))
-            inflight.append((next_ci, prev, carry, flags))
-            next_ci += 1
+            carry, flags = run_chunk(carry, slice_chunk(ev_dev, pos))
+            inflight.append((pos, prev, carry, flags))
+            pos += cur_chunk
         if not inflight:
             break
-        ci, prev, after, flags = inflight.popleft()
+        cpos, prev, after, flags = inflight.popleft()
         fl = np.asarray(flags)
         failed, overflow = bool(fl[0]), bool(fl[1])
         peak = int(fl[2])
@@ -484,9 +577,11 @@ def check(model: JaxModel, history: Optional[History] = None,
             max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
+            cur_chunk = chunk_for_capacity(cap, chunk)
+            slice_chunk = _chunk_slicer(cur_chunk)
             _, run_chunk = _get_run_chunk(model, window, cap, gw)
             carry = _grow_carry(prev, cap)
-            next_ci = ci
+            pos = cpos
             overflow = False
             continue
         done = after
@@ -510,9 +605,12 @@ def check(model: JaxModel, history: Optional[History] = None,
                 cap = target
                 recent_peaks.clear()
                 inflight.clear()
+                done_chunk = cur_chunk  # size the popped chunk ran with
+                cur_chunk = chunk_for_capacity(cap, chunk)
+                slice_chunk = _chunk_slicer(cur_chunk)
                 _, run_chunk = _get_run_chunk(model, window, cap, gw)
                 carry = _shrink_carry(after, cap)
-                next_ci = ci + 1
+                pos = cpos + done_chunk
     carry = done
 
     explored = int(carry[9])

@@ -76,8 +76,21 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
                 # (idempotent via the _logs_snarfed flag).
                 _snarf_logs_safe(test)
                 control.teardown_sessions(test)
+            _close_resources(test)
     finally:
         store.stop_logging(log_handler)
+
+
+def _close_resources(test) -> None:
+    """Close test-scoped resources (with-resources parity, core.clj:70):
+    anything a suite put in test["resources"] — e.g. the localkv proxy
+    router's listener sockets/threads — is closed when the run ends,
+    best-effort, never masking the run's own outcome."""
+    for r in test.get("resources") or []:
+        try:
+            r.close()
+        except Exception:  # noqa: BLE001
+            logger.exception("closing test resource %r", r)
 
 
 def _setup_os(test) -> None:
@@ -212,8 +225,29 @@ def _log_results(results: Dict[str, Any]) -> None:
         logger.info("Everything looks good! (⌐■_■)")
     elif v == UNKNOWN:
         logger.warning("Errors occurred during analysis; verdict unknown")
+        for where, tb in iter_analysis_errors(results):
+            logger.warning("analysis error in %s:\n%s", "/".join(where), tb)
     else:
         logger.error("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+
+
+def iter_analysis_errors(results: Any, path=()):
+    """Yield ``(path, reason)`` for every unknown-with-a-reason anywhere in
+    a (possibly nested — compose / independent) result map: crashed
+    checkers contribute their traceback, non-crash unknowns (capacity
+    ceilings, never-succeeded ops, cancellations) their ``error`` string."""
+    if not isinstance(results, dict):
+        return
+    if results.get("valid") == UNKNOWN:
+        if "traceback" in results:
+            yield path, results["traceback"]
+        elif "error" in results:
+            yield path, str(results["error"])
+        elif results.get("cancelled"):
+            yield path, "cancelled (competition loser)"
+    for k, value in results.items():
+        if isinstance(value, dict):
+            yield from iter_analysis_errors(value, path + (str(k),))
 
 
 def run_tests(tests, raise_on_failure: bool = False):

@@ -170,3 +170,97 @@ def find_cycle(g: Graph, component: List[Any]) -> Optional[List[Any]]:
 
 def cycle_edge_kinds(g: Graph, cycle: List[Any]) -> List[Set[str]]:
     return [g.edge_kinds(a, b) for a, b in zip(cycle, cycle[1:])]
+
+
+def gsingle_cycles(g: Graph, cap: int = 64):
+    """Cycles with exactly one anti-dependency (rw) edge: for each rw edge
+    a->b, a shortest return path b ->* a through edges that each offer a
+    non-rw kind.  This is the targeted G-single search (elle runs one per
+    anomaly type) — the generic shortest-cycle pass can surface a different,
+    SI-legal cycle from the same SCC and miss these."""
+    out = []
+    for a in list(g.out):
+        for b, ks in g.out[a].items():
+            if "rw" not in ks:
+                continue
+            path = _bfs_path(g, b, a, lambda kinds: bool(kinds - {"rw"}))
+            if path is not None:
+                out.append([a] + path)
+                if len(out) >= cap:
+                    return out
+    return out
+
+
+def nonadjacent_rw_cycles(g: Graph, cap: int = 64):
+    """Cycles with >= 2 rw edges and no two adjacent around the cycle —
+    the shape snapshot isolation cannot admit (every cycle in an SI
+    execution carries two *consecutive* anti-dependency edges; Fekete).
+
+    For each rw edge a->b, BFS over states (node, last-edge-was-rw,
+    used-a-second-rw) from (b, True, False) to (a, False, True): the start
+    state forbids an rw first hop (adjacent to a->b), the goal state forbids
+    an rw arrival at a (cyclically adjacent to a->b) and demands a second,
+    necessarily nonadjacent, rw somewhere in the path."""
+    out = []
+    for a in list(g.out):
+        for b, ks in g.out[a].items():
+            if "rw" not in ks:
+                continue
+            start = (b, True, False)
+            prev: Dict[Any, Any] = {start: None}
+            q = deque([start])
+            goal = None
+            while q and goal is None:
+                st = q.popleft()
+                n, last_rw, extra = st
+                for m, mks in g.out.get(n, {}).items():
+                    steps = []
+                    if mks - {"rw"}:
+                        steps.append((m, False, extra))
+                    if "rw" in mks and not last_rw:
+                        steps.append((m, True, True))
+                    for nxt in steps:
+                        if nxt in prev:
+                            continue
+                        prev[nxt] = st
+                        if nxt == (a, False, True):
+                            goal = nxt
+                            break
+                        q.append(nxt)
+                    if goal:
+                        break
+            if goal is None:
+                continue
+            path = []
+            st = goal
+            while st is not None:
+                path.append(st[0])
+                st = prev[st]
+            path.reverse()                 # [b, ..., a]
+            out.append([a] + path)
+            if len(out) >= cap:
+                return out
+    return out
+
+
+def _bfs_path(g: Graph, src, dst, edge_ok) -> Optional[List[Any]]:
+    """Shortest path src ->* dst using edges where ``edge_ok(kinds)``;
+    returns [src, ..., dst] (src == dst gives a self-returning path only via
+    an actual cycle, never the empty path)."""
+    prev: Dict[Any, Any] = {src: None}
+    q = deque([src])
+    while q:
+        n = q.popleft()
+        for m, ks in g.out.get(n, {}).items():
+            if not edge_ok(ks):
+                continue
+            if m == dst:
+                path = [m, n]
+                while prev[path[-1]] is not None:
+                    path.append(prev[path[-1]])
+                path.reverse()
+                return path
+            if m not in prev:
+                prev[m] = n
+                q.append(m)
+    return None

@@ -25,28 +25,98 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
+from jepsen_tpu.elle import consistency
+from jepsen_tpu.elle.graph import (Graph, cycle_edge_kinds, gsingle_cycles,
+                                   nonadjacent_rw_cycles, peeled_cycles)
 from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
 
-CYCLE_SEVERITY = ["G0", "G1c", "G-single", "G2-item"]
+CYCLE_SEVERITY = ["G0", "G1c", "G-single", "G-nonadjacent", "G2-item"]
 
 
 def classify_cycle(kind_sets: List[Set[str]]) -> str:
-    has_rw = sum(1 for ks in kind_sets if ks <= {"rw"})
-    any_rw = any("rw" in ks for ks in kind_sets)
-    only_ww = all("ww" in ks for ks in kind_sets)
-    if only_ww and not any_rw:
-        return "G0"
-    if not any_rw:
-        return "G1c"
-    if has_rw == 1 or sum(1 for ks in kind_sets if "rw" in ks) == 1:
-        return "G-single"
-    return "G2-item"
+    """Label a cycle by the *weakest-model-refuting* reading of its edges:
+    an edge offering a non-rw kind is read as non-rw (fewer anti-dependency
+    edges refute weaker models), and edges closable only in realtime push
+    the label to its ``-realtime`` variant (refutes only the strict tier).
+
+    G0 all-ww < G1c ww+wr < G-single (one forced rw) < G-nonadjacent
+    (>= 2 forced rw, none cyclically adjacent — the un-SI-able shape) <
+    G2-item (>= 2 forced rw, some adjacent — SI-legal write skew)."""
+    rt_needed = any(ks == {"realtime"} for ks in kind_sets)
+    core = [ks - {"realtime"} for ks in kind_sets]
+    rw_pos = [i for i, ks in enumerate(core) if ks == {"rw"}]
+    n = len(core)
+    if not rw_pos:
+        if all((not ks) or ("ww" in ks) for ks in core):
+            label = "G0"
+        else:
+            label = "G1c"
+    elif len(rw_pos) == 1:
+        label = "G-single"
+    else:
+        adjacent = any((j - i) % n == 1
+                       for i in rw_pos for j in rw_pos if i != j)
+        label = "G2-item" if adjacent else "G-nonadjacent"
+    return label + ("-realtime" if rt_needed else "")
 
 
-def check(history: History, consistency_models: Sequence[str] = ("serializable",),
+def _cycle_sig(cyc: List[int]) -> Tuple[int, ...]:
+    """Rotation-normalized signature of a cycle [n0, ..., n0]."""
+    body = tuple(cyc[:-1])
+    k = body.index(min(body))
+    return body[k:] + body[:k]
+
+
+def collect_cycle_anomalies(g: Graph, txn_of: Dict[int, List],
+                            anomalies: Dict[str, List[Any]]) -> None:
+    """Run the full cycle-search suite and file each distinct cycle under
+    its label.  The generic peeled pass alone is not enough below
+    serializability: one SCC can hide a G-single or G-nonadjacent witness
+    behind a shorter SI-legal cycle, so each anomaly family gets its own
+    targeted search (elle searches per anomaly type the same way):
+
+    - ww subgraph          -> G0
+    - ww+wr subgraph       -> G1c (its all-ww cycles dedup into G0)
+    - one-rw return paths  -> G-single
+    - nonadjacent-rw BFS   -> G-nonadjacent
+    - full graph, peeled   -> G2-item and anything the above missed
+    """
+    searches = [
+        peeled_cycles(g.filter_kinds({"ww", "realtime"})),
+        peeled_cycles(g.filter_kinds({"ww", "wr", "realtime"})),
+        gsingle_cycles(g),
+        nonadjacent_rw_cycles(g),
+        peeled_cycles(g),
+    ]
+    seen: Set[Tuple] = set()
+    for cycles in searches:
+        for cyc in cycles:
+            kinds = cycle_edge_kinds(g, cyc)
+            label = classify_cycle(kinds)
+            key = (label, _cycle_sig(cyc))
+            if key in seen:
+                continue
+            seen.add(key)
+            anomalies[label].append({
+                "cycle": [txn_of[t] for t in cyc],
+                "edges": [sorted(ks) for ks in kinds]})
+
+
+def check(history: History,
+          consistency_models: Optional[Sequence[str]] = None,
           realtime: bool = False) -> Dict[str, Any]:
-    """Analyze a list-append history; returns an elle-shaped result map."""
+    """Analyze a list-append history; returns an elle-shaped result map.
+
+    ``consistency_models`` selects what ``valid`` means (append.clj:15-21
+    parity): all anomalies found are always reported, but only those that
+    refute a *requested* model make the history invalid — e.g. a G2-item
+    write-skew cycle refutes ``("serializable",)`` (the default) yet passes
+    ``("snapshot-isolation",)``.  The result carries elle's weakest-model
+    boundary under ``not`` / ``also-not``.  Default: serializable, or
+    strict-serializable when ``realtime`` ordering is requested."""
+    if consistency_models is None:
+        consistency_models = (("strict-serializable",) if realtime
+                              else ("serializable",))
     oks: List[Tuple[int, Op]] = []
     failed_writes: Set[Tuple[Any, Any]] = set()
     info_writes: Set[Tuple[Any, Any]] = set()
@@ -126,12 +196,33 @@ def check(history: History, consistency_models: Sequence[str] = ("serializable",
     for tid in range(len(oks)):
         g.add_node(tid)
 
+    # Values appended but never observed by any read still have a sound
+    # place in the (append-only) version order: had such an append preceded
+    # the state some read observed, the value would appear in that read, so
+    # every unobserved append follows the longest observed list — giving ww
+    # edges from the last observed writer and rw edges from every reader
+    # (this is what makes pure write skew — two reads of [] and two blind
+    # appends — a detectable G2-item cycle).
+    by_key: Dict[Any, List[Any]] = defaultdict(list)
+    for (k, v) in writer:
+        by_key[k].append(v)
+    unobserved: Dict[Any, List[Any]] = {}
+    for k, vs in by_key.items():
+        obs = set(longest.get(k, []))
+        unobserved[k] = [v for v in vs if v not in obs]
+
     for k, order in longest.items():
         # ww edges along the version order
         for a, b in zip(order, order[1:]):
             wa, wb = writer.get((k, a)), writer.get((k, b))
             if wa is not None and wb is not None and wa != wb:
                 g.add_edge(wa, wb, "ww")
+        if order:
+            wa = writer.get((k, order[-1]))
+            for v in unobserved.get(k, ()):
+                wb = writer.get((k, v))
+                if wa is not None and wb is not None and wa != wb:
+                    g.add_edge(wa, wb, "ww")
 
     for rtid, (_, op) in enumerate(oks):
         for f, k, v in op.value:
@@ -150,6 +241,14 @@ def check(history: History, consistency_models: Sequence[str] = ("serializable",
                 w = writer.get((k, nxt))
                 if w is not None and w != rtid:
                     g.add_edge(rtid, w, "rw")
+            # rw: every unobserved append to k follows any observed state
+            observed = set(lst)
+            for v in unobserved.get(k, ()):
+                if v in observed:
+                    continue
+                w = writer.get((k, v))
+                if w is not None and w != rtid:
+                    g.add_edge(rtid, w, "rw")
 
     if realtime:
         # T1 -> T2 if T1's completion index < T2's invocation index
@@ -162,19 +261,23 @@ def check(history: History, consistency_models: Sequence[str] = ("serializable",
                 if inv2 >= 0 and i1 < inv2:
                     g.add_edge(t1, t2, "realtime")
 
-    # cycles: peel every node-disjoint cycle out of each SCC
-    for cyc in peeled_cycles(g):
-        kinds = cycle_edge_kinds(g, cyc)
-        label = classify_cycle(kinds)
-        anomalies[label].append({
-            "cycle": [txn_of[t] for t in cyc],
-            "edges": [sorted(ks) for ks in kinds]})
+    collect_cycle_anomalies(g, txn_of, anomalies)
 
-    valid = not anomalies
+    return finish_result(anomalies, consistency_models, len(oks))
+
+
+def finish_result(anomalies: Dict[str, List[Any]],
+                  consistency_models: Sequence[str],
+                  count: int) -> Dict[str, Any]:
+    """Shared result assembly: model-relative validity + boundary report."""
+    valid = consistency.judge(consistency_models, anomalies)
     return {"valid": valid,
+            "consistency-models": [consistency.canonicalize(m)
+                                   for m in consistency_models],
+            **consistency.boundary(anomalies),
             "anomaly-types": sorted(anomalies),
             "anomalies": {k: v[:8] for k, v in anomalies.items()},
             # complete map for artifact rendering; popped by
             # elle.render.write_artifacts so results stay small
             "anomalies-full": dict(anomalies),
-            "count": len(oks)}
+            "count": count}

@@ -38,15 +38,23 @@ import bisect
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
-from jepsen_tpu.elle.list_append import classify_cycle
+from jepsen_tpu.elle.graph import Graph
+from jepsen_tpu.elle.list_append import (collect_cycle_anomalies,
+                                         finish_result)
 from jepsen_tpu.history import FAIL, History, INFO, OK, Op
 from jepsen_tpu.txn import READ_FS, WRITE_FS
 
 
 def check(history: History, realtime: bool = False,
+          consistency_models: Optional[Sequence[str]] = None,
           sequential_keys: bool = False,
           linearizable_keys: bool = False) -> Dict[str, Any]:
+    """Analyze an rw-register history; ``consistency_models`` selects what
+    ``valid`` means (wr.clj:9-25 consumes elle the same way) — see
+    :func:`jepsen_tpu.elle.list_append.check`."""
+    if consistency_models is None:
+        consistency_models = (("strict-serializable",) if realtime
+                              else ("serializable",))
     pairs = history.pair_index()
     oks: List[Tuple[int, Op]] = []
     failed_writes: Set[Tuple[Any, Any]] = set()
@@ -162,19 +170,9 @@ def check(history: History, realtime: bool = False,
                     if inv2 >= 0 and i1 < inv2:
                         g.add_edge(t1, t2, "realtime")
 
-    for cyc in peeled_cycles(g):
-        kinds = cycle_edge_kinds(g, cyc)
-        anomalies[classify_cycle(kinds)].append({
-            "cycle": [txn_of[t] for t in cyc],
-            "edges": [sorted(ks) for ks in kinds]})
+    collect_cycle_anomalies(g, txn_of, anomalies)
 
-    return {"valid": not anomalies,
-            "anomaly-types": sorted(anomalies),
-            "anomalies": {k: v[:8] for k, v in anomalies.items()},
-            # complete map for artifact rendering; popped by
-            # elle.render.write_artifacts so results stay small
-            "anomalies-full": dict(anomalies),
-            "count": len(oks)}
+    return finish_result(anomalies, consistency_models, len(oks))
 
 
 def _order_writes(oks, pairs, vg, sequential_keys, linearizable_keys) -> None:

@@ -249,10 +249,19 @@ class IndependentChecker(Checker):
                 results = {k: f.result() for k, f in futs.items()}
 
         bad = {k: r for k, r in results.items() if r.get("valid") is not True}
-        return {"valid": merge_valid([r.get("valid") for r in results.values()]),
-                "key-count": len(keys),
-                "results": results,
-                "failures": sorted(bad, key=repr)}
+        out = {"valid": merge_valid([r.get("valid")
+                                     for r in results.values()]),
+               "key-count": len(keys),
+               "results": results,
+               "failures": sorted(bad, key=repr)}
+        # Engine disagreement is a framework bug signal: surface it beside
+        # `failures` so nobody has to scan per-key result maps to notice a
+        # batch refutation the re-derivation didn't confirm.
+        disagreements = sorted((k for k, r in results.items()
+                                if "recheck" in r), key=repr)
+        if disagreements:
+            out["disagreements"] = disagreements
+        return out
 
     @staticmethod
     def _key_opts(opts, k):

@@ -1,0 +1,238 @@
+"""Socket-level partitions: a framework-owned TCP proxy per node pair.
+
+The reference's partitioner rewires iptables on real cluster nodes
+(jepsen/src/jepsen/nemesis.clj:158-285, net.clj:176-186).  In environments
+with no root/netfilter (one-host real-process suites like localkv), the
+same *grudge* semantics — ``{dst: [srcs dst refuses to hear from]}`` — are
+enforced one layer up the stack: every inter-node link dials through a
+:class:`PairProxy` owned by the harness, and severing a link closes its
+live TCP connections (peers see a real RST/EOF mid-flight, exactly what a
+dropped link looks like to an application) and refuses new ones.
+
+Usage: build a :class:`ProxyRouter` over the node roster before DB setup,
+point each node's peer-address config at ``router.addr(src, dst)``, put
+``test["net"] = ProxyNet(router)`` in the test map, and the stock
+:class:`~jepsen_tpu.nemesis.partition.Partitioner` (and so the whole
+``nemesis/combined.py`` partition package and its grudge algebra —
+halves/one/majorities-ring) drives it unchanged.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.net import Net
+
+
+class PairProxy:
+    """One direction of one link: listens on a stable port, forwards byte
+    streams to ``target``.  ``sever()`` kills live connections (RST) and
+    CLOSES the listener, so new dials get ECONNREFUSED — a *definite*
+    failure the client can classify as :fail, like iptables REJECT.  (An
+    accept-then-close sever was tried first: it turns every op during a
+    partition into an indeterminate :info ghost, which is both a worse
+    model of a cut link and an unbounded load on the linearizability
+    checker's pending window.)  ``heal()`` re-binds the same port."""
+
+    def __init__(self, src: str, dst: str, target: Tuple[str, int]):
+        self.src, self.dst = src, dst
+        self.target = target
+        self.severed = False
+        self._lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        self.port = srv.getsockname()[1]
+        self._srv: Optional[socket.socket] = None
+        self._placeholder: Optional[socket.socket] = None
+        with self._lock:
+            self._listen(srv)
+
+    def _listen(self, srv: socket.socket) -> None:
+        """Start listening on an already-bound socket.  Holds the lock."""
+        srv.listen(64)
+        self._srv = srv
+        threading.Thread(target=self._accept_loop, args=(srv,), daemon=True,
+                         name=f"proxy-{self.src}->{self.dst}").start()
+
+    def _bind_reserved(self) -> socket.socket:
+        """A socket bound to our port but NOT listening: dials get
+        ECONNREFUSED, and nothing else (e.g. an ephemeral outbound socket —
+        observed in practice) can claim the port while the link is down."""
+        last: Optional[OSError] = None
+        for _ in range(200):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", self.port))
+                return s
+            except OSError as e:  # lost the close->rebind race; retry
+                last = e
+                s.close()
+                time.sleep(0.01)
+        raise last  # type: ignore[misc]
+
+    # -- control -----------------------------------------------------------
+
+    def sever(self) -> None:
+        with self._lock:
+            if self.severed:
+                return
+            self.severed = True
+            conns, self._conns = self._conns, []
+            srv, self._srv = self._srv, None
+        if srv is not None:
+            try:
+                srv.close()  # new dials now get ECONNREFUSED
+            except OSError:
+                pass
+        ph = self._bind_reserved()
+        with self._lock:
+            self._placeholder = ph
+        for c in conns:
+            try:
+                # RST rather than FIN: a partitioned peer mid-request sees
+                # a hard failure, not a graceful close
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def heal(self) -> None:
+        with self._lock:
+            if not self.severed:
+                return
+            self.severed = False
+            ph, self._placeholder = self._placeholder, None
+            # the reserved socket simply starts listening: no unbind window
+            self._listen(ph)
+
+    def close(self) -> None:
+        self.sever()
+        with self._lock:
+            ph, self._placeholder = self._placeholder, None
+        if ph is not None:
+            try:
+                ph.close()
+            except OSError:
+                pass
+
+    # -- data path ---------------------------------------------------------
+
+    def _accept_loop(self, srv: socket.socket) -> None:
+        while True:
+            try:
+                client, _ = srv.accept()
+            except OSError:
+                return  # listener closed (sever or shutdown)
+            threading.Thread(target=self._pump_pair, args=(client,),
+                             daemon=True).start()
+
+    def _pump_pair(self, client: socket.socket) -> None:
+        try:
+            upstream = socket.create_connection(self.target, timeout=2)
+        except OSError:
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            if self.severed:
+                for s in (client, upstream):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                return
+            self._conns += [client, upstream]
+        threading.Thread(target=self._pump, args=(client, upstream),
+                         daemon=True).start()
+        threading.Thread(target=self._pump, args=(upstream, client),
+                         daemon=True).start()
+
+    @staticmethod
+    def _pump(a: socket.socket, b: socket.socket) -> None:
+        try:
+            while True:
+                data = a.recv(65536)
+                if not data:
+                    break
+                b.sendall(data)
+        except OSError:
+            pass
+        for s in (a, b):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class ProxyRouter:
+    """All directed (src, dst) proxies for a node roster.  ``addr(src,
+    dst)`` is the address ``src``'s process must dial to reach ``dst``."""
+
+    def __init__(self, nodes: Sequence[str],
+                 real_ports: Dict[str, int]):
+        self.nodes = list(nodes)
+        self.proxies: Dict[Tuple[str, str], PairProxy] = {}
+        for src in nodes:
+            for dst in nodes:
+                if src != dst:
+                    self.proxies[(src, dst)] = PairProxy(
+                        src, dst, ("127.0.0.1", real_ports[dst]))
+
+    def addr(self, src: str, dst: str) -> Tuple[str, int]:
+        p = self.proxies[(src, dst)]
+        return ("127.0.0.1", p.port)
+
+    def sever(self, src: str, dst: str) -> None:
+        """Cut traffic src -> dst (and dst's replies on that link die with
+        the connection)."""
+        self.proxies[(src, dst)].sever()
+
+    def heal_all(self) -> None:
+        for p in self.proxies.values():
+            p.heal()
+
+    def close(self) -> None:
+        for p in self.proxies.values():
+            p.close()
+
+
+class ProxyNet(Net):
+    """Net implementation over a :class:`ProxyRouter` — same grudge
+    semantics as the iptables net (``drop(src, dst)`` = dst stops hearing
+    from src), so every stock partition nemesis works against
+    real-process single-host suites."""
+
+    def __init__(self, router: ProxyRouter):
+        self.router = router
+
+    def drop(self, test, src: str, dst: str) -> None:
+        self.router.sever(src, dst)
+
+    def heal(self, test) -> None:
+        self.router.heal_all()
+
+    # Packet shaping is not meaningfully emulatable at the stream layer;
+    # the tc-netem net covers it on real clusters.
+    def slow(self, test, opts=None):
+        raise NotImplementedError("proxy net does not shape traffic")
+
+    def flaky(self, test):
+        raise NotImplementedError("proxy net does not shape traffic")
+
+    def fast(self, test):
+        pass  # nothing shaped, nothing to undo
+
+    def shape(self, test, nodes=None, behavior=None):
+        raise NotImplementedError("proxy net does not shape traffic")

@@ -13,10 +13,49 @@ sort+compare is the shape XLA tiles well.
 
 from __future__ import annotations
 
+import os
 from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+#: Subsumption probe count (earlier in-group rows checked per row).  Read at
+#: import time; engines embed it in their cache keys (see wgl_tpu.make_engine)
+#: so changing it requires a fresh process, never a silent no-op.
+N_PROBES = int(os.environ.get("JTPU_PROBES", "5"))
+
+#: Above this row count the dedup sorts with ``_lex_perm`` (a chain of
+#: 2-operand stable sorts composing a permutation) instead of one wide
+#: variadic ``lax.sort``.  A 7-operand sort over C*(W+1) ~ 4.26M rows
+#: (capacity 65536 x window 64, the bench hard tier) crashes the TPU worker
+#: outright; 2-operand sorts at the same row count compile in ~26 s and run
+#: in milliseconds.  1.06M-row x 7-operand variadic sorts are measured-good,
+#: so the threshold keeps the single-sort path for every small shape.
+WIDE_SORT_ROWS = int(os.environ.get("JTPU_WIDE_SORT_ROWS", "1200000"))
+
+#: Ablation switch for ghost subsumption (``JTPU_SUBSUME=0`` disables the
+#: subset-drop; ghost columns then act as plain identity columns, i.e. the
+#: classic 2^crashes configuration search).  Import-time constant, part of
+#: the engine cache key — exists so the bench can measure what subsumption
+#: buys on hardware.
+SUBSUME = os.environ.get("JTPU_SUBSUME", "1") != "0"
+
+
+def _lex_perm(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Permutation sorting rows lexicographically by ``keys`` (first key most
+    significant), stable — equivalent to ``np.lexsort(reversed(keys))``.
+
+    Built least-significant-key-first from 2-operand stable sorts: each pass
+    gathers the next key through the permutation so far and stable-sorts
+    (key, perm).  Stability makes the passes compose into a lexicographic
+    order.  Narrow sorts sidestep the TPU compiler failure that wide variadic
+    sorts hit at multi-million-row shapes."""
+    n = keys[0].shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for k in reversed(list(keys)):
+        kk = jnp.take(k, perm)
+        _, perm = jax.lax.sort((kk, perm), num_keys=1, is_stable=True)
+    return perm
 
 
 def sort_dedup_compact(cols: Sequence[jnp.ndarray],
@@ -59,11 +98,17 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
     # existing row ahead of an identical candidate, so exact-dup keeps the
     # existing one and ``new_rows`` stays quiet.
     inv = (~valid).astype(jnp.int32)
-    operands = [inv] + list(cols) + list(ghost_cols)
-    if origin is not None:
-        operands.append(origin)
-    sorted_ops = jax.lax.sort(tuple(operands),
-                              num_keys=1 + n_key + len(ghost_cols))
+    keys = [inv] + list(cols) + list(ghost_cols)
+    if n <= WIDE_SORT_ROWS:
+        operands = list(keys)
+        if origin is not None:
+            operands.append(origin)
+        sorted_ops = jax.lax.sort(tuple(operands),
+                                  num_keys=1 + n_key + len(ghost_cols))
+    else:
+        perm = _lex_perm(keys)
+        payload = keys + ([origin] if origin is not None else [])
+        sorted_ops = [jnp.take(c, perm) for c in payload]
     s_inv = sorted_ops[0]
     s_cols = list(sorted_ops[1:1 + n_key])
     s_ghost = list(sorted_ops[1 + n_key:1 + n_key + len(ghost_cols)])
@@ -79,7 +124,7 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
         exact_same &= c == jnp.roll(c, 1)
     drop = exact_same & jnp.roll(s_valid, 1)
 
-    if s_ghost:
+    if s_ghost and SUBSUME:
         # Group head per row: the index where the row's group starts.
         # (cumsum + scatter/gather, NOT lax.cummax — cummax nested inside
         # scan/while_loop control flow has crashed the TPU compiler at
@@ -96,10 +141,8 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
         # dropped, chains down to a kept subset).  A subset sorts before
         # its supersets, so probing the head plus a few nearby offsets
         # catches most dominated rows; leftovers only cost capacity.
-        import os as _os
         probes = [jnp.maximum(head_of, 0)]
-        n_probes = int(_os.environ.get("JTPU_PROBES", "5"))
-        for off in (1, 2, 4, 8, 16)[:n_probes]:
+        for off in (1, 2, 4, 8, 16)[:N_PROBES]:
             probes.append(jnp.maximum(idx - off,
                                       jnp.maximum(head_of, 0)))
         subsumed = jnp.zeros(n, dtype=bool)

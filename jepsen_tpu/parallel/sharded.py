@@ -68,6 +68,8 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
 
 
 def _initial_carry(model, window, cap, n, mesh, axis):
+    from jepsen_tpu.checker.wgl_tpu import engine_window
+    window = engine_window(window)  # match the engine's block padding
     MW = (window + 31) // 32
     gcap = cap * n
 
@@ -161,6 +163,7 @@ def check_sharded(model: JaxModel,
 
     gw = ghost_words(p)
     cap = capacity_per_shard
+    max_cap_reached = cap  # diagnostics: how far escalation actually went
     run = _sharded_runner(model, window, cap, mesh, axis, gw)
     carry = _initial_carry(model, window, cap, n, mesh, axis)
     recent_peaks: deque = deque(maxlen=4)
@@ -198,6 +201,7 @@ def check_sharded(model: JaxModel,
                 cap = min(cap * 4, max_capacity_per_shard)
             if cap == old:
                 cap = min(old * 4, max_capacity_per_shard)
+            max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
             run = _sharded_runner(model, window, cap, mesh, axis, gw)
@@ -238,7 +242,8 @@ def check_sharded(model: JaxModel,
     if not failed:
         return {"valid": True, "analyzer": "wgl-tpu-sharded",
                 "configs-explored": explored, "shards": n,
-                "capacity": cap * n}
+                "capacity": cap * n,
+                "max-capacity-reached": max_cap_reached * n}
     return {"valid": False, "analyzer": "wgl-tpu-sharded",
             "op": p.ops[int(carry[7])].to_dict(),
             "configs-explored": explored, "shards": n}

@@ -55,36 +55,49 @@ def wr_gen(keys: int = 8, min_len: int = 1, max_len: int = 4,
 
 
 class AppendChecker(Checker):
-    def __init__(self, realtime: bool = False):
+    """``consistency_models`` mirrors append.clj:15-21: validity is judged
+    against the requested models (e.g. ``("snapshot-isolation",)`` passes
+    write-skew); the elle-style ``not``/``also-not`` boundary is reported
+    either way."""
+
+    def __init__(self, realtime: bool = False, consistency_models=None):
         self.realtime = realtime
+        self.consistency_models = consistency_models
 
     def check(self, test, history: History, opts=None):
-        res = list_append.check(history, realtime=self.realtime)
+        res = list_append.check(
+            history, realtime=self.realtime,
+            consistency_models=self.consistency_models)
         write_artifacts(test, res, opts)
         return res
 
 
 class WrChecker(Checker):
     def __init__(self, realtime: bool = False,
+                 consistency_models=None,
                  sequential_keys: bool = False,
                  linearizable_keys: bool = False):
         self.realtime = realtime
+        self.consistency_models = consistency_models
         self.sequential_keys = sequential_keys
         self.linearizable_keys = linearizable_keys
 
     def check(self, test, history: History, opts=None):
         res = rw_register.check(history, realtime=self.realtime,
+                                consistency_models=self.consistency_models,
                                 sequential_keys=self.sequential_keys,
                                 linearizable_keys=self.linearizable_keys)
         write_artifacts(test, res, opts)
         return res
 
 
-def append_workload(keys: int = 8, **kw) -> Dict[str, Any]:
+def append_workload(keys: int = 8, consistency_models=None,
+                    **kw) -> Dict[str, Any]:
     return {"generator": append_gen(keys, **kw),
-            "checker": AppendChecker()}
+            "checker": AppendChecker(consistency_models=consistency_models)}
 
 
-def wr_workload(keys: int = 8, **kw) -> Dict[str, Any]:
+def wr_workload(keys: int = 8, consistency_models=None,
+                **kw) -> Dict[str, Any]:
     return {"generator": wr_gen(keys, **kw),
-            "checker": WrChecker()}
+            "checker": WrChecker(consistency_models=consistency_models)}

@@ -58,8 +58,20 @@ class LocalKvDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
         s = session(test, node)
         d = data_dir(test, node)
         nodes = test["nodes"]
-        primary = f"{nodes[0]}:{port_of(test, nodes[0])}"
-        peers = ",".join(f"{n}:{port_of(test, n)}" for n in nodes[1:])
+        # With a proxy router in the test map, every inter-node link dials
+        # through a harness-owned TCP proxy so a partition nemesis can
+        # sever it at the socket layer (jepsen_tpu.net_proxy).  Client
+        # traffic still hits the node directly — like the reference,
+        # partitions cut db-node links, not the control plane.
+        router = test.get("proxy_router")
+
+        def peer_port(dst: str) -> int:
+            if router is not None and dst != node:
+                return router.addr(node, dst)[1]
+            return port_of(test, dst)  # self-dial needs no (and has no) link
+
+        primary = f"{nodes[0]}:{peer_port(nodes[0])}"
+        peers = ",".join(f"{n}:{peer_port(n)}" for n in nodes[1:])
         args = [SERVER,
                 "--node", node,
                 "--port", str(port_of(test, node)),
@@ -70,9 +82,16 @@ class LocalKvDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
         if test.get("localkv_unsafe"):
             args += ["--local-reads",
                      "--repl-delay", str(test.get("repl_delay", 0.05))]
+        # PYTHONPATH is emptied for the daemon: the harness environment may
+        # inject a sitecustomize that imports accelerator plugins (~2 s of
+        # CPU per interpreter start).  The server is stdlib-only, and with
+        # that tax a 1 s-interval kill nemesis would keep restarted servers
+        # from EVER reaching their accept loop — observed as runs where no
+        # op succeeds after the first kill.
         cu.start_daemon(s, sys.executable, *args,
                         pidfile=os.path.join(d, "server.pid"),
-                        logfile=os.path.join(d, "server.log"))
+                        logfile=os.path.join(d, "server.log"),
+                        env={"PYTHONPATH": ""})
 
     def kill(self, test, node):
         s = session(test, node)

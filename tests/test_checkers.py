@@ -244,6 +244,7 @@ class TestLinearizableFacade:
             raise cpu_mod.SearchExploded(999)
 
         monkeypatch.setattr(lin_mod.wgl_cpu, "check", exploding_cpu)
+        monkeypatch.setattr(lin_mod.linear_cpu, "check", exploding_cpu)
         c = linearizable(get_model("cas-register"), algorithm="competition",
                          capacity=64, chunk=16)
         r = c.check(T, self.H_BAD)
@@ -262,11 +263,12 @@ class TestLinearizableFacade:
             return {"valid": UNKNOWN, "error": "capacity exceeded"}
 
         monkeypatch.setattr(lin_mod.wgl_cpu, "check", exploding_cpu)
+        monkeypatch.setattr(lin_mod.linear_cpu, "check", exploding_cpu)
         monkeypatch.setattr(lin_mod.wgl_tpu, "check", unknown_tpu)
         c = linearizable(get_model("cas-register"), algorithm="competition")
         r = c.check(T, self.H_GOOD)
         assert r["valid"] == UNKNOWN
-        assert set(r["solvers"]) == {"cpu", "tpu"}
+        assert set(r["solvers"]) == {"cpu", "linear", "tpu"}
 
     def test_competition_cancels_loser(self, monkeypatch):
         # The losing solver's search must be told to stop (knossos cancels
@@ -285,7 +287,11 @@ class TestLinearizableFacade:
             finished.set()
             raise lin_mod.wgl_cpu.Cancelled()
 
+        def quiet_linear(model, history, cancel=None, **kw):
+            raise lin_mod.wgl_cpu.Cancelled()
+
         monkeypatch.setattr(lin_mod.wgl_cpu, "check", slow_cpu)
+        monkeypatch.setattr(lin_mod.linear_cpu, "check", quiet_linear)
         c = linearizable(get_model("cas-register"), algorithm="competition",
                          capacity=64, chunk=16)
         r = c.check(T, self.H_GOOD)

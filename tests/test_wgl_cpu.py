@@ -200,3 +200,91 @@ class TestSynthesized:
         h = cas_register_history(3000, concurrency=8, crash_p=0.002, seed=42)
         r = wgl_cpu.check(CASRegister(), h)
         assert r["valid"] is True
+
+
+class TestLinearSolver:
+    """The memoized-DFS solver (linear_cpu, the knossos `linear` role) must
+    be verdict-equivalent to the BFS oracle on every corpus — that's what
+    makes it a useful competition racer."""
+
+    def _both(self, model, h):
+        from jepsen_tpu.checker import linear_cpu
+        a = wgl_cpu.check(model, h)
+        b = linear_cpu.check(model, h)
+        assert a["valid"] == b["valid"], (a, b)
+        return a, b
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_differential_valid(self, seed):
+        h = cas_register_history(300, concurrency=5, crash_p=0.01, seed=seed)
+        a, b = self._both(CASRegister(), h)
+        assert b["valid"] is True
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_differential_refuted(self, seed):
+        h = corrupt_reads(cas_register_history(
+            300, concurrency=5, crash_p=0.0, seed=seed), n=1, seed=seed)
+        a, b = self._both(CASRegister(), h)
+        assert b["valid"] is False
+        # both solvers pinpoint the same failing completion
+        assert a["op"]["index"] == b["op"]["index"], (a["op"], b["op"])
+
+    def test_differential_mutex(self):
+        ops = [mk(0, INVOKE, "acquire"), mk(0, OK, "acquire"),
+               mk(1, INVOKE, "acquire"), mk(1, OK, "acquire")]
+        from jepsen_tpu.checker import linear_cpu
+        r = linear_cpu.check(Mutex(), History(ops))
+        assert r["valid"] is False
+
+    def test_empty_history(self):
+        from jepsen_tpu.checker import linear_cpu
+        assert linear_cpu.check(CASRegister(), History([]))["valid"] is True
+
+    def test_ghost_burst_is_cheap_when_valid(self):
+        # DFS never has to touch optional ghosts on a valid history — the
+        # 2^ghosts blowup that stresses BFS capacity doesn't exist here
+        from jepsen_tpu.checker import linear_cpu
+        from jepsen_tpu.synth import ghost_write_burst
+        h = History(ghost_write_burst(14)
+                    + list(cas_register_history(120, concurrency=4,
+                                                crash_p=0.0, seed=1)),
+                    reindex=True)
+        r = linear_cpu.check(CASRegister(), h, max_states=20_000)
+        assert r["valid"] is True
+
+    def test_explosion_budget(self):
+        # ...but a REFUTED history behind a ghost burst forces the DFS to
+        # exhaust ghost subsets while backtracking: the budget must trip
+        from jepsen_tpu.checker import linear_cpu
+        from jepsen_tpu.synth import ghost_write_burst
+        base = corrupt_reads(cas_register_history(120, concurrency=4,
+                                                  crash_p=0.0, seed=1),
+                             n=2, seed=1)
+        h = History(ghost_write_burst(14) + list(base), reindex=True)
+        with pytest.raises(wgl_cpu.SearchExploded):
+            linear_cpu.check(CASRegister(), h, max_states=2000)
+
+    def test_dfs_is_lazy_on_valid_histories(self):
+        # the whole point of racing it: on a clean history DFS visits
+        # roughly one state per event, not a frontier
+        from jepsen_tpu.checker import linear_cpu
+        h = cas_register_history(500, concurrency=4, crash_p=0.0, seed=3)
+        r = linear_cpu.check(CASRegister(), h)
+        assert r["valid"] is True
+        assert r["states-explored"] < 4 * len(h)
+
+
+class TestThreeWayCompetition:
+    def test_host_only_model_races_two_algorithms(self):
+        from jepsen_tpu.checker.linearizable import Linearizable
+        h = cas_register_history(200, concurrency=4, crash_p=0.005, seed=9)
+        chk = Linearizable(CASRegister(), "competition")
+        r = chk.check({}, h)
+        assert r["valid"] is True
+        assert r.get("solver") in ("cpu", "linear")
+
+    def test_linear_algorithm_selectable(self):
+        from jepsen_tpu.checker.linearizable import Linearizable
+        h = cas_register_history(200, concurrency=4, crash_p=0.005, seed=9)
+        r = Linearizable(CASRegister(), "linear").check({}, h)
+        assert r["valid"] is True and r["analyzer"] == "linear-cpu"

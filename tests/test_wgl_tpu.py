@@ -44,6 +44,32 @@ class TestDedup:
         assert int(total) == 0 and not bool(overflow)
         assert ov.tolist() == [False] * 4
 
+    def test_multipass_path_matches_variadic(self, monkeypatch):
+        """Force the narrow multi-pass sort (used above WIDE_SORT_ROWS, the
+        regime where one wide variadic sort crashes the TPU worker) and check
+        it is bit-identical to the variadic path — including ghost
+        subsumption and the new_rows fixpoint signal."""
+        from jepsen_tpu.ops import dedup
+        rng = np.random.default_rng(7)
+        n = 512
+        cols = [jnp.asarray(rng.integers(0, 6, n).astype(np.uint32)),
+                jnp.asarray(rng.integers(-3, 3, n).astype(np.int32))]
+        # small ghost universe so subset relations actually occur
+        gcols = [jnp.asarray(rng.integers(0, 8, n).astype(np.uint32))]
+        valid = jnp.asarray(rng.random(n) < 0.7)
+        origin = jnp.asarray((rng.random(n) < 0.5).astype(np.int32))
+        ref = sort_dedup_compact(cols, valid, 64, ghost_cols=gcols,
+                                 origin=origin)
+        monkeypatch.setattr(dedup, "WIDE_SORT_ROWS", 1)
+        got = sort_dedup_compact(cols, valid, 64, ghost_cols=gcols,
+                                 origin=origin)
+        for a, b in zip(ref[0], got[0]):
+            assert a.tolist() == b.tolist()
+        assert ref[1].tolist() == got[1].tolist()
+        assert int(ref[2]) == int(got[2])
+        assert bool(ref[3]) == bool(got[3])
+        assert bool(ref[4]) == bool(got[4])
+
 
 CASES = [
     # (ops, expected_valid)
