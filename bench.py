@@ -102,10 +102,15 @@ def build_hard():
     # cost is O(capacity * window)), and crashes drive capacity escalation
     # (each pending crashed write doubles the reachable configuration set)
     # — sized so the search still CONCLUDES below the ceiling; unbounded
-    # ghost pileups get their own ceiling tier.
+    # ghost pileups get their own ceiling tier.  Concurrency 8 (round 2
+    # used 10): measured on hardware, the conc-10 variant pins the engine
+    # at capacity >= 16384 for most of the stream and overflows into 65536
+    # at its worst burst — a tier that cannot finish inside any sane bench
+    # budget.  Conc 8 keeps the same shape (wide window, escalation, ghost
+    # bursts) with a ~4x smaller live-mask state space.
     from jepsen_tpu.history import History
     from jepsen_tpu.synth import cas_register_history, doomed_cas_padding
-    n_pad, conc = (16, 8) if SMOKE else (48, 10)
+    n_pad, conc = (16, 8) if SMOKE else (48, 8)
     pad = doomed_cas_padding(n_pad)
     work = cas_register_history(N_OPS, concurrency=conc, crash_p=0.0008,
                                 seed=11)
@@ -267,9 +272,13 @@ def tier_easy():
 
 
 def tier_hard():
+    # One timed run (disclosed): the burst region genuinely needs capacity
+    # 16384 for most of the stream (~2-3 s per 32-event dispatch measured on
+    # hardware), so a second run would double a ~15-25 min tier for no new
+    # information — compiles are already excluded via warm_shapes.
     hard_cap = 4096 if SMOKE else 65536
     r, walls, meta = _device_tier(build_hard(), capacity=1024,
-                                  max_capacity=hard_cap, runs=2)
+                                  max_capacity=hard_cap, runs=1)
     emit({"runs": walls, "valid": r["valid"],
           "configs_explored": r.get("configs-explored"),
           "max_capacity_reached": r.get("max-capacity-reached"),

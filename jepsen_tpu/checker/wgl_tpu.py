@@ -71,6 +71,24 @@ LOOKAHEAD = 2
 # a chunk's XLA program could outlive the TPU worker's watchdog.
 EXPAND_BLOCK = 8
 
+# Per-chunk closure work budget, in capacity x closure-iterations units.
+# Closure cost is superlinear in live configuration count (more fixpoint
+# rounds AND bigger sorts), so bounding the program by *event count* alone
+# cannot bound its duration — a 32-event chunk was measured at 26 s during
+# a 7k-config burst at capacity 16384, within sight of the TPU worker's
+# ~60 s watchdog.  Instead each chunk carries an iteration budget
+# (CLOSURE_WORK_BUDGET / capacity); when it runs out the remaining events
+# gate to no-ops, the flags report how many events were really consumed,
+# and the host resumes mid-chunk with a fresh budget.
+import os as _os
+
+CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "1000000"))
+
+
+def closure_budget(capacity: int) -> int:
+    """Closure iterations one chunk may spend at this capacity."""
+    return max(16, CLOSURE_WORK_BUDGET // capacity)
+
 
 def engine_window(window: int) -> int:
     """The padded slot count an engine built for ``window`` actually uses."""
@@ -88,7 +106,7 @@ def engine_window(window: int) -> int:
 
 def make_engine(model: JaxModel, window: int, capacity: int,
                 axis_name: Optional[str] = None, num_shards: int = 1,
-                gwords: int = 1):
+                gwords: int = 1, work_budget: Optional[int] = None):
     """Build the jittable (carry0, event_step, run_chunk) triple.
 
     ``window`` may be any positive slot count (candidate-row count — and so
@@ -108,6 +126,13 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     # window-shaped carries outside carry0 (parallel.sharded) must use
     # engine_window() for the same padding.
     window = engine_window(window)
+    # work_budget: None = capacity-scaled default; <= 0 = unlimited (the
+    # vmapped batch engine runs lanes in lockstep and cannot resume lanes
+    # at different positions, so it opts out).
+    if work_budget is None:
+        work_budget = closure_budget(capacity)
+    if work_budget <= 0:
+        work_budget = 2**31 - 1
     try:
         # All three engine paths (single-chip, sharded, batched) build here;
         # enabling the persistent compilation cache at this shared layer
@@ -301,15 +326,19 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-         overflow, explored, rounds, peak, ghosts) = carry
+         overflow, explored, rounds, peak, ghosts, budget, consumed) = carry
         kind, slot, f, a, b, op_id, is_ghost, gcls, grank, gpos = (
             ev[0], ev[1], ev[2], ev[3], ev[4], ev[5], ev[6], ev[7], ev[8],
             ev[9])
-        alive = ~failed & ~overflow
+        # budget > 0: an exhausted closure budget pauses the chunk — the
+        # remaining events gate to no-ops and the host resumes them in a
+        # fresh dispatch (consumed tells it where).  Bounds one XLA
+        # program's duration by *work*, which event counts cannot.
+        alive = ~failed & ~overflow & (budget > 0)
 
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak, ghosts) = c
+             overflow, explored, rounds, peak, ghosts, budget, consumed) = c
             win_ops2 = win_ops.at[slot].set(
                 jnp.stack([f, a, b, gcls, grank, gpos]))
             active2 = active.at[slot].set(True)
@@ -320,22 +349,26 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                 ghosts | slot_bitmask(slot), ghosts)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
                     failed, failed_op, overflow, explored, rounds, peak,
-                    ghosts2)
+                    ghosts2, budget, consumed)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak, ghosts) = c
+             overflow, explored, rounds, peak, ghosts, budget, consumed) = c
 
             def with_closure(args):
-                mask, states, valid, overflow, explored, rounds, peak = args
+                (mask, states, valid, overflow, explored, rounds, peak,
+                 budget) = args
                 mask, states, valid, count, overflow, iters = closure(
                     mask, states, valid, win_ops, active, ghosts, overflow)
                 return (mask, states, valid, overflow, explored + count,
-                        rounds + iters, jnp.maximum(peak, count))
+                        rounds + iters, jnp.maximum(peak, count),
+                        budget - iters)
 
-            mask, states, valid, overflow, explored, rounds, peak = lax.cond(
+            (mask, states, valid, overflow, explored, rounds, peak,
+             budget) = lax.cond(
                 dirty, with_closure, lambda a: a,
-                (mask, states, valid, overflow, explored, rounds, peak))
+                (mask, states, valid, overflow, explored, rounds, peak,
+                 budget))
 
             bm = slot_bitmask(slot)
             has = ((mask & bm[None, :]) != 0).any(-1)
@@ -347,12 +380,13 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             active2 = active.at[slot].set(False)
             return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
                     failed | newly_failed, failed_op2, overflow, explored,
-                    rounds, peak, ghosts)
+                    rounds, peak, ghosts, budget, consumed)
 
-        new_carry = lax.cond(
-            alive,
-            lambda c: lax.switch(kind, [do_enter, do_return, lambda x: x], c),
-            lambda c: c, carry)
+        def apply(c):
+            out = lax.switch(kind, [do_enter, do_return, lambda x: x], c)
+            return out[:14] + (out[14] + 1,)  # consumed += 1
+
+        new_carry = lax.cond(alive, apply, lambda c: c, carry)
         return new_carry, None
 
     def _init_win_ops(w):
@@ -375,19 +409,24 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.int32(0),                              # explored
                 jnp.int32(0),                              # closure rounds
                 jnp.int32(1),                              # peak config count
-                jnp.zeros(MW, jnp.uint32))                 # ghost slots
+                jnp.zeros(MW, jnp.uint32),                 # ghost slots
+                jnp.int32(work_budget),                    # closure budget
+                jnp.int32(0))                              # events consumed
 
     def run_chunk(carry, events):
-        # Reset the peak to the live count on entry (device-side: the host
-        # reads per-chunk peaks without extra round-trips), scan the events,
-        # and pack the scalars the host polls into ONE int32 vector so a
-        # chunk boundary costs a single device→host transfer.
+        # Reset the peak to the live count on entry, and the work budget /
+        # consumed-event counter to fresh values (device-side: the host
+        # reads all per-chunk scalars without extra round-trips); scan the
+        # events; pack the scalars the host polls into ONE int32 vector so
+        # a chunk boundary costs a single device→host transfer.
         live0 = global_sum(carry[2].sum()).astype(jnp.int32)
-        carry = carry[:11] + (live0,) + carry[12:]
+        carry = carry[:11] + (live0, carry[12],
+                              jnp.int32(work_budget), jnp.int32(0))
         carry, _ = lax.scan(event_step, carry, events)
         flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
                            carry[8].astype(jnp.int32),   # overflow
-                           carry[11]])                   # peak configs
+                           carry[11],                    # peak configs
+                           carry[14]])                   # events consumed
         return carry, flags
 
     return carry0, event_step, run_chunk
@@ -421,7 +460,8 @@ def _get_run_chunk(model: JaxModel, window: int, capacity: int,
     from jepsen_tpu.ops import dedup as _dedup
     key = (model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
-           gwords, _dedup.N_PROBES, _dedup.WIDE_SORT_ROWS, _dedup.SUBSUME)
+           gwords, _dedup.N_PROBES, _dedup.WIDE_SORT_ROWS, _dedup.SUBSUME,
+           CLOSURE_WORK_BUDGET)
     if key not in _ENGINE_CACHE:
         carry0, _, run_chunk = make_engine(model, window, capacity,
                                            gwords=gwords)
@@ -567,6 +607,7 @@ def check(model: JaxModel, history: Optional[History] = None,
         fl = np.asarray(flags)
         failed, overflow = bool(fl[0]), bool(fl[1])
         peak = int(fl[2])
+        consumed = int(fl[3])
         if overflow and cap < max_capacity:
             # Grow straight to a capacity the observed peak says is enough
             # (peak is a lower bound on the true need — it may itself have
@@ -587,6 +628,18 @@ def check(model: JaxModel, history: Optional[History] = None,
         done = after
         if failed or overflow:
             break
+        if consumed < cur_chunk:
+            # Closure budget exhausted mid-chunk: the unconsumed tail was
+            # gated to no-ops, and any speculative chunks skipped it —
+            # discard them and resume exactly where the engine stopped.
+            # (Keeps one XLA program's wall time bounded by work, under
+            # the TPU worker's watchdog, regardless of config-count
+            # superlinearity.)
+            inflight.clear()
+            carry = after
+            pos = cpos + consumed
+            recent_peaks.clear()
+            continue
         recent_peaks.append(peak)
         if cap > capacity and len(recent_peaks) == 4:
             # Crash-bursts inflate the configuration set transiently.  The

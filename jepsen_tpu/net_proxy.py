@@ -87,6 +87,15 @@ class PairProxy:
             srv, self._srv = self._srv, None
         if srv is not None:
             try:
+                # shutdown BEFORE close: close() alone does not interrupt a
+                # thread blocked in accept(), and the in-flight syscall
+                # keeps the kernel socket (and the port, and the accepting
+                # loop!) alive — the link would never actually sever under
+                # steady traffic.  shutdown wakes the accept with an error.
+                srv.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 srv.close()  # new dials now get ECONNREFUSED
             except OSError:
                 pass
@@ -133,6 +142,18 @@ class PairProxy:
                 client, _ = srv.accept()
             except OSError:
                 return  # listener closed (sever or shutdown)
+            with self._lock:
+                stale = self._srv is not srv
+            if stale:
+                # a sever raced our accept: this connection crossed a cut
+                # link — reset it and stop serving this listener generation
+                try:
+                    client.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                      b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                    client.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(target=self._pump_pair, args=(client,),
                              daemon=True).start()
 

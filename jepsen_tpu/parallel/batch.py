@@ -138,8 +138,11 @@ def _batched_runner_simple(model: JaxModel, window: int, capacity: int,
            gwords)
     if key in _CACHE:
         return _CACHE[key]
+    # work_budget=0 (unlimited): vmapped lanes advance in lockstep and
+    # cannot resume at per-lane positions; lanes are short per-key
+    # histories whose chunks stay far from the watchdog bound.
     carry0, _, run_chunk = make_engine(model, window, capacity,
-                                       gwords=gwords)
+                                       gwords=gwords, work_budget=0)
     vrun = jax.jit(jax.vmap(run_chunk))
     _CACHE[key] = (carry0, vrun)
     return _CACHE[key]

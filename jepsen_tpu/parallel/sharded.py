@@ -46,18 +46,22 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
     if key in _CACHE:
         return _CACHE[key]
     n = mesh.shape[axis]
+    # work_budget=0 (unlimited): this driver addresses chunks by index and
+    # has no mid-chunk resume path yet; on real multi-chip hardware the
+    # single-chip watchdog mitigation (capacity-scaled chunks +
+    # wgl_tpu.closure_budget) should be ported here the same way.
     _, _, run_chunk = make_engine(model, window, capacity_per_shard,
                                   axis_name=axis, num_shards=n,
-                                  gwords=gwords)
+                                  gwords=gwords, work_budget=0)
     # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
     #               dirty, failed, failed_op, overflow, explored, rounds,
-    #               peak, ghosts) — ghosts is per-slot, hence replicated.
+    #               peak, ghosts, budget, consumed) — ghosts is per-slot
+    #               and the scalars are identical across shards, hence
+    #               replicated.
     sharded = P(axis)
     repl = P()
-    in_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                 repl, repl, repl, repl, repl), repl)
-    out_specs = ((sharded, sharded, sharded, repl, repl, repl, repl, repl,
-                  repl, repl, repl, repl, repl), repl)
+    in_specs = ((sharded, sharded, sharded) + (repl,) * 12, repl)
+    out_specs = ((sharded, sharded, sharded) + (repl,) * 12, repl)
     # check_vma=False: closure dedup sorts the *gathered* global row set, so
     # every shard computes bit-identical "replicated" scalars (counts, flags),
     # but the varying-axes checker can't prove that post-all_gather.
@@ -92,6 +96,8 @@ def _initial_carry(model, window, cap, n, mesh, axis):
         put(np.int32(0), P()),
         put(np.int32(1), P()),
         put(np.zeros(MW, np.uint32), P()),
+        put(np.int32(2**31 - 1), P()),   # budget (unlimited; see runner)
+        put(np.int32(0), P()),           # consumed
     )
 
 

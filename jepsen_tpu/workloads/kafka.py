@@ -34,7 +34,22 @@ Anomalies:
   int-send-skip      — consecutive sends to a partition within one txn
                        skipped over offsets known to exist
   offset-conflict    — two values acked at one (partition, offset)
+  inconsistent-offsets — the cross-observation version order (every send and
+                       every poll, including *recovered* indeterminate txns)
+                       maps one (partition, offset) to several values
+                       (kafka.clj:820-870 version-orders :errors)
   unseen             — committed values never observed by any poll (info)
+
+Indeterminate-transaction recovery (kafka.clj:726-737
+``must-have-committed?``): an :info transaction's sends join the committed
+universe iff some OK poll observed one of its written values — those
+recovered sends then participate in version orders, duplicates, lost-write
+and unseen accounting exactly like acked ones.
+
+Realtime lag (kafka.clj:1358-1460, 1564): for each OK poll, the
+conservative lower bound on how stale its most-recent observed offset was
+at poll invocation — ``worst-realtime-lag`` reports the maximum, per key
+and globally, and ``realtime-lag.png`` plots lag over time per key.
 """
 
 from __future__ import annotations
@@ -48,7 +63,110 @@ from jepsen_tpu import generator as gen
 from jepsen_tpu.checker.core import Checker, UNKNOWN
 from jepsen_tpu.elle.graph import Graph, cycle_edge_kinds, peeled_cycles
 from jepsen_tpu.elle.list_append import classify_cycle
-from jepsen_tpu.history import FAIL, History, OK
+from jepsen_tpu.history import FAIL, History, INFO, OK
+
+
+def _mops(op) -> List[Any]:
+    if not isinstance(op.value, (list, tuple)):
+        return []
+    return [m for m in op.value if isinstance(m, (list, tuple)) and m]
+
+
+def _send_pairs(op):
+    """(k, offset, value) for send mops carrying an [offset, value] pair."""
+    for m in _mops(op):
+        if m[0] == "send":
+            ov = m[2]
+            if isinstance(ov, (list, tuple)) and len(ov) == 2:
+                yield m[1], ov[0], ov[1]
+
+
+def _send_values(op):
+    """(k, value) for every send mop, acked or not (op-writes parity)."""
+    for m in _mops(op):
+        if m[0] == "send":
+            ov = m[2]
+            if isinstance(ov, (list, tuple)) and len(ov) == 2:
+                yield m[1], ov[1]
+            else:
+                yield m[1], ov
+
+
+def _poll_records(op):
+    """(k, offset, value) for every polled record."""
+    for m in _mops(op):
+        if m[0] == "poll" and isinstance(m[1], dict):
+            for k, recs in m[1].items():
+                for o, v in recs:
+                    yield k, o, v
+
+
+def recovered_info_ops(history: History) -> List[Any]:
+    """Indeterminate (:info) transactions proven committed because an OK
+    poll observed one of their written values (kafka.clj:726-737)."""
+    ok_reads: Dict[Any, set] = defaultdict(set)
+    for op in history:
+        if op.type == OK:
+            for k, _o, v in _poll_records(op):
+                ok_reads[k].add(v)
+    out = []
+    for op in history:
+        if op.type == INFO and any(v in ok_reads.get(k, ())
+                                   for k, v in _send_values(op)):
+            out.append(op)
+    return out
+
+
+def realtime_lag(history: History) -> List[Dict[str, Any]]:
+    """Per-poll conservative staleness lower bound (kafka.clj:1358-1460).
+
+    ``known_at[k][o]`` = earliest time offset ``o`` of partition ``k`` was
+    known to exist (any op mentioning an offset proves every lower offset
+    too).  A poll invoked at ``t`` whose highest observation for ``k`` is
+    ``m`` lags at least ``t - known_at[k][m+1]``: by that time offset m+1
+    existed, so m was no longer the newest record."""
+    known_at: Dict[Any, List[Any]] = defaultdict(list)
+    for op in history:
+        if op.type not in (OK, INFO, FAIL):
+            continue
+        max_off: Dict[Any, int] = {}
+        for k, o, _v in itertools.chain(_send_pairs(op), _poll_records(op)):
+            if o is not None and o > max_off.get(k, -1):
+                max_off[k] = o
+        for k, o in max_off.items():
+            vec = known_at[k]
+            if len(vec) <= o:
+                vec.extend([None] * (o + 1 - len(vec)))
+            for i in range(o, -1, -1):
+                if vec[i] is not None:
+                    break
+                vec[i] = op.time
+    pairs = history.pair_index()
+    lags = []
+    for i, op in enumerate(history):
+        if op.type != OK:
+            continue
+        by_key: Dict[Any, int] = {}
+        saw_poll = False
+        for m in _mops(op):
+            if m[0] == "poll" and isinstance(m[1], dict):
+                saw_poll = True
+                for k, recs in m[1].items():
+                    mx = max((o for o, _v in recs), default=-1)
+                    by_key[k] = max(by_key.get(k, -1), mx)
+        if not saw_poll:
+            continue
+        j = pairs[i]
+        t_invoke = history[j].time if j >= 0 else op.time
+        if t_invoke is None:
+            continue
+        for k, m in by_key.items():
+            vec = known_at.get(k, [])
+            expired = vec[m + 1] if m + 1 < len(vec) else None
+            lag = max(0, t_invoke - expired) if expired is not None else 0
+            lags.append({"process": op.process, "key": k,
+                         "time": t_invoke, "lag": lag})
+    return lags
 
 
 def generator(partitions: int = 4, max_mops: int = 3,
@@ -118,6 +236,47 @@ class KafkaChecker(Checker):
                     if isinstance(mop, (list, tuple)) and mop \
                             and mop[0] == "send":
                         failed_values.add((mop[1], mop[2]))
+
+        # Indeterminate-txn recovery (must-have-committed?): sends of an
+        # :info txn observed by an OK poll are committed — they join the
+        # committed universe for version orders / lost-write / unseen.
+        recovered = recovered_info_ops(history)
+        for op in recovered:
+            for k, o, v in _send_pairs(op):
+                if (k, o) not in sends_ok:
+                    sends_ok[(k, o)] = v
+                    send_of_value.setdefault((k, v), o)
+            for k, o, _v in _poll_records(op):
+                observed[k].add(o)
+        if recovered:
+            anomalies_info_recovered = [
+                {"process": op.process, "index": op.index}
+                for op in recovered]
+        else:
+            anomalies_info_recovered = []
+
+        # Cross-observation version orders (kafka.clj:820-870): every send
+        # and every poll of every committed/recovered txn votes for the
+        # value at (k, offset); an offset with >1 distinct values is an
+        # inconsistent-offsets error, a value at >1 offsets a duplicate.
+        votes: Dict[Tuple[Any, int], set] = defaultdict(set)
+        value_offsets: Dict[Tuple[Any, Any], set] = defaultdict(set)
+        for op in itertools.chain(
+                (o for o in history if o.type == OK), recovered):
+            for k, o, v in itertools.chain(_send_pairs(op),
+                                           _poll_records(op)):
+                votes[(k, o)].add(v)
+                value_offsets[(k, v)].add(o)
+        for (k, o), vs in sorted(votes.items(), key=repr):
+            if len(vs) > 1:
+                anomalies["inconsistent-offsets"].append(
+                    {"key": k, "offset": o, "values": sorted(vs, key=repr)})
+        dup_reported = {(d["key"], d["value"])
+                        for d in anomalies.get("duplicate", ())}
+        for (k, v), offs in sorted(value_offsets.items(), key=repr):
+            if len(offs) > 1 and (k, v) not in dup_reported:
+                anomalies["duplicate"].append(
+                    {"key": k, "value": v, "offsets": sorted(offs)})
 
         def known(k, o):
             return (k, o) in sends_ok or o in observed[k]
@@ -233,19 +392,65 @@ class KafkaChecker(Checker):
                 d["observed"] += 1
             else:
                 d["unseen"] += 1
+        # Realtime lag (worst-case staleness per key + global worst).
+        lags = realtime_lag(history)
+        worst = max(lags, key=lambda d: d["lag"], default=None)
+        worst_by_key: Dict[Any, Dict[str, Any]] = {}
+        for d in lags:
+            cur = worst_by_key.get(d["key"])
+            if cur is None or d["lag"] > cur["lag"]:
+                worst_by_key[d["key"]] = d
+
         res = {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
                          else not hard),
                "anomaly-types": sorted(hard),
                "anomalies": {k: v[:8] for k, v in hard.items()},
                "anomalies-full": hard,
                "sends": len(sends_ok), "polls": n_polls,
+               "recovered-info-txns": anomalies_info_recovered[:8],
+               "recovered-info-count": len(anomalies_info_recovered),
+               "worst-realtime-lag": worst,
+               "worst-realtime-lag-by-key": worst_by_key,
                "unseen-count": len(unseen), "unseen": unseen[:8],
                "unseen-by-partition": {
                    k: d for k, d in sorted(per_part.items())
                    if d["unseen"]}}
+        self._plot_lag(lags, opts or {}, test or {})
         from jepsen_tpu.elle.render import write_artifacts
         write_artifacts(test, res, opts)
         return res
+
+    @staticmethod
+    def _plot_lag(lags, opts, test) -> None:
+        """realtime-lag.png: per-key lag over time (kafka.clj:1505-1560
+        plot-realtime-lag!).  Best-effort artifact; never affects the
+        verdict."""
+        d = opts.get("store_dir") or test.get("store_dir")
+        if not d or not lags:
+            return
+        try:
+            import os
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            by_key: Dict[Any, List] = defaultdict(list)
+            t0 = min(x["time"] for x in lags)
+            for x in lags:
+                by_key[x["key"]].append(((x["time"] - t0) / 1e9,
+                                         x["lag"] / 1e9))
+            fig, ax = plt.subplots(figsize=(8, 4))
+            for k, pts in sorted(by_key.items(), key=repr):
+                pts.sort()
+                ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                        drawstyle="steps-post", label=f"key {k}")
+            ax.set_xlabel("time (s)")
+            ax.set_ylabel("realtime lag (s)")
+            ax.legend(fontsize=7)
+            fig.tight_layout()
+            fig.savefig(os.path.join(d, "realtime-lag.png"), dpi=110)
+            plt.close(fig)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def _graph_pass(history: History) -> List[Dict[str, Any]]:

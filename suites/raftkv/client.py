@@ -1,0 +1,90 @@
+"""raftkv wire client: node-pinned with one leader-hint redirect.
+
+Error discipline (zookeeper.clj:91-104 pattern): connect failures and
+server-side ``definite`` errors (not-leader, cas-mismatch, truncated
+entries) are FAIL; anything mid-flight or marked ``indeterminate`` (commit
+timeouts — the entry may still commit!) is INFO."""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites.raftkv.server import recv_frame, send_frame
+
+
+def ping(port: int, timeout: float = 1.0):
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=timeout) as s:
+            send_frame(s, {"type": "ping"})
+            return recv_frame(s)
+    except (OSError, ValueError):
+        return None
+
+
+class ConnectFailed(Exception):
+    """The request was never sent: definite FAIL for any op."""
+
+
+def _call(port: int, msg, timeout: float = 4.0):
+    try:
+        sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    except OSError as e:
+        raise ConnectFailed(str(e)) from e
+    try:
+        with sock:
+            send_frame(sock, msg)
+            reply = recv_frame(sock)
+    except OSError as e:
+        raise ConnectionError(str(e)) from e
+    if reply is None:
+        raise ConnectionError("server closed connection")
+    return reply
+
+
+class RaftRegisterClient(jclient.Client):
+    def __init__(self, node: Optional[str] = None):
+        self.node = node
+
+    def open(self, test, node):
+        return RaftRegisterClient(node)
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        if op.f == "read":
+            msg = {"op": "read", "key": f"r{k}"}
+        elif op.f == "write":
+            msg = {"op": "write", "key": f"r{k}", "value": v}
+        else:
+            msg = {"op": "cas", "key": f"r{k}", "old": v[0], "new": v[1]}
+        ports = test["raftkv_ports"]
+        try:
+            reply = _call(ports[self.node], msg)
+            if reply.get("error") == "not-leader":
+                hint = reply.get("leader")
+                if hint in ports:
+                    # one redirect: the hinted leader may itself be stale,
+                    # in which case its reply stands on its own merits
+                    reply = _call(ports[hint], msg)
+                else:
+                    return op.with_(type=FAIL, error="not-leader (no hint)")
+            if reply.get("ok"):
+                if op.f == "read":
+                    return op.with_(type=OK, value=(k, reply.get("value")))
+                return op.with_(type=OK)
+            if reply.get("definite"):
+                return op.with_(type=FAIL, error=reply.get("error"))
+            return op.with_(type=INFO, error=reply.get("error"))
+        except ConnectFailed as e:
+            return op.with_(type=FAIL, error=str(e))
+        except (OSError, socket.timeout, ConnectionError) as e:
+            if op.f == "read":
+                return op.with_(type=FAIL, error=str(e))
+            return op.with_(type=INFO, error=str(e))
+
+    def close(self, test):
+        pass

@@ -1,0 +1,102 @@
+"""raftkv DB layer: real Raft daemons on each "node" (localkv's lifecycle
+patterns: pidfiles, SIGKILL via marker grepkill, WAL snarfing)."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+from suites.raftkv.client import ping
+
+SERVER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "server.py")
+
+
+def port_of(test, node: str) -> int:
+    return test["raftkv_ports"][node]
+
+
+def marker(test, node: str) -> str:
+    return f"raftkv-{node}-p{port_of(test, node)}"
+
+
+def data_dir(test, node: str) -> str:
+    return os.path.join(test.get("raftkv_dir", "/tmp/jepsen-raftkv"),
+                        marker(test, node))
+
+
+class RaftKvDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node)
+        s.exec("mkdir", "-p", data_dir(test, node))
+        self.start(test, node)
+        cu.await_tcp_port(s, port_of(test, node), timeout_s=30)
+
+    def teardown(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        cu.stop_daemon(s, os.path.join(d, "server.pid"))
+        cu.grepkill(s, marker(test, node))
+        if not test.get("leave_db_running"):
+            s.exec("rm", "-rf", d)
+
+    # -- Kill capability ---------------------------------------------------
+    def start(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        router = test.get("proxy_router")
+
+        def peer_addr(dst: str):
+            if router is not None:
+                return router.addr(node, dst)
+            return ("127.0.0.1", port_of(test, dst))
+
+        peers = ",".join(
+            f"{n}:{peer_addr(n)[0]}:{peer_addr(n)[1]}"
+            for n in test["nodes"] if n != node)
+        args = [SERVER,
+                "--node", node,
+                "--port", str(port_of(test, node)),
+                "--peers", peers,
+                "--data", d,
+                "--election-ms", str(test.get("raftkv_election_ms", 400)),
+                "--marker", marker(test, node)]
+        if test.get("raftkv_stale_reads"):
+            args.append("--stale-reads")
+        # PYTHONPATH emptied: see suites/localkv/db.py — the harness env's
+        # sitecustomize costs ~2 s of CPU per interpreter start, which
+        # under a kill nemesis keeps restarted servers from ever serving.
+        cu.start_daemon(s, sys.executable, *args,
+                        pidfile=os.path.join(d, "server.pid"),
+                        logfile=os.path.join(d, "server.log"),
+                        env={"PYTHONPATH": ""})
+
+    def kill(self, test, node):
+        s = session(test, node)
+        cu.grepkill(s, marker(test, node))
+        s.exec("rm", "-f", os.path.join(data_dir(test, node), "server.pid"))
+
+    # -- Pause capability --------------------------------------------------
+    def pause(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="CONT")
+
+    # -- Primary capability (real leader discovery) ------------------------
+    def primaries(self, test) -> List[str]:
+        out = []
+        for n in test["nodes"]:
+            r = ping(port_of(test, n))
+            if r and r.get("role") == "leader":
+                out.append(n)
+        return out
+
+    # -- LogFiles capability ----------------------------------------------
+    def log_files(self, test, node) -> List[str]:
+        d = data_dir(test, node)
+        return [os.path.join(d, "server.log"), os.path.join(d, "raft.wal")]

@@ -1,0 +1,128 @@
+"""raftkv suite CLI — real Raft consensus under real faults, one host.
+
+    python -m suites.raftkv.runner test --nemesis partition --time-limit 12
+    python -m suites.raftkv.runner test --stale-reads --nemesis partition
+
+Default mode must verify (every op, reads included, commits through the
+replicated log on a majority).  ``--stale-reads`` serves leader-local
+reads without a quorum round: a leader marooned in a minority partition
+keeps answering with stale state — the checker must refute it.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict
+
+from jepsen_tpu import cli, generator as gen
+from jepsen_tpu import net as jnet
+from jepsen_tpu.checker import Stats, compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.net_proxy import ProxyNet, ProxyRouter
+from jepsen_tpu.workloads import linearizable_register
+
+from suites.localkv.runner import free_ports
+from suites.raftkv.client import RaftRegisterClient
+from suites.raftkv.db import RaftKvDB
+
+
+def _leader_isolating_grudge(ports):
+    """Partition the CURRENT leader (live-discovered via ping) from the
+    majority — the scenario every Raft consistency argument hinges on: the
+    majority must elect a fresh leader and keep committing, while anything
+    the marooned leader still answers is judged by the checker."""
+    def grudge(nodes):
+        from suites.raftkv.client import ping
+        leader = next((n for n in nodes
+                       if (ping(ports[n]) or {}).get("role") == "leader"),
+                      None)
+        target = leader if leader is not None else random.choice(list(nodes))
+        return jnet.complete_grudge(jnet.split_one(target, list(nodes)))
+    return grudge
+
+
+def NEMESES(name, opts, ports):
+    if name == "none":
+        return combined.Package()
+    if name == "kill":
+        return combined.db_package({**opts, "faults": ["kill"]})
+    if name == "partition":
+        return combined.partition_package(
+            {**opts, "grudge_fn": _leader_isolating_grudge(ports)})
+    raise KeyError(name)
+
+
+NEMESIS_NAMES = ("none", "kill", "partition")
+
+
+def raftkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    nodes = opts.get("nodes") or ["n1", "n2", "n3"]
+    ports = free_ports(len(nodes))
+    nemesis_name = opts.get("nemesis", "none")
+    pkg = NEMESES(nemesis_name,
+                  {"interval": float(opts.get("nemesis_interval", 3.0))},
+                  dict(zip(nodes, ports)))
+
+    wl = linearizable_register.workload(
+        keys=range(int(opts.get("keys", 2))),
+        ops_per_key=int(opts.get("ops_per_key", 400)),
+        threads_per_key=2)
+
+    time_limit = float(opts.get("time_limit", 10.0))
+    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    parts = [client_gen]
+    if pkg.generator is not None:
+        parts = [gen.any_gen(client_gen,
+                             gen.nemesis(gen.time_limit(time_limit,
+                                                        pkg.generator)))]
+    if pkg.final_generator is not None:
+        parts.append(gen.synchronize(gen.nemesis(gen.lift(pkg.final_generator))))
+    if pkg.generator is not None:
+        # post-heal recovery phase (see suites/localkv/runner.py): raft
+        # additionally needs election time after the final heal
+        recovery = float(opts.get("recovery_time", 4.0))
+        if recovery > 0:
+            parts.append(gen.synchronize(gen.sleep(1.0)))
+            parts.append(gen.synchronize(
+                gen.time_limit(recovery, gen.clients(wl["generator"]))))
+
+    test = {**opts,
+            "name": ("raftkv-stale" if opts.get("stale_reads") else "raftkv")
+                    + f"-{nemesis_name}",
+            "nodes": nodes,
+            "raftkv_ports": dict(zip(nodes, ports)),
+            "raftkv_stale_reads": bool(opts.get("stale_reads")),
+            "remote": DummyRemote(),
+            "db": RaftKvDB(),
+            "client": RaftRegisterClient(),
+            "nemesis": pkg.nemesis,
+            "generator": parts,
+            "checker": compose({"stats": Stats(),
+                                "workload": wl["checker"],
+                                "perf": Perf(),
+                                "timeline": Timeline()})}
+    if nemesis_name == "partition":
+        router = ProxyRouter(nodes, dict(zip(nodes, ports)))
+        test["proxy_router"] = router
+        test["net"] = ProxyNet(router)
+        test.setdefault("resources", []).append(router)
+    return test
+
+
+def _suite_opts(parser):
+    parser.add_argument("--stale-reads", action="store_true",
+                        help="leader serves reads without a quorum round "
+                             "(must be refuted under partitions)")
+    parser.add_argument("--nemesis", default="none", choices=sorted(NEMESES))
+    parser.add_argument("--keys", type=int, default=2)
+    parser.add_argument("--ops-per-key", type=int, default=400)
+    parser.add_argument("--nemesis-interval", type=float, default=3.0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli.single_test_cmd(raftkv_test, opt_fn=_suite_opts,
+                                 prog="jepsen-tpu-raftkv"))

@@ -1,0 +1,489 @@
+"""raftkv server — a real Raft consensus KV store in a standalone process.
+
+The second in-repo real-server target (REALRUN.md; the first, localkv, is
+primary/backup): leader election, log replication, and majority commit are
+all real, over real TCP sockets, so the framework's partition/kill nemeses
+exercise *consensus* — leader deposal, elections across partitions,
+divergent-log repair — rather than a static primary.
+
+Protocol (length-prefixed JSON frames, shared with localkv):
+  peer RPCs    : request_vote, append_entries        (Raft §5)
+  client ops   : read / write / cas on named registers
+  diagnostics  : ping -> {role, term, leader}
+
+Linearizable by construction: every client op — including reads — is a log
+entry, applied to the state machine only once committed on a majority, and
+the reply is generated at apply time.  ``--stale-reads`` breaks exactly
+that: the leader answers reads from its local state machine immediately,
+so a deposed leader marooned in a minority partition keeps serving old
+values — the classic stale-leader-read violation the checker must catch.
+
+Durability: currentTerm/votedFor and every log mutation are appended to a
+WAL and fsync'd before externalization; a SIGKILL'd node replays it on
+restart (Raft's persistent state, §5.1).
+
+Stdlib only; run as ``python server.py --node n1 --port P --peers ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">I", hdr)
+    if length > 1 << 20:
+        raise ValueError("frame too large")
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return json.loads(data.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class RaftNode:
+    def __init__(self, opts):
+        self.node = opts.node
+        self.port = opts.port
+        # peers: {name: (host, port)} — possibly proxy addresses, so a
+        # partition nemesis can sever exactly this node's view of a peer
+        self.peers = {}
+        for spec in filter(None, opts.peers.split(",")):
+            name, host, port = spec.split(":")
+            self.peers[name] = (host, int(port))
+        self.stale_reads = opts.stale_reads
+        self.election_timeout = (opts.election_ms / 1000.0,
+                                 2 * opts.election_ms / 1000.0)
+        self.heartbeat_s = opts.heartbeat_ms / 1000.0
+
+        self.lock = threading.RLock()
+        self.role = FOLLOWER
+        self.current_term = 0
+        self.voted_for = None
+        self.log = []                    # [{"term": t, "cmd": {...}}]
+        self.commit_index = 0            # 1-based count of committed entries
+        self.last_applied = 0
+        self.kv = {}
+        self.leader_hint = None
+        self.next_index = {}             # leader: peer -> next log index
+        self.match_index = {}            # leader: peer -> replicated count
+        # client requests awaiting commit: log index -> [event, reply-slot]
+        self.waiting = {}
+        self.last_heard = time.monotonic()
+        self._rng = random.Random(f"{self.node}-{os.getpid()}")
+
+        os.makedirs(opts.data, exist_ok=True)
+        self.wal_path = os.path.join(opts.data, "raft.wal")
+        self._replay()
+        self.wal = open(self.wal_path, "a")
+
+    # -- persistence -------------------------------------------------------
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail write: ignore the partial record
+                t = rec.get("t")
+                if t == "term":
+                    self.current_term = rec["term"]
+                    self.voted_for = rec.get("voted")
+                elif t == "entry":
+                    del self.log[rec["i"] - 1:]
+                    self.log.append({"term": rec["term"], "cmd": rec["cmd"]})
+                elif t == "trunc":
+                    del self.log[rec["i"] - 1:]
+
+    def _persist_term(self) -> None:
+        self.wal.write(json.dumps({"t": "term", "term": self.current_term,
+                                   "voted": self.voted_for}) + "\n")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    def _persist_entries(self, start_i: int) -> None:
+        """Persist log entries from 1-based index start_i to the end."""
+        for i in range(start_i, len(self.log) + 1):
+            e = self.log[i - 1]
+            self.wal.write(json.dumps({"t": "entry", "i": i,
+                                       "term": e["term"],
+                                       "cmd": e["cmd"]}) + "\n")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    def _persist_trunc(self, from_i: int) -> None:
+        self.wal.write(json.dumps({"t": "trunc", "i": from_i}) + "\n")
+        self.wal.flush()
+        os.fsync(self.wal.fileno())
+
+    # -- role transitions (lock held) --------------------------------------
+
+    def _become_follower(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_term()
+        self.role = FOLLOWER
+
+    def _fail_waiting(self, from_i: int) -> None:
+        """Entries >= from_i were truncated: they can never commit."""
+        for i in [i for i in self.waiting if i >= from_i]:
+            ev, slot = self.waiting.pop(i)
+            slot.append({"ok": False, "error": "entry truncated "
+                         "(leadership lost)", "definite": True})
+            ev.set()
+
+    # -- Raft RPCs ---------------------------------------------------------
+
+    def on_request_vote(self, m):
+        with self.lock:
+            if m["term"] > self.current_term:
+                self._become_follower(m["term"])
+            granted = False
+            if m["term"] == self.current_term and \
+                    self.voted_for in (None, m["candidate"]):
+                my_last_term = self.log[-1]["term"] if self.log else 0
+                up_to_date = (m["last_log_term"], m["last_log_index"]) >= \
+                             (my_last_term, len(self.log))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = m["candidate"]
+                    self._persist_term()
+                    self.last_heard = time.monotonic()
+            return {"type": "vote", "term": self.current_term,
+                    "granted": granted}
+
+    def on_append_entries(self, m):
+        with self.lock:
+            if m["term"] > self.current_term:
+                self._become_follower(m["term"])
+            if m["term"] < self.current_term:
+                return {"type": "append-reply", "term": self.current_term,
+                        "ok": False}
+            # valid leader for this term
+            self.role = FOLLOWER
+            self.leader_hint = m["leader"]
+            self.last_heard = time.monotonic()
+            prev_i = m["prev_log_index"]
+            if prev_i > len(self.log) or \
+                    (prev_i > 0 and self.log[prev_i - 1]["term"]
+                     != m["prev_log_term"]):
+                return {"type": "append-reply", "term": self.current_term,
+                        "ok": False, "have": len(self.log)}
+            entries = m["entries"]
+            # delete conflicts, append new
+            for j, e in enumerate(entries):
+                i = prev_i + 1 + j
+                if i <= len(self.log):
+                    if self.log[i - 1]["term"] != e["term"]:
+                        self._persist_trunc(i)
+                        del self.log[i - 1:]
+                        self._fail_waiting(i)
+                    else:
+                        continue
+                self.log.append(e)
+                self._persist_entries(i)
+            if m["leader_commit"] > self.commit_index:
+                self.commit_index = min(m["leader_commit"],
+                                        prev_i + len(entries))
+                self._apply()
+            return {"type": "append-reply", "term": self.current_term,
+                    "ok": True, "have": len(self.log)}
+
+    # -- state machine -----------------------------------------------------
+
+    def _apply(self) -> None:
+        """Apply committed entries; answer any waiting client (lock held)."""
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            e = self.log[self.last_applied - 1]
+            reply = self._apply_cmd(e["cmd"])
+            w = self.waiting.pop(self.last_applied, None)
+            if w is not None:
+                ev, slot = w
+                slot.append(reply)
+                ev.set()
+
+    def _apply_cmd(self, cmd):
+        op, key = cmd["op"], cmd.get("key")
+        cur = self.kv.get(key)
+        if op == "read":
+            return {"ok": True, "value": cur}
+        if op == "write":
+            self.kv[key] = cmd["value"]
+            return {"ok": True}
+        if op == "cas":
+            if cur != cmd["old"]:
+                return {"ok": False, "error": "cas-mismatch",
+                        "definite": True}
+            self.kv[key] = cmd["new"]
+            return {"ok": True}
+        return {"ok": False, "error": f"bad op {op!r}", "definite": True}
+
+    # -- client ops --------------------------------------------------------
+
+    def on_client(self, m):
+        with self.lock:
+            if self.role != LEADER:
+                return {"ok": False, "error": "not-leader",
+                        "leader": self.leader_hint, "definite": True}
+            if m["op"] == "read" and self.stale_reads:
+                # the deliberate bug: local read, no quorum round
+                return {"ok": True, "value": self.kv.get(m["key"])}
+            cmd = {"op": m["op"], "key": m.get("key")}
+            if m["op"] == "write":
+                cmd["value"] = m["value"]
+            elif m["op"] == "cas":
+                cmd["old"], cmd["new"] = m["old"], m["new"]
+            self.log.append({"term": self.current_term, "cmd": cmd})
+            i = len(self.log)
+            self._persist_entries(i)
+            ev, slot = threading.Event(), []
+            self.waiting[i] = (ev, slot)
+            self.match_index[self.node] = i
+        self._replicate_once()
+        if not ev.wait(timeout=3.0):
+            with self.lock:
+                self.waiting.pop(i, None)
+            return {"ok": False, "error": "commit timeout",
+                    "indeterminate": True}
+        return slot[0]
+
+    # -- leader / election machinery ---------------------------------------
+
+    def _rpc(self, peer, msg, timeout=0.5):
+        try:
+            with socket.create_connection(self.peers[peer],
+                                          timeout=timeout) as s:
+                send_frame(s, msg)
+                return recv_frame(s)
+        except (OSError, ValueError):
+            return None
+
+    def _start_election(self) -> None:
+        with self.lock:
+            self.role = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.node
+            self._persist_term()
+            term = self.current_term
+            last_t = self.log[-1]["term"] if self.log else 0
+            req = {"type": "request_vote", "term": term,
+                   "candidate": self.node,
+                   "last_log_index": len(self.log), "last_log_term": last_t}
+            self.last_heard = time.monotonic()
+        votes = [self.node]
+        lock = threading.Lock()
+        majority = (len(self.peers) + 1) // 2 + 1
+        won = threading.Event()
+
+        def ask(p):
+            r = self._rpc(p, req)
+            if not r:
+                return
+            with self.lock:
+                if r["term"] > self.current_term:
+                    self._become_follower(r["term"])
+                    return
+                if not (self.role == CANDIDATE
+                        and self.current_term == term):
+                    return
+            if r.get("granted"):
+                with lock:
+                    votes.append(p)
+                    if len(votes) >= majority:
+                        won.set()
+
+        ts = [threading.Thread(target=ask, args=(p,), daemon=True)
+              for p in self.peers]
+        for t in ts:
+            t.start()
+        won.wait(timeout=self.election_timeout[0])
+        with self.lock:
+            if self.role == CANDIDATE and self.current_term == term \
+                    and len(votes) >= majority:
+                self.role = LEADER
+                self.leader_hint = self.node
+                self.next_index = {p: len(self.log) + 1 for p in self.peers}
+                self.match_index = {p: 0 for p in self.peers}
+                self.match_index[self.node] = len(self.log)
+                print(f"raftkv {self.node} elected leader term {term}",
+                      flush=True)
+        self._replicate_once()
+
+    def _replicate_once(self) -> None:
+        """One append_entries round to every peer (heartbeat + catch-up)."""
+        with self.lock:
+            if self.role != LEADER:
+                return
+            term = self.current_term
+            peers = list(self.peers)
+
+        def push(p):
+            while True:
+                with self.lock:
+                    if self.role != LEADER or self.current_term != term:
+                        return
+                    ni = self.next_index.get(p, len(self.log) + 1)
+                    prev_i = ni - 1
+                    prev_t = (self.log[prev_i - 1]["term"]
+                              if prev_i > 0 else 0)
+                    entries = self.log[ni - 1:ni + 63]  # <=64 per round
+                    req = {"type": "append_entries", "term": term,
+                           "leader": self.node, "prev_log_index": prev_i,
+                           "prev_log_term": prev_t, "entries": entries,
+                           "leader_commit": self.commit_index}
+                r = self._rpc(p, req)
+                if not r:
+                    return
+                with self.lock:
+                    if r["term"] > self.current_term:
+                        self._become_follower(r["term"])
+                        return
+                    if self.role != LEADER or self.current_term != term:
+                        return
+                    if r["ok"]:
+                        self.match_index[p] = prev_i + len(entries)
+                        self.next_index[p] = self.match_index[p] + 1
+                        self._advance_commit()
+                        if self.next_index[p] > len(self.log):
+                            return
+                        continue  # more to send
+                    # log mismatch: back off (use follower's hint)
+                    self.next_index[p] = min(ni - 1,
+                                             r.get("have", ni - 1) + 1)
+                    if self.next_index[p] < 1:
+                        self.next_index[p] = 1
+
+        ts = [threading.Thread(target=push, args=(p,), daemon=True)
+              for p in peers]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=1.5)
+
+    def _advance_commit(self) -> None:
+        """Leader: commit the highest N replicated on a majority with
+        log[N].term == currentTerm (Raft §5.4.2).  Lock held."""
+        counts = sorted(self.match_index.values(), reverse=True)
+        majority_n = counts[(len(self.peers) + 1) // 2]
+        if majority_n > self.commit_index and \
+                self.log[majority_n - 1]["term"] == self.current_term:
+            self.commit_index = majority_n
+            self._apply()
+
+    def _ticker(self) -> None:
+        while True:
+            time.sleep(self.heartbeat_s / 2)
+            with self.lock:
+                role = self.role
+                heard = self.last_heard
+            now = time.monotonic()
+            if role == LEADER:
+                self._replicate_once()
+            elif now - heard > self._rng.uniform(*self.election_timeout):
+                self._start_election()
+
+    # -- serving -----------------------------------------------------------
+
+    def handle(self, m):
+        t = m.get("type") or m.get("op")
+        if t == "request_vote":
+            return self.on_request_vote(m)
+        if t == "append_entries":
+            return self.on_append_entries(m)
+        if t == "ping":
+            with self.lock:
+                return {"ok": True, "node": self.node, "role": self.role,
+                        "term": self.current_term,
+                        "leader": self.leader_hint}
+        if t in ("read", "write", "cas"):
+            return self.on_client(m)
+        return {"ok": False, "error": f"bad message {t!r}",
+                "definite": True}
+
+    def serve(self) -> None:
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_frame(self.request)
+                    except (OSError, ValueError):
+                        return
+                    if msg is None:
+                        return
+                    try:
+                        reply = outer.handle(msg)
+                    except Exception as e:  # noqa: BLE001
+                        reply = {"ok": False, "error": repr(e),
+                                 "indeterminate": True}
+                    try:
+                        send_frame(self.request, reply)
+                    except OSError:
+                        return
+
+        class TS(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        threading.Thread(target=self._ticker, daemon=True).start()
+        with TS(("127.0.0.1", self.port), Handler) as srv:
+            print(f"raftkv {self.node} serving on {self.port} "
+                  f"(stale_reads={self.stale_reads})", flush=True)
+            srv.serve_forever()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--peers", default="",
+                    help="name:host:port,... of the other nodes")
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--election-ms", type=int, default=400)
+    ap.add_argument("--heartbeat-ms", type=int, default=120)
+    ap.add_argument("--stale-reads", action="store_true")
+    ap.add_argument("--marker", default="", help="argv tag for grepkill")
+    RaftNode(ap.parse_args(argv)).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -231,3 +231,121 @@ class TestConcurrentGeneratorRotation:
         assert keys == {0, 1, 2, 3, 4}
         invokes = [op for op in hist if op.type == "invoke"]
         assert len(invokes) == 5 * 6
+
+
+class TestFairness:
+    """Scheduling fairness (the reference leans on bifurcan's fair set,
+    generator.clj:437-451): free-thread choice must not starve threads or
+    generators."""
+
+    def test_threads_share_ops_roughly_equally(self):
+        h = testkit.simulate({"concurrency": 4},
+                             gen.limit(400, gen.FnGen(
+                                 lambda: {"f": "w"})))
+        by_p = {}
+        for o in invokes(h):
+            by_p[o.process] = by_p.get(o.process, 0) + 1
+        assert len(by_p) == 4
+        lo, hi = min(by_p.values()), max(by_p.values())
+        assert lo >= 50, by_p   # no starving under the fixed seed
+        assert hi - lo <= 60, by_p
+
+    def test_mix_distribution_is_roughly_uniform(self):
+        g = gen.mix([gen.repeat({"f": "a"}), gen.repeat({"f": "b"}),
+                     gen.repeat({"f": "c"})])
+        h = testkit.quick(gen.limit(600, g))
+        counts = {}
+        for o in invokes(h):
+            counts[o.f] = counts.get(o.f, 0) + 1
+        assert set(counts) == {"a", "b", "c"}
+        assert all(120 <= c <= 320 for c in counts.values()), counts
+
+    def test_reserve_keeps_ranges_busy_independently(self):
+        # one range's generator exhausting must not idle the other range
+        g = gen.reserve(2, gen.limit(10, gen.repeat({"f": "a"})),
+                        gen.limit(200, gen.repeat({"f": "b"})))
+        h = testkit.simulate({"concurrency": 5}, g)
+        counts = {}
+        for o in invokes(h):
+            counts[o.f] = counts.get(o.f, 0) + 1
+        assert counts == {"a": 10, "b": 200}, counts
+
+
+class TestPendingBackoff:
+    """:pending semantics: the scheduler waits (bounded poll tick) instead
+    of spinning or giving up (interpreter.clj:267 1 ms backoff)."""
+
+    def test_stagger_produces_pending_then_op(self):
+        # stagger makes ops due in the future; with no completions pending
+        # the simulator advances its 1 ms poll tick until the op is due
+        g = gen.time_limit(0.05, gen.stagger(0.01, gen.repeat({"f": "w"})))
+        h = testkit.quick(g, concurrency=2,
+                          complete_fn=testkit.instant)
+        ts = [o.time for o in invokes(h)]
+        assert 3 <= len(ts) <= 7, ts     # ~5 ops in 50 ms at 10 ms stagger
+        assert all(b >= a for a, b in zip(ts, ts[1:]))
+
+    def test_concurrency_limit_blocks_not_drops(self):
+        g = gen.concurrency_limit(1, gen.limit(20, gen.repeat({"f": "w"})))
+        h = testkit.simulate({"concurrency": 4}, g)
+        evs = [o for o in h if o.type in (INVOKE, OK)]
+        # with limit 1 the invoke/ok events must strictly alternate
+        for a, b in zip(evs, evs[1:]):
+            assert a.type != b.type, [(o.type, o.process) for o in evs[:8]]
+        assert len(invokes(h)) == 20
+
+
+class TestProcessLimitEdges:
+    def test_process_limit_counts_crashed_replacements(self):
+        # every op crashes; process-limit must stop after N distinct
+        # processes even though concurrency never drops
+        crash = lambda op: (1_000_000, INFO)
+        g = gen.process_limit(5, gen.repeat({"f": "w"}))
+        h = testkit.simulate({"concurrency": 2}, g, complete_fn=crash)
+        procs = {o.process for o in invokes(h)}
+        assert len(procs) == 5, procs
+
+    def test_each_thread_exhausts_independently(self):
+        g = gen.each_thread(gen.limit(3, gen.repeat({"f": "w"})))
+        h = testkit.simulate({"concurrency": 3}, g)
+        by_p = {}
+        for o in invokes(h):
+            by_p[o.process] = by_p.get(o.process, 0) + 1
+        # every thread INCLUDING the nemesis gets its own copy
+        # (generator.clj:1001 each-thread includes the nemesis thread)
+        assert by_p == {0: 3, 1: 3, 2: 3, "nemesis": 3}, by_p
+
+    def test_each_thread_follows_process_migration(self):
+        # a crashed process's replacement (p + concurrency) continues the
+        # SAME thread's copy — it must not get a fresh generator
+        crashes = iter([True, False, False, False, False, False])
+        def complete(op):
+            return (1_000_000, INFO if next(crashes, False) else OK)
+        g = gen.each_thread(gen.limit(3, gen.repeat({"f": "w"})))
+        h = testkit.simulate({"concurrency": 2}, g, complete_fn=complete)
+        client_invokes = [o for o in invokes(h) if o.process != "nemesis"
+                          and not (isinstance(o.process, str))]
+        assert len(client_invokes) == 6, [
+            (o.process, o.type) for o in h]
+
+
+class TestSynchronizeBarrier:
+    def test_synchronize_waits_for_stragglers(self):
+        # phase 2 must not start until every phase-1 op completed
+        g = [gen.limit(6, gen.repeat({"f": "one"})),
+             gen.synchronize(gen.limit(2, gen.repeat({"f": "two"})))]
+        h = testkit.simulate({"concurrency": 3}, g)
+        last_one_ok = max(o.time for o in h
+                          if o.type == OK and o.f == "one")
+        first_two = min(o.time for o in invokes(h) if o.f == "two")
+        assert first_two >= last_one_ok
+
+    def test_any_with_stagger_interleaves(self):
+        # any-stagger regression shape (generator_test.clj:509): both
+        # sources make progress
+        a = gen.stagger(0.001, gen.limit(20, gen.repeat({"f": "a"})))
+        b = gen.stagger(0.001, gen.limit(20, gen.repeat({"f": "b"})))
+        h = testkit.quick(gen.any_gen(a, b), concurrency=4)
+        fs = {o.f for o in invokes(h)}
+        assert fs == {"a", "b"}
+        assert len(invokes(h)) == 40

@@ -222,3 +222,108 @@ class TestKafkaSkipEvidence:
              ok(1, [["poll", {0: [[2, 12]]}]]) +
              ok(2, [["poll", {0: [[1, 11]]}]]))
         assert "poll-skip" in check(h)["anomaly-types"]
+
+
+def info(process, mops, time=None):
+    inv = Op(process=process, type=INVOKE, f="txn", value=mops)
+    cmp = Op(process=process, type="info", f="txn", value=mops)
+    if time is not None:
+        inv = inv.with_(time=time)
+        cmp = cmp.with_(time=time + 1)
+    return [inv, cmp]
+
+
+def ok_t(process, mops, t_invoke, t_ok):
+    return [Op(process=process, type=INVOKE, f="txn", value=mops,
+               time=t_invoke),
+            Op(process=process, type=OK, f="txn", value=mops, time=t_ok)]
+
+
+class TestKafkaVersionOrders:
+    """Cross-observation version orders (kafka.clj:820-870): polls vote on
+    offset contents too, with indeterminate-txn recovery."""
+
+    def test_inconsistent_offsets_poll_vs_poll(self):
+        # no send acked offset 0, but two polls disagree about its value
+        h = (ok(0, [["poll", {0: [[0, 10]]}]]) +
+             ok(1, [["poll", {0: [[0, 99]]}]]))
+        r = check(h)
+        assert "inconsistent-offsets" in r["anomaly-types"], r
+        a = r["anomalies"]["inconsistent-offsets"][0]
+        assert a["offset"] == 0 and sorted(a["values"]) == [10, 99]
+
+    def test_inconsistent_offsets_send_vs_poll(self):
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(1, [["poll", {0: [[0, 77]]}]]))
+        r = check(h)
+        assert "inconsistent-offsets" in r["anomaly-types"], r
+
+    def test_duplicate_across_polls_only(self):
+        # value 10 observed at two offsets purely via polls
+        h = (ok(0, [["poll", {0: [[0, 10]]}]]) +
+             ok(1, [["poll", {0: [[3, 10]]}]]))
+        r = check(h)
+        assert "duplicate" in r["anomaly-types"], r
+
+    def test_recovered_info_txn_joins_committed_universe(self):
+        # info send of (0 -> offset 0, value 10); an OK poll observed 10,
+        # proving the txn committed (must-have-committed?).  Its OTHER send
+        # (offset 1, value 11) is then committed too — so a poll observing
+        # offset 2 while never seeing offset 1 is a lost write.
+        h = (info(0, [["send", 0, [0, 10]], ["send", 0, [1, 11]]]) +
+             ok(1, [["send", 0, [2, 12]]]) +
+             ok(2, [["poll", {0: [[0, 10], [2, 12]]}]]))
+        r = check(h)
+        assert r["recovered-info-count"] == 1, r
+        lost = r["anomalies"].get("lost-write", [])
+        assert any(d["offset"] == 1 for d in lost), r
+
+    def test_unrecovered_info_txn_stays_out(self):
+        # nothing observed the info txn's values: its sends must NOT count
+        # as committed (no lost-write for them)
+        h = (info(0, [["send", 0, [0, 10]]]) +
+             ok(1, [["send", 0, [1, 11]]]) +
+             ok(2, [["poll", {0: [[1, 11]]}]]))
+        r = check(h)
+        assert r["recovered-info-count"] == 0
+        assert "lost-write" not in r["anomaly-types"], r
+
+
+class TestKafkaRealtimeLag:
+    def test_lag_zero_when_up_to_date(self):
+        h = (ok_t(0, [["send", 0, [0, 10]]], 0, 1_000_000_000) +
+             ok_t(1, [["poll", {0: [[0, 10]]}]],
+                  2_000_000_000, 3_000_000_000))
+        r = check(h)
+        assert r["worst-realtime-lag"]["lag"] == 0, r
+
+    def test_lag_counts_from_known_newer_offset(self):
+        # offset 1 known to exist at t=3s; a poll invoked at t=10s that only
+        # reaches offset 0 lags >= 7s
+        h = (ok_t(0, [["send", 0, [0, 10]]], 0, 1_000_000_000) +
+             ok_t(0, [["send", 0, [1, 11]]], 2_000_000_000, 3_000_000_000) +
+             ok_t(1, [["poll", {0: [[0, 10]]}]],
+                  10_000_000_000, 11_000_000_000))
+        r = check(h)
+        w = r["worst-realtime-lag"]
+        assert w["key"] == 0 and w["lag"] == 7_000_000_000, r
+
+    def test_empty_poll_lags_from_log_nonempty(self):
+        # empty poll of an assigned key invoked at t=5s; the log was known
+        # non-empty at t=1s -> lag >= 4s
+        h = (ok_t(0, [["send", 0, [0, 10]]], 0, 1_000_000_000) +
+             ok_t(1, [["poll", {0: []}]], 5_000_000_000, 6_000_000_000) +
+             ok_t(2, [["poll", {0: [[0, 10]]}]],
+                  7_000_000_000, 8_000_000_000))
+        r = check(h)
+        by_key = r["worst-realtime-lag-by-key"]
+        assert by_key[0]["lag"] == 4_000_000_000, r
+
+    def test_lag_is_per_key(self):
+        h = (ok_t(0, [["send", 0, [0, 10]]], 0, 1_000_000_000) +
+             ok_t(0, [["send", 1, [0, 20]]], 0, 1_000_000_000) +
+             ok_t(1, [["poll", {0: [[0, 10]], 1: [[0, 20]]}]],
+                  2_000_000_000, 3_000_000_000))
+        r = check(h)
+        assert all(v["lag"] == 0
+                   for v in r["worst-realtime-lag-by-key"].values()), r

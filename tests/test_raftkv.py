@@ -1,0 +1,71 @@
+"""Real-consensus end-to-end: the raftkv suite against actual Raft daemons.
+
+Leader election, replicated-log commit, and WAL recovery are all real OS
+processes and TCP sockets; faults are real SIGKILLs and really-severed
+proxy links.  Linearizable mode must verify under every nemesis; the
+stale-leader-reads mode must be refuted once a partition maroons a leader.
+"""
+
+import os
+
+from jepsen_tpu import core
+
+from suites.raftkv.runner import raftkv_test
+
+
+def run_raftkv(tmp_path, **opts):
+    t = raftkv_test({
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 6,
+        "time_limit": 6.0,
+        "keys": 2,
+        "store_base": str(tmp_path / "store"),
+        "raftkv_dir": str(tmp_path / "raftkv"),
+        **opts,
+    })
+    return core.run(t)
+
+
+class TestRaftKv:
+    def test_healthy_cluster_verifies(self, tmp_path):
+        done = run_raftkv(tmp_path, nemesis="none", time_limit=5.0)
+        assert done["results"]["valid"] is True, \
+            list(core.iter_analysis_errors(done["results"]))
+        wals = [os.path.join(done["store_dir"], n, "raft.wal")
+                for n in ("n1", "n2", "n3")]
+        assert any(os.path.exists(w) and os.path.getsize(w) > 0
+                   for w in wals)
+
+    def test_leader_kill_reelection_verifies(self, tmp_path):
+        done = run_raftkv(tmp_path, nemesis="kill", nemesis_interval=2.5,
+                          time_limit=8.0)
+        assert done["results"]["valid"] is True, \
+            list(core.iter_analysis_errors(done["results"]))
+        fs = [op.f for op in done["history"]
+              if getattr(op, "process", None) == "nemesis"]
+        assert "kill" in fs
+
+    def test_partition_minority_verifies(self, tmp_path):
+        done = run_raftkv(tmp_path, nemesis="partition",
+                          nemesis_interval=2.5, time_limit=8.0)
+        assert done["results"]["valid"] is True, \
+            list(core.iter_analysis_errors(done["results"]))
+        fs = [op.f for op in done["history"]
+              if getattr(op, "process", None) == "nemesis"]
+        assert "start-partition" in fs and "stop-partition" in fs
+
+    def test_stale_leader_reads_refuted_under_partition(self, tmp_path):
+        # A marooned leader serving unquorum'd reads is the classic raft
+        # consistency bug; severing its links must surface it as a
+        # machine-checked linearizability violation.  The grudge isolates a
+        # random minority each cycle, so give it a few cycles to catch the
+        # leader.
+        for attempt in range(3):
+            done = run_raftkv(tmp_path, nemesis="partition",
+                              nemesis_interval=2.0, time_limit=10.0,
+                              stale_reads=True,
+                              store_base=str(tmp_path / f"s{attempt}"))
+            if done["results"]["valid"] is False:
+                assert done["results"]["workload"]["failures"]
+                return
+        raise AssertionError("stale-read leader never caught in 3 runs")

@@ -172,3 +172,27 @@ class TestDifferential:
         tpu = wgl_tpu.check(get_model("cas-register"), h2,
                             capacity=256, chunk=256)
         assert cpu["valid"] == tpu["valid"]
+
+
+class TestClosureWorkBudget:
+    """The per-chunk closure budget (watchdog mitigation): with a tiny
+    budget the driver must take many mid-chunk resumes and still reach
+    exactly the oracle's verdict."""
+
+    def test_tiny_budget_same_verdicts(self, monkeypatch):
+        from jepsen_tpu.checker import wgl_tpu
+        monkeypatch.setattr(wgl_tpu, "CLOSURE_WORK_BUDGET", 64)
+        model = get_model("cas-register")
+        h = cas_register_history(300, concurrency=6, crash_p=0.01, seed=3)
+        r = wgl_tpu.check(model, h, capacity=64, chunk=64)
+        assert r["valid"] is True, r
+        bad = corrupt_reads(h, n=1, seed=3)
+        r2 = wgl_tpu.check(model, bad, capacity=64, chunk=64, explain=False)
+        assert r2["valid"] is False, r2
+        # differential: failing op agrees with the CPU oracle
+        c = wgl_cpu.check(CASRegister(), bad)
+        assert r2["op"]["index"] == c["op"]["index"]
+
+    def test_budget_scales_with_capacity(self):
+        from jepsen_tpu.checker.wgl_tpu import closure_budget
+        assert closure_budget(1024) > closure_budget(16384) >= 16
