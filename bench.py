@@ -132,10 +132,13 @@ def build_ceiling():
 
 
 def build_refuted():
+    # Corruption lands in the first 15% of the stream so the tier can
+    # *assert* the engine's early exit touched a bounded prefix (the
+    # host-poll early-out claimed in wgl_tpu's module docs).
     from jepsen_tpu.synth import cas_register_history, corrupt_reads
     return corrupt_reads(
         cas_register_history(N_OPS, concurrency=8, crash_p=0.0005, seed=4),
-        n=2, seed=4)
+        n=2, seed=4, within=0.15)
 
 
 def build_ablation():
@@ -286,22 +289,37 @@ def tier_hard():
 
 
 def tier_ceiling():
+    # The 2^18-state burst cannot conclude below the 65536 ceiling; the
+    # claim under test is that the engine escalates the whole capacity
+    # ladder and degrades to "unknown" in *bounded time* — asserted here
+    # against an explicit wall budget, not just the orchestrator timeout.
     hard_cap = 4096 if SMOKE else 65536
+    degrade_budget_s = 300.0 if SMOKE else 900.0
     r, walls, meta = _device_tier(build_ceiling(), capacity=1024,
                                   max_capacity=hard_cap, runs=1)
     if not SMOKE:
         assert r["valid"] == "unknown", r
+        assert walls[0] < degrade_budget_s, (walls, degrade_budget_s)
     emit({"runs": walls, "valid": r["valid"],
           "configs_explored": r.get("configs-explored"),
+          "degradation_timed": walls[0] < degrade_budget_s,
+          "degrade_budget_s": degrade_budget_s,
           "error": r.get("error"), **meta})
 
 
 def tier_refuted():
-    r, walls, meta = _device_tier(build_refuted(), capacity=1024,
+    h = build_refuted()
+    r, walls, meta = _device_tier(h, capacity=1024,
                                   max_capacity=4096 if SMOKE else 16384,
                                   runs=2, explain=False)
     assert r["valid"] is False, r
+    # Early exit: the corrupted read sits in the first 15% of the history
+    # (build_refuted), so the chunk-boundary failure poll must have stopped
+    # dispatch inside the first 20% of the stream.
+    frac = r["op"]["index"] / len(h.ops)
+    assert frac < 0.20, (r["op"]["index"], len(h.ops))
     emit({"runs": walls, "failed_op_index": r["op"]["index"],
+          "stream_fraction_to_refute": round(frac, 4),
           "configs_explored": r.get("configs-explored"), **meta})
 
 
@@ -447,6 +465,49 @@ def main():
     cpu_wall = cpu10k.get("wall_s")
     vs_lower_bound = bool(cpu10k.get("timeout") or cpu10k.get("exploded_at"))
 
+    # Full record — every tier verbatim, including stderr tails of crashed
+    # tiers — goes to DISK; the one stdout line stays compact (<4 KB) so the
+    # driver's tail always captures a parseable headline.  (Round-3 lesson:
+    # a 1500-char traceback embedded in the line pushed the headline out of
+    # the driver's 4 KB tail and the committed artifact was parsed: null.
+    # The reference treats results as artifacts, not logs — store.clj
+    # save-2!; this is the same discipline.)
+    full = {
+        "n_ops": N_OPS,
+        "timing": "median-of-3",
+        "tier_isolation": "per-tier subprocess + timeout",
+        "chunk": CHUNK,
+        "analyzer": "wgl-tpu",
+        "tiers": tiers,
+    }
+    full_path = os.environ.get(
+        "JTPU_BENCH_FULL",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_full.json"))
+    try:
+        with open(full_path, "w") as f:
+            json.dump(full, f, indent=1)
+    except OSError as e:  # a read-only fs must not cost the headline
+        progress(f"could not write {full_path}: {e}")
+
+    keep = ("status", "wall_s", "runs", "valid", "configs_explored",
+            "max_capacity_reached", "histories_per_sec", "n_histories",
+            "ops_each", "setup_s", "timeout_s", "rc", "subsume",
+            "failed_op_index", "stream_fraction_to_refute",
+            "degradation_timed", "window", "warm_s")
+
+    def slim(t: dict) -> dict:
+        out = {k: t[k] for k in keep if t.get(k) is not None}
+        if t.get("error"):
+            out["error"] = str(t["error"])[:120]
+        return out
+
+    cpu_slim = {"status": tiers["cpu"].get("status")}
+    for name in ("200", "1k", "10k"):
+        if isinstance(tiers["cpu"].get(name), dict):
+            cpu_slim[name] = {k: v for k, v in tiers["cpu"][name].items()
+                              if k in ("wall_s", "valid", "timeout")}
+
     print(json.dumps({
         "metric": "cas_register_10k_op_linearizability_check_wall_s",
         "value": round(wall, 3) if wall else None,
@@ -456,22 +517,18 @@ def main():
         "extra": {
             "n_ops": N_OPS,
             "timing": "median-of-3",
-            "tier_isolation": "per-tier subprocess + timeout",
             "vs_baseline_is_lower_bound": vs_lower_bound,
             "vs_target_60s": round(TARGET_S / wall, 2) if wall else None,
-            "cpu_baseline": tiers["cpu"],
-            "easy": easy,
-            "hard": tiers["hard"],
-            "ceiling": tiers["ceiling"],
-            "refuted": tiers["refuted"],
-            "batch": tiers["batch"],
-            "ablation": {"on": tiers["ablation_on"],
-                         "off": tiers["ablation_off"],
-                         "claim": "ghost subsumption: 2^crashes -> "
-                                  "O(crashes) configs (wgl_tpu.py:22-32)"},
-            "second_process_setup": tiers["setup2"],
-            "chunk": CHUNK,
-            "analyzer": "wgl-tpu",
+            "cpu_baseline": cpu_slim,
+            "easy": slim(easy),
+            "hard": slim(tiers["hard"]),
+            "ceiling": slim(tiers["ceiling"]),
+            "refuted": slim(tiers["refuted"]),
+            "batch": slim(tiers["batch"]),
+            "ablation_on": slim(tiers["ablation_on"]),
+            "ablation_off": slim(tiers["ablation_off"]),
+            "second_process_setup": slim(tiers["setup2"]),
+            "full_record": os.path.basename(full_path),
         },
     }))
     return 0

@@ -86,8 +86,14 @@ CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "1000000"))
 
 
 def closure_budget(capacity: int) -> int:
-    """Closure iterations one chunk may spend at this capacity."""
-    return max(16, CLOSURE_WORK_BUDGET // capacity)
+    """Closure iterations one chunk may spend at this capacity.
+
+    ``capacity`` is the TOTAL rows a closure iteration sorts: callers whose
+    per-iteration cost scales beyond a single engine's capacity (sharded:
+    capacity_per_shard * n_shards gathered rows; batch: capacity * lanes)
+    pass that product so one dispatch's wall-clock stays at the same bound
+    everywhere."""
+    return max(16, CLOSURE_WORK_BUDGET // max(1, capacity))
 
 
 def engine_window(window: int) -> int:
@@ -96,12 +102,18 @@ def engine_window(window: int) -> int:
 
 
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-#          overflow, explored, rounds, peak, ghosts)
+#          overflow, explored, rounds, peak, ghosts, budget, consumed,
+#          cl_iters)
 # peak is the high-water mark of the distinct-configuration count since the
 # driver last reset it: the capacity the search *actually* needed, which the
 # host reads at chunk boundaries to pick the cheapest sufficient engine.
 # ghosts is the uint32[MW] bitmask of window slots held by ops that never
 # return (crashed/info ops): closure dedup subsumes on it (see closure).
+# budget/consumed implement the per-dispatch work bound (see closure_budget);
+# cl_iters is the cumulative fixpoint-iteration count of the *current paused
+# closure* — it persists across pause/resume dispatches so the W+1
+# convergence cap applies to the cumulative count, exactly as it did when a
+# closure always ran inside one dispatch.
 
 
 def make_engine(model: JaxModel, window: int, capacity: int,
@@ -126,9 +138,10 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     # window-shaped carries outside carry0 (parallel.sharded) must use
     # engine_window() for the same padding.
     window = engine_window(window)
-    # work_budget: None = capacity-scaled default; <= 0 = unlimited (the
-    # vmapped batch engine runs lanes in lockstep and cannot resume lanes
-    # at different positions, so it opts out).
+    # work_budget: None = capacity-scaled default; <= 0 = unlimited
+    # (escape hatch for callers that manage their own bounds — the
+    # shipped drivers all pass a real budget: the batch driver resumes
+    # lanes at independent positions via per-lane consumed counts).
     if work_budget is None:
         work_budget = closure_budget(capacity)
     if work_budget <= 0:
@@ -220,7 +233,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 1, dtype=jnp.uint32))
         return jnp.stack(out, axis=-1)                     # [N, MW]
 
-    def closure(mask, states, valid, win_ops, active, ghosts, overflow):
+    def closure(mask, states, valid, win_ops, active, ghosts, overflow,
+                budget, it0):
         # Dedup treats the ghost-slot part of the mask as a *subsumption*
         # column, not an identity column: ghost ops never return, so their
         # bits are never consulted by pruning, and a config whose ghost set
@@ -229,6 +243,14 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # Together with per-class canonicalization this turns the
         # 2^crashes configuration blowup that kills knossos into
         # O(crashes) — see BENCH ghost tiers.
+        #
+        # ``budget`` caps the fixpoint iterations of THIS call: a closure
+        # that runs out pauses (returns converged=False) with the partial —
+        # but sound, monotone — set; the caller must then keep the dirty
+        # flag, not consume the event, and let the host resume the same
+        # RETURN in a fresh dispatch, where closure continues from the
+        # partial set to the same fixpoint.  This makes the per-dispatch
+        # iteration bound *tight* (<= budget), not budget + window.
         count0 = global_sum(valid.sum())
         n_blocks = (W + EXPAND_BLOCK - 1) // EXPAND_BLOCK
 
@@ -271,7 +293,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
         def cond(c):
             _, _, _, _, changed, ovf, it = c
-            return changed & ~ovf & (it < W + 1)
+            return changed & ~ovf & (it < W + 1) & (it - it0 < budget)
 
         B = EXPAND_BLOCK
 
@@ -318,15 +340,19 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             # new one, leaving the count level while the set moved.
             return (mask, states, valid, count, changed, ovf, it + 1)
 
-        init = (mask, states, valid, count0, jnp.bool_(True), overflow,
-                jnp.int32(0))
-        mask, states, valid, count, _, overflow, iters = lax.while_loop(
-            cond, body, init)
-        return mask, states, valid, count, overflow, iters
+        init = (mask, states, valid, count0, jnp.bool_(True), overflow, it0)
+        mask, states, valid, count, changed, overflow, it_fin = \
+            lax.while_loop(cond, body, init)
+        # Exit reasons: fixpoint (~changed), the W+1 cumulative chain-depth
+        # cap (treated as converged — matches the pre-budget behavior), or
+        # budget exhaustion — the only pause case.
+        converged = ~changed | (it_fin >= W + 1)
+        return mask, states, valid, count, overflow, it_fin, converged
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-         overflow, explored, rounds, peak, ghosts, budget, consumed) = carry
+         overflow, explored, rounds, peak, ghosts, budget, consumed,
+         cl_iters) = carry
         kind, slot, f, a, b, op_id, is_ghost, gcls, grank, gpos = (
             ev[0], ev[1], ev[2], ev[3], ev[4], ev[5], ev[6], ev[7], ev[8],
             ev[9])
@@ -338,7 +364,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak, ghosts, budget, consumed) = c
+             overflow, explored, rounds, peak, ghosts, budget, consumed,
+             cl_iters) = c
             win_ops2 = win_ops.at[slot].set(
                 jnp.stack([f, a, b, gcls, grank, gpos]))
             active2 = active.at[slot].set(True)
@@ -349,42 +376,79 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                 ghosts | slot_bitmask(slot), ghosts)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
                     failed, failed_op, overflow, explored, rounds, peak,
-                    ghosts2, budget, consumed)
+                    ghosts2, budget, consumed + 1, cl_iters)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
-             overflow, explored, rounds, peak, ghosts, budget, consumed) = c
+             overflow, explored, rounds, peak, ghosts, budget, consumed,
+             cl_iters) = c
 
             def with_closure(args):
-                (mask, states, valid, overflow, explored, rounds, peak,
-                 budget) = args
-                mask, states, valid, count, overflow, iters = closure(
-                    mask, states, valid, win_ops, active, ghosts, overflow)
-                return (mask, states, valid, overflow, explored + count,
-                        rounds + iters, jnp.maximum(peak, count),
-                        budget - iters)
+                (mask, states, valid, overflow, rounds, peak, budget,
+                 cl_iters) = args
+                mask, states, valid, count, overflow, it_fin, converged = \
+                    closure(mask, states, valid, win_ops, active, ghosts,
+                            overflow, budget, cl_iters)
+                iters = it_fin - cl_iters
+                return (mask, states, valid, overflow, rounds + iters,
+                        jnp.maximum(peak, count), budget - iters, it_fin,
+                        converged, count)
 
-            (mask, states, valid, overflow, explored, rounds, peak,
-             budget) = lax.cond(
-                dirty, with_closure, lambda a: a,
-                (mask, states, valid, overflow, explored, rounds, peak,
-                 budget))
+            def no_closure(args):
+                (mask, states, valid, overflow, rounds, peak, budget,
+                 cl_iters) = args
+                # Set already closed (no ENTER since the last closure):
+                # nothing to add to ``explored`` — count sentinel -1.
+                return (mask, states, valid, overflow, rounds, peak, budget,
+                        cl_iters, jnp.bool_(True), jnp.int32(-1))
 
-            bm = slot_bitmask(slot)
-            has = ((mask & bm[None, :]) != 0).any(-1)
-            valid2 = valid & has
-            n_surv = global_sum(valid2.sum())
-            newly_failed = n_surv == 0
-            failed_op2 = jnp.where(newly_failed & ~failed, op_id, failed_op)
-            mask2 = mask & ~bm[None, :]
-            active2 = active.at[slot].set(False)
-            return (mask2, states, valid2, win_ops, active2, jnp.bool_(False),
-                    failed | newly_failed, failed_op2, overflow, explored,
-                    rounds, peak, ghosts, budget, consumed)
+            (mask, states, valid, overflow, rounds, peak, budget, cl_iters,
+             converged, count) = lax.cond(
+                dirty, with_closure, no_closure,
+                (mask, states, valid, overflow, rounds, peak, budget,
+                 cl_iters))
+
+            def do_prune(args):
+                # Closure reached fixpoint inside the budget: prune configs
+                # lacking the returning op and consume the event.
+                (mask, states, valid, active, dirty, failed, failed_op,
+                 explored, consumed, cl_iters) = args
+                bm = slot_bitmask(slot)
+                has = ((mask & bm[None, :]) != 0).any(-1)
+                valid2 = valid & has
+                n_surv = global_sum(valid2.sum())
+                newly_failed = n_surv == 0
+                failed_op2 = jnp.where(newly_failed & ~failed, op_id,
+                                       failed_op)
+                mask2 = mask & ~bm[None, :]
+                active2 = active.at[slot].set(False)
+                return (mask2, states, valid2, active2, jnp.bool_(False),
+                        failed | newly_failed, failed_op2,
+                        explored + jnp.maximum(count, 0), consumed + 1,
+                        jnp.int32(0))
+
+            def do_pause(args):
+                # Budget ran out mid-fixpoint: keep the partial (sound,
+                # monotone) set, keep dirty, do NOT consume — the host
+                # resumes this same RETURN in a fresh dispatch and the
+                # closure continues where it left off (cl_iters carries the
+                # cumulative iteration count into the resumed closure).
+                return args
+
+            (mask, states, valid, active, dirty, failed, failed_op, explored,
+             consumed, cl_iters) = lax.cond(
+                converged, do_prune, do_pause,
+                (mask, states, valid, active, dirty, failed, failed_op,
+                 explored, consumed, cl_iters))
+            return (mask, states, valid, win_ops, active, dirty, failed,
+                    failed_op, overflow, explored, rounds, peak, ghosts,
+                    budget, consumed, cl_iters)
+
+        def do_nop(c):
+            return c[:14] + (c[14] + 1, c[15])  # consumed += 1
 
         def apply(c):
-            out = lax.switch(kind, [do_enter, do_return, lambda x: x], c)
-            return out[:14] + (out[14] + 1,)  # consumed += 1
+            return lax.switch(kind, [do_enter, do_return, do_nop], c)
 
         new_carry = lax.cond(alive, apply, lambda c: c, carry)
         return new_carry, None
@@ -411,17 +475,20 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.int32(1),                              # peak config count
                 jnp.zeros(MW, jnp.uint32),                 # ghost slots
                 jnp.int32(work_budget),                    # closure budget
-                jnp.int32(0))                              # events consumed
+                jnp.int32(0),                              # events consumed
+                jnp.int32(0))                              # paused-closure its
 
     def run_chunk(carry, events):
         # Reset the peak to the live count on entry, and the work budget /
         # consumed-event counter to fresh values (device-side: the host
         # reads all per-chunk scalars without extra round-trips); scan the
         # events; pack the scalars the host polls into ONE int32 vector so
-        # a chunk boundary costs a single device→host transfer.
+        # a chunk boundary costs a single device→host transfer.  cl_iters
+        # (carry[15]) is NOT reset: it belongs to a possibly-paused closure.
         live0 = global_sum(carry[2].sum()).astype(jnp.int32)
         carry = carry[:11] + (live0, carry[12],
-                              jnp.int32(work_budget), jnp.int32(0))
+                              jnp.int32(work_budget), jnp.int32(0),
+                              carry[15])
         carry, _ = lax.scan(event_step, carry, events)
         flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
                            carry[8].astype(jnp.int32),   # overflow
@@ -495,22 +562,21 @@ def ghost_words(p: PreparedHistory) -> int:
     return max(1, (int(p.n_ghosts) + 31) // 32)
 
 
-#: Per-dispatch work budget, in capacity x events units.  One chunk's XLA
-#: program must finish well inside the TPU worker's watchdog (a ~60 s
-#: program gets the worker killed — the round-2 bench death); per-event
-#: closure cost scales with capacity, so the driver shrinks the chunk as it
-#: escalates.  512 events at capacity 1024 is the measured-comfortable
-#: baseline shape.
-CHUNK_WORK_BUDGET = 512 * 1024
-
-
 def chunk_for_capacity(capacity: int, base_chunk: int) -> int:
-    """Events per dispatch at ``capacity``: the largest power-of-two chunk
-    <= base_chunk whose capacity x chunk work fits the budget (floor 8)."""
-    c = base_chunk
-    while c > 8 and c * capacity > CHUNK_WORK_BUDGET:
-        c //= 2
-    return max(8, c)
+    """Events per dispatch at ``capacity``.
+
+    Round 3 statically shrank the chunk as capacity grew (512*1024
+    capacity*events per dispatch) to keep one XLA program inside the TPU
+    worker's ~60 s watchdog — and the resulting per-dispatch host polls
+    (128-event chunks at capacity 4096, ~80 polls over a tunneled device)
+    became the easy-tier bottleneck.  The per-chunk closure work budget
+    (closure_budget: iterations scaled down as capacity grows, enforced
+    *inside* a single closure's fixpoint loop with mid-event pause/resume)
+    now bounds a dispatch's wall-clock tightly at any capacity, so the
+    chunk no longer needs to shrink: a capacity escalation keeps the same
+    dispatch granularity and the host just resumes mid-chunk whenever the
+    engine pauses."""
+    return base_chunk
 
 
 #: Configuration budget for the CPU witness re-derivation on refuted
@@ -575,11 +641,20 @@ def check(model: JaxModel, history: Optional[History] = None,
     gw = ghost_words(p)
     cap = capacity
     max_cap_reached = cap  # diagnostics: how far escalation actually went
+    # The chunk is capacity-INVARIANT (see chunk_for_capacity): capacity
+    # changes rebuild the engine but keep the dispatch granularity, and
+    # watchdog bounding comes from the closure work budget + mid-chunk
+    # resume, not from shrinking chunks.
     cur_chunk = chunk_for_capacity(cap, chunk)
     slice_chunk = _chunk_slicer(cur_chunk)
     carry0, run_chunk = _get_run_chunk(model, window, cap, gw)
     carry = carry0()
-    recent_peaks: deque = deque(maxlen=4)  # per-chunk high-water marks
+    # (peak, events-consumed) samples since the last capacity change.  With
+    # budget pauses a dispatch can cover anywhere from 0 to cur_chunk
+    # events, so shrink-back decisions weigh samples by the events they
+    # cover (>= SHRINK_WINDOW events of evidence), not by dispatch count.
+    SHRINK_WINDOW = 4 * cur_chunk
+    recent_peaks: deque = deque()
     # Pipelined dispatch: keep LOOKAHEAD chunks in flight so the (possibly
     # slow, e.g. tunneled) device→host flags transfer of chunk i overlaps
     # with the device computing chunk i+1.  Speculation is safe: once the
@@ -618,8 +693,6 @@ def check(model: JaxModel, history: Optional[History] = None,
             max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
-            cur_chunk = chunk_for_capacity(cap, chunk)
-            slice_chunk = _chunk_slicer(cur_chunk)
             _, run_chunk = _get_run_chunk(model, window, cap, gw)
             carry = _grow_carry(prev, cap)
             pos = cpos
@@ -628,26 +701,19 @@ def check(model: JaxModel, history: Optional[History] = None,
         done = after
         if failed or overflow:
             break
-        if consumed < cur_chunk:
-            # Closure budget exhausted mid-chunk: the unconsumed tail was
-            # gated to no-ops, and any speculative chunks skipped it —
-            # discard them and resume exactly where the engine stopped.
-            # (Keeps one XLA program's wall time bounded by work, under
-            # the TPU worker's watchdog, regardless of config-count
-            # superlinearity.)
-            inflight.clear()
-            carry = after
-            pos = cpos + consumed
-            recent_peaks.clear()
-            continue
-        recent_peaks.append(peak)
-        if cap > capacity and len(recent_peaks) == 4:
+        recent_peaks.append((peak, consumed))
+        covered = sum(e for _, e in recent_peaks)
+        while len(recent_peaks) > 1 and covered - recent_peaks[0][1] >= \
+                SHRINK_WINDOW:
+            covered -= recent_peaks.popleft()[1]
+        resumed = consumed < cur_chunk
+        if cap > capacity and covered >= SHRINK_WINDOW:
             # Crash-bursts inflate the configuration set transiently.  The
             # per-round sort cost scales with the *static* capacity, so once
             # recent peaks show a smaller buffer suffices (2x headroom over
-            # the last 4 chunks' high-water mark), drop back to a
-            # cheaper-per-round engine (discarding speculative chunks).
-            need = 2 * max(recent_peaks)
+            # the last SHRINK_WINDOW events' high-water mark), drop back to
+            # a cheaper-per-round engine (discarding speculative chunks).
+            need = 2 * max(pk for pk, _ in recent_peaks)
             target = cap
             while target > capacity and target // growth >= need:
                 target //= growth
@@ -658,12 +724,20 @@ def check(model: JaxModel, history: Optional[History] = None,
                 cap = target
                 recent_peaks.clear()
                 inflight.clear()
-                done_chunk = cur_chunk  # size the popped chunk ran with
-                cur_chunk = chunk_for_capacity(cap, chunk)
-                slice_chunk = _chunk_slicer(cur_chunk)
                 _, run_chunk = _get_run_chunk(model, window, cap, gw)
                 carry = _shrink_carry(after, cap)
-                pos = cpos + done_chunk
+                pos = cpos + consumed
+                continue
+        if resumed:
+            # Closure budget exhausted mid-chunk: the unconsumed tail was
+            # gated to no-ops, and any speculative chunks skipped it —
+            # discard them and resume exactly where the engine stopped.
+            # (Keeps one XLA program's wall time bounded by work, under
+            # the TPU worker's watchdog, regardless of config-count
+            # superlinearity.)
+            inflight.clear()
+            carry = after
+            pos = cpos + consumed
     carry = done
 
     explored = int(carry[9])

@@ -518,6 +518,15 @@ class Any(Generator):
         best_i = -1
         soonest = math.inf
         pending_any = False
+        # Pending children's continuations must survive even when another
+        # child wins the draw: a Sleep (or any self-timing generator)
+        # anchors its deadline in the continuation, and discarding it
+        # whenever a sibling produced an op re-anchors the timer on every
+        # dispense — a nemesis `sleep 1s; start-fault` inside any_gen with
+        # a busy client stream then fires arbitrarily late (observed 1-8 s
+        # of drift).  Ready-but-not-chosen children keep their PRE-draw
+        # state (the op was not taken from them), matching
+        # generator.clj:946's `any`.
         gens = list(self.gens)
         for i, g in enumerate(self.gens):
             r = g.op(test, ctx)
@@ -536,7 +545,6 @@ class Any(Generator):
         if best is None:
             return (PENDING, Any(*gens)) if pending_any else None
         v, g2 = best
-        gens = list(self.gens)
         if g2 is None:
             gens.pop(best_i)
         else:

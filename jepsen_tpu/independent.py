@@ -113,6 +113,7 @@ class ConcurrentGenerator(gen.Generator):
         self.fgen = fgen
         self.active: Dict[int, Optional[gen.Generator]] = {}  # group -> gen
         self.next_key = 0
+        self.rr = 0  # round-robin cursor for same-time candidate ties
 
     def _clone(self):
         c = ConcurrentGenerator.__new__(ConcurrentGenerator)
@@ -121,6 +122,7 @@ class ConcurrentGenerator(gen.Generator):
         c.fgen = self.fgen
         c.active = dict(self.active)
         c.next_key = self.next_key
+        c.rr = self.rr
         return c
 
     def _groups(self, ctx) -> List[List[Any]]:
@@ -138,9 +140,17 @@ class ConcurrentGenerator(gen.Generator):
                 c.active[gi] = None
 
     def op(self, test, ctx):
+        # Draw a CANDIDATE op from every group and dispense the soonest
+        # (generator.clj `any`'s rule).  Returning the first group's op
+        # starved the others whenever an outer pacing wrapper (stagger)
+        # kept group 0's threads free at each draw: with k keys only the
+        # first thread-group ever ran, so whole nodes had no clients.
+        # Non-chosen groups keep their pre-draw state (no op was taken);
+        # pending continuations ARE kept (they carry timer anchors).
         c = self._clone()
         groups = self._groups(ctx)
         pending = False
+        cands = []  # (v, g2, gi)
         for gi, threads in enumerate(groups):
             while True:
                 self._ensure(c, gi)
@@ -160,14 +170,24 @@ class ConcurrentGenerator(gen.Generator):
                     pending = True
                     c.active[gi] = g2
                     break
-                if g2 is None:
-                    # key exhausted via a final (op, None) draw (limit's
-                    # shape): free the group so the next draw advances it
-                    # to the next unclaimed key instead of parking forever
-                    del c.active[gi]
-                else:
-                    c.active[gi] = g2
-                return (v, c)
+                cands.append((v, g2, gi))
+                break
+        if cands:
+            # Soonest op wins; ties (the common case — unpaced gens stamp
+            # ops "now") rotate round-robin so no group monopolizes draws.
+            tmin = min(v.time for v, _, _ in cands)
+            ng = max(1, len(groups))
+            v, g2, gi = min((cand for cand in cands if cand[0].time == tmin),
+                            key=lambda cand: (cand[2] - c.rr) % ng)
+            c.rr = (gi + 1) % ng
+            if g2 is None:
+                # key exhausted via a final (op, None) draw (limit's
+                # shape): free the group so the next draw advances it
+                # to the next unclaimed key instead of parking forever
+                del c.active[gi]
+            else:
+                c.active[gi] = g2
+            return (v, c)
         if pending:
             return (gen.PENDING, c)
         if all(g is None for g in c.active.values()) and \

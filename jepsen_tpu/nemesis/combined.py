@@ -71,24 +71,42 @@ def db_package(opts: Optional[Dict] = None) -> Package:
                    perf=perf)
 
 
+def random_grudge(nodes):
+    """Default partition shape mix (combined.clj:227's targets)."""
+    kind = random.choice(["halves", "one", "majorities-ring"])
+    if kind == "halves":
+        return random_halves_grudge(nodes)
+    if kind == "one":
+        return jnet.complete_grudge(
+            jnet.split_one(random.choice(list(nodes)), nodes))
+    return jnet.majorities_ring(nodes)
+
+
 def partition_package(opts: Optional[Dict] = None) -> Package:
     """Network partition faults (combined.clj:227)."""
     opts = opts or {}
     interval = opts.get("interval", DEFAULT_INTERVAL)
 
-    def random_grudge(nodes):
-        kind = random.choice(["halves", "one", "majorities-ring"])
-        if kind == "halves":
-            return random_halves_grudge(nodes)
-        if kind == "one":
-            return jnet.complete_grudge(
-                jnet.split_one(random.choice(list(nodes)), nodes))
-        return jnet.majorities_ring(nodes)
-
     nem = Partitioner(opts.get("grudge_fn", random_grudge))
     g = _cycle_ops(interval,
                    {"f": "start-partition", "type": "info"},
                    {"f": "stop-partition", "type": "info"})
+    return Package(nemesis=nem, generator=g,
+                   final_generator=[{"f": "stop-partition", "type": "info"}],
+                   perf=[{"name": "partition", "start": ["start-partition"],
+                          "stop": ["stop-partition"], "color": "#E9DCA0"}])
+
+
+def partition_hold_package(opts: Optional[Dict] = None) -> Package:
+    """ONE partition, started after ``delay`` seconds and held until the
+    final heal — the deterministic schedule for refutation tests: a
+    bug-catching test must *force* its bug's window (a long, known one),
+    not hope a start/stop cycle lands on it.  The grudge fn still decides
+    who is severed (e.g. the live-discovered leader)."""
+    opts = opts or {}
+    nem = Partitioner(opts.get("grudge_fn", random_grudge))
+    g = [gen.sleep(float(opts.get("delay", 1.0))),
+         gen.once(gen.lift({"f": "start-partition", "type": "info"}))]
     return Package(nemesis=nem, generator=g,
                    final_generator=[{"f": "stop-partition", "type": "info"}],
                    perf=[{"name": "partition", "start": ["start-partition"],

@@ -5,6 +5,23 @@ checking (jepsen.independent/checker splits a multi-key history and runs
 sub-checkers in a bounded pmap, jepsen/src/jepsen/independent.clj:266-317):
 sub-histories become lanes of a vmapped engine, and lanes are sharded across
 the ``data`` mesh axis with pjit — no collectives needed, pure SPMD fan-out.
+
+**Watchdog bounding (round-4).**  A vmapped dispatch's wall-clock is the sum
+over scan steps of the *slowest lane's* closure work at that step, times the
+batched per-iteration cost (~all lanes' sorts fused).  Round 3 ran lanes
+with an unlimited work budget and a near-full-history chunk; one dispatch
+over 96 lanes outlived the TPU worker's ~60 s watchdog and killed the bench
+tier.  Two bounds now apply:
+
+- the chunk shrinks with the batch size (``_batch_chunk``), so the number
+  of scan steps — each of which can carry some lane's closure — divides
+  the per-dispatch work across more, shorter programs; and
+- each lane carries the capacity- and batch-scaled closure budget
+  (``wgl_tpu.closure_budget`` semantics): a lane that runs out pauses
+  mid-closure and the host resumes it from its per-lane ``consumed``
+  counter — lanes advance at *independent* positions via device-side
+  dynamic slicing, so one deep lane no longer holds a whole dispatch
+  hostage.
 """
 
 from __future__ import annotations
@@ -14,15 +31,30 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import (EV_NOP, events_array, ghost_words,
+from jepsen_tpu.checker.wgl_tpu import (EV_NOP, closure_budget,
+                                        events_array, ghost_words,
                                         make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
 _CACHE: Dict[Any, Any] = {}
+
+#: Target lane-events per dispatch: the vmapped scan costs ~(batch x chunk)
+#: lane-event steps, so the chunk shrinks as the batch grows to keep one
+#: XLA program's duration roughly constant regardless of batch size.
+LANE_EVENTS_PER_DISPATCH = 16384
+
+
+def _batch_chunk(bpad: int, longest: int) -> int:
+    """Events per dispatch for a ``bpad``-lane batch (multiple of 64,
+    clamped to [64, 2048] and to the longest lane rounded up)."""
+    c = max(64, min(2048, (LANE_EVENTS_PER_DISPATCH // max(1, bpad))
+                    // 64 * 64))
+    return min(c, max(64, ((longest + 63) // 64) * 64))
 
 
 def check_batch(model: JaxModel,
@@ -31,37 +63,27 @@ def check_batch(model: JaxModel,
                 axis: str = "data",
                 capacity: int = 1024,
                 max_capacity: int = 65536,
-                chunk: int = 2048) -> List[Dict[str, Any]]:
+                chunk: Optional[int] = None) -> List[Dict[str, Any]]:
     """Check many histories at once; returns one result dict per history.
 
     All lanes share one engine shape (window = max over histories, events
     NOP-padded to the longest).  With ``mesh``, lanes are sharded over the
     ``axis`` mesh axis; the batch is padded to a multiple of the axis size.
+    ``chunk=None`` picks the batch-size-scaled default (``_batch_chunk``).
     """
     if not histories:
         return []
     from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
     window = _round_window(max(p.window for p in preps))
-    # Clamp the chunk to the longest lane (rounded to 128) so short per-key
-    # histories don't pay a scan over thousands of NOP-padding events.
     longest = max(len(p) for p in preps)
-    chunk = min(chunk, max(128, ((longest + 127) // 128) * 128))
-    evs = [events_array(p, chunk) for p in preps]
-
-    # Per-lane capacity adaptivity: most lanes (short per-key histories)
-    # finish at the starting capacity; only the lanes that actually
-    # overflowed are regrouped into a smaller batch and re-run at an
-    # escalated capacity — one deep lane no longer makes every lane pay
-    # the O(C·W) closure cost of the rare worst case.
     gw = max(ghost_words(p) for p in preps)
-    out: List[Optional[Dict[str, Any]]] = [None] * len(evs)
-    lanes = list(range(len(evs)))
+    out: List[Optional[Dict[str, Any]]] = [None] * len(preps)
+    lanes = list(range(len(preps)))
     cap = capacity
     while lanes:
-        res = _run_lanes(model, [evs[i] for i in lanes],
-                         [preps[i] for i in lanes],
-                         window, cap, mesh, axis, chunk, gw)
+        res = _run_lanes(model, [preps[i] for i in lanes],
+                         window, cap, mesh, axis, chunk, gw, longest)
         retry = []
         for lane, r in zip(lanes, res):
             if r is None:
@@ -78,23 +100,32 @@ def check_batch(model: JaxModel,
     return out  # type: ignore[return-value]
 
 
-def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
-               mesh: Optional[Mesh], axis: str, chunk: int,
-               gwords: int = 1) -> List[Optional[Dict[str, Any]]]:
+def _run_lanes(model: JaxModel, preps, window: int, cap: int,
+               mesh: Optional[Mesh], axis: str, chunk: Optional[int],
+               gwords: int, longest: int) -> List[Optional[Dict[str, Any]]]:
     """One vmapped pass over a set of lanes at a fixed capacity.  Returns a
-    result per lane, or None where the lane overflowed (caller escalates)."""
-    emax = max(e.shape[0] for e in evs)
-    b = len(evs)
+    result per lane, or None where the lane overflowed (caller escalates).
+
+    Lanes progress at independent event positions: each dispatch slices a
+    per-lane chunk at that lane's position device-side, and the per-lane
+    ``consumed`` flag advances it — a budget-paused lane simply consumes
+    fewer events that dispatch (wgl_tpu's mid-chunk resume, vmapped)."""
+    b = len(preps)
     bpad = b
     if mesh is not None:
         n = mesh.shape[axis]
         bpad = ((b + n - 1) // n) * n
-    batch = np.full((bpad, emax, 10), 0, np.int32)
+    cc = chunk if chunk else _batch_chunk(bpad, longest)
+    evs = [events_array(p, cc) for p in preps]
+    emax = max(e.shape[0] for e in evs)
+    # One chunk-sized NOP cushion so any in-bounds resume offset slices a
+    # full chunk without clamping back into real events.
+    batch = np.zeros((bpad, emax + cc, 10), np.int32)
     batch[:, :, 0] = EV_NOP
     for i, e in enumerate(evs):
         batch[i, :e.shape[0]] = e
 
-    carry0, vrun = _batched_runner_simple(model, window, cap, gwords)
+    carry0, vrun = _batched_runner(model, window, cap, gwords, cc, bpad)
     c0 = carry0()
     carry = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (bpad,) + x.shape), c0)
@@ -105,16 +136,32 @@ def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
             carry)
         batch_dev = jax.device_put(
             jnp.asarray(batch), NamedSharding(mesh, P(axis, None, None)))
+        pos_sharding = NamedSharding(mesh, P(axis))
     else:
         batch_dev = jnp.asarray(batch)
-    from jepsen_tpu.checker.wgl_tpu import _chunk_slicer
-    slice_chunk = _chunk_slicer(chunk, axis=1)
-    n_chunks = emax // chunk
-    for ci in range(n_chunks):
-        carry, _ = vrun(carry, slice_chunk(batch_dev, ci * chunk))
+        pos_sharding = None
 
-    overflow = np.asarray(carry[8])[:b]
-    failed = np.asarray(carry[6])[:b]
+    lane_len = np.array([e.shape[0] for e in evs]
+                        + [0] * (bpad - b), np.int32)
+    pos = np.zeros(bpad, np.int32)
+    failed = np.zeros(bpad, bool)
+    overflow = np.zeros(bpad, bool)
+    while True:
+        active = ~failed & ~overflow & (pos < lane_len)
+        if not active.any():
+            break
+        pos_dev = jnp.asarray(pos)
+        if pos_sharding is not None:
+            pos_dev = jax.device_put(pos_dev, pos_sharding)
+        carry, flags = vrun(carry, batch_dev, pos_dev)
+        fl = np.asarray(flags)              # [bpad, 4]
+        failed = fl[:, 0].astype(bool)
+        overflow = fl[:, 1].astype(bool)
+        # A lane is done once its position passes its real events (the
+        # tail beyond lane_len is the NOP cushion); clamping there keeps
+        # finished lanes' positions stable across further dispatches.
+        pos = np.minimum(pos + fl[:, 3], lane_len)
+
     failed_op = np.asarray(carry[7])[:b]
     explored = np.asarray(carry[9])[:b]
     out: List[Optional[Dict[str, Any]]] = []
@@ -131,18 +178,26 @@ def _run_lanes(model: JaxModel, evs, preps, window: int, cap: int,
     return out
 
 
-def _batched_runner_simple(model: JaxModel, window: int, capacity: int,
-                           gwords: int = 1):
+def _batched_runner(model: JaxModel, window: int, capacity: int,
+                    gwords: int, chunk: int, bpad: int):
+    # Per-lane closure budget, scaled down by the batch size: a vmapped
+    # closure iteration costs ~bpad single-lane iterations (every lane's
+    # block merges run, masked or not), so the budget divides by
+    # (capacity * bpad) to keep one dispatch's wall-clock at the same
+    # bound as the single-history engine.
+    budget = closure_budget(capacity * bpad)
     key = ("batchv", model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
-           gwords)
+           gwords, chunk, bpad, budget)
     if key in _CACHE:
         return _CACHE[key]
-    # work_budget=0 (unlimited): vmapped lanes advance in lockstep and
-    # cannot resume at per-lane positions; lanes are short per-key
-    # histories whose chunks stay far from the watchdog bound.
     carry0, _, run_chunk = make_engine(model, window, capacity,
-                                       gwords=gwords, work_budget=0)
-    vrun = jax.jit(jax.vmap(run_chunk))
+                                       gwords=gwords, work_budget=budget)
+
+    def run_lane(carry, ev_all, p):
+        ev = lax.dynamic_slice_in_dim(ev_all, p, chunk)
+        return run_chunk(carry, ev)
+
+    vrun = jax.jit(jax.vmap(run_lane, in_axes=(0, 0, 0)))
     _CACHE[key] = (carry0, vrun)
     return _CACHE[key]

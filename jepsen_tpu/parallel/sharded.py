@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import (LOOKAHEAD, _chunk_slicer,
+from jepsen_tpu.checker.wgl_tpu import (EV_NOP, LOOKAHEAD, _chunk_slicer,
                                         events_array, ghost_words,
                                         make_engine)
 from jepsen_tpu.history import History
@@ -39,29 +39,37 @@ _CACHE: Dict[Any, Any] = {}
 
 
 def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
-                    mesh: Mesh, axis: str, gwords: int = 1):
+                    mesh: Mesh, axis: str, gwords: int = 1,
+                    work_budget: Optional[int] = None):
     key = ("shard", model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window,
-           capacity_per_shard, id(mesh), axis, gwords)
+           capacity_per_shard, id(mesh), axis, gwords, work_budget)
     if key in _CACHE:
         return _CACHE[key]
     n = mesh.shape[axis]
-    # work_budget=0 (unlimited): this driver addresses chunks by index and
-    # has no mid-chunk resume path yet; on real multi-chip hardware the
-    # single-chip watchdog mitigation (capacity-scaled chunks +
-    # wgl_tpu.closure_budget) should be ported here the same way.
+    # The capacity-scaled per-dispatch closure budget (the single-chip
+    # watchdog mitigation, wgl_tpu.closure_budget) applies to the sharded
+    # engine too; the host loop below resumes mid-chunk from the
+    # consumed-events flag exactly like wgl_tpu.check.  Each shard's
+    # closure round sorts the *gathered global* set, so the per-iteration
+    # cost scales with capacity_per_shard * n — the budget divides by the
+    # global capacity, keeping one dispatch's wall-clock at the same bound
+    # regardless of shard count.
+    if work_budget is None:
+        from jepsen_tpu.checker.wgl_tpu import closure_budget
+        work_budget = closure_budget(capacity_per_shard * n)
     _, _, run_chunk = make_engine(model, window, capacity_per_shard,
                                   axis_name=axis, num_shards=n,
-                                  gwords=gwords, work_budget=0)
+                                  gwords=gwords, work_budget=work_budget)
     # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
     #               dirty, failed, failed_op, overflow, explored, rounds,
-    #               peak, ghosts, budget, consumed) — ghosts is per-slot
-    #               and the scalars are identical across shards, hence
-    #               replicated.
+    #               peak, ghosts, budget, consumed, cl_iters) — ghosts is
+    #               per-slot and the scalars are identical across shards,
+    #               hence replicated.
     sharded = P(axis)
     repl = P()
-    in_specs = ((sharded, sharded, sharded) + (repl,) * 12, repl)
-    out_specs = ((sharded, sharded, sharded) + (repl,) * 12, repl)
+    in_specs = ((sharded, sharded, sharded) + (repl,) * 13, repl)
+    out_specs = ((sharded, sharded, sharded) + (repl,) * 13, repl)
     # check_vma=False: closure dedup sorts the *gathered* global row set, so
     # every shard computes bit-identical "replicated" scalars (counts, flags),
     # but the varying-axes checker can't prove that post-all_gather.
@@ -96,8 +104,9 @@ def _initial_carry(model, window, cap, n, mesh, axis):
         put(np.int32(0), P()),
         put(np.int32(1), P()),
         put(np.zeros(MW, np.uint32), P()),
-        put(np.int32(2**31 - 1), P()),   # budget (unlimited; see runner)
+        put(np.int32(0), P()),           # budget (run_chunk resets it)
         put(np.int32(0), P()),           # consumed
+        put(np.int32(0), P()),           # cl_iters (paused-closure its)
     )
 
 
@@ -147,15 +156,25 @@ def check_sharded(model: JaxModel,
                   capacity_per_shard: int = 1024,
                   max_capacity_per_shard: int = 65536,
                   chunk: int = 2048,
-                  max_window: int = 4096) -> Dict[str, Any]:
-    """Frontier-sharded linearizability check of one history."""
+                  max_window: int = 4096,
+                  work_budget: Optional[int] = None) -> Dict[str, Any]:
+    """Frontier-sharded linearizability check of one history.
+
+    ``work_budget`` overrides the per-dispatch closure-iteration budget
+    (None = the capacity-scaled default, see _sharded_runner; tests pass a
+    tiny value to force the mid-chunk pause/resume path on small meshes)."""
     assert mesh is not None, "check_sharded requires a mesh"
     from jepsen_tpu.checker.wgl_tpu import _round_window
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
     window = _round_window(p.window)
     ev = events_array(p, chunk)
-    n_chunks = ev.shape[0] // chunk
+    n_events = ev.shape[0]
+    # One chunk-sized NOP cushion so a mid-chunk resume offset can always
+    # slice a full chunk without clamping back into real events (see
+    # wgl_tpu.check).
+    ev = np.concatenate([ev, np.zeros((chunk, ev.shape[1]), ev.dtype)])
+    ev[n_events:, 0] = EV_NOP
     n = mesh.shape[axis]
 
     def put_repl(x):
@@ -170,11 +189,15 @@ def check_sharded(model: JaxModel,
     gw = ghost_words(p)
     cap = capacity_per_shard
     max_cap_reached = cap  # diagnostics: how far escalation actually went
-    run = _sharded_runner(model, window, cap, mesh, axis, gw)
+    run = _sharded_runner(model, window, cap, mesh, axis, gw, work_budget)
     carry = _initial_carry(model, window, cap, n, mesh, axis)
-    recent_peaks: deque = deque(maxlen=4)
-    inflight: deque = deque()  # (ci, carry_before, carry_after, flags)
-    next_ci = 0
+    # (peak, events-consumed) samples since the last capacity change (see
+    # wgl_tpu.check: shrink-back weighs samples by events covered because a
+    # budget-paused dispatch can cover anywhere from 0 to chunk events).
+    SHRINK_WINDOW = 4 * chunk
+    recent_peaks: deque = deque()
+    inflight: deque = deque()  # (pos, carry_before, carry_after, flags)
+    pos = 0
     failed = overflow = False
     done = carry
     # Pipelined dispatch (see wgl_tpu.check): speculation past a failure or
@@ -187,17 +210,18 @@ def check_sharded(model: JaxModel,
     lookahead = (LOOKAHEAD
                  if mesh.devices.flat[0].platform != "cpu" else 1)
     while True:
-        while len(inflight) < lookahead and next_ci < n_chunks:
+        while len(inflight) < lookahead and pos < n_events:
             prev = carry
-            carry, flags = run(carry, slice_chunk(ev_dev, next_ci * chunk))
-            inflight.append((next_ci, prev, carry, flags))
-            next_ci += 1
+            carry, flags = run(carry, slice_chunk(ev_dev, pos))
+            inflight.append((pos, prev, carry, flags))
+            pos += chunk
         if not inflight:
             break
-        ci, prev, after, flags = inflight.popleft()
+        cpos, prev, after, flags = inflight.popleft()
         fl = np.asarray(flags)
         failed, overflow = bool(fl[0]), bool(fl[1])
         peak = int(fl[2])  # global (psum'd) distinct-config high-water mark
+        consumed = int(fl[3])
         if overflow and cap < max_capacity_per_shard:
             # Escalate straight to a capacity the observed global peak says
             # is enough (peak may itself be clipped, so the loop can escalate
@@ -210,19 +234,25 @@ def check_sharded(model: JaxModel,
             max_cap_reached = max(max_cap_reached, cap)
             recent_peaks.clear()
             inflight.clear()
-            run = _sharded_runner(model, window, cap, mesh, axis, gw)
+            run = _sharded_runner(model, window, cap, mesh, axis, gw,
+                                  work_budget)
             carry = _resize_carry_sharded(prev, n, old, cap, mesh, axis)
-            next_ci = ci
+            pos = cpos
             overflow = False
             continue
         done = after
         if failed or overflow:
             break
-        recent_peaks.append(peak)
-        if cap > capacity_per_shard and len(recent_peaks) == 4:
+        recent_peaks.append((peak, consumed))
+        covered = sum(e for _, e in recent_peaks)
+        while len(recent_peaks) > 1 and covered - recent_peaks[0][1] >= \
+                SHRINK_WINDOW:
+            covered -= recent_peaks.popleft()[1]
+        resumed = consumed < chunk
+        if cap > capacity_per_shard and covered >= SHRINK_WINDOW:
             # Transient crash-burst demand has passed: drop back to a
             # cheaper-per-round engine once 2x the recent global peak fits.
-            need = 2 * max(recent_peaks)
+            need = 2 * max(pk for pk, _ in recent_peaks)
             target = cap
             while (target > capacity_per_shard
                    and (target // 4) * n >= need):
@@ -235,9 +265,18 @@ def check_sharded(model: JaxModel,
                 cap = target
                 recent_peaks.clear()
                 inflight.clear()
-                run = _sharded_runner(model, window, cap, mesh, axis, gw)
-                carry = _resize_carry_sharded(done, n, old, cap, mesh, axis)
-                next_ci = ci + 1
+                run = _sharded_runner(model, window, cap, mesh, axis, gw,
+                                      work_budget)
+                carry = _resize_carry_sharded(after, n, old, cap, mesh, axis)
+                pos = cpos + consumed
+                continue
+        if resumed:
+            # Closure budget exhausted mid-chunk: discard speculative
+            # dispatches and resume exactly where the engine stopped (the
+            # single-chip watchdog-bound pattern, wgl_tpu.check).
+            inflight.clear()
+            carry = after
+            pos = cpos + consumed
     carry = done
 
     explored = int(carry[9])

@@ -147,13 +147,18 @@ def ghost_write_burst(k: int, start_process: int = 2000,
 
 
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
-                  values: int = 5) -> History:
+                  values: int = 5,
+                  within: float | None = None) -> History:
     """Flip the observed value of ``n`` ok-reads to a value that was never
     current at any point during the read — producing (with overwhelming
-    likelihood) a non-linearizable history."""
+    likelihood) a non-linearizable history.  ``within`` restricts the
+    corrupted reads to the first fraction of the history (benchmarks use
+    it to assert the checker's early exit touches a bounded prefix)."""
     rng = random.Random(seed)
     ops = [o.with_() for o in history]
-    read_oks = [i for i, o in enumerate(ops) if o.type == OK and o.f == "read"]
+    cut = len(ops) if within is None else max(1, int(len(ops) * within))
+    read_oks = [i for i, o in enumerate(ops[:cut])
+                if o.type == OK and o.f == "read"]
     if not read_oks:
         raise ValueError("no ok reads to corrupt")
     for i in rng.sample(read_oks, min(n, len(read_oks))):

@@ -9,6 +9,7 @@ all keys check as one vmapped batch (independent.IndependentChecker).
 
 from __future__ import annotations
 
+import itertools
 import random
 from typing import Any, Dict, Optional
 
@@ -32,7 +33,27 @@ def cas(values: int = 5):
                               random.randrange(values)]}
 
 
-def key_gen(k, values: int = 5, ops_per_key: int = 100):
+def key_gen(k, values: int = 5, ops_per_key: int = 100,
+            unique_writes: bool = False):
+    if unique_writes:
+        # Every written value is distinct (per-key monotonic counter), so a
+        # stale read is *unambiguously* stale: with a small reused domain a
+        # frozen replica's answer often coincides with some legal current
+        # value and linearizes anyway — the reason probabilistic
+        # stale-read refutation tests flake.  CAS guesses a recent value as
+        # ``old`` so it still sometimes succeeds.
+        cnt = itertools.count()
+
+        def w_():
+            return {"f": "write", "value": next(cnt)}
+
+        def cas_():
+            n = next(cnt)
+            return {"f": "cas", "value": [random.randrange(max(1, n)), n]}
+
+        return gen.limit(ops_per_key, gen.mix([gen.FnGen(lambda: r()),
+                                               gen.FnGen(w_),
+                                               gen.FnGen(cas_)]))
     return gen.limit(ops_per_key, gen.mix([gen.FnGen(lambda: r()),
                                            gen.FnGen(w(values)),
                                            gen.FnGen(cas(values))]))
@@ -40,13 +61,14 @@ def key_gen(k, values: int = 5, ops_per_key: int = 100):
 
 def workload(keys=None, values: int = 5, ops_per_key: int = 100,
              threads_per_key: int = 2, mesh=None,
-             algorithm: Optional[str] = None, **engine_opts) -> Dict[str, Any]:
+             algorithm: Optional[str] = None,
+             unique_writes: bool = False, **engine_opts) -> Dict[str, Any]:
     keys = list(keys if keys is not None else range(8))
     model = get_model("cas-register")
     return {
         "generator": independent.concurrent_generator(
             threads_per_key, keys,
-            lambda k: key_gen(k, values, ops_per_key)),
+            lambda k: key_gen(k, values, ops_per_key, unique_writes)),
         "checker": independent.checker(
             linearizable(model, algorithm, **engine_opts), mesh=mesh),
         "model": model,

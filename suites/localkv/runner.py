@@ -65,6 +65,11 @@ NEMESES = {
     # (jepsen_tpu.net_proxy): real severed connections, stock grudge algebra
     "partition": lambda opts: combined.partition_package(
         {**opts, "grudge_fn": _follower_isolating_grudge}),
+    # deterministic refutation schedule: one follower severed from t=delay
+    # until the final heal, so unsafe local reads have a long, forced
+    # staleness window instead of a lucky start/stop cycle
+    "partition-hold": lambda opts: combined.partition_hold_package(
+        {**opts, "grudge_fn": _follower_isolating_grudge}),
 }
 
 
@@ -74,15 +79,21 @@ def localkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
     unsafe = bool(opts.get("unsafe"))
     nemesis_name = opts.get("nemesis", "kill")
     pkg = NEMESES[nemesis_name](
-        {"interval": float(opts.get("nemesis_interval", 3.0))})
+        {"interval": float(opts.get("nemesis_interval", 3.0)),
+         "delay": float(opts.get("nemesis_delay", 1.0))})
 
     wl = linearizable_register.workload(
         keys=range(int(opts.get("keys", 4))),
         ops_per_key=int(opts.get("ops_per_key", 150)),
-        threads_per_key=2)
+        threads_per_key=2,
+        unique_writes=bool(opts.get("unique_writes")))
 
     time_limit = float(opts.get("time_limit", 10.0))
-    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    wgen = wl["generator"]
+    stagger_s = float(opts.get("stagger_s", 0.0))
+    if stagger_s > 0:  # pace clients: bounded history -> bounded analysis
+        wgen = gen.stagger(stagger_s, wgen)
+    client_gen = gen.time_limit(time_limit, gen.clients(wgen))
     parts = [client_gen]
     if pkg.generator is not None:
         parts = [gen.any_gen(client_gen,
@@ -102,7 +113,7 @@ def localkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
         recovery = float(opts.get("recovery_time", 3.0))
         if recovery > 0:
             parts.append(gen.synchronize(
-                gen.time_limit(recovery, gen.clients(wl["generator"]))))
+                gen.time_limit(recovery, gen.clients(wgen))))
 
     test = {**opts,
             "name": ("localkv-unsafe" if unsafe else "localkv")
@@ -119,7 +130,7 @@ def localkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
                                 "workload": wl["checker"],
                                 "perf": Perf(),
                                 "timeline": Timeline()})}
-    if nemesis_name == "partition":
+    if nemesis_name in ("partition", "partition-hold"):
         # Inter-node links dial through harness-owned TCP proxies so the
         # stock Partitioner severs real sockets (VERDICT: partitions
         # exercised end-to-end against real processes).

@@ -64,6 +64,8 @@ class RaftKvDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.Primary, jdb.LogFiles):
                 "--peers", peers,
                 "--data", d,
                 "--election-ms", str(test.get("raftkv_election_ms", 400)),
+                "--commit-timeout-ms",
+                str(test.get("raftkv_commit_timeout_ms", 3000)),
                 "--marker", marker(test, node)]
         if test.get("raftkv_stale_reads"):
             args.append("--stale-reads")

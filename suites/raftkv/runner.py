@@ -29,16 +29,24 @@ from suites.raftkv.client import RaftRegisterClient
 from suites.raftkv.db import RaftKvDB
 
 
-def _leader_isolating_grudge(ports):
+def _leader_isolating_grudge(ports, wait_s: float = 3.0):
     """Partition the CURRENT leader (live-discovered via ping) from the
     majority — the scenario every Raft consistency argument hinges on: the
     majority must elect a fresh leader and keep committing, while anything
-    the marooned leader still answers is judged by the checker."""
+    the marooned leader still answers is judged by the checker.  Discovery
+    polls for up to ``wait_s`` so a partition landing mid-election still
+    targets a real leader (falling back to random only if none emerges)."""
     def grudge(nodes):
+        import time as _time
         from suites.raftkv.client import ping
-        leader = next((n for n in nodes
-                       if (ping(ports[n]) or {}).get("role") == "leader"),
-                      None)
+        deadline = _time.monotonic() + wait_s
+        leader = None
+        while leader is None and _time.monotonic() < deadline:
+            leader = next((n for n in nodes
+                           if (ping(ports[n]) or {}).get("role") == "leader"),
+                          None)
+            if leader is None:
+                _time.sleep(0.1)
         target = leader if leader is not None else random.choice(list(nodes))
         return jnet.complete_grudge(jnet.split_one(target, list(nodes)))
     return grudge
@@ -52,10 +60,17 @@ def NEMESES(name, opts, ports):
     if name == "partition":
         return combined.partition_package(
             {**opts, "grudge_fn": _leader_isolating_grudge(ports)})
+    if name == "maroon-leader":
+        # Deterministic stale-leader scenario: ONE partition around the
+        # live-discovered leader, held from ``delay`` until the final
+        # heal — the forced version of what cycling partitions only
+        # sometimes achieve (consul/register.clj:72's scenario).
+        return combined.partition_hold_package(
+            {**opts, "grudge_fn": _leader_isolating_grudge(ports)})
     raise KeyError(name)
 
 
-NEMESIS_NAMES = ("none", "kill", "partition")
+NEMESIS_NAMES = ("none", "kill", "partition", "maroon-leader")
 
 
 def raftkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
@@ -63,16 +78,22 @@ def raftkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
     ports = free_ports(len(nodes))
     nemesis_name = opts.get("nemesis", "none")
     pkg = NEMESES(nemesis_name,
-                  {"interval": float(opts.get("nemesis_interval", 3.0))},
+                  {"interval": float(opts.get("nemesis_interval", 3.0)),
+                   "delay": float(opts.get("nemesis_delay", 1.0))},
                   dict(zip(nodes, ports)))
 
     wl = linearizable_register.workload(
         keys=range(int(opts.get("keys", 2))),
         ops_per_key=int(opts.get("ops_per_key", 400)),
-        threads_per_key=2)
+        threads_per_key=2,
+        unique_writes=bool(opts.get("unique_writes")))
 
     time_limit = float(opts.get("time_limit", 10.0))
-    client_gen = gen.time_limit(time_limit, gen.clients(wl["generator"]))
+    wgen = wl["generator"]
+    stagger_s = float(opts.get("stagger_s", 0.0))
+    if stagger_s > 0:  # pace clients: bounded history -> bounded analysis
+        wgen = gen.stagger(stagger_s, wgen)
+    client_gen = gen.time_limit(time_limit, gen.clients(wgen))
     parts = [client_gen]
     if pkg.generator is not None:
         parts = [gen.any_gen(client_gen,
@@ -87,7 +108,7 @@ def raftkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
         if recovery > 0:
             parts.append(gen.synchronize(gen.sleep(1.0)))
             parts.append(gen.synchronize(
-                gen.time_limit(recovery, gen.clients(wl["generator"]))))
+                gen.time_limit(recovery, gen.clients(wgen))))
 
     test = {**opts,
             "name": ("raftkv-stale" if opts.get("stale_reads") else "raftkv")
@@ -104,7 +125,7 @@ def raftkv_test(opts: Dict[str, Any]) -> Dict[str, Any]:
                                 "workload": wl["checker"],
                                 "perf": Perf(),
                                 "timeline": Timeline()})}
-    if nemesis_name == "partition":
+    if nemesis_name in ("partition", "maroon-leader"):
         router = ProxyRouter(nodes, dict(zip(nodes, ports)))
         test["proxy_router"] = router
         test["net"] = ProxyNet(router)
@@ -116,10 +137,23 @@ def _suite_opts(parser):
     parser.add_argument("--stale-reads", action="store_true",
                         help="leader serves reads without a quorum round "
                              "(must be refuted under partitions)")
-    parser.add_argument("--nemesis", default="none", choices=sorted(NEMESES))
-    parser.add_argument("--keys", type=int, default=2)
+    parser.add_argument("--nemesis", default="none",
+                        choices=sorted(NEMESIS_NAMES))
+    parser.add_argument("--keys", type=int, default=3)
     parser.add_argument("--ops-per-key", type=int, default=400)
     parser.add_argument("--nemesis-interval", type=float, default=3.0)
+    parser.add_argument("--nemesis-delay", type=float, default=1.0,
+                        help="maroon-leader: seconds before the held "
+                             "partition starts")
+    parser.add_argument("--unique-writes", action="store_true",
+                        help="distinct write values per key: stale reads "
+                             "become unambiguous violations")
+    parser.add_argument("--stagger-s", type=float, default=0.0,
+                        help="mean client pacing delay (bounds history and "
+                             "analysis size)")
+    parser.add_argument("--raftkv-commit-timeout-ms", type=int, default=3000,
+                        help="server-side majority-commit wait before an "
+                             "indeterminate reply")
 
 
 if __name__ == "__main__":

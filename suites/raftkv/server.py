@@ -84,6 +84,11 @@ class RaftNode:
         self.election_timeout = (opts.election_ms / 1000.0,
                                  2 * opts.election_ms / 1000.0)
         self.heartbeat_s = opts.heartbeat_ms / 1000.0
+        # How long a client op waits for majority commit before answering
+        # indeterminately.  A worker dialing a marooned leader is stuck for
+        # exactly this long per write, so partition tests shorten it to
+        # keep those workers cycling (and reading!) through the window.
+        self.commit_timeout_s = opts.commit_timeout_ms / 1000.0
 
         self.lock = threading.RLock()
         self.role = FOLLOWER
@@ -161,11 +166,16 @@ class RaftNode:
         self.role = FOLLOWER
 
     def _fail_waiting(self, from_i: int) -> None:
-        """Entries >= from_i were truncated: they can never commit."""
+        """Entries >= from_i were truncated on THIS node: answer waiting
+        clients indeterminately.  Raft Figure 8: an entry truncated on a
+        deposed leader may survive on another replica and commit later, so
+        the op may still take effect — reporting it as a definite failure
+        would let the checker drop an op that actually executed and refute
+        a correct server."""
         for i in [i for i in self.waiting if i >= from_i]:
             ev, slot = self.waiting.pop(i)
             slot.append({"ok": False, "error": "entry truncated "
-                         "(leadership lost)", "definite": True})
+                         "(leadership lost)", "indeterminate": True})
             ev.set()
 
     # -- Raft RPCs ---------------------------------------------------------
@@ -277,7 +287,7 @@ class RaftNode:
             self.waiting[i] = (ev, slot)
             self.match_index[self.node] = i
         self._replicate_once()
-        if not ev.wait(timeout=3.0):
+        if not ev.wait(timeout=self.commit_timeout_s):
             with self.lock:
                 self.waiting.pop(i, None)
             return {"ok": False, "error": "commit timeout",
@@ -479,6 +489,7 @@ def main(argv=None) -> int:
     ap.add_argument("--data", required=True)
     ap.add_argument("--election-ms", type=int, default=400)
     ap.add_argument("--heartbeat-ms", type=int, default=120)
+    ap.add_argument("--commit-timeout-ms", type=int, default=3000)
     ap.add_argument("--stale-reads", action="store_true")
     ap.add_argument("--marker", default="", help="argv tag for grepkill")
     RaftNode(ap.parse_args(argv)).serve()

@@ -121,6 +121,18 @@ class TestCombinators:
         assert fs[0] == "fast"
         assert set(fs) == {"slow", "fast"}
 
+    def test_any_preserves_sleep_deadline_under_busy_sibling(self):
+        # Regression: Any used to discard a pending child's continuation
+        # whenever another child produced an op, re-anchoring a Sleep's
+        # deadline on every dispense — a `sleep; fault` nemesis schedule
+        # racing a busy client stream then fired seconds late (or never).
+        busy = gen.stagger(0.001, gen.limit(400, gen.repeat({"f": "c"})))
+        delayed = [gen.sleep(0.05), gen.once(gen.lift({"f": "fault"}))]
+        h = testkit.quick(gen.any_gen(busy, delayed), concurrency=4)
+        fault_t = next(o.time for o in invokes(h) if o.f == "fault")
+        # must fire right at its deadline, not after the busy stream ends
+        assert 0.05e9 <= fault_t < 0.2e9, fault_t
+
     def test_sleep_then(self):
         h = testkit.quick([gen.sleep(0.5), {"f": "late"}], concurrency=1)
         op = invokes(h)[0]
@@ -231,6 +243,27 @@ class TestConcurrentGeneratorRotation:
         assert keys == {0, 1, 2, 3, 4}
         invokes = [op for op in hist if op.type == "invoke"]
         assert len(invokes) == 5 * 6
+
+    def test_groups_progress_concurrently_under_global_stagger(self):
+        # Regression: the first group's available op used to win every
+        # draw, so an OUTER stagger (which keeps group 0's threads free at
+        # each dispense) starved every other group — with one key-group
+        # per node, whole nodes had no clients.  The soonest-op rule must
+        # let all groups progress interleaved.
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu import independent
+        from jepsen_tpu.generator import testkit
+
+        g = independent.concurrent_generator(
+            2, [0, 1, 2],
+            lambda k: gen.limit(50, gen.repeat({"f": "write", "value": k})))
+        hist = testkit.simulate({"nodes": ["n1"], "concurrency": 6},
+                                gen.stagger(0.005, g))
+        invs = [op for op in hist if op.type == "invoke"]
+        first_40 = {op.value[0] for op in invs[:40]}
+        assert first_40 == {0, 1, 2}, first_40  # interleaved, not serial
+        threads = {op.process % 6 for op in invs}
+        assert threads == {0, 1, 2, 3, 4, 5}, threads
 
 
 class TestFairness:

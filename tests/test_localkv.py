@@ -76,9 +76,16 @@ class TestLocalKv:
 
     def test_partition_with_local_reads_refuted(self, tmp_path):
         """Severing replication to a follower that serves local reads must
-        produce a real, machine-checked linearizability violation."""
-        done = run_localkv(tmp_path, unsafe=True, nemesis="partition",
-                           nemesis_interval=1.5, time_limit=8.0,
-                           repl_delay=0.0)
+        produce a real, machine-checked linearizability violation.  The
+        hold schedule severs one follower from t=1s until the final heal —
+        a forced multi-second staleness window, not a lucky start/stop
+        cycle (the cycling variant flaked under full-suite load)."""
+        # keys=3: all 6 workers active (2 per node), so whichever follower
+        # the grudge severs has pinned readers (keys=2 left a node with no
+        # clients and the refutation hinged on the grudge's coin flip).
+        done = run_localkv(tmp_path, unsafe=True, nemesis="partition-hold",
+                           nemesis_delay=1.0, time_limit=8.0, keys=3,
+                           repl_delay=0.0, unique_writes=True,
+                           ops_per_key=1000, stagger_s=0.02)
         assert done["results"]["valid"] is False
         assert done["results"]["workload"]["failures"]

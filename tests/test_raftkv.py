@@ -56,16 +56,28 @@ class TestRaftKv:
 
     def test_stale_leader_reads_refuted_under_partition(self, tmp_path):
         # A marooned leader serving unquorum'd reads is the classic raft
-        # consistency bug; severing its links must surface it as a
-        # machine-checked linearizability violation.  The grudge isolates a
-        # random minority each cycle, so give it a few cycles to catch the
-        # leader.
-        for attempt in range(3):
-            done = run_raftkv(tmp_path, nemesis="partition",
-                              nemesis_interval=2.0, time_limit=10.0,
-                              stale_reads=True,
-                              store_base=str(tmp_path / f"s{attempt}"))
-            if done["results"]["valid"] is False:
-                assert done["results"]["workload"]["failures"]
-                return
-        raise AssertionError("stale-read leader never caught in 3 runs")
+        # consistency bug.  The maroon-leader nemesis FORCES the window:
+        # the live-discovered leader is severed from the majority at t=1s
+        # and held there, the majority elects a replacement and keeps
+        # committing, and workers pinned to the marooned leader (short
+        # commit timeout keeps them cycling) read its frozen state — a
+        # deterministic, machine-checked linearizability violation.
+        # unique_writes: every written value is distinct, so a single read
+        # of the marooned leader's frozen state after the majority commits
+        # anything newer is an unambiguous violation (reused small domains
+        # let stale answers coincide with legal values and linearize).
+        # stagger paces clients so the history (and so the analysis) stays
+        # small; the violation needs only a handful of marooned-leader
+        # reads, not a firehose.
+        # keys=3 so all 6 workers are active (2 per key-group -> 2 per
+        # node): whichever node the marooned leader turns out to be, some
+        # worker keeps dialing it.  With keys=2 only 2 threads ever ran
+        # and a leader on the third node had no clients at all.
+        done = run_raftkv(tmp_path, nemesis="maroon-leader",
+                          nemesis_delay=1.0, time_limit=8.0, keys=3,
+                          stale_reads=True, unique_writes=True,
+                          ops_per_key=2000, stagger_s=0.02,
+                          raftkv_commit_timeout_ms=600)
+        assert done["results"]["valid"] is False, \
+            list(core.iter_analysis_errors(done["results"]))
+        assert done["results"]["workload"]["failures"]

@@ -196,3 +196,23 @@ class TestClosureWorkBudget:
     def test_budget_scales_with_capacity(self):
         from jepsen_tpu.checker.wgl_tpu import closure_budget
         assert closure_budget(1024) > closure_budget(16384) >= 16
+
+    def test_mid_closure_pause_resume(self, monkeypatch):
+        # Budget of ONE fixpoint iteration per dispatch: every closure
+        # needing more must pause mid-closure (partial set kept, dirty
+        # stays, event unconsumed, cl_iters persisted) and the host resumes
+        # the same RETURN across dispatches until convergence.  Verdicts —
+        # including the refuting op — must match the CPU oracle exactly.
+        from jepsen_tpu.checker import wgl_tpu
+        monkeypatch.setattr(wgl_tpu, "CLOSURE_WORK_BUDGET", -101)  # cache key
+        monkeypatch.setattr(wgl_tpu, "closure_budget", lambda cap: 1)
+        model = get_model("cas-register")
+        h = cas_register_history(200, concurrency=6, crash_p=0.02, seed=5)
+        r = wgl_tpu.check(model, h, capacity=64, chunk=64)
+        c = wgl_cpu.check(CASRegister(), h)
+        assert r["valid"] == c["valid"], (r, c)
+        bad = corrupt_reads(h, n=1, seed=5)
+        r2 = wgl_tpu.check(model, bad, capacity=64, chunk=64, explain=False)
+        c2 = wgl_cpu.check(CASRegister(), bad)
+        assert r2["valid"] is False, r2
+        assert r2["op"]["index"] == c2["op"]["index"]
