@@ -64,7 +64,12 @@ def check(model: Model, history: History,
             del window[slot]
 
     visited: set = set()
-    # Deepest stuck RETURN, for the refutation report.
+    # Deepest STUCK return for the refutation report: a RETURN event whose
+    # frame produced no successor at all (nothing could linearize past
+    # it on that branch).  The deepest merely-VISITED return would name
+    # whatever op some abandoned branch happened to reach — knossos names
+    # the op whose return is unsatisfiable, and so do we.
+    deepest_stuck = -1
     deepest_e = -1
 
     # Explicit stack of (event, mask, model, choice iterator).  A frame's
@@ -100,14 +105,15 @@ def check(model: Model, history: History,
 
     start = (0, 0, model)
     visited.add(start)
-    stack: List[Tuple[int, int, Model, Any]] = [
-        (0, 0, model, successors(0, 0, model))]
+    # Frames: [e, mask, model, iterator, ever_advanced]
+    stack: List[List[Any]] = [[0, 0, model, successors(0, 0, model), False]]
     steps = 0
     while stack:
         steps += 1
         if (steps & 0xFFF) == 0 and cancel is not None and cancel.is_set():
             raise Cancelled()
-        e, mask, m, it = stack[-1]
+        frame = stack[-1]
+        e, mask, m, it = frame[0], frame[1], frame[2], frame[3]
         if int(p.kind[e]) == EV_RETURN:
             deepest_e = max(deepest_e, e)
         advanced = False
@@ -122,14 +128,19 @@ def check(model: Model, history: History,
             visited.add(key)
             if len(visited) > max_states:
                 raise SearchExploded(len(visited))
-            stack.append((ne, nmask, nm, successors(ne, nmask, nm)))
+            stack.append([ne, nmask, nm, successors(ne, nmask, nm), False])
             advanced = True
+            frame[4] = True
             break
         if not advanced:
+            if not frame[4] and int(p.kind[e]) == EV_RETURN:
+                deepest_stuck = max(deepest_stuck, e)
             stack.pop()
 
-    bad = ret_op[deepest_e] if deepest_e >= 0 else None
+    named = deepest_stuck if deepest_stuck >= 0 else deepest_e
+    bad = ret_op[named] if named >= 0 else None
     return {"valid": False, "analyzer": "linear-cpu",
             "op": bad.to_dict() if bad is not None else None,
             "states-explored": len(visited),
-            "deepest-event": deepest_e}
+            "deepest-event": deepest_e,
+            "stuck-event": deepest_stuck}

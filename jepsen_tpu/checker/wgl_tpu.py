@@ -40,6 +40,9 @@ device-local slice of the deduplicated global set.
 from __future__ import annotations
 
 import math
+import os as _os
+import sys as _sys
+import time as _time
 from collections import deque
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -62,14 +65,10 @@ EV_NOP = 2
 # transfer of chunk i overlaps with the device computing chunk i+1.
 LOOKAHEAD = 2
 
-# Closure expansion runs the window in blocks of this many slots; a block
-# whose candidates are all invalid (inactive slots, op already held, model
-# step refuses) skips its sort+dedup entirely via lax.cond.  Real windows
-# are wide (crashed ops pin slots forever) but *live* slots cluster in a
-# few blocks, so this cuts per-closure sorted rows from C*(W+1) to C*(B+1)
-# per active block — both the dominant cost at high capacity and the reason
-# a chunk's XLA program could outlive the TPU worker's watchdog.
-EXPAND_BLOCK = 8
+# (Round-3's EXPAND_BLOCK block-partitioned closure is gone: the delta
+# closure with candidate compaction — see make_engine.closure — replaced
+# per-block C*(B+1)-row sorts with one compacted C+NC-row merge per round,
+# measured 20.2s -> well under the round-3 easy-tier wall on hardware.)
 
 # Per-chunk closure work budget, in capacity x closure-iterations units.
 # Closure cost is superlinear in live configuration count (more fixpoint
@@ -79,10 +78,12 @@ EXPAND_BLOCK = 8
 # ~60 s watchdog.  Instead each chunk carries an iteration budget
 # (CLOSURE_WORK_BUDGET / capacity); when it runs out the remaining events
 # gate to no-ops, the flags report how many events were really consumed,
-# and the host resumes mid-chunk with a fresh budget.
-import os as _os
-
-CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "1000000"))
+# and the host resumes mid-chunk with a fresh budget.  (3M with the delta
+# closure's compacted merges ~ the wall-clock the block closure bought at
+# 1M: per-iteration cost dropped ~4x, so the same watchdog margin affords
+# more iterations per dispatch — measured easy-tier 7.9 s vs 8.1 s at 1M,
+# with fewer discarded speculative dispatches at escalated capacities.)
+CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "3000000"))
 
 
 def closure_budget(capacity: int) -> int:
@@ -97,13 +98,16 @@ def closure_budget(capacity: int) -> int:
 
 
 def engine_window(window: int) -> int:
-    """The padded slot count an engine built for ``window`` actually uses."""
-    return ((window + EXPAND_BLOCK - 1) // EXPAND_BLOCK) * EXPAND_BLOCK
+    """The slot count an engine built for ``window`` actually uses (the
+    delta closure expands the full window at once, so no block padding —
+    kept as the single source of truth for callers that build
+    window-shaped carries outside carry0, e.g. parallel.sharded)."""
+    return window
 
 
 # carry = (mask, states, valid, win_ops, active, dirty, failed, failed_op,
 #          overflow, explored, rounds, peak, ghosts, budget, consumed,
-#          cl_iters)
+#          cl_iters, fresh, cur_new)
 # peak is the high-water mark of the distinct-configuration count since the
 # driver last reset it: the capacity the search *actually* needed, which the
 # host reads at chunk boundaries to pick the cheapest sufficient engine.
@@ -113,7 +117,10 @@ def engine_window(window: int) -> int:
 # cl_iters is the cumulative fixpoint-iteration count of the *current paused
 # closure* — it persists across pause/resume dispatches so the W+1
 # convergence cap applies to the cumulative count, exactly as it did when a
-# closure always ran inside one dispatch.
+# closure always ran inside one dispatch.  fresh ([W] bool) marks slots
+# ENTERed since the last completed closure (delta round 0's slot gate);
+# cur_new ([C] bool) marks rows added by the previous closure round (delta
+# rounds' row gate) — both persist across pause/resume.
 
 
 def make_engine(model: JaxModel, window: int, capacity: int,
@@ -234,7 +241,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         return jnp.stack(out, axis=-1)                     # [N, MW]
 
     def closure(mask, states, valid, win_ops, active, ghosts, overflow,
-                budget, it0):
+                budget, it0, fresh, cur_new):
         # Dedup treats the ghost-slot part of the mask as a *subsumption*
         # column, not an identity column: ghost ops never return, so their
         # bits are never consulted by pruning, and a config whose ghost set
@@ -244,6 +251,22 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # 2^crashes configuration blowup that kills knossos into
         # O(crashes) — see BENCH ghost tiers.
         #
+        # **Delta (semi-naive) evaluation** — the round-4 speedup.  The set
+        # is closed between closures, so round 0 only expands (all rows) x
+        # (slots ENTERed since the last closure — ``fresh``), and round
+        # r>0 only expands (rows kept NEW by round r-1 — ``cur_new``) x
+        # (all active slots).  Soundness: S was closed over the old slots;
+        # S x old-slots candidates are already present-or-subsumed, and a
+        # row dropped by subsumption is simulated by its (kept, expanded)
+        # dropper, whose successors subsume the dropped row's successors.
+        #
+        # **Candidate compaction** — the valid candidates of a round are
+        # usually far fewer than the C*W expansion grid, so they compact
+        # (cumsum + scatter, no sort) into a small buffer and the merge
+        # sorts C + NC rows instead of C*(W+1).  Three merge widths are
+        # compiled (NC = C, 4C, and the full C*W grid) and selected per
+        # round by the (shard-uniform) candidate count.
+        #
         # ``budget`` caps the fixpoint iterations of THIS call: a closure
         # that runs out pauses (returns converged=False) with the partial —
         # but sound, monotone — set; the caller must then keep the dirty
@@ -252,12 +275,12 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # partial set to the same fixpoint.  This makes the per-dispatch
         # iteration bound *tight* (<= budget), not budget + window.
         count0 = global_sum(valid.sum())
-        n_blocks = (W + EXPAND_BLOCK - 1) // EXPAND_BLOCK
 
         def merge_rows(mask, states, valid, cand_mask, cand_states,
-                       cand_valid, count, ovf):
-            """Dedup/compact the union of the existing set and one block's
-            candidate rows; returns the new set + fixpoint/overflow."""
+                       cand_valid, ovf):
+            """Dedup/compact the union of the existing set and this
+            round's candidate rows; returns the new set, per-row newness,
+            and fixpoint/overflow signals."""
             nc = cand_valid.shape[0]
             all_mask = jnp.concatenate([mask, cand_mask])
             all_states = jnp.concatenate([states, cand_states])
@@ -276,83 +299,111 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                     + [all_states[:, i] for i in range(S)])
             gcols = [gpart[:, i] for i in range(GW)]
             gcap = C * num_shards
-            out_cols, out_valid, total, ovf2, new_rows = \
+            out_cols, out_valid, total, ovf2, new_rows, out_orig = \
                 sort_dedup_compact(cols, all_valid, gcap,
                                    ghost_cols=gcols, origin=origin)
             new_keyed = jnp.stack(out_cols[:MW], -1)
             new_states = jnp.stack(out_cols[MW:MW + S], -1)
             new_compact = jnp.stack(out_cols[MW + S:], -1)
             new_mask = new_keyed | expand_compact(new_compact, win_ops)
+            cur_new2 = (out_orig == 1) & out_valid
             if axis_name is not None:
                 start = lax.axis_index(axis_name) * C
                 new_mask = lax.dynamic_slice_in_dim(new_mask, start, C)
                 new_states = lax.dynamic_slice_in_dim(new_states, start, C)
                 out_valid = lax.dynamic_slice_in_dim(out_valid, start, C)
-            return new_mask, new_states, out_valid, total, new_rows, \
-                ovf | ovf2
+                cur_new2 = lax.dynamic_slice_in_dim(cur_new2, start, C)
+            return new_mask, new_states, out_valid, cur_new2, total, \
+                new_rows, ovf | ovf2
+
+        def compact_to(cand_mask, cand_states, cv, NC):
+            """Compact the [C, W] candidate grid's valid rows into NC rows
+            (cumsum + scatter — no sort)."""
+            flat_v = cv.reshape(C * W)
+            pos = jnp.cumsum(flat_v.astype(jnp.int32)) - 1
+            dest = jnp.where(flat_v & (pos < NC), pos, NC)
+            fm = cand_mask.reshape(C * W, MW)
+            fs = cand_states.reshape(C * W, S)
+            cm = jnp.zeros((NC + 1, MW), jnp.uint32) \
+                .at[dest].set(fm, mode="drop")[:NC]
+            cs = jnp.zeros((NC + 1, S), jnp.int32) \
+                .at[dest].set(fs, mode="drop")[:NC]
+            n_valid = pos[-1] + 1
+            cvv = jnp.arange(NC) < jnp.minimum(n_valid, NC)
+            return cm, cs, cvv
 
         def cond(c):
-            _, _, _, _, changed, ovf, it = c
+            _, _, _, _, _, changed, ovf, it = c
             return changed & ~ovf & (it < W + 1) & (it - it0 < budget)
 
-        B = EXPAND_BLOCK
+        def body(c):
+            mask, states, valid, cur_new, count, _, ovf, it = c
+            # Full-window expansion grid, gated by the delta rule.
+            cand_states, ok = expand(states, win_ops)          # [C, W, S]
+            has = ((mask[:, None, :] & slot_masks[None, :, :]) != 0).any(-1)
+            round0 = it == 0
+            row_gate = jnp.where(round0, valid, valid & cur_new)
+            slot_gate = jnp.where(round0, active & fresh, active)
+            cv = row_gate[:, None] & slot_gate[None, :] & ~has & ok
+            cand_mask = mask[:, None, :] | slot_masks[None, :, :]
+            nv = cv.sum().astype(jnp.int32)
+            nv_max = (lax.pmax(nv, axis_name)
+                      if axis_name is not None else nv)
+            some = global_sum(nv) > 0
 
-        def block(b, acc):
-            # One compiled block body, indexed dynamically — a python
-            # unroll of W/B cond'd sort+dedup graphs made TPU compiles
-            # pathologically long; fori_loop keeps the graph one block big.
-            mask, states, valid, count, changed, ovf = acc
-            lo = b * B
-            wo = lax.dynamic_slice_in_dim(win_ops, lo, B)     # [B, 6]
-            smb = lax.dynamic_slice_in_dim(slot_masks, lo, B)
-            act = lax.dynamic_slice_in_dim(active, lo, B)
-            cand_states, ok = expand(states, wo)              # [C, B, S]
-            has = ((mask[:, None, :] & smb[None, :, :]) != 0).any(-1)
-            cand_valid = valid[:, None] & act[None, :] & ~has & ok
-            # Uniform across shards (global any) so every device takes
-            # the same cond branch.
-            some = global_sum(cand_valid.sum()) > 0
+            def merge_compacted(NC):
+                def f(args):
+                    mask, states, valid, cur_new, ovf = args
+                    cm, cs, cvv = compact_to(cand_mask, cand_states, cv, NC)
+                    return merge_rows(mask, states, valid, cm, cs, cvv, ovf)
+                return f
+
+            def merge_full(args):
+                mask, states, valid, cur_new, ovf = args
+                return merge_rows(mask, states, valid,
+                                  cand_mask.reshape(C * W, MW),
+                                  cand_states.reshape(C * W, S),
+                                  cv.reshape(C * W), ovf)
 
             def do(args):
-                mask, states, valid, count, ovf = args
-                cand_mask = (mask[:, None, :] | smb[None, :, :]) \
-                    .reshape(C * B, MW)
-                return merge_rows(mask, states, valid, cand_mask,
-                                  cand_states.reshape(C * B, S),
-                                  cand_valid.reshape(C * B),
-                                  count, ovf)
+                # Merge width by (shard-uniform) candidate volume: most
+                # rounds fit the C buffer, burst rounds the 4C one, and
+                # the full grid is the rare fallback.
+                sel = jnp.where(nv_max <= C, 0,
+                                jnp.where(nv_max <= 4 * C, 1, 2))
+                return lax.switch(sel, [merge_compacted(C),
+                                        merge_compacted(4 * C),
+                                        merge_full], args)
 
             def skip(args):
-                mask, states, valid, count, ovf = args
-                return (mask, states, valid, count, jnp.bool_(False), ovf)
+                mask, states, valid, cur_new, ovf = args
+                return (mask, states, valid,
+                        jnp.zeros_like(cur_new), count,
+                        jnp.bool_(False), ovf)
 
-            mask, states, valid, count, new_rows, ovf = lax.cond(
-                some, do, skip, (mask, states, valid, count, ovf))
-            return (mask, states, valid, count, changed | new_rows, ovf)
-
-        def body(c):
-            mask, states, valid, count, _, ovf, it = c
-            mask, states, valid, count, changed, ovf = lax.fori_loop(
-                0, n_blocks, block,
-                (mask, states, valid, count, jnp.bool_(False), ovf))
+            mask, states, valid, cur_new, count, changed, ovf = lax.cond(
+                some, do, skip, (mask, states, valid, cur_new, ovf))
             # Fixpoint signal: a kept candidate, NOT a count delta —
             # subsumption can drop an existing row in the round that adds a
             # new one, leaving the count level while the set moved.
-            return (mask, states, valid, count, changed, ovf, it + 1)
+            return (mask, states, valid, cur_new, count, changed, ovf,
+                    it + 1)
 
-        init = (mask, states, valid, count0, jnp.bool_(True), overflow, it0)
-        mask, states, valid, count, changed, overflow, it_fin = \
+        init = (mask, states, valid, cur_new, count0, jnp.bool_(True),
+                overflow, it0)
+        mask, states, valid, cur_new, count, changed, overflow, it_fin = \
             lax.while_loop(cond, body, init)
         # Exit reasons: fixpoint (~changed), the W+1 cumulative chain-depth
         # cap (treated as converged — matches the pre-budget behavior), or
         # budget exhaustion — the only pause case.
         converged = ~changed | (it_fin >= W + 1)
-        return mask, states, valid, count, overflow, it_fin, converged
+        return mask, states, valid, cur_new, count, overflow, it_fin, \
+            converged
 
     def event_step(carry, ev):
         (mask, states, valid, win_ops, active, dirty, failed, failed_op,
          overflow, explored, rounds, peak, ghosts, budget, consumed,
-         cl_iters) = carry
+         cl_iters, fresh, cur_new) = carry
         kind, slot, f, a, b, op_id, is_ghost, gcls, grank, gpos = (
             ev[0], ev[1], ev[2], ev[3], ev[4], ev[5], ev[6], ev[7], ev[8],
             ev[9])
@@ -365,10 +416,11 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         def do_enter(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
              overflow, explored, rounds, peak, ghosts, budget, consumed,
-             cl_iters) = c
+             cl_iters, fresh, cur_new) = c
             win_ops2 = win_ops.at[slot].set(
                 jnp.stack([f, a, b, gcls, grank, gpos]))
             active2 = active.at[slot].set(True)
+            fresh2 = fresh.at[slot].set(True)  # delta-closure round 0 gate
             # A crashed op holds its slot forever; its bit becomes a
             # subsumption column in closure dedup.  (Slots of crashed ops
             # are never freed, so the bit can't later mean a live op.)
@@ -376,43 +428,46 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                 ghosts | slot_bitmask(slot), ghosts)
             return (mask, states, valid, win_ops2, active2, jnp.bool_(True),
                     failed, failed_op, overflow, explored, rounds, peak,
-                    ghosts2, budget, consumed + 1, cl_iters)
+                    ghosts2, budget, consumed + 1, cl_iters, fresh2,
+                    cur_new)
 
         def do_return(c):
             (mask, states, valid, win_ops, active, dirty, failed, failed_op,
              overflow, explored, rounds, peak, ghosts, budget, consumed,
-             cl_iters) = c
+             cl_iters, fresh, cur_new) = c
 
             def with_closure(args):
-                (mask, states, valid, overflow, rounds, peak, budget,
-                 cl_iters) = args
-                mask, states, valid, count, overflow, it_fin, converged = \
-                    closure(mask, states, valid, win_ops, active, ghosts,
-                            overflow, budget, cl_iters)
+                (mask, states, valid, cur_new, overflow, rounds, peak,
+                 budget, cl_iters) = args
+                (mask, states, valid, cur_new, count, overflow, it_fin,
+                 converged) = closure(mask, states, valid, win_ops, active,
+                                      ghosts, overflow, budget, cl_iters,
+                                      fresh, cur_new)
                 iters = it_fin - cl_iters
-                return (mask, states, valid, overflow, rounds + iters,
-                        jnp.maximum(peak, count), budget - iters, it_fin,
-                        converged, count)
+                return (mask, states, valid, cur_new, overflow,
+                        rounds + iters, jnp.maximum(peak, count),
+                        budget - iters, it_fin, converged, count)
 
             def no_closure(args):
-                (mask, states, valid, overflow, rounds, peak, budget,
-                 cl_iters) = args
+                (mask, states, valid, cur_new, overflow, rounds, peak,
+                 budget, cl_iters) = args
                 # Set already closed (no ENTER since the last closure):
                 # nothing to add to ``explored`` — count sentinel -1.
-                return (mask, states, valid, overflow, rounds, peak, budget,
-                        cl_iters, jnp.bool_(True), jnp.int32(-1))
+                return (mask, states, valid, cur_new, overflow, rounds,
+                        peak, budget, cl_iters, jnp.bool_(True),
+                        jnp.int32(-1))
 
-            (mask, states, valid, overflow, rounds, peak, budget, cl_iters,
-             converged, count) = lax.cond(
+            (mask, states, valid, cur_new, overflow, rounds, peak, budget,
+             cl_iters, converged, count) = lax.cond(
                 dirty, with_closure, no_closure,
-                (mask, states, valid, overflow, rounds, peak, budget,
-                 cl_iters))
+                (mask, states, valid, cur_new, overflow, rounds, peak,
+                 budget, cl_iters))
 
             def do_prune(args):
                 # Closure reached fixpoint inside the budget: prune configs
                 # lacking the returning op and consume the event.
                 (mask, states, valid, active, dirty, failed, failed_op,
-                 explored, consumed, cl_iters) = args
+                 explored, consumed, cl_iters, fresh) = args
                 bm = slot_bitmask(slot)
                 has = ((mask & bm[None, :]) != 0).any(-1)
                 valid2 = valid & has
@@ -425,27 +480,27 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 return (mask2, states, valid2, active2, jnp.bool_(False),
                         failed | newly_failed, failed_op2,
                         explored + jnp.maximum(count, 0), consumed + 1,
-                        jnp.int32(0))
+                        jnp.int32(0), jnp.zeros_like(fresh))
 
             def do_pause(args):
                 # Budget ran out mid-fixpoint: keep the partial (sound,
                 # monotone) set, keep dirty, do NOT consume — the host
                 # resumes this same RETURN in a fresh dispatch and the
                 # closure continues where it left off (cl_iters carries the
-                # cumulative iteration count into the resumed closure).
+                # cumulative iteration count, cur_new the delta frontier).
                 return args
 
             (mask, states, valid, active, dirty, failed, failed_op, explored,
-             consumed, cl_iters) = lax.cond(
+             consumed, cl_iters, fresh) = lax.cond(
                 converged, do_prune, do_pause,
                 (mask, states, valid, active, dirty, failed, failed_op,
-                 explored, consumed, cl_iters))
+                 explored, consumed, cl_iters, fresh))
             return (mask, states, valid, win_ops, active, dirty, failed,
                     failed_op, overflow, explored, rounds, peak, ghosts,
-                    budget, consumed, cl_iters)
+                    budget, consumed, cl_iters, fresh, cur_new)
 
         def do_nop(c):
-            return c[:14] + (c[14] + 1, c[15])  # consumed += 1
+            return c[:14] + (c[14] + 1,) + c[15:]  # consumed += 1
 
         def apply(c):
             return lax.switch(kind, [do_enter, do_return, do_nop], c)
@@ -476,19 +531,22 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.zeros(MW, jnp.uint32),                 # ghost slots
                 jnp.int32(work_budget),                    # closure budget
                 jnp.int32(0),                              # events consumed
-                jnp.int32(0))                              # paused-closure its
+                jnp.int32(0),                              # paused-closure its
+                jnp.zeros(W, dtype=bool),                  # fresh slots
+                jnp.zeros(C, dtype=bool))                  # delta frontier
 
     def run_chunk(carry, events):
         # Reset the peak to the live count on entry, and the work budget /
         # consumed-event counter to fresh values (device-side: the host
         # reads all per-chunk scalars without extra round-trips); scan the
         # events; pack the scalars the host polls into ONE int32 vector so
-        # a chunk boundary costs a single device→host transfer.  cl_iters
-        # (carry[15]) is NOT reset: it belongs to a possibly-paused closure.
+        # a chunk boundary costs a single device→host transfer.  cl_iters /
+        # fresh / cur_new (carry[15:]) are NOT reset: they belong to a
+        # possibly-paused closure.
         live0 = global_sum(carry[2].sum()).astype(jnp.int32)
         carry = carry[:11] + (live0, carry[12],
-                              jnp.int32(work_budget), jnp.int32(0),
-                              carry[15])
+                              jnp.int32(work_budget), jnp.int32(0)) \
+            + carry[15:]
         carry, _ = lax.scan(event_step, carry, events)
         flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
                            carry[8].astype(jnp.int32),   # overflow
@@ -663,6 +721,8 @@ def check(model: JaxModel, history: Optional[History] = None,
     # simply discarded on resume.
     inflight: deque = deque()  # (pos, carry_before, carry_after, flags)
     pos = 0
+    trace = bool(_os.environ.get("JTPU_TRACE"))
+    t_last = _time.time() if trace else 0.0
     # n_events >= 512 always, so the loop pops at least once and failed/
     # overflow/carry are always (re)assigned before use below.
     while True:
@@ -683,6 +743,12 @@ def check(model: JaxModel, history: Optional[History] = None,
         failed, overflow = bool(fl[0]), bool(fl[1])
         peak = int(fl[2])
         consumed = int(fl[3])
+        if trace:
+            now = _time.time()
+            print(f"[wgl] pos={cpos} cap={cap} peak={peak} "
+                  f"consumed={consumed}/{cur_chunk} ovf={int(overflow)} "
+                  f"dt={now - t_last:.3f}", file=_sys.stderr, flush=True)
+            t_last = now
         if overflow and cap < max_capacity:
             # Grow straight to a capacity the observed peak says is enough
             # (peak is a lower bound on the true need — it may itself have
@@ -769,10 +835,10 @@ def _round_window(w: int) -> int:
 
 
 def _grow_carry(carry, new_capacity: int):
-    """Pad the configuration buffers (mask, states, valid) of a
+    """Pad the configuration buffers (mask, states, valid, cur_new) of a
     chunk-boundary carry up to a larger capacity; other elements carry over.
     Gaps are fine — the engine tracks liveness with the valid flags."""
-    mask, states, valid = carry[0], carry[1], carry[2]
+    mask, states, valid, cur_new = carry[0], carry[1], carry[2], carry[17]
     c = mask.shape[0]
     extra = new_capacity - c
     mask2 = jnp.concatenate([mask, jnp.zeros((extra,) + mask.shape[1:],
@@ -780,7 +846,8 @@ def _grow_carry(carry, new_capacity: int):
     states2 = jnp.concatenate([states, jnp.zeros((extra,) + states.shape[1:],
                                                  states.dtype)])
     valid2 = jnp.concatenate([valid, jnp.zeros(extra, valid.dtype)])
-    return (mask2, states2, valid2) + tuple(carry[3:])
+    cur_new2 = jnp.concatenate([cur_new, jnp.zeros(extra, cur_new.dtype)])
+    return (mask2, states2, valid2) + tuple(carry[3:17]) + (cur_new2,)
 
 
 def _shrink_carry(carry, new_capacity: int):
@@ -789,15 +856,19 @@ def _shrink_carry(carry, new_capacity: int):
     mask = np.asarray(carry[0])
     states = np.asarray(carry[1])
     valid = np.asarray(carry[2])
+    cur_new = np.asarray(carry[17])
     idx = np.flatnonzero(valid)[:new_capacity]
     mask2 = np.zeros((new_capacity,) + mask.shape[1:], mask.dtype)
     states2 = np.zeros((new_capacity,) + states.shape[1:], states.dtype)
     valid2 = np.zeros(new_capacity, bool)
+    cur_new2 = np.zeros(new_capacity, bool)
     mask2[:len(idx)] = mask[idx]
     states2[:len(idx)] = states[idx]
     valid2[:len(idx)] = True
+    cur_new2[:len(idx)] = cur_new[idx]
     return (jnp.asarray(mask2), jnp.asarray(states2),
-            jnp.asarray(valid2)) + tuple(carry[3:])
+            jnp.asarray(valid2)) + tuple(carry[3:17]) \
+        + (jnp.asarray(cur_new2),)
 
 
 def _cpu_witness(model: JaxModel, history: History, failed_op,

@@ -191,56 +191,64 @@ def gsingle_cycles(g: Graph, cap: int = 64):
     return out
 
 
-def nonadjacent_rw_cycles(g: Graph, cap: int = 64):
+def nonadjacent_rw_cycles(g: Graph, cap: int = 64,
+                          budget: int = 20000):
     """Cycles with >= 2 rw edges and no two adjacent around the cycle —
     the shape snapshot isolation cannot admit (every cycle in an SI
     execution carries two *consecutive* anti-dependency edges; Fekete).
 
-    For each rw edge a->b, BFS over states (node, last-edge-was-rw,
-    used-a-second-rw) from (b, True, False) to (a, False, True): the start
-    state forbids an rw first hop (adjacent to a->b), the goal state forbids
-    an rw arrival at a (cyclically adjacent to a->b) and demands a second,
-    necessarily nonadjacent, rw somewhere in the path."""
+    For each rw edge a->b, DFS over (node, last-edge-was-rw,
+    used-a-second-rw) from (b, True, False) to an arrival at ``a`` with a
+    non-rw last edge and a second (necessarily nonadjacent) rw on the
+    path.  The search tracks per-path visited NODES, so every emitted
+    witness is a simple cycle — a state-keyed BFS could revisit a node
+    under a different flag state and file a closed *walk* as the anomaly
+    (the verdict stayed sound, but the witness edges in the artifact could
+    be wrong).  ``budget`` caps expansions per rw edge (simple-path search
+    is worst-case exponential); on exhaustion the edge just yields no
+    witness — other searches still guard the verdict."""
     out = []
     for a in list(g.out):
         for b, ks in g.out[a].items():
             if "rw" not in ks:
                 continue
-            start = (b, True, False)
-            prev: Dict[Any, Any] = {start: None}
-            q = deque([start])
-            goal = None
-            while q and goal is None:
-                st = q.popleft()
-                n, last_rw, extra = st
-                for m, mks in g.out.get(n, {}).items():
-                    steps = []
-                    if mks - {"rw"}:
-                        steps.append((m, False, extra))
-                    if "rw" in mks and not last_rw:
-                        steps.append((m, True, True))
-                    for nxt in steps:
-                        if nxt in prev:
-                            continue
-                        prev[nxt] = st
-                        if nxt == (a, False, True):
-                            goal = nxt
-                            break
-                        q.append(nxt)
-                    if goal:
-                        break
-            if goal is None:
+            path = _simple_nonadjacent_path(g, a, b, budget)
+            if path is None:
                 continue
-            path = []
-            st = goal
-            while st is not None:
-                path.append(st[0])
-                st = prev[st]
-            path.reverse()                 # [b, ..., a]
             out.append([a] + path)
             if len(out) >= cap:
                 return out
     return out
+
+
+def _simple_nonadjacent_path(g: Graph, a, b,
+                             budget: int) -> Optional[List[Any]]:
+    """Simple path [b, ..., a] whose first hop is non-rw-preceded (the
+    caller's a->b edge was rw), containing >= 1 further rw edge, no two
+    rw edges adjacent, and a non-rw arrival at ``a``."""
+    stack = [(b, True, False, (b,))]
+    seen_budget = budget
+    while stack:
+        n, last_rw, extra, path = stack.pop()
+        seen_budget -= 1
+        if seen_budget <= 0:
+            return None
+        on_path = set(path)
+        for m, mks in g.out.get(n, {}).items():
+            steps = []
+            if mks - {"rw"}:
+                steps.append((m, False, extra))
+            if "rw" in mks and not last_rw:
+                steps.append((m, True, True))
+            for mm, lr, ex in steps:
+                if mm == a:
+                    if not lr and ex:
+                        return list(path) + [a]
+                    continue
+                if mm in on_path:
+                    continue
+                stack.append((mm, lr, ex, path + (mm,)))
+    return None
 
 
 def _bfs_path(g: Graph, src, dst, edge_ok) -> Optional[List[Any]]:

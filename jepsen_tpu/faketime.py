@@ -15,15 +15,40 @@ from jepsen_tpu.control import util as cu
 
 LIB_PATH = "/usr/local/lib/faketime/libfaketime.so.1"
 
+#: The pinned fork + tag the reference builds (faketime.clj:8-23): the
+#: last release that worked with jemalloc, patched for
+#: CLOCK_MONOTONIC_COARSE / CLOCK_REALTIME_COARSE.
+PINNED_REPO = "https://github.com/jepsen-io/libfaketime.git"
+PINNED_TAG = "0.9.6-jepsen1"
+BUILD_DIR = "/tmp/jepsen/libfaketime-jepsen"
+
 
 def install(test, node) -> None:
-    """Install libfaketime from the distro package (faketime.clj builds a
-    fork; the packaged library covers the rate+offset interface we use)."""
+    """Install libfaketime from the distro package — the fast path when
+    the packaged library's rate+offset interface suffices.  Databases that
+    trip the jemalloc/COARSE-clock incompatibilities the reference's fork
+    patches need :func:`install_pinned` instead."""
     s = session(test, node).sudo()
     if not cu.exists(s, LIB_PATH) and \
             not cu.exists(s, "/usr/lib/x86_64-linux-gnu/faketime/libfaketime.so.1"):
         s.env(DEBIAN_FRONTEND="noninteractive").exec(
             "apt-get", "install", "-y", "libfaketime")
+
+
+def install_pinned(test, node, repo: str = PINNED_REPO,
+                   tag: str = PINNED_TAG) -> None:
+    """Build the pinned libfaketime fork from source on the node
+    (faketime.clj:8-23 install-0.9.6-jepsen1!): clone once, check out the
+    pinned tag, make, make install.  Idempotent — an existing checkout is
+    reused, only the checkout/build re-run."""
+    s = session(test, node).sudo()
+    s.exec("mkdir", "-p", "/tmp/jepsen")
+    if not cu.exists(s, BUILD_DIR):
+        s.exec("git", "clone", repo, BUILD_DIR)
+    sb = s.cd(BUILD_DIR)
+    sb.exec("git", "checkout", tag)
+    sb.exec("make")
+    sb.exec("make", "install")
 
 
 def script(binary: str, offset_s: float, rate: float) -> str:

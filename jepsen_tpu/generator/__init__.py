@@ -86,10 +86,10 @@ class Context:
         return self.worker_map()[thread]
 
     def process_thread(self, process) -> Any:
-        for t, p in self.workers:
-            if p == process:
-                return t
-        return None
+        pm = self.__dict__.get("_pm")
+        if pm is None:
+            pm = self.__dict__["_pm"] = {p: t for t, p in self.workers}
+        return pm.get(process)
 
     def free_processes(self) -> List[Any]:
         wm = self.worker_map()
@@ -125,7 +125,7 @@ class Context:
     def with_time(self, time: int) -> "Context":
         # keeps free_threads/workers: caches may be rebuilt but stay valid
         new = self._clone(time=time)
-        for k in ("_wm", "_sfree", "_pool"):
+        for k in ("_wm", "_sfree", "_pool", "_pm"):
             if k in self.__dict__:
                 new.__dict__[k] = self.__dict__[k]
         return new
@@ -146,12 +146,21 @@ class Context:
         return replace(self, workers=tuple(sorted(wm.items(), key=lambda kv: _thread_key(kv[0]))))
 
     def restrict(self, threads) -> "Context":
-        """Sub-context visible to a generator bound to `threads`."""
-        tset = set(threads)
-        return replace(
-            self,
-            free_threads=frozenset(t for t in self.free_threads if t in tset),
-            workers=tuple((t, p) for t, p in self.workers if t in tset))
+        """Sub-context visible to a generator bound to `threads`.
+
+        This is the scheduler's hottest allocation (clients/nemesis/
+        on_threads wrap every op AND update): _clone skips dataclass
+        machinery, and a restriction that keeps every worker returns self.
+        """
+        tset = threads if isinstance(threads, (set, frozenset)) \
+            else set(threads)
+        workers = tuple((t, p) for t, p in self.workers if t in tset)
+        if workers == self.workers:
+            return self
+        return self._clone(
+            free_threads=frozenset(t for t in self.free_threads
+                                   if t in tset),
+            workers=workers)
 
 
 def _thread_key(t):
@@ -461,18 +470,48 @@ class OnThreads(_Wrap):
         super().__init__(gen)
         if callable(pred) and not isinstance(pred, (set, frozenset)):
             self.pred = pred
+            self.tset = None
         else:
-            s = set(pred) if not isinstance(pred, (set, frozenset)) else pred
-            self.pred = lambda t: t in s
+            s = frozenset(pred)
+            self.tset = s
+            self.pred = s.__contains__
 
     def _threads(self, ctx):
-        return [t for t in ctx.all_threads() if self.pred(t)]
+        # set-bound restrictions pass the set straight to restrict (its
+        # fast path); predicate restrictions filter the workers
+        if self.tset is not None:
+            return self.tset
+        return [t for t, _ in ctx.workers if self.pred(t)]
+
+    def _restrict(self, ctx):
+        """ctx.restrict memoized on the workers tuple: the worker map only
+        changes on process crashes, while this runs for every op AND every
+        completion — the scheduler's hottest allocation site."""
+        cache = self.__dict__.get("_rcache")
+        if cache is None:
+            cache = self.__dict__["_rcache"] = {}
+        ent = cache.get(ctx.workers)
+        if ent is None:
+            if self.tset is not None:
+                tset = self.tset
+            else:
+                tset = frozenset(t for t, _ in ctx.workers if self.pred(t))
+            workers = tuple((t, p) for t, p in ctx.workers if t in tset)
+            if len(cache) > 64:
+                cache.clear()
+            cache[ctx.workers] = ent = (tset, workers)
+        tset, workers = ent
+        if workers == ctx.workers:
+            return ctx
+        return ctx._clone(
+            free_threads=frozenset(t for t in ctx.free_threads
+                                   if t in tset),
+            workers=workers)
 
     def op(self, test, ctx):
         if self.gen is None:
             return None
-        sub = ctx.restrict(self._threads(ctx))
-        r = self.gen.op(test, sub)
+        r = self.gen.op(test, self._restrict(ctx))
         if r is None:
             return None
         v, g2 = r
@@ -482,8 +521,7 @@ class OnThreads(_Wrap):
         t = ctx.process_thread(getattr(event, "process", None))
         if t is None or not self.pred(t):
             return self
-        sub = ctx.restrict(self._threads(ctx))
-        g2 = self.gen.update(test, sub, event)
+        g2 = self.gen.update(test, self._restrict(ctx), event)
         if g2 is self.gen:
             return self
         return self._new(g2)

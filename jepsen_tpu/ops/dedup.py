@@ -79,16 +79,19 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
     plain exact dedup.
 
     ``origin`` (optional, int32 [N], 1 = newly-generated candidate) is
-    carried as a payload; when given, the return gains a fifth element
-    ``new_rows``: True iff any *kept* row is a candidate.  This — not a
-    count delta — is the sound fixpoint signal for a closure loop, because
-    subsumption can drop existing rows in the same round that adds new
-    ones, leaving the count unchanged while the set moved.
+    carried as a payload; when given, the return gains ``new_rows`` (True
+    iff any *kept* row is a candidate — this, not a count delta, is the
+    sound fixpoint signal for a closure loop, because subsumption can drop
+    existing rows in the same round that adds new ones, leaving the count
+    unchanged while the set moved) and ``out_origin``, the compacted
+    per-row origin column (the delta closure's next-round expansion
+    frontier).  For a dropped duplicate the kept copy's origin wins (the
+    stable sort keeps the existing row ahead of an identical candidate).
 
-    Returns ``(out_cols, out_valid, total, overflow[, new_rows])`` —
-    ``out_cols`` in the order ``[*cols, *ghost_cols]``; ``total`` is the
-    number of kept rows (may exceed capacity — then ``overflow`` is True
-    and the surplus rows were dropped).
+    Returns ``(out_cols, out_valid, total, overflow[, new_rows,
+    out_origin])`` — ``out_cols`` in the order ``[*cols, *ghost_cols]``;
+    ``total`` is the number of kept rows (may exceed capacity — then
+    ``overflow`` is True and the surplus rows were dropped).
     """
     n = valid.shape[0]
     n_key = len(cols)
@@ -99,16 +102,13 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
     # existing one and ``new_rows`` stays quiet.
     inv = (~valid).astype(jnp.int32)
     keys = [inv] + list(cols) + list(ghost_cols)
+    extras = [origin] if origin is not None else []
     if n <= WIDE_SORT_ROWS:
-        operands = list(keys)
-        if origin is not None:
-            operands.append(origin)
-        sorted_ops = jax.lax.sort(tuple(operands),
+        sorted_ops = jax.lax.sort(tuple(keys + extras),
                                   num_keys=1 + n_key + len(ghost_cols))
     else:
         perm = _lex_perm(keys)
-        payload = keys + ([origin] if origin is not None else [])
-        sorted_ops = [jnp.take(c, perm) for c in payload]
+        sorted_ops = [jnp.take(c, perm) for c in keys + extras]
     s_inv = sorted_ops[0]
     s_cols = list(sorted_ops[1:1 + n_key])
     s_ghost = list(sorted_ops[1 + n_key:1 + n_key + len(ghost_cols)])
@@ -168,4 +168,6 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
     if origin is None:
         return out_cols, out_valid, total, overflow
     new_rows = jnp.any(keep & (s_origin == 1))
-    return out_cols, out_valid, total, overflow, new_rows
+    buf = jnp.zeros(capacity + 1, dtype=s_origin.dtype)
+    out_origin = buf.at[dest].set(s_origin, mode="drop")[:capacity]
+    return out_cols, out_valid, total, overflow, new_rows, out_origin

@@ -63,13 +63,16 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
                                   gwords=gwords, work_budget=work_budget)
     # carry layout: (mask[C,MW], states[C,S], valid[C], win_ops, active,
     #               dirty, failed, failed_op, overflow, explored, rounds,
-    #               peak, ghosts, budget, consumed, cl_iters) — ghosts is
-    #               per-slot and the scalars are identical across shards,
-    #               hence replicated.
+    #               peak, ghosts, budget, consumed, cl_iters, fresh[W],
+    #               cur_new[C]) — ghosts/fresh are per-slot and the
+    #               scalars are identical across shards, hence replicated;
+    #               cur_new is a per-row delta flag, sharded like valid.
     sharded = P(axis)
     repl = P()
-    in_specs = ((sharded, sharded, sharded) + (repl,) * 13, repl)
-    out_specs = ((sharded, sharded, sharded) + (repl,) * 13, repl)
+    in_specs = ((sharded, sharded, sharded) + (repl,) * 14 + (sharded,),
+                repl)
+    out_specs = ((sharded, sharded, sharded) + (repl,) * 14 + (sharded,),
+                 repl)
     # check_vma=False: closure dedup sorts the *gathered* global row set, so
     # every shard computes bit-identical "replicated" scalars (counts, flags),
     # but the varying-axes checker can't prove that post-all_gather.
@@ -107,6 +110,8 @@ def _initial_carry(model, window, cap, n, mesh, axis):
         put(np.int32(0), P()),           # budget (run_chunk resets it)
         put(np.int32(0), P()),           # consumed
         put(np.int32(0), P()),           # cl_iters (paused-closure its)
+        put(np.zeros(window, bool), P()),     # fresh slots
+        put(np.zeros(gcap, bool), P(axis)),   # cur_new delta frontier
     )
 
 
@@ -122,14 +127,17 @@ def _resize_carry_sharded(carry, n, old_cap, new_cap, mesh, axis):
     mask = np.asarray(carry[0]).reshape(n, old_cap, -1)
     states = np.asarray(carry[1]).reshape(n, old_cap, -1)
     valid = np.asarray(carry[2]).reshape(n, old_cap)
+    cur_new = np.asarray(carry[17]).reshape(n, old_cap)
 
     nm = np.zeros((n, new_cap, mask.shape[2]), mask.dtype)
     ns = np.zeros((n, new_cap, states.shape[2]), states.dtype)
     nv = np.zeros((n, new_cap), bool)
+    nn = np.zeros((n, new_cap), bool)
     if new_cap >= old_cap:
         nm[:, :old_cap] = mask
         ns[:, :old_cap] = states
         nv[:, :old_cap] = valid
+        nn[:, :old_cap] = cur_new
     else:
         # round-robin deal: global live row j -> shard j % n, slot j // n
         idx, sh = np.divmod(np.arange(n * new_cap), n)
@@ -139,13 +147,15 @@ def _resize_carry_sharded(carry, n, old_cap, new_cap, mesh, axis):
         nm[sh[:k], idx[:k]] = fm[live]
         ns[sh[:k], idx[:k]] = fs[live]
         nv[sh[:k], idx[:k]] = True
+        nn[sh[:k], idx[:k]] = cur_new.reshape(-1)[live]
 
     def put(x):
         return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(axis)))
 
     return (put(nm.reshape(n * new_cap, -1)),
             put(ns.reshape(n * new_cap, -1)),
-            put(nv.reshape(n * new_cap))) + tuple(carry[3:])
+            put(nv.reshape(n * new_cap))) + tuple(carry[3:17]) \
+        + (put(nn.reshape(n * new_cap)),)
 
 
 def check_sharded(model: JaxModel,

@@ -171,8 +171,9 @@ def realtime_lag(history: History) -> List[Dict[str, Any]]:
 
 def generator(partitions: int = 4, max_mops: int = 3,
               sub_p: float = 0.05):
-    """Mix of txn ops and occasional assign/subscribe rebalances
-    (kafka.clj's generator interleaves the same way)."""
+    """Simple mix of txn ops and occasional assign/subscribe rebalances
+    (the quick-test generator; the reference-shaped pipeline is
+    :func:`workload` / :func:`txn_generator` + the wrappers below)."""
     counter = itertools.count(1)
 
     def one():
@@ -192,6 +193,304 @@ def generator(partitions: int = 4, max_mops: int = 3,
         return {"f": "txn", "value": mops}
 
     return gen.FnGen(one)
+
+
+# ---------------------------------------------------------------------------
+# Reference-shaped generator machinery (kafka.clj:195-443)
+# ---------------------------------------------------------------------------
+
+
+def txn_generator(la_gen=None, keys: int = 4):
+    """Rewrite list-append transactions into send/poll micro-ops
+    (kafka.clj:195-210 txn-generator): ``append k v`` -> ``["send", k, v]``,
+    ``r k`` -> ``["poll", {}]``.  The keys the original txn touched ride in
+    ``op.extra["keys"]`` so interleave_subscribes can subscribe to them."""
+    if la_gen is None:
+        from jepsen_tpu.workloads.cycle import append_gen
+        la_gen = append_gen(keys=keys)
+
+    def rewrite(op):
+        mops = []
+        ks = set()
+        for m in _mops(op):
+            ks.add(m[1])
+            if m[0] == "append":
+                mops.append(["send", m[1], m[2]])
+            else:
+                mops.append(["poll", {}])
+        op2 = op.with_(value=mops)
+        op2.extra["keys"] = sorted(ks, key=repr)
+        return op2
+
+    return gen.gen_map(rewrite, la_gen)
+
+
+def tag_rw(g):
+    """Tag ops whose mops are all sends / all polls as :f send / poll
+    (kafka.clj:244-253 tag-rw)."""
+    def tag(op):
+        fs = {m[0] for m in _mops(op)}
+        if fs == {"poll"}:
+            return op.with_(f="poll")
+        if fs == {"send"}:
+            return op.with_(f="send")
+        return op
+    return gen.gen_map(tag, g)
+
+
+SUBSCRIBE_RATIO = 1 / 8  # subscribe ops per txn op (kafka.clj:212-214)
+
+
+class InterleaveSubscribes(gen.Generator):
+    """With probability SUBSCRIBE_RATIO, emit a subscribe/assign op for the
+    keys the pending txn would touch BEFORE that same txn, which is queued
+    and dispensed on the next draw — kafka.clj:216-236.  (Queuing the
+    drawn txn, rather than redrawing later, matters because the inner
+    generator's draws are impure — a redraw would produce a DIFFERENT
+    txn and the subscribe would name a phantom txn's keys.)"""
+
+    def __init__(self, inner, sub_via=("subscribe", "assign"),
+                 queued=None):
+        self.inner = gen.lift(inner)
+        self.sub_via = tuple(sub_via)
+        self.queued = queued  # an Op template awaiting dispatch
+
+    def op(self, test, ctx):
+        if self.queued is not None:
+            filled = gen.fill_op(self.queued, ctx)
+            if filled is gen.PENDING:
+                return (gen.PENDING, self)
+            return (filled,
+                    InterleaveSubscribes(self.inner, self.sub_via))
+        if self.inner is None:
+            return None
+        r = self.inner.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is gen.PENDING:
+            return (gen.PENDING, InterleaveSubscribes(g2, self.sub_via))
+        ks = v.extra.get("keys") if isinstance(v.extra, dict) else None
+        if isinstance(v.extra, dict):
+            v.extra.pop("keys", None)
+        if ks and random.random() < SUBSCRIBE_RATIO:
+            f = random.choice(tuple(test.get("sub_via", self.sub_via)))
+            sub = gen.fill_op({"f": f, "value": list(ks)}, ctx)
+            if sub is gen.PENDING:
+                return (gen.PENDING,
+                        InterleaveSubscribes(g2, self.sub_via,
+                                             v.with_(process=None)))
+            # the drawn txn is QUEUED (inner already advanced to g2) and
+            # re-filled with a fresh process/time on the next draw
+            return (sub, InterleaveSubscribes(g2, self.sub_via,
+                                              v.with_(process=None)))
+        return (v, InterleaveSubscribes(g2, self.sub_via))
+
+    def update(self, test, ctx, event):
+        g2 = self.inner.update(test, ctx, event) if self.inner else None
+        if g2 is self.inner:
+            return self
+        return InterleaveSubscribes(g2, self.sub_via, self.queued)
+
+
+def interleave_subscribes(g, sub_via=("subscribe", "assign")):
+    return InterleaveSubscribes(g, sub_via)
+
+
+def op_max_send_offsets(op) -> Dict[Any, int]:
+    """key -> highest offset SENT by this op (kafka.clj:277-295)."""
+    out: Dict[Any, int] = {}
+    for k, o, _v in _send_pairs(op):
+        if o is not None and o > out.get(k, -1):
+            out[k] = o
+    return out
+
+
+def op_max_poll_offsets(op) -> Dict[Any, int]:
+    """key -> highest offset POLLED by this op (kafka.clj:256-275)."""
+    out: Dict[Any, int] = {}
+    for k, o, _v in _poll_records(op):
+        if o is not None and o > out.get(k, -1):
+            out[k] = o
+    return out
+
+
+def op_max_offsets(op) -> Dict[Any, int]:
+    out = op_max_send_offsets(op)
+    for k, o in op_max_poll_offsets(op).items():
+        if o > out.get(k, -1):
+            out[k] = o
+    return out
+
+
+class PollUnseen(gen.Generator):
+    """Track sent-but-never-polled keys; ~1/3 of assign/subscribe ops get
+    those keys spliced into their value so consumers chase the unseen tail
+    (kafka.clj:297-350 poll-unseen)."""
+
+    def __init__(self, inner, sent=None, polled=None):
+        self.inner = gen.lift(inner)
+        self.sent = dict(sent or {})      # key -> max offset sent
+        self.polled = dict(polled or {})  # key -> max offset polled
+
+    def _with(self, inner, sent=None, polled=None):
+        c = PollUnseen.__new__(PollUnseen)
+        c.inner = inner
+        c.sent = self.sent if sent is None else sent
+        c.polled = self.polled if polled is None else polled
+        return c
+
+    def op(self, test, ctx):
+        if self.inner is None:
+            return None
+        r = self.inner.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        if v is gen.PENDING:
+            return (gen.PENDING, self._with(g2))
+        if v.f in ("assign", "subscribe") and self.sent \
+                and random.random() < 1 / 3:
+            merged = list(v.value or [])
+            merged += [k for k in self.sent if k not in merged]
+            v = v.with_(value=merged)
+        return (v, self._with(g2))
+
+    def update(self, test, ctx, event):
+        inner2 = self.inner.update(test, ctx, event) if self.inner else None
+        if getattr(event, "type", None) != OK:
+            return self if inner2 is self.inner else self._with(inner2)
+        sent = dict(self.sent)
+        polled = dict(self.polled)
+        for k, o in op_max_send_offsets(event).items():
+            if o > sent.get(k, -1):
+                sent[k] = o
+        for k, o in op_max_poll_offsets(event).items():
+            if o > polled.get(k, -1):
+                polled[k] = o
+        for k in list(sent):  # trim keys we're caught up on
+            if polled.get(k, -1) >= sent[k]:
+                sent.pop(k, None)
+                polled.pop(k, None)
+        return self._with(inner2, sent, polled)
+
+
+def poll_unseen(g):
+    return PollUnseen(g)
+
+
+class TrackKeyOffsets(gen.Generator):
+    """Record the highest offset seen per key into a shared dict (the
+    'atom' final_polls reads) — kafka.clj:352-371."""
+
+    def __init__(self, offsets: Dict[Any, int], inner):
+        self.offsets = offsets  # SHARED, mutated in place
+        self.inner = gen.lift(inner)
+
+    def op(self, test, ctx):
+        if self.inner is None:
+            return None
+        r = self.inner.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        nxt = self if g2 is self.inner else TrackKeyOffsets(self.offsets, g2)
+        return (v, nxt)
+
+    def update(self, test, ctx, event):
+        if getattr(event, "type", None) == OK:
+            for k, o in op_max_offsets(event).items():
+                if o > self.offsets.get(k, -1):
+                    self.offsets[k] = o
+        inner2 = self.inner.update(test, ctx, event) if self.inner else None
+        if inner2 is self.inner:
+            return self
+        return TrackKeyOffsets(self.offsets, inner2)
+
+
+def track_key_offsets(offsets: Dict[Any, int], g):
+    return TrackKeyOffsets(offsets, g)
+
+
+class FinalPolls(gen.Generator):
+    """Drive the inner crash/assign/poll loop until polls catch up to the
+    target offsets (kafka.clj:373-436 final-polls): exhausts as soon as
+    every target key has been polled to its recorded max offset."""
+
+    def __init__(self, targets: Dict[Any, int], inner):
+        self.targets = dict(targets)
+        self.inner = gen.lift(inner)
+
+    def op(self, test, ctx):
+        if not self.targets or self.inner is None:
+            return None
+        r = self.inner.op(test, ctx)
+        if r is None:
+            return None
+        v, g2 = r
+        nxt = self if g2 is self.inner else FinalPolls(self.targets, g2)
+        return (v, nxt)
+
+    def update(self, test, ctx, event):
+        inner2 = self.inner.update(test, ctx, event) if self.inner else None
+        targets = self.targets
+        if getattr(event, "type", None) == OK and \
+                getattr(event, "f", None) in ("poll", "txn"):
+            seen = op_max_offsets(event)
+            t2 = {k: o for k, o in targets.items()
+                  if seen.get(k, -1) < o}
+            if len(t2) != len(targets):
+                targets = t2
+        if targets is self.targets and inner2 is self.inner:
+            return self
+        return FinalPolls(targets, inner2)
+
+
+def final_polls(offsets: Dict[Any, int], rounds_s: float = 10.0):
+    """Build the reference's catch-up phase from the tracked offsets:
+    crash the client (fresh state), assign every key with
+    seek-to-beginning, then poll repeatedly; the whole cycle repeats
+    until FinalPolls sees every target offset (kafka.clj:404-436).
+
+    Built lazily via FnGen-on-first-draw semantics: ``offsets`` is the
+    live dict track_key_offsets mutates, so the snapshot happens when the
+    final phase actually starts (the reference's ``delay``)."""
+    built: List[Any] = []
+
+    class _Delay(gen.Generator):
+        def op(self, test, ctx):
+            if not built:
+                targets = dict(offsets)
+                ks = sorted(targets, key=repr)
+                cycle = [{"f": "crash"},
+                         {"f": "debug-topic-partitions", "value": ks},
+                         {"f": "assign", "value": ks,
+                          "seek_to_beginning": True},
+                         gen.stagger(0.2, gen.repeat({"f": "poll",
+                                                      "value": [["poll",
+                                                                 {}]]}))]
+                built.append(FinalPolls(
+                    targets, gen.cycle(gen.time_limit(rounds_s,
+                                                      gen.lift(cycle)))))
+            return built[0].op(test, ctx)
+
+        def update(self, test, ctx, event):
+            if built:
+                built[0] = built[0].update(test, ctx, event)
+            return self
+
+    return _Delay()
+
+
+def crash_client_gen(opts: Optional[Dict[str, Any]] = None):
+    """Periodic client crashes when the test asks for them
+    (kafka.clj:438-445 crash-client-gen); None otherwise."""
+    opts = opts or {}
+    if not opts.get("crash_clients"):
+        return None
+    interval = float(opts.get("crash_client_interval", 30.0))
+    conc = max(1, int(opts.get("concurrency", 1)))
+    return gen.stagger(interval / conc, gen.repeat({"f": "crash"}))
 
 
 class KafkaChecker(Checker):
@@ -401,6 +700,7 @@ class KafkaChecker(Checker):
             if cur is None or d["lag"] > cur["lag"]:
                 worst_by_key[d["key"]] = d
 
+        cc = consume_counts(history)
         res = {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
                          else not hard),
                "anomaly-types": sorted(hard),
@@ -411,11 +711,15 @@ class KafkaChecker(Checker):
                "recovered-info-count": len(anomalies_info_recovered),
                "worst-realtime-lag": worst,
                "worst-realtime-lag-by-key": worst_by_key,
+               # exactly-once accounting (informational, kafka.clj
+               # consume-counts): subscribed polls reading a value twice
+               "consume-counts": cc,
                "unseen-count": len(unseen), "unseen": unseen[:8],
                "unseen-by-partition": {
                    k: d for k, d in sorted(per_part.items())
                    if d["unseen"]}}
         self._plot_lag(lags, opts or {}, test or {})
+        render_order_viz(test, history, hard, unseen, opts)
         from jepsen_tpu.elle.render import write_artifacts
         write_artifacts(test, res, opts)
         return res
@@ -551,5 +855,134 @@ def _txn_brief(op) -> Dict[str, Any]:
     return {"process": op.process, "index": op.index, "value": op.value}
 
 
-def workload(partitions: int = 4) -> Dict[str, Any]:
-    return {"generator": generator(partitions), "checker": KafkaChecker()}
+def consume_counts(history: History) -> Dict[str, Any]:
+    """Exactly-once accounting (kafka.clj:1651-1704 consume-counts): for
+    every committed txn polling while SUBSCRIBED (assign polls may freely
+    double-consume), count how often each (process, key, value) was read.
+    Returns the count distribution plus the key->value->count map of
+    anything consumed more than once."""
+    counts: Dict[Any, Dict[Any, Dict[Any, int]]] = {}
+    subscribed: set = set()
+    for op in history:
+        if op.type != OK:
+            continue
+        if op.f == "subscribe":
+            subscribed.add(op.process)
+        elif op.f == "assign":
+            subscribed.discard(op.process)
+        elif op.f in ("txn", "poll") or (
+                op.f is None and any(True for _ in _poll_records(op))):
+            if op.process not in subscribed:
+                continue
+            per = counts.setdefault(op.process, {})
+            for k, _o, v in _poll_records(op):
+                kk = per.setdefault(k, {})
+                kk[v] = kk.get(v, 0) + 1
+    dist: Dict[int, int] = {}
+    dups: Dict[Any, Dict[Any, int]] = {}
+    for _p, by_k in counts.items():
+        for k, by_v in by_k.items():
+            for v, c in by_v.items():
+                dist[c] = dist.get(c, 0) + 1
+                if c > 1:
+                    dups.setdefault(k, {})[v] = c
+    return {"distribution": dict(sorted(dist.items())),
+            "dup-counts": {k: dict(sorted(v.items(), key=repr))
+                           for k, v in sorted(dups.items(), key=repr)}}
+
+
+def key_order_viz(k, history: History) -> str:
+    """SVG visualization of every OK op's sends/polls of key ``k``'s log:
+    one row per op, offsets on the x axis, the observed value as the cell
+    text, with cells of offsets that carry conflicting values highlighted
+    (kafka.clj:1570-1630 key-order-viz)."""
+    votes: Dict[int, set] = defaultdict(set)
+    rows = []
+    for op in history:
+        if op.type != OK:
+            continue
+        pairs = [(o, v) for kk, o, v in itertools.chain(_send_pairs(op),
+                                                        _poll_records(op))
+                 if kk == k and o is not None]
+        if pairs:
+            rows.append((op, pairs))
+            for o, v in pairs:
+                votes[o].add(v)
+    cells = []
+    max_x = max_y = 0
+    for i, (op, pairs) in enumerate(rows):
+        y = (i + 1) * 14
+        max_y = max(max_y, y)
+        title = (f"{op.type} {op.f} by process {op.process} "
+                 f"(index {op.index})")
+        row_cells = []
+        for o, v in pairs:
+            x = o * 24
+            max_x = max(max_x, x)
+            conflict = len(votes[o]) > 1
+            style = ' style="fill:#c0392b;font-weight:bold"' if conflict \
+                else ""
+            row_cells.append(f'<text x="{x}" y="{y}"{style}>{v}</text>')
+        cells.append(f"<g><title>{title}</title>" + "".join(row_cells)
+                     + "</g>")
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" version="1.1" '
+            f'width="{max_x + 40}" height="{max_y + 20}">'
+            '<style>svg { font-family: Helvetica, Arial, sans-serif; '
+            'font-size: 10px; }</style>'
+            + "".join(cells) + "</svg>")
+
+
+def render_order_viz(test, history: History, anomalies: Dict[str, Any],
+                     unseen, opts=None) -> None:
+    """Write orders/<k>.svg for every key implicated in offset anomalies
+    (kafka.clj:1632-1650 render-order-viz!).  Best-effort artifact."""
+    d = (opts or {}).get("store_dir") or (test or {}).get("store_dir")
+    if not d:
+        return
+    keys = {a["key"] for t in ("inconsistent-offsets", "duplicate",
+                               "lost-write")
+            for a in anomalies.get(t, ()) if "key" in a}
+    keys |= {u["key"] for u in unseen}
+    if not keys:
+        return
+    try:
+        import os
+        od = os.path.join(d, "orders")
+        os.makedirs(od, exist_ok=True)
+        for k in sorted(keys, key=repr):
+            name = f"{k:03d}.svg" if isinstance(k, int) else f"{k}.svg"
+            with open(os.path.join(od, name), "w") as f:
+                f.write(key_order_viz(k, history))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def workload(partitions: int = 4, sub_via=("subscribe", "assign"),
+             crash_clients: bool = False,
+             crash_client_interval: float = 30.0,
+             concurrency: int = 4,
+             reference_shape: bool = False) -> Dict[str, Any]:
+    """Kafka workload.  With ``reference_shape``, the generator is the
+    reference's full pipeline (kafka.clj:2106-2150 workload): list-append
+    txns rewritten to send/poll, rw-tagged, subscribe-interleaved,
+    unseen-chasing, offset-tracked — plus a ``final_generator`` that
+    crashes clients and polls until every tracked offset has been seen,
+    and an optional crash-client schedule."""
+    if not reference_shape:
+        return {"generator": generator(partitions),
+                "checker": KafkaChecker()}
+    offsets: Dict[Any, int] = {}
+    g = txn_generator(keys=partitions)
+    g = tag_rw(g)
+    g = interleave_subscribes(g, sub_via)
+    g = poll_unseen(g)
+    g = track_key_offsets(offsets, g)
+    crash = crash_client_gen({"crash_clients": crash_clients,
+                              "crash_client_interval": crash_client_interval,
+                              "concurrency": concurrency})
+    if crash is not None:
+        g = gen.any_gen(g, crash)
+    return {"generator": g,
+            "final_generator": final_polls(offsets),
+            "tracked_offsets": offsets,
+            "checker": KafkaChecker()}

@@ -31,6 +31,10 @@ EXTRA = {
     "monotonic": lambda opts: sqlextra.monotonic_workload(conn),
     "sequential": lambda opts: sqlextra.sequential_workload(
         conn, keys=int(opts.get("keys", 32))),
+    # strict-serializability write precedence over sharded comment tables
+    # (cockroach/comments.clj); adya G2 ships as the shared "g2" workload
+    "comments": lambda opts: sqlextra.comments_workload(
+        conn, keys=int(opts.get("keys", 4))),
 }
 
 WORKLOADS, cockroach_test, all_tests, main = sqlsuite.make_suite(

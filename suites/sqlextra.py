@@ -351,3 +351,114 @@ class RmwSetClient(_SqlClient):
 def lost_updates_workload(conn_factory) -> Dict[str, Any]:
     wl = sets.workload()
     return {**wl, "client": RmwSetClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Comments (strict-serializability write precedence)
+# --------------------------------------------------------------------------
+
+COMMENT_TABLES = 5
+
+
+def comments_generator(keys: int = 4, ops_per_key: int = 120,
+                       threads_per_key: int = 2):
+    """Blind inserts of globally-sequential ids mixed with read-alls,
+    lifted over keys (comments.clj:148-167's independent shape)."""
+    from jepsen_tpu import independent
+    ids = itertools.count()
+
+    def key_gen(k):
+        def one():
+            if random.random() < 0.5:
+                return {"f": "write", "value": (k, next(ids))}
+            return {"f": "read", "value": (k, None)}
+        return gen.limit(ops_per_key, gen.FnGen(one))
+
+    return independent.concurrent_generator(threads_per_key,
+                                            list(range(keys)), key_gen)
+
+
+class CommentsClient(_SqlClient):
+    """Blind insert of (id, key) into one of COMMENT_TABLES tables chosen
+    by id (the reference splits tables to land in different shard ranges,
+    comments.clj:30-41); reads select the key's ids from EVERY table in
+    one transaction."""
+
+    def setup(self, test):
+        for t in range(COMMENT_TABLES):
+            self.conn.query(f"CREATE TABLE IF NOT EXISTS comment_{t} "
+                            "(id INT PRIMARY KEY, k INT)")
+
+    def invoke(self, test, op: Op) -> Op:
+        k, v = op.value
+        try:
+            if op.f == "write":
+                self.conn.query(
+                    f"INSERT INTO comment_{v % COMMENT_TABLES} "
+                    f"VALUES ({v}, {k})")
+                return op.with_(type=OK)
+            # read: all tables, one txn
+            self.conn.query("BEGIN")
+            try:
+                seen = []
+                for t in range(COMMENT_TABLES):
+                    rows = self.conn.query(
+                        f"SELECT id FROM comment_{t} WHERE k = {k}")
+                    seen.extend(int(r[0]) for r in rows)
+                self.conn.query("COMMIT")
+                return op.with_(type=OK, value=(k, sorted(seen)))
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+class CommentsChecker(Checker):
+    """T1 < T2 (w1 completed before w2 was invoked) but a read sees T2
+    without T1: the strict-serializability violation comments.clj:89-140
+    replays for.  Expected sets are per-write snapshots of the completed
+    writes at invocation; a read containing w must contain w's whole
+    expected set."""
+
+    def check(self, test, history: History, opts=None):
+        completed: set = set()
+        expected: Dict[Any, frozenset] = {}
+        for op in history:
+            if op.f != "write":
+                continue
+            if op.type == "invoke":
+                expected[op.value] = frozenset(completed)
+            elif op.type == OK:
+                completed.add(op.value)
+        errors = []
+        reads = 0
+        for op in history:
+            if op.f != "read" or op.type != OK or \
+                    not isinstance(op.value, (list, tuple, set, frozenset)):
+                continue
+            reads += 1
+            seen = set(op.value)
+            want: set = set()
+            for v in seen:
+                want |= expected.get(v, frozenset())
+            missing = want - seen
+            if missing:
+                errors.append({"missing": sorted(missing),
+                               "expected-count": len(want),
+                               "read": op.to_dict()})
+        if reads == 0:
+            return {"valid": UNKNOWN, "error": "no reads completed"}
+        return {"valid": not errors, "reads": reads,
+                "errors": errors[:8]}
+
+
+def comments_workload(conn_factory, keys: int = 4,
+                      ops_per_key: int = 120) -> Dict[str, Any]:
+    from jepsen_tpu import independent
+    return {"generator": comments_generator(keys, ops_per_key),
+            "checker": independent.checker(CommentsChecker()),
+            "client": CommentsClient(conn_factory)}

@@ -623,6 +623,7 @@ class MiniSqlState:
         self.mono: Dict[int, int] = {}          # val -> proc
         self.dirty: Dict[int, int] = {}         # id -> x
         self.seq: Dict[int, set] = {}           # table idx -> {k}
+        self.comments: Dict[int, Dict[int, int]] = {}  # table -> id -> k
         self.lock = _NullLock()  # handlers' outer lock: serialization is
         self.txn = threading.RLock()  # done here, txn-scoped
         self._holders: Dict[int, int] = {}  # thread id -> depth
@@ -793,6 +794,22 @@ class MiniSqlState:
         if m:
             t, k = int(m.group(1)), int(m.group(2))
             return ([(k,)] if k in self.seq.get(t, set()) else []), 0, None
+        # comments workload: comment_0..N tables of (id, k)
+        m = _re.match(r"insert into comment_(\d+) values \((\d+), (\d+)\)",
+                      low)
+        if m:
+            t, i, k = (int(m.group(1)), int(m.group(2)), int(m.group(3)))
+            rows = self.comments.setdefault(t, {})
+            if i in rows:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            rows[i] = k
+            return [], 1, None
+        m = _re.match(r"select id from comment_(\d+) where k = (\d+)", low)
+        if m:
+            t, k = int(m.group(1)), int(m.group(2))
+            return sorted((i,) for i, kk in self.comments.get(t, {}).items()
+                          if kk == k), 0, None
         return [], 0, {"S": "ERROR", "C": "42601",
                        "M": f"unparsed: {q[:60]}", "errno": "1064"}
 

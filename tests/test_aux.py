@@ -82,6 +82,31 @@ class TestFaketime:
         assert "LD_PRELOAD" in s
         assert s.startswith("#!/bin/bash")
 
+    def test_install_pinned_builds_fork_from_source(self):
+        # faketime.clj:8-23 parity: clone the pinned fork, check out the
+        # pinned tag, make, make install — all through the control layer.
+        SHARED = []
+
+        class SharedLogDummy(control.DummyRemote):
+            def connect(self, ctx):
+                r = super().connect(ctx)
+                r.log = SHARED
+                return r
+
+        test = {"nodes": ["n1"], "remote": SharedLogDummy(record_only=True)}
+        control.setup_sessions(test)
+        try:
+            faketime.install_pinned(test, "n1")
+        finally:
+            control.teardown_sessions(test)
+        cmds = " ;; ".join(SHARED)
+        # record-only remotes answer ok to the exists probe, so the clone
+        # is skipped; the probe + pinned checkout + build must all appear
+        assert f"test -e {faketime.BUILD_DIR}" in cmds
+        assert f"git checkout {faketime.PINNED_TAG}" in cmds
+        assert f"cd {faketime.BUILD_DIR} && make" in cmds
+        assert "make install" in cmds
+
 
 class FakeClusterState(State):
     """Mock membership state over an in-memory 'cluster'."""

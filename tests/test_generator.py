@@ -210,18 +210,27 @@ class TestValidate:
 class TestPerf:
     def test_scheduler_throughput(self):
         """The reference cites >20k ops/s for pure generator scheduling
-        (generator.clj:67-70); assert we're within striking distance in the
-        simulator (which also pays completion costs)."""
+        (generator.clj:67-70).  On this stack the equivalent pure-mix
+        shape measures ~24k ops/s on an idle machine (best-of-N through
+        the simulator, which ALSO pays completion/update costs the
+        reference's figure excludes); the realistic wrapped stack
+        (clients + time_limit + mix) measures ~14k.  The assertion bar is
+        set below the idle measurements to tolerate CI load, but high
+        enough that a regression to round-3's ~12k pure-mix rate fails."""
         import time
-        g = gen.limit(20_000, gen.mix([gen.repeat({"f": "r"}),
-                                       gen.repeat({"f": "w", "value": 1})]))
-        t0 = time.time()
-        h = testkit.quick(g, concurrency=10, complete_fn=testkit.instant)
-        dt = time.time() - t0
-        n = len([o for o in h if o.type == INVOKE])
-        assert n == 20_000
-        rate = n / dt
-        assert rate > 5_000, f"scheduler too slow: {rate:.0f} ops/s"
+        best = 0.0
+        for _ in range(3):
+            g = gen.limit(20_000, gen.mix([gen.repeat({"f": "r"}),
+                                           gen.repeat({"f": "w",
+                                                       "value": 1})]))
+            t0 = time.time()
+            h = testkit.quick(g, concurrency=10,
+                              complete_fn=testkit.instant)
+            dt = time.time() - t0
+            n = len([o for o in h if o.type == INVOKE])
+            assert n == 20_000
+            best = max(best, n / dt)
+        assert best > 15_000, f"scheduler too slow: {best:.0f} ops/s"
 
 
 class TestConcurrentGeneratorRotation:

@@ -327,3 +327,136 @@ class TestKafkaRealtimeLag:
         r = check(h)
         assert all(v["lag"] == 0
                    for v in r["worst-realtime-lag-by-key"].values()), r
+
+
+def ctl(process, f, value=None):
+    return [Op(process=process, type=INVOKE, f=f, value=value),
+            Op(process=process, type=OK, f=f, value=value)]
+
+
+class TestConsumeCounts:
+    def test_subscribed_double_read_reported(self):
+        from jepsen_tpu.workloads.kafka import consume_counts
+        h = History(ctl(0, "subscribe", [0]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]))
+        cc = consume_counts(h)
+        assert cc["dup-counts"] == {0: {10: 2}}, cc
+        assert cc["distribution"] == {2: 1}
+
+    def test_assign_double_read_free(self):
+        # assigns are free to double-consume (kafka.clj:1674-1678)
+        from jepsen_tpu.workloads.kafka import consume_counts
+        h = History(ctl(0, "assign", [0]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]))
+        assert consume_counts(h)["dup-counts"] == {}
+
+    def test_assign_after_subscribe_clears(self):
+        from jepsen_tpu.workloads.kafka import consume_counts
+        h = History(ctl(0, "subscribe", [0]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]) +
+                    ctl(0, "assign", [0]) +
+                    ok(0, [["poll", {0: [[0, 10]]}]]))
+        assert consume_counts(h)["dup-counts"] == {}
+
+    def test_in_checker_result(self):
+        h = (ctl(0, "subscribe", [0]) +
+             ok(0, [["poll", {0: [[0, 10]]}]]) +
+             ok(0, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert r["consume-counts"]["dup-counts"] == {0: {10: 2}}
+
+
+class TestKeyOrderViz:
+    def test_svg_marks_conflicting_offsets(self):
+        from jepsen_tpu.workloads.kafka import key_order_viz
+        h = History(ok(0, [["send", 0, [0, 10]]]) +
+                    ok(1, [["poll", {0: [[0, 99]]}]]))  # conflict at offset 0
+        svg = key_order_viz(0, h)
+        assert svg.startswith("<svg") and "</svg>" in svg
+        assert ">10<" in svg and ">99<" in svg
+        assert "fill:#c0392b" in svg  # conflicting offset highlighted
+
+    def test_render_writes_per_key_files(self, tmp_path):
+        from jepsen_tpu.workloads.kafka import KafkaChecker
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [2, 10]]]) +   # duplicate value
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = KafkaChecker().check({"store_dir": str(tmp_path)}, History(h))
+        assert "duplicate" in r["anomaly-types"]
+        assert (tmp_path / "orders" / "000.svg").exists()
+
+
+class TestGeneratorMachinery:
+    def test_txn_generator_rewrites_and_tags_keys(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.generator import testkit
+        from jepsen_tpu.workloads.kafka import txn_generator
+        h = testkit.quick(gen.limit(30, txn_generator(keys=3)),
+                          concurrency=2)
+        invs = [o for o in h if o.type == "invoke"]
+        assert invs
+        for op in invs:
+            for m in op.value:
+                assert m[0] in ("send", "poll")
+                if m[0] == "send":
+                    assert isinstance(m[1], int) and m[1] < 3
+
+    def test_interleave_subscribes_emits_control_ops(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.generator import testkit
+        from jepsen_tpu.workloads.kafka import (interleave_subscribes,
+                                                txn_generator)
+        gen.seed(5)
+        g = interleave_subscribes(gen.limit(60, txn_generator(keys=3)))
+        h = testkit.quick(g, concurrency=2)
+        fs = {o.f for o in h if o.type == "invoke"}
+        assert fs & {"subscribe", "assign"}, fs
+        subs = [o for o in h if o.type == "invoke"
+                and o.f in ("subscribe", "assign")]
+        for s in subs:
+            assert isinstance(s.value, list) and s.value
+        # txn ops still flow (the replaced txn is not lost)
+        assert sum(1 for o in h if o.type == "invoke"
+                   and o.f in ("txn", "send", "poll")) == 60
+
+    def test_poll_unseen_splices_lagging_keys(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.workloads.kafka import PollUnseen
+        pu = PollUnseen(gen.repeat({"f": "assign", "value": [9]}))
+        # an OK send on key 0 with no polls -> key 0 is unseen
+        ev = Op(process=0, type=OK, f="txn",
+                value=[["send", 0, [4, 44]]], time=0)
+        pu = pu.update({}, gen.context({"concurrency": 2}), ev)
+        assert pu.sent == {0: 4} and pu.polled == {}
+        # a catching-up poll trims it
+        ev2 = Op(process=0, type=OK, f="txn",
+                 value=[["poll", {0: [[4, 44]]}]], time=0)
+        pu = pu.update({}, gen.context({"concurrency": 2}), ev2)
+        assert pu.sent == {} and pu.polled == {}
+
+    def test_final_polls_exhausts_when_caught_up(self):
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.workloads.kafka import FinalPolls
+        fp = FinalPolls({0: 2}, gen.repeat({"f": "poll",
+                                            "value": [["poll", {}]]}))
+        ctx = gen.context({"concurrency": 2})
+        assert fp.op({}, ctx) is not None
+        ev = Op(process=0, type=OK, f="poll",
+                value=[["poll", {0: [[2, 22]]}]], time=0)
+        fp = fp.update({}, ctx, ev)
+        assert fp.targets == {}
+        assert fp.op({}, ctx) is None  # exhausted: targets met
+
+    def test_track_key_offsets_and_final_polls_wiring(self):
+        from jepsen_tpu.workloads.kafka import workload
+        wl = workload(partitions=3, reference_shape=True)
+        assert wl["final_generator"] is not None
+        assert wl["tracked_offsets"] == {}
+
+    def test_crash_client_gen_gated(self):
+        from jepsen_tpu.workloads.kafka import crash_client_gen
+        assert crash_client_gen({}) is None
+        assert crash_client_gen({"crash_clients": True,
+                                 "concurrency": 4}) is not None

@@ -102,9 +102,11 @@ class TestSharded:
         mask = rng.integers(0, 2**8, (n * cap, 1)).astype(np.uint32)
         states = rng.integers(0, 5, (n * cap, 1)).astype(np.int32)
         valid = np.array([1, 0, 1, 0, 0, 1, 0, 0], bool)
+        cur_new = np.array([1, 0, 0, 0, 0, 1, 0, 0], bool)
         carry = (jax.numpy.asarray(mask), jax.numpy.asarray(states),
                  jax.numpy.asarray(valid), "w", "a", "d", "f", "fo",
-                 "o", "e", "r", "p")
+                 "o", "e", "r", "p", "g", "b", "c", "ci", "fr",
+                 jax.numpy.asarray(cur_new))
         live = {(int(m), int(s)) for m, s, v in
                 zip(mask[:, 0], states[:, 0], valid) if v}
 
@@ -115,7 +117,15 @@ class TestSharded:
 
         grown = _resize_carry_sharded(carry, n, cap, 8, mesh, "model")
         assert live_set(grown) == live
-        assert grown[3:] == carry[3:]
+        assert grown[3:17] == carry[3:17]
+        # cur_new rides with its rows: flags follow the same live configs
+        def new_set(c):
+            m = np.asarray(c[0]); v = np.asarray(c[2])
+            nn = np.asarray(c[17])
+            return {int(m[i, 0]) for i in range(len(v)) if v[i] and nn[i]}
+        flagged = {int(m) for m, v, f in
+                   zip(mask[:, 0], valid, cur_new) if v and f}
+        assert new_set(grown) == flagged
         # grow keeps shard-local rows in the shard's slice
         gm = np.asarray(grown[0]).reshape(n, 8, 1)
         gv = np.asarray(grown[2]).reshape(n, 8)

@@ -71,6 +71,11 @@ class TestPgFamilyWire:
         run_wire_test(WORKLOADS["sequential"]({}), "crdb-sequential",
                       pg_port)
 
+    def test_cockroach_comments(self, pg_port):
+        from suites.cockroachdb.runner import WORKLOADS
+        run_wire_test(WORKLOADS["comments"]({"keys": 2}), "crdb-comments",
+                      pg_port)
+
     def test_crate_lost_updates(self, pg_port):
         from suites.crate.runner import WORKLOADS
         run_wire_test(WORKLOADS["lost-updates"]({}), "crate-lost-updates",
@@ -188,3 +193,39 @@ class TestSuiteConstruction:
                 assert t["client"] is not None
                 assert t["checker"] is not None
                 assert t["generator"] is not None
+
+
+class TestCommentsChecker:
+    def _check(self, history):
+        from suites.sqlextra import CommentsChecker
+        return CommentsChecker().check({}, history)
+
+    def test_clean_precedence_valid(self):
+        r = self._check(h(
+            inv(0, 0, "write", 1), ok(1, 0, "write", 1),
+            inv(2, 1, "write", 2), ok(3, 1, "write", 2),
+            inv(4, 2, "read"), ok(5, 2, "read", [1, 2])))
+        assert r["valid"] is True, r
+
+    def test_later_write_visible_without_earlier_refuted(self):
+        # w1 completed BEFORE w2 was invoked; a read sees 2 but not 1
+        r = self._check(h(
+            inv(0, 0, "write", 1), ok(1, 0, "write", 1),
+            inv(2, 1, "write", 2), ok(3, 1, "write", 2),
+            inv(4, 2, "read"), ok(5, 2, "read", [2])))
+        assert r["valid"] is False
+        assert r["errors"][0]["missing"] == [1]
+
+    def test_concurrent_writes_order_free(self):
+        # w1 and w2 overlap: seeing either alone is fine
+        r = self._check(h(
+            inv(0, 0, "write", 1),
+            inv(1, 1, "write", 2), ok(2, 1, "write", 2),
+            ok(3, 0, "write", 1),
+            inv(4, 2, "read"), ok(5, 2, "read", [2])))
+        assert r["valid"] is True, r
+
+    def test_no_reads_unknown(self):
+        from jepsen_tpu.checker.core import UNKNOWN
+        r = self._check(h(inv(0, 0, "write", 1), ok(1, 0, "write", 1)))
+        assert r["valid"] is UNKNOWN
