@@ -125,7 +125,9 @@ def engine_window(window: int) -> int:
 
 def make_engine(model: JaxModel, window: int, capacity: int,
                 axis_name: Optional[str] = None, num_shards: int = 1,
-                gwords: int = 1, work_budget: Optional[int] = None):
+                gwords: int = 1, work_budget: Optional[int] = None,
+                single_round_closure: bool = False,
+                steps_per_dispatch: int = 256):
     """Build the jittable (carry0, event_step, run_chunk) triple.
 
     ``window`` may be any positive slot count (candidate-row count — and so
@@ -137,12 +139,26 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     ghost subsumption state sorts as ``gwords`` columns, not ceil(W/32) —
     keeping the big variadic sort narrow (wide sorts at high capacity have
     crashed the TPU compiler).
+
+    ``single_round_closure`` builds the VMAP-SAFE variant for the batched
+    (per-lane) driver: under vmap, ``lax.cond``/``switch`` execute EVERY
+    branch for the whole batch, so the standard engine's three merge
+    widths and per-return fixpoint loop multiply into a per-step cost that
+    outruns the TPU watchdog (the round-2/3 batch-tier killer).  This mode
+    runs exactly ONE closure round per scan step with ONE merge width
+    (NC = C; a round whose candidates overflow the compacted buffer flags
+    engine overflow and the lane escalates).  A RETURN whose closure
+    hasn't converged parks in the pending-return register and later steps
+    continue it one round at a time; each step gathers the lane's next
+    event by the lane's own absolute ``consumed`` cursor (run_chunk's
+    ``events`` is then the FULL stream and ``steps_per_dispatch`` fixes
+    the program length), so per-step device work is constant, a
+    dispatch's wall-clock is bounded by its step count, and vmapped lanes
+    progress at fully independent rates with no idle steps.
     """
     assert window > 0
-    # The closure expands the window in fixed blocks (see closure); pad the
-    # slot count to a block multiple — surplus slots are never active, so
-    # their blocks always take the skip branch.  Callers building
-    # window-shaped carries outside carry0 (parallel.sharded) must use
+    # Callers building window-shaped carries outside carry0
+    # (parallel.sharded) must use
     # engine_window() for the same padding.
     window = engine_window(window)
     # work_budget: None = capacity-scaled default; <= 0 = unlimited
@@ -241,7 +257,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         return jnp.stack(out, axis=-1)                     # [N, MW]
 
     def closure(mask, states, valid, win_ops, active, ghosts, overflow,
-                budget, it0, fresh, cur_new):
+                budget, it0, fresh, cur_new, enable=None):
         # Dedup treats the ghost-slot part of the mask as a *subsumption*
         # column, not an identity column: ghost ops never return, so their
         # bits are never consulted by pruning, and a config whose ghost set
@@ -345,6 +361,8 @@ def make_engine(model: JaxModel, window: int, capacity: int,
             row_gate = jnp.where(round0, valid, valid & cur_new)
             slot_gate = jnp.where(round0, active & fresh, active)
             cv = row_gate[:, None] & slot_gate[None, :] & ~has & ok
+            if enable is not None:  # lane-level gate (single-round mode)
+                cv = cv & enable
             cand_mask = mask[:, None, :] | slot_masks[None, :, :]
             nv = cv.sum().astype(jnp.int32)
             nv_max = (lax.pmax(nv, axis_name)
@@ -366,6 +384,13 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                   cv.reshape(C * W), ovf)
 
             def do(args):
+                if single_round_closure:
+                    # vmap runs every switch branch, so the batched engine
+                    # gets ONE width; compact_to silently truncates past
+                    # NC, which would be unsound — flag overflow instead
+                    # so the driver escalates the lane.
+                    out = merge_compacted(C)(args)
+                    return out[:6] + (out[6] | (nv > C),)
                 # Merge width by (shard-uniform) candidate volume: most
                 # rounds fit the C buffer, burst rounds the 4C one, and
                 # the full grid is the rare fallback.
@@ -391,8 +416,18 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
         init = (mask, states, valid, cur_new, count0, jnp.bool_(True),
                 overflow, it0)
-        mask, states, valid, cur_new, count, changed, overflow, it_fin = \
-            lax.while_loop(cond, body, init)
+        if single_round_closure:
+            # One round per call.  NOTE the consume-on-arrival design: a
+            # RETURN is consumed the step it arrives and parked in the
+            # pending-return register; successive steps run one round
+            # each until convergence lands the prune.  The host must
+            # therefore treat a lane as LIVE while its stalled flag is
+            # set even if its cursor passed the stream end (flags[4]).
+            mask, states, valid, cur_new, count, changed, overflow, \
+                it_fin = body(init)
+        else:
+            (mask, states, valid, cur_new, count, changed, overflow,
+             it_fin) = lax.while_loop(cond, body, init)
         # Exit reasons: fixpoint (~changed), the W+1 cumulative chain-depth
         # cap (treated as converged — matches the pre-budget behavior), or
         # budget exhaustion — the only pause case.
@@ -508,6 +543,76 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         new_carry = lax.cond(alive, apply, lambda c: c, carry)
         return new_carry, None
 
+    def event_step_single(carry, ev):
+        """Mask-native event step for the vmapped batch engine: no
+        cond/switch (vmap executes every branch), exactly ONE closure
+        round per step.  ``ev`` is the lane's NEXT unconsumed event
+        (gathered by the lane's own ``consumed`` cursor — see
+        run_chunk's single-round variant), so lanes never need positional
+        alignment: a step either continues a pending return's closure
+        (pr_slot/pr_op, carry[18:20]) one round, or applies the next
+        event; every step makes real progress for every lane."""
+        (mask, states, valid, win_ops, active, dirty, failed, failed_op,
+         overflow, explored, rounds, peak, ghosts, budget, consumed,
+         cl_iters, fresh, cur_new, pr_slot, pr_op) = carry
+        kind, slot = ev[0], ev[1]
+        f, a, b, op_id = ev[2], ev[3], ev[4], ev[5]
+        is_ghost, gcls, grank, gpos = ev[6], ev[7], ev[8], ev[9]
+        alive = ~failed & ~overflow
+        stalled = pr_slot >= 0
+
+        # -- Phase A: one closure round for the pending return, or for an
+        # incoming RETURN (at most one closure user per step).
+        ret_in = alive & ~stalled & (kind == EV_RETURN)
+        c_active = (alive & stalled) | ret_in
+        c_slot = jnp.where(stalled, pr_slot, slot)
+        c_op = jnp.where(stalled, pr_op, op_id)
+        work = c_active & dirty
+        (mask, states, valid, cur_new, count, overflow, it_fin,
+         converged) = closure(mask, states, valid, win_ops, active, ghosts,
+                              overflow, jnp.int32(2**30), cl_iters, fresh,
+                              cur_new, enable=work)
+        rounds = rounds + jnp.where(work, it_fin - cl_iters, 0)
+        peak = jnp.maximum(peak, jnp.where(work, count, 0))
+        converged = converged | ~dirty
+        finish = c_active & converged
+        bm = slot_bitmask(c_slot)
+        has = ((mask & bm[None, :]) != 0).any(-1)
+        valid = jnp.where(finish, valid & has, valid)
+        newly_failed = finish & (global_sum(valid.sum()) == 0)
+        failed_op = jnp.where(newly_failed & ~failed, c_op, failed_op)
+        failed = failed | newly_failed
+        mask = jnp.where(finish, mask & ~bm[None, :], mask)
+        active = jnp.where(finish, active.at[c_slot].set(False), active)
+        explored = explored + jnp.where(finish & work, count, 0)
+        fresh = jnp.where(finish, jnp.zeros_like(fresh), fresh)
+        cl_iters = jnp.where(finish, 0,
+                             jnp.where(c_active, it_fin, cl_iters))
+        dirty = dirty & ~finish
+        new_stall = c_active & ~converged & ~stalled
+        pr_slot = jnp.where(finish, -1, jnp.where(new_stall, slot, pr_slot))
+        pr_op = jnp.where(finish, -1, jnp.where(new_stall, op_id, pr_op))
+
+        # -- Phase B: ENTER/NOP apply only when the lane entered the step
+        # un-stalled (a pending return's prune must land before an ENTER
+        # can reuse its just-freed slot — the ENTER waits a step).
+        entering = alive & ~stalled & (kind == EV_ENTER)
+        row = jnp.stack([f, a, b, gcls, grank, gpos])
+        win_ops = jnp.where(entering, win_ops.at[slot].set(row), win_ops)
+        active = jnp.where(entering, active.at[slot].set(True), active)
+        fresh = jnp.where(entering, fresh.at[slot].set(True), fresh)
+        ghosts = jnp.where(entering & (is_ghost == 1),
+                           ghosts | slot_bitmask(slot), ghosts)
+        dirty = dirty | entering
+
+        consumed = consumed + jnp.where(
+            entering | ret_in | (alive & ~stalled & (kind == EV_NOP)),
+            1, 0)
+        return (mask, states, valid, win_ops, active, dirty, failed,
+                failed_op, overflow, explored, rounds, peak, ghosts,
+                budget, consumed, cl_iters, fresh, cur_new, pr_slot,
+                pr_op), None
+
     def _init_win_ops(w):
         # columns: f, a, b, ghost-class (-1 = not a ghost), ghost-rank,
         # compact ghost bit position
@@ -533,7 +638,9 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 jnp.int32(0),                              # events consumed
                 jnp.int32(0),                              # paused-closure its
                 jnp.zeros(W, dtype=bool),                  # fresh slots
-                jnp.zeros(C, dtype=bool))                  # delta frontier
+                jnp.zeros(C, dtype=bool)) + (              # delta frontier
+                (jnp.int32(-1), jnp.int32(-1))             # pending return
+                if single_round_closure else ())
 
     def run_chunk(carry, events):
         # Reset the peak to the live count on entry, and the work budget /
@@ -544,14 +651,36 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         # fresh / cur_new (carry[15:]) are NOT reset: they belong to a
         # possibly-paused closure.
         live0 = global_sum(carry[2].sum()).astype(jnp.int32)
-        carry = carry[:11] + (live0, carry[12],
-                              jnp.int32(work_budget), jnp.int32(0)) \
-            + carry[15:]
-        carry, _ = lax.scan(event_step, carry, events)
+        if single_round_closure:
+            # ``events`` is the lane's FULL (padded) stream; ``consumed``
+            # is the lane's ABSOLUTE cursor (not reset per dispatch) and
+            # each of the fixed per-dispatch steps gathers the cursor's
+            # event — no slicing, no alignment, no idle steps.
+            carry = carry[:11] + (live0, carry[12],
+                                  jnp.int32(work_budget)) + carry[14:]
+            n_ev = events.shape[0]
+
+            def gather_step(c, _):
+                pos = jnp.minimum(c[14], n_ev - 1)
+                ev = lax.dynamic_index_in_dim(events, pos, keepdims=False)
+                return event_step_single(c, ev)
+
+            carry, _ = lax.scan(gather_step, carry, None,
+                                length=steps_per_dispatch)
+        else:
+            carry = carry[:11] + (live0, carry[12],
+                                  jnp.int32(work_budget), jnp.int32(0)) \
+                + carry[15:]
+            carry, _ = lax.scan(event_step, carry, events)
+        stalled = (carry[18] >= 0) if single_round_closure else jnp.int32(0)
         flags = jnp.stack([carry[6].astype(jnp.int32),   # failed
                            carry[8].astype(jnp.int32),   # overflow
                            carry[11],                    # peak configs
-                           carry[14]])                   # events consumed
+                           carry[14],                    # events consumed
+                           # pending return still unconverged: the host
+                           # MUST keep dispatching even when the cursor
+                           # passed the stream (its prune hasn't landed)
+                           jnp.asarray(stalled, jnp.int32)])
         return carry, flags
 
     return carry0, event_step, run_chunk

@@ -188,3 +188,14 @@ def bitset_jax(domain: int = 1024) -> JaxModel:
     return JaxModel(name="bitset", state_size=words,
                     init_state=np.zeros(words, np.int32),
                     step=step, encode_op=encode)
+
+
+@register_model("bitset-256")
+def bitset256_jax() -> JaxModel:
+    """256-element bitset: 8 state words instead of 32, keeping the
+    engine's variadic dedup sort narrow (wide sorts at large row counts
+    have crashed the TPU compiler) — the bench ceiling tier's model."""
+    m = bitset_jax(256)
+    return JaxModel(name="bitset-256", state_size=m.state_size,
+                    init_state=m.init_state, step=m.step,
+                    encode_op=m.encode_op)

@@ -6,22 +6,17 @@ sub-checkers in a bounded pmap, jepsen/src/jepsen/independent.clj:266-317):
 sub-histories become lanes of a vmapped engine, and lanes are sharded across
 the ``data`` mesh axis with pjit — no collectives needed, pure SPMD fan-out.
 
-**Watchdog bounding (round-4).**  A vmapped dispatch's wall-clock is the sum
-over scan steps of the *slowest lane's* closure work at that step, times the
-batched per-iteration cost (~all lanes' sorts fused).  Round 3 ran lanes
-with an unlimited work budget and a near-full-history chunk; one dispatch
-over 96 lanes outlived the TPU worker's ~60 s watchdog and killed the bench
-tier.  Two bounds now apply:
-
-- the chunk shrinks with the batch size (``_batch_chunk``), so the number
-  of scan steps — each of which can carry some lane's closure — divides
-  the per-dispatch work across more, shorter programs; and
-- each lane carries the capacity- and batch-scaled closure budget
-  (``wgl_tpu.closure_budget`` semantics): a lane that runs out pauses
-  mid-closure and the host resumes it from its per-lane ``consumed``
-  counter — lanes advance at *independent* positions via device-side
-  dynamic slicing, so one deep lane no longer holds a whole dispatch
-  hostage.
+**Watchdog bounding (round-4).**  Under vmap, ``lax.cond``/``switch``
+execute EVERY branch for the whole batch, so the standard engine's
+fixpoint loops and multi-width merges multiply into per-step costs that
+outrun the TPU worker's ~60 s watchdog (the round-2/3 batch-tier killer).
+The batched engine therefore runs in *single-round* mode
+(``make_engine(single_round_closure=True)``): exactly one fixed-width
+merge per scan step, a pending-return register continuing multi-round
+closures across steps, and each lane's step gathering its next event by
+the lane's own absolute ``consumed`` cursor — per-step device work is a
+constant, a dispatch's wall-clock is bounded by its step count alone,
+and lanes progress at fully independent rates with no idle steps.
 """
 
 from __future__ import annotations
@@ -31,13 +26,11 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import (EV_NOP, closure_budget,
-                                        events_array, ghost_words,
-                                        make_engine)
+from jepsen_tpu.checker.wgl_tpu import (EV_NOP, events_array,
+                                        ghost_words, make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
@@ -106,10 +99,10 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
     """One vmapped pass over a set of lanes at a fixed capacity.  Returns a
     result per lane, or None where the lane overflowed (caller escalates).
 
-    Lanes progress at independent event positions: each dispatch slices a
-    per-lane chunk at that lane's position device-side, and the per-lane
-    ``consumed`` flag advances it — a budget-paused lane simply consumes
-    fewer events that dispatch (wgl_tpu's mid-chunk resume, vmapped)."""
+    Each dispatch runs a fixed number of single-round steps; a lane's step
+    gathers the event at the lane's own absolute ``consumed`` cursor, so
+    lanes progress at fully independent rates and the host just re-invokes
+    until every lane's cursor passes its stream (or fails/overflows)."""
     b = len(preps)
     bpad = b
     if mesh is not None:
@@ -117,10 +110,11 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
         bpad = ((b + n - 1) // n) * n
     cc = chunk if chunk else _batch_chunk(bpad, longest)
     evs = [events_array(p, cc) for p in preps]
-    emax = max(e.shape[0] for e in evs)
-    # One chunk-sized NOP cushion so any in-bounds resume offset slices a
-    # full chunk without clamping back into real events.
-    batch = np.zeros((bpad, emax + cc, 10), np.int32)
+    # >= 1 trailing NOP row per lane: finished lanes' cursors clamp onto
+    # it (the gather-based engine reads events by each lane's absolute
+    # consumed cursor; see wgl_tpu run_chunk's single-round variant).
+    emax = max(e.shape[0] for e in evs) + 1
+    batch = np.zeros((bpad, emax, 10), np.int32)
     batch[:, :, 0] = EV_NOP
     for i, e in enumerate(evs):
         batch[i, :e.shape[0]] = e
@@ -136,31 +130,27 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
             carry)
         batch_dev = jax.device_put(
             jnp.asarray(batch), NamedSharding(mesh, P(axis, None, None)))
-        pos_sharding = NamedSharding(mesh, P(axis))
     else:
         batch_dev = jnp.asarray(batch)
-        pos_sharding = None
 
     lane_len = np.array([e.shape[0] for e in evs]
                         + [0] * (bpad - b), np.int32)
-    pos = np.zeros(bpad, np.int32)
     failed = np.zeros(bpad, bool)
     overflow = np.zeros(bpad, bool)
     while True:
-        active = ~failed & ~overflow & (pos < lane_len)
-        if not active.any():
-            break
-        pos_dev = jnp.asarray(pos)
-        if pos_sharding is not None:
-            pos_dev = jax.device_put(pos_dev, pos_sharding)
-        carry, flags = vrun(carry, batch_dev, pos_dev)
-        fl = np.asarray(flags)              # [bpad, 4]
+        carry, flags = vrun(carry, batch_dev)
+        fl = np.asarray(flags)              # [bpad, 5]
         failed = fl[:, 0].astype(bool)
         overflow = fl[:, 1].astype(bool)
-        # A lane is done once its position passes its real events (the
-        # tail beyond lane_len is the NOP cushion); clamping there keeps
-        # finished lanes' positions stable across further dispatches.
-        pos = np.minimum(pos + fl[:, 3], lane_len)
+        consumed = fl[:, 3]                 # absolute per-lane cursors
+        stalled = fl[:, 4].astype(bool)     # unconverged pending return
+        # A lane whose cursor passed its stream may STILL have its final
+        # return's closure in flight (consume-on-arrival): it stays live
+        # until the stalled flag clears, or its prune could be dropped —
+        # a false "valid" on a refuting final return.
+        if not (~failed & ~overflow
+                & ((consumed < lane_len) | stalled)).any():
+            break
 
     failed_op = np.asarray(carry[7])[:b]
     explored = np.asarray(carry[9])[:b]
@@ -180,24 +170,23 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
 
 def _batched_runner(model: JaxModel, window: int, capacity: int,
                     gwords: int, chunk: int, bpad: int):
-    # Per-lane closure budget, scaled down by the batch size: a vmapped
-    # closure iteration costs ~bpad single-lane iterations (every lane's
-    # block merges run, masked or not), so the budget divides by
-    # (capacity * bpad) to keep one dispatch's wall-clock at the same
-    # bound as the single-history engine.
-    budget = closure_budget(capacity * bpad)
     key = ("batchv", model.name, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
-           gwords, chunk, bpad, budget)
+           gwords, chunk, bpad)
     if key in _CACHE:
         return _CACHE[key]
+    # single_round_closure: under vmap every cond/switch branch executes
+    # for the whole batch, so the batched engine runs exactly ONE closure
+    # round (one fixed-width merge) per scan step — per-step device work
+    # is constant, a dispatch's wall-clock is bounded by the step count
+    # alone, and no iteration budget is needed (work_budget=0).  Each
+    # lane's step gathers its next event by the lane's own absolute
+    # consumed cursor, so lanes progress at fully independent rates with
+    # no idle steps.
     carry0, _, run_chunk = make_engine(model, window, capacity,
-                                       gwords=gwords, work_budget=budget)
-
-    def run_lane(carry, ev_all, p):
-        ev = lax.dynamic_slice_in_dim(ev_all, p, chunk)
-        return run_chunk(carry, ev)
-
-    vrun = jax.jit(jax.vmap(run_lane, in_axes=(0, 0, 0)))
+                                       gwords=gwords, work_budget=0,
+                                       single_round_closure=True,
+                                       steps_per_dispatch=chunk)
+    vrun = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0)))
     _CACHE[key] = (carry0, vrun)
     return _CACHE[key]

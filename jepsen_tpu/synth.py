@@ -146,6 +146,45 @@ def ghost_write_burst(k: int, start_process: int = 2000,
     return out
 
 
+def bitset_ceiling_history(k: int, n_clean: int = 200,
+                           concurrency: int = 4,
+                           domain_off: int = 32) -> History:
+    """``k`` crashed ``add`` ops on a grow-only bitset + a clean stream.
+
+    A register's state only remembers the LAST linearized value, so ghost
+    subset-subsumption collapses any crashed-write pileup to an O(k)
+    antichain — a register history cannot exercise a capacity ceiling
+    once the engine's dedup is doing its job.  A bitset's state IS the
+    linearized subset: ``k`` crashed adds of distinct elements give 2^k
+    genuinely distinct (mask, state) configurations that neither class
+    canonicalization nor subset-subsumption can merge (every state
+    differs).  The clean tail (adds/reads of elements outside the ghost
+    range, overlapped ``concurrency`` wide) forces closures that
+    materialize the subsets until any capacity ladder overflows."""
+    ops: List[Op] = [Op(process=3000 + i, type=INVOKE, f="add", value=i)
+                     for i in range(k)]
+    ops += [Op(process=3000 + i, type=INFO, f="add", value=None)
+            for i in range(k)]
+    pend: List[Op] = []
+    for j in range(n_clean):
+        p = j % concurrency
+        if len(pend) == concurrency:
+            for q in pend:
+                ops.append(Op(process=q.process, type=OK, f=q.f,
+                              value=q.value))
+            pend = []
+        if j % 3 == 2:
+            op = Op(process=p, type=INVOKE, f="read",
+                    value=(domain_off + j - 2, 1))
+        else:
+            op = Op(process=p, type=INVOKE, f="add", value=domain_off + j)
+        ops.append(op)
+        pend.append(op)
+    for q in pend:
+        ops.append(Op(process=q.process, type=OK, f=q.f, value=q.value))
+    return History(ops, reindex=True)
+
+
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
                   values: int = 5,
                   within: float | None = None) -> History:

@@ -169,6 +169,27 @@ class TestSharded:
         for n_lanes, cap in calls[1:]:
             assert n_lanes < 4 and cap > 32
 
+    def test_batch_final_refuting_return_at_exact_chunk(self, model):
+        # The lane's LAST event is a refuting RETURN and the stream length
+        # is an exact chunk multiple: with consume-on-arrival semantics
+        # the cursor reaches lane_len while the return's closure is still
+        # in flight, so the host must keep dispatching on the stalled
+        # flag — or the final prune is dropped and the refutation reads
+        # as valid (the round-4 review's unsoundness finding).
+        from jepsen_tpu.checker.prep import prepare
+        base = cas_register_history(90, concurrency=5, crash_p=0.0, seed=3)
+        ops = list(base)
+        last_read = max(j for j, o in enumerate(ops)
+                        if o.type == "ok" and o.f == "read")
+        ops = ops[:last_read + 1]
+        ops[last_read] = ops[last_read].with_(value=9999)
+        h = History(ops, reindex=True)
+        cc = len(prepare(h, model))
+        rs = check_batch(model, [h], capacity=64, chunk=cc)
+        assert rs[0]["valid"] is False, rs
+        c = wgl_cpu.check(CASRegister(), h)
+        assert rs[0]["op"]["index"] == c["op"]["index"]
+
     def test_batch_tiny_budget_lanes_advance_independently(self, model,
                                                            monkeypatch):
         # Floor-sized per-lane budgets force repeated budget pauses; lanes

@@ -197,6 +197,100 @@ class TestClosureWorkBudget:
         from jepsen_tpu.checker.wgl_tpu import closure_budget
         assert closure_budget(1024) > closure_budget(16384) >= 16
 
+    def test_register_ghost_pileup_collapses_to_antichain(self):
+        # A register's state only remembers the last linearized value, so
+        # subset subsumption collapses a crashed-write pileup to an O(k)
+        # antichain — the delta closure concludes where the round-3 eager
+        # closure overflowed.  (This is why the bench ceiling tier moved
+        # to the bitset model.)
+        from jepsen_tpu.synth import cas_register_history, ghost_write_burst
+        model = get_model("cas-register")
+        h = History(ghost_write_burst(10)
+                    + list(cas_register_history(60, concurrency=4,
+                                                crash_p=0.0, seed=3)),
+                    reindex=True)
+        r = wgl_tpu.check(model, h, capacity=256, chunk=64,
+                          max_capacity=4096)
+        assert r["valid"] is True, r
+        assert r["max-capacity-reached"] <= 1024, r
+
+    def test_bitset_ghost_pileup_is_incompressible(self):
+        # The bitset's state IS the linearized subset: 2^k genuinely
+        # distinct configurations that no subsumption can merge — the
+        # capacity ceiling degrades to unknown (the ceiling tier's claim).
+        from jepsen_tpu.synth import bitset_ceiling_history
+        model = get_model("bitset-256")
+        h = bitset_ceiling_history(12, n_clean=60)
+        r = wgl_tpu.check(model, h, capacity=128, chunk=64,
+                          max_capacity=1024)
+        assert r["valid"] == "unknown", r
+        # and a small pileup concludes once capacity covers 2^k
+        h6 = bitset_ceiling_history(6, n_clean=60)
+        r6 = wgl_tpu.check(model, h6, capacity=256, chunk=64,
+                           max_capacity=4096)
+        assert r6["valid"] is True, r6
+
+    def test_mutex_differential_random(self):
+        # Delta-closure soundness on a second model family: random lock
+        # histories from a simulated correct lock service must verify, and
+        # a double-granted acquire must refute — both agreeing with the
+        # CPU oracle.  (The CAS differential suite can't exercise the
+        # mutex step function's refusal patterns.)
+        import random as _random
+        from jepsen_tpu.history import INVOKE, OK, Op
+
+        def mutex_history(sessions, procs, seed, corrupt=False):
+            rng = _random.Random(seed)
+            ops, holder, waiting = [], None, []
+            pending = {p: 0 for p in range(procs)}  # 0 idle 1 wait 2 held
+            remaining = sessions
+            while remaining > 0 or holder is not None or waiting:
+                choices = []
+                if remaining > 0:
+                    idle = [p for p in pending if pending[p] == 0]
+                    if idle:
+                        choices.append("invoke")
+                if holder is None and waiting:
+                    choices.append("grant")
+                if holder is not None:
+                    choices.append("release")
+                act = rng.choice(choices)
+                if act == "invoke":
+                    p = rng.choice([p for p in pending if pending[p] == 0])
+                    ops.append(Op(process=p, type=INVOKE, f="acquire"))
+                    pending[p] = 1
+                    waiting.append(p)
+                    remaining -= 1
+                elif act == "grant":
+                    p = waiting.pop(0)
+                    ops.append(Op(process=p, type=OK, f="acquire"))
+                    pending[p] = 2
+                    holder = p
+                    if corrupt and waiting and rng.random() < 0.5:
+                        # the bug: grant a second waiter while held
+                        q = waiting.pop(0)
+                        ops.append(Op(process=q, type=OK, f="acquire"))
+                        pending[q] = 2
+                else:  # release
+                    p = holder
+                    ops.append(Op(process=p, type=INVOKE, f="release"))
+                    ops.append(Op(process=p, type=OK, f="release"))
+                    pending[p] = 0
+                    holder = None
+            return History(ops)
+
+        model = get_model("mutex")
+        from jepsen_tpu.models.collections import Mutex
+        for seed in range(6):
+            h = mutex_history(30, 4, seed)
+            r = wgl_tpu.check(model, h, capacity=64, chunk=64)
+            c = wgl_cpu.check(Mutex(), h)
+            assert r["valid"] == c["valid"] is True, (seed, r, c)
+        bad = mutex_history(30, 4, 99, corrupt=True)
+        r = wgl_tpu.check(model, bad, capacity=64, chunk=64, explain=False)
+        c = wgl_cpu.check(Mutex(), bad)
+        assert r["valid"] == c["valid"] is False, (r, c)
+
     def test_mid_closure_pause_resume(self, monkeypatch):
         # Budget of ONE fixpoint iteration per dispatch: every closure
         # needing more must pause mid-closure (partial set kept, dirty
