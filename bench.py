@@ -277,13 +277,13 @@ def tier_easy():
 
 
 def tier_hard():
-    # One timed run (disclosed): the burst region genuinely needs capacity
-    # 16384 for most of the stream (~2-3 s per 32-event dispatch measured on
-    # hardware), so a second run would double a ~15-25 min tier for no new
-    # information — compiles are already excluded via warm_shapes.
+    # Two timed runs: the delta closure brought this tier from ~119 s
+    # (round 3) to ~38 s, so a second sample is affordable — closing the
+    # round-3 review's "the tier that carries the TPU-advantage story has
+    # a single sample" gap.  Compiles are excluded via warm_shapes.
     hard_cap = 4096 if SMOKE else 65536
     r, walls, meta = _device_tier(build_hard(), capacity=1024,
-                                  max_capacity=hard_cap, runs=1)
+                                  max_capacity=hard_cap, runs=2)
     emit({"runs": walls, "valid": r["valid"],
           "configs_explored": r.get("configs-explored"),
           "max_capacity_reached": r.get("max-capacity-reached"),
