@@ -78,12 +78,15 @@ LOOKAHEAD = 2
 # ~60 s watchdog.  Instead each chunk carries an iteration budget
 # (CLOSURE_WORK_BUDGET / capacity); when it runs out the remaining events
 # gate to no-ops, the flags report how many events were really consumed,
-# and the host resumes mid-chunk with a fresh budget.  (3M with the delta
-# closure's compacted merges ~ the wall-clock the block closure bought at
-# 1M: per-iteration cost dropped ~4x, so the same watchdog margin affords
-# more iterations per dispatch — measured easy-tier 7.9 s vs 8.1 s at 1M,
-# with fewer discarded speculative dispatches at escalated capacities.)
-CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "3000000"))
+# and the host resumes mid-chunk with a fresh budget.  (4M with the delta
+# closure's compacted merges: per-iteration cost dropped ~4x vs the block
+# closure, so the same watchdog margin affords more iterations per
+# dispatch — fewer budget pauses means fewer discarded speculative
+# dispatches; measured easy-tier 7.5 s vs 7.8 s at 3M, hard tier
+# unchanged.  At capacity 65536 this is 61 iterations/dispatch, which
+# stays inside the watchdog even when rounds take the full-grid fallback
+# merge.)
+CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "4000000"))
 
 
 def closure_budget(capacity: int) -> int:
