@@ -155,6 +155,27 @@ class MultiRegister(Model):
 
 # -- bounded-domain set, device tier ---------------------------------------
 
+
+@dataclass(frozen=True)
+class BitSetModel(Model):
+    """Host-tier oracle for the device bitset: grow-only int set with
+    single-element membership reads (f=read value=(k, present))."""
+
+    items: FrozenSet[int] = frozenset()
+
+    def step(self, op: Op):
+        if op.f == "add":
+            return BitSetModel(self.items | {int(op.value)})
+        if op.f == "read":
+            k, present = op.value
+            if bool(present) == (int(k) in self.items):
+                return self
+            return inconsistent(
+                f"read ({k}, {present}) but membership is "
+                f"{int(k) in self.items}")
+        return inconsistent(f"unknown f {op.f!r}")
+
+
 F_ADD, F_READBIT = 0, 1
 
 
@@ -187,7 +208,8 @@ def bitset_jax(domain: int = 1024) -> JaxModel:
 
     return JaxModel(name="bitset", state_size=words,
                     init_state=np.zeros(words, np.int32),
-                    step=step, encode_op=encode)
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: BitSetModel())
 
 
 @register_model("bitset-256")
@@ -198,4 +220,4 @@ def bitset256_jax() -> JaxModel:
     m = bitset_jax(256)
     return JaxModel(name="bitset-256", state_size=m.state_size,
                     init_state=m.init_state, step=m.step,
-                    encode_op=m.encode_op)
+                    encode_op=m.encode_op, cpu_model=m.cpu_model)

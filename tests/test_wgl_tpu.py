@@ -214,6 +214,33 @@ class TestClosureWorkBudget:
         assert r["valid"] is True, r
         assert r["max-capacity-reached"] <= 1024, r
 
+    def test_bitset_differential_with_host_oracle(self):
+        # The bitset model's host-tier oracle (BitSetModel): device and
+        # CPU engines must agree on membership-read histories, including
+        # a corrupted present-claim.
+        from jepsen_tpu.history import INVOKE, OK, Op
+        from jepsen_tpu.models.collections import BitSetModel
+        model = get_model("bitset-256")
+
+        def ops(*specs):
+            out = []
+            for p, f, v in specs:
+                out.append(Op(process=p, type=INVOKE, f=f, value=v))
+                out.append(Op(process=p, type=OK, f=f, value=v))
+            return out
+
+        good = History(ops((0, "add", 3), (1, "add", 9),
+                           (0, "read", (3, 1)), (1, "read", (5, 0))))
+        r = wgl_tpu.check(model, good, capacity=32, chunk=16)
+        c = wgl_cpu.check(BitSetModel(), good)
+        assert r["valid"] == c["valid"] is True, (r, c)
+        bad = History(ops((0, "add", 3), (0, "read", (5, 1))))
+        r2 = wgl_tpu.check(model, bad, capacity=32, chunk=16,
+                           explain=False)
+        c2 = wgl_cpu.check(BitSetModel(), bad)
+        assert r2["valid"] == c2["valid"] is False, (r2, c2)
+        assert r2["op"]["index"] == c2["op"]["index"]
+
     def test_bitset_ghost_pileup_is_incompressible(self):
         # The bitset's state IS the linearized subset: 2^k genuinely
         # distinct configurations that no subsumption can merge — the
