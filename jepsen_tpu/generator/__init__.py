@@ -633,6 +633,13 @@ class EachThread(_Wrap):
             sub = ctx.restrict([t])
             r = g.op(test, sub)
             if r is None:
+                # the thread's copy is exhausted: RECORD that, or a copy
+                # that dies on its first draw keeps _gen_for returning the
+                # prototype and all_done never fires — each_thread of an
+                # immediately-empty generator then pends forever
+                cur = cur._copy()
+                cur.started.add(t)
+                cur.per[t] = None
                 continue
             v, g2 = r
             if v is PENDING:

@@ -493,7 +493,68 @@ def crash_client_gen(opts: Optional[Dict[str, Any]] = None):
     return gen.stagger(interval / conc, gen.repeat({"f": "crash"}))
 
 
+class KafkaStats(Checker):
+    """Wraps the standard Stats checker but never invalidates over
+    ``crash`` / ``debug-topic-partitions`` ops, which by design never
+    complete ok (kafka.clj:2089-2104 stats-checker)."""
+
+    def __init__(self, inner=None):
+        from jepsen_tpu.checker.core import Stats
+        self.inner = inner or Stats()
+
+    def check(self, test, history: History, opts=None):
+        res = self.inner.check(test, history, opts)
+        if res.get("valid") is True:
+            return res
+        by_f = dict(res.get("by-f") or {})
+        by_f.pop("crash", None)
+        by_f.pop("debug-topic-partitions", None)
+        bad = [f for f, c in by_f.items()
+               if not c.get(OK, 0) and (c.get(FAIL, 0) or c.get(INFO, 0))]
+        if not bad:
+            out = {**res, "valid": True,
+                   "note": "only crash/debug-topic-partitions lack oks "
+                           "(they never complete ok by design)"}
+            out.pop("error", None)  # the inner checker's stale complaint
+            return out
+        return res
+
+
+def allowed_error_types(test, sub_via=None, ww_deps=None) -> set:
+    """Anomaly types that do NOT invalidate the test
+    (kafka.clj:2019-2047 allowed-error-types): int-send-skip and G0 are
+    normal in the Kafka transactional model (writes are never isolated);
+    with subscribe in play, rebalances legitimately skip/rewind polls;
+    with ww edges in the dependency graph, t0 <ww t1 <wr t0 cycles (G1c)
+    are expected for the same lack of write isolation.  Explicit args
+    (from the workload's configuration) win over test-map keys."""
+    test = test or {}
+    if sub_via is None:
+        sub_via = test.get("sub_via", ("subscribe", "assign"))
+    if ww_deps is None:
+        ww_deps = test.get("ww_deps", True)
+    allowed = {"int-send-skip", "G0", "process-G0"}
+    if "subscribe" in tuple(sub_via):
+        allowed |= {"poll-skip", "nonmonotonic-poll"}
+    if ww_deps:
+        allowed |= {"G1c", "process-G1c"}
+    return allowed
+
+
 class KafkaChecker(Checker):
+    def __init__(self, sub_via=None, ww_deps=None):
+        # workload-configured semantics: which error types are allowed
+        # (allowed_error_types) and whether ww edges join the dependency
+        # graph at all (kafka.clj's :ww-deps).  None = read the test map /
+        # defaults at check time.
+        self.sub_via = sub_via
+        self.ww_deps = ww_deps
+
+    def _ww_deps(self, test) -> bool:
+        if self.ww_deps is not None:
+            return bool(self.ww_deps)
+        return bool((test or {}).get("ww_deps", True))
+
     def check(self, test, history: History, opts=None):
         sends_ok: Dict[Tuple[Any, int], Any] = {}   # (k, offset) -> value
         send_of_value: Dict[Tuple[Any, Any], int] = {}  # (k, value) -> offset
@@ -675,7 +736,7 @@ class KafkaChecker(Checker):
         # elle-style cycle pass, kafka.clj:110-2049) — catches cycles the
         # per-mop offset/order analyses above cannot (e.g. two txns each
         # polling the other's send: G1c on the log).
-        cycles = _graph_pass(history)
+        cycles = _graph_pass(history, ww_deps=self._ww_deps(test))
         for c in cycles:
             anomalies[c["type"]].append(c)
 
@@ -701,8 +762,13 @@ class KafkaChecker(Checker):
                 worst_by_key[d["key"]] = d
 
         cc = consume_counts(history)
-        res = {"valid": (UNKNOWN if (not hard and unseen and n_polls == 0)
-                         else not hard),
+        allowed = allowed_error_types(test, sub_via=self.sub_via,
+                                      ww_deps=self._ww_deps(test))
+        bad = sorted(t for t in hard if t not in allowed)
+        res = {"valid": (UNKNOWN if (not bad and unseen and n_polls == 0)
+                         else not bad),
+               "bad-error-types": bad,
+               "allowed-error-types": sorted(allowed),
                "anomaly-types": sorted(hard),
                "anomalies": {k: v[:8] for k, v in hard.items()},
                "anomalies-full": hard,
@@ -757,13 +823,17 @@ class KafkaChecker(Checker):
             pass
 
 
-def _graph_pass(history: History) -> List[Dict[str, Any]]:
+def _graph_pass(history: History,
+                ww_deps: bool = True) -> List[Dict[str, Any]]:
     """Elle-style dependency cycles over the log (kafka.clj:110-2049).
 
     Edges between OK transactions:
       ww      — writer of a partition's offset -> writer of the next known
                 offset of that partition (the log's version order is the
-                offset order, so this is exact);
+                offset order, so this is exact); OMITTED when ``ww_deps``
+                is false — the reference drops ww edges from the graph
+                entirely in that mode, it doesn't just whitelist the
+                cycles they close;
       wr      — writer of (k, offset) -> each txn that polled that record
                 (self-reads of a txn's own sends are precommitted reads,
                 legitimate, and excluded with all self-edges);
@@ -795,15 +865,16 @@ def _graph_pass(history: History) -> List[Dict[str, Any]]:
     for tid in range(len(oks)):
         g.add_node(tid)
     # ww: offset order of each partition, over offsets with known writers
-    by_part: Dict[Any, List[int]] = defaultdict(list)
-    for (k, o) in writer_of:
-        by_part[k].append(o)
-    for k, offs in by_part.items():
-        offs.sort()
-        for o1, o2 in zip(offs, offs[1:]):
-            a, b = writer_of[(k, o1)], writer_of[(k, o2)]
-            if a != b:
-                g.add_edge(a, b, "ww")
+    if ww_deps:
+        by_part: Dict[Any, List[int]] = defaultdict(list)
+        for (k, o) in writer_of:
+            by_part[k].append(o)
+        for k, offs in by_part.items():
+            offs.sort()
+            for o1, o2 in zip(offs, offs[1:]):
+                a, b = writer_of[(k, o1)], writer_of[(k, o2)]
+                if a != b:
+                    g.add_edge(a, b, "ww")
     # wr: sender -> poller of the same record
     for tid, (_, op) in enumerate(oks):
         for mop in op.value:
@@ -970,7 +1041,7 @@ def workload(partitions: int = 4, sub_via=("subscribe", "assign"),
     and an optional crash-client schedule."""
     if not reference_shape:
         return {"generator": generator(partitions),
-                "checker": KafkaChecker()}
+                "checker": KafkaChecker(sub_via=sub_via)}
     offsets: Dict[Any, int] = {}
     g = txn_generator(keys=partitions)
     g = tag_rw(g)
@@ -982,7 +1053,11 @@ def workload(partitions: int = 4, sub_via=("subscribe", "assign"),
                               "concurrency": concurrency})
     if crash is not None:
         g = gen.any_gen(g, crash)
+    # each worker runs its OWN crash/assign/poll catch-up cycle
+    # (kafka.clj:2142 wraps final-polls in gen/each-thread) — otherwise
+    # the assign lands on one worker and the polls on another, and
+    # coverage of the log is accidental
     return {"generator": g,
-            "final_generator": final_polls(offsets),
+            "final_generator": gen.each_thread(final_polls(offsets)),
             "tracked_offsets": offsets,
-            "checker": KafkaChecker()}
+            "checker": KafkaChecker(sub_via=sub_via)}

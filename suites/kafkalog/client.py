@@ -1,0 +1,132 @@
+"""kafkalog wire client: executes the kafka workload's op language against
+the real log server.
+
+Consumer positions live here (kafka's assign/seek/poll shape): assign and
+subscribe both take ownership of the listed partitions and seek to the
+log end (or the beginning when the final-polls catch-up phase asks via
+``op.extra["seek_to_beginning"]``).  ``crash`` completes :info so the
+interpreter burns the process and opens a fresh client — kafka.clj's
+crash-client semantics.
+
+Error discipline: connect failures are FAIL (nothing was sent);
+mid-flight failures are INFO for txns containing sends (they may have
+landed — the checker's recovered-:info machinery takes over) and FAIL for
+pure polls."""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, Optional, Set
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu.history import FAIL, INFO, OK, Op
+
+from suites.kafkalog.server import recv_frame, send_frame
+
+
+class ConnectFailed(Exception):
+    pass
+
+
+class Conn:
+    def __init__(self, port: int, timeout: float = 3.0):
+        self.port = port
+        self.timeout = timeout
+        self.sock = None
+
+    def call(self, msg):
+        if self.sock is None:
+            try:
+                self.sock = socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=self.timeout)
+            except OSError as e:
+                raise ConnectFailed(str(e)) from e
+        try:
+            send_frame(self.sock, msg)
+            reply = recv_frame(self.sock)
+        except OSError:
+            self.close()
+            raise
+        if reply is None:
+            self.close()
+            raise ConnectionError("server closed connection")
+        return reply
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+
+class KafkaLogClient(jclient.Client):
+    def __init__(self, conn: Optional[Conn] = None):
+        self.conn = conn
+        self.owned: Set[int] = set()
+        self.positions: Dict[int, int] = {}
+
+    def open(self, test, node):
+        return KafkaLogClient(Conn(test["kafkalog_ports"][node]))
+
+    def _seek(self, keys, to_beginning: bool) -> None:
+        self.owned = set(keys)
+        if to_beginning:
+            self.positions = {k: 0 for k in self.owned}
+            return
+        ends = self.conn.call({"op": "end_offsets",
+                               "keys": sorted(self.owned)})["ends"]
+        self.positions = {int(k): int(v) for k, v in ends.items()}
+
+    def invoke(self, test, op: Op) -> Op:
+        sent_any = False
+        try:
+            if op.f in ("assign", "subscribe"):
+                self._seek(op.value or [],
+                           bool(op.extra.get("seek_to_beginning")))
+                return op.with_(type=OK)
+            if op.f == "crash":
+                # deliberate client crash: the process burns, a fresh
+                # client (fresh positions) opens for its successor
+                return op.with_(type=INFO, error="crashed by request")
+            if op.f == "debug-topic-partitions":
+                ends = self.conn.call({"op": "end_offsets",
+                                       "keys": sorted(op.value or [])})
+                return op.with_(type=OK, value=ends["ends"])
+            if not isinstance(op.value, (list, tuple)):
+                return op.with_(type=FAIL, error="not a txn op")
+            out = []
+            for mop in op.value:
+                if mop[0] == "send":
+                    r = self.conn.call({"op": "send", "key": mop[1],
+                                        "value": mop[2]})
+                    sent_any = True
+                    out.append(["send", mop[1], [r["offset"], mop[2]]])
+                else:  # poll
+                    pos = {k: self.positions.get(k, 0)
+                           for k in sorted(self.owned)}
+                    r = self.conn.call({"op": "poll", "positions": pos,
+                                        "max": 6})
+                    recs = {int(k): v for k, v in r["records"].items()}
+                    for k, rows in recs.items():
+                        if rows:
+                            self.positions[k] = rows[-1][0] + 1
+                    out.append(["poll", recs])
+            return op.with_(type=OK, value=out)
+        except ConnectFailed as e:
+            # nothing of THIS op was sent... unless an earlier mop already
+            # landed (reconnect happens per call): sends may have applied
+            if sent_any:
+                return op.with_(type=INFO, error=str(e))
+            return op.with_(type=FAIL, error=str(e))
+        except (OSError, socket.timeout, ConnectionError) as e:
+            mops = op.value if isinstance(op.value, (list, tuple)) else []
+            has_send = any(isinstance(m, (list, tuple)) and m
+                           and m[0] == "send" for m in mops)
+            return op.with_(type=INFO if (sent_any or has_send) else FAIL,
+                            error=str(e))
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()

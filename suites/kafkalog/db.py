@@ -1,0 +1,79 @@
+"""kafkalog DB layer: the real log daemon's lifecycle (localkv's
+patterns: pidfiles, marker grepkill, WAL snarfing)."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+from jepsen_tpu import db as jdb
+from jepsen_tpu.control import session
+from jepsen_tpu.control import util as cu
+
+SERVER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "server.py")
+
+
+def port_of(test, node: str) -> int:
+    return test["kafkalog_ports"][node]
+
+
+def marker(test, node: str) -> str:
+    return f"kafkalog-{node}-p{port_of(test, node)}"
+
+
+def data_dir(test, node: str) -> str:
+    return os.path.join(test.get("kafkalog_dir", "/tmp/jepsen-kafkalog"),
+                        marker(test, node))
+
+
+class KafkaLogDB(jdb.DB, jdb.Kill, jdb.Pause, jdb.LogFiles):
+    def setup(self, test, node):
+        s = session(test, node)
+        s.exec("mkdir", "-p", data_dir(test, node))
+        self.start(test, node)
+        cu.await_tcp_port(s, port_of(test, node), timeout_s=30)
+
+    def teardown(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        cu.stop_daemon(s, os.path.join(d, "server.pid"))
+        cu.grepkill(s, marker(test, node))
+        if not test.get("leave_db_running"):
+            s.exec("rm", "-rf", d)
+
+    def start(self, test, node):
+        s = session(test, node)
+        d = data_dir(test, node)
+        args = [SERVER,
+                "--node", node,
+                "--port", str(port_of(test, node)),
+                "--data", d,
+                "--marker", marker(test, node)]
+        if test.get("kafkalog_no_fsync"):
+            args.append("--no-fsync")
+        dup = float(test.get("kafkalog_dup_sends", 0.0))
+        if dup:
+            args += ["--dup-sends", str(dup)]
+        # PYTHONPATH emptied: the harness env's sitecustomize costs ~2 s
+        # per interpreter start (see suites/localkv/db.py)
+        cu.start_daemon(s, sys.executable, *args,
+                        pidfile=os.path.join(d, "server.pid"),
+                        logfile=os.path.join(d, "server.log"),
+                        env={"PYTHONPATH": ""})
+
+    def kill(self, test, node):
+        s = session(test, node)
+        cu.grepkill(s, marker(test, node))
+        s.exec("rm", "-f", os.path.join(data_dir(test, node), "server.pid"))
+
+    def pause(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill(session(test, node), marker(test, node), signal="CONT")
+
+    def log_files(self, test, node) -> List[str]:
+        d = data_dir(test, node)
+        return [os.path.join(d, "server.log"), os.path.join(d, "log.wal")]

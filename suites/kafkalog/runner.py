@@ -1,0 +1,124 @@
+"""kafkalog suite CLI — the kafka workload end-to-end against a real
+partitioned log daemon.
+
+    python -m suites.kafkalog.runner test --time-limit 8
+    python -m suites.kafkalog.runner test --nemesis kill --no-fsync
+
+Default mode must verify (fsync'd WAL: kills cost availability, never
+acked records).  ``--no-fsync`` loses the acked tail on SIGKILL and later
+sends re-use the lost offsets — the kafka checker's lost-write /
+inconsistent-offsets analyses must refute it.  ``--dup-sends`` seeds
+double-applied sends the duplicate analysis must catch.
+
+The generator is the REFERENCE pipeline (kafka.clj:2106): list-append
+txns rewritten to send/poll, subscribe interleaving, unseen-chasing,
+offset tracking, and a final-polls catch-up phase that crashes clients,
+assigns from the beginning, and polls until every tracked offset has
+been observed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu import cli, generator as gen
+from jepsen_tpu.checker import compose
+from jepsen_tpu.checker.perf import Perf
+from jepsen_tpu.checker.timeline import Timeline
+from jepsen_tpu.control import DummyRemote
+from jepsen_tpu.nemesis import combined
+from jepsen_tpu.workloads import kafka
+from jepsen_tpu.workloads.kafka import KafkaStats
+
+from suites.localkv.runner import free_ports
+from suites.kafkalog.client import KafkaLogClient
+from suites.kafkalog.db import KafkaLogDB
+
+
+def NEMESES(name, opts):
+    if name == "none":
+        return combined.Package()
+    if name == "kill":
+        return combined.db_package({**opts, "faults": ["kill"]})
+    if name == "pause":
+        return combined.db_package({**opts, "faults": ["pause"]})
+    raise KeyError(name)
+
+
+NEMESIS_NAMES = ("none", "kill", "pause")
+
+
+def kafkalog_test(opts: Dict[str, Any]) -> Dict[str, Any]:
+    # Single broker: every client talks to ONE log daemon (the reference's
+    # kafka workload likewise drives one cluster through many clients).
+    # Multiple nodes would be multiple INDEPENDENT logs, and the offset
+    # analyses would correctly — but meaninglessly — refute the overlap.
+    nodes = (opts.get("nodes") or ["n1"])[:1]
+    ports = free_ports(len(nodes))
+    nemesis_name = opts.get("nemesis", "none")
+    pkg = NEMESES(nemesis_name,
+                  {"interval": float(opts.get("nemesis_interval", 3.0))})
+
+    wl = kafka.workload(partitions=int(opts.get("partitions", 4)),
+                        reference_shape=True,
+                        concurrency=int(opts.get("concurrency", 4)))
+
+    time_limit = float(opts.get("time_limit", 8.0))
+    wgen = wl["generator"]
+    stagger_s = float(opts.get("stagger_s", 0.01))
+    if stagger_s > 0:
+        wgen = gen.stagger(stagger_s, wgen)
+    client_gen = gen.time_limit(time_limit, gen.clients(wgen))
+    parts = [client_gen]
+    if pkg.generator is not None:
+        parts = [gen.any_gen(client_gen,
+                             gen.nemesis(gen.time_limit(time_limit,
+                                                        pkg.generator)))]
+    if pkg.final_generator is not None:
+        parts.append(gen.synchronize(gen.nemesis(gen.lift(
+            pkg.final_generator))))
+    # the final-polls catch-up phase: crash, assign from the beginning,
+    # poll until every tracked offset is seen (bounded by its own window)
+    final_s = float(opts.get("final_time", 6.0))
+    parts.append(gen.synchronize(gen.time_limit(
+        final_s, gen.clients(gen.lift(wl["final_generator"])))))
+
+    return {**opts,
+            "name": "kafkalog"
+                    + ("-nofsync" if opts.get("no_fsync") else "")
+                    + (f"-dup" if opts.get("dup_sends") else "")
+                    + f"-{nemesis_name}",
+            "nodes": nodes,
+            "kafkalog_ports": dict(zip(nodes, ports)),
+            "kafkalog_no_fsync": bool(opts.get("no_fsync")),
+            "kafkalog_dup_sends": float(opts.get("dup_sends", 0.0)),
+            "remote": DummyRemote(),
+            "db": KafkaLogDB(),
+            "client": KafkaLogClient(),
+            "nemesis": pkg.nemesis,
+            "generator": parts,
+            "checker": compose({"stats": KafkaStats(),
+                                "workload": wl["checker"],
+                                "perf": Perf(),
+                                "timeline": Timeline()})}
+
+
+def _suite_opts(parser):
+    parser.add_argument("--nemesis", default="none",
+                        choices=sorted(NEMESIS_NAMES))
+    parser.add_argument("--nemesis-interval", type=float, default=3.0)
+    parser.add_argument("--partitions", type=int, default=4)
+    parser.add_argument("--no-fsync", action="store_true",
+                        help="ack before fsync: kills lose the acked tail "
+                             "(must be refuted)")
+    parser.add_argument("--dup-sends", type=float, default=0.0,
+                        help="probability a send applies twice (must be "
+                             "refuted)")
+    parser.add_argument("--stagger-s", type=float, default=0.01)
+    parser.add_argument("--final-time", type=float, default=6.0)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli.single_test_cmd(kafkalog_test, opt_fn=_suite_opts,
+                                 prog="jepsen-tpu-kafkalog"))

@@ -1,0 +1,203 @@
+"""kafkalog server — a real partitioned append-only log in a standalone
+process: the system-under-test that exercises the kafka workload's
+analyses (jepsen_tpu/workloads/kafka.py; reference analyses at
+jepsen/src/jepsen/tests/kafka.clj) against a real wire server instead of
+constructed histories.
+
+Semantics (a deliberately small kafka): named integer partitions, each an
+append-only list of values; ``send`` appends and acks the assigned offset;
+``poll`` reads from a caller-supplied per-partition position (consumer
+positions live client-side, like kafka's assign/seek/poll);
+``end_offsets`` reports log ends (the client's assign/subscribe seek-to-end
+and the final-polls catch-up both use it).
+
+Durability: every send appends to a per-server WAL and — in the default
+mode — fsyncs before acking, so a SIGKILL'd server replays to exactly the
+acked log.  Seeded bugs the checker must catch:
+
+- ``--no-fsync``: acks before the WAL hits disk; a kill loses the acked
+  tail, and any later send re-uses those offsets -> the kafka checker's
+  lost-write / inconsistent-offsets analyses fire.
+- ``--dup-sends P``: with probability P a send is applied twice (two
+  offsets ack one value... the second append is silent) -> duplicate.
+
+Protocol: length-prefixed JSON frames (shared with localkv/raftkv):
+  {"op": "send", "key": k, "value": v}                -> {"ok", "offset"}
+  {"op": "poll", "positions": {k: pos}, "max": n}     -> {"ok", "records":
+                                                          {k: [[o, v]...]}}
+  {"op": "end_offsets", "keys": [k...]}               -> {"ok", "ends"}
+  {"op": "ping"}                                      -> {"ok", "node"}
+
+Stdlib only; run as ``python server.py --node n1 --port P --data DIR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import socketserver
+import struct
+import sys
+import threading
+
+
+def send_frame(sock, obj) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">I", hdr)
+    if length > 1 << 20:
+        raise ValueError("frame too large")
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return json.loads(data.decode())
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+class LogStore:
+    def __init__(self, data_dir: str, fsync: bool, dup_p: float,
+                 seed: str):
+        os.makedirs(data_dir, exist_ok=True)
+        self.lock = threading.Lock()
+        self.logs: dict = {}     # k -> [value]
+        self.fsync = fsync
+        self.dup_p = dup_p
+        self._rng = random.Random(seed)
+        self.wal_path = os.path.join(data_dir, "log.wal")
+        self._replay()
+        # fsync mode: small buffer, flush+fsync per send.  no-fsync mode:
+        # a large USERSPACE buffer that is never flushed — a SIGKILL then
+        # really loses the acked tail (flushing to the OS page cache would
+        # survive a process kill; only the user buffer models the
+        # ack-before-durable bug a kill can expose).
+        self.wal = open(self.wal_path, "a",
+                        buffering=(8 * 1024 * 1024) if not fsync else -1)
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail write
+                self.logs.setdefault(rec["k"], []).append(rec["v"])
+
+    def send(self, k, v):
+        with self.lock:
+            log = self.logs.setdefault(k, [])
+            log.append(v)
+            off = len(log) - 1
+            self.wal.write(json.dumps({"k": k, "v": v}) + "\n")
+            if self.dup_p and self._rng.random() < self.dup_p:
+                # seeded duplicate: the record lands twice, one ack
+                log.append(v)
+                self.wal.write(json.dumps({"k": k, "v": v}) + "\n")
+            if self.fsync:
+                self.wal.flush()
+                os.fsync(self.wal.fileno())
+            return off
+
+    def poll(self, positions, max_records):
+        out = {}
+        with self.lock:
+            for k, pos in positions.items():
+                log = self.logs.get(int(k) if str(k).isdigit() else k, [])
+                pos = max(0, int(pos))
+                out[k] = [[o, log[o]]
+                          for o in range(pos, min(pos + max_records,
+                                                  len(log)))]
+        return out
+
+    def end_offsets(self, keys):
+        with self.lock:
+            return {k: len(self.logs.get(
+                int(k) if str(k).isdigit() else k, [])) for k in keys}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", required=True)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--no-fsync", action="store_true",
+                    help="ack sends before the WAL hits disk (a kill "
+                         "loses the acked tail: lost-write bug)")
+    ap.add_argument("--dup-sends", type=float, default=0.0,
+                    help="probability a send is applied twice (duplicate "
+                         "bug)")
+    ap.add_argument("--marker", default="", help="argv tag for grepkill")
+    opts = ap.parse_args(argv)
+    store = LogStore(opts.data, fsync=not opts.no_fsync,
+                     dup_p=opts.dup_sends, seed=f"{opts.node}-{os.getpid()}")
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                try:
+                    msg = recv_frame(self.request)
+                except (OSError, ValueError):
+                    return
+                if msg is None:
+                    return
+                try:
+                    op = msg.get("op")
+                    if op == "send":
+                        off = store.send(msg["key"], msg["value"])
+                        reply = {"ok": True, "offset": off}
+                    elif op == "poll":
+                        reply = {"ok": True,
+                                 "records": store.poll(
+                                     msg.get("positions") or {},
+                                     int(msg.get("max", 8)))}
+                    elif op == "end_offsets":
+                        reply = {"ok": True,
+                                 "ends": store.end_offsets(
+                                     msg.get("keys") or [])}
+                    elif op == "ping":
+                        reply = {"ok": True, "node": opts.node}
+                    else:
+                        reply = {"ok": False, "error": f"bad op {op!r}",
+                                 "definite": True}
+                except Exception as e:  # noqa: BLE001
+                    reply = {"ok": False, "error": repr(e),
+                             "indeterminate": True}
+                try:
+                    send_frame(self.request, reply)
+                except OSError:
+                    return
+
+    class TS(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with TS(("127.0.0.1", opts.port), Handler) as srv:
+        print(f"kafkalog {opts.node} serving on {opts.port} "
+              f"(fsync={store.fsync}, dup={store.dup_p})", flush=True)
+        srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,22 @@ class TestCombinators:
         assert fs[0] == "fast"
         assert set(fs) == {"slow", "fast"}
 
+    def test_each_thread_exhausts_on_immediately_empty_copies(self):
+        # Regression: a per-thread copy that dies on its FIRST draw was
+        # never recorded as exhausted, so each_thread of an empty
+        # generator pended forever (hanging any final-generator phase
+        # whose targets were already met).
+        h = testkit.simulate({"concurrency": 4},
+                             gen.each_thread(gen.limit(0,
+                                                       gen.repeat(
+                                                           {"f": "x"}))))
+        assert len(h) == 0
+        # mixed: copies with one op each still all run (clients only)
+        h2 = testkit.simulate({"concurrency": 4},
+                              gen.clients(gen.each_thread(
+                                  gen.limit(1, gen.repeat({"f": "y"})))))
+        assert len([o for o in h2 if o.type == INVOKE]) == 4
+
     def test_any_preserves_sleep_deadline_under_busy_sibling(self):
         # Regression: Any used to discard a pending child's continuation
         # whenever another child produced an op, re-anchoring a Sleep's

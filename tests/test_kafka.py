@@ -149,12 +149,52 @@ class TestKafkaGraphCycles:
     def test_g1c_mutual_reads(self):
         # T1 polls T2's send and T2 polls T1's send: a wr-wr cycle (G1c on
         # the log).  Every per-mop analysis passes — only the graph pass
-        # catches it.
+        # catches it.  With ww edges in play G1c is an ALLOWED error type
+        # (kafka.clj:2044-2046 — write isolation isn't promised), so the
+        # verdict only flips when the test opts out of ww deps.
         h = (ok(0, [["send", 0, [0, 1]], ["poll", {1: [[0, 2]]}]]) +
              ok(1, [["send", 1, [0, 2]], ["poll", {0: [[0, 1]]}]]))
         r = check(h)
         assert "G1c" in r["anomaly-types"], r
+        assert r["valid"] is True  # allowed under default ww-deps
+        r2 = KafkaChecker().check({"ww_deps": False}, History(h))
+        assert r2["valid"] is False, r2
+        assert "G1c" in r2["bad-error-types"]
+
+    def test_ww_deps_false_drops_ww_edges_from_graph(self):
+        # A cycle closed only via a ww edge (T1 -ww-> T2 -wr-> T1): with
+        # ww_deps false the reference omits ww edges from the graph
+        # entirely — no cycle exists, no spurious G1c refutation.  (The
+        # pure wr-wr mutual-read cycle above must STILL refute.)
+        h = (ok(0, [["send", 0, [0, 10]],                 # T1 writes o0...
+                    ["poll", {0: [[1, 11]]}]]) +          # ...and reads T2
+             ok(1, [["send", 0, [1, 11]]]) +              # T2 writes o1
+             ok(2, [["poll", {0: [[0, 10], [1, 11]]}]]))  # full coverage
+        r = KafkaChecker(ww_deps=False).check({}, History(h))
+        assert not any(t.startswith(("G", "process-G"))
+                       for t in r["anomaly-types"]), r
+        assert r["valid"] is True, r
+        r2 = KafkaChecker(ww_deps=True).check({"ww_deps": True}, History(h))
+        # with ww edges present the same history closes a (ww, wr) cycle
+        assert "G1c" in r2["anomaly-types"]
+        assert r2["valid"] is True  # ...but allowed under ww-deps
+
+    def test_subscribe_free_workloads_keep_poll_skip_bad(self):
+        # sub_via=("assign",): no rebalances can excuse a poll skip, so
+        # the checker configured by the workload must treat it as bad —
+        # regression for the sub_via plumbing (the test map carries no
+        # sub_via key; the checker's ctor config must win).
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [1, 11]]]) +
+             ok(0, [["send", 0, [2, 12]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]) +
+             ok(1, [["poll", {0: [[2, 12]]}]]))   # skips known offset 1
+        r = KafkaChecker(sub_via=("assign",)).check({}, History(h))
+        assert "poll-skip" in r["bad-error-types"], r
         assert r["valid"] is False
+        r2 = KafkaChecker(sub_via=("subscribe", "assign")).check(
+            {}, History(h))
+        assert "poll-skip" not in r2["bad-error-types"]
 
     def test_g0_write_order_cycle(self):
         # T1 wrote before T2 on partition 0, T2 before T1 on partition 1:
