@@ -394,12 +394,17 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                     # so the driver escalates the lane.
                     out = merge_compacted(C)(args)
                     return out[:6] + (out[6] | (nv > C),)
-                # Merge width by (shard-uniform) candidate volume: most
-                # rounds fit the C buffer, burst rounds the 4C one, and
-                # the full grid is the rare fallback.
-                sel = jnp.where(nv_max <= C, 0,
-                                jnp.where(nv_max <= 4 * C, 1, 2))
-                return lax.switch(sel, [merge_compacted(C),
+                # Merge width by (shard-uniform) candidate volume: the
+                # typical round's candidates are at most the live count
+                # (well under C/2 in steady state), burst rounds take the
+                # C or 4C buffers, and the full grid is the rare fallback.
+                half = max(1, C // 2)
+                sel = jnp.where(nv_max <= half, 0,
+                                jnp.where(nv_max <= C, 1,
+                                          jnp.where(nv_max <= 4 * C, 2,
+                                                    3)))
+                return lax.switch(sel, [merge_compacted(half),
+                                        merge_compacted(C),
                                         merge_compacted(4 * C),
                                         merge_full], args)
 
