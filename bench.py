@@ -252,7 +252,7 @@ def _device_tier(history, *, capacity, max_capacity, runs, explain=True,
     model = get_model(model_name)
     prep = prepare(history, model)
     window = wgl_tpu._round_window(prep.window)
-    gw = wgl_tpu.ghost_words(prep)
+    gw = wgl_tpu.chosen_gwords(prep)
     progress(f"warm window={window} gw={gw} caps={cap_ladder(capacity, max_capacity)}")
     t0 = time.time()
     warm_shapes(model, window, cap_ladder(capacity, max_capacity), gw)

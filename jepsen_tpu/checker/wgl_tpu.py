@@ -57,7 +57,7 @@ from jepsen_tpu.checker.prep import (
 )
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
-from jepsen_tpu.ops.dedup import sort_dedup_compact
+from jepsen_tpu.ops.dedup import compact_rows, sort_dedup_compact
 
 EV_NOP = 2
 
@@ -87,6 +87,19 @@ LOOKAHEAD = 2
 # stays inside the watchdog even when rounds take the full-grid fallback
 # merge.)
 CLOSURE_WORK_BUDGET = int(_os.environ.get("JTPU_CLOSURE_BUDGET", "4000000"))
+
+#: Histories with at most this many ghost (crashed/info) ops run the LEAN
+#: engine (``gwords=0``): ghost bits stay plain identity mask bits and the
+#: whole subsumption pipeline — per-class canonicalization (a matmul),
+#: compact-word expansion, and the subset probes — drops out of every merge.
+#: Subsumption is an optimization, never a soundness condition: verdicts
+#: are identical either way, only the explored-config count (and with it,
+#: capacity pressure) changes.  Default 0 — measured on hardware, even 4
+#: unsubsumed crashed CAS writes blew the 10k-op easy history from 819k to
+#: 2.2M configs and forced capacity 16384 (18.5 s vs 6.6 s): the antichain
+#: collapse matters at ANY ghost count, so lean is only for histories with
+#: no ghosts at all, where it saves the machinery with nothing to lose.
+LEAN_GHOST_MAX = int(_os.environ.get("JTPU_LEAN_GHOSTS", "0"))
 
 
 def closure_budget(capacity: int) -> int:
@@ -141,7 +154,11 @@ def make_engine(model: JaxModel, window: int, capacity: int,
     ghost words (>= ceil(n_ghosts / 32) for the history being checked):
     ghost subsumption state sorts as ``gwords`` columns, not ceil(W/32) —
     keeping the big variadic sort narrow (wide sorts at high capacity have
-    crashed the TPU compiler).
+    crashed the TPU compiler).  ``gwords=0`` builds the LEAN engine: ghost
+    bits are ordinary identity mask bits, and canonicalization, compact
+    expansion, and subsumption all vanish from the merge — sound for any
+    history (subsumption is an optimization), chosen by drivers when the
+    ghost count is small (chosen_gwords).
 
     ``single_round_closure`` builds the VMAP-SAFE variant for the batched
     (per-lane) driver: under vmap, ``lax.cond``/``switch`` execute EVERY
@@ -281,10 +298,11 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         #
         # **Candidate compaction** — the valid candidates of a round are
         # usually far fewer than the C*W expansion grid, so they compact
-        # (cumsum + scatter, no sort) into a small buffer and the merge
-        # sorts C + NC rows instead of C*(W+1).  Three merge widths are
-        # compiled (NC = C, 4C, and the full C*W grid) and selected per
-        # round by the (shard-uniform) candidate count.
+        # (stable sort + payload carry, ops.dedup.compact_rows — TPU
+        # scatters serialize per update) into a small buffer and the
+        # merge sorts C + NC rows instead of C*(W+1).  Four merge widths
+        # are compiled (NC = C/2, C, 4C, and the full C*W grid) and
+        # selected per round by the (shard-uniform) candidate count.
         #
         # ``budget`` caps the fixpoint iterations of THIS call: a closure
         # that runs out pauses (returns converged=False) with the partial —
@@ -312,19 +330,29 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                             tiled=True)
                 all_valid = lax.all_gather(all_valid, axis_name, tiled=True)
                 origin = lax.all_gather(origin, axis_name, tiled=True)
-            keyed = all_mask & ~ghosts[None, :]
-            gpart = canonical_compact(all_mask & ghosts[None, :], win_ops)
+            if GW:
+                keyed = all_mask & ~ghosts[None, :]
+                gpart = canonical_compact(all_mask & ghosts[None, :],
+                                          win_ops)
+                gcols = [gpart[:, i] for i in range(GW)]
+            else:
+                # Lean engine: ghost bits are identity bits like any other;
+                # no canonicalization column, no subset subsumption.
+                keyed = all_mask
+                gcols = []
             cols = ([keyed[:, i] for i in range(MW)]
                     + [all_states[:, i] for i in range(S)])
-            gcols = [gpart[:, i] for i in range(GW)]
             gcap = C * num_shards
             out_cols, out_valid, total, ovf2, new_rows, out_orig = \
                 sort_dedup_compact(cols, all_valid, gcap,
                                    ghost_cols=gcols, origin=origin)
             new_keyed = jnp.stack(out_cols[:MW], -1)
             new_states = jnp.stack(out_cols[MW:MW + S], -1)
-            new_compact = jnp.stack(out_cols[MW + S:], -1)
-            new_mask = new_keyed | expand_compact(new_compact, win_ops)
+            if GW:
+                new_compact = jnp.stack(out_cols[MW + S:], -1)
+                new_mask = new_keyed | expand_compact(new_compact, win_ops)
+            else:
+                new_mask = new_keyed
             cur_new2 = (out_orig == 1) & out_valid
             if axis_name is not None:
                 start = lax.axis_index(axis_name) * C
@@ -337,18 +365,13 @@ def make_engine(model: JaxModel, window: int, capacity: int,
 
         def compact_to(cand_mask, cand_states, cv, NC):
             """Compact the [C, W] candidate grid's valid rows into NC rows
-            (cumsum + scatter — no sort)."""
-            flat_v = cv.reshape(C * W)
-            pos = jnp.cumsum(flat_v.astype(jnp.int32)) - 1
-            dest = jnp.where(flat_v & (pos < NC), pos, NC)
-            fm = cand_mask.reshape(C * W, MW)
-            fs = cand_states.reshape(C * W, S)
-            cm = jnp.zeros((NC + 1, MW), jnp.uint32) \
-                .at[dest].set(fm, mode="drop")[:NC]
-            cs = jnp.zeros((NC + 1, S), jnp.int32) \
-                .at[dest].set(fs, mode="drop")[:NC]
-            n_valid = pos[-1] + 1
-            cvv = jnp.arange(NC) < jnp.minimum(n_valid, NC)
+            (stable sort + gather; a scatter here serialized over all C*W
+            grid rows on TPU and was the closure's single hottest op —
+            see ops.dedup.compact_rows)."""
+            (cm, cs), cvv, _total = compact_rows(
+                [cand_mask.reshape(C * W, MW),
+                 cand_states.reshape(C * W, S)],
+                cv.reshape(C * W), NC)
             return cm, cs, cvv
 
         def cond(c):
@@ -757,6 +780,19 @@ def ghost_words(p: PreparedHistory) -> int:
     return max(1, (int(p.n_ghosts) + 31) // 32)
 
 
+def chosen_gwords(p: PreparedHistory) -> int:
+    """Ghost words the driver actually builds the engine with: 0 (the lean,
+    subsumption-free engine) when the history's ghost count is small enough
+    that the ≤2^ghosts extra configurations are cheaper than the ghost
+    machinery's per-merge op chain (see LEAN_GHOST_MAX), else the compact
+    word count.  Single source of truth for check(), the bench warm-up, and
+    the batch/sharded drivers — warming a different engine shape than the
+    timed path dispatches would re-compile inside the timed run."""
+    if int(p.n_ghosts) <= LEAN_GHOST_MAX:
+        return 0
+    return ghost_words(p)
+
+
 def chunk_for_capacity(capacity: int, base_chunk: int) -> int:
     """Events per dispatch at ``capacity``.
 
@@ -833,7 +869,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     # transfer, not compute, dominated the easy-history wall-clock.
     ev_dev = jnp.asarray(ev)
 
-    gw = ghost_words(p)
+    gw = chosen_gwords(p)
     cap = capacity
     max_cap_reached = cap  # diagnostics: how far escalation actually went
     # The chunk is capacity-INVARIANT (see chunk_for_capacity): capacity

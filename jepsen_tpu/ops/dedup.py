@@ -4,7 +4,8 @@ The TPU search's configuration sets live in fixed-shape buffers; after each
 closure expansion the union of (existing ∪ candidate) rows must be
 deduplicated and compacted back to capacity.  Rows are fully described by
 their key columns, so a multi-operand lexicographic ``lax.sort`` (invalid rows
-keyed last), a neighbour-equality pass, and a cumsum/scatter compaction do the
+keyed last), a neighbour-equality pass, and a stable-sort compaction
+(compact_rows — TPU scatters serialize per update, sorts don't) do the
 whole job with static shapes — no host round-trips, no dynamic allocation.
 
 This replaces what knossos does with JVM hash sets of configuration objects;
@@ -43,6 +44,73 @@ WIDE_SORT_ROWS = int(os.environ.get("JTPU_WIDE_SORT_ROWS", "1200000"))
 #: the engine cache key — exists so the bench can measure what subsumption
 #: buys on hardware.
 SUBSUME = os.environ.get("JTPU_SUBSUME", "1") != "0"
+
+
+def compact_rows(cols: Sequence[jnp.ndarray], keep: jnp.ndarray,
+                 capacity: int):
+    """Stable compaction of the rows where ``keep`` into ``capacity``-row
+    buffers via one stable sort + GATHER — no scatter.
+
+    TPU scatters serialize over their updates (a C*W-row grid compaction
+    measured 60 us per scatter — the single hottest op in the whole
+    closure, 42% of device time), while sorts and gathers are parallel
+    (the same merge's 1536-row variadic sort: 6 us).  A single stable
+    2-operand sort of ``(~keep, iota)`` ranks the kept rows' indices
+    first, in order — the whole inverse map in one parallel op; the rows
+    then GATHER into place.  Rows past the kept count are masked to zero
+    to keep the old scatter semantics (callers rely on valid-gating, but
+    zeroed tails keep artifacts reproducible).  Rows past ``capacity``
+    are silently truncated, exactly like the scatter's ``mode="drop"`` —
+    callers detect that via ``total``.
+
+    Returns ``(out_cols, out_valid, total)``.
+    """
+    n = keep.shape[0]
+    total = jnp.sum(keep.astype(jnp.int32))
+    # One stable single-KEY sort with every column riding along as a
+    # payload operand: payloads don't enter the comparator (num_keys=1),
+    # they are just carried by the permutation network — so the kept rows
+    # land first, in order, with zero per-column gathers (TPU row-gathers
+    # serialize like scatters; 4 of them cost 30 us/round before this).
+    flat, meta = [], []
+    for c in cols:
+        if c.ndim == 1:
+            flat.append(c)
+            meta.append(None)
+        else:
+            flat.extend(c[:, j] for j in range(c.shape[1]))
+            meta.append(c.shape[1])
+    if n <= WIDE_SORT_ROWS:
+        sorted_ops = jax.lax.sort(
+            tuple([(~keep).astype(jnp.int32)] + flat),
+            num_keys=1, is_stable=True)[1:]
+    else:
+        # Multi-million-row wide variadic sorts crash the TPU compiler
+        # (see WIDE_SORT_ROWS): sort only (key, iota) and gather each
+        # column — gather cost scales with the OUTPUT (capacity), not n.
+        _, src = jax.lax.sort(((~keep).astype(jnp.int32),
+                               jnp.arange(n, dtype=jnp.int32)),
+                              num_keys=1, is_stable=True)
+        src = src[:min(capacity, n)]
+        sorted_ops = [jnp.take(c, src, axis=0) for c in flat]
+        n = src.shape[0]
+    out_valid = jnp.arange(capacity) < total
+
+    def fit(c):
+        c = c[:capacity] if capacity <= n else jnp.concatenate(
+            [c, jnp.zeros(capacity - n, c.dtype)])
+        return jnp.where(out_valid, c, jnp.zeros((), c.dtype))
+
+    outs, k = [], 0
+    for m in meta:
+        if m is None:
+            outs.append(fit(sorted_ops[k]))
+            k += 1
+        else:
+            outs.append(jnp.stack([fit(sorted_ops[k + j])
+                                   for j in range(m)], axis=-1))
+            k += m
+    return outs, out_valid, total
 
 
 def _lex_perm(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
@@ -136,42 +204,43 @@ def sort_dedup_compact(cols: Sequence[jnp.ndarray],
         is_head = s_valid & ~(same_as_prev & jnp.roll(s_valid, 1))
         idx = jnp.arange(n)
         seg = jnp.cumsum(is_head.astype(jnp.int32)) - 1
-        head_buf = jnp.zeros(n + 1, jnp.int32).at[
-            jnp.where(is_head, seg, n)].set(idx, mode="drop")
-        head_of = head_buf[jnp.clip(seg, 0, n - 1)]
+        # Index of each group's head row, gather-side: one stable sort
+        # ranks the head rows' indices first, in order (scatters
+        # serialize on TPU — see compact_rows).
+        _, head_idx = jax.lax.sort(((~is_head).astype(jnp.int32),
+                                    idx.astype(jnp.int32)),
+                                   num_keys=1, is_stable=True)
+        head_of = jnp.take(head_idx, jnp.clip(seg, 0, n - 1))
         in_group = s_valid & (head_of != idx) & (seg >= 0)
         # Probe several earlier in-group rows: ANY earlier row with a
         # subset ghost bitset justifies the drop (its own drop reason, if
         # dropped, chains down to a kept subset).  A subset sorts before
         # its supersets, so probing the head plus a few nearby offsets
         # catches most dominated rows; leftovers only cost capacity.
-        probes = [jnp.maximum(head_of, 0)]
-        for off in (1, 2, 4, 8, 16)[:N_PROBES]:
-            probes.append(jnp.maximum(idx - off,
-                                      jnp.maximum(head_of, 0)))
+        # The head probe is the one true GATHER; the offset probes are
+        # static ROLLS guarded by a same-group check — a TPU row-gather
+        # serializes per element (3 probe gathers cost 31 us/round), a
+        # roll is parallel slices.  Equivalent hits: the old clamped
+        # probe max(idx-off, head_of) degenerated to the (already
+        # probed) head exactly when the roll's same-group guard fails.
         subsumed = jnp.zeros(n, dtype=bool)
-        for pr in probes:
-            hit = in_group & (pr != idx)
+        hit = in_group
+        for c in s_ghost:
+            hit &= (c[jnp.maximum(head_of, 0)] & ~c) == 0
+        subsumed |= hit
+        for off in (1, 2, 4, 8, 16)[:N_PROBES]:
+            hit = in_group & (idx >= off) & (jnp.roll(seg, off) == seg)
             for c in s_ghost:
-                hit &= (c[pr] & ~c) == 0
+                hit &= (jnp.roll(c, off) & ~c) == 0
             subsumed |= hit
         drop = drop | subsumed
 
     keep = s_valid & ~drop
 
-    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    total = pos[-1] + 1
+    src_cols = s_cols + s_ghost + ([s_origin] if origin is not None else [])
+    outs, out_valid, total = compact_rows(src_cols, keep, capacity)
     overflow = total > capacity
-    dest = jnp.where(keep & (pos < capacity), pos, capacity)
-
-    out_cols = []
-    for c in s_cols + s_ghost:
-        buf = jnp.zeros(capacity + 1, dtype=c.dtype)
-        out_cols.append(buf.at[dest].set(c, mode="drop")[:capacity])
-    out_valid = jnp.arange(capacity) < jnp.minimum(total, capacity)
     if origin is None:
-        return out_cols, out_valid, total, overflow
+        return outs, out_valid, total, overflow
     new_rows = jnp.any(keep & (s_origin == 1))
-    buf = jnp.zeros(capacity + 1, dtype=s_origin.dtype)
-    out_origin = buf.at[dest].set(s_origin, mode="drop")[:capacity]
-    return out_cols, out_valid, total, overflow, new_rows, out_origin
+    return outs[:-1], out_valid, total, overflow, new_rows, outs[-1]

@@ -29,8 +29,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import (EV_NOP, events_array,
-                                        ghost_words, make_engine)
+from jepsen_tpu.checker.wgl_tpu import (EV_NOP, chosen_gwords,
+                                        events_array, make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
@@ -70,7 +70,10 @@ def check_batch(model: JaxModel,
     preps = [prepare(h, model) for h in histories]
     window = _round_window(max(p.window for p in preps))
     longest = max(len(p) for p in preps)
-    gw = max(ghost_words(p) for p in preps)
+    # Lean (gwords=0) only when EVERY lane qualifies — the engine shape is
+    # shared across the batch, and a non-qualifying lane's ghost_words
+    # dominates the max anyway.
+    gw = max(chosen_gwords(p) for p in preps)
     out: List[Optional[Dict[str, Any]]] = [None] * len(preps)
     lanes = list(range(len(preps)))
     cap = capacity

@@ -30,7 +30,7 @@ from jax import shard_map
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
 from jepsen_tpu.checker.wgl_tpu import (EV_NOP, LOOKAHEAD, _chunk_slicer,
-                                        events_array, ghost_words,
+                                        chosen_gwords, events_array,
                                         make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
@@ -196,7 +196,7 @@ def check_sharded(model: JaxModel,
     ev_dev = put_repl(ev)
     slice_chunk = _chunk_slicer(chunk)
 
-    gw = ghost_words(p)
+    gw = chosen_gwords(p)
     cap = capacity_per_shard
     max_cap_reached = cap  # diagnostics: how far escalation actually went
     run = _sharded_runner(model, window, cap, mesh, axis, gw, work_budget)

@@ -1,0 +1,49 @@
+"""One-off: easy-tier wall-clock vs dispatch chunk size on the real TPU.
+
+The round-4 trace showed the easy tier's wall is ~40 per-dispatch polls at
+~0.18 s each on the tunneled device (compute per 512-event chunk is far
+smaller), so the chunk size — polls = events / chunk — is the lever.
+Measures check() at several chunks on the bench's own easy history.
+
+Usage: JAX_PLATFORMS=axon python scripts/chunk_sweep.py [chunks...]
+"""
+
+import statistics
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bench import build_easy, cap_ladder, warm_shapes  # noqa: E402
+
+from jepsen_tpu.checker import wgl_tpu  # noqa: E402
+from jepsen_tpu.checker.prep import prepare  # noqa: E402
+from jepsen_tpu.models import get_model  # noqa: E402
+
+
+def main():
+    chunks = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048]
+    model = get_model("cas-register")
+    h = build_easy()
+    prep = prepare(h, model)
+    window = wgl_tpu._round_window(prep.window)
+    gw = wgl_tpu.chosen_gwords(prep)
+    for chunk in chunks:
+        t0 = time.time()
+        warm_shapes(model, window, cap_ladder(1024, 4096), gw, chunk=chunk)
+        warm = time.time() - t0
+        walls = []
+        for _ in range(3):
+            t0 = time.time()
+            r = wgl_tpu.check(model, h, prepared=prep, capacity=1024,
+                              chunk=chunk, max_capacity=16384)
+            walls.append(round(time.time() - t0, 3))
+            assert r["valid"] is True, r
+        print(f"chunk={chunk}: warm={warm:.1f}s runs={walls} "
+              f"median={statistics.median(walls):.3f}s "
+              f"configs={r['configs-explored']} "
+              f"maxcap={r['max-capacity-reached']}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

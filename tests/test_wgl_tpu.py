@@ -71,6 +71,93 @@ class TestDedup:
         assert bool(ref[4]) == bool(got[4])
 
 
+class TestCompactRows:
+    def test_matches_kept_rows_in_order(self):
+        from jepsen_tpu.ops.dedup import compact_rows
+        rng = np.random.default_rng(3)
+        n = 97
+        keep = rng.random(n) < 0.4
+        col1 = rng.integers(0, 100, n).astype(np.int32)
+        col2 = rng.integers(0, 9, (n, 3)).astype(np.uint32)
+        (o1, o2), ov, total = compact_rows(
+            [jnp.asarray(col1), jnp.asarray(col2)], jnp.asarray(keep), 64)
+        want1 = col1[keep]
+        assert int(total) == len(want1)
+        assert o1[:len(want1)].tolist() == want1.tolist()
+        assert o2[:len(want1)].tolist() == col2[keep].tolist()
+        assert not bool(ov[len(want1)]) if len(want1) < 64 else True
+        assert np.all(np.asarray(o1[len(want1):]) == 0)
+
+    def test_truncates_past_capacity(self):
+        from jepsen_tpu.ops.dedup import compact_rows
+        col = jnp.arange(10, dtype=jnp.int32)
+        (o,), ov, total = compact_rows([col], jnp.ones(10, bool), 4)
+        assert int(total) == 10 and o.tolist() == [0, 1, 2, 3]
+
+    def test_wide_fallback_matches(self, monkeypatch):
+        from jepsen_tpu.ops import dedup
+        rng = np.random.default_rng(5)
+        n = 256
+        keep = jnp.asarray(rng.random(n) < 0.5)
+        cols = [jnp.asarray(rng.integers(0, 50, n).astype(np.int32)),
+                jnp.asarray(rng.integers(0, 7, (n, 2)).astype(np.uint32))]
+        ref = dedup.compact_rows(cols, keep, 96)
+        monkeypatch.setattr(dedup, "WIDE_SORT_ROWS", 1)
+        got = dedup.compact_rows(cols, keep, 96)
+        for a, b in zip(ref[0], got[0]):
+            assert a.tolist() == b.tolist()
+        assert ref[1].tolist() == got[1].tolist()
+        assert int(ref[2]) == int(got[2])
+
+
+class TestLeanEngine:
+    """gwords=0 drops the whole ghost-subsumption pipeline; subsumption is
+    an optimization, so verdicts must be identical — only configs-explored
+    may grow.  chosen_gwords picks lean only for ghost-free histories
+    (LEAN_GHOST_MAX=0 default: measured on hardware, even 4 unsubsumed
+    ghosts ballooned the 10k-op easy history 819k -> 2.2M configs)."""
+
+    def test_chosen_gwords_default(self):
+        from jepsen_tpu.checker.prep import prepare
+        model = get_model("cas-register")
+        clean = cas_register_history(200, concurrency=4, crash_p=0.0,
+                                     seed=1)
+        assert wgl_tpu.chosen_gwords(prepare(clean, model)) == 0
+        ghosty = cas_register_history(300, concurrency=4, crash_p=0.05,
+                                      seed=1)
+        p = prepare(ghosty, model)
+        assert p.n_ghosts > 0
+        assert wgl_tpu.chosen_gwords(p) == wgl_tpu.ghost_words(p)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_lean_matches_full_with_ghosts(self, seed, monkeypatch):
+        # Force lean even for ghost histories: verdicts must still agree
+        # with the full engine and the CPU oracle.
+        model = get_model("cas-register")
+        h = cas_register_history(150, concurrency=4, crash_p=0.03,
+                                 seed=seed)
+        full = wgl_tpu.check(model, h, capacity=128, chunk=32,
+                             max_capacity=4096)
+        monkeypatch.setattr(wgl_tpu, "LEAN_GHOST_MAX", 10**9)
+        # Without subsumption the ghost pileup needs real capacity
+        # headroom (that blowup is exactly why LEAN_GHOST_MAX is 0).
+        lean = wgl_tpu.check(model, h, capacity=128, chunk=32,
+                             max_capacity=65536)
+        assert lean["valid"] == full["valid"]
+        oracle = wgl_cpu.check(CASRegister(), h)
+        assert lean["valid"] == oracle["valid"]
+
+    def test_lean_refutation(self, monkeypatch):
+        monkeypatch.setattr(wgl_tpu, "LEAN_GHOST_MAX", 10**9)
+        model = get_model("cas-register")
+        h = corrupt_reads(cas_register_history(200, concurrency=4,
+                                               crash_p=0.02, seed=9),
+                          n=1, seed=2)
+        r = wgl_tpu.check(model, h, capacity=128, chunk=32,
+                          max_capacity=4096)
+        assert r["valid"] is False
+
+
 CASES = [
     # (ops, expected_valid)
     ([mk(0, INVOKE, "write", 1), mk(0, OK, "write", 1),
