@@ -257,13 +257,26 @@ def _device_tier(history, *, capacity, max_capacity, runs, explain=True,
     t0 = time.time()
     warm_shapes(model, window, cap_ladder(capacity, max_capacity), gw)
     warm_s = round(time.time() - t0, 1)
-    progress("timed runs")
+    # One untimed SHAKEOUT run: warm_shapes covers the engine programs,
+    # but the first real check also touches the event-stream slicer (jit
+    # retraces per stream shape), the grow/shrink escalation paths, and —
+    # right after a compile-heavy tier — a possibly still-congested
+    # tunneled compile service (BENCH_r04's refuted tier measured a
+    # 14.5 s first run vs 0.59 s steady; standalone cold-cache the same
+    # first run is 1.0 s).  The shakeout absorbs all of that outside the
+    # timed region and is disclosed in the artifact.
+    t0 = time.time()
+    wgl_tpu.check(model, history, prepared=prep, capacity=capacity,
+                  chunk=CHUNK, max_capacity=max_capacity, explain=False)
+    shakeout_s = round(time.time() - t0, 2)
+    progress(f"timed runs (shakeout {shakeout_s}s)")
     r, walls = timed_runs(
         lambda: wgl_tpu.check(model, history, prepared=prep,
                               capacity=capacity, chunk=CHUNK,
                               max_capacity=max_capacity, explain=explain),
         runs)
-    return r, walls, {"window": prep.window, "gwords": gw, "warm_s": warm_s}
+    return r, walls, {"window": prep.window, "gwords": gw, "warm_s": warm_s,
+                      "shakeout_s": shakeout_s}
 
 
 def tier_easy():
@@ -501,7 +514,7 @@ def main():
             "max_capacity_reached", "histories_per_sec", "n_histories",
             "ops_each", "setup_s", "timeout_s", "rc", "subsume",
             "failed_op_index", "stream_fraction_to_refute",
-            "degradation_timed", "window", "warm_s")
+            "degradation_timed", "window", "warm_s", "shakeout_s")
 
     def slim(t: dict) -> dict:
         out = {k: t[k] for k in keep if t.get(k) is not None}

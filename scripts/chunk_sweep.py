@@ -30,7 +30,9 @@ def main():
     gw = wgl_tpu.chosen_gwords(prep)
     for chunk in chunks:
         t0 = time.time()
-        warm_shapes(model, window, cap_ladder(1024, 4096), gw, chunk=chunk)
+        # Warm the same ladder check() can escalate through (max_capacity
+        # below) — a missing shape would compile inside the timed region.
+        warm_shapes(model, window, cap_ladder(1024, 16384), gw, chunk=chunk)
         warm = time.time() - t0
         walls = []
         for _ in range(3):

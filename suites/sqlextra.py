@@ -462,3 +462,132 @@ def comments_workload(conn_factory, keys: int = 4,
     return {"generator": comments_generator(keys, ops_per_key),
             "checker": independent.checker(CommentsChecker()),
             "client": CommentsClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Counter (yugabyte/src/yugabyte/counter.clj: concurrent increments of one
+# row, reads graded by the counter envelope — jepsen checker.clj:737)
+# --------------------------------------------------------------------------
+
+
+def counter_generator(max_delta: int = 5):
+    def add():
+        return {"f": "add", "value": random.randint(1, max_delta)}
+    return gen.mix([gen.FnGen(add),
+                    gen.stagger(1 / 10, gen.repeat({"f": "read"}))])
+
+
+class SqlCounterClient(_SqlClient):
+    """One counter row; add = relative UPDATE, read = SELECT.  The
+    yugabyte reference drives a CQL counter column (ycql/counter.clj);
+    the SQL shape is the same single-row relative update."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS counter "
+                        "(id INT PRIMARY KEY, val INT)")
+        try:
+            self.conn.query("INSERT INTO counter VALUES (0, 0)")
+        except Exception:  # noqa: BLE001 — another client won the race
+            pass
+
+    def invoke(self, test, op: Op) -> Op:
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    "SELECT val FROM counter WHERE id = 0")
+                val = int(rows[0][0]) if rows else 0
+                return op.with_(type=OK, value=val)
+            d = int(op.value)
+            sign, mag = ("+", d) if d >= 0 else ("-", -d)
+            self.conn.query(f"UPDATE counter SET val = val {sign} {mag} "
+                            f"WHERE id = 0")
+            return op.with_(type=OK if self.conn.rowcount else FAIL)
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+def counter_workload(conn_factory, max_delta: int = 5) -> Dict[str, Any]:
+    from jepsen_tpu.checker import CounterChecker
+    return {"generator": counter_generator(max_delta),
+            "checker": CounterChecker(),
+            "client": SqlCounterClient(conn_factory)}
+
+
+# --------------------------------------------------------------------------
+# Multi-key ACID (yugabyte/src/yugabyte/multi_key_acid.clj: transactional
+# writes over a composite-key table, linearizable as a multi-register per
+# independent group)
+# --------------------------------------------------------------------------
+
+
+def mka_generator(groups: int = 3, keys_per_group: int = 3,
+                  values: int = 5, ops_per_group: int = 120,
+                  threads_per_group: int = 2):
+    from jepsen_tpu import independent
+
+    def group_gen(_g):
+        def read():
+            ks = random.sample(range(keys_per_group),
+                               random.randint(1, keys_per_group))
+            return {"f": "read", "value": [[k, None] for k in sorted(ks)]}
+
+        def write():
+            ks = random.sample(range(keys_per_group),
+                               random.randint(1, keys_per_group))
+            return {"f": "write",
+                    "value": [[k, random.randrange(values)]
+                              for k in sorted(ks)]}
+        return gen.limit(ops_per_group,
+                         gen.mix([gen.FnGen(read), gen.FnGen(write)]))
+
+    return independent.concurrent_generator(
+        threads_per_group, list(range(groups)), group_gen)
+
+
+class MkaClient(_SqlClient):
+    """Writes upsert every (k, v) of the op inside ONE transaction; reads
+    are a single whole-group SELECT (statement-atomic), filled into the
+    requested key list (multi_key_acid.clj r/w shapes)."""
+
+    def setup(self, test):
+        self.conn.query("CREATE TABLE IF NOT EXISTS mka "
+                        "(grp INT, k INT, v INT, PRIMARY KEY (grp, k))")
+
+    def invoke(self, test, op: Op) -> Op:
+        g, pairs = op.value
+        try:
+            if op.f == "read":
+                rows = self.conn.query(
+                    f"SELECT k, v FROM mka WHERE grp = {g}")
+                have = {int(r[0]): int(r[1]) for r in rows}
+                filled = [[k, have.get(k)] for k, _ in pairs]
+                return op.with_(type=OK, value=(g, filled))
+            self.conn.query("BEGIN")
+            try:
+                for k, v in pairs:
+                    self.conn.query(f"UPDATE mka SET v = {v} "
+                                    f"WHERE grp = {g} AND k = {k}")
+                    if self.conn.rowcount == 0:
+                        self.conn.query(
+                            f"INSERT INTO mka VALUES ({g}, {k}, {v})")
+                self.conn.query("COMMIT")
+                return op.with_(type=OK)
+            except Exception:
+                try:
+                    self.conn.query("ROLLBACK")
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+        except Exception as e:  # noqa: BLE001
+            return self._convert(op, e)
+
+
+def mka_workload(conn_factory, groups: int = 3, keys_per_group: int = 3,
+                 ops_per_group: int = 120) -> Dict[str, Any]:
+    from jepsen_tpu import independent
+    from jepsen_tpu.checker import Linearizable
+    from jepsen_tpu.models import MultiRegister
+    return {"generator": mka_generator(groups, keys_per_group,
+                                       ops_per_group=ops_per_group),
+            "checker": independent.checker(Linearizable(MultiRegister())),
+            "client": MkaClient(conn_factory)}

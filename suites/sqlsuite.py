@@ -56,9 +56,21 @@ def make_workloads(conn_factory: Callable) -> Dict[str, Callable]:
         wl = adya.g2_workload()
         return {**wl, "client": sqlkit.TxnClient(conn_factory)}
 
+    def counter_wl(opts):
+        from suites import sqlextra
+        return sqlextra.counter_workload(
+            conn_factory, max_delta=int(opts.get("max_delta", 5)))
+
+    def mka_wl(opts):
+        from suites import sqlextra
+        return sqlextra.mka_workload(
+            conn_factory, groups=int(opts.get("groups", 3)),
+            keys_per_group=int(opts.get("keys_per_group", 3)),
+            ops_per_group=int(opts.get("ops_per_group", 120)))
+
     return {"bank": bank_wl, "register": register_wl, "set": set_wl,
             "append": append_wl, "wr": wr_wl, "long-fork": long_fork_wl,
-            "g2": g2_wl}
+            "g2": g2_wl, "counter": counter_wl, "multi-key-acid": mka_wl}
 
 
 def make_suite(suite: str, db, conn_factory: Callable, os=None,

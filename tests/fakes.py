@@ -624,6 +624,8 @@ class MiniSqlState:
         self.dirty: Dict[int, int] = {}         # id -> x
         self.seq: Dict[int, set] = {}           # table idx -> {k}
         self.comments: Dict[int, Dict[int, int]] = {}  # table -> id -> k
+        self.counter: Dict[int, int] = {}       # id -> val
+        self.mka: Dict[int, Dict[int, int]] = {}  # grp -> k -> v
         self.lock = _NullLock()  # handlers' outer lock: serialization is
         self.txn = threading.RLock()  # done here, txn-scoped
         self._holders: Dict[int, int] = {}  # thread id -> depth
@@ -810,6 +812,51 @@ class MiniSqlState:
             t, k = int(m.group(1)), int(m.group(2))
             return sorted((i,) for i, kk in self.comments.get(t, {}).items()
                           if kk == k), 0, None
+        # counter workload (suites/sqlextra.py)
+        m = _re.match(r"insert into counter values \((\d+), (-?\d+)\)", low)
+        if m:
+            i, v = int(m.group(1)), int(m.group(2))
+            if i in self.counter:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            self.counter[i] = v
+            return [], 1, None
+        m = _re.match(r"update counter set val = val ([+-]) (\d+) "
+                      r"where id = (\d+)", low)
+        if m:
+            sign, mag, i = m.group(1), int(m.group(2)), int(m.group(3))
+            if i not in self.counter:
+                return [], 0, None
+            self.counter[i] += mag if sign == "+" else -mag
+            return [], 1, None
+        m = _re.match(r"select val from counter where id = (\d+)", low)
+        if m:
+            i = int(m.group(1))
+            return ([(self.counter[i],)] if i in self.counter else []), 0, \
+                None
+        # multi-key-acid workload (suites/sqlextra.py)
+        m = _re.match(r"insert into mka values \((\d+), (\d+), (-?\d+)\)",
+                      low)
+        if m:
+            g, k, v = (int(m.group(1)), int(m.group(2)), int(m.group(3)))
+            rows = self.mka.setdefault(g, {})
+            if k in rows:
+                return [], 0, {"S": "ERROR", "C": "23505",
+                               "M": "duplicate key", "errno": "1062"}
+            rows[k] = v
+            return [], 1, None
+        m = _re.match(r"update mka set v = (-?\d+) "
+                      r"where grp = (\d+) and k = (\d+)", low)
+        if m:
+            v, g, k = (int(m.group(1)), int(m.group(2)), int(m.group(3)))
+            if k not in self.mka.get(g, {}):
+                return [], 0, None
+            self.mka[g][k] = v
+            return [], 1, None
+        m = _re.match(r"select k, v from mka where grp = (\d+)", low)
+        if m:
+            g = int(m.group(1))
+            return sorted(self.mka.get(g, {}).items()), 0, None
         return [], 0, {"S": "ERROR", "C": "42601",
                        "M": f"unparsed: {q[:60]}", "errno": "1064"}
 

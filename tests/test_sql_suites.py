@@ -94,6 +94,16 @@ class TestPgFamilyWire:
         from suites.yugabyte.runner import WORKLOADS
         run_wire_test(WORKLOADS["set"]({}), "yb-set", pg_port)
 
+    def test_yugabyte_counter(self, pg_port):
+        from suites.yugabyte.runner import WORKLOADS
+        run_wire_test(WORKLOADS["counter"]({}), "yb-counter", pg_port)
+
+    def test_yugabyte_multi_key_acid(self, pg_port):
+        from suites.yugabyte.runner import WORKLOADS
+        run_wire_test(
+            WORKLOADS["multi-key-acid"]({"ops_per_group": 60}),
+            "yb-mka", pg_port)
+
 
 # --------------------------------------------------------------------------
 # Checker units (history in, verdict out)
