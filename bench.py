@@ -10,11 +10,18 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
   ceiling   ghost-write burst that must blow past max capacity: clean,
             *timed* degradation to an unknown verdict at the 65536 ceiling
   refuted   10k ops with corrupted reads: early-exit on the failing prefix
-  batch     check_batch throughput over short per-key histories -> hist/sec
+  batch     check_batch throughput over short per-key histories -> hist/sec,
+            plus the same-host CPU-oracle comparison (per core AND per
+            socket) and the break-even core count, on two shapes (96 and
+            512 lanes)
   ablation  ghost-subsumption on vs off (JTPU_SUBSUME=0) on a ghost burst
             that concludes in O(crashes) configs with subsumption and needs
             ~2^crashes without — the measured evidence for the claim in
             checker/wgl_tpu.py:22-32
+  sched     generator scheduler throughput (pure mix + wrapped stack),
+            the committed record behind the ~24k ops/s claim
+  multireg  10k-op multi-key register history (BASELINE configs #4/#5) on
+            the device-tier MultiRegister vs the host oracle
 
 **Isolation:** every tier runs in its own subprocess with its own timeout; a
 tier that crashes the TPU worker (or hangs) degrades to a per-tier
@@ -56,12 +63,16 @@ TIER_TIMEOUT_S = {
     "easy": 300 if SMOKE else 1500,
     "cpu": 120 if SMOKE else 1100,
     "hard": 300 if SMOKE else 2400,
-    "ceiling": 300 if SMOKE else 1500,
+    # Cold-cache ladder warm-up measured 1466 s (the 65536 engine's
+    # compile); with the persistent cache it is ~48 s.  Budget for cold.
+    "ceiling": 300 if SMOKE else 2400,
     "refuted": 300 if SMOKE else 1200,
     "batch": 300 if SMOKE else 1200,
     "ablation_on": 300 if SMOKE else 900,
     "ablation_off": 300 if SMOKE else 900,
     "setup2": 300 if SMOKE else 700,
+    "sched": 120 if SMOKE else 300,
+    "multireg": 300 if SMOKE else 1500,
 }
 
 
@@ -209,7 +220,10 @@ def cap_ladder(start, max_cap, growth=4):
 
 def tier_cpu():
     """Measure the CPU oracle with a hard timeout — this is the 'CPU
-    knossos' baseline the device tier is claimed against."""
+    knossos' baseline the device tier is claimed against.  ``hard`` is the
+    SAME history the device hard tier runs (round-4 review: the ~12x
+    device advantage on the crash-heavy shape needs a committed CPU
+    number, not a stale README claim)."""
     from jepsen_tpu.checker import wgl_cpu
     from jepsen_tpu.models import CASRegister
     from jepsen_tpu.synth import cas_register_history
@@ -221,6 +235,7 @@ def tier_cpu():
         "1k": cas_register_history(1000, concurrency=8, crash_p=0.001,
                                    seed=2),
         "10k": build_easy(),
+        "hard": build_hard(),
     }
     for name, h in hs.items():
         progress(f"cpu {name}")
@@ -245,11 +260,11 @@ def tier_cpu():
 
 
 def _device_tier(history, *, capacity, max_capacity, runs, explain=True,
-                 model_name="cas-register"):
+                 model_name="cas-register", model_kw=None):
     from jepsen_tpu.checker import wgl_tpu
     from jepsen_tpu.checker.prep import prepare
     from jepsen_tpu.models import get_model
-    model = get_model(model_name)
+    model = get_model(model_name, **(model_kw or {}))
     prep = prepare(history, model)
     window = wgl_tpu._round_window(prep.window)
     gw = wgl_tpu.chosen_gwords(prep)
@@ -304,15 +319,16 @@ def tier_hard():
 
 
 def tier_ceiling():
-    # The 2^18-state burst cannot conclude below a 16384 ceiling (it
-    # exceeds it 16x); the claim under test is that the engine escalates
-    # the whole capacity ladder and degrades to "unknown" in *bounded
-    # time* — asserted against an explicit wall budget, not just the
-    # orchestrator timeout.  (The ladder stops at 16384 rather than
-    # 65536 because the 65536-capacity bitset engine's full-fallback
-    # merge compiles for tens of minutes on the tunneled compile service
-    # — all compile, no information: the degradation story is identical.)
-    hard_cap = 4096 if SMOKE else 16384
+    # The 2^18-state burst cannot conclude below the 65536 ceiling (it
+    # exceeds it 4x); the claim under test is that the engine escalates
+    # the WHOLE documented capacity ladder and degrades to "unknown" in
+    # *bounded time* — asserted against an explicit wall budget, not just
+    # the orchestrator timeout.  (Round 4 stopped the ladder at 16384
+    # because the 65536-capacity engine's full-fallback merge — one
+    # C*(W+1)-row _lex_perm sort chain — compiled for tens of minutes;
+    # round 5's tiled fold keeps every sort under WIDE_SORT_ROWS, so the
+    # full ladder is back.)
+    hard_cap = 4096 if SMOKE else 65536
     degrade_budget_s = 300.0 if SMOKE else 900.0
     r, walls, meta = _device_tier(build_ceiling(), capacity=1024,
                                   max_capacity=hard_cap, runs=1,
@@ -357,22 +373,139 @@ def tier_ablation():
           "error": r.get("error"), **meta})
 
 
+def build_batch512():
+    from jepsen_tpu.synth import cas_register_history, corrupt_reads
+    n = 64 if SMOKE else 512
+    hs = [cas_register_history(BATCH_OPS, concurrency=6, crash_p=0.005,
+                               seed=500 + i) for i in range(n)]
+    for i in range(0, n, 4):
+        hs[i] = corrupt_reads(hs[i], n=1, seed=i)
+    return hs
+
+
 def tier_batch():
-    from jepsen_tpu.models import get_model
+    """Batch offload throughput + the honest same-host CPU comparison the
+    round-4 review asked for: histories/sec BOTH ways, per CPU core and
+    per socket (this bench host's socket, os.cpu_count() cores), plus the
+    break-even core count.  Two shapes: the legacy 96-lane stream
+    (round-over-round comparability) and the 512-lane group that is the
+    measured throughput knee (parallel/batch.py MAX_LANES_PER_GROUP)."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.models import CASRegister, get_model
     from jepsen_tpu.parallel.batch import check_batch
     model = get_model("cas-register")
-    hs = build_batch()
-    progress("batch warm (full batch size — jit keys on the batch dim)")
-    check_batch(model, hs)
-    progress("batch timed run")
+    out = {}
+    for name, hs in (("96", build_batch()), ("512", build_batch512())):
+        progress(f"batch[{name}] warm (jit keys on the batch dim)")
+        check_batch(model, hs)
+        progress(f"batch[{name}] timed run")
+        t0 = time.time()
+        res = check_batch(model, hs)
+        wall = time.time() - t0
+        n_false = sum(1 for r in res if r["valid"] is False)
+        assert n_false == len(hs) // 4, [r["valid"] for r in res]
+        # CPU oracle on a sample of the same lanes, single core.
+        sample = hs[:16]
+        t0 = time.time()
+        for h in sample:
+            wgl_cpu.check(CASRegister(), h)
+        per = (time.time() - t0) / len(sample)
+        cores = os.cpu_count() or 1
+        dev_hps = len(hs) / wall
+        cpu_core = 1.0 / per
+        out[name] = {
+            "n_histories": len(hs), "ops_each": BATCH_OPS,
+            "wall_s": round(wall, 3),
+            "histories_per_sec": round(dev_hps, 1),
+            "cpu_s_per_history_1core": round(per, 4),
+            "cpu_histories_per_sec_core": round(cpu_core, 1),
+            "host_cores": cores,
+            "cpu_histories_per_sec_socket": round(cores * cpu_core, 1),
+            "device_vs_socket": round(dev_hps / (cores * cpu_core), 2),
+            "break_even_cores": round(dev_hps / cpu_core, 1),
+        }
+    emit({**out["96"], "shapes": out})
+
+
+def build_multireg():
+    from jepsen_tpu.synth import multi_register_history
+    return multi_register_history(N_OPS, keys=3, concurrency=8,
+                                  crash_p=0.0005, seed=77)
+
+
+def tier_multireg():
+    """Multi-key register history (BASELINE configs #4/#5: the
+    cockroach/tidb/yugabyte multi-key shapes) on the round-5 device-tier
+    MultiRegister (k int32 lanes) vs the host oracle on the same
+    history."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.models import MultiRegister, get_model
+    h = build_multireg()
+    r, walls, meta = _device_tier(
+        h, capacity=1024, max_capacity=4096 if SMOKE else 16384, runs=2,
+        model_name="multi-register", model_kw={"keys": 3, "vbits": 3})
+    assert r["valid"] is True, r
+    cancel = threading.Event()
+    timer = threading.Timer(CPU_TIMEOUT_S, cancel.set)
+    timer.start()
     t0 = time.time()
-    res = check_batch(model, hs)
-    wall = time.time() - t0
-    n_false = sum(1 for r in res if r["valid"] is False)
-    assert n_false == BATCH_N // 4, [r["valid"] for r in res]
-    emit({"n_histories": BATCH_N, "ops_each": BATCH_OPS,
-          "wall_s": round(wall, 3),
-          "histories_per_sec": round(BATCH_N / wall, 1)})
+    try:
+        c = wgl_cpu.check(MultiRegister(), h, cancel=cancel)
+        cpu = {"wall_s": round(time.time() - t0, 3), "valid": c["valid"]}
+    except wgl_cpu.Cancelled:
+        cpu = {"wall_s": round(time.time() - t0, 3), "timeout": True}
+    finally:
+        timer.cancel()
+    import statistics as st
+    dev = st.median(walls)
+    emit({"runs": walls, "valid": r["valid"],
+          "configs_explored": r.get("configs-explored"),
+          "max_capacity_reached": r.get("max-capacity-reached"),
+          "cpu": cpu,
+          # On CPU timeout the ratio is a LOWER bound (flagged).
+          "vs_cpu": (round(cpu["wall_s"] / dev, 2)
+                     if cpu.get("wall_s") else None),
+          "vs_cpu_is_lower_bound": bool(cpu.get("timeout")),
+          **meta})
+
+
+def tier_sched():
+    """Generator scheduler throughput — the committed record behind the
+    ~24k ops/s claim (round-4 review: the number lived only in a test
+    docstring; reference bar: generator.clj:67-70 cites >20k/s).  Two
+    shapes: the pure mix through the simulator (completion/update costs
+    included) and the realistic wrapped stack (clients + time_limit)."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.generator import testkit
+    n = 5_000 if SMOKE else 20_000
+    out = {}
+    best = 0.0
+    for _ in range(3):
+        g = gen.limit(n, gen.mix([gen.repeat({"f": "r"}),
+                                  gen.repeat({"f": "w", "value": 1})]))
+        t0 = time.time()
+        h = testkit.quick(g, concurrency=10, complete_fn=testkit.instant)
+        dt = time.time() - t0
+        assert sum(1 for o in h if o.type == "invoke") == n
+        best = max(best, n / dt)
+    out["pure_mix_ops_per_sec"] = round(best, 0)
+    best = 0.0
+    for _ in range(3):
+        g = gen.time_limit(3600, gen.clients(gen.limit(
+            n, gen.mix([gen.repeat({"f": "r"}),
+                        gen.repeat({"f": "w", "value": 1})]))))
+        t0 = time.time()
+        h = testkit.quick(g, concurrency=10, complete_fn=testkit.instant)
+        dt = time.time() - t0
+        best = max(best, n / dt)
+    out["wrapped_stack_ops_per_sec"] = round(best, 0)
+    out["reference_bar_ops_per_sec"] = 20_000
+    # Best-of-3, NOT the bench's usual post-shakeout median: scheduler
+    # throughput is a pure-host figure whose low outliers are scheduler
+    # noise (GC, the suite running alongside), and the reference's cited
+    # figure (generator.clj:67-70) is likewise a best-case rate.
+    out["timing"] = "best-of-3"
+    emit(out)
 
 
 def tier_setup2():
@@ -399,6 +532,8 @@ TIER_FNS = {
     "ablation_on": tier_ablation,
     "ablation_off": tier_ablation,
     "setup2": tier_setup2,
+    "sched": tier_sched,
+    "multireg": tier_multireg,
 }
 
 
@@ -472,7 +607,8 @@ def main():
     # Easy (the headline) runs FIRST so later-tier failures can't starve it
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
-                 "ablation_on", "ablation_off", "setup2"):
+                 "ablation_on", "ablation_off", "setup2", "sched",
+                 "multireg"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "
@@ -503,7 +639,10 @@ def main():
     full_path = os.environ.get(
         "JTPU_BENCH_FULL",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "bench_full.json"))
+                     # smoke runs must not clobber the committed hardware
+                     # record
+                     "bench_full_smoke.json" if SMOKE
+                     else "bench_full.json"))
     try:
         with open(full_path, "w") as f:
             json.dump(full, f, indent=1)
@@ -514,7 +653,10 @@ def main():
             "max_capacity_reached", "histories_per_sec", "n_histories",
             "ops_each", "setup_s", "timeout_s", "rc", "subsume",
             "failed_op_index", "stream_fraction_to_refute",
-            "degradation_timed", "window", "warm_s", "shakeout_s")
+            "degradation_timed", "window", "warm_s", "shakeout_s",
+            "device_vs_socket", "cpu_histories_per_sec_socket",
+            "break_even_cores", "host_cores", "vs_cpu",
+            "vs_cpu_is_lower_bound", "cpu")
 
     def slim(t: dict) -> dict:
         out = {k: t[k] for k in keep if t.get(k) is not None}
@@ -523,7 +665,7 @@ def main():
         return out
 
     cpu_slim = {"status": tiers["cpu"].get("status")}
-    for name in ("200", "1k", "10k"):
+    for name in ("200", "1k", "10k", "hard"):
         if isinstance(tiers["cpu"].get(name), dict):
             cpu_slim[name] = {k: v for k, v in tiers["cpu"][name].items()
                               if k in ("wall_s", "valid", "timeout")}
@@ -548,6 +690,11 @@ def main():
             "ablation_on": slim(tiers["ablation_on"]),
             "ablation_off": slim(tiers["ablation_off"]),
             "second_process_setup": slim(tiers["setup2"]),
+            "scheduler": {k: v for k, v in tiers["sched"].items()
+                          if k not in ("status",)},
+            "multireg": slim(tiers["multireg"]),
+            "batch_vs_cpu_socket": (tiers["batch"].get("shapes") or {}).get(
+                "512", {}),
             "full_record": os.path.basename(full_path),
         },
     }))

@@ -105,19 +105,22 @@ class Stats(Checker):
                 continue
             by_f[op.f][op.type] += 1
             total[op.type] += 1
-        never = sorted(str(f) for f, c in by_f.items()
-                       if c[OK] == 0 and (c[FAIL] > 0 or c[INFO] > 0))
-        out = {"valid": UNKNOWN if never else True,
-               "count": sum(total.values()),
-               "ok-count": total[OK], "fail-count": total[FAIL],
-               "info-count": total[INFO],
-               "by-f": {f: dict(c) for f, c in by_f.items()}}
-        if never:
-            # say WHY, in the result itself: an unexplained `unknown` from a
-            # composed checker is exactly the verdict class this framework
-            # exists to catch in others
-            out["error"] = f"no ok operations for f in {never}"
-        return out
+        # Per-f verdicts, reference-style (checker.clj:145-183: stats- puts
+        # a :valid? in every by-f block and the top level merges them): an
+        # f that never succeeded is UNKNOWN *in its own block* — the block
+        # is self-documenting, no top-level error string shouting at
+        # whoever reads a passing run's artifact under incident pressure.
+        blocks = {}
+        never = False
+        for f, c in by_f.items():
+            f_ok = c[OK] > 0 or not (c[FAIL] > 0 or c[INFO] > 0)
+            never = never or not f_ok
+            blocks[f] = {"valid": True if f_ok else UNKNOWN, **dict(c)}
+        return {"valid": UNKNOWN if never else True,
+                "count": sum(total.values()),
+                "ok-count": total[OK], "fail-count": total[FAIL],
+                "info-count": total[INFO],
+                "by-f": blocks}
 
 
 class UnhandledExceptions(Checker):

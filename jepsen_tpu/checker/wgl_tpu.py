@@ -57,6 +57,7 @@ from jepsen_tpu.checker.prep import (
 )
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
+from jepsen_tpu.ops import dedup as _dedup
 from jepsen_tpu.ops.dedup import compact_rows, sort_dedup_compact
 
 EV_NOP = 2
@@ -314,15 +315,25 @@ def make_engine(model: JaxModel, window: int, capacity: int,
         count0 = global_sum(valid.sum())
 
         def merge_rows(mask, states, valid, cand_mask, cand_states,
-                       cand_valid, ovf):
+                       cand_valid, ovf, round_new=None):
             """Dedup/compact the union of the existing set and this
             round's candidate rows; returns the new set, per-row newness,
-            and fixpoint/overflow signals."""
+            and fixpoint/overflow signals.
+
+            ``round_new`` (bool[C], tiled-fold path only) marks existing
+            rows that were added by an EARLIER fold of the same closure
+            round: they must stay in the returned ``cur_new`` (the next
+            round's delta frontier) but must not re-trigger the new-rows
+            fixpoint signal.  Encoded as origin 2 — dedup's ``new_rows``
+            only counts origin 1 (candidates), while the returned frontier
+            keeps any origin >= 1."""
             nc = cand_valid.shape[0]
             all_mask = jnp.concatenate([mask, cand_mask])
             all_states = jnp.concatenate([states, cand_states])
             all_valid = jnp.concatenate([valid, cand_valid])
-            origin = jnp.concatenate([jnp.zeros(C, jnp.int32),
+            exist_origin = (jnp.zeros(C, jnp.int32) if round_new is None
+                            else 2 * round_new.astype(jnp.int32))
+            origin = jnp.concatenate([exist_origin,
                                       jnp.ones(nc, jnp.int32)])
             if axis_name is not None:
                 all_mask = lax.all_gather(all_mask, axis_name, tiled=True)
@@ -353,7 +364,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 new_mask = new_keyed | expand_compact(new_compact, win_ops)
             else:
                 new_mask = new_keyed
-            cur_new2 = (out_orig == 1) & out_valid
+            cur_new2 = (out_orig >= 1) & out_valid
             if axis_name is not None:
                 start = lax.axis_index(axis_name) * C
                 new_mask = lax.dynamic_slice_in_dim(new_mask, start, C)
@@ -409,6 +420,57 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                                   cand_states.reshape(C * W, S),
                                   cv.reshape(C * W), ovf)
 
+            def merge_full_tiled(args):
+                """Full-grid merge as a fold over candidate tiles, each
+                merge kept under ops.dedup.WIDE_SORT_ROWS so every sort
+                takes the single-variadic-sort path.  One C*(W+1)-row
+                merge at capacity 65536 exceeds the threshold and falls
+                back to the _lex_perm sort chain, whose ~11 full-size
+                sort passes compile for tens of minutes on TPU — and
+                lax.switch compiles ALL branches, so every 65536-capacity
+                engine paid that even when the full fallback never ran.
+                The fold's loop body compiles ONCE at (C + tile) rows.
+
+                Soundness of folding: the existing set participates in
+                every fold, so duplicates against it are always dropped;
+                a candidate duplicating an earlier fold's survivor sees
+                that survivor as an existing row.  ``round_new`` threads
+                the this-round frontier through the folds (origin-2
+                protocol in merge_rows)."""
+                mask, states, valid, cur_new, ovf = args
+                flat_mask = cand_mask.reshape(C * W, MW)
+                flat_states = cand_states.reshape(C * W, S)
+                flat_cv = cv.reshape(C * W)
+                budget_rows = max(_dedup.WIDE_SORT_ROWS // num_shards - C,
+                                  C)
+                K = -(-(C * W) // budget_rows)  # ceil
+                T = -(-(C * W) // K)
+                pad = K * T - C * W
+                if pad:
+                    flat_mask = jnp.concatenate(
+                        [flat_mask, jnp.zeros((pad, MW), flat_mask.dtype)])
+                    flat_states = jnp.concatenate(
+                        [flat_states, jnp.zeros((pad, S),
+                                                flat_states.dtype)])
+                    flat_cv = jnp.concatenate(
+                        [flat_cv, jnp.zeros(pad, flat_cv.dtype)])
+
+                def fold(i, acc):
+                    mask, states, valid, rnew, total, newr, ovf = acc
+                    tm = lax.dynamic_slice_in_dim(flat_mask, i * T, T)
+                    ts = lax.dynamic_slice_in_dim(flat_states, i * T, T)
+                    tv = lax.dynamic_slice_in_dim(flat_cv, i * T, T)
+                    m2, s2, v2, rnew2, total2, nr2, ovf2 = merge_rows(
+                        mask, states, valid, tm, ts, tv, ovf,
+                        round_new=rnew)
+                    return (m2, s2, v2, rnew2, total2, newr | nr2, ovf2)
+
+                init = (mask, states, valid, jnp.zeros_like(valid),
+                        count, jnp.bool_(False), ovf)
+                m2, s2, v2, rnew, total, newr, ovf2 = lax.fori_loop(
+                    0, K, fold, init)
+                return m2, s2, v2, rnew, total, newr, ovf2
+
             def do(args):
                 if single_round_closure:
                     # vmap runs every switch branch, so the batched engine
@@ -422,6 +484,9 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 # (well under C/2 in steady state), burst rounds take the
                 # C or 4C buffers, and the full grid is the rare fallback.
                 half = max(1, C // 2)
+                full = (merge_full_tiled
+                        if num_shards * C * (W + 1) > _dedup.WIDE_SORT_ROWS
+                        else merge_full)
                 sel = jnp.where(nv_max <= half, 0,
                                 jnp.where(nv_max <= C, 1,
                                           jnp.where(nv_max <= 4 * C, 2,
@@ -429,7 +494,7 @@ def make_engine(model: JaxModel, window: int, capacity: int,
                 return lax.switch(sel, [merge_compacted(half),
                                         merge_compacted(C),
                                         merge_compacted(4 * C),
-                                        merge_full], args)
+                                        full], args)
 
             def skip(args):
                 mask, states, valid, cur_new, ovf = args
@@ -740,10 +805,9 @@ def _chunk_slicer(chunk: int, axis: int = 0):
 def _get_run_chunk(model: JaxModel, window: int, capacity: int,
                    gwords: int = 1):
     # Same-named registry models share step semantics; keying on the name +
-    # initial state (not the closure id) lets every get_model() call reuse
-    # one compiled engine.
-    from jepsen_tpu.ops import dedup as _dedup
-    key = (model.name, model.state_size,
+    # variant + initial state (not the closure id) lets every get_model()
+    # call reuse one compiled engine.
+    key = (model.name, model.variant, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
            gwords, _dedup.N_PROBES, _dedup.WIDE_SORT_ROWS, _dedup.SUBSUME,
            CLOSURE_WORK_BUDGET)
@@ -981,9 +1045,18 @@ def check(model: JaxModel, history: Optional[History] = None,
 
     explored = int(carry[9])
     if overflow:
+        # ``explored`` only accumulates at converged RETURN prunes; a
+        # history that overflows before any return prunes (the ceiling
+        # shape: one giant ghost-burst closure) would report 0 even though
+        # the engine explored a full frontier per closure round.  Count the
+        # in-progress (clipped) frontier — its high-water mark — as
+        # explored work so the overflow artifact shows what the engine did
+        # before degrading.
         return {"valid": "unknown", "analyzer": "wgl-tpu",
                 "error": f"configuration capacity exceeded at {cap}",
-                "configs-explored": explored}
+                "configs-explored": explored + int(carry[11]),
+                "closure-rounds": int(carry[10]),
+                "max-capacity-reached": max_cap_reached}
     if not failed:
         return {"valid": True, "analyzer": "wgl-tpu",
                 "configs-explored": explored,

@@ -117,6 +117,10 @@ def check(history: History,
     if consistency_models is None:
         consistency_models = (("strict-serializable",) if realtime
                               else ("serializable",))
+    # Client ops only: a nemesis op's value (e.g. the killed node list)
+    # is not a txn, and elle likewise analyzes the client subhistory
+    # (elle's history preparation removes non-txn ops).
+    history = history.client_ops()
     oks: List[Tuple[int, Op]] = []
     failed_writes: Set[Tuple[Any, Any]] = set()
     info_writes: Set[Tuple[Any, Any]] = set()

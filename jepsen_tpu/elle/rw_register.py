@@ -55,6 +55,8 @@ def check(history: History, realtime: bool = False,
     if consistency_models is None:
         consistency_models = (("strict-serializable",) if realtime
                               else ("serializable",))
+    # Client ops only (see list_append.check: nemesis values are not txns).
+    history = history.client_ops()
     pairs = history.pair_index()
     oks: List[Tuple[int, Op]] = []
     failed_writes: Set[Tuple[Any, Any]] = set()

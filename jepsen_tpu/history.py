@@ -215,9 +215,12 @@ class History(Sequence):
         return History([o for o in self.ops if o.process != NEMESIS])
 
     def complete(self) -> "History":
-        """Knossos history/complete parity: fill invoke values from their
-        completions (e.g. a read invoked with value=None completes with the
-        observed value) and mark unmatched invokes as info."""
+        """Knossos history/complete parity: an OK completion's value is
+        adopted by its invocation unconditionally (knossos history/complete
+        assoc's the completion :value onto the invoke), so reads invoked with
+        structured placeholders like [[k, None], ...] step the model with the
+        observed value, not the placeholder. Unmatched invokes stay open
+        (treated as concurrent-to-the-end by the checkers)."""
         pairs = self.pair_index()
         out = []
         for i, op in enumerate(self.ops):
@@ -225,7 +228,7 @@ class History(Sequence):
                 j = pairs[i]
                 if j >= 0:
                     comp = self.ops[j]
-                    if op.value is None and comp.type == OK:
+                    if comp.type == OK and comp.value is not None:
                         op = op.with_(value=comp.value)
             out.append(op)
         return History(out)

@@ -85,6 +85,12 @@ class JaxModel:
     # unknown — ops with these codes and unknown values can be dropped during
     # preprocessing (e.g. crashed reads; knossos does the same elimination).
     pure_read_fs: Tuple[int, ...] = ()
+    # Engine-cache discriminator: parametrized models whose STEP SEMANTICS
+    # differ while (name, state_size, init_state) coincide MUST set this
+    # (e.g. multi-register's (keys, vbits) packing) — compiled engines are
+    # cached by name + shape + variant, and a collision silently runs the
+    # wrong step function.
+    variant: Tuple = ()
 
     def init_state_array(self) -> np.ndarray:
         return np.asarray(self.init_state, np.int32).reshape(self.state_size)

@@ -2,8 +2,8 @@
 
 The reference's suites construct these via knossos.model (e.g. mutex for lock
 services, fifo-queue for queue workloads); see the external-library inventory
-in SURVEY.md §2.2.  Host tier for all; device tier for mutex (trivial state)
-and bounded-domain set.
+in SURVEY.md §2.2.  Host tier for all; device tier for mutex (trivial state),
+bounded-domain set, and the multi-register (k int32 lanes).
 """
 
 from __future__ import annotations
@@ -144,6 +144,11 @@ class MultiRegister(Model):
             if op.value is None:
                 return self
             for k, v in dict(op.value).items():
+                # Nil reads are always legal (multi_key_acid.clj:22-23): a
+                # None value is an unfilled placeholder (pending/info read),
+                # not an observation of "key absent".
+                if v is None:
+                    continue
                 if d.get(k) != v:
                     return inconsistent(f"key {k!r}: read {v!r}, have {d.get(k)!r}")
             return self
@@ -151,6 +156,83 @@ class MultiRegister(Model):
             d.update(dict(op.value))
             return MultiRegister(tuple(sorted(d.items(), key=repr)))
         return inconsistent(f"unknown f {op.f!r}")
+
+
+# -- multi-register, device tier --------------------------------------------
+
+F_MR_READ, F_MR_WRITE = 0, 1
+
+
+@register_model("multi-register")
+def multi_register_jax(keys: int = 3, vbits: int = 4) -> JaxModel:
+    """Device tier for :class:`MultiRegister`: k int32 lanes, one per key.
+
+    Multi-key ops (the multi_key_acid.clj / crdb / tidb register shapes,
+    BASELINE configs #4/#5) pack into the engine's (f, a, b) encoding:
+    ``a`` is the touched-key bitmask, ``b`` packs each touched key's value in
+    ``vbits``-bit fields.  None read values are simply absent from the mask —
+    nil reads are always legal (multi_key_acid.clj:22-23) — and an op whose
+    mask is empty (e.g. a crashed read that never observed anything) encodes
+    ``a = UNKNOWN32`` so preprocessing's crashed-read elimination drops it.
+
+    Constraints checked at encode time: integer keys in [0, keys), integer
+    values in [0, 2**vbits); keys ≤ 31 and keys*vbits ≤ 31 so both fields fit
+    an int32.  Out-of-domain histories raise ValueError — the competition
+    facade then falls through to the host oracle.
+    """
+    if keys > 31 or keys * vbits > 31:
+        raise ValueError(f"multi-register device tier needs keys<=31 and "
+                         f"keys*vbits<=31 (got {keys}x{vbits})")
+    vmask = (1 << vbits) - 1
+    lanes = np.arange(keys, dtype=np.int32)
+
+    def step(state, f, a, b):
+        unconstrained = a == UNKNOWN32
+        mask = jnp.where(unconstrained, 0, a)
+        touched = ((mask >> lanes) & 1) == 1
+        vals = (b >> (lanes * vbits)) & vmask
+        is_read = f == F_MR_READ
+        is_write = f == F_MR_WRITE
+        read_ok = jnp.all(~touched | (state == vals))
+        ok = jnp.where(is_read, read_ok, is_write)
+        new_state = jnp.where(is_write & touched, vals, state)
+        return jnp.where(ok, new_state, state), ok
+
+    def encode(op: Op):
+        f = {"read": F_MR_READ, "r": F_MR_READ,
+             "write": F_MR_WRITE, "w": F_MR_WRITE}.get(op.f)
+        if f is None:
+            raise ValueError(f"multi-register can't encode f={op.f!r}")
+        if op.value is None:
+            return f, UNKNOWN32, 0
+        mask = packed = 0
+        for k, v in dict(op.value).items():
+            if v is None:
+                if f == F_MR_WRITE:
+                    # The host model stores the None literally; silently
+                    # dropping the pair here would diverge the tiers.  No
+                    # workload writes nil (multi_key_acid.clj rand-val) —
+                    # refuse and let the facade fall back to the host.
+                    raise ValueError("multi-register can't encode a nil "
+                                     f"write for key {k!r}")
+                continue  # nil read: unconstraining
+            k, v = int(k), int(v)
+            if not 0 <= k < keys:
+                raise ValueError(f"key {k} outside [0, {keys})")
+            if not 0 <= v <= vmask:
+                raise ValueError(f"value {v} outside [0, {vmask}]")
+            mask |= 1 << k
+            packed |= v << (k * vbits)
+        if mask == 0:
+            return f, UNKNOWN32, 0
+        return f, mask, packed
+
+    return JaxModel(name="multi-register", state_size=keys,
+                    init_state=np.full(keys, UNKNOWN32 + 1, np.int32),
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: MultiRegister(),
+                    pure_read_fs=(F_MR_READ,),
+                    variant=(keys, vbits))
 
 
 # -- bounded-domain set, device tier ---------------------------------------

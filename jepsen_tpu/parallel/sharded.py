@@ -41,7 +41,7 @@ _CACHE: Dict[Any, Any] = {}
 def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
                     mesh: Mesh, axis: str, gwords: int = 1,
                     work_budget: Optional[int] = None):
-    key = ("shard", model.name, model.state_size,
+    key = ("shard", model.name, model.variant, model.state_size,
            tuple(model.init_state_array().tolist()), window,
            capacity_per_shard, id(mesh), axis, gwords, work_budget)
     if key in _CACHE:

@@ -185,6 +185,104 @@ def bitset_ceiling_history(k: int, n_clean: int = 200,
     return History(ops, reindex=True)
 
 
+def multi_register_history(n_ops: int,
+                           keys: int = 3,
+                           concurrency: int = 5,
+                           values: int = 5,
+                           crash_p: float = 0.003,
+                           seed: int = 0,
+                           read_p: float = 0.5) -> History:
+    """Simulate ``n_ops`` multi-key reads/writes against a key->value map
+    (the multi_key_acid.clj / BASELINE configs #4-#5 shape): writes upsert a
+    random key subset atomically; reads invoke with ``[[k, None], ...]``
+    placeholders and OK-complete with the observed values (None for unset
+    keys — nil reads are always legal).  Linearizable by construction."""
+    rng = random.Random(seed)
+    state: dict = {}
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending = {}
+    ghost_effects = []
+    t = 0
+    invoked = 0
+
+    def subset():
+        ks = rng.sample(range(keys), rng.randint(1, keys))
+        return sorted(ks)
+
+    def effect(p):
+        d = pending[p]
+        op = d["op"]
+        if op.f == "read":
+            d["result_value"] = [[k, state.get(k)] for k, _ in op.value]
+        else:
+            state.update({k: v for k, v in op.value})
+            d["result_value"] = op.value
+        d["result_type"] = OK
+        d["effected"] = True
+
+    while invoked < n_ops or pending:
+        t += rng.randint(1, 1000)
+        if ghost_effects and rng.random() < 0.3:
+            ge = ghost_effects.pop(rng.randrange(len(ghost_effects)))
+            state.update({k: v for k, v in ge["op"].value})
+        roll = rng.random()
+        if free and invoked < n_ops and (roll < 0.45 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            if rng.random() < read_p:
+                op = Op(process=p, type=INVOKE, f="read",
+                        value=[[k, None] for k in subset()], time=t)
+            else:
+                op = Op(process=p, type=INVOKE, f="write",
+                        value=[[k, rng.randrange(values)] for k in subset()],
+                        time=t)
+            history.append(op)
+            pending[p] = {"op": op, "effected": False,
+                          "result_type": None, "result_value": None}
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            d = pending[p]
+            if rng.random() < crash_p:
+                history.append(Op(process=p, type=INFO, f=d["op"].f,
+                                  value=d["op"].value if d["op"].f != "read"
+                                  else None,
+                                  time=t, error="crashed"))
+                if (not d["effected"] and d["op"].f != "read"
+                        and rng.random() < 0.5):
+                    ghost_effects.append(d)
+                del pending[p]
+                free.append(p)
+            elif not d["effected"]:
+                effect(p)
+            else:
+                history.append(Op(process=p, type=d["result_type"],
+                                  f=d["op"].f, value=d["result_value"],
+                                  time=t))
+                del pending[p]
+                free.append(p)
+
+    return History(history)
+
+
+def corrupt_multi_reads(history: History, n: int = 1, seed: int = 0,
+                        values: int = 5) -> History:
+    """Multi-register analog of :func:`corrupt_reads`: flip one observed key
+    of ``n`` ok-reads to an out-of-domain value."""
+    rng = random.Random(seed)
+    ops = [o.with_() for o in history]
+    read_oks = [i for i, o in enumerate(ops)
+                if o.type == OK and o.f == "read" and o.value]
+    if not read_oks:
+        raise ValueError("no ok reads to corrupt")
+    for i in rng.sample(read_oks, min(n, len(read_oks))):
+        pairs = [list(kv) for kv in ops[i].value]
+        j = rng.randrange(len(pairs))
+        pairs[j][1] = values + 1 + rng.randrange(values)
+        ops[i] = ops[i].with_(value=pairs)
+    return History(ops, reindex=True)
+
+
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
                   values: int = 5,
                   within: float | None = None) -> History:

@@ -1,0 +1,66 @@
+"""Commit-grade multi-key-acid run analyzed by the DEVICE engine.
+
+BASELINE configs #4/#5 name multi-key register histories
+(cockroach/tidb/yugabyte) as the flagship long-history targets; round 5's
+MultiRegister JaxModel (models/collections.py multi_register_jax) puts
+them on the TPU.  This runs the mka workload end-to-end over the pg-wire
+fake (generator -> interpreter -> wire client -> server -> history) and
+checks every group with ``algorithm="tpu"`` — the committed results.json
+must show ``analyzer: wgl-tpu`` per group.
+
+    python -m scripts.run_mka_device [--ops-per-group 400]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops-per-group", type=int, default=400)
+    ap.add_argument("--name", default="yb-mka-device")
+    args = ap.parse_args()
+
+    from jepsen_tpu import control, core, generator as gen
+    from jepsen_tpu.checker import Stats, compose
+    from suites.sqlextra import mka_workload
+    from tests.fakes import FakePgHandler, MiniSqlState, start_server
+
+    srv, port = start_server(FakePgHandler, MiniSqlState())
+    try:
+        def conn_factory(node, test):
+            from jepsen_tpu.clients.pgwire import PgClient
+            return PgClient(node, port=int(test["db_port"])).connect()
+
+        wl = mka_workload(conn_factory,
+                          ops_per_group=args.ops_per_group,
+                          algorithm="tpu")
+        test = {"name": args.name, "nodes": ["127.0.0.1"], "db_port": port,
+                "remote": control.DummyRemote(record_only=True),
+                "concurrency": 6,
+                "client": wl["client"],
+                "generator": [gen.time_limit(
+                    30.0, gen.clients(wl["generator"]))],
+                "checker": compose({"stats": Stats(),
+                                    "workload": wl["checker"]})}
+        done = core.run(test)
+        res = done["results"]
+        groups = res["workload"]["results"]
+        analyzers = {str(g): r.get("analyzer") for g, r in groups.items()}
+        print(json.dumps({"dir": done.get("store_dir"),
+                          "valid": res["valid"],
+                          "analyzers": analyzers}))
+        return 0 if res["valid"] is True else 1
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

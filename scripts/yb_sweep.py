@@ -1,0 +1,85 @@
+"""Yugabyte workload x nemesis sweep over the wire harness.
+
+The reference's CI driver (yugabyte/run-jepsen.py:34-59) sweeps its
+workload list against its nemesis list and sorts the results; this is the
+same role through ``jepsen_tpu.core.run_tests`` (cli.clj:433-519
+test-all): every (workload, nemesis) cell runs the full pipeline —
+generator -> interpreter -> pg-wire client -> fake serializable SQL server
+-> history -> checkers — with the dummy-record control plane standing in
+for SSH, and the summary table lands in store/yb-sweep/summary.json.
+
+    python -m scripts.yb_sweep [--time-limit 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+WORKLOADS = ["register", "append", "bank", "set", "long-fork",
+             "multi-key-acid", "counter"]
+NEMESES = ["none", "partition", "kill", "kill-master", "kill-tserver",
+           "clock"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--time-limit", type=float, default=2.0)
+    ap.add_argument("--concurrency", type=int, default=4)
+    args = ap.parse_args()
+
+    from jepsen_tpu import control, core
+    from suites.yugabyte.runner import yugabyte_test
+    from tests.fakes import FakePgHandler, MiniSqlState, start_server
+
+    # One fresh fake server per cell: the sweep's cells are independent
+    # tests, and a shared server would leak table state between them (a
+    # later cell's read observing an earlier cell's write is a refutation
+    # of the CHECKER, not the database) — the reference CI driver likewise
+    # reinstalls the DB for every run (run-jepsen.py:96-117).
+    t0 = time.time()
+    cells = []
+    for w in WORKLOADS:
+        for n in NEMESES:
+            srv, port = start_server(FakePgHandler, MiniSqlState())
+            try:
+                t = yugabyte_test({
+                    "workload": w, "nemesis": n,
+                    "nodes": ["127.0.0.1"],
+                    "db_port": port,
+                    "remote": control.DummyRemote(record_only=True),
+                    "concurrency": args.concurrency,
+                    "time_limit": args.time_limit,
+                    "nemesis_interval": 1.0,
+                    "store_base": "store/yb-sweep",
+                })
+                if w == "bank":
+                    t["bank"] = {"accounts": list(range(8)),
+                                 "total_amount": 100}
+                s = core.run_tests([t])
+                cells.append(s["results"][0])
+            finally:
+                srv.shutdown()
+    n_bad = sum(1 for r in cells if r["valid"] is False)
+    n_unknown = sum(1 for r in cells
+                    if r["valid"] not in (True, False))
+    summary = {"results": cells, "failures": n_bad, "unknown": n_unknown,
+               "wall_s": round(time.time() - t0, 1),
+               "matrix": {"workloads": WORKLOADS, "nemeses": NEMESES}}
+    os.makedirs("store/yb-sweep", exist_ok=True)
+    with open("store/yb-sweep/summary.json", "w") as f:
+        json.dump(summary, f, indent=2, default=str)
+    print(json.dumps({"cells": len(cells), "failures": n_bad,
+                      "unknown": n_unknown,
+                      "wall_s": summary["wall_s"]}))
+    return 1 if n_bad else (2 if n_unknown else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -487,8 +487,12 @@ class SqlCounterClient(_SqlClient):
                         "(id INT PRIMARY KEY, val INT)")
         try:
             self.conn.query("INSERT INTO counter VALUES (0, 0)")
-        except Exception:  # noqa: BLE001 — another client won the race
-            pass
+        except Exception:  # noqa: BLE001 — another client may win the race
+            # Only a duplicate-key race is benign: verify the row actually
+            # exists so a genuinely failed seed insert propagates instead of
+            # silently reading 0 for the whole run.
+            if not self.conn.query("SELECT val FROM counter WHERE id = 0"):
+                raise
 
     def invoke(self, test, op: Op) -> Op:
         try:
@@ -583,11 +587,21 @@ class MkaClient(_SqlClient):
 
 
 def mka_workload(conn_factory, groups: int = 3, keys_per_group: int = 3,
-                 ops_per_group: int = 120) -> Dict[str, Any]:
+                 ops_per_group: int = 120,
+                 algorithm: str = "competition") -> Dict[str, Any]:
     from jepsen_tpu import independent
     from jepsen_tpu.checker import Linearizable
+    from jepsen_tpu.models import get_model
+    # Device-tier multi-register (k int32 lanes); the competition facade
+    # races it against both host solvers and falls back cleanly when a
+    # history leaves the packed int32 domain.  Key counts past the packed
+    # encoding's 31-bit budget get the host-tier model outright.
     from jepsen_tpu.models import MultiRegister
+    try:
+        model = get_model("multi-register", keys=keys_per_group, vbits=3)
+    except ValueError:
+        model = MultiRegister()
     return {"generator": mka_generator(groups, keys_per_group,
                                        ops_per_group=ops_per_group),
-            "checker": independent.checker(Linearizable(MultiRegister())),
+            "checker": independent.checker(Linearizable(model, algorithm)),
             "client": MkaClient(conn_factory)}

@@ -387,3 +387,36 @@ class TestConsistencyModels:
                     ok_txn(1, [["r", "x", [1]]]))
         r = list_append.check(h)
         assert r["valid"] is True and r["not"] == [] and r["also-not"] == []
+
+
+class TestNemesisOpsExcluded:
+    """Regression (round-5 yb sweep): a nemesis op's value — e.g. the
+    killed-node list — is not a txn; elle checkers must analyze the
+    client subhistory only, not crash unpacking node names."""
+
+    def test_list_append_ignores_nemesis_values(self):
+        from jepsen_tpu.elle import list_append
+        from jepsen_tpu.history import History, Op
+        h = History([
+            Op(process=0, type="invoke", f="txn",
+               value=[["append", 1, 1]]),
+            Op(process="nemesis", type="info", f="kill",
+               value=["127.0.0.1", "127.0.0.2"]),
+            Op(process=0, type="ok", f="txn", value=[["append", 1, 1]]),
+            Op(process="nemesis", type="info", f="start",
+               value=["127.0.0.1"]),
+        ])
+        r = list_append.check(h)
+        assert r["valid"] is True, r
+
+    def test_rw_register_ignores_nemesis_values(self):
+        from jepsen_tpu.elle import rw_register
+        from jepsen_tpu.history import History, Op
+        h = History([
+            Op(process=0, type="invoke", f="txn", value=[["w", 0, 1]]),
+            Op(process="nemesis", type="info", f="kill",
+               value=["127.0.0.1"]),
+            Op(process=0, type="ok", f="txn", value=[["w", 0, 1]]),
+        ])
+        r = rw_register.check(h)
+        assert r["valid"] is True, r

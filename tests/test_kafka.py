@@ -3,7 +3,7 @@
 
 import pytest
 
-from jepsen_tpu.history import FAIL, History, INVOKE, OK, Op
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
 from jepsen_tpu.workloads.kafka import KafkaChecker
 
 
@@ -500,3 +500,76 @@ class TestGeneratorMachinery:
         assert crash_client_gen({}) is None
         assert crash_client_gen({"crash_clients": True,
                                  "concurrency": 4}) is not None
+
+
+class TestDrillDown:
+    """Reference debug-inspection helpers (kafka.clj:600-737) + their
+    wiring into refuted results."""
+
+    def _h(self):
+        return History(
+            ok(0, [["send", 0, [0, 10]]]) +
+            ok(0, [["send", 0, [1, 11]]]) +
+            ok(0, [["send", 0, [2, 12]]]) +
+            ok(0, [["send", 1, [0, 50]]]) +
+            ok(1, [["poll", {0: [[0, 10], [1, 11], [2, 12]]}]]))
+
+    def test_around_key_offset_trims(self):
+        from jepsen_tpu.workloads.kafka import around_key_offset
+        near = around_key_offset(0, 0, self._h(), n=1)
+        # sends at offsets 0,1 and the poll trimmed to offsets 0,1;
+        # key-1 send and offset-2 records are gone
+        assert len(near) == 3
+        polls = [m for op in near for m in op.value if m[0] == "poll"]
+        assert polls == [["poll", {0: [[0, 10], [1, 11]]}]]
+        assert all(m[1] == 0 for op in near for m in op.value
+                   if m[0] == "send")
+
+    def test_around_key_value_clips_neighborhood(self):
+        from jepsen_tpu.workloads.kafka import around_key_value
+        near = around_key_value(0, 11, self._h(), n=0)
+        sends = [m for op in near for m in op.value if m[0] == "send"]
+        polls = [m for op in near for m in op.value if m[0] == "poll"]
+        assert sends == [["send", 0, [1, 11]]]
+        assert polls == [["poll", {0: [[1, 11]]}]]
+
+    def test_writes_reads_by_type(self):
+        from jepsen_tpu.workloads.kafka import (reads_by_type,
+                                                writes_by_type)
+        h = History(
+            ok(0, [["send", 0, [0, 10]]]) +
+            [Op(process=2, type=INVOKE, f="txn",
+                value=[["send", 0, 99]]),
+             Op(process=2, type=FAIL, f="txn",
+                value=[["send", 0, 99]])] +
+            ok(1, [["poll", {0: [[0, 10]]}]]))
+        w = writes_by_type(h)
+        assert w[OK] == {0: {10}} and w[FAIL] == {0: {99}}
+        r = reads_by_type(h)
+        assert r[OK] == {0: {10}}
+
+    def test_must_have_committed(self):
+        from jepsen_tpu.workloads.kafka import (must_have_committed,
+                                                reads_by_type)
+        send = [Op(process=3, type=INVOKE, f="txn",
+                   value=[["send", 0, [5, 77]]]),
+                Op(process=3, type=INFO, f="txn",
+                   value=[["send", 0, [5, 77]]])]
+        seen = ok(1, [["poll", {0: [[5, 77]]}]])
+        h = History(send + seen)
+        rbt = reads_by_type(h)
+        assert must_have_committed(rbt, send[1]) is True
+        lone = History(send)
+        assert must_have_committed(reads_by_type(lone), send[1]) is False
+
+    def test_refuted_result_carries_neighborhood(self):
+        # duplicate value at two offsets: the refuted result must include
+        # the trimmed drill-down context for the anomaly
+        h = (ok(0, [["send", 0, [0, 10]]]) +
+             ok(0, [["send", 0, [2, 10]]]) +
+             ok(1, [["poll", {0: [[0, 10]]}]]))
+        r = check(h)
+        assert r["valid"] is False and "duplicate" in r["bad-error-types"]
+        dd = r["drill-down"]
+        assert "duplicate" in dd and dd["duplicate"][0]["around"], dd
+        assert "writes-by-type" in dd and "reads-by-type" in dd

@@ -212,3 +212,24 @@ class TestSharded:
                              chunk=256)
         r_1 = wgl_tpu.check(model, h, capacity=256, chunk=256)
         assert r_sh["valid"] == r_1["valid"] is True
+
+
+class TestBatchLaneGrouping:
+    def test_large_batches_dispatch_in_groups(self):
+        """Regression for the >=1024-vmapped-lane verdict corruption
+        (parallel/batch.py MAX_LANES_PER_GROUP): two distinct valid 8-op
+        histories alternated to 1024+ lanes must all verify valid.
+        Ungrouped, every lane of one history was refuted at its first
+        return on both backends."""
+        from jepsen_tpu.history import History
+        from jepsen_tpu.models import get_model
+        from jepsen_tpu.parallel.batch import check_batch
+        from jepsen_tpu.synth import cas_register_history
+        h0 = History(list(cas_register_history(
+            60, concurrency=4, crash_p=0.0, seed=500))[:8], reindex=True)
+        h1 = History(list(cas_register_history(
+            60, concurrency=4, crash_p=0.0, seed=501))[:8], reindex=True)
+        res = check_batch(get_model("cas-register"), [h0, h1] * 520,
+                          capacity=64)
+        assert len(res) == 1040
+        assert all(r["valid"] is True for r in res)

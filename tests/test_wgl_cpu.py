@@ -288,3 +288,53 @@ class TestThreeWayCompetition:
         h = cas_register_history(200, concurrency=4, crash_p=0.005, seed=9)
         r = Linearizable(CASRegister(), "linear").check({}, h)
         assert r["valid"] is True and r["analyzer"] == "linear-cpu"
+
+
+class TestMultiRegisterSoundness:
+    """Round-4 judge's minimized false refutation: W(0->1) ok; W(0->2)
+    concurrent; R observes 2 -> must be VALID (order W1, W2, R).  Root
+    causes fixed in round 5: History.complete adopts OK-completion values
+    (knossos parity) and MultiRegister treats None reads as always legal
+    (multi_key_acid.clj:22-23)."""
+
+    def _mr(self, ops):
+        from jepsen_tpu.models import MultiRegister
+        return wgl_cpu.check(MultiRegister(), History(ops))
+
+    def test_concurrent_write_read_is_valid(self):
+        ops = [
+            mk(0, INVOKE, "write", [[0, 1]]),
+            mk(0, OK, "write", [[0, 1]]),
+            mk(1, INVOKE, "write", [[0, 2]]),
+            mk(2, INVOKE, "read", [[0, None]]),
+            mk(2, OK, "read", [[0, 2]]),
+            mk(1, OK, "write", [[0, 2]]),
+        ]
+        assert self._mr(ops)["valid"] is True
+
+    def test_placeholder_invoke_adopts_ok_value(self):
+        h = History([
+            mk(0, INVOKE, "read", [[0, None], [1, None]]),
+            mk(0, OK, "read", [[0, 5], [1, None]]),
+        ]).complete()
+        assert h[0].value == [[0, 5], [1, None]]
+
+    def test_nil_read_always_legal_after_write(self):
+        ops = [
+            mk(0, INVOKE, "write", [[0, 1]]),
+            mk(0, OK, "write", [[0, 1]]),
+            mk(1, INVOKE, "read", [[0, None]]),
+            mk(1, OK, "read", [[0, None]]),
+        ]
+        assert self._mr(ops)["valid"] is True
+
+    def test_real_stale_read_still_refuted(self):
+        ops = [
+            mk(0, INVOKE, "write", [[0, 1]]),
+            mk(0, OK, "write", [[0, 1]]),
+            mk(1, INVOKE, "write", [[0, 2]]),
+            mk(1, OK, "write", [[0, 2]]),
+            mk(2, INVOKE, "read", [[0, 1]]),
+            mk(2, OK, "read", [[0, 1]]),
+        ]
+        assert self._mr(ops)["valid"] is False
