@@ -1,0 +1,57 @@
+"""Minimized reproducer for the >=1024-lane vmapped bool-scatter bug.
+
+Found while scaling the batch checking driver (parallel/batch.py): a
+vmapped scatter into a BOOL array inside ``lax.scan`` returns wrong
+results at batch >= 1024, on both the CPU and TPU backends, jitted or
+eager.  int32 arrays are unaffected; batch 1023 is bit-perfect.  The
+engine's ``active``/``fresh`` slot updates are exactly this shape, so the
+batch driver caps vmapped groups at ``MAX_LANES_PER_GROUP`` (512) — see
+parallel/batch.py.
+
+Run ``python -m jepsen_tpu.ops.jax_bug_repro`` to print ok/BAD per batch
+size; kept as an executable record so the workaround can be dropped the
+day this prints all-ok on the pinned jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+W = 8
+
+
+def reproduce(batch: int, steps: int = 6) -> bool:
+    """True iff jax matches the numpy reference at this batch size."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(c, ev):
+        cond, arr = c
+        slot = ev[0] % W
+        arr = arr.at[slot].set(jnp.where(cond, False, arr[slot]))
+        return (ev[1] % 2 == 0, arr), None
+
+    def run(carry, events):
+        return lax.scan(step, carry, events)[0]
+
+    rng = np.random.default_rng(0)
+    events = rng.integers(0, 100, (batch, steps, 2)).astype(np.int32)
+    conds = rng.random(batch) < 0.5
+    arrs = np.ones((batch, W), bool)
+    f = jax.jit(jax.vmap(run, in_axes=((0, 0), 0)))
+    _, arr = f((jnp.asarray(conds), jnp.asarray(arrs)),
+               jnp.asarray(events))
+    c = conds.copy()
+    a = arrs.copy()
+    for s in range(steps):
+        sl = events[:, s, 0] % W
+        a[np.arange(batch), sl] = np.where(
+            c, False, a[np.arange(batch), sl])
+        c = events[:, s, 1] % 2 == 0
+    return bool(np.array_equal(np.asarray(arr), a))
+
+
+if __name__ == "__main__":
+    for b in (512, 1022, 1023, 1024, 2048):
+        print(b, "ok" if reproduce(b) else "BAD", flush=True)

@@ -41,16 +41,20 @@ _CACHE: Dict[Any, Any] = {}
 #: XLA program's duration roughly constant regardless of batch size.
 LANE_EVENTS_PER_DISPATCH = 16384
 
-#: Max lanes per vmapped dispatch group.  Empirical: at EXACTLY >= 1024
-#: lanes the vmapped engine returns corrupt verdicts (hand-minimized: two
-#: distinct valid 8-op register histories alternated 512x -> every lane of
-#: one history refuted at its first return; 1023 lanes of the same input
-#: are verdict-perfect, 1024 identical lanes are fine, and the pure-JAX
-#: gather/scatter/sort scan shapes reproduce nothing in isolation).  The
-#: corruption reproduces on BOTH the CPU and TPU backends and with eager
-#: (un-jitted) vmap, so it sits below this driver — gate the group size
-#: well under the cliff.  512 is also the measured throughput sweet spot
-#: on hardware (58.9 h/s at 512 lanes vs 52.1 at 256 on 200-op lanes).
+#: Max lanes per vmapped dispatch group.  Root cause (minimized to pure
+#: JAX, reproduces on CPU and TPU backends and with eager vmap): a
+#: vmapped scatter into a BOOL array inside ``lax.scan`` computes wrong
+#: results at batch >= 1024 — ``jax.vmap(lambda arr, slot:
+#: arr.at[slot].set(False))`` over bool[W] carriers, exactly the engine's
+#: ``active``/``fresh`` slot updates; int32 carriers are unaffected, 1023
+#: lanes are verdict-perfect (see tests/test_parallel.py regression and
+#: ops/jax_bug_repro.py).  Engine-side symptom before the cap: two
+#: distinct valid 8-op histories alternated 512x -> every lane of one
+#: history refuted at its first return.  512 is also the throughput knee
+#: measured in the one-off hardware tuning sweep (58.9 h/s at 512 lanes
+#: vs 52.1 at 256 on 200-op lanes; the committed bench artifact's
+#: 512-lane row reproduces the level at 56.3 h/s), so grouping costs
+#: nothing.
 MAX_LANES_PER_GROUP = 512
 
 

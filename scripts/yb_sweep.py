@@ -75,10 +75,36 @@ def main() -> int:
     os.makedirs("store/yb-sweep", exist_ok=True)
     with open("store/yb-sweep/summary.json", "w") as f:
         json.dump(summary, f, indent=2, default=str)
+    write_table(summary, "store/yb-sweep/summary.md")
     print(json.dumps({"cells": len(cells), "failures": n_bad,
                       "unknown": n_unknown,
                       "wall_s": summary["wall_s"]}))
     return 1 if n_bad else (2 if n_unknown else 0)
+
+
+def write_table(summary: dict, path: str) -> None:
+    """Markdown workload x nemesis verdict matrix (the reference's
+    sort-results.sh role: a human-scannable sweep table)."""
+    by_name = {r["name"]: r for r in summary["results"]}
+    ws = summary["matrix"]["workloads"]
+    ns = summary["matrix"]["nemeses"]
+    mark = {True: "ok", False: "FAIL", "unknown": "?"}
+    lines = ["# yugabyte sweep — workload x nemesis", "",
+             "| workload | " + " | ".join(ns) + " |",
+             "|---|" + "---|" * len(ns)]
+    for w in ws:
+        row = [w]
+        for n in ns:
+            r = by_name.get(f"yugabyte-{w}-{n}")
+            row.append(mark.get(r["valid"], str(r["valid"]))
+                       if r else "-")
+        lines.append("| " + " | ".join(row) + " |")
+    lines += ["", f"{len(summary['results'])} cells, "
+                  f"{summary['failures']} failures, "
+                  f"{summary['unknown']} unknown, "
+                  f"{summary['wall_s']} s wall."]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
 
 
 if __name__ == "__main__":

@@ -233,3 +233,11 @@ class TestBatchLaneGrouping:
                           capacity=64)
         assert len(res) == 1040
         assert all(r["valid"] is True for r in res)
+
+    def test_bool_scatter_repro_documents_the_cliff(self):
+        """The upstream bug MAX_LANES_PER_GROUP works around, as an
+        executable record: vmapped bool-scatter-in-scan is correct at 512
+        (our group size).  (At >=1024 it miscomputes on current jax; we
+        don't assert that so a fixed jax doesn't fail the suite.)"""
+        from jepsen_tpu.ops.jax_bug_repro import reproduce
+        assert reproduce(512) is True
