@@ -52,7 +52,6 @@ SMOKE = bool(os.environ.get("JTPU_BENCH_SMOKE"))
 N_OPS = 600 if SMOKE else 10_000
 CPU_TIMEOUT_S = 20.0 if SMOKE else 300.0
 TARGET_S = 60.0
-CHUNK = 512
 BATCH_N = 16 if SMOKE else 96
 BATCH_OPS = 200
 RESULT_TAG = "JTPU_TIER_RESULT "
@@ -186,11 +185,11 @@ def build_batch():
 # ---------------------------------------------------------------------------
 
 
-def warm_shapes(model, window, caps, gw, chunk=CHUNK):
+def warm_shapes(model, window, caps, gw, chunk=512):
     """Compile every (window, capacity, gwords, chunk) engine an escalating
     check() on this tier could request, by running each on one all-NOP
-    chunk of the size the driver will really dispatch at that capacity
-    (chunk shrinks as capacity grows — wgl_tpu.chunk_for_capacity).  NOP
+    chunk of the size the driver will really dispatch (capacity-invariant
+    — wgl_tpu.chunk_for_capacity returns the base chunk).  NOP
     events take the identity branch of the event switch — no closure, no
     search — so unlike round 2's run-a-real-history warm-up this cannot
     blow up on the history itself, and the call path leaves the jit
@@ -268,9 +267,12 @@ def _device_tier(history, *, capacity, max_capacity, runs, explain=True,
     prep = prepare(history, model)
     window = wgl_tpu._round_window(prep.window)
     gw = wgl_tpu.chosen_gwords(prep)
-    progress(f"warm window={window} gw={gw} caps={cap_ladder(capacity, max_capacity)}")
+    cc = wgl_tpu.auto_chunk(prep, model)
+    progress(f"warm window={window} gw={gw} chunk={cc} "
+             f"caps={cap_ladder(capacity, max_capacity)}")
     t0 = time.time()
-    warm_shapes(model, window, cap_ladder(capacity, max_capacity), gw)
+    warm_shapes(model, window, cap_ladder(capacity, max_capacity), gw,
+                chunk=cc)
     warm_s = round(time.time() - t0, 1)
     # One untimed SHAKEOUT run: warm_shapes covers the engine programs,
     # but the first real check also touches the event-stream slicer (jit
@@ -282,16 +284,16 @@ def _device_tier(history, *, capacity, max_capacity, runs, explain=True,
     # timed region and is disclosed in the artifact.
     t0 = time.time()
     wgl_tpu.check(model, history, prepared=prep, capacity=capacity,
-                  chunk=CHUNK, max_capacity=max_capacity, explain=False)
+                  chunk=cc, max_capacity=max_capacity, explain=False)
     shakeout_s = round(time.time() - t0, 2)
     progress(f"timed runs (shakeout {shakeout_s}s)")
     r, walls = timed_runs(
         lambda: wgl_tpu.check(model, history, prepared=prep,
-                              capacity=capacity, chunk=CHUNK,
+                              capacity=capacity, chunk=cc,
                               max_capacity=max_capacity, explain=explain),
         runs)
-    return r, walls, {"window": prep.window, "gwords": gw, "warm_s": warm_s,
-                      "shakeout_s": shakeout_s}
+    return r, walls, {"window": prep.window, "gwords": gw, "chunk": cc,
+                      "warm_s": warm_s, "shakeout_s": shakeout_s}
 
 
 def tier_easy():
@@ -517,7 +519,7 @@ def tier_setup2():
     from jepsen_tpu.synth import cas_register_history
     m = get_model("cas-register")
     h = cas_register_history(200, concurrency=8, crash_p=0.005, seed=7)
-    r = wgl_tpu.check(m, h, capacity=1024, chunk=CHUNK)
+    r = wgl_tpu.check(m, h, capacity=1024)
     assert r["valid"] is True
     emit({"setup_s": round(time.time() - t0, 1)})
 
@@ -632,7 +634,7 @@ def main():
         "n_ops": N_OPS,
         "timing": "median-of-3",
         "tier_isolation": "per-tier subprocess + timeout",
-        "chunk": CHUNK,
+        "chunk": "auto (1024: ghost-light 1-lane-state; else 512)",
         "analyzer": "wgl-tpu",
         "tiers": tiers,
     }
@@ -653,7 +655,7 @@ def main():
             "max_capacity_reached", "histories_per_sec", "n_histories",
             "ops_each", "setup_s", "timeout_s", "rc", "subsume",
             "failed_op_index", "stream_fraction_to_refute",
-            "degradation_timed", "window", "warm_s", "shakeout_s",
+            "degradation_timed", "window", "warm_s", "shakeout_s", "chunk",
             "device_vs_socket", "cpu_histories_per_sec_socket",
             "break_even_cores", "host_cores", "vs_cpu",
             "vs_cpu_is_lower_bound", "cpu")

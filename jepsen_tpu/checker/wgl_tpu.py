@@ -881,10 +881,34 @@ def chunk_for_capacity(capacity: int, base_chunk: int) -> int:
 WITNESS_BUDGET = 200_000
 
 
+#: Auto-chunk rule (chunk=None): histories unlikely to escalate take the
+#: COARSE chunk — fewer chunk-boundary polls over a tunneled device —
+#: while escalation-prone ones keep the fine chunk, whose tighter
+#: capacity adaptation wins once bursts drive capacity changes (coarser
+#: chunks discard more speculative work per change).  Escalation
+#: pressure has two measured drivers: ghosts (each pending crashed op
+#: can double the config set) and multi-lane state (wider state, bigger
+#: spaces).  Measured on hardware, 10k-op histories: register-easy
+#: (~3 ghosts, 1 lane) 3.08 s at 1024 vs 3.81 s at 512; register-hard
+#: (56 ghosts) 8.7 s at 512 vs 10.3 s at 1024; multi-register (7
+#: ghosts but 3 state lanes, escalates to 16384) 36.2 s at 512 vs
+#: 40.6 s at 1024.
+AUTO_CHUNK_FINE = 512
+AUTO_CHUNK_COARSE = 1024
+AUTO_CHUNK_GHOST_MAX = 8
+
+
+def auto_chunk(p: PreparedHistory, model: JaxModel) -> int:
+    """Events per dispatch for this history under the auto-chunk rule."""
+    return (AUTO_CHUNK_COARSE
+            if p.n_ghosts <= AUTO_CHUNK_GHOST_MAX and model.state_size == 1
+            else AUTO_CHUNK_FINE)
+
+
 def check(model: JaxModel, history: Optional[History] = None,
           prepared: Optional[PreparedHistory] = None,
           capacity: int = 1024, max_capacity: int = 65536,
-          chunk: int = 512, max_window: int = 4096,
+          chunk: Optional[int] = None, max_window: int = 4096,
           explain: bool = True, cancel=None,
           witness_budget: int = WITNESS_BUDGET,
           growth: int = 4) -> Dict[str, Any]:
@@ -901,10 +925,12 @@ def check(model: JaxModel, history: Optional[History] = None,
     transfer.  512 measured ~2x faster than 256 end-to-end on a tunneled
     TPU (chunk-boundary polls dominate there) with an *identical* capacity
     trajectory on the crash-burst benchmark — same configs explored, same
-    peak — so the coarser adaptation is theoretical on these workloads;
-    pass chunk=256 explicitly on directly-attached devices if adaptation
-    matters more than polls.  Pure-throughput batch checking with no
-    mid-stream adaptation (check_batch) uses larger chunks.
+    peak.  ``chunk=None`` (the default) picks per history: coarse 1024 for
+    ghost-light streams, fine 512 for ghost-heavy ones (see
+    :func:`auto_chunk` for the measured rationale).  Pass chunk=256
+    explicitly on directly-attached devices if adaptation matters more
+    than polls.  Pure-throughput batch checking with no mid-stream
+    adaptation (check_batch) uses its own batch-scaled chunks.
 
     ``cancel`` is an optional :class:`threading.Event` polled at chunk
     boundaries; when a competing solver already produced a definite verdict
@@ -912,11 +938,12 @@ def check(model: JaxModel, history: Optional[History] = None,
     ``cancelled: True`` (knossos.competition loser cancellation)."""
     p = prepared if prepared is not None else prepare(
         history, model, max_window=max_window)
+    if chunk is None:
+        chunk = auto_chunk(p, model)
     window = _round_window(p.window)
     # Pad the event stream to a chunk multiple PLUS one chunk-sized NOP
-    # cushion: progress is tracked in *event* units (chunk size changes
-    # with capacity — see chunk_for_capacity, always dividing down from
-    # ``chunk``), and the cushion guarantees any in-bounds dispatch offset
+    # cushion: progress is tracked in *event* units, and the cushion
+    # guarantees any in-bounds dispatch offset
     # can slice a full chunk without clamping back into (and re-applying!)
     # real events.  Trailing NOPs are inert.  Small-chunk callers keep
     # their small streams — padding to a fixed 512 would multiply

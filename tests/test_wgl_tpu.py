@@ -594,3 +594,31 @@ class TestEngineCacheVariant:
         tpu = wgl_tpu.check(m4, h, capacity=256, chunk=256)
         assert cpu["valid"] == tpu["valid"] is False
         assert cpu["op"]["index"] == tpu["op"]["index"]
+
+
+class TestAutoChunk:
+    def test_rule(self):
+        """chunk=None routes through auto_chunk: coarse only for
+        ghost-light histories on single-lane-state models (measured
+        rationale in the constant's comment)."""
+        from jepsen_tpu.checker.prep import prepare
+        from jepsen_tpu.checker.wgl_tpu import (AUTO_CHUNK_COARSE,
+                                                AUTO_CHUNK_FINE, auto_chunk)
+        reg = get_model("cas-register")
+        light = prepare(cas_register_history(120, concurrency=4,
+                                             crash_p=0.0, seed=1), reg)
+        heavy = prepare(cas_register_history(300, concurrency=4,
+                                             crash_p=0.08, seed=1), reg)
+        assert auto_chunk(light, reg) == AUTO_CHUNK_COARSE
+        assert heavy.n_ghosts > 8
+        assert auto_chunk(heavy, reg) == AUTO_CHUNK_FINE
+        from jepsen_tpu.synth import multi_register_history
+        mr = get_model("multi-register", keys=3, vbits=3)
+        mlight = prepare(multi_register_history(80, keys=3, concurrency=4,
+                                                crash_p=0.0, seed=1), mr)
+        assert auto_chunk(mlight, mr) == AUTO_CHUNK_FINE  # multi-lane state
+
+    def test_default_chunk_is_auto(self):
+        h = cas_register_history(120, concurrency=4, crash_p=0.0, seed=2)
+        r = wgl_tpu.check(get_model("cas-register"), h, capacity=64)
+        assert r["valid"] is True
