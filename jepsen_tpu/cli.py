@@ -34,6 +34,12 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dummy-ssh-record", action="store_true",
                    help="record-only control plane: log commands, execute "
                         "nothing (smoke-tests suite control logic)")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="never open SSH connections (cli.clj:85); "
+                        "control commands are recorded, not executed")
+    p.add_argument("--strict-host-key-checking", action="store_true",
+                   help="verify SSH host keys (cli.clj:82; default off, "
+                        "like the reference's default)")
     p.add_argument("--concurrency", "-c", default="1n",
                    help="worker count; '3n' = 3x node count")
     p.add_argument("--time-limit", type=float, default=60.0,
@@ -41,6 +47,8 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--test-count", type=int, default=1,
                    help="how many times to run the test")
     p.add_argument("--leave-db-running", action="store_true")
+    p.add_argument("--logging-json", action="store_true",
+                   help="jepsen.log as JSON lines (cli.clj:98)")
     p.add_argument("--store", default="store", help="results directory")
 
 
@@ -62,11 +70,16 @@ def test_opts_to_map(args) -> Dict[str, Any]:
                 "password": args.password,
                 "private_key_path": args.ssh_private_key,
                 "port": args.ssh_port,
-                "dummy": "record" if getattr(args, "dummy_ssh_record", False)
+                "strict_host_key_checking":
+                    getattr(args, "strict_host_key_checking", False),
+                "dummy": "record"
+                if (getattr(args, "dummy_ssh_record", False)
+                    or getattr(args, "no_ssh", False))
                 else args.dummy_ssh},
         "concurrency": args.concurrency,
         "time_limit": args.time_limit,
         "leave_db_running": args.leave_db_running,
+        "logging_json": getattr(args, "logging_json", False),
         "store_base": args.store,
     }
 

@@ -83,6 +83,8 @@ def conn_spec(test: Dict[str, Any], node: str) -> Dict[str, Any]:
             "user": ssh.get("username", "root"),
             "password": ssh.get("password"),
             "private_key_path": ssh.get("private_key_path"),
+            "strict_host_key_checking":
+                ssh.get("strict_host_key_checking", False),
             "namespace": ssh.get("namespace", "default")}
 
 

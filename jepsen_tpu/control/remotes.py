@@ -119,12 +119,24 @@ class SshRemote(Remote):
         user = self.spec.get("user", "root")
         return f"{user}@{self.spec.get('host')}"
 
+    def _common_opts(self) -> List[str]:
+        """Shared -o options for ssh AND scp.  Default: keys unchecked and
+        the user's known_hosts untouched (the reference's default,
+        cli.clj:82-84).  With strict checking requested, the known-hosts
+        override must NOT apply — /dev/null knows no keys, and with
+        BatchMode forbidding the accept prompt the connection could never
+        succeed."""
+        opts = ["-o", "BatchMode=yes", "-o", "LogLevel=ERROR"]
+        if self.spec.get("strict_host_key_checking"):
+            opts += ["-o", "StrictHostKeyChecking=yes"]
+        else:
+            opts += ["-o", "StrictHostKeyChecking=no",
+                     "-o", "UserKnownHostsFile=/dev/null"]
+        return opts
+
     def _ssh_argv(self, master: bool = False) -> List[str]:
-        argv = ["ssh", "-o", "BatchMode=yes",
-                "-o", "StrictHostKeyChecking=no",
-                "-o", "UserKnownHostsFile=/dev/null",
-                "-o", "LogLevel=ERROR",
-                "-p", str(self.spec.get("port", 22))]
+        argv = (["ssh"] + self._common_opts()
+                + ["-p", str(self.spec.get("port", 22))])
         if self.ctrl_path:
             argv += ["-o", f"ControlPath={self.ctrl_path}"]
             if master:
@@ -147,11 +159,8 @@ class SshRemote(Remote):
         return _run(self._ssh_argv() + [full], stdin=stdin)
 
     def _scp_base(self) -> List[str]:
-        argv = ["scp", "-o", "BatchMode=yes",
-                "-o", "StrictHostKeyChecking=no",
-                "-o", "UserKnownHostsFile=/dev/null",
-                "-o", "LogLevel=ERROR",
-                "-P", str(self.spec.get("port", 22))]
+        argv = (["scp"] + self._common_opts()
+                + ["-P", str(self.spec.get("port", 22))])
         if self.ctrl_path:
             argv += ["-o", f"ControlPath={self.ctrl_path}"]
         pk = self.spec.get("private_key_path")

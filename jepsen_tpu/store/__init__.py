@@ -230,12 +230,32 @@ def runs(base: str = BASE) -> List[Dict[str, Any]]:
     return out
 
 
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per log line (cli.clj:98 --logging-json parity):
+    machine-ingestable run logs for fleet/CI pipelines."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {"ts": self.formatTime(record),
+                 "level": record.levelname,
+                 "thread": record.threadName,
+                 "logger": record.name,
+                 "message": record.getMessage()}
+        if record.exc_info:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry, default=str)
+
+
 def start_logging(test: Dict[str, Any]) -> logging.Handler:
-    """Per-run log file (store.clj:474 start-logging!)."""
+    """Per-run log file (store.clj:474 start-logging!).  With
+    ``test["logging_json"]`` the file is JSON-lines (cli.clj:98)."""
     d = test.get("store_dir") or make_run_dir(test)
     h = logging.FileHandler(os.path.join(d, "jepsen.log"))
-    h.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: %(message)s"))
+    if test.get("logging_json"):
+        h.setFormatter(JsonLineFormatter())
+    else:
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s [%(threadName)s] %(name)s: "
+            "%(message)s"))
     root = logging.getLogger()
     root.addHandler(h)
     if root.level > logging.INFO:

@@ -113,3 +113,26 @@ class TestDbLifecycle:
         assert sorted(setups) == ["n1", "n2", "n3"]
         # teardown in cycle_ + final teardown
         assert len(teardowns) >= 6
+
+
+class TestJsonLogging:
+    def test_logging_json_writes_json_lines(self, tmp_path):
+        """cli.clj:98 --logging-json parity: jepsen.log as one JSON object
+        per line."""
+        import json
+        import logging
+        import os
+        from jepsen_tpu import store
+        test = {"name": "jsonlog", "store_base": str(tmp_path),
+                "logging_json": True}
+        h = store.start_logging(test)
+        try:
+            logging.getLogger("t.json").info("hello %s", "world")
+        finally:
+            store.stop_logging(h)
+        log = os.path.join(test["store_dir"], "jepsen.log")
+        lines = [ln for ln in open(log) if ln.strip()]
+        assert lines, "no log lines written"
+        rec = json.loads(lines[-1])
+        assert rec["message"] == "hello world"
+        assert rec["level"] == "INFO" and rec["logger"] == "t.json"
