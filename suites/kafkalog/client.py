@@ -1,12 +1,19 @@
 """kafkalog wire client: executes the kafka workload's op language against
 the real log server.
 
-Consumer positions live here (kafka's assign/seek/poll shape): assign and
-subscribe both take ownership of the listed partitions and seek to the
-log end (or the beginning when the final-polls catch-up phase asks via
-``op.extra["seek_to_beginning"]``).  ``crash`` completes :info so the
-interpreter burns the process and opens a fresh client — kafka.clj's
-crash-client semantics.
+Consumer positions live here (kafka's assign/seek/poll shape).  assign
+and subscribe take ownership of the listed partitions and resume from the
+GROUP'S COMMITTED offsets (kafka consumer-group semantics: positions are
+auto-committed after each successful poll, so a rebalance or a fresh
+client re-reads at most the uncommitted tail and NEVER skips unread
+records).  A partition with no committed offset starts at the log end —
+kafka's auto.offset.reset=latest — which at test start is offset 0, so
+the first era is gap-free too.  (The old seek-to-end-on-every-assign
+behavior produced era-jump gaps under load: an acked record that no
+consumer era covered read as a lost-write.)  The final-polls catch-up
+phase still forces ``op.extra["seek_to_beginning"]``.  ``crash``
+completes :info so the interpreter burns the process and opens a fresh
+client — kafka.clj's crash-client semantics.
 
 Error discipline: connect failures are FAIL (nothing was sent);
 mid-flight failures are INFO for txns containing sends (they may have
@@ -62,22 +69,47 @@ class Conn:
 
 
 class KafkaLogClient(jclient.Client):
-    def __init__(self, conn: Optional[Conn] = None):
+    def __init__(self, conn: Optional[Conn] = None,
+                 group: str = "jepsen-group"):
         self.conn = conn
+        self.group = group
         self.owned: Set[int] = set()
         self.positions: Dict[int, int] = {}
 
     def open(self, test, node):
-        return KafkaLogClient(Conn(test["kafkalog_ports"][node]))
+        return KafkaLogClient(Conn(test["kafkalog_ports"][node]),
+                              group=test.get("kafka_group", "jepsen-group"))
 
     def _seek(self, keys, to_beginning: bool) -> None:
         self.owned = set(keys)
         if to_beginning:
             self.positions = {k: 0 for k in self.owned}
             return
-        ends = self.conn.call({"op": "end_offsets",
-                               "keys": sorted(self.owned)})["ends"]
-        self.positions = {int(k): int(v) for k, v in ends.items()}
+        committed = self.conn.call(
+            {"op": "committed", "group": self.group,
+             "keys": sorted(self.owned)})["offsets"]
+        need_end = [k for k, pos in committed.items() if int(pos) < 0]
+        ends = {}
+        if need_end:
+            ends = self.conn.call({"op": "end_offsets",
+                                   "keys": sorted(need_end)})["ends"]
+        self.positions = {}
+        for k, pos in committed.items():
+            kk = int(k)
+            self.positions[kk] = (int(pos) if int(pos) >= 0
+                                  else int(ends.get(k, 0)))
+
+    def _auto_commit(self) -> None:
+        """Commit the current positions (kafka auto-commit after poll).
+        Best-effort: a lost commit only re-reads the uncommitted tail."""
+        if not self.positions:
+            return
+        try:
+            self.conn.call({"op": "commit", "group": self.group,
+                            "offsets": {str(k): v
+                                        for k, v in self.positions.items()}})
+        except (ConnectFailed, ConnectionError, OSError):
+            pass
 
     def invoke(self, test, op: Op) -> Op:
         sent_any = False
@@ -112,6 +144,7 @@ class KafkaLogClient(jclient.Client):
                     for k, rows in recs.items():
                         if rows:
                             self.positions[k] = rows[-1][0] + 1
+                    self._auto_commit()
                     out.append(["poll", recs])
             return op.with_(type=OK, value=out)
         except ConnectFailed as e:

@@ -35,6 +35,78 @@ from suites.kafkalog.client import KafkaLogClient
 from suites.kafkalog.db import KafkaLogDB
 
 
+class VanishedLog:
+    """Suite-specific strengthening of the kafka analyses: the kafkalog
+    daemon is an append-only log with NO retention/compaction and
+    synchronous polls, so a record once observed at (k, offset) can never
+    legitimately disappear from a later seek-to-beginning read.  The
+    generic offset analyses cannot use this (real kafka has retention, and
+    an empty poll is indistinguishable from consumer lag) — which is
+    exactly how a kill that wipes the whole log AFTER everything was
+    observed once slipped past them: nothing contradicts a history whose
+    final catch-up simply reads nothing.  Here an OK poll in a
+    seek-to-beginning era that returns records starting past the key's
+    earliest observed offset — or no records at all while the key
+    demonstrably held observed records — refutes durability.
+
+    (jepsen.checker protocol shape; composed into the suite's checker the
+    way localkv adds its own invariants.)"""
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.workloads.kafka import _poll_records
+        # ONE chronological pass: ``observed`` holds only offsets seen
+        # STRICTLY BEFORE the op being judged, so a record that lands
+        # (and is observed) after an era's legitimately-empty early poll
+        # can never retroactively refute it.
+        observed: Dict[Any, Dict[int, Any]] = {}
+        vanished = []
+        era_keys: Dict[Any, list] = {}     # process -> keys of current era
+        era_first: Dict[Any, Dict[int, int]] = {}  # process -> k -> first
+        for op in history:
+            if op.f == "assign" and op.type == "invoke" \
+                    and (op.extra or {}).get("seek_to_beginning"):
+                era_keys[op.process] = [int(k) for k in (op.value or [])]
+                era_first[op.process] = {}
+            elif op.f in ("assign", "subscribe") and op.type == "invoke":
+                era_keys.pop(op.process, None)
+            elif (op.type == "ok" and op.process in era_keys
+                  and isinstance(op.value, (list, tuple))):
+                for m in op.value:
+                    if not (isinstance(m, (list, tuple)) and m
+                            and m[0] == "poll" and isinstance(m[1], dict)):
+                        continue
+                    for k in era_keys[op.process]:
+                        recs = m[1].get(k, m[1].get(str(k), []))
+                        firsts = era_first[op.process]
+                        if k in firsts:
+                            continue  # era's first record already judged
+                        prior = observed.get(k, {})
+                        if not prior:
+                            continue
+                        mn = min(prior)
+                        if recs:
+                            firsts[k] = int(recs[0][0])
+                            if int(recs[0][0]) > mn:
+                                vanished.append(
+                                    {"key": k, "era-first": int(recs[0][0]),
+                                     "earliest-observed": mn,
+                                     "process": op.process})
+                        else:
+                            # synchronous read from the beginning returned
+                            # nothing although observed records existed
+                            firsts[k] = -1
+                            vanished.append(
+                                {"key": k, "era-first": None,
+                                 "earliest-observed": mn,
+                                 "process": op.process})
+            if op.type == "ok":
+                for k, o, v in _poll_records(op):
+                    observed.setdefault(int(k), {}).setdefault(int(o), v)
+        return {"valid": not vanished,
+                "vanished": vanished[:16],
+                "vanished-count": len(vanished)}
+
+
 def NEMESES(name, opts):
     if name == "none":
         return combined.Package()
@@ -98,6 +170,7 @@ def kafkalog_test(opts: Dict[str, Any]) -> Dict[str, Any]:
             "nemesis": pkg.nemesis,
             "generator": parts,
             "checker": compose({"stats": KafkaStats(),
+                                "durability": VanishedLog(),
                                 "workload": wl["checker"],
                                 "perf": Perf(),
                                 "timeline": Timeline()})}

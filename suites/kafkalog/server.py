@@ -26,6 +26,8 @@ Protocol: length-prefixed JSON frames (shared with localkv/raftkv):
   {"op": "poll", "positions": {k: pos}, "max": n}     -> {"ok", "records":
                                                           {k: [[o, v]...]}}
   {"op": "end_offsets", "keys": [k...]}               -> {"ok", "ends"}
+  {"op": "commit", "group": g, "offsets": {k: pos}}   -> {"ok"}
+  {"op": "committed", "group": g, "keys": [k...]}     -> {"ok", "offsets"}
   {"op": "ping"}                                      -> {"ok", "node"}
 
 Stdlib only; run as ``python server.py --node n1 --port P --data DIR``.
@@ -77,6 +79,13 @@ class LogStore:
         os.makedirs(data_dir, exist_ok=True)
         self.lock = threading.Lock()
         self.logs: dict = {}     # k -> [value]
+        # group -> {k: committed position} — kafka's __consumer_offsets
+        # role: consumer groups resume from committed positions, so a
+        # rebalance NEVER skips unread records (a seek-to-latest client
+        # produced era-jump gaps that read as lost-writes).  Persisted in
+        # the WAL under the same fsync policy as the data (kafka's
+        # offsets topic is a log with the same durability knobs).
+        self.committed: dict = {}
         self.fsync = fsync
         self.dup_p = dup_p
         self._rng = random.Random(seed)
@@ -102,6 +111,12 @@ class LogStore:
                     rec = json.loads(line)
                 except ValueError:
                     break  # torn tail write
+                if "c" in rec:  # committed-offsets record
+                    g = self.committed.setdefault(rec["c"], {})
+                    for k, pos in rec["o"].items():
+                        kk = int(k) if str(k).isdigit() else k
+                        g[kk] = max(g.get(kk, -1), int(pos))
+                    continue
                 self.logs.setdefault(rec["k"], []).append(rec["v"])
 
     def send(self, k, v):
@@ -134,6 +149,27 @@ class LogStore:
         with self.lock:
             return {k: len(self.logs.get(
                 int(k) if str(k).isdigit() else k, [])) for k in keys}
+
+    def commit(self, group, offsets):
+        """Advance the group's committed positions (monotonic max — a
+        stale consumer's late commit must not rewind a newer one past
+        re-read safety; kafka's group coordinator is last-write-wins, the
+        max keeps the gap-free invariant strictly)."""
+        with self.lock:
+            g = self.committed.setdefault(group, {})
+            for k, pos in offsets.items():
+                kk = int(k) if str(k).isdigit() else k
+                g[kk] = max(g.get(kk, -1), int(pos))
+            self.wal.write(json.dumps({"c": group, "o": offsets}) + "\n")
+            if self.fsync:
+                self.wal.flush()
+                os.fsync(self.wal.fileno())
+
+    def committed_offsets(self, group, keys):
+        with self.lock:
+            g = self.committed.get(group, {})
+            return {k: g.get(int(k) if str(k).isdigit() else k, -1)
+                    for k in keys}
 
 
 def main(argv=None) -> int:
@@ -174,6 +210,15 @@ def main(argv=None) -> int:
                     elif op == "end_offsets":
                         reply = {"ok": True,
                                  "ends": store.end_offsets(
+                                     msg.get("keys") or [])}
+                    elif op == "commit":
+                        store.commit(msg.get("group", ""),
+                                     msg.get("offsets") or {})
+                        reply = {"ok": True}
+                    elif op == "committed":
+                        reply = {"ok": True,
+                                 "offsets": store.committed_offsets(
+                                     msg.get("group", ""),
                                      msg.get("keys") or [])}
                     elif op == "ping":
                         reply = {"ok": True, "node": opts.node}

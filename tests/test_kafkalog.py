@@ -59,3 +59,125 @@ class TestKafkaLog:
         r = done["results"]["workload"]
         assert r["valid"] is False
         assert "duplicate" in r["bad-error-types"], r
+
+
+class TestGroupOffsets:
+    def test_rebalance_resumes_from_committed(self, tmp_path):
+        """Kafka group semantics (round-5 fix): a fresh consumer era
+        resumes from the group's committed offsets, never seek-to-end past
+        unread records.  The old behavior skipped offset 2 here, which
+        under load read as a lost-write of a perfectly durable record."""
+        import subprocess
+        import sys
+        import time
+        from suites.kafkalog.client import Conn, KafkaLogClient
+        from suites.kafkalog.server import __file__ as srv_file
+        from suites.localkv.runner import free_ports
+        from jepsen_tpu.history import OK, Op
+        port = free_ports(1)[0]
+        proc = subprocess.Popen(
+            [sys.executable, srv_file, "--node", "n1",
+             "--port", str(port), "--data", str(tmp_path / "d")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            for _ in range(50):
+                try:
+                    Conn(port).call({"op": "ping"})
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.1)
+            test = {"kafkalog_ports": {"n1": port}}
+            c1 = KafkaLogClient(Conn(port))
+            assert c1.invoke(test, Op(process=0, type="invoke", f="assign",
+                                      value=[0])).type == OK
+            # 9 records > the poll's max of 6, so one poll CANNOT read
+            # the whole log and committed < end — the distinguishing
+            # setup (with 3 records the old seek-to-end behavior passed
+            # this test vacuously)
+            for v in range(10, 19):
+                c1.invoke(test, Op(process=0, type="invoke", f="send",
+                                   value=[["send", 0, v]]))
+            r = c1.invoke(test, Op(process=0, type="invoke", f="poll",
+                                   value=[["poll", None]]))
+            polled = r.value[0][1][0]
+            read_through = polled[-1][0] + 1
+            assert read_through < 9  # poll max is 6: log end NOT reached
+            # a brand-new client (fresh era) must resume at the committed
+            # position, not the log end
+            c2 = KafkaLogClient(Conn(port))
+            c2.invoke(test, Op(process=1, type="invoke", f="assign",
+                               value=[0]))
+            assert c2.positions[0] == read_through, (
+                c2.positions, read_through)
+            r2 = c2.invoke(test, Op(process=1, type="invoke", f="poll",
+                                    value=[["poll", None]]))
+            polled2 = r2.value[0][1].get(0, [])
+            assert polled2 and polled2[0][0] == read_through
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+class TestVanishedLog:
+    def _h(self, *dicts):
+        from jepsen_tpu.history import History, Op
+        return History([Op(**d) for d in dicts])
+
+    def test_vanished_prefix_refuted(self):
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=0, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=0, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10], [1, 11]]}]]),
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll", value=[["poll", {0: []}]]),
+        )
+        r = VanishedLog().check({}, h)
+        assert r["valid"] is False and r["vanished-count"] == 1
+
+    def test_full_rewind_read_is_valid(self):
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=0, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=0, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10], [1, 11]]}]]),
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10]]}]]),
+        )
+        assert VanishedLog().check({}, h)["valid"] is True
+
+    def test_failed_era_polls_are_no_evidence(self):
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=0, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10]]}]]),
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="fail", f="poll", value=None),
+        )
+        assert VanishedLog().check({}, h)["valid"] is True
+
+    def test_truncated_prefix_refuted(self):
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=0, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10], [1, 11], [2, 12]]}]]),
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll",
+                 value=[["poll", {0: [[2, 12]]}]]),
+        )
+        r = VanishedLog().check({}, h)
+        assert r["valid"] is False
+        assert r["vanished"][0]["era-first"] == 2
