@@ -1,0 +1,68 @@
+"""Crash-safe file writes: temp file, fsync, atomic rename.
+
+The store's staged durability (store.clj:413-457 — save_0/1/2) only means
+anything if each artifact lands *whole*: a run killed mid-``json.dump``
+must not leave a torn ``test.json`` shadowing the previous good one, and a
+re-analysis (``load_history``) must never see half a ``history.jsonl``.
+The classic discipline: write to a temp file in the target directory (same
+filesystem, so the final rename is atomic), fsync the data, ``os.replace``
+over the destination, then best-effort fsync the directory so the rename
+itself survives a power cut.  Readers therefore observe either the old
+complete file or the new complete file, never a prefix.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Callable, Iterator
+
+
+def _fsync_dir(d: str) -> None:
+    """Durable rename: fsync the directory entry (best-effort — some
+    filesystems/platforms refuse O_RDONLY dir fds)."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_path(path: str) -> Iterator[str]:
+    """Yield a temp path in ``path``'s directory; on clean exit fsync it
+    and rename it over ``path``, on error delete it.  For writers that
+    need a *path* rather than a file object (np.savez, format.Writer)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    os.close(fd)
+    try:
+        yield tmp
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def atomic_write(path: str, write_fn: Callable, mode: str = "w") -> None:
+    """Run ``write_fn(file)`` against a temp file, then atomically publish
+    it as ``path`` (fsync before rename, directory fsync after)."""
+    with atomic_path(path) as tmp:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())

@@ -14,6 +14,8 @@ The linearizable checker lives in jepsen_tpu.checker.linearizable.
 
 from __future__ import annotations
 
+import threading
+import time as _time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from collections import Counter as _Counter, defaultdict
@@ -41,25 +43,79 @@ def merge_valid(valids: List[Any]) -> Any:
     return out
 
 
-def check_safe(checker: Checker, test, history, opts=None) -> Dict[str, Any]:
+def check_safe(checker: Checker, test, history, opts=None,
+               budget_s: Optional[float] = None) -> Dict[str, Any]:
     """Run a checker, converting crashes into unknown verdicts
-    (checker.clj:74)."""
-    try:
-        return checker.check(test, history, opts or {})
-    except Exception as e:  # noqa: BLE001
-        return {"valid": UNKNOWN, "error": str(e),
-                "traceback": traceback.format_exc()}
+    (checker.clj:74).
+
+    Every verdict gains ``duration-s`` — the checker's wall time — so
+    budget tuning for degradation chains is data-driven, not guessed.
+
+    ``budget_s`` (or ``opts["budget_s"]`` / ``test["checker_budget_s"]``)
+    bounds the checker's wall clock: past the budget the verdict degrades
+    to ``unknown`` with ``budget-exceeded`` instead of wedging the
+    analysis phase (decrease-and-conquer spirit, arXiv:2410.04581 — a
+    bounded partial answer beats an unbounded all-or-nothing solve).  The
+    over-budget checker thread is abandoned (daemonized), never joined."""
+    opts = opts or {}
+    if budget_s is None:
+        budget_s = opts.get("budget_s")
+    if budget_s is None:
+        budget_s = (test or {}).get("checker_budget_s")
+    t0 = _time.monotonic()
+
+    def finish(r: Dict[str, Any]) -> Dict[str, Any]:
+        if isinstance(r, dict):
+            r.setdefault("duration-s", round(_time.monotonic() - t0, 6))
+        return r
+
+    if budget_s is None:
+        try:
+            return finish(checker.check(test, history, opts))
+        except Exception as e:  # noqa: BLE001
+            return finish({"valid": UNKNOWN, "error": str(e),
+                           "traceback": traceback.format_exc()})
+
+    box: Dict[str, Any] = {}
+
+    def work():
+        try:
+            box["result"] = checker.check(test, history, opts)
+        except Exception as e:  # noqa: BLE001
+            box["error"] = {"valid": UNKNOWN, "error": str(e),
+                            "traceback": traceback.format_exc()}
+
+    th = threading.Thread(target=work, daemon=True,
+                          name=f"checker-{type(checker).__name__}")
+    th.start()
+    th.join(timeout=float(budget_s))
+    if th.is_alive():
+        return finish({"valid": UNKNOWN, "budget-exceeded": True,
+                       "budget-s": float(budget_s),
+                       "error": f"checker exceeded its {budget_s}s "
+                                "wall-clock budget"})
+    return finish(box["result"] if "result" in box else box["error"])
 
 
 class Compose(Checker):
     """Run named sub-checkers concurrently; merge verdicts
-    (checker.clj:87)."""
+    (checker.clj:87).
 
-    def __init__(self, checkers: Dict[str, Checker]):
+    ``budget_s`` gives every sub-checker the same wall-clock budget (they
+    run concurrently, so it is also approximately the compose's own wall
+    bound): a wedged sub-checker degrades to ``unknown`` while the rest
+    still report — one backend failure never costs the whole analysis.
+    Each sub-verdict carries ``duration-s`` (see :func:`check_safe`)."""
+
+    def __init__(self, checkers: Dict[str, Checker],
+                 budget_s: Optional[float] = None):
         self.checkers = checkers
+        self.budget_s = budget_s
 
     def check(self, test, history, opts=None):
         opts = opts or {}
+        if self.budget_s is not None and "budget_s" not in opts:
+            opts = {**opts, "budget_s": self.budget_s}
         names = list(self.checkers)
         with ThreadPoolExecutor(max_workers=max(1, len(names))) as ex:
             futs = {n: ex.submit(check_safe, self.checkers[n], test, history,
@@ -80,8 +136,9 @@ class Compose(Checker):
         return out
 
 
-def compose(checkers: Dict[str, Checker]) -> Checker:
-    return Compose(checkers)
+def compose(checkers: Dict[str, Checker],
+            budget_s: Optional[float] = None) -> Checker:
+    return Compose(checkers, budget_s=budget_s)
 
 
 class NoopChecker(Checker):

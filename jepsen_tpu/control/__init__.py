@@ -19,7 +19,10 @@ from jepsen_tpu.control.core import (  # noqa: F401
     env_str, escape,
 )
 from jepsen_tpu.control.remotes import (  # noqa: F401
-    DockerExec, DummyRemote, K8sExec, RetryRemote, SshRemote, list_pods,
+    DockerExec, DummyRemote, K8sExec, SshRemote, list_pods,
+)
+from jepsen_tpu.control.retry import (  # noqa: F401
+    RetryPolicy, RetryRemote, policy_for, retrying,
 )
 
 
@@ -90,7 +93,9 @@ def conn_spec(test: Dict[str, Any], node: str) -> Dict[str, Any]:
 
 def remote_for(test: Dict[str, Any]) -> Remote:
     """Choose the Remote prototype for a test: test["remote"] wins; dummy
-    mode (ssh {dummy: true}) routes everything to the local dummy."""
+    mode (ssh {dummy: true}) routes everything to the local dummy.  The
+    default SSH transport is wrapped in the retrying proxy under the test's
+    setup-phase policy (control/retry.clj parity — see control.retry)."""
     r = test.get("remote")
     if r is not None:
         return r
@@ -99,7 +104,7 @@ def remote_for(test: Dict[str, Any]) -> Remote:
         return DummyRemote(record_only=True)
     if dummy:
         return DummyRemote()
-    return RetryRemote(SshRemote())
+    return RetryRemote(SshRemote(), policy=policy_for(test, "setup"))
 
 
 def setup_sessions(test: Dict[str, Any]) -> Dict[str, Session]:
@@ -135,13 +140,29 @@ def session(test: Dict[str, Any], node: str) -> Session:
 
 def on_nodes(test: Dict[str, Any],
              f: Callable[[Dict[str, Any], str], Any],
-             nodes: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+             nodes: Optional[Sequence[str]] = None,
+             phase: Optional[str] = None) -> Dict[str, Any]:
     """Run ``f(test, node)`` on each node concurrently, with that node's
     session reachable via ``session(test, node)``; returns {node: result}
-    (control.clj:299-315)."""
+    (control.clj:299-315).
+
+    With ``phase`` given ("setup"/"run"/"teardown"), each node's closure is
+    wrapped in :func:`~jepsen_tpu.control.retry.retrying` under the test's
+    policy for that phase: a node that flaps mid-setup gets its whole
+    per-node closure replayed after the transport reconnects, instead of
+    failing the fan-out (control/retry.clj parity above the session layer —
+    the reference retries per command; replaying the idempotent setup
+    closure also covers multi-command sequences that died halfway)."""
     ns = list(nodes if nodes is not None else test.get("nodes") or [])
     if not ns:
         return {}
+    if phase is not None:
+        policy = policy_for(test, phase)
+        inner = f
+
+        def f(t, node):  # noqa: F811 - deliberate retrying shadow
+            return retrying(lambda: inner(t, node), policy)
+
     with ThreadPoolExecutor(max_workers=len(ns)) as ex:
         futs = {n: ex.submit(f, test, n) for n in ns}
         return {n: fut.result() for n, fut in futs.items()}

@@ -13,7 +13,9 @@ Parity map (SURVEY.md §5.8):
   (control/sshj.clj:181-187).
 - DockerExec   — `docker exec` remote (control/docker.clj:30-76).
 - K8sExec      — `kubectl exec` remote (control/k8s.clj:14-95).
-- RetryRemote  — reconnect/backoff wrapper (control/retry.clj:15-67).
+
+The reconnect/backoff wrapper (RetryRemote, control/retry.clj parity) lives
+in jepsen_tpu.control.retry and is re-exported here for compatibility.
 """
 
 from __future__ import annotations
@@ -22,12 +24,12 @@ import os
 import re
 import subprocess
 import tempfile
-import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from jepsen_tpu.control.core import (
     CmdResult, Remote, RemoteConnectError, wrap_context,
 )
+from jepsen_tpu.control.retry import RetryPolicy, RetryRemote  # noqa: F401
 
 DEFAULT_TIMEOUT = 600.0
 
@@ -242,51 +244,3 @@ def list_pods(namespace: str = "default") -> List[str]:
                 "-o", "jsonpath={.items[*].metadata.name}"])
     res.throw_on_nonzero()
     return res.out.split()
-
-
-class RetryRemote(Remote):
-    """Wraps a remote with reconnect-and-retry on connection errors
-    (control/retry.clj: 5 tries, 1 s backoff)."""
-
-    def __init__(self, inner: Remote, tries: int = 5, backoff_s: float = 1.0):
-        self.proto = inner
-        self.inner: Optional[Remote] = None
-        self.spec: Dict[str, Any] = {}
-        self.tries = tries
-        self.backoff_s = backoff_s
-
-    def connect(self, conn_spec):
-        r = RetryRemote(self.proto, self.tries, self.backoff_s)
-        r.spec = conn_spec
-        r.inner = r._retry(lambda: self.proto.connect(conn_spec))
-        return r
-
-    def _retry(self, f):
-        last = None
-        for i in range(self.tries):
-            try:
-                return f()
-            except RemoteConnectError as e:
-                last = e
-                time.sleep(self.backoff_s)
-                if self.inner is not None:
-                    try:
-                        self.inner = self.proto.connect(self.spec)
-                    except RemoteConnectError:
-                        pass
-        raise last
-
-    def disconnect(self):
-        if self.inner:
-            self.inner.disconnect()
-
-    def execute(self, ctx, cmd, stdin=None):
-        return self._retry(lambda: self.inner.execute(ctx, cmd, stdin))
-
-    def upload(self, ctx, local_paths, remote_path):
-        return self._retry(lambda: self.inner.upload(ctx, local_paths,
-                                                     remote_path))
-
-    def download(self, ctx, remote_paths, local_path):
-        return self._retry(lambda: self.inner.download(ctx, remote_paths,
-                                                       local_path))

@@ -1,0 +1,179 @@
+"""Retrying control plane — policies, the ``retrying`` combinator, and the
+reconnect-on-failure Remote wrapper.
+
+Parity: jepsen.control.retry (jepsen/src/jepsen/control/retry.clj): the
+reference wraps every control-plane session in a retrying proxy that
+catches connection-level failures, tears the dead connection down, backs
+off, reconnects, and replays the operation — so a transient node flap
+during OS/DB setup (or a mid-run log snarf) costs a pause, not the run.
+Our :class:`RetryRemote` is that proxy; :func:`retrying` is the underlying
+combinator (usable around any control-plane call, e.g. a whole per-node
+setup closure in ``on_nodes``); :class:`RetryPolicy` makes the reference's
+hard-coded 5-tries/1-s loop configurable per phase.
+
+Only :class:`~jepsen_tpu.control.core.RemoteConnectError` (and whatever a
+policy adds) is retried: a command that *ran* and exited nonzero is a
+result, not a flap — replaying it could double-apply side effects.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from jepsen_tpu.control.core import Remote, RemoteConnectError
+
+logger = logging.getLogger("jepsen.control.retry")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How hard to try: ``tries`` total attempts; exponential backoff
+    starting at ``backoff_s`` and doubling up to ``max_backoff_s``; each
+    delay jittered by ±``jitter`` (a fraction) so a cluster-wide flap
+    doesn't have every node's session reconnect in lockstep.  ``retry_on``
+    is the exception allowlist (connection-level failures only, by
+    default — see module docstring)."""
+
+    tries: int = 5
+    backoff_s: float = 1.0
+    max_backoff_s: float = 30.0
+    jitter: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (RemoteConnectError,)
+
+    def delay(self, attempt: int, rng=random) -> float:
+        d = min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
+        if self.jitter:
+            d *= 1.0 + rng.uniform(-self.jitter, self.jitter)
+        return max(0.0, d)
+
+
+#: Per-phase defaults.  Setup is patient (a rebooting node can take a
+#: while to accept connections); the run phase is tight (a worker stuck
+#: replaying control commands distorts the history's timing); teardown
+#: sits between (heal MUST eventually land, but shouldn't hang exit).
+DEFAULT_POLICIES: Dict[str, RetryPolicy] = {
+    "setup": RetryPolicy(tries=8, backoff_s=1.0),
+    "run": RetryPolicy(tries=3, backoff_s=0.25, max_backoff_s=2.0),
+    "teardown": RetryPolicy(tries=5, backoff_s=0.5, max_backoff_s=8.0),
+}
+
+
+def policy_for(test: Optional[Dict[str, Any]], phase: str = "run") \
+        -> RetryPolicy:
+    """The retry policy for a phase.  ``test["retry"]`` may be a
+    :class:`RetryPolicy` (applies to every phase), or a dict of
+    phase -> policy (or kwargs dict), with ``"default"`` as the fallback
+    key; absent, the module defaults apply."""
+    spec = (test or {}).get("retry")
+    if isinstance(spec, RetryPolicy):
+        return spec
+    if isinstance(spec, dict):
+        sub = spec.get(phase, spec.get("default"))
+        if isinstance(sub, RetryPolicy):
+            return sub
+        if isinstance(sub, dict):
+            return RetryPolicy(**sub)
+    return DEFAULT_POLICIES.get(phase, RetryPolicy())
+
+
+def retrying(f: Callable[[], Any], policy: Optional[RetryPolicy] = None,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Call ``f()`` under ``policy``: on a retriable exception, back off
+    and try again, up to ``policy.tries`` attempts total.  ``on_retry``
+    runs between attempts (the reconnect hook); its own retriable failures
+    are swallowed — the next attempt will surface them."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, policy.tries)):
+        try:
+            return f()
+        except policy.retry_on as e:  # type: ignore[misc]
+            last = e
+            if attempt + 1 >= max(1, policy.tries):
+                break
+            logger.warning("retriable failure (attempt %d/%d): %s",
+                           attempt + 1, policy.tries, e)
+            sleep(policy.delay(attempt))
+            if on_retry is not None:
+                try:
+                    on_retry(attempt, e)
+                except policy.retry_on:  # type: ignore[misc]
+                    pass
+    raise last  # type: ignore[misc]
+
+
+class RetryRemote(Remote):
+    """Reconnect-and-retry proxy around a Remote (control/retry.clj:15-67).
+
+    Every operation retries under the policy; between attempts the (likely
+    dead) connection is dropped so the next attempt dials fresh.  Connect
+    itself retries too, which is what lets ``setup_sessions``'s fan-out
+    survive a node that flaps during cluster bring-up.
+
+    ``tries``/``backoff_s`` kwargs are accepted for compatibility with the
+    original fixed-loop wrapper and fold into the policy."""
+
+    def __init__(self, inner: Remote, policy: Optional[RetryPolicy] = None,
+                 tries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
+        p = policy or RetryPolicy()
+        if tries is not None:
+            p = replace(p, tries=tries)
+        if backoff_s is not None:
+            p = replace(p, backoff_s=backoff_s)
+        self.proto = inner
+        self.policy = p
+        self.inner: Optional[Remote] = None
+        self.spec: Dict[str, Any] = {}
+        # One connection per RetryRemote, but retries may race a concurrent
+        # caller's reconnect (on_nodes fans out over *sessions*, each with
+        # its own RetryRemote, so this lock is rarely contended).
+        self._lock = threading.Lock()
+
+    def connect(self, conn_spec):
+        r = RetryRemote(self.proto, self.policy)
+        r.spec = dict(conn_spec)
+        r.inner = retrying(lambda: self.proto.connect(r.spec), r.policy)
+        return r
+
+    def _drop_conn(self, attempt: int, exc: BaseException) -> None:
+        with self._lock:
+            old, self.inner = self.inner, None
+        if old is not None:
+            try:
+                old.disconnect()
+            except Exception:  # noqa: BLE001 - it's already dead
+                pass
+
+    def _with_conn(self, f: Callable[[Remote], Any]) -> Any:
+        def attempt():
+            with self._lock:
+                if self.inner is None:
+                    self.inner = self.proto.connect(self.spec)
+                conn = self.inner
+            return f(conn)
+
+        return retrying(attempt, self.policy, on_retry=self._drop_conn)
+
+    def disconnect(self):
+        with self._lock:
+            old, self.inner = self.inner, None
+        if old is not None:
+            old.disconnect()
+
+    def execute(self, ctx, cmd, stdin=None):
+        return self._with_conn(lambda c: c.execute(ctx, cmd, stdin))
+
+    def upload(self, ctx, local_paths, remote_path):
+        return self._with_conn(
+            lambda c: c.upload(ctx, local_paths, remote_path))
+
+    def download(self, ctx, remote_paths, local_path):
+        return self._with_conn(
+            lambda c: c.download(ctx, remote_paths, local_path))

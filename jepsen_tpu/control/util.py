@@ -144,37 +144,56 @@ def start_daemon(s: Session, binary: str, *args,
     """Start a long-running process detached with a pidfile
     (util.clj:311's start-stop-daemon pattern, without requiring the
     start-stop-daemon binary).  ``user`` runs the daemon as a service
-    account; the pidfile records the daemon itself (not the sudo wrapper),
-    so stop_daemon's KILL escalation reaches it."""
+    account.
+
+    The daemon is launched under ``setsid`` as its own session leader, so
+    its pid doubles as the process-group id and stop_daemon can signal the
+    whole group (``kill -- -$pid``) — a daemon that forked workers can't
+    leave orphans behind (start-stop-daemon's --make-pidfile semantics;
+    util.clj:370's stop-daemon! kills by group for the same reason).  The
+    inner shell writes its *own* pid (the group leader's, preserved across
+    ``exec``) before exec'ing the real binary, so the pidfile never records
+    a wrapper."""
     import shlex
 
     from jepsen_tpu.control.core import build_cmd, env_str
     cmd = build_cmd(binary, *args)
     if env:
         cmd = f"env {env_str(env)} {cmd}"
+    # The session-leader shell records its own pid, then becomes the daemon
+    # via exec: pidfile pid == daemon pid == pgid.  ($! in the outer shell
+    # would record setsid's short-lived fork-parent instead.)
+    inner = f"echo $$ > {pidfile}; exec {cmd}"
     if user:
-        inner = f"echo $$ > {pidfile}; exec {cmd}"
-        cmd = f"sudo -n -u {user} bash -c {shlex.quote(inner)}"
+        launch = f"sudo -n -u {user} setsid bash -c {shlex.quote(inner)}"
+    else:
+        launch = f"setsid bash -c {shlex.quote(inner)}"
     # chdir runs as its own foreground statement: `nohup cd X && cmd` tries
     # to exec the `cd` builtin and short-circuits; `cd X && nohup cmd &`
     # backgrounds the whole list, so $! would be a wrapper subshell instead
     # of the daemon and signals would never reach it.
     prefix = f"cd {chdir} || exit 1; " if chdir else ""
-    # with user=, the sudo'd inner shell wrote its own pid already; writing
-    # $! here would record the sudo wrapper instead (and race the inner echo)
-    record = "true" if user else f"echo $! > {pidfile}"
     script = (f"if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; "
               f"then echo already-running; else "
-              f"{prefix}nohup {cmd} >> {logfile} 2>&1 & {record}; "
+              f"{prefix}nohup {launch} >> {logfile} 2>&1 & "
+              # the inner echo races the outer shell's return; don't let
+              # stop_daemon see a missing pidfile for a started daemon
+              f"for i in 1 2 3 4 5 6 7 8 9 10; do "
+              f"[ -s {pidfile} ] && break; sleep 0.1; done; "
               f"fi")
     s.exec("bash", "-c", script)
 
 
 def stop_daemon(s: Session, pidfile: str, timeout_s: float = 10) -> None:
-    """Kill the pidfile's process tree and remove the pidfile
-    (util.clj:370)."""
+    """Kill the pidfile's process *group* and remove the pidfile
+    (util.clj:370 stop-daemon!, which also signals the group).  Signalling
+    ``-$pid`` reaches every worker the daemon forked; the bare-pid kill is
+    the fallback for daemons started by an older start_daemon whose pid
+    isn't a group leader."""
+    group_kill = (f"kill -{{sig}} -- -$pid 2>/dev/null || "
+                  f"kill -{{sig}} $pid 2>/dev/null || true")
     script = (f"if [ -f {pidfile} ]; then pid=$(cat {pidfile}); "
-              f"kill -TERM $pid 2>/dev/null || true; fi")
+              + group_kill.format(sig="TERM") + "; fi")
     s.exec("bash", "-c", script)
     deadline = time.time() + timeout_s
     while time.time() < deadline:
@@ -182,7 +201,7 @@ def stop_daemon(s: Session, pidfile: str, timeout_s: float = 10) -> None:
             break
         time.sleep(0.25)
     script = (f"if [ -f {pidfile} ]; then pid=$(cat {pidfile}); "
-              f"kill -KILL $pid 2>/dev/null || true; rm -f {pidfile}; fi")
+              + group_kill.format(sig="KILL") + f"; rm -f {pidfile}; fi")
     s.exec("bash", "-c", script)
 
 

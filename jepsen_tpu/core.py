@@ -58,6 +58,15 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
             try:
                 history = _run_case(test)
             finally:
+                # A stalled run (interpreter.StalledRun) still leaves its
+                # salvaged partial history on disk — partial beats nothing
+                # for post-mortem analysis.
+                ph = test.get("partial_history")
+                if ph is not None and "history" not in test:
+                    try:
+                        store.save_1(test, ph)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("saving partial history")
                 # Logs must come off the nodes BEFORE teardown wipes them
                 # (core.clj:143-163 with-log-snarfing wraps the db phase).
                 _snarf_logs_safe(test)
@@ -98,7 +107,7 @@ def _setup_os(test) -> None:
     if osys is None or not test.get("nodes"):
         return
     logger.info("Setting up OS")
-    control.on_nodes(test, osys.setup)
+    control.on_nodes(test, osys.setup, phase="setup")
 
 
 def _setup_db(test) -> None:
@@ -110,7 +119,7 @@ def _setup_db(test) -> None:
     def cyc(t, node):
         jdb.cycle_(database, t, node)
 
-    control.on_nodes(test, cyc)
+    control.on_nodes(test, cyc, phase="setup")
     if isinstance(database, jdb.Primary) and test["nodes"]:
         database.setup_primary(test, test["nodes"][0])
 
@@ -123,7 +132,7 @@ def _teardown_db(test, final: bool = False) -> None:
         logger.info("Leaving DB running for inspection")
         return
     logger.info("Tearing down DB")
-    control.on_nodes(test, database.teardown)
+    control.on_nodes(test, database.teardown, phase="teardown")
 
 
 def _run_case(test) -> History:
@@ -152,6 +161,29 @@ def _run_case(test) -> History:
             test["nemesis"].teardown(test)
         except Exception:  # noqa: BLE001
             logger.exception("nemesis teardown")
+        finally:
+            # The run-level heal guarantee (nemesis/registry.py): even when
+            # the generator phase raised, or the nemesis crashed mid-fault
+            # before its own teardown could know about the fault, every
+            # registered-but-unresolved undo runs here — no run exits with
+            # the cluster still partitioned / skewed / SIGSTOPped.
+            _heal_outstanding_faults(test)
+
+
+def _heal_outstanding_faults(test) -> None:
+    reg = test.get("fault_registry")
+    if reg is None:
+        return
+    pending = reg.outstanding()
+    if not pending:
+        return
+    logger.warning("healing %d outstanding fault(s) at teardown: %s",
+                   len(pending), ", ".join(pending))
+    outcomes = reg.heal_all()
+    test["healed_faults"] = {**test.get("healed_faults", {}), **outcomes}
+    for key, outcome in outcomes.items():
+        if outcome != "healed":
+            logger.error("fault %s: %s", key, outcome)
 
 
 def analyze(test, history: History) -> Dict[str, Any]:

@@ -14,6 +14,24 @@ Key semantics carried over exactly:
   (interpreter.clj:33-67, 234-239);
 - :pending polls with a bounded (1 ms) backoff (interpreter.clj:166-170);
 - ops scheduled in the future are dispatched no earlier than their time.
+
+Fault tolerance (this layer must survive the faults it injects):
+
+- **Per-op deadlines** — ``test["op_timeout_s"]`` (a number, or a dict of
+  f -> seconds with a ``"default"`` key) bounds each invocation's wall
+  clock.  A hung ``invoke`` cannot be interrupted in Python, so the
+  scheduler *abandons* it: the op completes as ``info`` with a
+  ``:timeout`` error (indeterminate — it may still take effect, exactly
+  like a crash, interpreter.clj:142-157), the worker thread is replaced by
+  a fresh one at a new epoch, and the process is burned.  The abandoned
+  worker's late completion, if it ever arrives, is recognized by its stale
+  epoch and dropped — each logical op completes exactly once.
+- **Scheduler watchdog** — ``test["watchdog_s"]`` (default 300; None/0
+  disables) bounds how long the run may sit with outstanding ops and zero
+  progress.  Threads whose ops carry their own deadline are exempt (the
+  deadline will fire first); if an op *without* a deadline wedges past the
+  watchdog, the run fails loudly with :class:`StalledRun` naming the stuck
+  ops, instead of blocking its worker thread forever.
 """
 
 from __future__ import annotations
@@ -32,16 +50,35 @@ logger = logging.getLogger("jepsen.interpreter")
 
 _STOP = object()
 MAX_PENDING_WAIT_S = 0.001  # 1 ms, like the reference's poll granularity
+DEFAULT_WATCHDOG_S = 300.0
+TIMEOUT_ERROR = ":timeout"
+
+
+class StalledRun(RuntimeError):
+    """The completion queue stalled: outstanding ops without deadlines made
+    no progress for the watchdog interval.  Carries the stuck invocations
+    so the failure names the wedged processes instead of wedging the run."""
+
+    def __init__(self, stalled_s: float, ops: List[Op]):
+        self.stalled_s = stalled_s
+        self.ops = list(ops)
+        super().__init__(
+            f"scheduler stalled: no completion for {stalled_s:.1f}s with "
+            f"{len(ops)} outstanding op(s): "
+            + ", ".join(f"{o.process}/{o.f}" for o in self.ops))
 
 
 class _Worker(threading.Thread):
     """Base worker: pulls ops from its queue, pushes completions to the
-    shared completion queue."""
+    shared completion queue.  ``epoch`` stamps every completion so the
+    scheduler can drop output from workers it has already abandoned."""
 
-    def __init__(self, thread_id, test, completions):
-        super().__init__(name=f"jepsen-worker-{thread_id}", daemon=True)
+    def __init__(self, thread_id, test, completions, epoch: int = 0):
+        super().__init__(name=f"jepsen-worker-{thread_id}.{epoch}",
+                         daemon=True)
         self.thread_id = thread_id
         self.test = test
+        self.epoch = epoch
         self.inbox: "queue.Queue" = queue.Queue()
         self.completions = completions
 
@@ -61,7 +98,7 @@ class _Worker(threading.Thread):
                 logger.warning("process %s crashed in %s: %s",
                                op.process, op.f, e)
                 res = op.with_(type=INFO, error=str(e) or type(e).__name__)
-            self.completions.put((self.thread_id, res))
+            self.completions.put((self.thread_id, self.epoch, res))
 
     def _invoke(self, op: Op) -> Op:
         raise NotImplementedError
@@ -74,8 +111,9 @@ class ClientWorker(_Worker):
     """Owns the client lifecycle for its thread's current process
     (interpreter.clj:33-67)."""
 
-    def __init__(self, thread_id, test, completions, client_proto):
-        super().__init__(thread_id, test, completions)
+    def __init__(self, thread_id, test, completions, client_proto,
+                 epoch: int = 0):
+        super().__init__(thread_id, test, completions, epoch)
         self.client_proto = client_proto
         self.client: Optional[jclient.Client] = None
         self.process = None
@@ -112,12 +150,24 @@ class ClientWorker(_Worker):
 class NemesisWorker(_Worker):
     """The nemesis runs on its own logical thread (interpreter.clj:69)."""
 
-    def __init__(self, test, completions, nemesis):
-        super().__init__(NEMESIS, test, completions)
+    def __init__(self, test, completions, nemesis, epoch: int = 0):
+        super().__init__(NEMESIS, test, completions, epoch)
         self.nemesis = nemesis
 
     def _invoke(self, op: Op) -> Op:
         return self.nemesis.invoke(self.test, op)
+
+
+def _op_timeout_s(test: Dict[str, Any], op: Op) -> Optional[float]:
+    """The per-op wall-clock budget, or None for unbounded."""
+    spec = test.get("op_timeout_s")
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        t = spec.get(op.f, spec.get("default"))
+    else:
+        t = spec
+    return None if t is None else float(t)
 
 
 def run(test: Dict[str, Any]) -> History:
@@ -130,27 +180,43 @@ def run(test: Dict[str, Any]) -> History:
         from jepsen_tpu import nemesis as jnemesis
         nemesis = jnemesis.NoopNemesis()
 
+    # completion entries: (thread_id, worker_epoch, op)
     ctx = gen.context(test)
     completions: "queue.Queue" = queue.Queue()
     workers: Dict[Any, _Worker] = {}
-    for t in ctx.all_threads():
-        if t == NEMESIS:
-            workers[t] = NemesisWorker(test, completions, nemesis)
+    epochs: Dict[Any, int] = {}
+
+    def spawn(thread_id, epoch: int = 0) -> _Worker:
+        if thread_id == NEMESIS:
+            w = NemesisWorker(test, completions, nemesis, epoch)
         else:
-            workers[t] = ClientWorker(t, test, completions, client_proto)
-        workers[t].start()
+            w = ClientWorker(thread_id, test, completions, client_proto,
+                             epoch)
+        workers[thread_id] = w
+        epochs[thread_id] = epoch
+        w.start()
+        return w
+
+    for t in ctx.all_threads():
+        spawn(t)
 
     history: List[Op] = []
     outstanding = 0
+    inflight: Dict[Any, Op] = {}        # thread -> dispatched, uncompleted op
+    deadlines: Dict[Any, float] = {}    # thread -> monotonic deadline
+    watchdog_s = test.get("watchdog_s", DEFAULT_WATCHDOG_S) or None
+    last_progress = _time.monotonic()
     t0 = _time.monotonic_ns()
 
     def now() -> int:
         return _time.monotonic_ns() - t0
 
-    def handle_completion(item):
-        nonlocal ctx, g, outstanding
-        thread_id, res = item
+    def handle_completion(thread_id, res: Op):
+        nonlocal ctx, g, outstanding, last_progress
         outstanding -= 1
+        inflight.pop(thread_id, None)
+        deadlines.pop(thread_id, None)
+        last_progress = _time.monotonic()
         res = res.with_(time=now(), index=len(history))
         history.append(res)
         ctx = ctx.with_time(res.time).free_thread(thread_id)
@@ -159,44 +225,111 @@ def run(test: Dict[str, Any]) -> History:
         if g is not None:
             g = g.update(test, ctx, res)
 
+    def take(item) -> bool:
+        """Apply one queue entry; False if it came from a burned worker
+        (stale epoch) and was dropped."""
+        thread_id, epoch, res = item
+        if epochs.get(thread_id) != epoch:
+            logger.info("dropping late completion from abandoned worker "
+                        "%s (epoch %d): %s", thread_id, epoch, res)
+            return False
+        handle_completion(thread_id, res)
+        return True
+
+    def fire_deadlines() -> bool:
+        """Abandon every worker whose op blew its deadline: synthesize the
+        ``info :timeout`` completion, burn the process, replace the worker
+        at a fresh epoch (the hung thread's late output is dropped by
+        ``take``).  True if anything fired."""
+        now_m = _time.monotonic()
+        fired = False
+        for thread_id in [t for t, dl in list(deadlines.items())
+                          if dl <= now_m]:
+            op = inflight[thread_id]
+            logger.warning(
+                "op exceeded its %ss deadline; abandoning worker %s and "
+                "completing as info: %s/%s",
+                _op_timeout_s(test, op), thread_id, op.process, op.f)
+            old = workers[thread_id]
+            old.inbox.put(_STOP)  # if it ever unwedges, it exits
+            spawn(thread_id, epochs[thread_id] + 1)
+            handle_completion(thread_id, op.with_(type=INFO,
+                                                  error=TIMEOUT_ERROR))
+            fired = True
+        return fired
+
+    def check_watchdog() -> None:
+        if not watchdog_s or not outstanding:
+            return
+        stalled = _time.monotonic() - last_progress
+        if stalled < watchdog_s:
+            return
+        # Ops with their own deadline are the deadline's problem.
+        stuck = [inflight[t] for t in inflight if t not in deadlines]
+        if stuck:
+            raise StalledRun(stalled, stuck)
+
+    def bounded(want: Optional[float]) -> Optional[float]:
+        """Cap a queue wait so the scheduler wakes for the nearest op
+        deadline and the watchdog — it must never block past either."""
+        limit = want
+        now_m = _time.monotonic()
+        if deadlines:
+            d = min(deadlines.values()) - now_m
+            limit = d if limit is None else min(limit, d)
+        if watchdog_s and outstanding:
+            d = (last_progress + watchdog_s) - now_m
+            limit = d if limit is None else min(limit, d)
+        return None if limit is None else max(0.0, limit)
+
+    def wait_completion(want: Optional[float]) -> bool:
+        """Block up to ``want`` (None = until deadline/watchdog) for one
+        completion; fire deadlines/watchdog on timeout.  True if the
+        context changed (a completion was applied or a deadline fired)."""
+        try:
+            item = completions.get(timeout=bounded(want))
+        except queue.Empty:
+            if fire_deadlines():
+                return True
+            check_watchdog()
+            return False
+        return take(item)
+
     try:
         while True:
             # 1. Drain any ready completions.
             drained = False
             while True:
                 try:
-                    handle_completion(completions.get_nowait())
-                    drained = True
+                    drained = take(completions.get_nowait()) or drained
                 except queue.Empty:
                     break
+            if fire_deadlines():
+                drained = True
             if drained:
                 continue
+            check_watchdog()
             # 2. Ask the generator.
             ctx = ctx.with_time(now())
             r = g.op(test, ctx) if g is not None else None
             if r is None:
                 if outstanding == 0:
                     break
-                handle_completion(completions.get())
+                wait_completion(None)
                 continue
             v, g2 = r
             if v == gen.PENDING:
                 g = g2
-                try:
-                    handle_completion(
-                        completions.get(timeout=MAX_PENDING_WAIT_S))
-                except queue.Empty:
-                    pass
+                wait_completion(MAX_PENDING_WAIT_S)
                 continue
             op: Op = v
             if op.time is not None and op.time > ctx.time:
                 # Scheduled in the future: wait, staying responsive.
                 wait = (op.time - ctx.time) / 1e9
-                try:
-                    handle_completion(completions.get(timeout=wait))
+                if wait_completion(wait):
                     continue  # context changed; re-ask the generator
-                except queue.Empty:
-                    pass
+                if _time.monotonic_ns() - t0 < op.time:
+                    continue  # woken early (bounded wait); not due yet
             if op.type == "log":
                 logger.info("%s", op.value)
                 g = g2
@@ -207,7 +340,17 @@ def run(test: Dict[str, Any]) -> History:
             ctx = ctx.busy_thread(thread_id)
             g = g2.update(test, ctx, op) if g2 is not None else None
             outstanding += 1
+            inflight[thread_id] = op
+            timeout_s = _op_timeout_s(test, op)
+            if timeout_s is not None:
+                deadlines[thread_id] = _time.monotonic() + timeout_s
+            last_progress = _time.monotonic()
             workers[thread_id].inbox.put(op)
+    except StalledRun:
+        # Fail loudly, but leave a usable partial history behind for
+        # whoever catches this (core.run stores what it got).
+        test["partial_history"] = History(history, reindex=True)
+        raise
     finally:
         for w in workers.values():
             w.inbox.put(_STOP)

@@ -245,9 +245,16 @@ class History(Sequence):
 
     # -- I/O ---------------------------------------------------------------
     def to_jsonl(self, path: str) -> None:
-        with open(path, "w") as f:
+        # Atomic publish (atomic_io): the history is the one artifact a
+        # crashed analysis re-runs from; a torn write must never shadow a
+        # previously complete copy.
+        from jepsen_tpu.atomic_io import atomic_write
+
+        def dump(f):
             for op in self.ops:
                 f.write(json.dumps(op.to_dict(), default=str) + "\n")
+
+        atomic_write(path, dump)
 
     @classmethod
     def from_jsonl(cls, path: str) -> "History":

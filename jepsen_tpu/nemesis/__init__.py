@@ -13,6 +13,9 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence
 
 from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis.registry import (  # noqa: F401
+    FaultRegistry, registry_of,
+)
 
 
 class Nemesis:

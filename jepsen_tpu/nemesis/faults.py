@@ -17,6 +17,7 @@ from jepsen_tpu.control import session
 from jepsen_tpu.control import util as cu
 from jepsen_tpu.history import Op
 from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.registry import registry_of
 
 NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 
@@ -55,12 +56,20 @@ class KillNemesis(Nemesis):
             raise RuntimeError("db does not support Kill")
         if op.f == "kill":
             targets = pick_nodes(test, op.value)
+
+            def restart_all():
+                for n in test["nodes"]:
+                    database.start(test, n)
+
+            registry_of(test).register(f"kill:{id(self)}", restart_all,
+                                       "killed db processes")
             for n in targets:
                 database.kill(test, n)
             return op.with_(type="info", value=sorted(targets))
         if op.f == "start":
             for n in test["nodes"]:
                 database.start(test, n)
+            registry_of(test).resolve(f"kill:{id(self)}")
             return op.with_(type="info", value="started")
         raise ValueError(f"kill nemesis doesn't handle f={op.f!r}")
 
@@ -78,12 +87,20 @@ class PauseNemesis(Nemesis):
             raise RuntimeError("db does not support Pause")
         if op.f == "pause":
             targets = pick_nodes(test, op.value)
+
+            def resume_all():
+                for n in test["nodes"]:
+                    database.resume(test, n)
+
+            registry_of(test).register(f"pause:{id(self)}", resume_all,
+                                       "SIGSTOPped db processes")
             for n in targets:
                 database.pause(test, n)
             return op.with_(type="info", value=sorted(targets))
         if op.f == "resume":
             for n in test["nodes"]:
                 database.resume(test, n)
+            registry_of(test).resolve(f"pause:{id(self)}")
             return op.with_(type="info", value="resumed")
         raise ValueError(f"pause nemesis doesn't handle f={op.f!r}")
 
@@ -160,6 +177,14 @@ class NodeStartStopper(Nemesis):
     def invoke(self, test, op: Op) -> Op:
         if op.f == "start":
             targets = self.targeter(test, list(test["nodes"]))
+
+            def restart():
+                for n in (self.affected or targets):
+                    self.start_fn(test, n)
+                self.affected = []
+
+            registry_of(test).register(f"start-stop:{id(self)}", restart,
+                                       "stopped nodes")
             for n in targets:
                 self.stop_fn(test, n)
             self.affected = targets
@@ -168,6 +193,7 @@ class NodeStartStopper(Nemesis):
             for n in (self.affected or test["nodes"]):
                 self.start_fn(test, n)
             healed, self.affected = self.affected, []
+            registry_of(test).resolve(f"start-stop:{id(self)}")
             return op.with_(type="info", value=sorted(healed))
         raise ValueError(f"start-stopper doesn't handle f={op.f!r}")
 

@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from jepsen_tpu import net as jnet
 from jepsen_tpu.history import Op
 from jepsen_tpu.nemesis import Nemesis
+from jepsen_tpu.nemesis.registry import registry_of
 
 
 def _net_of(test) -> jnet.Net:
@@ -41,17 +42,25 @@ class Partitioner(Nemesis):
                  else None)
             if grudge is None:
                 raise ValueError("no grudge to apply")
+            # Register the undo BEFORE injecting: if drop_all dies halfway
+            # the partition may be partially live, and only the registry
+            # guarantees it heals at teardown (registry.py).
+            registry_of(test).register(
+                f"partition:{id(self)}", lambda: _net_of(test).heal(test),
+                "network partition")
             _net_of(test).drop_all(test, grudge)
             return op.with_(type="info",
                             value={n: sorted(v) for n, v in grudge.items()})
         if op.f == self.stop_f:
             _net_of(test).heal(test)
+            registry_of(test).resolve(f"partition:{id(self)}")
             return op.with_(type="info", value="network healed")
         raise ValueError(f"partitioner doesn't handle f={op.f!r}")
 
     def teardown(self, test):
         try:
             _net_of(test).heal(test)
+            registry_of(test).resolve(f"partition:{id(self)}")
         except Exception:  # noqa: BLE001
             pass
 
@@ -109,17 +118,22 @@ class PacketNemesis(Nemesis):
             name = spec.get("behavior", "slow") if isinstance(spec, dict) \
                 else spec
             nodes = spec.get("targets") if isinstance(spec, dict) else None
+            registry_of(test).register(
+                f"packet:{id(self)}", lambda: _net_of(test).fast(test),
+                "packet shaping")
             n.shape(test, nodes=nodes,
                     behavior=self.behaviors.get(name, jnet.DEFAULT_SLOW))
             return op.with_(type="info")
         if op.f == "stop-packet":
             n.fast(test)
+            registry_of(test).resolve(f"packet:{id(self)}")
             return op.with_(type="info")
         raise ValueError(f"packet nemesis doesn't handle f={op.f!r}")
 
     def teardown(self, test):
         try:
             _net_of(test).fast(test)
+            registry_of(test).resolve(f"packet:{id(self)}")
         except Exception:  # noqa: BLE001
             pass
 

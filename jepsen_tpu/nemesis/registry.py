@@ -1,0 +1,78 @@
+"""Fault registry — every injected fault registers an undo; teardown heals.
+
+The reference heals faults in each nemesis's ``teardown!`` (e.g. the
+partitioner's heal at nemesis.clj:158-185), which works as long as the
+nemesis object survives to teardown and its teardown runs.  Two failure
+modes escape that design: a nemesis that *raises mid-fault* (the fault is
+live but the nemesis never recorded it), and a generator phase that dies
+while a fault is open (teardown may itself need control-plane calls that
+the crash skipped).  The registry closes both holes: the *moment* a fault
+goes live, its undo closure is registered under a stable key; when the
+nemesis heals it normally, it resolves the key; and ``core.run``'s
+teardown path invokes every *outstanding* undo — even when the generator
+phase raised — so no run exits with the cluster still partitioned, the
+clock still skewed, or a process still SIGSTOPped.
+
+Undo closures must be idempotent (healing a healed cluster is a no-op);
+heal_all never raises — a failed undo is recorded and the rest still run.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("jepsen.nemesis.registry")
+
+
+class FaultRegistry:
+    """Outstanding-fault ledger for one run.  Keys are stable per fault
+    source (re-registering a key replaces its undo — a second
+    :start-partition supersedes the first; both heal with one undo)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # key -> (undo, description); dict preserves registration order
+        self._faults: Dict[str, Tuple[Callable[[], Any], str]] = {}
+
+    def register(self, key: str, undo: Callable[[], Any],
+                 description: Optional[str] = None) -> None:
+        """Record a live fault.  ``undo`` takes no args and heals it."""
+        with self._lock:
+            self._faults[key] = (undo, description or key)
+
+    def resolve(self, key: str) -> bool:
+        """The nemesis healed this fault itself; drop its undo."""
+        with self._lock:
+            return self._faults.pop(key, None) is not None
+
+    def outstanding(self) -> List[str]:
+        with self._lock:
+            return list(self._faults)
+
+    def heal_all(self) -> Dict[str, str]:
+        """Invoke every outstanding undo, newest first (LIFO: a fault
+        stacked on another unwinds in reverse), collecting outcomes.
+        Never raises; clears the ledger."""
+        with self._lock:
+            items = list(self._faults.items())[::-1]
+            self._faults.clear()
+        outcomes: Dict[str, str] = {}
+        for key, (undo, desc) in items:
+            try:
+                undo()
+                outcomes[key] = "healed"
+                logger.info("healed outstanding fault: %s", desc)
+            except Exception as e:  # noqa: BLE001 - heal the rest regardless
+                outcomes[key] = f"heal failed: {e}"
+                logger.exception("healing outstanding fault %s", desc)
+        return outcomes
+
+
+def registry_of(test: Dict[str, Any]) -> FaultRegistry:
+    """The run's fault registry, created on first use."""
+    reg = test.get("fault_registry")
+    if reg is None:
+        reg = test["fault_registry"] = FaultRegistry()
+    return reg

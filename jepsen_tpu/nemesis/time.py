@@ -17,6 +17,7 @@ from jepsen_tpu.control import on_nodes, session
 from jepsen_tpu.history import Op
 from jepsen_tpu.nemesis import Nemesis
 from jepsen_tpu.nemesis.faults import NATIVE_DIR, pick_nodes
+from jepsen_tpu.nemesis.registry import registry_of
 
 REMOTE_DIR = "/opt/jepsen-tpu"
 
@@ -75,12 +76,19 @@ class ClockNemesis(Nemesis):
         targets = pick_nodes(test, v.get("targets", "all"))
         if op.f == "reset-clock":
             reset_time(test, targets)
+            registry_of(test).resolve(f"clock:{id(self)}")
         elif op.f == "bump-clock":
+            registry_of(test).register(
+                f"clock:{id(self)}", lambda: reset_time(test),
+                "skewed clocks")
             delta = v.get("delta_ms", random.choice(
                 [-60_000, -1_000, -250, 250, 1_000, 60_000]))
             for n in targets:
                 bump_time(test, n, delta)
         elif op.f == "strobe-clock":
+            registry_of(test).register(
+                f"clock:{id(self)}", lambda: reset_time(test),
+                "strobed clocks")
             for n in targets:
                 strobe_time(test, n,
                             v.get("delta_ms", 200),
@@ -94,6 +102,7 @@ class ClockNemesis(Nemesis):
     def teardown(self, test):
         try:
             reset_time(test)
+            registry_of(test).resolve(f"clock:{id(self)}")
         except Exception:  # noqa: BLE001
             pass
 

@@ -26,7 +26,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level ...
+    from jax import shard_map as _shard_map
+    _NO_CHECK = {"check_vma": False}
+except ImportError:  # ... older versions only under experimental, and the
+    # replication-check kwarg is spelled check_rep there
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NO_CHECK = {"check_rep": False}
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
 from jepsen_tpu.checker.wgl_tpu import (EV_NOP, LOOKAHEAD, _chunk_slicer,
@@ -73,11 +80,12 @@ def _sharded_runner(model: JaxModel, window: int, capacity_per_shard: int,
                 repl)
     out_specs = ((sharded, sharded, sharded) + (repl,) * 14 + (sharded,),
                  repl)
-    # check_vma=False: closure dedup sorts the *gathered* global row set, so
-    # every shard computes bit-identical "replicated" scalars (counts, flags),
-    # but the varying-axes checker can't prove that post-all_gather.
-    fn = jax.jit(shard_map(run_chunk, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False))
+    # Replication checking off (check_vma / legacy check_rep): closure dedup
+    # sorts the *gathered* global row set, so every shard computes
+    # bit-identical "replicated" scalars (counts, flags), but the
+    # varying-axes checker can't prove that post-all_gather.
+    fn = jax.jit(_shard_map(run_chunk, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, **_NO_CHECK))
     _CACHE[key] = fn
     return fn
 

@@ -25,6 +25,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from jepsen_tpu.atomic_io import atomic_path, atomic_write
 from jepsen_tpu.history import History, Op
 
 BASE = "store"
@@ -73,10 +74,12 @@ def serializable_test(test: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def save_0(test: Dict[str, Any]) -> str:
-    """Phase 0: persist the test map before the run (store.clj:413)."""
+    """Phase 0: persist the test map before the run (store.clj:413).
+    Atomic (atomic_io): a crash mid-dump can't leave a torn test.json."""
     d = test.get("store_dir") or make_run_dir(test)
-    with open(os.path.join(d, "test.json"), "w") as f:
-        json.dump(serializable_test(test), f, indent=2, default=str)
+    atomic_write(os.path.join(d, "test.json"),
+                 lambda f: json.dump(serializable_test(test), f,
+                                     indent=2, default=str))
     return d
 
 
@@ -85,10 +88,11 @@ def save_1(test: Dict[str, Any], history: History) -> None:
     in both JSONL (greppable) and the CRC32 block format (crash-safe,
     lazily readable — store/format.py)."""
     d = test["store_dir"]
-    history.to_jsonl(os.path.join(d, "history.jsonl"))
+    history.to_jsonl(os.path.join(d, "history.jsonl"))  # atomic internally
     try:
         from jepsen_tpu.store import format as _fmt
-        _fmt.write_history(os.path.join(d, "history.jtsf"), history)
+        with atomic_path(os.path.join(d, "history.jtsf")) as tmp:
+            _fmt.write_history(tmp, history)
     except Exception:  # noqa: BLE001 - the JSONL copy is authoritative
         pass
     try:
@@ -100,8 +104,9 @@ def save_1(test: Dict[str, Any], history: History) -> None:
             "f": [str(o.f) for o in history],
             "time": [o.time or 0 for o in history],
         }
-        np.savez_compressed(os.path.join(d, "history.npz"),
-                            **{k: np.asarray(v) for k, v in cols.items()})
+        arrs = {k: np.asarray(v) for k, v in cols.items()}
+        atomic_write(os.path.join(d, "history.npz"),
+                     lambda f: np.savez_compressed(f, **arrs), mode="wb")
     except Exception:  # noqa: BLE001 - the npz is a convenience copy
         pass
 
@@ -113,15 +118,16 @@ def save_2(test: Dict[str, Any], results: Dict[str, Any]) -> None:
     BlockRef/PartialMap lazy-results design, store/format.clj:97-120) —
     browsing a thousand runs' verdicts never loads a thousand big maps."""
     d = test["store_dir"]
-    with open(os.path.join(d, "results.json"), "w") as f:
-        json.dump(results, f, indent=2, default=str)
+    atomic_write(os.path.join(d, "results.json"),
+                 lambda f: json.dump(results, f, indent=2, default=str))
     try:
         from jepsen_tpu.store import format as _fmt
-        with _fmt.Writer(os.path.join(d, "results.jtsf")) as w:
-            w.append_named_json("valid", {"valid": results.get("valid"),
-                                          "keys": sorted(results)})
-            for k, v in results.items():
-                w.append_named_json(f"results/{k}", v)
+        with atomic_path(os.path.join(d, "results.jtsf")) as tmp:
+            with _fmt.Writer(tmp) as w:
+                w.append_named_json("valid", {"valid": results.get("valid"),
+                                              "keys": sorted(results)})
+                for k, v in results.items():
+                    w.append_named_json(f"results/{k}", v)
     except Exception:  # noqa: BLE001 - results.json is authoritative
         pass
 

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Flake triage: rerun every test that FAILED in a pytest log N times and
+# report a per-test flake rate, separating deterministic breakage
+# (0/N passes) from timing-sensitive flakes (some passes, some failures).
+#
+# Usage: scripts/flake_triage.sh [LOG] [RUNS]
+#   LOG   pytest output containing "FAILED tests/..." lines
+#         (default: /tmp/_t1.log — the tier-1 verify log, see ROADMAP.md)
+#   RUNS  reruns per failed test (default: 5)
+set -u -o pipefail
+
+LOG="${1:-/tmp/_t1.log}"
+RUNS="${2:-5}"
+
+if [ ! -f "$LOG" ]; then
+    echo "no log at $LOG — run the tier-1 suite first (see ROADMAP.md)" >&2
+    exit 2
+fi
+
+# "FAILED tests/test_x.py::TestY::test_z - Error..." -> the node id only.
+mapfile -t FAILED < <(grep -aE '^FAILED ' "$LOG" \
+                      | awk '{print $2}' | sed 's/ *-.*//' | sort -u)
+
+if [ "${#FAILED[@]}" -eq 0 ]; then
+    echo "no FAILED lines in $LOG — nothing to triage"
+    exit 0
+fi
+
+echo "triaging ${#FAILED[@]} failed test(s), $RUNS reruns each"
+echo
+
+flaky=0
+broken=0
+for t in "${FAILED[@]}"; do
+    pass=0
+    for i in $(seq 1 "$RUNS"); do
+        if env JAX_PLATFORMS=cpu python -m pytest "$t" -q -x \
+               -p no:cacheprovider -p no:randomly >/dev/null 2>&1; then
+            pass=$((pass + 1))
+        fi
+    done
+    fail=$((RUNS - pass))
+    rate=$(awk -v f="$fail" -v r="$RUNS" 'BEGIN{printf "%.0f", 100*f/r}')
+    if [ "$pass" -eq 0 ]; then
+        verdict="BROKEN (deterministic)"
+        broken=$((broken + 1))
+    elif [ "$fail" -eq 0 ]; then
+        verdict="PASSES NOW (flaked in logged run)"
+        flaky=$((flaky + 1))
+    else
+        verdict="FLAKY"
+        flaky=$((flaky + 1))
+    fi
+    printf '%-72s pass %d/%d  flake-rate %s%%  %s\n' \
+           "$t" "$pass" "$RUNS" "$rate" "$verdict"
+done
+
+echo
+echo "summary: ${#FAILED[@]} triaged, $broken deterministic, $flaky flaky/recovered"

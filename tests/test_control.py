@@ -131,3 +131,36 @@ class TestUtil:
                         pidfile=pidfile, logfile=logfile)
         cu.stop_daemon(sess, pidfile)
         assert not cu.daemon_running(sess, pidfile)
+
+    def test_stop_daemon_kills_process_group(self, sess, tmp_path):
+        # A daemon that forks workers: stop_daemon must reap the whole
+        # session (kill -- -$pid), not just the leader — otherwise the
+        # sleeps it spawned survive as orphans and the next run's port
+        # binds / pkill sweeps hit stale processes.
+        import time as _t
+        pidfile = str(tmp_path / "d.pid")
+        logfile = str(tmp_path / "d.log")
+        marker = f"jepsen-grp-{tmp_path.name}"
+        cu.start_daemon(
+            sess, "bash", "-c",
+            f"sleep 300 & sleep 300 & echo {marker} > /dev/null; wait",
+            pidfile=pidfile, logfile=logfile)
+        assert cu.daemon_running(sess, pidfile)
+        pid = int(sess.exec("cat", pidfile))
+        # the daemon is its own session/group leader (setsid)
+        pgid = int(sess.exec("ps", "-o", "pgid=", "-p", str(pid)).strip())
+        assert pgid == pid
+        kids = sess.exec_result(
+            "bash", "-c", f"ps -eo pgid= -o comm= | grep '^ *{pid} '")
+        assert kids.ok and kids.out.count("sleep") >= 2
+        cu.stop_daemon(sess, pidfile)
+        assert not cu.daemon_running(sess, pidfile)
+        # every group member is gone, workers included
+        deadline = _t.time() + 5
+        while _t.time() < deadline:
+            left = sess.exec_result(
+                "bash", "-c", f"ps -eo pgid= | grep -c '^ *{pid}$'")
+            if not left.ok or left.out.strip() == "0":
+                break
+            _t.sleep(0.2)
+        assert not left.ok or left.out.strip() == "0"

@@ -96,6 +96,65 @@ class TestRun:
         assert rs[0]["valid"] is True
 
 
+class TestAtomicWrites:
+    """Crash-safe store artifacts: every save publishes whole files via
+    temp+fsync+rename (atomic_io), so a crash mid-save can't shadow a
+    previously complete artifact with a torn one."""
+
+    def test_atomic_write_roundtrip_no_temp_leftovers(self, tmp_path):
+        from jepsen_tpu.atomic_io import atomic_write
+        p = tmp_path / "out.json"
+        atomic_write(str(p), lambda f: f.write('{"ok": true}'))
+        assert json.loads(p.read_text()) == {"ok": True}
+        assert os.listdir(tmp_path) == ["out.json"]
+
+    def test_crash_mid_write_preserves_previous_version(self, tmp_path):
+        from jepsen_tpu.atomic_io import atomic_write
+        p = tmp_path / "test.json"
+        atomic_write(str(p), lambda f: f.write("v1"))
+
+        def torn(f):
+            f.write("v2-partial")
+            raise RuntimeError("killed mid-dump")
+
+        with pytest.raises(RuntimeError):
+            atomic_write(str(p), torn)
+        assert p.read_text() == "v1"          # old version intact
+        assert os.listdir(tmp_path) == ["test.json"]  # temp cleaned up
+
+    def test_history_jsonl_survives_interrupted_rewrite(self, tmp_path):
+        h1 = History([{"index": 0, "type": "invoke", "f": "read",
+                       "value": None, "process": 0},
+                      {"index": 1, "type": "ok", "f": "read",
+                       "value": 1, "process": 0}])
+        p = tmp_path / "history.jsonl"
+        h1.to_jsonl(str(p))
+        # simulate a crash mid-save of a *newer* history: the old file
+        # must stay loadable (the whole point of staged durability)
+        import jepsen_tpu.atomic_io as aio
+
+        orig = aio.atomic_write
+
+        def boom(path, fn, mode="w"):
+            raise OSError("disk vanished")
+
+        aio.atomic_write = boom
+        try:
+            with pytest.raises(OSError):
+                History([]).to_jsonl(str(p))
+        finally:
+            aio.atomic_write = orig
+        assert len(History.from_jsonl(str(p))) == 2
+
+    def test_save_2_artifacts_complete_and_loadable(self, tmp_path):
+        t = core.run(base_test(tmp_path, checker=Stats()))
+        d = t["store_dir"]
+        # no stray .tmp files from the atomic pipeline
+        assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+        assert store.load_results(d)["valid"] is True
+        assert store.load_history(d)
+
+
 class TestDbLifecycle:
     def test_db_setup_teardown_called(self, tmp_path):
         calls = []

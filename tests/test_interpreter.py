@@ -156,6 +156,105 @@ class TestInterpreter:
         nem_ops = [o for o in h if o.process == NEMESIS]
         assert len(nem_ops) == 4  # 2 invocations + 2 completions
 
+    def test_worker_crash_burns_process(self):
+        """The process-burn contract (interpreter.clj:142-157): a crashed
+        worker's pid is retired, its successor is pid + concurrency, the
+        non-reusable client is reopened fresh for the successor, and the
+        crashed op completes as ``info`` — never ``fail`` — because a
+        thrown invoke is indeterminate."""
+        opens = []
+
+        class AlwaysCrash(jclient.Client):
+            def open(self, test, node):
+                c = AlwaysCrash()
+                c.opened = True
+                opens.append(id(c))
+                return c
+
+            def invoke(self, test, op):
+                raise RuntimeError("boom")
+
+        concurrency = 2
+        test = {"concurrency": concurrency,
+                "client": AlwaysCrash(),
+                "generator": gen.clients(rwc_gen(12))}
+        h = interpreter.run(test)
+        completions = [o for o in h
+                       if o.type != INVOKE and o.process != NEMESIS]
+        assert completions
+        assert all(o.type == INFO for o in completions)   # never FAIL
+        assert not any(o.type == FAIL for o in h)
+        assert all(o.error for o in completions)
+        # pids burn monotonically: thread t's processes are t, t+c, t+2c...
+        by_thread = {}
+        for o in h:
+            if o.type == INVOKE and o.process != NEMESIS:
+                by_thread.setdefault(o.process % concurrency,
+                                     []).append(o.process)
+        for t, pids in by_thread.items():
+            assert pids == sorted(pids)
+            assert pids == list(range(pids[0],
+                                      pids[0] + concurrency * len(pids),
+                                      concurrency))
+        # a fresh (non-reusable) client was opened per burned process
+        n_procs = len({o.process for o in h
+                       if o.type == INVOKE and o.process != NEMESIS})
+        assert len(opens) >= n_procs
+
+    def test_hung_op_completes_info_timeout(self):
+        """Per-op deadline: a hung invoke completes as ``info`` with the
+        :timeout error, the worker is abandoned (pid burned) and the run
+        finishes instead of wedging."""
+        import time as _t
+
+        class SometimesHangs(MockRegisterClient):
+            def invoke(self, test, op):
+                if op.f == "write" and op.value == 99:
+                    _t.sleep(30)  # way past the deadline
+                _t.sleep(0.05)   # keep ops pending past the deadline fire
+                return super().invoke(test, op)
+
+        test = {"concurrency": 2,
+                "client": SometimesHangs(),
+                "op_timeout_s": {"write": 0.3, "default": 5.0},
+                "generator": gen.clients(gen.lift(
+                    [{"f": "write", "value": 99}] +
+                    [{"f": "read"} for _ in range(12)]))}
+        t0 = _t.monotonic()
+        h = interpreter.run(test)
+        assert _t.monotonic() - t0 < 10, "run must not wait for the sleep"
+        hung = [o for o in h if o.f == "write" and o.type != INVOKE]
+        assert len(hung) == 1
+        assert hung[0].type == INFO
+        assert hung[0].error == interpreter.TIMEOUT_ERROR
+        # every invoke still pairs with exactly one completion
+        invokes = [o for o in h if o.type == INVOKE and o.process != NEMESIS]
+        pairs = h.pair_index()
+        assert all(pairs[o.index] >= 0 for o in invokes)
+        # the hung worker's pid was burned: a successor pid appears
+        assert any(o.process >= 2 for o in h if o.type == INVOKE)
+
+    def test_watchdog_fails_stalled_run(self):
+        """A run making no progress (hung op with NO deadline configured)
+        fails loudly with StalledRun instead of hanging forever, and the
+        partial history is salvaged onto the test map."""
+        import time as _t
+
+        class HangsForever(jclient.Client):
+            def invoke(self, test, op):
+                _t.sleep(60)
+                return op.with_(type=OK)
+
+        test = {"concurrency": 1,
+                "client": HangsForever(),
+                "watchdog_s": 0.5,
+                "generator": gen.clients(rwc_gen(3))}
+        with pytest.raises(interpreter.StalledRun) as ei:
+            interpreter.run(test)
+        assert ei.value.ops, "StalledRun names the stuck invocations"
+        assert "partial_history" in test
+        assert any(o.type == INVOKE for o in test["partial_history"])
+
     def test_time_limited_run_terminates(self):
         test = {"concurrency": 2,
                 "client": jclient.NoopClient(),

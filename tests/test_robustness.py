@@ -1,0 +1,289 @@
+"""Fault-tolerant harness acceptance: a single end-to-end run that survives
+a flaky control plane (RemoteConnectError on first connect), a client whose
+invoke hangs past its op deadline, and a nemesis that crashes mid-fault —
+plus unit coverage for the retry combinator, the reconnecting RetryRemote,
+and the budgeted checker degradation chain (TPU WGL -> CPU WGL -> unknown).
+"""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as jnemesis
+from jepsen_tpu.checker import Stats, compose, wgl_cpu, wgl_tpu
+from jepsen_tpu.checker.core import Checker, UNKNOWN, check_safe
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.control import (DummyRemote, RemoteConnectError, RetryPolicy,
+                                RetryRemote)
+from jepsen_tpu.control.retry import policy_for, retrying
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History, INFO, INVOKE, NEMESIS, OK, Op
+from jepsen_tpu.models import CASRegister
+from jepsen_tpu.models.register import cas_register_jax
+from tests.test_interpreter import MockRegisterClient
+
+FAST = RetryPolicy(tries=4, backoff_s=0.005, max_backoff_s=0.02, jitter=0.0)
+
+
+class FlakyRemote(DummyRemote):
+    """Record-only dummy whose first connect per node fails with a
+    connection-level error — the flap RetryRemote must absorb."""
+
+    def __init__(self):
+        super().__init__(record_only=True)
+        self.connect_attempts = {}
+
+    def connect(self, conn_spec):
+        host = conn_spec.get("host")
+        n = self.connect_attempts.get(host, 0)
+        self.connect_attempts[host] = n + 1
+        if n == 0:
+            raise RemoteConnectError(f"{host}: connection refused (flap)")
+        return super().connect(conn_spec)
+
+
+class HangingClient(MockRegisterClient):
+    """The write of the sentinel value wedges well past its op deadline."""
+
+    HANG_VALUE = 99
+    HANG_S = 2.0
+
+    def invoke(self, test, op):
+        if op.f == "write" and op.value == self.HANG_VALUE:
+            time.sleep(self.HANG_S)
+        return super().invoke(test, op)
+
+
+class CrashyNemesis(jnemesis.Nemesis):
+    """Registers its undo, then dies mid-injection: only the run-level
+    fault registry knows the fault is (half) in place."""
+
+    def __init__(self, healed):
+        self.healed = healed
+
+    def invoke(self, test, op):
+        jnemesis.registry_of(test).register(
+            "crashy-fault", lambda: self.healed.append(op.f),
+            "half-injected fault")
+        raise RuntimeError("nemesis crashed mid-fault")
+
+    def fs(self):
+        return ["break"]
+
+
+class TestRetrying:
+    def test_retrying_retries_then_succeeds(self):
+        calls = []
+
+        def f():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RemoteConnectError("flap")
+            return "ok"
+
+        slept = []
+        assert retrying(f, FAST, sleep=slept.append) == "ok"
+        assert len(calls) == 3
+        assert len(slept) == 2
+        # exponential: second delay doubles the first (jitter is 0)
+        assert slept[1] == pytest.approx(slept[0] * 2)
+
+    def test_retrying_exhausts_and_raises(self):
+        def f():
+            raise RemoteConnectError("always down")
+
+        with pytest.raises(RemoteConnectError):
+            retrying(f, FAST, sleep=lambda s: None)
+
+    def test_retrying_does_not_retry_command_failures(self):
+        calls = []
+
+        def f():
+            calls.append(1)
+            raise ValueError("ran and failed — a result, not a flap")
+
+        with pytest.raises(ValueError):
+            retrying(f, FAST, sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_policy_for_reads_test_map(self):
+        t = {"retry": {"setup": {"tries": 9},
+                       "default": RetryPolicy(tries=2)}}
+        assert policy_for(t, "setup").tries == 9
+        assert policy_for(t, "teardown").tries == 2
+        assert policy_for({}, "setup").tries >= policy_for({}, "run").tries
+
+    def test_retry_remote_reconnects_mid_run(self):
+        """An execute that dies with a connection error is replayed on a
+        fresh connection (control/retry.clj:15-67)."""
+
+        class DropsOnce(DummyRemote):
+            def __init__(self, fails=None, connects=None):
+                super().__init__(record_only=True)
+                self.fails = fails if fails is not None else {"left": 1}
+                self.connects = connects if connects is not None else {"n": 0}
+
+            def connect(self, conn_spec):
+                self.connects["n"] += 1
+                child = DropsOnce(self.fails, self.connects)
+                child.host = conn_spec.get("host")
+                return child
+
+            def execute(self, ctx, cmd, stdin=None):
+                if self.fails["left"] > 0:
+                    self.fails["left"] -= 1
+                    raise RemoteConnectError("connection reset")
+                return super().execute(ctx, cmd, stdin=stdin)
+
+        proto = DropsOnce()
+        wrapped = RetryRemote(proto, policy=FAST).connect({"host": "n1"})
+        res = wrapped.execute({}, "echo hi")
+        assert res.exit == 0
+        assert proto.connects["n"] == 2  # original + reconnect
+
+
+class TestCheckerDegradation:
+    def _history(self):
+        return History([
+            Op(index=0, type=INVOKE, f="write", value=1, process=0, time=0),
+            Op(index=1, type=OK, f="write", value=1, process=0, time=1),
+            Op(index=2, type=INVOKE, f="read", value=None, process=1, time=2),
+            Op(index=3, type=OK, f="read", value=1, process=1, time=3),
+        ])
+
+    def test_tpu_failure_falls_back_to_cpu(self, monkeypatch):
+        monkeypatch.setattr(
+            wgl_tpu, "check",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("RESOURCE_EXHAUSTED: device OOM")))
+        res = Linearizable(cas_register_jax(), algorithm="tpu").check(
+            {}, self._history())
+        assert res["valid"] is True      # still a definite verdict
+        assert res["fallback"]["to"] == "wgl-cpu"
+        assert "device OOM" in res["fallback"]["error"]
+
+    def test_both_tiers_failing_degrades_to_unknown(self, monkeypatch):
+        monkeypatch.setattr(
+            wgl_tpu, "check",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("device lost")))
+        monkeypatch.setattr(
+            wgl_cpu, "check",
+            lambda *a, **k: (_ for _ in ()).throw(wgl_cpu.SearchExploded(123)))
+        res = Linearizable(cas_register_jax(), algorithm="tpu").check(
+            {}, self._history())
+        assert res["valid"] == UNKNOWN
+        assert [s["solver"] for s in res["fallback-chain"]] == \
+            ["wgl-tpu", "wgl-cpu"]
+        assert res["partial-search"] == {"configs-explored": 123,
+                                         "exhausted": False}
+
+    def test_check_safe_budget_degrades_to_unknown(self):
+        class Wedged(Checker):
+            def check(self, test, history, opts=None):
+                time.sleep(30)
+
+        res = check_safe(Wedged(), {}, self._history(), budget_s=0.1)
+        assert res["valid"] == UNKNOWN
+        assert res["budget-exceeded"] is True
+        assert res["budget-s"] == 0.1
+        assert res["duration-s"] >= 0.1
+
+    def test_compose_budget_isolates_wedged_subchecker(self):
+        class Wedged(Checker):
+            def check(self, test, history, opts=None):
+                time.sleep(30)
+
+        c = compose({"stats": Stats(), "wedged": Wedged()}, budget_s=0.2)
+        res = c.check({}, self._history())
+        assert res["valid"] == UNKNOWN          # wedged degrades the merge
+        assert res["stats"]["valid"] is True    # ...but stats still reports
+        assert "duration-s" in res["stats"]
+        assert res["wedged"]["budget-exceeded"] is True
+
+
+class TestAcceptance:
+    def test_faulty_run_end_to_end(self, tmp_path, monkeypatch):
+        """The ISSUE's acceptance scenario: RemoteConnectError on first
+        connect, a client invoke hanging past its deadline, a nemesis
+        raising mid-fault, and a TPU checker forced to fail — the run
+        still completes with a history, the fault heals at teardown, the
+        hung op completes as info/:timeout, and the verdict is definite
+        via the CPU fallback."""
+        monkeypatch.setattr(
+            wgl_tpu, "check",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("RESOURCE_EXHAUSTED: TPU OOM")))
+        healed = []
+        flaky = FlakyRemote()
+        ops = ([{"f": "write", "value": HangingClient.HANG_VALUE}]
+               + [{"f": "read"} for _ in range(6)]
+               + [{"f": "write", "value": 3}]
+               + [{"f": "read"} for _ in range(6)])
+        test = {
+            "name": "robustness-acceptance",
+            "nodes": ["n1", "n2", "n3"],
+            "remote": RetryRemote(flaky, policy=FAST),
+            "retry": {"default": {"tries": 4, "backoff_s": 0.005,
+                                  "max_backoff_s": 0.02, "jitter": 0.0}},
+            "concurrency": 3,
+            "store_base": str(tmp_path / "store"),
+            "client": HangingClient(),
+            "op_timeout_s": {"write": 0.3, "default": 10.0},
+            "nemesis": CrashyNemesis(healed),
+            "generator": [
+                gen.nemesis(gen.lift([{"f": "break", "type": "info"}])),
+                gen.clients(gen.lift(ops)),
+            ],
+            "checker": compose({
+                "linear": Linearizable(cas_register_jax(), algorithm="tpu"),
+                "stats": Stats(),
+            }),
+        }
+        t = core.run(test)
+
+        # (a) the flaky control plane was retried, not fatal: every node
+        # needed a second connect attempt and the run still finished
+        assert all(n >= 2 for n in flaky.connect_attempts.values())
+        assert set(flaky.connect_attempts) == {"n1", "n2", "n3"}
+
+        # (b) the hung write completed as info/:timeout; its worker was
+        # abandoned and the rest of the history still happened
+        h = t["history"]
+        hung = [o for o in h if o.f == "write" and o.type != INVOKE
+                and o.value == HangingClient.HANG_VALUE]
+        assert len(hung) == 1
+        assert hung[0].type == INFO
+        assert hung[0].error == interpreter.TIMEOUT_ERROR
+        reads = [o for o in h if o.f == "read" and o.type == OK]
+        assert len(reads) == 12
+
+        # (c) the crashed nemesis neither killed the run nor leaked its
+        # fault: the op completed info, and teardown ran the undo
+        nem_completions = [o for o in h
+                           if o.process == NEMESIS and o.type != INVOKE]
+        assert nem_completions and all(o.type == INFO
+                                       for o in nem_completions)
+        assert healed == ["break"]
+        assert t["healed_faults"] == {"crashy-fault": "healed"}
+        assert t["fault_registry"].outstanding() == []
+
+        # (d) the forced TPU-WGL failure fell back to CPU WGL and still
+        # produced a definite verdict, with per-checker durations
+        lin = t["results"]["linear"]
+        assert lin["valid"] is True
+        assert lin["fallback"]["to"] == "wgl-cpu"
+        assert "TPU OOM" in lin["fallback"]["error"]
+        assert "duration-s" in lin
+        assert "duration-s" in t["results"]["stats"]
+        assert t["results"]["valid"] is True
+
+        # (e) artifacts are on disk, whole
+        import os
+        d = t["store_dir"]
+        for artifact in ("test.json", "history.jsonl", "results.json"):
+            assert os.path.exists(os.path.join(d, artifact))
+        assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
