@@ -22,6 +22,9 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
             the committed record behind the ~24k ops/s claim
   multireg  10k-op multi-key register history (BASELINE configs #4/#5) on
             the device-tier MultiRegister vs the host oracle
+  elle      transactional-anomaly engine (elle_tpu) on a 96 x 200-op
+            list-append batch, parity-checked lane-by-lane against the CPU
+            elle oracle, with the same device-vs-socket comparison as batch
 
 **Isolation:** every tier runs in its own subprocess with its own timeout; a
 tier that crashes the TPU worker (or hangs) degrades to a per-tier
@@ -72,6 +75,7 @@ TIER_TIMEOUT_S = {
     "setup2": 300 if SMOKE else 700,
     "sched": 120 if SMOKE else 300,
     "multireg": 300 if SMOKE else 1500,
+    "elle": 300 if SMOKE else 1200,
 }
 
 
@@ -471,6 +475,61 @@ def tier_multireg():
           **meta})
 
 
+def build_elle():
+    from jepsen_tpu.synth import list_append_history
+    n = 16 if SMOKE else 96
+    # Every 4th lane corrupted: the batch exercises both the acyclic fast
+    # path (device flags only, no CPU search) and the cyclic witness path.
+    return [list_append_history(n_txns=100, keys=4, concurrency=6,
+                                seed=3000 + i,
+                                anomaly_p=0.3 if i % 4 == 0 else 0.0)
+            for i in range(n)]
+
+
+def tier_elle():
+    """Transactional-anomaly engine (elle_tpu) throughput on the acceptance
+    shape — a 96-history x 200-op list-append batch — with the same honest
+    same-host CPU comparison as tier_batch: histories/sec both ways, per
+    core and per socket, and the break-even core count.  Every lane is
+    parity-checked against the CPU elle oracle (verdict + anomaly set)
+    before any number is emitted."""
+    from jepsen_tpu import elle_tpu
+    from jepsen_tpu.elle import list_append
+    hs = build_elle()
+    progress(f"elle warm ({len(hs)} lanes, closure kernel compile)")
+    elle_tpu.check_batch(hs, workload="list-append")
+    progress("elle timed device run")
+    t0 = time.time()
+    res = elle_tpu.check_batch(hs, workload="list-append")
+    wall = time.time() - t0
+    progress("elle CPU oracle pass (full batch, timed)")
+    t0 = time.time()
+    cpu_res = [list_append.check(h) for h in hs]
+    cpu_wall = time.time() - t0
+    for i, (d, c) in enumerate(zip(res, cpu_res)):
+        assert d["valid"] == c["valid"] and \
+            d.get("anomaly-types", []) == c.get("anomaly-types", []), \
+            (i, d.get("anomaly-types"), c.get("anomaly-types"))
+    n_false = sum(1 for r in res if r["valid"] is False)
+    cores = os.cpu_count() or 1
+    dev_hps = len(hs) / wall
+    cpu_core = len(hs) / cpu_wall
+    emit({
+        "n_histories": len(hs), "ops_each": 200,
+        "n_refuted": n_false,
+        "parity": "all-lanes verdict+anomaly-set vs CPU oracle",
+        "analyzer": res[0].get("analyzer"),
+        "wall_s": round(wall, 3),
+        "histories_per_sec": round(dev_hps, 1),
+        "cpu_wall_s": round(cpu_wall, 3),
+        "cpu_histories_per_sec_core": round(cpu_core, 1),
+        "host_cores": cores,
+        "cpu_histories_per_sec_socket": round(cores * cpu_core, 1),
+        "device_vs_socket": round(dev_hps / (cores * cpu_core), 2),
+        "break_even_cores": round(dev_hps / cpu_core, 1),
+    })
+
+
 def tier_sched():
     """Generator scheduler throughput — the committed record behind the
     ~24k ops/s claim (round-4 review: the number lived only in a test
@@ -536,6 +595,7 @@ TIER_FNS = {
     "setup2": tier_setup2,
     "sched": tier_sched,
     "multireg": tier_multireg,
+    "elle": tier_elle,
 }
 
 
@@ -610,7 +670,7 @@ def main():
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
                  "ablation_on", "ablation_off", "setup2", "sched",
-                 "multireg"):
+                 "multireg", "elle"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "
@@ -695,6 +755,12 @@ def main():
             "scheduler": {k: v for k, v in tiers["sched"].items()
                           if k not in ("status",)},
             "multireg": slim(tiers["multireg"]),
+            "elle": {k: v for k, v in tiers["elle"].items()
+                     if k in ("status", "wall_s", "n_histories", "ops_each",
+                              "n_refuted", "histories_per_sec",
+                              "cpu_histories_per_sec_socket",
+                              "device_vs_socket", "break_even_cores",
+                              "host_cores", "analyzer")},
             "batch_vs_cpu_socket": (tiers["batch"].get("shapes") or {}).get(
                 "512", {}),
             "full_record": os.path.basename(full_path),

@@ -9,6 +9,11 @@ from jepsen_tpu.checker.core import (  # noqa: F401
     Checker, Compose, CounterChecker, LogFilePattern, NoopChecker,
     QueueChecker, SetChecker, SetFullChecker, Stats, TotalQueueChecker,
     UNKNOWN, UnhandledExceptions, UniqueIds, check_safe, compose,
-    concurrency_limit, merge_valid, noop, unbridled_optimism,
+    concurrency_limit, merge_valid, noop, register_checker,
+    registered_checkers, resolve_checker, unbridled_optimism,
+)
+from jepsen_tpu.checker.elle import (  # noqa: F401
+    ElleChecker, ElleListAppend, ElleRwRegister, elle_list_append,
+    elle_rw_register,
 )
 from jepsen_tpu.checker.linearizable import Linearizable, linearizable  # noqa: F401

@@ -32,6 +32,104 @@ class Checker:
         raise NotImplementedError
 
 
+# ---------------------------------------------------------------------------
+# Checker registry: the plugin seam core.run's analysis phase resolves
+# through.  A test map can carry `checker` as a Checker instance (as
+# before), a registered name ("elle-list-append"), a {"name": ..., **opts}
+# spec, a {sub-name: spec} mapping (composed), or a list of specs.
+# Factories are registered lazily so importing this module never drags in
+# JAX or the elle machinery.
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def register_checker(name: str, factory) -> None:
+    """Register ``factory(**opts) -> Checker`` under ``name``."""
+    _REGISTRY[name] = factory
+
+
+def registered_checkers() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def resolve_checker(spec) -> Checker:
+    """Turn a checker spec into a Checker instance.
+
+    - a ``Checker``: returned as-is;
+    - ``"name"``: the registered factory, no opts;
+    - ``{"name": n, **opts}``: the factory with opts;
+    - ``{sub: spec, ...}``: a :class:`Compose` of resolved sub-specs;
+    - ``[spec, ...]``: a Compose keyed by each spec's name.
+    """
+    if isinstance(spec, Checker):
+        return spec
+    if isinstance(spec, str):
+        return _factory(spec)()
+    if isinstance(spec, dict):
+        if isinstance(spec.get("name"), str):
+            opts = {k: v for k, v in spec.items() if k != "name"}
+            return _factory(spec["name"])(**opts)
+        return Compose({str(k): resolve_checker(v)
+                        for k, v in spec.items()})
+    if isinstance(spec, (list, tuple)):
+        named: Dict[str, Checker] = {}
+        for i, s in enumerate(spec):
+            if isinstance(s, str):
+                name = s
+            elif isinstance(s, dict) and isinstance(s.get("name"), str):
+                name = s["name"]
+            else:
+                name = f"{type(s).__name__.lower()}-{i}"
+            base, k = name, 1
+            while name in named:
+                k += 1
+                name = f"{base}-{k}"
+            named[name] = resolve_checker(s)
+        return Compose(named)
+    raise TypeError(f"cannot resolve checker spec of type "
+                    f"{type(spec).__name__}: {spec!r}")
+
+
+def _factory(name: str):
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"no checker registered as {name!r}; "
+                       f"known: {registered_checkers()}") from None
+
+
+def _lazy_elle(workload: str, **preset):
+    def factory(**opts):
+        from jepsen_tpu.checker.elle import ElleChecker
+        return ElleChecker(workload=workload, **{**preset, **opts})
+    return factory
+
+
+def _lazy_linearizable(**opts):
+    from jepsen_tpu.checker.linearizable import Linearizable
+    return Linearizable(**opts)
+
+
+def _register_builtins() -> None:
+    for name, cls in [("stats", Stats), ("set", SetChecker),
+                      ("set-full", SetFullChecker), ("queue", QueueChecker),
+                      ("total-queue", TotalQueueChecker),
+                      ("unique-ids", UniqueIds),
+                      ("counter", CounterChecker),
+                      ("unhandled-exceptions", UnhandledExceptions),
+                      ("noop", NoopChecker)]:
+        register_checker(name, cls)
+    register_checker("linearizable", _lazy_linearizable)
+    # The Elle checkers, device tier by default; the -cpu variants pin the
+    # oracle path (parity baselines, device-free boxes).
+    register_checker("elle-list-append", _lazy_elle("list-append"))
+    register_checker("elle-rw-register", _lazy_elle("rw-register"))
+    register_checker("elle-list-append-cpu",
+                     _lazy_elle("list-append", engine="cpu"))
+    register_checker("elle-rw-register-cpu",
+                     _lazy_elle("rw-register", engine="cpu"))
+
+
 def merge_valid(valids: List[Any]) -> Any:
     """false > unknown > true (checker.clj:29-50)."""
     out = True
@@ -473,3 +571,6 @@ class ConcurrencyLimitChecker(Checker):
 
 def concurrency_limit(limit: int, inner: Checker) -> Checker:
     return ConcurrencyLimitChecker(limit, inner)
+
+
+_register_builtins()

@@ -187,11 +187,19 @@ def _heal_outstanding_faults(test) -> None:
 
 
 def analyze(test, history: History) -> Dict[str, Any]:
-    """Run the checker over the history (core.clj:216-232 analyze!)."""
+    """Run the checker over the history (core.clj:216-232 analyze!).
+
+    ``test["checker"]`` may be a Checker instance or any registry spec
+    (a name like "elle-list-append", a ``{"name": ..., **opts}`` dict, a
+    mapping, or a list — see checker.core.resolve_checker): workload
+    configs can name their analysis declaratively."""
     logger.info("Analyzing history (%d ops)", len(history))
-    checker: Optional[Checker] = test.get("checker")
+    checker = test.get("checker")
     if checker is None:
         return {"valid": True, "note": "no checker configured"}
+    if not isinstance(checker, Checker):
+        from jepsen_tpu.checker.core import resolve_checker
+        checker = resolve_checker(checker)
     results = check_safe(checker, test, history,
                          {"store_dir": test.get("store_dir")})
     if results.get("valid") is False:

@@ -7,8 +7,63 @@ edges carry dependency types (ww/wr/rw/realtime/process).  Tarjan SCC
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict, deque
 from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+
+class SearchBudget:
+    """Work/time guard for cycle recovery.
+
+    Witness recovery is best-effort by nature (the verdict-deciding pass is
+    the closure / SCC scan); on a huge SCC the peel-and-research loop in
+    :func:`peeled_cycles` is O(cycles * E) and the per-start BFS of
+    :func:`find_cycle` is O(|C| * E) — enough to wedge the budgeted checker
+    path (checker/core.py check_safe) on a pathological history.  The budget
+    caps both a step counter (coarse-grained: nodes touched per peel / BFS
+    expansions) and, optionally, a wall-clock deadline; exhaustion flips
+    ``truncated`` and the searches stop yielding.  Callers surface the flag
+    as ``cycle-search-truncated`` so a truncated pass can never silently
+    certify a history (finish_result degrades a clean verdict to unknown).
+    """
+
+    #: default step ceiling — generous (a 10k-txn history's full suite
+    #: spends well under 10% of this) but finite, so the CPU fallback path
+    #: is bounded even when no explicit budget was configured.
+    DEFAULT_MAX_STEPS = 20_000_000
+    #: SCCs beyond this many nodes are reported truncated, not searched.
+    DEFAULT_MAX_SCC_NODES = 200_000
+    #: cap on shortest-cycle BFS starts inside one component (the search
+    #: stays correct — any cycle is a witness — just not globally shortest).
+    DEFAULT_MAX_CYCLE_STARTS = 2_000
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 max_steps: int = DEFAULT_MAX_STEPS,
+                 max_scc_nodes: int = DEFAULT_MAX_SCC_NODES,
+                 max_cycle_starts: int = DEFAULT_MAX_CYCLE_STARTS):
+        self.deadline = (time.monotonic() + deadline_s
+                         if deadline_s is not None else None)
+        self.steps = max_steps
+        self.max_scc_nodes = max_scc_nodes
+        self.max_cycle_starts = max_cycle_starts
+        self.truncated = False
+
+    def admit_scc(self, n_nodes: int) -> bool:
+        if n_nodes > self.max_scc_nodes:
+            self.truncated = True
+            return False
+        return self.spend(0)
+
+    def spend(self, n: int = 1) -> bool:
+        """Charge ``n`` work units; False (and truncated) once exhausted."""
+        if self.truncated:
+            return False
+        self.steps -= n
+        if self.steps < 0 or (self.deadline is not None
+                              and time.monotonic() > self.deadline):
+            self.truncated = True
+            return False
+        return True
 
 
 class Graph:
@@ -60,22 +115,31 @@ class Graph:
         return len(self.nodes)
 
 
-def peeled_cycles(g: Graph):
+def peeled_cycles(g: Graph, budget: Optional[SearchBudget] = None):
     """Yield node-disjoint cycles across the whole graph.
 
     ``find_cycle`` recovers one (shortest) cycle per SCC, but one SCC can
     merge several distinct anomalies (e.g. a ww 2-cycle bridged to a wr
     cycle).  After yielding a cycle, its nodes are peeled off and the
     remainder re-searched, so every node-disjoint cycle in a component is
-    reported (the coverage elle's checkers get from per-SCC re-search)."""
+    reported (the coverage elle's checkers get from per-SCC re-search).
+
+    ``budget`` (:class:`SearchBudget`) bounds the peel loop: each iteration
+    re-runs Tarjan over the remainder, so an adversarial SCC could cost
+    O(cycles * E) — past the budget the generator just stops (the caller
+    reads ``budget.truncated``)."""
     for comp in sccs(g):
+        if budget is not None and not budget.admit_scc(len(comp)):
+            continue
         remaining = set(comp)
         while len(remaining) >= 2:
+            if budget is not None and not budget.spend(len(remaining)):
+                return
             sub = g.subgraph(remaining)
             cyc = None
             for c in sccs(sub):
                 if len(c) >= 2:
-                    cyc = find_cycle(sub, c)
+                    cyc = find_cycle(sub, c, budget)
                     if cyc:
                         break
             if not cyc:
@@ -134,12 +198,20 @@ def sccs(g: Graph) -> List[List[Any]]:
     return out
 
 
-def find_cycle(g: Graph, component: List[Any]) -> Optional[List[Any]]:
+def find_cycle(g: Graph, component: List[Any],
+               budget: Optional[SearchBudget] = None) -> Optional[List[Any]]:
     """A shortest cycle within an SCC: BFS from each node back to itself
-    (bounded — component members only)."""
+    (bounded — component members only).  With a ``budget``, the number of
+    BFS starts is capped (any recovered cycle is a valid witness; only
+    global shortestness is sacrificed) and each start charges the
+    component size."""
     comp = set(component)
     best: Optional[List[Any]] = None
-    for start in component:
+    starts = component if budget is None \
+        else component[:budget.max_cycle_starts]
+    for start in starts:
+        if budget is not None and not budget.spend(len(comp)):
+            break
         # BFS over comp
         prev: Dict[Any, Any] = {start: None}
         q = deque([start])
@@ -172,7 +244,21 @@ def cycle_edge_kinds(g: Graph, cycle: List[Any]) -> List[Set[str]]:
     return [g.edge_kinds(a, b) for a, b in zip(cycle, cycle[1:])]
 
 
-def gsingle_cycles(g: Graph, cap: int = 64):
+def edge_list(g: Graph, cap: int = 100_000) -> List[Tuple[Any, Any, List[str]]]:
+    """The graph as a flat, JSON-friendly edge list ``(src, dst, kinds)``
+    for artifact export.  Capped: a dense realtime layer is O(N^2) edges
+    and the artifact is a debugging aid, not the verdict."""
+    out: List[Tuple[Any, Any, List[str]]] = []
+    for a, bs in g.out.items():
+        for b, ks in bs.items():
+            out.append((a, b, sorted(ks)))
+            if len(out) >= cap:
+                return out
+    return out
+
+
+def gsingle_cycles(g: Graph, cap: int = 64,
+                   budget: Optional[SearchBudget] = None):
     """Cycles with exactly one anti-dependency (rw) edge: for each rw edge
     a->b, a shortest return path b ->* a through edges that each offer a
     non-rw kind.  This is the targeted G-single search (elle runs one per
@@ -183,6 +269,8 @@ def gsingle_cycles(g: Graph, cap: int = 64):
         for b, ks in g.out[a].items():
             if "rw" not in ks:
                 continue
+            if budget is not None and not budget.spend(len(g)):
+                return out
             path = _bfs_path(g, b, a, lambda kinds: bool(kinds - {"rw"}))
             if path is not None:
                 out.append([a] + path)
@@ -192,7 +280,8 @@ def gsingle_cycles(g: Graph, cap: int = 64):
 
 
 def nonadjacent_rw_cycles(g: Graph, cap: int = 64,
-                          budget: int = 20000):
+                          budget: int = 20000,
+                          search_budget: Optional[SearchBudget] = None):
     """Cycles with >= 2 rw edges and no two adjacent around the cycle —
     the shape snapshot isolation cannot admit (every cycle in an SI
     execution carries two *consecutive* anti-dependency edges; Fekete).
@@ -212,7 +301,10 @@ def nonadjacent_rw_cycles(g: Graph, cap: int = 64,
         for b, ks in g.out[a].items():
             if "rw" not in ks:
                 continue
-            path = _simple_nonadjacent_path(g, a, b, budget)
+            if search_budget is not None and not search_budget.spend(0):
+                return out
+            path = _simple_nonadjacent_path(g, a, b, budget,
+                                            search_budget)
             if path is None:
                 continue
             out.append([a] + path)
@@ -221,8 +313,9 @@ def nonadjacent_rw_cycles(g: Graph, cap: int = 64,
     return out
 
 
-def _simple_nonadjacent_path(g: Graph, a, b,
-                             budget: int) -> Optional[List[Any]]:
+def _simple_nonadjacent_path(
+        g: Graph, a, b, budget: int,
+        search_budget: Optional[SearchBudget] = None) -> Optional[List[Any]]:
     """Simple path [b, ..., a] whose first hop is non-rw-preceded (the
     caller's a->b edge was rw), containing >= 1 further rw edge, no two
     rw edges adjacent, and a non-rw arrival at ``a``."""
@@ -232,6 +325,8 @@ def _simple_nonadjacent_path(g: Graph, a, b,
         n, last_rw, extra, path = stack.pop()
         seen_budget -= 1
         if seen_budget <= 0:
+            return None
+        if search_budget is not None and not search_budget.spend():
             return None
         on_path = set(path)
         for m, mks in g.out.get(n, {}).items():

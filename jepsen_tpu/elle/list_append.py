@@ -23,14 +23,20 @@ G0 (ww only), G1c (ww+wr), G-single (exactly one rw), G2-item (≥1 rw).
 from __future__ import annotations
 
 from collections import defaultdict
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from jepsen_tpu.elle import consistency
-from jepsen_tpu.elle.graph import (Graph, cycle_edge_kinds, gsingle_cycles,
+from jepsen_tpu.elle.graph import (Graph, SearchBudget, cycle_edge_kinds,
+                                   edge_list, gsingle_cycles,
                                    nonadjacent_rw_cycles, peeled_cycles)
 from jepsen_tpu.history import FAIL, History, INFO, INVOKE, OK, Op
 
 CYCLE_SEVERITY = ["G0", "G1c", "G-single", "G-nonadjacent", "G2-item"]
+
+# Same sentinel as checker.core.UNKNOWN — spelled out so elle stays
+# importable without the checker package.
+UNKNOWN = "unknown"
 
 
 def classify_cycle(kind_sets: List[Set[str]]) -> str:
@@ -68,7 +74,8 @@ def _cycle_sig(cyc: List[int]) -> Tuple[int, ...]:
 
 
 def collect_cycle_anomalies(g: Graph, txn_of: Dict[int, List],
-                            anomalies: Dict[str, List[Any]]) -> None:
+                            anomalies: Dict[str, List[Any]],
+                            budget: Optional[SearchBudget] = None) -> bool:
     """Run the full cycle-search suite and file each distinct cycle under
     its label.  The generic peeled pass alone is not enough below
     serializability: one SCC can hide a G-single or G-nonadjacent witness
@@ -80,13 +87,17 @@ def collect_cycle_anomalies(g: Graph, txn_of: Dict[int, List],
     - one-rw return paths  -> G-single
     - nonadjacent-rw BFS   -> G-nonadjacent
     - full graph, peeled   -> G2-item and anything the above missed
+
+    ``budget`` (one :class:`SearchBudget` shared by all five searches)
+    bounds the work; returns True when the suite was truncated — the
+    caller must then degrade a clean verdict (see :func:`finish_result`).
     """
     searches = [
-        peeled_cycles(g.filter_kinds({"ww", "realtime"})),
-        peeled_cycles(g.filter_kinds({"ww", "wr", "realtime"})),
-        gsingle_cycles(g),
-        nonadjacent_rw_cycles(g),
-        peeled_cycles(g),
+        peeled_cycles(g.filter_kinds({"ww", "realtime"}), budget),
+        peeled_cycles(g.filter_kinds({"ww", "wr", "realtime"}), budget),
+        gsingle_cycles(g, budget=budget),
+        nonadjacent_rw_cycles(g, search_budget=budget),
+        peeled_cycles(g, budget),
     ]
     seen: Set[Tuple] = set()
     for cycles in searches:
@@ -100,11 +111,49 @@ def collect_cycle_anomalies(g: Graph, txn_of: Dict[int, List],
             anomalies[label].append({
                 "cycle": [txn_of[t] for t in cyc],
                 "edges": [sorted(ks) for ks in kinds]})
+    return budget is not None and budget.truncated
+
+
+@dataclass
+class Analysis:
+    """Everything the linear host pass produces *before* cycle search: the
+    dependency graph (ww/wr/rw only — the realtime layer is dense and is
+    added on demand via :func:`add_realtime_edges`), per-txn labels, the
+    host-detectable anomalies (G1a/G1b/duplicates/incompatible-order), and
+    the ok/pair indices the realtime order derives from.  This is the
+    shared front half of the CPU checker and the elle_tpu encoder — both
+    paths literally analyze the same object, which is what makes their
+    anomaly sets identical by construction."""
+    graph: Graph
+    txn_of: Dict[int, List]
+    anomalies: Dict[str, List[Any]] = field(default_factory=dict)
+    oks: List[Tuple[int, Op]] = field(default_factory=list)
+    pairs: Sequence[int] = ()
+
+    @property
+    def count(self) -> int:
+        return len(self.oks)
+
+
+def add_realtime_edges(g: Graph, oks: List[Tuple[int, Op]],
+                       pairs: Sequence[int]) -> None:
+    """T1 -> T2 iff T1's completion index precedes T2's invocation index
+    (strict mode).  O(n^2) and dense — kept out of :func:`analyze` so the
+    device engine can compute the same relation as a broadcast compare and
+    only materialize these edges for witness recovery."""
+    for t1, (i1, _) in enumerate(oks):
+        for t2, (i2, _) in enumerate(oks):
+            if t1 == t2:
+                continue
+            inv2 = pairs[i2]
+            if inv2 >= 0 and i1 < inv2:
+                g.add_edge(t1, t2, "realtime")
 
 
 def check(history: History,
           consistency_models: Optional[Sequence[str]] = None,
-          realtime: bool = False) -> Dict[str, Any]:
+          realtime: bool = False,
+          search_budget: Optional[SearchBudget] = None) -> Dict[str, Any]:
     """Analyze a list-append history; returns an elle-shaped result map.
 
     ``consistency_models`` selects what ``valid`` means (append.clj:15-21
@@ -113,10 +162,28 @@ def check(history: History,
     write-skew cycle refutes ``("serializable",)`` (the default) yet passes
     ``("snapshot-isolation",)``.  The result carries elle's weakest-model
     boundary under ``not`` / ``also-not``.  Default: serializable, or
-    strict-serializable when ``realtime`` ordering is requested."""
+    strict-serializable when ``realtime`` ordering is requested.
+    ``search_budget`` bounds cycle recovery (see :class:`SearchBudget`)."""
     if consistency_models is None:
         consistency_models = (("strict-serializable",) if realtime
                               else ("serializable",))
+    a = analyze(history)
+    if realtime:
+        add_realtime_edges(a.graph, a.oks, a.pairs)
+    truncated = collect_cycle_anomalies(a.graph, a.txn_of, a.anomalies,
+                                        budget=search_budget)
+    res = finish_result(a.anomalies, consistency_models, a.count,
+                        truncated=truncated)
+    # complete edge list for artifact rendering; popped by
+    # elle.render.write_artifacts alongside anomalies-full
+    res["edges-full"] = edge_list(a.graph)
+    return res
+
+
+def analyze(history: History) -> Analysis:
+    """The linear host pass: indices, version orders, host anomalies, and
+    the ww/wr/rw dependency graph — everything but cycle search and the
+    realtime layer."""
     # Client ops only: a nemesis op's value (e.g. the killed node list)
     # is not a txn, and elle likewise analyzes the client subhistory
     # (elle's history preparation removes non-txn ops).
@@ -254,34 +321,34 @@ def check(history: History,
                 if w is not None and w != rtid:
                     g.add_edge(rtid, w, "rw")
 
-    if realtime:
-        # T1 -> T2 if T1's completion index < T2's invocation index
-        for t1, (i1, op1) in enumerate(oks):
-            inv1 = pairs[i1]
-            for t2, (i2, op2) in enumerate(oks):
-                if t1 == t2:
-                    continue
-                inv2 = pairs[i2]
-                if inv2 >= 0 and i1 < inv2:
-                    g.add_edge(t1, t2, "realtime")
-
-    collect_cycle_anomalies(g, txn_of, anomalies)
-
-    return finish_result(anomalies, consistency_models, len(oks))
+    return Analysis(graph=g, txn_of=txn_of, anomalies=anomalies,
+                    oks=oks, pairs=pairs)
 
 
 def finish_result(anomalies: Dict[str, List[Any]],
                   consistency_models: Sequence[str],
-                  count: int) -> Dict[str, Any]:
-    """Shared result assembly: model-relative validity + boundary report."""
+                  count: int, truncated: bool = False) -> Dict[str, Any]:
+    """Shared result assembly: model-relative validity + boundary report.
+
+    ``truncated`` (cycle search hit its :class:`SearchBudget`) degrades a
+    *clean* verdict to unknown — an exhausted search may simply not have
+    reached the refuting cycle — while found anomalies still refute.  The
+    marker rides as its own ``cycle-search-truncated`` key, never as an
+    anomaly type: consistency.refuted_models treats unknown anomaly types
+    as refuting everything, which would turn "gave up" into "invalid"."""
     valid = consistency.judge(consistency_models, anomalies)
-    return {"valid": valid,
-            "consistency-models": [consistency.canonicalize(m)
-                                   for m in consistency_models],
-            **consistency.boundary(anomalies),
-            "anomaly-types": sorted(anomalies),
-            "anomalies": {k: v[:8] for k, v in anomalies.items()},
-            # complete map for artifact rendering; popped by
-            # elle.render.write_artifacts so results stay small
-            "anomalies-full": dict(anomalies),
-            "count": count}
+    if truncated and valid is True:
+        valid = UNKNOWN
+    res = {"valid": valid,
+           "consistency-models": [consistency.canonicalize(m)
+                                  for m in consistency_models],
+           **consistency.boundary(anomalies),
+           "anomaly-types": sorted(anomalies),
+           "anomalies": {k: v[:8] for k, v in anomalies.items()},
+           # complete map for artifact rendering; popped by
+           # elle.render.write_artifacts so results stay small
+           "anomalies-full": dict(anomalies),
+           "count": count}
+    if truncated:
+        res["cycle-search-truncated"] = True
+    return res

@@ -9,10 +9,16 @@ anomaly files and graphviz cycle plots into the store dir
                        (elle's explained-cycle text format);
 - ``<type>-<i>.svg`` — a self-contained circular-layout digraph (no
                        graphviz dependency; same spirit as checker/render);
-- ``anomalies.json`` — the complete untruncated anomaly map.
+- ``anomalies.json`` — the complete untruncated anomaly map;
+- ``edges.jsonl``    — the dependency graph as one ``{src, dst, kinds}``
+                       object per line (from the checker's ``edges-full``),
+                       so a refuted run's graph can be re-searched offline.
 
-Rendering is best-effort and must never mask a verdict (the callers wrap
-it like Linearizable._render does for linear.svg).
+All files land via atomic_io.atomic_write: a run killed mid-render must
+never leave a torn artifact shadowing a good one (same discipline as the
+store's staged saves).  Rendering is best-effort and must never mask a
+verdict (the callers wrap it like Linearizable._render does for
+linear.svg).
 """
 
 from __future__ import annotations
@@ -21,6 +27,8 @@ import json
 import math
 import os
 from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.atomic_io import atomic_write
 
 # anomaly entries carrying these keys are dependency cycles
 _CYCLE_KEYS = ("cycle", "edges")
@@ -41,6 +49,7 @@ def write_artifacts(test, res: Dict[str, Any], opts) -> None:
     stays small.  Best-effort: artifact trouble must never mask the
     verdict."""
     full = res.pop("anomalies-full", None)
+    edges = res.pop("edges-full", None)
     if res.get("valid") is True or not (full or res.get("anomalies")):
         return
     d = (opts or {}).get("store_dir") or (test or {}).get("store_dir")
@@ -48,7 +57,8 @@ def write_artifacts(test, res: Dict[str, Any], opts) -> None:
         return
     try:
         path = write_anomaly_dir(
-            d, {**res, "anomalies": full or res.get("anomalies")})
+            d, {**res, "anomalies": full or res.get("anomalies")},
+            edges=edges)
         if path:
             res["anomaly-dir"] = path
     except Exception as e:  # noqa: BLE001
@@ -56,7 +66,8 @@ def write_artifacts(test, res: Dict[str, Any], opts) -> None:
 
 
 def write_anomaly_dir(store_dir: str, analysis: Dict[str, Any],
-                      subdir: str = "elle") -> Optional[str]:
+                      subdir: str = "elle",
+                      edges: Optional[List[Any]] = None) -> Optional[str]:
     """Write the ``elle/`` artifact directory for a checker analysis.
     Returns the directory path, or None when there is nothing to write."""
     anomalies = analysis.get("anomalies") or {}
@@ -64,24 +75,40 @@ def write_anomaly_dir(store_dir: str, analysis: Dict[str, Any],
         return None
     d = os.path.join(store_dir, subdir)
     os.makedirs(d, exist_ok=True)
-    with open(os.path.join(d, "anomalies.json"), "w") as f:
-        json.dump(anomalies, f, indent=2, default=repr)
+    atomic_write(os.path.join(d, "anomalies.json"),
+                 lambda f: json.dump(anomalies, f, indent=2, default=repr))
+    if edges:
+        atomic_write(os.path.join(d, "edges.jsonl"),
+                     lambda f: _dump_edges(f, edges))
     for typ, entries in anomalies.items():
         cycles = [e for e in entries if isinstance(e, dict)
                   and all(k in e for k in _CYCLE_KEYS)]
         if not cycles:
             continue
-        with open(os.path.join(d, f"{typ}.txt"), "w") as f:
+
+        def dump_txt(f, typ=typ, cycles=cycles):
             f.write(f"{len(cycles)} {typ} cycle(s)\n\n")
             for i, c in enumerate(cycles):
                 f.write(f"--- cycle {i} ---\n")
                 f.write(_explain_cycle(c))
                 f.write("\n")
+
+        atomic_write(os.path.join(d, f"{typ}.txt"), dump_txt)
         for i, c in enumerate(cycles[:MAX_SVGS_PER_TYPE]):
             svg = cycle_svg(c, title=f"{typ} #{i}")
-            with open(os.path.join(d, f"{typ}-{i}.svg"), "w") as f:
-                f.write(svg)
+            atomic_write(os.path.join(d, f"{typ}-{i}.svg"),
+                         lambda f, svg=svg: f.write(svg))
     return d
+
+
+def _dump_edges(f, edges: List[Any]) -> None:
+    """One {src, dst, kinds} object per line (txn ids are the checker's
+    dense 0..N-1 labels, matching the cycle witnesses' order)."""
+    for e in edges:
+        src, dst, kinds = e
+        f.write(json.dumps({"src": src, "dst": dst,
+                            "kinds": list(kinds)}, default=str))
+        f.write("\n")
 
 
 def _node_label(n: Any, limit: int = 48) -> str:

@@ -38,8 +38,9 @@ import bisect
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from jepsen_tpu.elle.graph import Graph
-from jepsen_tpu.elle.list_append import (collect_cycle_anomalies,
+from jepsen_tpu.elle.graph import Graph, SearchBudget, edge_list
+from jepsen_tpu.elle.list_append import (Analysis, add_realtime_edges,
+                                         collect_cycle_anomalies,
                                          finish_result)
 from jepsen_tpu.history import FAIL, History, INFO, OK, Op
 from jepsen_tpu.txn import READ_FS, WRITE_FS
@@ -48,13 +49,31 @@ from jepsen_tpu.txn import READ_FS, WRITE_FS
 def check(history: History, realtime: bool = False,
           consistency_models: Optional[Sequence[str]] = None,
           sequential_keys: bool = False,
-          linearizable_keys: bool = False) -> Dict[str, Any]:
+          linearizable_keys: bool = False,
+          search_budget: Optional[SearchBudget] = None) -> Dict[str, Any]:
     """Analyze an rw-register history; ``consistency_models`` selects what
     ``valid`` means (wr.clj:9-25 consumes elle the same way) — see
     :func:`jepsen_tpu.elle.list_append.check`."""
     if consistency_models is None:
         consistency_models = (("strict-serializable",) if realtime
                               else ("serializable",))
+    a = analyze(history, sequential_keys=sequential_keys,
+                linearizable_keys=linearizable_keys)
+    if realtime:
+        add_realtime_edges(a.graph, a.oks, a.pairs)
+    truncated = collect_cycle_anomalies(a.graph, a.txn_of, a.anomalies,
+                                        budget=search_budget)
+    res = finish_result(a.anomalies, consistency_models, a.count,
+                        truncated=truncated)
+    res["edges-full"] = edge_list(a.graph)
+    return res
+
+
+def analyze(history: History, sequential_keys: bool = False,
+            linearizable_keys: bool = False) -> Analysis:
+    """The linear host pass: version-graph recovery, host anomalies, and
+    the ww/wr/rw dependency graph — everything but cycle search and the
+    realtime layer (see :class:`jepsen_tpu.elle.list_append.Analysis`)."""
     # Client ops only (see list_append.check: nemesis values are not txns).
     history = history.client_ops()
     pairs = history.pair_index()
@@ -164,17 +183,8 @@ def check(history: History, realtime: bool = False,
                     if r != w2:
                         g.add_edge(r, w2, "rw")
 
-    if realtime:
-        for t1, (i1, _) in enumerate(oks):
-            for t2, (i2, _) in enumerate(oks):
-                if t1 != t2:
-                    inv2 = pairs[i2]
-                    if inv2 >= 0 and i1 < inv2:
-                        g.add_edge(t1, t2, "realtime")
-
-    collect_cycle_anomalies(g, txn_of, anomalies)
-
-    return finish_result(anomalies, consistency_models, len(oks))
+    return Analysis(graph=g, txn_of=txn_of, anomalies=anomalies,
+                    oks=oks, pairs=pairs)
 
 
 def _order_writes(oks, pairs, vg, sequential_keys, linearizable_keys) -> None:

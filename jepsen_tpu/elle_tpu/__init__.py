@@ -1,0 +1,38 @@
+"""elle_tpu: the device tier of the Elle transactional-anomaly checkers.
+
+The CPU checkers (jepsen_tpu.elle.list_append / rw_register) spend their
+time in two places: a linear host pass that infers the dependency graph,
+and a cycle-search suite over that graph.  The search is the hot part —
+and "is this graph cyclic (under this edge-kind mask)?" is a dense
+linear-algebra question: build the boolean adjacency matrix, close it
+under repeated squaring (``R <- min(R + R@R, 1)``), and read the trace.
+That formulation batches across whole histories with ``vmap`` — the same
+decomposition argument as the linearizability batch tier
+(P-compositionality, arXiv:1504.00204; decrease-and-conquer monitoring,
+arXiv:2410.04581).
+
+Division of labor (this is what makes device results *identical* to the
+CPU oracle, not merely close):
+
+- the host pass (``elle.list_append.analyze`` / ``elle.rw_register
+  .analyze``) runs unchanged — same graph, same host anomalies;
+- the device decides, per lane and per edge-kind mask, only the boolean
+  "does a cycle exist" (cyclic / G0 / G1c / G-single flags);
+- when a lane is cyclic, witness recovery runs the *same*
+  ``collect_cycle_anomalies`` suite on the *same* graph the CPU checker
+  would have searched, so the reported anomaly set is the CPU set by
+  construction.  Acyclic lanes — the common case — skip CPU search
+  entirely.
+
+Module map: ``encode`` (history -> dense tensors), ``graphs`` (lane-group
+packing/padding), ``closure`` (the jitted vmapped flag kernel),
+``anomalies`` (per-lane verdict assembly + witness recovery), ``engine``
+(grouping, sharding, budgets, degradation chain).  See docs/elle_tpu.md.
+"""
+
+from jepsen_tpu.elle_tpu.closure import FLAG_NAMES
+from jepsen_tpu.elle_tpu.encode import EncodedHistory, encode
+from jepsen_tpu.elle_tpu.engine import available, check, check_batch
+
+__all__ = ["EncodedHistory", "FLAG_NAMES", "available", "check",
+           "check_batch", "encode"]

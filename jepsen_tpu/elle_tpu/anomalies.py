@@ -1,0 +1,59 @@
+"""Per-lane verdict assembly: device flags -> elle-shaped result map.
+
+The device only answers "is there a cycle (under mask X)?" — everything
+human-readable comes from the CPU machinery, run *only when needed*:
+
+- acyclic lane: no cycle search at all.  The host anomalies from
+  ``analyze`` (G1a/G1b/duplicates/...) plus empty cycle families are
+  exactly what the CPU checker would have produced (its searches find
+  nothing in an acyclic graph), so the results agree without the work.
+- cyclic lane: materialize the realtime layer (if strict mode) and run
+  the same ``collect_cycle_anomalies`` suite over the same graph the CPU
+  checker uses — identical witnesses, identical labels.
+- flags unavailable (device error / engine="cpu"): recovery runs
+  unconditionally; the result is the CPU checker's, reached through the
+  engine's degradation chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.elle.graph import SearchBudget, edge_list
+from jepsen_tpu.elle.list_append import (add_realtime_edges,
+                                         collect_cycle_anomalies,
+                                         finish_result)
+from jepsen_tpu.elle_tpu.closure import FLAG_NAMES
+from jepsen_tpu.elle_tpu.encode import EncodedHistory
+
+ANALYZER = "elle-tpu"
+
+
+def finish_lane(enc: EncodedHistory,
+                flags: Optional[np.ndarray],
+                realtime: bool,
+                consistency_models: Sequence[str],
+                budget: Optional[SearchBudget] = None) -> Dict[str, Any]:
+    """One lane's result map from its encoding and device flag vector
+    (``flags=None`` means "no device verdict — search unconditionally")."""
+    a = enc.analysis
+    truncated = False
+    if flags is None or bool(flags[0]):
+        if realtime:
+            add_realtime_edges(a.graph, a.oks, a.pairs)
+        truncated = collect_cycle_anomalies(a.graph, a.txn_of, a.anomalies,
+                                            budget=budget)
+    res = finish_result(a.anomalies, consistency_models, a.count,
+                        truncated=truncated)
+    res["analyzer"] = ANALYZER
+    if flags is not None:
+        res["device-flags"] = {name: bool(v)
+                               for name, v in zip(FLAG_NAMES, flags)}
+    # Complete edge list for artifact rendering (popped by
+    # elle.render.write_artifacts).  On an acyclic strict-mode lane the
+    # dense realtime layer was never materialized host-side — the list
+    # then carries the ww/wr/rw core only.
+    res["edges-full"] = edge_list(a.graph)
+    return res

@@ -1,0 +1,106 @@
+"""History -> dense device tensors for the elle_tpu engine.
+
+The encoder is deliberately thin: it runs the *CPU checker's own* host
+pass (``elle.list_append.analyze`` / ``elle.rw_register.analyze``) and
+merely re-shapes its dependency graph into fixed-kind edge arrays, plus
+the invoke/complete index vectors the device needs to rebuild the
+realtime order as a broadcast comparison.  Sharing the host pass is the
+parity argument's foundation — both tiers literally analyze the same
+``Analysis`` object (see the package docstring).
+
+Encoding:
+
+- ``src/dst [3, E] int32`` — per-kind (ww, wr, rw) edge endpoints, padded
+  with ``-1``.  The device reconstructs each adjacency layer as
+  ``one_hot(src).T @ one_hot(dst)`` (a ``-1`` one-hots to a zero row, so
+  padding vanishes); a matmul-based build sidesteps the vmapped
+  bool-scatter miscompile documented at parallel/batch.py (the
+  MAX_LANES_PER_GROUP cap) entirely.
+- ``invoke/complete [N] int32`` — each txn's invocation/completion index
+  in the client subhistory.  ``invoke = -1`` marks an unknown invocation
+  (no realtime edges *into* that txn, matching the CPU checker's
+  ``inv >= 0`` guard); padding rows get ``complete = COMPLETE_PAD`` (no
+  realtime edges *out of* them either).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from jepsen_tpu.elle import list_append, rw_register
+from jepsen_tpu.elle.list_append import Analysis
+from jepsen_tpu.history import History
+
+#: edge-kind layer order of the ``src``/``dst`` arrays.
+KINDS = ("ww", "wr", "rw")
+
+WORKLOADS = ("list-append", "rw-register")
+
+#: completion index for padding txn slots: later than any real invocation,
+#: so a padded row emits no realtime edge.
+COMPLETE_PAD = np.int32(2**30)
+
+
+@dataclass
+class EncodedHistory:
+    """One history's device encoding plus the host ``Analysis`` it came
+    from (kept for witness recovery — the device only answers booleans)."""
+    analysis: Analysis
+    workload: str
+    src: np.ndarray        # [len(KINDS), E] int32, -1-padded
+    dst: np.ndarray        # [len(KINDS), E] int32, -1-padded
+    invoke: np.ndarray     # [N] int32, -1 = unknown invocation
+    complete: np.ndarray   # [N] int32
+
+    @property
+    def n(self) -> int:
+        return self.analysis.count
+
+    @property
+    def n_edges(self) -> int:
+        return int((self.src >= 0).sum())
+
+
+def analyze(history: History, workload: str = "list-append",
+            **workload_kw) -> Analysis:
+    """Dispatch to the workload's host pass."""
+    if workload == "list-append":
+        return list_append.analyze(history, **workload_kw)
+    if workload == "rw-register":
+        return rw_register.analyze(history, **workload_kw)
+    raise ValueError(f"unknown elle workload {workload!r}; "
+                     f"known: {WORKLOADS}")
+
+
+def encode(history: History, workload: str = "list-append",
+           **workload_kw) -> EncodedHistory:
+    return encode_analysis(analyze(history, workload, **workload_kw),
+                           workload)
+
+
+def encode_analysis(a: Analysis, workload: str) -> EncodedHistory:
+    per = {k: ([], []) for k in KINDS}
+    for s, bs in a.graph.out.items():
+        for d, ks in bs.items():
+            for k in ks:
+                if k in per:
+                    per[k][0].append(s)
+                    per[k][1].append(d)
+    e = max(1, max(len(per[k][0]) for k in KINDS))
+    src = np.full((len(KINDS), e), -1, np.int32)
+    dst = np.full((len(KINDS), e), -1, np.int32)
+    for i, k in enumerate(KINDS):
+        m = len(per[k][0])
+        src[i, :m] = per[k][0]
+        dst[i, :m] = per[k][1]
+    n = a.count
+    invoke = np.full(max(1, n), -1, np.int32)
+    complete = np.full(max(1, n), COMPLETE_PAD, np.int32)
+    for t, (i, _) in enumerate(a.oks):
+        complete[t] = i
+        inv = int(a.pairs[i])
+        invoke[t] = inv if inv >= 0 else -1
+    return EncodedHistory(analysis=a, workload=workload, src=src, dst=dst,
+                          invoke=invoke, complete=complete)

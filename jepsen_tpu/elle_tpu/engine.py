@@ -1,0 +1,159 @@
+"""The elle_tpu engine: grouping, sharding, budgets, degradation chain.
+
+``check_batch`` fans a set of histories out as lanes of the vmapped
+closure kernel:
+
+- lanes are dispatched in bounded groups — at most
+  ``parallel.batch.MAX_LANES_PER_GROUP`` (the vmap-width cap that
+  module's bool-scatter repro established; the one-hot-matmul kernel
+  avoids the scatter, but staying under the proven-safe width costs
+  nothing) and at most ``LANE_CELLS_PER_GROUP / n_pad^2`` lanes so one
+  dispatch's adjacency residency stays bounded as histories grow;
+- with a ``mesh``, each group is padded to the lane axis and sharded
+  with ``NamedSharding(mesh, P(axis, ...))`` like parallel/batch.py —
+  pure SPMD fan-out, no collectives;
+- ``budget_s`` bounds the *whole call's* witness recovery: every lane's
+  CPU search gets a SearchBudget deadline at the call's remaining time
+  (the device pass itself is a handful of bounded matmuls — it's the
+  host-side cycle search that can wedge, see elle.graph.SearchBudget);
+- a device failure downgrades the affected group to the CPU path with a
+  ``fallback``/``fallback-chain`` annotation, mirroring
+  checker.linearizable's TPU->CPU chain: a device error says nothing
+  about the history and must never decide a verdict.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.elle.graph import SearchBudget
+from jepsen_tpu.elle_tpu.anomalies import finish_lane
+from jepsen_tpu.elle_tpu.encode import EncodedHistory, encode
+from jepsen_tpu.elle_tpu.graphs import pack_group, padded_n
+from jepsen_tpu.history import History
+from jepsen_tpu.parallel.batch import MAX_LANES_PER_GROUP
+
+log = logging.getLogger(__name__)
+
+#: cap on (lanes x n_pad^2) adjacency cells resident per dispatch: three
+#: closure masks plus temporaries per lane, so ~16M cells keeps a group
+#: under a few hundred MB of f32 at any history size.
+LANE_CELLS_PER_GROUP = 1 << 24
+
+ENGINES = ("auto", "tpu", "cpu")
+
+
+def available() -> bool:
+    """True when a JAX backend with at least one device is importable —
+    the engine itself is backend-agnostic (the kernel is plain jnp)."""
+    try:
+        import jax
+        return len(jax.devices()) > 0
+    except Exception:  # noqa: BLE001 — any init failure means "no"
+        return False
+
+
+def group_cap(n_pad: int) -> int:
+    return max(1, min(MAX_LANES_PER_GROUP,
+                      LANE_CELLS_PER_GROUP // max(1, n_pad * n_pad)))
+
+
+def check(history: History, **kw) -> Dict[str, Any]:
+    """Single-history convenience wrapper over :func:`check_batch`."""
+    return check_batch([history], **kw)[0]
+
+
+def check_batch(histories: Sequence[History],
+                workload: str = "list-append",
+                realtime: bool = False,
+                consistency_models: Optional[Sequence[str]] = None,
+                engine: str = "auto",
+                mesh=None,
+                axis: str = "data",
+                budget_s: Optional[float] = None,
+                **workload_kw) -> List[Dict[str, Any]]:
+    """Check many histories at once; one elle-shaped result per history.
+
+    ``engine``: ``"auto"``/``"tpu"`` run the device pass (falling back to
+    CPU per group on device errors), ``"cpu"`` skips the device and runs
+    the full CPU search per lane (still through this code path, so budget
+    and artifacts behave identically)."""
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r}; known: {ENGINES}")
+    if not histories:
+        return []
+    if consistency_models is None:
+        consistency_models = (("strict-serializable",) if realtime
+                              else ("serializable",))
+    deadline = (time.monotonic() + budget_s) if budget_s is not None else None
+    encs = [encode(h, workload, **workload_kw) for h in histories]
+    n_pad = padded_n(encs)
+    cap = group_cap(n_pad)
+    use_device = engine != "cpu" and available()
+    if engine == "tpu" and not use_device:
+        raise RuntimeError("elle_tpu device engine requested but no JAX "
+                           "device is available")
+
+    out: List[Dict[str, Any]] = []
+    for i in range(0, len(encs), cap):
+        group = encs[i:i + cap]
+        flags: Optional[np.ndarray] = None
+        chain: Optional[List[Dict[str, Any]]] = None
+        if use_device:
+            try:
+                flags = _device_flags(group, n_pad, realtime, mesh, axis)
+            except Exception as e:  # noqa: BLE001
+                # Device trouble (XLA OOM, runtime wedge, ...) says nothing
+                # about the histories: degrade this group to the CPU path,
+                # annotated like checker.linearizable's fallback chain.
+                log.warning("elle-tpu device pass failed (%s: %s); "
+                            "falling back to CPU search for %d lane(s)",
+                            type(e).__name__, e, len(group))
+                chain = [{"solver": "elle-tpu", "error": str(e),
+                          "error-type": type(e).__name__}]
+        for j, enc in enumerate(group):
+            budget = (SearchBudget(deadline_s=max(
+                0.0, deadline - time.monotonic()))
+                if deadline is not None else None)
+            res = finish_lane(enc, flags[j] if flags is not None else None,
+                              realtime, consistency_models, budget=budget)
+            if chain is not None:
+                res["fallback"] = {"from": "elle-tpu", "to": "elle-cpu",
+                                   **{k: chain[0][k]
+                                      for k in ("error", "error-type")}}
+                res["fallback-chain"] = chain
+                res["analyzer"] = "elle-cpu"
+            elif flags is None:
+                res["analyzer"] = "elle-cpu"
+            out.append(res)
+    return out
+
+
+def _device_flags(group: Sequence[EncodedHistory], n_pad: int,
+                  realtime: bool, mesh, axis: str) -> np.ndarray:
+    """One vmapped dispatch over a lane group; returns [len(group), 4]."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from jepsen_tpu.elle_tpu.closure import lane_flags_fn
+
+    b = len(group)
+    b_pad = b
+    if mesh is not None:
+        n_sh = mesh.shape[axis]
+        b_pad = ((b + n_sh - 1) // n_sh) * n_sh
+    packed = pack_group(group, n_pad=n_pad, b_pad=b_pad)
+    arrays = {k: jnp.asarray(v) for k, v in packed.items()}
+    if mesh is not None:
+        arrays = {k: jax.device_put(
+            v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1)))))
+            for k, v in arrays.items()}
+    fn = lane_flags_fn(n_pad, realtime)
+    flags = fn(arrays["src"], arrays["dst"],
+               arrays["invoke"], arrays["complete"])
+    return np.asarray(flags)[:b]

@@ -1,0 +1,58 @@
+"""Lane-group packing: stack per-history encodings into padded batches.
+
+One vmapped dispatch wants rectangular arrays, so a group of encoded
+histories is padded to shared shapes:
+
+- ``n_pad`` — txn count, rounded up to a multiple of 32 (min 32): the
+  adjacency matrices are ``[n_pad, n_pad]`` and matmul tiles like round
+  shapes; sharing one ``n_pad`` across *all* groups of a batch keeps one
+  compiled kernel per (n_pad, realtime) rather than one per group.
+- ``e_pad`` — edges per kind, rounded up to a multiple of 64 (min 64),
+  ``-1``-padded (a ``-1`` endpoint one-hots to zero: padding contributes
+  no edge).
+- ``b_pad`` — lanes, padded with empty histories (all ``-1`` edges,
+  ``invoke = -1``, ``complete = COMPLETE_PAD``) so a mesh-sharded batch
+  divides evenly over the lane axis; padded lanes compute all-False
+  flags and are dropped by the caller.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.elle_tpu.encode import COMPLETE_PAD, KINDS, EncodedHistory
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def padded_n(encs: Sequence[EncodedHistory]) -> int:
+    """The shared adjacency dimension for a batch of encodings."""
+    return max(32, _round_up(max((e.n for e in encs), default=1) or 1, 32))
+
+
+def pack_group(encs: Sequence[EncodedHistory],
+               n_pad: Optional[int] = None,
+               b_pad: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Stack a lane group into one padded batch of device inputs."""
+    if n_pad is None:
+        n_pad = padded_n(encs)
+    b = len(encs)
+    if b_pad is None:
+        b_pad = b
+    e_pad = max(64, _round_up(max(e.src.shape[1] for e in encs), 64))
+    src = np.full((b_pad, len(KINDS), e_pad), -1, np.int32)
+    dst = np.full((b_pad, len(KINDS), e_pad), -1, np.int32)
+    invoke = np.full((b_pad, n_pad), -1, np.int32)
+    complete = np.full((b_pad, n_pad), COMPLETE_PAD, np.int32)
+    for i, enc in enumerate(encs):
+        ew = enc.src.shape[1]
+        src[i, :, :ew] = enc.src
+        dst[i, :, :ew] = enc.dst
+        nn = enc.invoke.shape[0]
+        invoke[i, :nn] = enc.invoke
+        complete[i, :nn] = enc.complete
+    return {"src": src, "dst": dst, "invoke": invoke, "complete": complete}

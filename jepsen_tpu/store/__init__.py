@@ -128,6 +128,10 @@ def save_2(test: Dict[str, Any], results: Dict[str, Any]) -> None:
                                               "keys": sorted(results)})
                 for k, v in results.items():
                     w.append_named_json(f"results/{k}", v)
+                # Elle anomaly artifacts (edge list, anomaly listings —
+                # elle/render.py) ride along as named blocks, so the
+                # verdict file is self-contained for refuted runs.
+                _fmt.index_artifact_dir(w, d, "elle")
     except Exception:  # noqa: BLE001 - results.json is authoritative
         pass
 

@@ -288,6 +288,46 @@ def verify(path: str) -> int:
     return sum(1 for _ in read_blocks(path))
 
 
+# -- artifact indexing -------------------------------------------------------
+
+#: per-file embed ceiling for index_artifact_dir: anomaly listings and
+#: edge lists fit; nothing pathological can balloon results.jtsf.
+MAX_ARTIFACT_BYTES = 4 << 20
+
+
+def index_artifact_dir(writer: Writer, store_dir: str,
+                       subdir: str = "elle") -> int:
+    """Index a run's artifact directory (e.g. the elle/ anomaly dir) into
+    a block store: each file becomes a named block
+    ``artifacts/<subdir>/<name>`` (its bytes, up to MAX_ARTIFACT_BYTES),
+    and a manifest block ``artifacts/<subdir>`` lists every file with its
+    size and whether it was embedded.  Readers then pull one anomaly
+    listing or the edge list with a single seek — without the store dir
+    even present (results.jtsf travels alone).  Returns the number of
+    files indexed (0 when the directory doesn't exist)."""
+    d = os.path.join(store_dir, subdir)
+    if not os.path.isdir(d):
+        return 0
+    manifest = []
+    for name in sorted(os.listdir(d)):
+        path = os.path.join(d, name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        entry = {"name": name, "bytes": size,
+                 "embedded": size <= MAX_ARTIFACT_BYTES}
+        if entry["embedded"]:
+            with open(path, "rb") as f:
+                writer.append_named(f"artifacts/{subdir}/{name}", f.read())
+        manifest.append(entry)
+    if manifest:
+        writer.append_named_json(f"artifacts/{subdir}", manifest)
+    return len(manifest)
+
+
 # -- history-specific layer --------------------------------------------------
 
 OPS_PER_BLOCK = 1024

@@ -283,6 +283,210 @@ def corrupt_multi_reads(history: History, n: int = 1, seed: int = 0,
     return History(ops, reindex=True)
 
 
+def list_append_history(n_txns: int = 100,
+                        keys: int = 3,
+                        concurrency: int = 5,
+                        max_txn_len: int = 4,
+                        read_p: float = 0.5,
+                        fail_p: float = 0.05,
+                        anomaly_p: float = 0.0,
+                        seed: int = 0) -> History:
+    """Simulate ``n_txns`` list-append transactions against an atomic
+    per-key list store (elle's append.clj workload shape): each txn is a
+    list of ``["append", k, v]`` / ``["r", k, [vs...]]`` mops, appended
+    values unique per key, and every txn takes effect atomically at its
+    completion — so the history is strict-serializable *by construction*.
+    ``fail_p`` txns abort (their appends never apply — G1a bait for the
+    corruptor).  ``anomaly_p > 0`` then corrupts that fraction of ok
+    reads via :func:`corrupt_list_append`, producing histories with known
+    anomaly families for checker fuzzing."""
+    rng = random.Random(seed)
+    state = {k: [] for k in range(keys)}
+    counters = {k: 0 for k in range(keys)}
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending = {}
+    t = 0
+    invoked = 0
+    while invoked < n_txns or pending:
+        t += rng.randint(1, 1000)
+        if free and invoked < n_txns and (rng.random() < 0.55 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            txn = []
+            for _ in range(rng.randint(1, max_txn_len)):
+                k = rng.randrange(keys)
+                if rng.random() < read_p:
+                    txn.append(["r", k, None])
+                else:
+                    counters[k] += 1
+                    txn.append(["append", k, counters[k]])
+            history.append(Op(process=p, type=INVOKE, f="txn",
+                              value=txn, time=t))
+            pending[p] = (txn, rng.random() < fail_p)
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            txn, will_fail = pending.pop(p)
+            if will_fail:
+                history.append(Op(process=p, type=FAIL, f="txn",
+                                  value=txn, time=t))
+            else:
+                filled = []
+                for f_, k, v in txn:
+                    if f_ == "append":
+                        state[k] = state[k] + [v]
+                        filled.append(["append", k, v])
+                    else:
+                        filled.append(["r", k, list(state[k])])
+                history.append(Op(process=p, type=OK, f="txn",
+                                  value=filled, time=t))
+            free.append(p)
+    h = History(history, reindex=True)
+    if anomaly_p > 0:
+        h = corrupt_list_append(h, anomaly_p=anomaly_p, seed=seed)
+    return h
+
+
+def corrupt_list_append(history: History, anomaly_p: float = 0.1,
+                        seed: int = 0) -> History:
+    """Corrupt ok list-reads to inject elle-detectable anomalies: swap
+    the last two observed elements (incompatible-order and order cycles),
+    truncate the last element (a stale read — rw inversions), or splice
+    in a value appended by a *failed* txn (G1a)."""
+    rng = random.Random(seed + 1)
+    failed_by_key = {}
+    for op in history:
+        if op.type == FAIL and isinstance(op.value, (list, tuple)):
+            for f_, k, v in op.value:
+                if f_ == "append":
+                    failed_by_key.setdefault(k, []).append(v)
+    ops = [o.with_() for o in history]
+    for i, op in enumerate(ops):
+        if op.type != OK or not isinstance(op.value, (list, tuple)):
+            continue
+        txn = [list(m) for m in op.value]
+        changed = False
+        for m in txn:
+            if m[0] != "r" or not m[2] or rng.random() >= anomaly_p:
+                continue
+            lst = list(m[2])
+            roll = rng.random()
+            if roll < 0.4 and len(lst) >= 2:
+                lst[-1], lst[-2] = lst[-2], lst[-1]
+            elif roll < 0.7:
+                lst = lst[:-1]
+            elif failed_by_key.get(m[1]):
+                lst = lst + [rng.choice(failed_by_key[m[1]])]
+            else:
+                lst = lst[:-1]
+            m[2] = lst
+            changed = True
+        if changed:
+            ops[i] = op.with_(value=txn)
+    return History(ops, reindex=True)
+
+
+def rw_register_history(n_txns: int = 100,
+                        keys: int = 3,
+                        concurrency: int = 5,
+                        max_txn_len: int = 4,
+                        read_p: float = 0.5,
+                        fail_p: float = 0.05,
+                        anomaly_p: float = 0.0,
+                        seed: int = 0) -> History:
+    """Simulate ``n_txns`` read/write-register transactions (elle's
+    wr.clj workload shape): ``["w", k, v]`` with v unique per key,
+    ``["r", k, v]`` observing the current value, txns atomic at
+    completion — strict-serializable by construction.  ``anomaly_p``
+    corrupts ok reads via :func:`corrupt_rw_register`."""
+    rng = random.Random(seed)
+    state = {}
+    counters = {k: 0 for k in range(keys)}
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending = {}
+    t = 0
+    invoked = 0
+    while invoked < n_txns or pending:
+        t += rng.randint(1, 1000)
+        if free and invoked < n_txns and (rng.random() < 0.55 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            txn = []
+            for _ in range(rng.randint(1, max_txn_len)):
+                k = rng.randrange(keys)
+                if rng.random() < read_p:
+                    txn.append(["r", k, None])
+                else:
+                    counters[k] += 1
+                    txn.append(["w", k, counters[k]])
+            history.append(Op(process=p, type=INVOKE, f="txn",
+                              value=txn, time=t))
+            pending[p] = (txn, rng.random() < fail_p)
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            txn, will_fail = pending.pop(p)
+            if will_fail:
+                history.append(Op(process=p, type=FAIL, f="txn",
+                                  value=txn, time=t))
+            else:
+                filled = []
+                for f_, k, v in txn:
+                    if f_ == "w":
+                        state[k] = v
+                        filled.append(["w", k, v])
+                    else:
+                        filled.append(["r", k, state.get(k)])
+                history.append(Op(process=p, type=OK, f="txn",
+                                  value=filled, time=t))
+            free.append(p)
+    h = History(history, reindex=True)
+    if anomaly_p > 0:
+        h = corrupt_rw_register(h, anomaly_p=anomaly_p, seed=seed)
+    return h
+
+
+def corrupt_rw_register(history: History, anomaly_p: float = 0.1,
+                        seed: int = 0) -> History:
+    """Corrupt ok register-reads: rewind to an older committed value of
+    the key (stale reads — wr/ww/rw inversions once version orders are
+    recovered) or observe a *failed* write's value (G1a)."""
+    rng = random.Random(seed + 1)
+    committed = {}
+    failed_by_key = {}
+    for op in history:
+        if not isinstance(op.value, (list, tuple)):
+            continue
+        for f_, k, v in op.value:
+            if f_ == "w" and op.type == OK:
+                committed.setdefault(k, []).append(v)
+            elif f_ == "w" and op.type == FAIL:
+                failed_by_key.setdefault(k, []).append(v)
+    ops = [o.with_() for o in history]
+    for i, op in enumerate(ops):
+        if op.type != OK or not isinstance(op.value, (list, tuple)):
+            continue
+        txn = [list(m) for m in op.value]
+        changed = False
+        for m in txn:
+            if m[0] != "r" or m[2] is None or rng.random() >= anomaly_p:
+                continue
+            k = m[1]
+            older = [v for v in committed.get(k, []) if v != m[2]]
+            if rng.random() < 0.7 and older:
+                m[2] = rng.choice(older)
+            elif failed_by_key.get(k):
+                m[2] = rng.choice(failed_by_key[k])
+            elif older:
+                m[2] = rng.choice(older)
+            else:
+                continue
+            changed = True
+        if changed:
+            ops[i] = op.with_(value=txn)
+    return History(ops, reindex=True)
+
+
 def corrupt_reads(history: History, n: int = 1, seed: int = 0,
                   values: int = 5,
                   within: float | None = None) -> History:

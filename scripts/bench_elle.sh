@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Smoke the elle_tpu bench tier: a shrunken (JTPU_BENCH_SMOKE) run of
+# bench.py --tier elle on the CPU backend.  Proves the device engine, the
+# lane-by-lane CPU-oracle parity assertion, and the emit contract all work
+# on a machine with no accelerator — the tier itself aborts on any parity
+# miss, so a green exit IS the parity proof.
+#
+# Usage: scripts/bench_elle.sh [extra env...]
+# The full hardware record stays bench.py (no --tier) on the device host;
+# smoke never touches the committed bench_full.json.
+set -eu -o pipefail
+
+cd "$(dirname "$0")/.."
+
+export JTPU_BENCH_SMOKE=1
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+out="$(python bench.py --tier elle 2> >(tail -20 >&2))"
+echo "$out" | grep "^JTPU_TIER_RESULT " | tail -1 | sed 's/^JTPU_TIER_RESULT //'
+echo "$out" | grep -q "^JTPU_TIER_RESULT " || {
+    echo "bench_elle: no result line emitted" >&2
+    exit 1
+}
