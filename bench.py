@@ -661,6 +661,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier", choices=sorted(TIER_FNS))
     args = ap.parse_args()
+    # Persistent XLA compile cache shared with the CLI and the checking
+    # service: tier subprocesses re-use each other's compiles.
+    from jepsen_tpu.ops.cache import init_compilation_cache
+    init_compilation_cache(os.environ.get("JEPSEN_TPU_STORE", "store"))
     if args.tier:
         TIER_FNS[args.tier]()
         return 0

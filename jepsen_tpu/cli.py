@@ -101,13 +101,40 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
     pa = sub.add_parser("analyze", help="re-check a stored run")
     pa.add_argument("dir", help="store run directory (or .../latest)")
 
-    ps = sub.add_parser("serve", help="results web browser")
+    ps = sub.add_parser("serve",
+                        help="results web browser + checking service")
     ps.add_argument("--port", type=int, default=8080)
     ps.add_argument("--store", default="store")
+    ps.add_argument("--no-service", action="store_true",
+                    help="results browser only, no checking service")
+    ps.add_argument("--max-lanes", type=int, default=64,
+                    help="lanes per device dispatch")
+    ps.add_argument("--max-queue", type=int, default=4096,
+                    help="admission-control queue depth (cells)")
+
+    pq = sub.add_parser("submit",
+                        help="submit a stored history to a running serve")
+    pq.add_argument("dir", help="store run directory (or .../latest)")
+    pq.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="base URL of the running serve")
+    pq.add_argument("--kind", choices=["wgl", "elle"], default="wgl")
+    pq.add_argument("--model", default="cas-register",
+                    help="device model name (wgl kind)")
+    pq.add_argument("--workload", default="list-append",
+                    help="elle workload (elle kind)")
+    pq.add_argument("--realtime", action="store_true")
+    pq.add_argument("--independent", action="store_true",
+                    help="history is an independent workload: restore "
+                         "[k, v] values to keyed tuples so the service "
+                         "splits per key")
+    pq.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline in seconds")
 
     args = parser.parse_args(argv)
 
     if args.cmd == "test":
+        from jepsen_tpu.ops.cache import init_compilation_cache
+        init_compilation_cache(args.store)
         opts = test_opts_to_map(args)
         for k, v in vars(args).items():
             if k not in opts and v is not None:
@@ -133,23 +160,81 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
 
     if args.cmd == "serve":
         from jepsen_tpu.web import serve
-        serve(base=args.store, port=args.port)
+        service = None
+        if not args.no_service:
+            from jepsen_tpu.serve import CheckService
+            service = CheckService(store_base=args.store,
+                                   max_lanes=args.max_lanes,
+                                   max_queue_cells=args.max_queue)
+        try:
+            serve(base=args.store, port=args.port, service=service)
+        finally:
+            if service is not None:
+                service.close(timeout=30.0)
         return 0
 
+    if args.cmd == "submit":
+        return submit_cmd(args)
+
     return 2
+
+
+def submit_cmd(args) -> int:
+    """POST a stored run's history to a running serve's /submit endpoint
+    and print the verdict JSON."""
+    import urllib.request
+    history = store.load_history(args.dir)
+    body = {"ops": [op.to_dict() for op in history],
+            "kind": args.kind, "realtime": args.realtime,
+            "independent": args.independent}
+    if args.kind == "wgl":
+        body["model"] = args.model
+    else:
+        body["workload"] = args.workload
+    if args.deadline is not None:
+        body["deadline_s"] = args.deadline
+    req = urllib.request.Request(
+        args.url.rstrip("/") + "/submit",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as resp:
+        results = json.loads(resp.read())
+    print(json.dumps(results, indent=2, default=str))
+    return 0 if results.get("valid") is True else 1
 
 
 def test_all_cmd(tests_fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]],
                  opt_fn: Optional[Callable] = None,
                  argv: Optional[Sequence[str]] = None) -> int:
-    """Run a suite's whole sweep matrix (cli.clj:433-519)."""
+    """Run a suite's whole sweep matrix (cli.clj:433-519).
+
+    The whole campaign shares one checking service: every test's analyze
+    phase routes through a single CheckService, so the sweep's histories
+    are continuously batched onto the device engines and compiled shapes
+    are reused across tests.  ``--campaign-workers N`` overlaps N runs
+    (their checks coalesce into shared dispatches); ``--no-service``
+    restores the per-test direct checker path."""
     parser = argparse.ArgumentParser()
     add_test_opts(parser)
+    parser.add_argument("--campaign-workers", type=int, default=1,
+                        help="concurrent test runs in the sweep")
+    parser.add_argument("--no-service", action="store_true",
+                        help="check each test directly, no shared service")
     if opt_fn:
         opt_fn(parser)
     args = parser.parse_args(argv)
     opts = test_opts_to_map(args)
-    summary = core.run_tests(tests_fn(dict(opts)))
+    service = None
+    if not args.no_service:
+        from jepsen_tpu.serve import CheckService
+        service = CheckService(store_base=args.store)
+    try:
+        summary = core.run_tests(tests_fn(dict(opts)),
+                                 workers=max(1, args.campaign_workers),
+                                 service=service)
+    finally:
+        if service is not None:
+            service.close(timeout=60.0)
     for r in summary["results"]:
         print(json.dumps(r, default=str))
     print(json.dumps({"failures": summary["failures"],

@@ -186,13 +186,22 @@ def _heal_outstanding_faults(test) -> None:
             logger.error("fault %s: %s", key, outcome)
 
 
-def analyze(test, history: History) -> Dict[str, Any]:
+def analyze(test, history: History,
+            service: Optional[Any] = None) -> Dict[str, Any]:
     """Run the checker over the history (core.clj:216-232 analyze!).
 
     ``test["checker"]`` may be a Checker instance or any registry spec
     (a name like "elle-list-append", a ``{"name": ..., **opts}`` dict, a
     mapping, or a list — see checker.core.resolve_checker): workload
-    configs can name their analysis declaratively."""
+    configs can name their analysis declaratively.
+
+    With a ``service`` (the argument, or ``test["service"]`` — a
+    serve.CheckService), device-tier checkers route through the shared
+    batched checking service instead of running a cold one-shot: N
+    concurrent runs share one device and one compiled-engine cache.
+    Checkers the service cannot batch fall back to the direct path, and
+    a service-side crash degrades to the direct path too — routing is an
+    optimization, never a verdict risk."""
     logger.info("Analyzing history (%d ops)", len(history))
     checker = test.get("checker")
     if checker is None:
@@ -200,8 +209,19 @@ def analyze(test, history: History) -> Dict[str, Any]:
     if not isinstance(checker, Checker):
         from jepsen_tpu.checker.core import resolve_checker
         checker = resolve_checker(checker)
-    results = check_safe(checker, test, history,
-                         {"store_dir": test.get("store_dir")})
+    opts = {"store_dir": test.get("store_dir")}
+    service = service if service is not None else test.get("service")
+    if service is not None:
+        try:
+            routed = service.try_route_analyze(test, checker, history, opts)
+        except Exception:  # noqa: BLE001
+            logger.exception("service routing failed; using direct path")
+            routed = None
+        if routed is not None:
+            if routed.get("valid") is False:
+                _failure_artifacts(test, history)
+            return routed
+    results = check_safe(checker, test, history, opts)
     if results.get("valid") is False:
         _failure_artifacts(test, history)
     return results
@@ -290,20 +310,39 @@ def iter_analysis_errors(results: Any, path=()):
             yield from iter_analysis_errors(value, path + (str(k),))
 
 
-def run_tests(tests, raise_on_failure: bool = False):
+def run_tests(tests, raise_on_failure: bool = False, workers: int = 1,
+              service: Optional[Any] = None):
     """Run a sequence of tests, collecting verdicts (cli.clj:433-519
-    test-all)."""
-    results = []
-    for t in tests:
+    test-all).
+
+    ``service`` (a serve.CheckService) is injected into every test map so
+    each run's analysis phase routes through one shared batched checking
+    service; with ``workers > 1`` the campaign's runs execute
+    concurrently and their checks batch onto the device together —
+    N concurrent runs, one device.  Results keep the input order."""
+    tests = list(tests)
+    if service is not None:
+        for t in tests:
+            t.setdefault("service", service)
+
+    def one(t):
         try:
             done = run(t)
-            results.append({"name": done.get("name"),
-                            "dir": done.get("store_dir"),
-                            "valid": done.get("results", {}).get("valid")})
+            return {"name": done.get("name"),
+                    "dir": done.get("store_dir"),
+                    "valid": done.get("results", {}).get("valid")}
         except Exception as e:  # noqa: BLE001
             logger.error("test crashed: %s", e)
-            results.append({"name": t.get("name"), "valid": UNKNOWN,
-                            "error": traceback.format_exc()})
+            return {"name": t.get("name"), "valid": UNKNOWN,
+                    "error": traceback.format_exc()}
+
+    if workers > 1 and len(tests) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="campaign") as ex:
+            results = list(ex.map(one, tests))
+    else:
+        results = [one(t) for t in tests]
     n_bad = sum(1 for r in results if r["valid"] is False)
     n_unknown = sum(1 for r in results if r["valid"] == UNKNOWN)
     summary = {"results": results, "failures": n_bad, "unknown": n_unknown,

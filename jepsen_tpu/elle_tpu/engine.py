@@ -75,13 +75,17 @@ def check_batch(histories: Sequence[History],
                 mesh=None,
                 axis: str = "data",
                 budget_s: Optional[float] = None,
+                n_pad_floor: int = 0,
                 **workload_kw) -> List[Dict[str, Any]]:
     """Check many histories at once; one elle-shaped result per history.
 
     ``engine``: ``"auto"``/``"tpu"`` run the device pass (falling back to
     CPU per group on device errors), ``"cpu"`` skips the device and runs
     the full CPU search per lane (still through this code path, so budget
-    and artifacts behave identically)."""
+    and artifacts behave identically).  ``n_pad_floor`` pads the shared
+    adjacency dimension up to a caller-chosen bucket so successive batches
+    of similar histories reuse one compiled closure kernel (the serve
+    scheduler's shape-bucketing lever; 0 = tightest)."""
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}; known: {ENGINES}")
     if not histories:
@@ -91,7 +95,7 @@ def check_batch(histories: Sequence[History],
                               else ("serializable",))
     deadline = (time.monotonic() + budget_s) if budget_s is not None else None
     encs = [encode(h, workload, **workload_kw) for h in histories]
-    n_pad = padded_n(encs)
+    n_pad = max(padded_n(encs), ((n_pad_floor + 31) // 32) * 32)
     cap = group_cap(n_pad)
     use_device = engine != "cpu" and available()
     if engine == "tpu" and not use_device:

@@ -26,6 +26,22 @@ from jepsen_tpu.history import History, INVOKE, NEMESIS, Op
 
 KeyedValue = Tuple[Any, Any]
 
+#: host-tier per-key check parallelism when nothing else configures it
+DEFAULT_WORKERS = 8
+
+
+def worker_count(test: Optional[Dict[str, Any]] = None,
+                 explicit: Optional[int] = None) -> int:
+    """Resolve the per-key checking thread count: an explicit argument
+    wins, then the test map's ``independent_workers`` opt, then the
+    ``JEPSEN_TPU_WORKERS`` env var, then :data:`DEFAULT_WORKERS`."""
+    for v in (explicit,
+              (test or {}).get("independent_workers"),
+              os.environ.get("JEPSEN_TPU_WORKERS")):
+        if v:
+            return max(1, int(v))
+    return DEFAULT_WORKERS
+
 
 def tuple_(k, v) -> KeyedValue:
     """A keyed value (independent.clj:21)."""
@@ -37,6 +53,20 @@ def key_of(op: Op) -> Optional[Any]:
     if isinstance(v, tuple) and len(v) == 2:
         return v[0]
     return None
+
+
+def rewrap_tuples(history: History) -> History:
+    """Restore keyed-value tuples on a deserialized history: JSON has no
+    tuple type, so a stored independent-workload history comes back with
+    ``[k, v]`` lists that :func:`key_of` (correctly) refuses to treat as
+    keys — an unkeyed cas value ``[old, new]`` is also a 2-element list,
+    so the caller must *assert* the independent shape explicitly (the
+    ``submit --independent`` flag / the web API's ``independent`` key)."""
+    return History(
+        [op.with_(value=tuple(op.value))
+         if (op.process != NEMESIS and isinstance(op.value, list)
+             and len(op.value) == 2) else op
+         for op in history], reindex=True)
 
 
 def history_keys(history: History) -> List[Any]:
@@ -218,9 +248,11 @@ class IndependentChecker(Checker):
     (independent.clj:266-317).  Device-tier linearizable sub-checkers batch
     all keys into one vmapped engine call (optionally mesh-sharded)."""
 
-    def __init__(self, inner: Checker, mesh=None, max_workers: int = 8):
+    def __init__(self, inner: Checker, mesh=None,
+                 max_workers: Optional[int] = None):
         self.inner = inner
         self.mesh = mesh
+        # None = resolve at check time (test opts / JEPSEN_TPU_WORKERS env)
         self.max_workers = max_workers
 
     def check(self, test, history, opts=None):
@@ -262,11 +294,16 @@ class IndependentChecker(Checker):
                                                 "confirm; batch refutation "
                                                 "stands"}
         else:
-            with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            mw = worker_count(test, self.max_workers)
+            with ThreadPoolExecutor(max_workers=mw) as ex:
                 futs = {k: ex.submit(check_safe, inner, test, subs[k],
                                      self._key_opts(opts, k))
                         for k in keys}
-                results = {k: f.result() for k, f in futs.items()}
+                # Merge in first-appearance key order regardless of which
+                # future lands first: the results map (and everything
+                # derived from it downstream) is deterministic for a given
+                # history, independent of thread scheduling.
+                results = {k: futs[k].result() for k in keys}
 
         bad = {k: r for k, r in results.items() if r.get("valid") is not True}
         out = {"valid": merge_valid([r.get("valid")

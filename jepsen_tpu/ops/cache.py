@@ -48,3 +48,21 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled = True
     return d
+
+
+def init_compilation_cache(store_base: Optional[str] = None) -> str:
+    """The one shared init used by the serve service, bench.py, and the
+    CLI: point the persistent XLA cache at ``<store_base>/cache/xla`` (or
+    the enable_compilation_cache defaults when no base is given) so every
+    repeated process — a second bench run, a restarted service, each
+    bench subprocess tier — loads executables from disk instead of
+    recompiling.  Never raises (a read-only filesystem, a CPU-only CI box
+    with no accelerator cache to keep — see the CPU gate above — or a
+    broken JAX install must not take checking down with it); returns the
+    directory used, or "" when caching stayed off."""
+    try:
+        d = (os.path.join(store_base, "cache", "xla")
+             if store_base else None)
+        return enable_compilation_cache(d)
+    except Exception:  # noqa: BLE001
+        return ""

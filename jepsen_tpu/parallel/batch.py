@@ -21,6 +21,9 @@ and lanes progress at fully independent rates with no idle steps.
 
 from __future__ import annotations
 
+import os
+import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -34,7 +37,60 @@ from jepsen_tpu.checker.wgl_tpu import (EV_NOP, chosen_gwords,
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 
-_CACHE: Dict[Any, Any] = {}
+
+class _LRUCache:
+    """Bounded compiled-engine cache.
+
+    Each entry pins a jitted vmapped engine (traced program + XLA
+    executable) whose size scales with window*capacity*chunk — a service
+    that sees many shapes would grow an unbounded dict without end.  LRU
+    eviction keeps the hot buckets resident; hit/miss/eviction counters
+    feed the serve metrics endpoint (an eviction storm means the bucket
+    ladder is too fine)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                return self._d[key]
+            self.misses += 1
+            return None
+
+    def put(self, key, value):
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+                self.evictions += 1
+            return value
+
+    def __len__(self):
+        return len(self._d)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._d), "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+_CACHE = _LRUCache(int(os.environ.get("JEPSEN_TPU_ENGINE_CACHE", "32")))
+
+
+def engine_cache_stats() -> Dict[str, int]:
+    """Hit/miss/eviction counters of the compiled-engine cache (a miss is
+    a fresh trace+compile — the serve metrics' recompile counter)."""
+    return _CACHE.stats()
 
 #: Target lane-events per dispatch: the vmapped scan costs ~(batch x chunk)
 #: lane-event steps, so the chunk shrinks as the batch grows to keep one
@@ -72,13 +128,17 @@ def check_batch(model: JaxModel,
                 axis: str = "data",
                 capacity: int = 256,
                 max_capacity: int = 65536,
-                chunk: Optional[int] = None) -> List[Dict[str, Any]]:
+                chunk: Optional[int] = None,
+                window_floor: int = 0) -> List[Dict[str, Any]]:
     """Check many histories at once; returns one result dict per history.
 
     All lanes share one engine shape (window = max over histories, events
     NOP-padded to the longest).  With ``mesh``, lanes are sharded over the
     ``axis`` mesh axis; the batch is padded to a multiple of the axis size.
     ``chunk=None`` picks the batch-size-scaled default (``_batch_chunk``).
+    ``window_floor`` pads the shared window up to a caller-chosen bucket so
+    successive batches of similar histories reuse one compiled engine (the
+    serve scheduler's shape-bucketing lever; 0 = tightest window).
 
     Unlike the single-history engine (kernel-latency bound, per-round
     cost flat in capacity), the vmapped engine's per-step cost IS
@@ -100,11 +160,12 @@ def check_batch(model: JaxModel,
             out.extend(check_batch(model,
                                    histories[i:i + MAX_LANES_PER_GROUP],
                                    mesh=mesh, axis=axis, capacity=capacity,
-                                   max_capacity=max_capacity, chunk=chunk))
+                                   max_capacity=max_capacity, chunk=chunk,
+                                   window_floor=window_floor))
         return out
     from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
-    window = _round_window(max(p.window for p in preps))
+    window = _round_window(max(window_floor, max(p.window for p in preps)))
     longest = max(len(p) for p in preps)
     # Lean (gwords=0) only when EVERY lane qualifies — the engine shape is
     # shared across the batch, and a non-qualifying lane's ghost_words
@@ -212,8 +273,9 @@ def _batched_runner(model: JaxModel, window: int, capacity: int,
     key = ("batchv", model.name, model.variant, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
            gwords, chunk, bpad)
-    if key in _CACHE:
-        return _CACHE[key]
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
     # single_round_closure: under vmap every cond/switch branch executes
     # for the whole batch, so the batched engine runs exactly ONE closure
     # round (one fixed-width merge) per scan step — per-step device work
@@ -227,5 +289,4 @@ def _batched_runner(model: JaxModel, window: int, capacity: int,
                                        single_round_closure=True,
                                        steps_per_dispatch=chunk)
     vrun = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0)))
-    _CACHE[key] = (carry0, vrun)
-    return _CACHE[key]
+    return _CACHE.put(key, (carry0, vrun))

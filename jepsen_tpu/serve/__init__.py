@@ -1,0 +1,29 @@
+"""serve: the persistent batched checking service.
+
+A checking run today is a cold one-shot: ``core.analyze`` builds its own
+batch, pays its own XLA compile, and the device idles between runs.
+This package keeps the device saturated instead: a persistent in-process
+service accepts history-check requests from concurrent test runs, the
+CLI, and the web UI, decomposes them into independent per-key cells
+(P-compositionality — jepsen_tpu.independent's splitting), pads the
+cells into a small ladder of engine shapes, and continuously batches
+them onto the vmapped wgl (parallel.batch) and elle (elle_tpu.engine)
+device engines, merging verdicts back per request under the established
+never-degrade-to-false rules.
+
+Module map: ``request`` (requests/cells/trace spans), ``decompose``
+(per-key splitting), ``buckets`` (the shape ladder), ``scheduler`` (the
+continuous-batch device loop: priority queue, admission, backpressure,
+deadlines, host-tier degradation), ``aggregate`` (verdict merge),
+``metrics`` (counters/occupancy/traces for web.py's ``/metrics``),
+``service`` (the CheckService facade + core.analyze routing).  See
+docs/serving.md.
+"""
+
+from jepsen_tpu.serve.request import Cell, Request  # noqa: F401
+from jepsen_tpu.serve.service import (  # noqa: F401
+    CheckService, ServiceClosed, ServiceSaturated,
+)
+
+__all__ = ["Cell", "CheckService", "Request", "ServiceClosed",
+           "ServiceSaturated"]

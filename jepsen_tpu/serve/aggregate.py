@@ -1,0 +1,45 @@
+"""The aggregator: fold per-cell verdicts back into per-request results.
+
+Merging follows the established verdict lattice (checker.core
+.merge_valid: false beats unknown beats true) — the same never-degrade
+rules every composed checker in the repo obeys.  In particular a
+deadline-expired cell contributes ``unknown``, never ``false``: missing
+a deadline says nothing about the history.
+
+A request that decomposed into per-key cells aggregates into the
+IndependentChecker result shape ({"valid", "key-count", "results",
+"failures"}) so downstream consumers (store artifacts, the web UI's
+validity coloring, run_tests exit codes) cannot tell a serviced check
+from a direct one.  Single-cell requests return the engine result
+itself, annotated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jepsen_tpu.checker.core import merge_valid
+from jepsen_tpu.serve.request import Request
+
+
+def aggregate(req: Request) -> Dict[str, Any]:
+    cells = req.cells
+    if len(cells) == 1 and cells[0].key is None:
+        return dict(cells[0].result or {})
+    results = {c.key: c.result for c in cells}  # decompose order = key order
+    bad = {k: r for k, r in results.items()
+           if (r or {}).get("valid") is not True}
+    return {"valid": merge_valid([(r or {}).get("valid")
+                                  for r in results.values()]),
+            "key-count": len(cells),
+            "results": results,
+            "failures": sorted(bad, key=repr)}
+
+
+def expired_result(kind: str) -> Dict[str, Any]:
+    """The verdict for a cell whose deadline passed before dispatch —
+    unknown with the same shape check_safe's budget path produces, so
+    deadline semantics read identically service-side and direct."""
+    return {"valid": "unknown", "deadline-expired": True,
+            "analyzer": f"{kind}-serve",
+            "error": "request deadline expired before dispatch"}

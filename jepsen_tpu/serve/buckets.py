@@ -1,0 +1,83 @@
+"""Shape bucketing: pad cells into a small ladder of engine shapes.
+
+Every distinct (window, events-chunk, lane-count) triple the wgl engine
+sees — and every (n_pad, lane-count) the elle closure kernel sees — is a
+fresh XLA trace + compile.  Histories arriving at a service vary
+continuously in length and concurrency, so without bucketing the engine
+cache would see an unbounded stream of near-miss shapes and the device
+would spend its life compiling.
+
+The ladder here is coarse on purpose: power-of-two event counts, power-
+of-two width/adjacency buckets, power-of-two lane groups.  Padding waste
+is bounded by 2x per axis (and measured: the scheduler reports lane
+occupancy through the metrics endpoint), while the shape universe
+collapses to a few dozen buckets that the bounded engine LRU
+(parallel.batch._CACHE) keeps resident.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from jepsen_tpu.history import FAIL, History, INVOKE, NEMESIS, OK
+
+#: floor of the event-count ladder (matches the engine's 64-row chunking)
+MIN_EVENTS_BUCKET = 64
+#: floor of the wgl window ladder (engine windows are >= 8 anyway)
+MIN_WIDTH_BUCKET = 8
+#: floor of the elle adjacency ladder (graphs.padded_n rounds to >= 32)
+MIN_N_BUCKET = 32
+#: lanes per dispatch are padded to a power of two up to this cap; beyond
+#: it groups dispatch at the cap exactly (parallel.batch groups at 512
+#: internally anyway)
+MAX_LANE_BUCKET = 512
+
+
+def pow2_at_least(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def events_bucket(h: History) -> int:
+    return pow2_at_least(len(h), MIN_EVENTS_BUCKET)
+
+
+def width_bucket(h: History) -> int:
+    """Bucketed upper bound on the wgl engine window: the maximum number
+    of simultaneously-open client ops (crashed ops never close — they hold
+    window slots forever, exactly like the engine's ghost slots)."""
+    open_ = 0
+    peak = 1
+    for op in h:
+        if op.process == NEMESIS:
+            continue
+        if op.type == INVOKE:
+            open_ += 1
+            peak = max(peak, open_)
+        elif op.type in (OK, FAIL):
+            open_ = max(0, open_ - 1)
+        # INFO: crashed — stays open
+    return pow2_at_least(peak, MIN_WIDTH_BUCKET)
+
+
+def elle_n_bucket(h: History) -> int:
+    """Bucketed upper bound on the elle adjacency dimension: committed +
+    indeterminate txns (encode keeps ok and info txns as graph nodes)."""
+    n = sum(1 for op in h if op.type != INVOKE and op.process != NEMESIS)
+    return pow2_at_least(max(1, n), MIN_N_BUCKET)
+
+
+def lane_bucket(n_lanes: int, cap: int = MAX_LANE_BUCKET) -> int:
+    """Lanes per dispatch, padded to a power of two (stable ``bpad`` in
+    the engine cache key) and clamped to ``cap``."""
+    return min(pow2_at_least(max(1, n_lanes), 1), cap)
+
+
+def wgl_bucket(h: History) -> Tuple[int, int]:
+    return (events_bucket(h), width_bucket(h))
+
+
+def elle_bucket(h: History) -> Tuple[int]:
+    return (elle_n_bucket(h),)

@@ -1,0 +1,88 @@
+"""Service observability: counters, gauges, occupancy, and trace export.
+
+One thread-safe registry per service.  Everything lands in one
+``snapshot()`` dict — the payload of web.py's ``/metrics`` endpoint and
+the body of the queue-status page — so there is exactly one schema to
+document (docs/serving.md) and assert on in the smoke test:
+
+- counters: requests/cells through each lifecycle edge, deadline
+  expiries, admission rejections, dispatches, host fallbacks;
+- gauges: queue depth and in-flight requests, sampled live;
+- occupancy: used vs padded lanes per dispatch, summed — the price of
+  shape bucketing, as a ratio;
+- engine-cache: hit/miss/eviction counters of the bounded compiled-
+  engine LRU (parallel.batch) — a miss is a recompile;
+- traces: the last few completed requests' span lists (enqueue -> pack
+  -> dispatch -> verdict, relative seconds).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class Metrics:
+    def __init__(self, trace_capacity: int = 64):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {
+            "requests-submitted": 0, "requests-completed": 0,
+            "requests-rejected": 0, "cells-submitted": 0,
+            "cells-completed": 0, "deadline-expired": 0,
+            "dispatches": 0, "host-fallbacks": 0,
+        }
+        self._lanes_used = 0
+        self._lanes_padded = 0
+        self._dispatch_s = 0.0
+        self._traces: deque = deque(maxlen=trace_capacity)
+        self._depth_fn = None       # live queue-depth callback
+        self._inflight_fn = None
+
+    def bind(self, depth_fn, inflight_fn) -> None:
+        self._depth_fn = depth_fn
+        self._inflight_fn = inflight_fn
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def dispatch(self, lanes_used: int, lanes_padded: int,
+                 seconds: float) -> None:
+        with self._lock:
+            self._counters["dispatches"] += 1
+            self._lanes_used += lanes_used
+            self._lanes_padded += lanes_padded
+            self._dispatch_s += seconds
+
+    def trace(self, request) -> None:
+        with self._lock:
+            self._traces.append({"request-id": request.id,
+                                 "kind": request.kind,
+                                 "valid": (request.result or {}).get("valid"),
+                                 "spans": list(request.spans)})
+
+    def snapshot(self) -> Dict[str, Any]:
+        from jepsen_tpu.parallel.batch import engine_cache_stats
+        with self._lock:
+            counters = dict(self._counters)
+            used, padded = self._lanes_used, self._lanes_padded
+            dispatch_s = self._dispatch_s
+            traces = list(self._traces)
+        cache = engine_cache_stats()
+        return {
+            "counters": counters,
+            "gauges": {
+                "queue-depth": self._depth_fn() if self._depth_fn else 0,
+                "inflight-requests":
+                    self._inflight_fn() if self._inflight_fn else 0,
+            },
+            "occupancy": {
+                "lanes-used": used,
+                "lanes-padded": padded,
+                "ratio": round(used / padded, 4) if padded else None,
+                "dispatch-seconds": round(dispatch_s, 6),
+            },
+            "engine-cache": {**cache, "recompiles": cache["misses"]},
+            "traces": traces,
+        }

@@ -1,0 +1,107 @@
+"""Requests, cells, and trace spans — the service's unit of work.
+
+A *request* is one history plus a check spec (which engine kind, which
+model/workload, a deadline).  The decomposer splits it into *cells* —
+independent per-key sub-histories (P-compositionality, arXiv:1504.00204)
+— which are what the scheduler actually queues, packs, and dispatches.
+The aggregator folds cell verdicts back into one per-request result.
+
+Every request carries a trace: monotonic spans from ``enqueue`` through
+``pack``/``dispatch`` to ``verdict``, exported via the metrics endpoint
+so queueing delay, packing delay, and device time are separable without
+a profiler.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.history import History
+
+_ids = itertools.count(1)
+
+#: engine kinds the device loop knows how to batch
+KIND_WGL = "wgl"
+KIND_ELLE = "elle"
+KINDS = (KIND_WGL, KIND_ELLE)
+
+
+class Request:
+    """One submitted history check, decomposed into cells by the service."""
+
+    def __init__(self, history: History, kind: str, spec: Dict[str, Any],
+                 deadline_s: Optional[float] = None):
+        if kind not in KINDS:
+            raise ValueError(f"unknown request kind {kind!r}; known: {KINDS}")
+        self.id = next(_ids)
+        self.history = history
+        self.kind = kind
+        self.spec = spec            # kind-specific engine options
+        self.submitted = time.monotonic()
+        self.deadline = (self.submitted + deadline_s
+                         if deadline_s is not None else None)
+        self.cells: List["Cell"] = []
+        self.spans: List[Dict[str, Any]] = []
+        self.result: Optional[Dict[str, Any]] = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.span("enqueue")
+
+    # -- trace ------------------------------------------------------------
+    def span(self, name: str) -> None:
+        """Record a trace span (relative seconds since submit)."""
+        self.spans.append({"span": name,
+                           "t": round(time.monotonic() - self.submitted, 6)})
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    # -- completion -------------------------------------------------------
+    def cell_done(self) -> bool:
+        """Called (under the service lock) as each cell resolves; True when
+        this was the last one."""
+        return all(c.result is not None for c in self.cells)
+
+    def finish(self, result: Dict[str, Any]) -> None:
+        self.span("verdict")
+        result.setdefault("serve", {})
+        result["serve"].update({"request-id": self.id,
+                                "cells": len(self.cells),
+                                "spans": list(self.spans)})
+        self.result = result
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still in flight")
+        assert self.result is not None
+        return self.result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+@dataclass
+class Cell:
+    """One independently-checkable sub-history of a request."""
+
+    request: Request
+    history: History
+    key: Any = None                 # None = the request was a single cell
+    seq: int = 0                    # global admission order (FIFO tiebreak)
+    bucket: Tuple = ()              # (kind, engine-identity, shape buckets)
+    result: Optional[Dict[str, Any]] = field(default=None)
+
+    def sort_key(self) -> Tuple[float, int]:
+        """Deadline-first priority, FIFO within a deadline class."""
+        d = self.request.deadline
+        return (d if d is not None else float("inf"), self.seq)

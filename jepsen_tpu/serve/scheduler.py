@@ -1,0 +1,281 @@
+"""The continuous-batch scheduler: one device loop draining a cell queue.
+
+The device is a single serially-dispatched resource, so the scheduler is
+one thread: each iteration it picks the most urgent shape bucket
+(earliest deadline, FIFO within a deadline class), packs up to a lane
+bucket's worth of that bucket's cells into ONE vmapped dispatch — wgl
+cells through parallel.batch.check_batch, elle cells through
+elle_tpu.engine.check_batch — and loops.  New cells admitted while a
+dispatch is on the device are seen at the very next iteration: requests
+continuously join batches instead of waiting for a convoy to finish
+(continuous batching, the same scheduler shape as an inference server).
+
+Guarantees:
+
+- cells whose request deadline has already passed are resolved
+  ``unknown`` (never dispatched, never ``false``) — deadline semantics
+  match check_safe's budget degradation;
+- a device failure downgrades the affected cells to the host tier
+  (wgl_cpu / elle engine="cpu") with a ``fallback`` annotation, exactly
+  like checker.linearizable's degradation chain — a device error never
+  decides a verdict;
+- lane padding (to power-of-two lane buckets, for engine-cache
+  stability) is measured: every dispatch reports used vs padded lanes to
+  the metrics registry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from jepsen_tpu.serve import buckets
+from jepsen_tpu.serve.aggregate import aggregate, expired_result
+from jepsen_tpu.serve.request import Cell, KIND_ELLE, KIND_WGL
+
+log = logging.getLogger("jepsen.serve")
+
+
+class Scheduler:
+    def __init__(self, metrics, mesh=None, max_lanes: int = 64,
+                 capacity: int = 256, max_capacity: int = 65536):
+        self.metrics = metrics
+        self.mesh = mesh
+        self.max_lanes = max(1, min(max_lanes, buckets.MAX_LANE_BUCKET))
+        self.capacity = capacity
+        self.max_capacity = max_capacity
+        self._groups: Dict[Tuple, deque] = {}
+        self._depth = 0
+        self._seq = 0               # admission order (FIFO tiebreak)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stop = False
+        self._inflight = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-scheduler")
+        self._started = False
+
+    # -- queue ------------------------------------------------------------
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def offer(self, cells: List[Cell], block: bool, max_depth: int,
+              timeout: Optional[float]) -> bool:
+        """Admit a request's cells (all or nothing).  Blocks while the
+        queue is above ``max_depth`` (backpressure); False = rejected."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while not self._stop and self._depth + len(cells) > max_depth:
+                if not block:
+                    return False
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                if not self._cond.wait(timeout=rem if rem is not None
+                                       else 0.1):
+                    return False
+            if self._stop:
+                return False
+            for c in cells:
+                c.seq = self._seq = self._seq + 1
+                self._groups.setdefault(c.bucket, deque()).append(c)
+            self._depth += len(cells)
+            self._cond.notify_all()
+            return True
+
+    def depth(self) -> int:
+        return self._depth
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the queue is empty and no dispatch is in flight."""
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while self._depth > 0 or self._inflight:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cond.wait(timeout=rem if rem is not None else 0.1)
+            return True
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> bool:
+        """Stop the loop; with ``drain`` (default) the queue is emptied
+        first — every admitted request still gets its verdict."""
+        ok = self.drain(timeout) if drain else True
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._started:
+            self._thread.join(timeout=30.0)
+        return ok
+
+    # -- the device loop --------------------------------------------------
+    def _take_group(self) -> List[Cell]:
+        """Pop the most urgent bucket's head cells (up to max_lanes)."""
+        best = None
+        for key, dq in self._groups.items():
+            if not dq:
+                continue
+            k = dq[0].sort_key()
+            if best is None or k < best[0]:
+                best = (k, key)
+        if best is None:
+            return []
+        dq = self._groups[best[1]]
+        out = []
+        while dq and len(out) < self.max_lanes:
+            out.append(dq.popleft())
+        if not dq:
+            del self._groups[best[1]]
+        self._depth -= len(out)
+        return out
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._depth == 0 and not self._stop:
+                    self._cond.wait(timeout=0.1)
+                if self._stop and self._depth == 0:
+                    return
+                cells = self._take_group()
+                self._inflight = len(cells)
+                self._cond.notify_all()  # depth dropped: wake producers
+            if not cells:
+                continue
+            try:
+                self._process(cells)
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("scheduler dispatch failed terminally")
+                for c in cells:
+                    if c.result is None:
+                        self._finalize(c, {
+                            "valid": "unknown", "analyzer": "serve",
+                            "error": "scheduler dispatch crashed"})
+            finally:
+                with self._cond:
+                    self._inflight = 0
+                    self._cond.notify_all()
+
+    def _process(self, cells: List[Cell]) -> None:
+        live: List[Cell] = []
+        for c in cells:
+            if c.request.expired():
+                self.metrics.inc("deadline-expired")
+                self._finalize(c, expired_result(c.request.kind))
+            else:
+                live.append(c)
+        if not live:
+            return
+        for c in live:
+            c.request.span("pack")
+        t0 = time.monotonic()
+        lanes = [c.history for c in live]
+        pad = buckets.lane_bucket(len(lanes), self.max_lanes)
+        padded = lanes + [lanes[0]] * (pad - len(lanes))
+        kind = live[0].request.kind
+        for c in live:
+            c.request.span("dispatch")
+        try:
+            if kind == KIND_WGL:
+                rs = self._dispatch_wgl(live, padded)
+            else:
+                rs = self._dispatch_elle(live, padded)
+        except Exception as e:  # noqa: BLE001 — device trouble, degrade
+            log.warning("device dispatch failed (%s: %s); host fallback "
+                        "for %d cell(s)", type(e).__name__, e, len(live))
+            self.metrics.inc("host-fallbacks", len(live))
+            rs = self._host_fallback(live, e)
+        self.metrics.dispatch(len(live), pad, time.monotonic() - t0)
+        for c, r in zip(live, rs):
+            self._finalize(c, r)
+
+    def _dispatch_wgl(self, live: List[Cell],
+                      padded: List[Any]) -> List[Dict[str, Any]]:
+        from jepsen_tpu.parallel.batch import _batch_chunk, check_batch
+        spec0 = live[0].request.spec
+        _, _, ev_bucket, w_bucket = live[0].bucket
+        cap = max(int(s.request.spec.get("capacity", self.capacity))
+                  for s in live)
+        max_cap = max(int(s.request.spec.get("max_capacity",
+                                             self.max_capacity))
+                      for s in live)
+        rs = check_batch(spec0["model"], padded, mesh=self.mesh,
+                         capacity=cap, max_capacity=max_cap,
+                         chunk=_batch_chunk(len(padded), ev_bucket),
+                         window_floor=w_bucket)
+        return rs[:len(live)]
+
+    def _dispatch_elle(self, live: List[Cell],
+                       padded: List[Any]) -> List[Dict[str, Any]]:
+        from jepsen_tpu.elle_tpu.engine import check_batch
+        spec0 = live[0].request.spec
+        (_, _, n_bucket) = live[0].bucket
+        remaining = [c.request.remaining_s() for c in live]
+        known = [r for r in remaining if r is not None]
+        budget = max(0.0, min(known)) if known else None
+        rs = check_batch(padded,
+                         workload=spec0.get("workload", "list-append"),
+                         realtime=bool(spec0.get("realtime", False)),
+                         consistency_models=spec0.get("consistency_models"),
+                         engine=spec0.get("engine", "auto"),
+                         mesh=self.mesh, budget_s=budget,
+                         n_pad_floor=n_bucket)
+        return rs[:len(live)]
+
+    def _host_fallback(self, live: List[Cell],
+                       exc: Exception) -> List[Dict[str, Any]]:
+        """Per-cell host-tier re-check after a device dispatch failure."""
+        out = []
+        chain = [{"solver": f"{live[0].request.kind}-serve",
+                  "error": str(exc), "error-type": type(exc).__name__}]
+        for c in live:
+            try:
+                if c.request.kind == KIND_WGL:
+                    from jepsen_tpu.checker import wgl_cpu
+                    cm = c.request.spec["model"].cpu_model()
+                    if cm is None:
+                        r = {"valid": "unknown",
+                             "error": "device failed; no host-tier model"}
+                    else:
+                        r = wgl_cpu.check(cm, c.history)
+                else:
+                    from jepsen_tpu.elle_tpu.engine import check_batch
+                    r = check_batch(
+                        [c.history], engine="cpu",
+                        workload=c.request.spec.get("workload",
+                                                    "list-append"),
+                        realtime=bool(c.request.spec.get("realtime",
+                                                         False)),
+                        consistency_models=c.request.spec.get(
+                            "consistency_models"),
+                        budget_s=c.request.remaining_s())[0]
+            except Exception as e2:  # noqa: BLE001
+                r = {"valid": "unknown",
+                     "error": f"device and host tiers both failed: "
+                              f"{exc}; {e2}"}
+            r.setdefault("fallback", {"from": f"{c.request.kind}-device",
+                                      "to": "host", "error": str(exc),
+                                      "error-type": type(exc).__name__})
+            r["fallback-chain"] = chain
+            out.append(r)
+        return out
+
+    def _finalize(self, cell: Cell, result: Dict[str, Any]) -> None:
+        cell.result = result
+        self.metrics.inc("cells-completed")
+        req = cell.request
+        with req._lock:
+            if req.done() or not req.cell_done():
+                return
+        req.finish(aggregate(req))
+        self.metrics.inc("requests-completed")
+        self.metrics.trace(req)

@@ -1,0 +1,235 @@
+"""CheckService: the persistent in-process batched checking service.
+
+One service owns one scheduler (one device loop) and accepts history-
+check requests from any number of threads — concurrent test runs,
+cli.py's ``submit`` command via the web endpoint, the web UI.  Requests
+are decomposed into per-key cells, shape-bucketed, and continuously
+batched onto the vmapped wgl / elle_tpu engines; verdicts come back
+through the aggregator under the established never-degrade-to-false
+rules.  See docs/serving.md.
+
+Usage::
+
+    with CheckService(store_base="store") as svc:
+        req = svc.submit(history, kind="wgl", model="cas-register")
+        result = req.wait()
+        # or one-shot:
+        result = svc.check(history, kind="elle", workload="list-append")
+
+``core.analyze`` routes through a service automatically when the test
+map carries one under ``test["service"]`` (see try_route_analyze), which
+is how ``cli.test_all_cmd`` shares one device across a campaign.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Union
+
+from jepsen_tpu.history import History
+from jepsen_tpu.serve.decompose import decompose
+from jepsen_tpu.serve.metrics import Metrics
+from jepsen_tpu.serve.request import KIND_ELLE, KIND_WGL, Request
+from jepsen_tpu.serve.scheduler import Scheduler
+
+
+class ServiceSaturated(RuntimeError):
+    """Admission control rejected the request (queue at max depth)."""
+
+
+class ServiceClosed(RuntimeError):
+    """The service is shut down; no new requests are admitted."""
+
+
+class _ServiceRouted:
+    """Checker adapter: ``check`` submits to the service (used for the
+    serviceable children of a composed checker, so Compose's merge and
+    crash handling stay authoritative).  Falls back to the wrapped
+    checker's direct path if routing declines."""
+
+    def __init__(self, service: "CheckService", inner):
+        self.service = service
+        self.inner = inner
+
+    def check(self, test, history, opts=None):
+        routed = self.service.try_route_analyze(test, self.inner, history,
+                                                opts)
+        if routed is not None:
+            return routed
+        # Compose already wraps this call in check_safe — crashes and
+        # budgets are handled one level up; don't double-wrap.
+        return self.inner.check(test, history, opts)
+
+
+class CheckService:
+    def __init__(self,
+                 max_queue_cells: int = 4096,
+                 max_lanes: int = 64,
+                 default_deadline_s: Optional[float] = None,
+                 store_base: Optional[str] = None,
+                 mesh=None,
+                 capacity: int = 256,
+                 max_capacity: int = 65536):
+        # Shared init: repeated service processes skip XLA compiles.
+        from jepsen_tpu.ops.cache import init_compilation_cache
+        init_compilation_cache(store_base)
+        self.max_queue_cells = max_queue_cells
+        self.default_deadline_s = default_deadline_s
+        self.metrics = Metrics()
+        self._sched = Scheduler(self.metrics, mesh=mesh,
+                                max_lanes=max_lanes, capacity=capacity,
+                                max_capacity=max_capacity)
+        self._closed = False
+        self._lock = threading.Lock()
+        self._submitted = 0
+        self.metrics.bind(self._sched.depth, self._inflight)
+        self._sched.start()
+
+    def _inflight(self) -> int:
+        snap = self.metrics._counters  # bound gauge; reads are atomic ints
+        return max(0, self._submitted - snap.get("requests-completed", 0))
+
+    # -- submission -------------------------------------------------------
+    def submit(self, history: History, *,
+               kind: str = KIND_WGL,
+               model: Union[str, Any, None] = None,
+               workload: str = "list-append",
+               realtime: bool = False,
+               consistency_models=None,
+               engine: str = "auto",
+               deadline_s: Optional[float] = None,
+               block: bool = True,
+               timeout: Optional[float] = None,
+               **engine_opts) -> Request:
+        """Enqueue one history check; returns a :class:`Request` handle
+        (``.wait()`` for the verdict).  ``block=False`` raises
+        :class:`ServiceSaturated` instead of waiting out backpressure."""
+        if self._closed:
+            raise ServiceClosed("service is closed")
+        if kind == KIND_WGL:
+            if isinstance(model, str) or model is None:
+                from jepsen_tpu.models import get_model
+                model = get_model(model or "cas-register")
+            spec: Dict[str, Any] = {"model": model, **engine_opts}
+        elif kind == KIND_ELLE:
+            spec = {"workload": workload, "realtime": realtime,
+                    "consistency_models": consistency_models,
+                    "engine": engine, **engine_opts}
+        else:
+            raise ValueError(f"unknown kind {kind!r}")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = Request(history, kind, spec, deadline_s=deadline_s)
+        cells = decompose(req)
+        if not self._sched.offer(cells, block=block,
+                                 max_depth=self.max_queue_cells,
+                                 timeout=timeout):
+            self.metrics.inc("requests-rejected")
+            raise ServiceSaturated(
+                f"queue at {self._sched.depth()}/{self.max_queue_cells} "
+                f"cells; request of {len(cells)} cell(s) rejected")
+        with self._lock:
+            self._submitted += 1
+        self.metrics.inc("requests-submitted")
+        self.metrics.inc("cells-submitted", len(cells))
+        return req
+
+    def check(self, history: History, *, timeout: Optional[float] = None,
+              **kw) -> Dict[str, Any]:
+        """Submit and wait: the synchronous convenience path."""
+        return self.submit(history, **kw).wait(timeout=timeout)
+
+    # -- core.analyze routing ---------------------------------------------
+    def _routable(self, checker) -> bool:
+        """Cheap predicate: would :meth:`try_route_analyze` service this
+        checker?  (No submission, no side effects.)"""
+        from jepsen_tpu.checker.linearizable import Linearizable
+        from jepsen_tpu.independent import IndependentChecker
+        inner = checker.inner if isinstance(checker, IndependentChecker) \
+            else checker
+        if isinstance(inner, Linearizable):
+            return (inner._jax_model() is not None
+                    and inner.algorithm in (None, "tpu"))
+        try:
+            from jepsen_tpu.checker.elle import ElleChecker
+        except Exception:  # noqa: BLE001
+            return False
+        return (isinstance(checker, ElleChecker)
+                and checker.engine in ("auto", "tpu"))
+
+    def try_route_analyze(self, test, checker, history: History,
+                          opts=None) -> Optional[Dict[str, Any]]:
+        """Route a test's analysis through the service when its checker
+        maps onto a device engine; None = not serviceable (caller runs the
+        direct path).  Deadlines reuse the test's ``checker_budget_s`` —
+        the same knob check_safe honors — so budget semantics don't fork
+        between the direct and serviced paths.
+
+        A composed checker (the shape every suite builds: stats +
+        workload + perf) routes per child: serviceable children submit to
+        the service, the rest run directly, and Compose's own merge /
+        concurrency / budget semantics apply unchanged."""
+        from jepsen_tpu.checker.core import Compose
+        from jepsen_tpu.checker.linearizable import Linearizable
+        if isinstance(checker, Compose):
+            if not any(self._routable(c) for c in checker.checkers.values()):
+                return None
+            shim = Compose(
+                {n: _ServiceRouted(self, c) if self._routable(c) else c
+                 for n, c in checker.checkers.items()},
+                budget_s=checker.budget_s)
+            return shim.check(test, history, opts)
+        budget = (opts or {}).get("budget_s") \
+            or (test or {}).get("checker_budget_s")
+        inner = checker
+        from jepsen_tpu.independent import IndependentChecker
+        if isinstance(checker, IndependentChecker):
+            inner = checker.inner
+        if isinstance(inner, Linearizable):
+            jm = inner._jax_model()
+            if jm is None or inner.algorithm not in (None, "tpu"):
+                return None
+            req = self.submit(history, kind=KIND_WGL, model=jm,
+                              deadline_s=budget,
+                              **{k: v for k, v in inner.engine_opts.items()
+                                 if k in ("capacity", "max_capacity")})
+            return req.wait()
+        try:
+            from jepsen_tpu.checker.elle import ElleChecker
+        except Exception:  # noqa: BLE001
+            return None
+        if isinstance(checker, ElleChecker):
+            if checker.engine not in ("auto", "tpu"):
+                return None
+            req = self.submit(history, kind=KIND_ELLE,
+                              workload=checker.workload,
+                              realtime=checker.realtime,
+                              consistency_models=checker.consistency_models,
+                              deadline_s=checker.budget_s or budget)
+            res = req.wait()
+            from jepsen_tpu.elle import render
+            render.write_artifacts(test, res, opts)
+            return res
+        return None
+
+    # -- lifecycle --------------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._sched.depth()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        return self._sched.drain(timeout=timeout)
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, drain the queue (every admitted request still
+        resolves), stop the device loop."""
+        with self._lock:
+            if self._closed:
+                return True
+            self._closed = True
+        return self._sched.stop(drain=True, timeout=timeout)
+
+    def __enter__(self) -> "CheckService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

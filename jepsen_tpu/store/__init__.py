@@ -43,7 +43,20 @@ def test_dir(test: Dict[str, Any], base: Optional[str] = None) -> str:
 
 def make_run_dir(test: Dict[str, Any], base: Optional[str] = None) -> str:
     d = test_dir(test, base)
-    os.makedirs(d, exist_ok=True)
+    # Two runs of one suite in the same wall-clock second (a concurrent
+    # campaign sharing a checking service, or a fast test_count loop) must
+    # never share a run dir: claim the path atomically, bumping a numeric
+    # suffix on collision and keeping start_time in sync with the dir name.
+    base_d, i = d, 1
+    while True:
+        try:
+            os.makedirs(d)
+            break
+        except FileExistsError:
+            i += 1
+            d = f"{base_d}-{i}"
+    if d != base_d:
+        test["start_time"] = os.path.basename(d)
     _update_symlink(os.path.join(os.path.dirname(d), "latest"), d)
     _update_symlink(os.path.join(os.path.dirname(os.path.dirname(d)),
                                  "latest"), d)

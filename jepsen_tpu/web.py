@@ -1,13 +1,26 @@
-"""Results web browser.
+"""Results web browser + checking-service front end.
 
 Parity: jepsen.web (jepsen/src/jepsen/web.clj): an HTTP server listing runs
 with validity-colored rows (web.clj:28-36,175), per-run file browsing, and
 zip export of a run directory.  Stdlib http.server — no framework needed.
+
+With a serve.CheckService attached (cli.py's ``serve`` command wires one
+in), the server additionally exposes the service's observability and
+submission surface:
+
+- ``GET /metrics``  — the full metrics snapshot as JSON (counters, queue
+  depth, lane occupancy, engine-cache hit/miss/recompile, traces);
+- ``GET /queue``    — a human-readable queue-status page;
+- ``POST /submit``  — submit a history for checking: a JSON body with
+  ``ops`` (op dicts, the history.jsonl shape) plus the submit options of
+  CheckService.submit (kind/model/workload/...); responds with the
+  verdict JSON.  This is what ``cli.py submit`` talks to.
 """
 
 from __future__ import annotations
 
 import html
+import json
 import os
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -38,7 +51,33 @@ def _index_html(base: str) -> str:
             + "".join(rows) + "</table></body></html>")
 
 
-def make_handler(base: str):
+def _queue_html(service) -> str:
+    snap = service.metrics.snapshot()
+    rows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for section in ("counters", "gauges", "occupancy", "engine-cache")
+        for k, v in snap[section].items())
+    traces = []
+    for t in reversed(snap["traces"]):
+        spans = ", ".join(f"{s['span']}@{s['t']:.3f}s" for s in t["spans"])
+        traces.append(f"<tr><td>{t['request-id']}</td>"
+                      f"<td>{html.escape(str(t['kind']))}</td>"
+                      f"<td>{html.escape(str(t['valid']))}</td>"
+                      f"<td>{html.escape(spans)}</td></tr>")
+    return ("<html><head><title>jepsen-tpu queue</title></head><body>"
+            "<h1>checking-service queue</h1>"
+            "<table border=1 cellpadding=4 style='border-collapse:collapse'>"
+            "<tr><th>metric</th><th>value</th></tr>" + rows + "</table>"
+            "<h2>recent requests</h2>"
+            "<table border=1 cellpadding=4 style='border-collapse:collapse'>"
+            "<tr><th>id</th><th>kind</th><th>valid</th><th>spans</th></tr>"
+            + "".join(traces) + "</table>"
+            "<p><a href='/metrics'>metrics JSON</a> · "
+            "<a href='/'>runs</a></p></body></html>")
+
+
+def make_handler(base: str, service=None):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -51,15 +90,58 @@ def make_handler(base: str):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_json(self, code: int, obj):
+            self._send(code, json.dumps(obj, default=str).encode(),
+                       "application/json")
+
         def do_GET(self):  # noqa: N802
             path = unquote(self.path)
             if path in ("/", "/index.html"):
                 return self._send(200, _index_html(base).encode())
+            if path == "/metrics":
+                if service is None:
+                    from jepsen_tpu.parallel.batch import engine_cache_stats
+                    return self._send_json(
+                        200, {"engine-cache": engine_cache_stats()})
+                return self._send_json(200, service.metrics.snapshot())
+            if path == "/queue":
+                if service is None:
+                    return self._send(503, b"no checking service attached")
+                return self._send(200, _queue_html(service).encode())
             if path.startswith("/files/"):
                 return self._files(path[len("/files/"):])
             if path.startswith("/zip/"):
                 return self._zip(path[len("/zip/"):])
             return self._send(404, b"not found")
+
+        def do_POST(self):  # noqa: N802
+            if unquote(self.path) != "/submit":
+                return self._send(404, b"not found")
+            if service is None:
+                return self._send_json(
+                    503, {"error": "no checking service attached"})
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                from jepsen_tpu.history import History, Op
+                ops = body.pop("ops")
+                hist = History([Op.from_dict(d) for d in ops],
+                               reindex=True)
+                if body.pop("independent", False):
+                    # JSON can't carry the keyed-value tuples of an
+                    # independent workload; the client asserts the shape
+                    from jepsen_tpu.independent import rewrap_tuples
+                    hist = rewrap_tuples(hist)
+                timeout = body.pop("timeout_s", None)
+            except Exception as e:  # noqa: BLE001
+                return self._send_json(400, {"error": f"bad request: {e}"})
+            try:
+                res = service.check(hist, timeout=timeout, **body)
+            except TimeoutError as e:
+                return self._send_json(504, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — saturation, bad opts
+                return self._send_json(503, {"error": str(e)})
+            return self._send_json(200, res)
 
         def _safe(self, rel: str):
             p = os.path.realpath(os.path.join(base, rel))
@@ -160,9 +242,11 @@ def make_handler(base: str):
     return Handler
 
 
-def serve(base: str = "store", port: int = 8080, block: bool = True):
-    httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(base))
+def serve(base: str = "store", port: int = 8080, block: bool = True,
+          service=None):
+    httpd = ThreadingHTTPServer(("0.0.0.0", port),
+                                make_handler(base, service=service))
     if block:
-        print(f"jepsen-tpu web on http://0.0.0.0:{port}")
+        print(f"jepsen-tpu web on http://0.0.0.0:{httpd.server_address[1]}")
         httpd.serve_forever()
     return httpd

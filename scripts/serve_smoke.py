@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Serve smoke: the checking service vs a sequential direct loop.
+
+Runs a 64-history mixed workload (48 wgl cas-register + 16 elle
+list-append, a third of them corrupted) twice on the CPU backend:
+
+  1. sequentially through direct ``core.analyze`` — the cold path every
+     run pays without the service;
+  2. concurrently (4 client threads) through one shared CheckService.
+
+Asserts per-history verdict parity between the two paths, service
+throughput >= 2x the sequential loop, and a non-empty metrics export
+(queue depth, lane occupancy, recompile counters), then writes the full
+metrics snapshot to the path given as argv[1] (default
+/tmp/serve_metrics.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu import core  # noqa: E402
+from jepsen_tpu.checker.elle import ElleChecker
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.models import get_model
+from jepsen_tpu.serve import CheckService
+from jepsen_tpu.synth import (
+    cas_register_history, corrupt_list_append, corrupt_reads,
+    list_append_history,
+)
+
+N_WGL, N_ELLE, CLIENTS = 48, 16, 4
+
+
+def build_workload():
+    jobs = []
+    for s in range(N_WGL):
+        h = cas_register_history(60, concurrency=4, seed=s)
+        if s % 3 == 2:
+            h = corrupt_reads(h, n=1, seed=s)
+        jobs.append(("wgl", h))
+    for s in range(N_ELLE):
+        h = list_append_history(25, seed=1000 + s)
+        if s % 3 == 2:
+            h = corrupt_list_append(h, anomaly_p=0.5, seed=s)
+        jobs.append(("elle", h))
+    return jobs
+
+
+def direct_checker(kind):
+    return (Linearizable(get_model("cas-register")) if kind == "wgl"
+            else ElleChecker(workload="list-append"))
+
+
+def run_direct(jobs):
+    out = []
+    for i, (kind, h) in enumerate(jobs):
+        res = core.analyze({"name": f"direct-{i}",
+                            "checker": direct_checker(kind)}, h)
+        out.append(res["valid"])
+    return out
+
+
+def run_service(svc, jobs):
+    out = [None] * len(jobs)
+
+    def client(span):
+        # Submit the whole share first (continuous batching feeds on queue
+        # depth — a submit-then-wait client is how checks arrive from a
+        # campaign of concurrent runs), then collect verdicts.
+        reqs = []
+        for i in span:
+            kind, h = jobs[i]
+            kw = ({"model": "cas-register"} if kind == "wgl"
+                  else {"workload": "list-append"})
+            reqs.append((i, svc.submit(h, kind=kind, **kw)))
+        for i, r in reqs:
+            out[i] = r.wait(timeout=600)["valid"]
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    return out
+
+
+def main():
+    dump = sys.argv[1] if len(sys.argv) > 1 else "/tmp/serve_metrics.json"
+    jobs = build_workload()
+
+    # Start the capacity-escalation ladder low: the vmapped engine's
+    # per-step cost is capacity-proportional for every lane, and these
+    # short histories never need more than a few dozen configurations
+    # (overflowing lanes escalate automatically).
+    svc = CheckService(max_lanes=16, capacity=64)
+    # Warm both paths so the comparison times steady-state checking, not
+    # first-compile: one history per kind warms the direct engines (every
+    # job shares their shapes), a full round warms the service's bucket
+    # ladder (all lane-group sizes the scheduler will form).
+    run_direct(jobs[:1] + jobs[-1:])
+    run_service(svc, jobs)
+
+    t0 = time.perf_counter()
+    direct = run_direct(jobs)
+    t_direct = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    served = run_service(svc, jobs)
+    t_serve = time.perf_counter() - t0
+
+    snap = svc.metrics.snapshot()
+    svc.close(timeout=60.0)
+
+    mismatches = [i for i, (a, b) in enumerate(zip(direct, served))
+                  if a != b]
+    speedup = t_direct / t_serve if t_serve else float("inf")
+    report = {"histories": len(jobs),
+              "direct_s": round(t_direct, 3),
+              "service_s": round(t_serve, 3),
+              "speedup": round(speedup, 2),
+              "mismatches": mismatches,
+              "invalid": direct.count(False),
+              "metrics": snap}
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps({k: v for k, v in report.items() if k != "metrics"}))
+
+    assert not mismatches, f"verdict mismatches at {mismatches}"
+    assert direct.count(False) > 0, "corrupted histories must refute"
+    counters = snap["counters"]
+    assert counters.get("requests-completed", 0) >= len(jobs)
+    assert counters.get("dispatches", 0) > 0
+    assert "queue-depth" in snap["gauges"]
+    assert snap["occupancy"]["lanes-used"] > 0
+    assert snap["engine-cache"]["recompiles"] >= 1
+    assert speedup >= 2.0, f"service speedup {speedup:.2f}x < 2x"
+    print(f"serve smoke OK: {speedup:.2f}x over sequential, "
+          f"metrics dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
