@@ -50,6 +50,15 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--logging-json", action="store_true",
                    help="jepsen.log as JSON lines (cli.clj:98)")
     p.add_argument("--store", default="store", help="results directory")
+    p.add_argument("--monitor", action="store_true",
+                   help="check the run online: stream ops into the "
+                        "checker during the run, refute early, resume "
+                        "the final check from monitor state")
+    p.add_argument("--monitor-epoch", type=int, default=None,
+                   help="monitor epoch size in ops (default 256)")
+    p.add_argument("--monitor-abort", action="store_true",
+                   help="cut the generator as soon as the monitor "
+                        "confirms a refutation")
 
 
 def parse_nodes(args) -> List[str]:
@@ -81,6 +90,9 @@ def test_opts_to_map(args) -> Dict[str, Any]:
         "leave_db_running": args.leave_db_running,
         "logging_json": getattr(args, "logging_json", False),
         "store_base": args.store,
+        "monitor": getattr(args, "monitor", False),
+        "monitor_epoch": getattr(args, "monitor_epoch", None),
+        "monitor_abort": getattr(args, "monitor_abort", False),
     }
 
 

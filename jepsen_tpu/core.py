@@ -49,6 +49,15 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
     logger.info("Running test %s", test["name"])
     try:
         store.save_0(test)
+        mon = None
+        if test.get("monitor"):
+            # Online monitor (jepsen_tpu.monitor): taps the interpreter's
+            # op stream via test["_monitor"], checks incrementally during
+            # the run, and hands analyze() a resumable frontier.
+            from jepsen_tpu.monitor import Monitor
+            mon = Monitor.from_test(test)
+            if mon is not None:
+                test["_monitor"] = mon.start()
         has_cluster = bool(test.get("nodes"))
         if has_cluster:
             control.setup_sessions(test)
@@ -73,12 +82,21 @@ def run(test: Dict[str, Any]) -> Dict[str, Any]:
                 _teardown_db(test, final=True)
             test["history"] = history
             store.save_1(test, history)
+            if mon is not None:
+                # Settle the frontier on the tail ops and persist the
+                # checkpoint before analysis resumes from it.
+                try:
+                    mon.finalize()
+                except Exception:  # noqa: BLE001
+                    logger.exception("monitor finalize; cold analyze")
             results = analyze(test, history)
             test["results"] = results
             store.save_2(test, results)
             _log_results(results)
             return test
         finally:
+            if mon is not None:
+                mon.close()
             if has_cluster:
                 # Failed OS/DB setup never reaches the in-run snarf site;
                 # those logs matter most for diagnosis, so snarf here too
@@ -210,6 +228,27 @@ def analyze(test, history: History,
         from jepsen_tpu.checker.core import resolve_checker
         checker = resolve_checker(checker)
     opts = {"store_dir": test.get("store_dir")}
+    mon = test.get("_monitor")
+    if mon is not None:
+        # A monitored run resumes the authoritative check from the last
+        # monitor epoch (monitor/resume.py): None = soundness doubt, run
+        # the cold path below.  A resume crash is likewise just a cold
+        # analyze — resumption is an optimization, never a verdict risk.
+        from jepsen_tpu.monitor import resume as _mon_resume
+        try:
+            resumed = _mon_resume.resume_final_check(test, checker, history,
+                                                     mon, opts)
+        except Exception:  # noqa: BLE001
+            logger.exception("monitor resume failed; cold analyze")
+            resumed = None
+        if resumed is not None:
+            logger.info("analysis resumed from monitor epoch %s "
+                        "(%s tail op(s) re-checked)",
+                        resumed.get("resumed-from-epoch"),
+                        resumed.get("tail-ops"))
+            if resumed.get("valid") is False:
+                _failure_artifacts(test, history)
+            return resumed
     service = service if service is not None else test.get("service")
     if service is not None:
         try:

@@ -211,6 +211,10 @@ def run(test: Dict[str, Any]) -> History:
     def now() -> int:
         return _time.monotonic_ns() - t0
 
+    # Online monitor (jepsen_tpu.monitor): core.run parks it on the test
+    # map; the tap never blocks this loop.
+    mon = test.get("_monitor")
+
     def handle_completion(thread_id, res: Op):
         nonlocal ctx, g, outstanding, last_progress
         outstanding -= 1
@@ -219,6 +223,8 @@ def run(test: Dict[str, Any]) -> History:
         last_progress = _time.monotonic()
         res = res.with_(time=now(), index=len(history))
         history.append(res)
+        if mon is not None:
+            mon.offer(res)
         ctx = ctx.with_time(res.time).free_thread(thread_id)
         if res.type == INFO and thread_id != NEMESIS:
             ctx = ctx.with_next_process(thread_id)
@@ -309,7 +315,15 @@ def run(test: Dict[str, Any]) -> History:
             if drained:
                 continue
             check_watchdog()
-            # 2. Ask the generator.
+            # 2. Ask the generator — unless the monitor refuted the run
+            # and the test opted into early abort: cut the generator,
+            # let outstanding ops drain, and the loop exits normally.
+            if g is not None and mon is not None and mon.should_abort():
+                logger.warning("monitor refuted the run; aborting the "
+                               "generator with %d op(s) outstanding",
+                               outstanding)
+                test["monitor_aborted"] = True
+                g = None
             ctx = ctx.with_time(now())
             r = g.op(test, ctx) if g is not None else None
             if r is None:
@@ -337,6 +351,8 @@ def run(test: Dict[str, Any]) -> History:
             op = op.with_(time=now(), index=len(history))
             thread_id = ctx.process_thread(op.process)
             history.append(op)
+            if mon is not None:
+                mon.offer(op)
             ctx = ctx.busy_thread(thread_id)
             g = g2.update(test, ctx, op) if g2 is not None else None
             outstanding += 1

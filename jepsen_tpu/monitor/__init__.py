@@ -1,0 +1,330 @@
+"""Online monitoring: incremental checking riding the run's op stream.
+
+The framework's post-hoc shape — run for minutes, then check — burns
+wall clock on runs that are already doomed: a history that violates
+linearizability at op 900 keeps generating ops until the time limit,
+then pays a cold full-history check.  The monitor turns the checker
+into a live oracle (see docs/monitoring.md):
+
+- the interpreter's scheduler loop taps every op it appends into a
+  bounded ring buffer (:mod:`tap` — the run never blocks on the
+  monitor);
+- a flusher thread drains the tap on an epoch cadence into incremental
+  per-key checker state (:mod:`epochs` — the WGL configuration frontier
+  or the Elle completed-prefix), so each epoch pays only for new ops;
+- a refuting epoch goes through the verdict channel (:mod:`verdict`):
+  confirmed via the serve.CheckService lanes when one is attached,
+  recorded with the refuting op index, snapshotted to the store, and —
+  with the ``monitor_abort`` test opt — the generator is cut so the run
+  ends early;
+- at analyze time the final authoritative check *resumes* from the
+  monitor's frontier (:mod:`resume`) instead of re-checking from op 0:
+  same verdict as the cold offline check by construction, paying only
+  for the ops after the last monitor epoch.
+
+Invariant inherited from the rest of the stack: partial state never
+degrades a verdict toward ``false``.  Dropped tap ops disable
+refutation and resume (the analyze phase falls back to the cold path);
+an exploded frontier yields ``unknown`` for its key; an unconfirmed
+refutation never aborts the run.
+
+Usage — test opts (all wired through cli.py)::
+
+    test["monitor"] = True          # enable (needs a monitorable checker)
+    test["monitor_epoch"] = 256     # epoch size in ops (default 256)
+    test["monitor_abort"] = True    # cut the generator on refutation
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.monitor.epochs import ElleEpochEngine, WglEpochEngine
+from jepsen_tpu.monitor.tap import DEFAULT_CAPACITY, OpTap
+from jepsen_tpu.monitor.verdict import VerdictChannel
+from jepsen_tpu.serve.metrics import mono_now
+
+logger = logging.getLogger("jepsen.monitor")
+
+DEFAULT_EPOCH_OPS = 256
+DEFAULT_EPOCH_S = 1.0
+
+# Live monitors, for web.py's /monitor endpoint (a run registers its
+# monitor while active; the last few finished ones keep their final
+# status visible).
+_ACTIVE: Dict[int, "Monitor"] = {}
+_RECENT: deque = deque(maxlen=8)
+_REG_LOCK = threading.Lock()
+_ids = iter(range(1, 1 << 62))
+
+
+def active_statuses() -> List[Dict[str, Any]]:
+    with _REG_LOCK:
+        live = [m.status() for m in _ACTIVE.values()]
+        recent = list(_RECENT)
+    return live + recent
+
+
+class Monitor:
+    """One run's online monitor: tap -> epochs -> verdict -> resume."""
+
+    def __init__(self, *, kind: str,
+                 model=None, jax_model=None,
+                 workload: str = "list-append", realtime: bool = False,
+                 independent: bool = False,
+                 epoch_ops: int = DEFAULT_EPOCH_OPS,
+                 epoch_s: float = DEFAULT_EPOCH_S,
+                 service=None, abort: bool = False,
+                 tap_capacity: int = DEFAULT_CAPACITY,
+                 max_configs: int = 2_000_000,
+                 store_dir: Optional[str] = None,
+                 budget_s: Optional[float] = None,
+                 name: str = "monitor"):
+        if kind not in ("wgl", "elle"):
+            raise ValueError(f"unknown monitor kind {kind!r}")
+        self.id = next(_ids)
+        self.name = name
+        self.kind = kind
+        self.independent = independent
+        self.jax_model = jax_model
+        self.epoch_ops = max(1, int(epoch_ops))
+        self.epoch_s = epoch_s
+        self.service = service
+        self.store_dir = store_dir
+        self.tap = OpTap(tap_capacity)
+        if kind == "wgl":
+            self.engine = WglEpochEngine(model, independent=independent,
+                                         max_configs=max_configs,
+                                         keep_prefix=service is not None)
+        else:
+            self.engine = ElleEpochEngine(workload=workload,
+                                          realtime=realtime,
+                                          service=service,
+                                          budget_s=budget_s)
+        self.channel = VerdictChannel(abort=abort, store_dir=store_dir,
+                                      service=service)
+        self.epochs: List[Dict[str, Any]] = []
+        self.t0 = mono_now()
+        self.finalized = False
+        self.final_delta: Optional[Dict[str, Any]] = None
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._flush_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.tap.bind_wake(self._wake, self.epoch_ops)
+
+    # -- construction from a test map -------------------------------------
+    @classmethod
+    def from_test(cls, test: Dict[str, Any],
+                  service=None) -> Optional["Monitor"]:
+        """Build a monitor for a test map, or None when the test didn't
+        ask for one / its checker has no monitorable core."""
+        if not test.get("monitor"):
+            return None
+        checker = test.get("checker")
+        if checker is None:
+            return None
+        from jepsen_tpu.checker.core import Checker, resolve_checker
+        if not isinstance(checker, Checker):
+            checker = resolve_checker(checker)
+        spec = cls._monitorable(checker)
+        if spec is None:
+            logger.warning("monitor requested but checker %r has no "
+                           "monitorable core; running unmonitored",
+                           type(checker).__name__)
+            return None
+        return cls(service=service if service is not None
+                   else test.get("service"),
+                   epoch_ops=int(test.get("monitor_epoch")
+                                 or DEFAULT_EPOCH_OPS),
+                   abort=bool(test.get("monitor_abort")),
+                   store_dir=test.get("store_dir"),
+                   budget_s=test.get("checker_budget_s"),
+                   name=test.get("name", "monitor"),
+                   **spec)
+
+    @staticmethod
+    def _monitorable(checker) -> Optional[Dict[str, Any]]:
+        """Map a checker onto a monitor spec: Linearizable (host model
+        required — the frontier is the host search), an IndependentChecker
+        around one, an ElleChecker, or the first monitorable child of a
+        Compose."""
+        from jepsen_tpu.checker.core import Compose
+        from jepsen_tpu.checker.linearizable import Linearizable
+        from jepsen_tpu.independent import IndependentChecker
+        if isinstance(checker, Compose):
+            for c in checker.checkers.values():
+                spec = Monitor._monitorable(c)
+                if spec is not None:
+                    return spec
+            return None
+        if isinstance(checker, IndependentChecker):
+            inner = checker.inner
+            if isinstance(inner, Linearizable) \
+                    and inner._cpu_model() is not None:
+                return {"kind": "wgl", "model": inner._cpu_model(),
+                        "jax_model": inner._jax_model(),
+                        "independent": True}
+            return None
+        if isinstance(checker, Linearizable):
+            if checker._cpu_model() is None:
+                return None
+            return {"kind": "wgl", "model": checker._cpu_model(),
+                    "jax_model": checker._jax_model()}
+        try:
+            from jepsen_tpu.checker.elle import ElleChecker
+        except Exception:  # noqa: BLE001
+            return None
+        if isinstance(checker, ElleChecker):
+            return {"kind": "elle", "workload": checker.workload,
+                    "realtime": checker.realtime,
+                    "budget_s": checker.budget_s}
+        return None
+
+    # -- the run-side surface (called from the scheduler loop) ------------
+    def offer(self, op: Op) -> None:
+        self.tap.offer(op)
+
+    def should_abort(self) -> bool:
+        return self.channel.should_abort()
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Monitor":
+        with _REG_LOCK:
+            _ACTIVE[self.id] = self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"jepsen-monitor-{self.id}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.epoch_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — the run must not care
+                logger.exception("monitor flush failed")
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Drain the tap and advance the incremental state by one epoch.
+        Returns the epoch record when new ops were processed."""
+        with self._flush_lock:
+            ops = self.tap.drain()
+            if not ops:
+                return None
+            self.engine.feed(ops)
+            n = len(self.epochs) + 1
+            refutations = self._advance(n)
+            rec = {"epoch": n, "t": round(mono_now() - self.t0, 6),
+                   "new-ops": len(ops), **self.engine.counters()}
+            if refutations:
+                rec["refuted"] = refutations
+            self.epochs.append(rec)
+            return rec
+
+    def _advance(self, epoch: int) -> List[Any]:
+        if self.kind == "wgl":
+            refuted_keys = self.engine.advance()
+            for k in refuted_keys:
+                f = self.engine.frontiers[k]
+                prefix = History(list(f.prefix)) if f.prefix else None
+                self.channel.report(kind="wgl", key=k, result=f.result,
+                                    epoch=epoch, prefix=prefix,
+                                    model=self.jax_model)
+            return refuted_keys
+        res = self.engine.advance()
+        if res is not None:
+            self.channel.report(kind="elle", key=None, result=res,
+                                epoch=epoch)
+            return [None]
+        return []
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    def finalize(self) -> None:
+        """Run over: stop the flusher, drain the tail, settle per-key
+        verdicts, persist the checkpoint.  The tail consumed here is
+        exactly what the resumed final check re-checks — everything
+        before it was already paid for during the run."""
+        if self.finalized:
+            return
+        self.stop()
+        with self._flush_lock:
+            pre = self.engine.counters()
+            ops = self.tap.drain()
+            if ops:
+                self.engine.feed(ops)
+            n = len(self.epochs) + 1
+            refutations = self._advance(n) if ops else []
+            self.engine.finalize()
+            if self.kind == "wgl":
+                # finalize() can itself refute (ghost-closing the tail)
+                for k, f in self.engine.frontiers.items():
+                    if f.result is not None and k not in refutations:
+                        prefix = History(list(f.prefix)) if f.prefix \
+                            else None
+                        self.channel.report(kind="wgl", key=k,
+                                            result=f.result, epoch=n,
+                                            prefix=prefix,
+                                            model=self.jax_model)
+            post = self.engine.counters()
+            self.final_delta = {
+                "tail-ops": len(ops),
+                **{k: post.get(k, 0) - pre.get(k, 0)
+                   for k in ("ops-checked", "ops-entered",
+                             "configs-explored") if k in post},
+            }
+            self.finalized = True
+        from jepsen_tpu.monitor import resume
+        resume.save(self)
+        with _REG_LOCK:
+            _ACTIVE.pop(self.id, None)
+            _RECENT.appendleft(self.status())
+
+    def close(self) -> None:
+        """Idempotent teardown (also safe before finalize on a crashed
+        run): stops the flusher and deregisters."""
+        self.stop()
+        with _REG_LOCK:
+            if self.id in _ACTIVE:
+                _RECENT.appendleft(self.status())
+            _ACTIVE.pop(self.id, None)
+
+    # -- observability ----------------------------------------------------
+    @property
+    def poisoned(self) -> Optional[str]:
+        """Why refutation/resume is disabled, or None when sound."""
+        if self.tap.dropped:
+            return f"tap dropped {self.tap.dropped} op(s): the monitored " \
+                   f"stream has a gap"
+        return None
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "name": self.name,
+            "kind": self.kind,
+            "independent": self.independent,
+            "active": self.id in _ACTIVE,
+            "finalized": self.finalized,
+            "t": round(mono_now() - self.t0, 6),
+            "epoch-ops": self.epoch_ops,
+            "epochs": len(self.epochs),
+            "last-epoch": self.epochs[-1] if self.epochs else None,
+            "counters": self.engine.counters(),
+            "tap": self.tap.stats(),
+            "poisoned": self.poisoned,
+            "verdict": self.channel.status(),
+            "final-delta": self.final_delta,
+        }

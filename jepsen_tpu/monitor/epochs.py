@@ -1,0 +1,360 @@
+"""Incremental checker state, carried across monitor epochs.
+
+The WGL side is a true incremental frontier: :class:`KeyFrontier` is the
+streaming configuration search of :mod:`jepsen_tpu.checker.wgl_cpu`
+(same closure, same ghost subsumption — it *imports* ``_closure``) with
+the event loop turned inside out, so state persists between feeds and
+each epoch flush pays only for ops that arrived since the last one.
+
+Why that is sound: the WGL scan refutes at a RETURN event when no
+surviving configuration linearized the returning op — and nothing a
+*later* event does can resurrect a dead configuration set, so a
+refutation on a prefix is final for every extension of that prefix.
+(Validity of a prefix, by contrast, implies nothing about the full
+history — hence the resumed authoritative check in resume.py.)
+
+The stream-order subtlety: an ENTER event needs its op's *completed*
+view (observed read values; ok/fail/info class), which is unknown at
+invoke time.  The frontier therefore advances only up to its
+*horizon* — the earliest invocation whose completion has not yet
+arrived — and buffers everything after it.  Ops consumed past the
+horizon produce exactly the event stream :func:`checker.prep.prepare`
+would build for the same history (fail pairs removed, crashed ops
+entering as ghosts, unconstraining crashed reads dropped, free-list
+slot reuse), so the final frontier verdict is wgl_cpu's verdict by
+construction — the parity the fuzz tests assert op-for-op, including
+``configs-explored``.
+
+Per-key decomposition (P-compositionality, the same split
+serve/decompose.py and independent.py use) keeps each frontier's
+pending window at per-key concurrency: :class:`WglEpochEngine` routes
+ops to per-key frontiers exactly as ``independent.subhistory`` would.
+
+The Elle side (:class:`ElleEpochEngine`) carries the completed-txn
+prefix across epochs — ingest is incremental (each flush appends only
+new ops) — and checks the accumulated prefix as a run-ended-here
+history: invocations still open at the cut are included as ``info``
+(indeterminate) txns, which is precisely what the history would look
+like had the run stopped at the cut, so anomaly sets on the prefix are
+anomaly sets of a legitimate history, never artifacts of the cut.
+Epoch checks ride the shared serve.CheckService lanes when a service is
+attached (bounded-shape engine cache, continuous batching with the rest
+of the fleet's traffic) and fall back to the host elle engine when not.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.checker.wgl_cpu import SearchExploded, _closure, \
+    _render_configs
+from jepsen_tpu.history import FAIL, History, INFO, INVOKE, NEMESIS, OK, Op
+from jepsen_tpu.independent import key_of
+from jepsen_tpu.models.base import Model
+
+PURE_READ_NAMES = ("read", "r")  # checker.prep's host-tier default
+
+
+class KeyFrontier:
+    """The resumable WGL configuration frontier for one key's stream.
+
+    Feed ops in history order (invocations and completions); call
+    :meth:`advance` to consume everything up to the horizon.  A
+    refutation (``self.result``) is final; an exploded search
+    (``self.exploded``) poisons this key's verdict to unknown."""
+
+    def __init__(self, model: Model, max_configs: int = 2_000_000,
+                 keep_prefix: bool = False):
+        self.model = model
+        self.max_configs = max_configs
+        # With keep_prefix the frontier retains every fed op (for
+        # service-side confirmation of a refutation); off by default so
+        # the frontier's memory stays bounded by pending concurrency.
+        self.keep_prefix = keep_prefix
+        self.prefix: List[Op] = []
+        self.window: Dict[int, Op] = {}     # slot -> pending effective op
+        self.configs = {(0, model)}
+        self.ghost_mask = 0
+        self.n_ghosts = 0
+        self.n_explored = 0
+        self.ops_entered = 0                # ENTER events consumed
+        self.ops_checked = 0                # RETURN events consumed
+        self.result: Optional[Dict[str, Any]] = None
+        self.exploded: Optional[str] = None
+        self._gclasses: Dict[Any, List[int]] = {}  # semantic key -> slots
+        self._free: List[int] = []
+        self._next_slot = 0
+        self._stream: deque = deque()       # unconsumed ops, history order
+        self._open: Dict[Any, int] = {}     # process -> open invoke index
+        self._resolution: Dict[int, Op] = {}  # invoke index -> completion
+        self._return_slot: Dict[int, int] = {}  # ok-completion index -> slot
+        self._finalizing = False
+
+    # -- ingest -----------------------------------------------------------
+    def feed(self, op: Op) -> None:
+        if self.keep_prefix:
+            self.prefix.append(op)
+        if op.type == INVOKE:
+            self._open[op.process] = op.index
+        else:
+            j = self._open.pop(op.process, None)
+            if j is not None:
+                self._resolution[j] = op
+        self._stream.append(op)
+
+    # -- the incremental event loop ---------------------------------------
+    def _alloc_slot(self) -> int:
+        if self._free:
+            return self._free.pop()
+        s = self._next_slot
+        self._next_slot += 1
+        return s
+
+    def _enter(self, eff: Op, ghost: bool, comp: Optional[Op]) -> None:
+        s = self._alloc_slot()
+        self.window[s] = eff
+        self.ops_entered += 1
+        if ghost:
+            self.ghost_mask |= 1 << s
+            self._gclasses.setdefault((eff.f, repr(eff.value)), []).append(s)
+            self.n_ghosts += 1
+        elif comp is not None:
+            self._return_slot[comp.index] = s
+
+    def _return(self, slot: int, comp: Op) -> None:
+        self.configs = _closure(self.configs, self.window, self.max_configs,
+                                None, self.ghost_mask, self._gclasses)
+        self.n_explored += len(self.configs)
+        bit = 1 << slot
+        survivors = {(m & ~bit, st) for (m, st) in self.configs if m & bit}
+        if not survivors:
+            self.result = {
+                "valid": False,
+                "analyzer": "wgl-cpu",          # same search, same shape
+                "op": self.window[slot].to_dict(),
+                "op-index": comp.index,          # refuting completion index
+                "previous-ok": True,
+                "final-configs": _render_configs(self.configs, self.window,
+                                                 limit=10),
+                "pending": [o.to_dict() for o in self.window.values()],
+                "configs-explored": self.n_explored,
+            }
+            return
+        del self.window[slot]
+        self._free.append(slot)
+        self.configs = survivors
+        self.ops_checked += 1
+
+    def advance(self) -> Optional[Dict[str, Any]]:
+        """Consume the stream up to the horizon; returns the refutation
+        result if this advance produced one (already stored on
+        ``self.result``)."""
+        if self.result is not None or self.exploded is not None:
+            self._stream.clear()
+            return None
+        before = self.result
+        try:
+            self._advance()
+        except SearchExploded as e:
+            self.exploded = str(e)
+        return self.result if self.result is not before else None
+
+    def _advance(self) -> None:
+        while self._stream and self.result is None:
+            op = self._stream[0]
+            if op.type == INVOKE:
+                comp = self._resolution.get(op.index)
+                if comp is None:
+                    if not self._finalizing:
+                        return  # horizon: completion class still unknown
+                    # run over: the op never completed — indeterminate,
+                    # exactly prepare()'s unmatched-invoke rule
+                    comp = op.with_(type=INFO)
+                else:
+                    del self._resolution[op.index]
+                self._stream.popleft()
+                if comp.type == FAIL:
+                    continue  # never took effect: pair removed outright
+                eff = op
+                if comp.type == OK and comp.value is not None:
+                    eff = op.with_(value=comp.value)
+                if comp.type != OK and eff.f in PURE_READ_NAMES \
+                        and eff.value is None:
+                    continue  # crashed read, unknown value: unconstraining
+                self._enter(eff, ghost=comp.type != OK, comp=comp)
+            else:
+                self._stream.popleft()
+                if op.type == OK:
+                    slot = self._return_slot.pop(op.index, None)
+                    if slot is not None:
+                        self._return(slot, op)
+                # fail/info completions generate no event
+
+    # -- epoch boundary / run end -----------------------------------------
+    def finalize(self) -> None:
+        """The run is over: remaining open invocations resolve as
+        indeterminate (ghosts), then the frontier drains completely."""
+        self._finalizing = True
+        self.advance()
+
+    def pending_ops(self) -> int:
+        """Invocations buffered past the horizon (not yet paid for).
+        Every open invocation is necessarily still in the stream (it
+        cannot be consumed before its completion class is known), so the
+        stream count alone covers both the open and the blocked-behind-
+        the-horizon cases."""
+        return sum(1 for o in self._stream if o.type == INVOKE)
+
+    def verdict(self) -> Dict[str, Any]:
+        if self.result is not None:
+            return dict(self.result)
+        if self.exploded is not None:
+            return {"valid": "unknown", "analyzer": "wgl-cpu",
+                    "error": self.exploded,
+                    "configs-explored": self.n_explored}
+        return {"valid": True, "analyzer": "wgl-cpu",
+                "configs-explored": self.n_explored,
+                "final-configs-count": len(self.configs)}
+
+
+class WglEpochEngine:
+    """Per-key frontier routing for the wgl kind.
+
+    ``independent=True`` mirrors ``independent.subhistory`` exactly: ops
+    route by their ``(key, value)`` tuple's key, values are unwrapped,
+    unkeyed client ops are dropped (as the cold per-key split drops
+    them); nemesis ops never reach a frontier (prepare strips them)."""
+
+    def __init__(self, model: Model, independent: bool = False,
+                 max_configs: int = 2_000_000, keep_prefix: bool = False):
+        self.model = model
+        self.independent = independent
+        self.max_configs = max_configs
+        self.keep_prefix = keep_prefix
+        self.frontiers: Dict[Any, KeyFrontier] = {}
+
+    def feed(self, ops: List[Op]) -> None:
+        for op in ops:
+            if op.process == NEMESIS:
+                continue
+            if self.independent:
+                k = key_of(op)
+                if k is None:
+                    continue
+                op = op.with_(value=op.value[1])
+            else:
+                k = None
+            f = self.frontiers.get(k)
+            if f is None:
+                f = self.frontiers[k] = KeyFrontier(
+                    self.model, max_configs=self.max_configs,
+                    keep_prefix=self.keep_prefix)
+            f.feed(op)
+
+    def advance(self) -> List[Any]:
+        """Advance every frontier; returns the keys newly refuted by this
+        epoch (their results are on the frontiers)."""
+        refuted = []
+        for k, f in self.frontiers.items():
+            if f.advance() is not None:
+                refuted.append(k)
+        return refuted
+
+    def finalize(self) -> None:
+        for f in self.frontiers.values():
+            f.finalize()
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "keys": len(self.frontiers),
+            "ops-entered": sum(f.ops_entered
+                               for f in self.frontiers.values()),
+            "ops-checked": sum(f.ops_checked
+                               for f in self.frontiers.values()),
+            "configs-explored": sum(f.n_explored
+                                    for f in self.frontiers.values()),
+            "pending-ops": sum(f.pending_ops()
+                               for f in self.frontiers.values()),
+        }
+
+
+class ElleEpochEngine:
+    """Accumulates the completed-txn prefix and re-derives the dependency
+    graph each epoch (ingest is incremental; the graph check covers the
+    accumulated prefix).  Pending invocations are included as ``info``
+    txns so the prefix is a legitimate run-ended-here history."""
+
+    def __init__(self, workload: str = "list-append",
+                 realtime: bool = False, service=None,
+                 budget_s: Optional[float] = None):
+        self.workload = workload
+        self.realtime = realtime
+        self.service = service
+        self.budget_s = budget_s
+        self._ops: List[Op] = []            # arrival-order client ops
+        self._open: Dict[Any, Op] = {}      # process -> open invocation
+        self.new_since_check = 0
+        self.checked_ops = 0                # prefix length at last check
+        self.result: Optional[Dict[str, Any]] = None
+        self.last: Optional[Dict[str, Any]] = None
+
+    def feed(self, ops: List[Op]) -> None:
+        for op in ops:
+            if op.process == NEMESIS:
+                continue
+            self._ops.append(op)
+            if op.type == INVOKE:
+                self._open[op.process] = op
+            else:
+                self._open.pop(op.process, None)
+            self.new_since_check += 1
+
+    def _prefix(self) -> History:
+        cut = list(self._ops)
+        for inv in self._open.values():
+            cut.append(inv.with_(type=INFO, error=":monitor-cut"))
+        return History(cut, reindex=True)
+
+    def _check(self, h: History) -> Dict[str, Any]:
+        if self.service is not None:
+            return self.service.check(h, kind="elle",
+                                      workload=self.workload,
+                                      realtime=self.realtime,
+                                      deadline_s=self.budget_s)
+        from jepsen_tpu.elle_tpu.engine import check_batch
+        return check_batch([h], workload=self.workload,
+                           realtime=self.realtime,
+                           budget_s=self.budget_s)[0]
+
+    def advance(self) -> Optional[Dict[str, Any]]:
+        """Check the accumulated prefix; returns a refutation result the
+        first time the prefix goes definitely invalid."""
+        if self.result is not None or not self.new_since_check:
+            return None
+        h = self._prefix()
+        self.new_since_check = 0
+        self.checked_ops = len(self._ops)
+        try:
+            res = self._check(h)
+        except Exception as e:  # noqa: BLE001 — a check crash never ends
+            self.last = {"valid": "unknown", "error": str(e)}
+            return None
+        self.last = res
+        if res.get("valid") is False:
+            last_done = max((o.index for o in self._ops
+                             if o.type != INVOKE), default=None)
+            self.result = {**res, "op-index": last_done}
+            return self.result
+        return None
+
+    def finalize(self) -> None:
+        # The authoritative elle verdict comes from the offline path over
+        # the full history (the graph is not prefix-resumable); nothing
+        # to drain here beyond the early-refutation state we already hold.
+        pass
+
+    def counters(self) -> Dict[str, int]:
+        return {"ops-ingested": len(self._ops),
+                "ops-at-last-check": self.checked_ops,
+                "pending-ops": len(self._open)}

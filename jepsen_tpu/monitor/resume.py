@@ -1,0 +1,230 @@
+"""Resume the final authoritative check from the monitor's last epoch.
+
+The monitor's WGL frontier IS the checker's search — same closure, same
+event preparation, same configuration sets (epochs.py documents the
+parity argument).  So when the run ends, ``core.analyze`` does not need
+to re-check from op 0: :func:`resume_final_check` finalizes the frontier
+(consuming only the tail ops that arrived after the last monitor epoch)
+and assembles the verdict from per-key frontier state.  The verdict is
+the cold offline verdict by construction; the work is proportional to
+the tail.
+
+Strictness over savings: any condition that could make the resumed
+verdict diverge from the cold one returns ``None`` and the caller runs
+the cold path — a gap in the tapped stream (dropped ops), an op-count
+mismatch between tap and history, a checker shape the monitor wasn't
+built from, an elle monitor (the dependency graph is not
+prefix-resumable, so elle's authoritative verdict always comes from the
+offline full-history path).  And per the framework-wide invariant, a
+resumed verdict is never ``false`` except from an actual frontier
+refutation — exploded or partial keys degrade to ``unknown``.
+
+A ``Compose`` — the shape every suite builds (stats + workload + perf) —
+resumes through its *monitored* child: the child the monitor was built
+from (``Monitor._monitorable``'s first-match order) gets the resumed
+verdict, every sibling runs its normal cold check, and the results merge
+under Compose's own semantics (same ``merge_valid``, same crashed-child
+surfacing).  The siblings were never covered by the monitor, so nothing
+is resumed for them — only the expensive linearizability search skips
+its re-check.
+
+:func:`save` persists a ``monitor.json`` checkpoint into the run's store
+directory (atomic write — a torn checkpoint must never shadow a good
+one) recording epochs, counters, per-key verdicts, and the refutation
+record; :func:`load` reads it back.  The checkpoint is the *artifact*
+trail (web UI, post-mortems, the smoke script's metrics dump); the
+in-process resume path uses the live monitor object on
+``test["_monitor"]``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.checker.core import UNKNOWN, merge_valid
+from jepsen_tpu.history import History
+
+logger = logging.getLogger("jepsen.monitor")
+
+CHECKPOINT = "monitor.json"
+VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint persistence
+
+def save(monitor) -> Optional[str]:
+    """Write the monitor checkpoint into its store dir; returns the path
+    (None when the monitor has no store dir).  Best-effort: a checkpoint
+    write failure never fails the run."""
+    if not monitor.store_dir:
+        return None
+    record = checkpoint_record(monitor)
+    path = os.path.join(monitor.store_dir, CHECKPOINT)
+    try:
+        from jepsen_tpu.atomic_io import atomic_write
+        os.makedirs(monitor.store_dir, exist_ok=True)
+        atomic_write(path, lambda f: json.dump(record, f, indent=2,
+                                               default=str))
+    except Exception:  # noqa: BLE001
+        logger.exception("writing monitor checkpoint")
+        return None
+    return path
+
+
+def checkpoint_record(monitor) -> Dict[str, Any]:
+    rec = {
+        "version": VERSION,
+        "kind": monitor.kind,
+        "independent": monitor.independent,
+        "finalized": monitor.finalized,
+        "epoch-ops": monitor.epoch_ops,
+        "epochs": list(monitor.epochs),
+        "counters": monitor.engine.counters(),
+        "tap": monitor.tap.stats(),
+        "poisoned": monitor.poisoned,
+        "verdict": monitor.channel.status(),
+        "final-delta": monitor.final_delta,
+    }
+    if monitor.kind == "wgl":
+        rec["keys"] = {repr(k): f.verdict()
+                       for k, f in monitor.engine.frontiers.items()}
+    return rec
+
+
+def load(store_dir: str) -> Optional[Dict[str, Any]]:
+    """Read a run's monitor checkpoint, or None when absent/unreadable."""
+    path = os.path.join(store_dir, CHECKPOINT)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# The resumed final check
+
+def resume_final_check(test, checker, history: History, monitor,
+                       opts=None) -> Optional[Dict[str, Any]]:
+    """Produce the final verdict from the monitor's frontier state, or
+    None when the cold path must run instead (any soundness doubt)."""
+    if monitor is None or monitor.kind != "wgl":
+        return None
+    if monitor.poisoned is not None:
+        logger.warning("monitor resume disabled (%s); cold analyze",
+                       monitor.poisoned)
+        return None
+    from jepsen_tpu.checker.core import Compose
+    if isinstance(checker, Compose):
+        return _resume_compose(test, checker, history, monitor, opts)
+    if not _checker_matches(checker, monitor):
+        return None
+    if not monitor.finalized:
+        monitor.finalize()
+    # Defense in depth: the tap must have seen exactly the history being
+    # analyzed.  A mismatch (an append site the tap missed, a re-analysis
+    # of a different stored history) silently invalidates the frontier's
+    # claim to cover this history — fall back cold.
+    if monitor.tap.offered != len(history):
+        logger.warning(
+            "monitor tap saw %d op(s) but the analyzed history has %d; "
+            "cold analyze", monitor.tap.offered, len(history))
+        return None
+
+    frontiers = monitor.engine.frontiers
+    per_key = {k: f.verdict() for k, f in frontiers.items()}
+    valid = merge_valid([r.get("valid") for r in per_key.values()])
+    delta = monitor.final_delta or {}
+    meta = {
+        "analyzer": "monitor-resume",
+        "resumed-from-epoch": len(monitor.epochs),
+        "ops-rechecked": delta.get("ops-checked", 0),
+        "tail-ops": delta.get("tail-ops", 0),
+        "configs-explored": sum(f.n_explored for f in frontiers.values()),
+    }
+    if monitor.independent:
+        bad = {k: r for k, r in per_key.items()
+               if r.get("valid") is not True}
+        return {"valid": valid,
+                "key-count": len(frontiers),
+                "results": per_key,
+                "failures": sorted(bad, key=repr),
+                **meta}
+    f = frontiers.get(None)
+    if f is None:
+        # No client ops ever reached the frontier: an empty history is
+        # vacuously linearizable, same as the cold checker's answer.
+        return {"valid": True, **meta}
+    return {**f.verdict(), **meta}
+
+
+def _resume_compose(test, checker, history: History, monitor,
+                    opts=None) -> Optional[Dict[str, Any]]:
+    """Resume a Compose: the monitored child resumes from frontier state,
+    every sibling runs its normal cold check concurrently, and the merge
+    is exactly ``Compose.check``'s (merge_valid over children, crashed
+    children surfaced under ``errors``).  None — whole compose goes
+    cold — when no child resumes, so a partially-resumed compose can
+    never diverge from the cold verdict."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from jepsen_tpu.checker.core import check_safe
+    from jepsen_tpu.monitor import Monitor
+
+    # Mirror Monitor._monitorable's selection: the monitor was built from
+    # the first child (dict order, depth-first) with a monitorable spec.
+    target = next((n for n, c in checker.checkers.items()
+                   if Monitor._monitorable(c) is not None), None)
+    if target is None:
+        return None
+    resumed = resume_final_check(test, checker.checkers[target], history,
+                                 monitor, opts)
+    if resumed is None:
+        return None
+    opts = dict(opts or {})
+    if checker.budget_s is not None and "budget_s" not in opts:
+        opts["budget_s"] = checker.budget_s
+    rest = [n for n in checker.checkers if n != target]
+    results = {}
+    if rest:
+        with ThreadPoolExecutor(max_workers=len(rest)) as ex:
+            futs = {n: ex.submit(check_safe, checker.checkers[n], test,
+                                 history, opts)
+                    for n in rest}
+            results = {n: f.result() for n, f in futs.items()}
+    results[target] = resumed
+    out = {"valid": merge_valid([r.get("valid")
+                                 for r in results.values()]),
+           **{n: results[n] for n in checker.checkers},
+           "analyzer": "monitor-resume",
+           "monitored-child": target,
+           "resumed-from-epoch": resumed.get("resumed-from-epoch"),
+           "ops-rechecked": resumed.get("ops-rechecked"),
+           "tail-ops": resumed.get("tail-ops")}
+    crashed = {n: r["traceback"] for n, r in results.items()
+               if r.get("valid") == UNKNOWN and "traceback" in r}
+    if crashed:
+        out["errors"] = crashed
+    return out
+
+
+def _checker_matches(checker, monitor) -> bool:
+    """The resumed verdict only stands in for checkers whose cold path is
+    exactly the search the frontier ran: a bare Linearizable (host model)
+    or an IndependentChecker around one, matching the monitor's per-key
+    mode (Compose routes through :func:`_resume_compose` before reaching
+    here).  Everything else goes cold."""
+    from jepsen_tpu.checker.linearizable import Linearizable
+    from jepsen_tpu.independent import IndependentChecker
+    if isinstance(checker, IndependentChecker):
+        return monitor.independent \
+            and isinstance(checker.inner, Linearizable) \
+            and checker.inner._cpu_model() is not None
+    if isinstance(checker, Linearizable):
+        return not monitor.independent \
+            and checker._cpu_model() is not None
+    return False

@@ -1,0 +1,78 @@
+"""The op tap: a bounded, non-blocking ring buffer on the run's op stream.
+
+The interpreter's scheduler loop calls :meth:`OpTap.offer` for every op it
+appends to the history (invocations and completions alike).  The contract
+is one-sided by design: **the run never blocks on the monitor**.  ``offer``
+takes one short lock, appends, and returns — no allocation beyond the
+deque node, no waiting, no exceptions escaping into the scheduler.  The
+monitor's flusher thread drains the buffer on its own cadence.
+
+When the flusher falls behind and the buffer fills, new ops are *dropped*
+(and counted) rather than stalling the run or evicting older ops — older
+ops are the ones the incremental frontier still needs, and a gap anywhere
+in the stream poisons the monitor's ability to refute (a refutation is
+only sound on a contiguous prefix).  The drop counter is therefore also
+the monitor's "refutations disabled" signal: any drop makes the verdict
+channel report ``unknown`` at worst, never ``false`` — the
+never-false-on-partial-state invariant starts here.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+from jepsen_tpu.history import Op
+
+DEFAULT_CAPACITY = 1 << 16
+
+
+class OpTap:
+    """Bounded MPSC op buffer between the run and the monitor flusher."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._buf: deque = deque()
+        self._lock = threading.Lock()
+        self.offered = 0
+        self.dropped = 0
+        self._wake: Optional[threading.Event] = None
+        self._wake_at = self.capacity  # backlog that triggers a wake
+
+    def bind_wake(self, event: threading.Event, backlog: int) -> None:
+        """Ask the tap to set ``event`` once the backlog reaches
+        ``backlog`` ops (the monitor's epoch size), so the flusher wakes
+        on data rather than polling a short timer."""
+        self._wake = event
+        self._wake_at = max(1, int(backlog))
+
+    def offer(self, op: Op) -> bool:
+        """Append one op; False (and a counted drop) when full.  Never
+        blocks, never raises."""
+        with self._lock:
+            self.offered += 1
+            if len(self._buf) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._buf.append(op)
+            backlog = len(self._buf)
+        if self._wake is not None and backlog >= self._wake_at:
+            self._wake.set()
+        return True
+
+    def drain(self) -> List[Op]:
+        """Take everything buffered, in offer order."""
+        with self._lock:
+            out = list(self._buf)
+            self._buf.clear()
+        return out
+
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"offered": self.offered, "dropped": self.dropped,
+                    "backlog": len(self._buf), "capacity": self.capacity}

@@ -1,0 +1,127 @@
+"""The early-refutation channel: confirm, record, snapshot, maybe abort.
+
+A monitor epoch that refutes hands its result here.  The channel:
+
+1. **confirms** the refutation when a serve.CheckService is attached —
+   the refuted key's consumed prefix is re-submitted through the service
+   lanes, so the device engine independently re-derives the verdict
+   before anything irreversible (an abort) happens.  Without a service,
+   a WGL frontier refutation is already the host oracle's own verdict
+   (the frontier *is* wgl_cpu's search) and counts as confirmed; elle
+   epoch results already came through an engine.  A disagreeing
+   confirmation leaves the finding recorded as *unconfirmed* and never
+   fires the abort — the never-false-on-partial-state invariant applies
+   to the run-control side effects too.
+2. **records** the refuting op index and result, exposed on the monitor
+   status (web ``/monitor``) and in the resume checkpoint.
+3. **snapshots** a ``monitor-refutation.json`` artifact into the run's
+   store directory via the atomic writers (a torn write must never
+   shadow a complete refutation record).
+4. optionally signals the interpreter to **abort** the run
+   (``monitor_abort`` test opt): the generator is cut, outstanding ops
+   drain, and the run proceeds straight to the authoritative check.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("jepsen.monitor")
+
+
+class VerdictChannel:
+    def __init__(self, abort: bool = False,
+                 store_dir: Optional[str] = None, service=None):
+        self.abort_enabled = abort
+        self.store_dir = store_dir
+        self.service = service
+        self.refuted = threading.Event()
+        self.verdict: Optional[Dict[str, Any]] = None
+        self.unconfirmed: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    # -- the refutation path ----------------------------------------------
+    def report(self, *, kind: str, key: Any, result: Dict[str, Any],
+               epoch: int, prefix=None, model=None) -> bool:
+        """Handle one epoch refutation; True if it was confirmed (and the
+        channel is now refuted).  ``prefix`` is the refuted key's consumed
+        op prefix (a History) for service confirmation, when available."""
+        with self._lock:
+            if self.verdict is not None:
+                return True  # already refuted; first finding stands
+        confirmed, confirmation = self._confirm(kind, result, prefix, model)
+        record = {
+            "kind": kind,
+            "key": key,
+            "epoch": epoch,
+            "op-index": result.get("op-index"),
+            "confirmed": confirmed,
+            "result": result,
+        }
+        if confirmation is not None:
+            record["confirmation"] = confirmation
+        with self._lock:
+            if self.verdict is not None:
+                return True
+            if not confirmed:
+                self.unconfirmed = record
+            else:
+                self.verdict = record
+                self.refuted.set()
+        self._snapshot(record)
+        if confirmed:
+            logger.error(
+                "monitor refuted the run at epoch %d (key=%r, op-index=%s)%s",
+                epoch, key, result.get("op-index"),
+                "; aborting generator" if self.abort_enabled else "")
+        else:
+            logger.warning(
+                "monitor found an UNCONFIRMED refutation at epoch %d "
+                "(key=%r); not aborting", epoch, key)
+        return confirmed
+
+    def _confirm(self, kind, result, prefix, model):
+        """Independent re-derivation through the service lanes (device
+        engine), when possible.  Unknown/crashed confirmations do not
+        veto: the host refutation stands (the host frontier is the
+        oracle); only a definite ``valid=True`` disagreement blocks."""
+        if self.service is None or prefix is None or kind != "wgl":
+            return True, None
+        try:
+            res = self.service.check(prefix, kind="wgl", model=model,
+                                     timeout=60.0)
+        except Exception as e:  # noqa: BLE001 — service trouble never vetoes
+            return True, {"valid": "unknown", "error": str(e)}
+        if res.get("valid") is True:
+            return False, res
+        return True, res
+
+    # -- run control ------------------------------------------------------
+    def should_abort(self) -> bool:
+        return self.abort_enabled and self.refuted.is_set()
+
+    # -- artifacts --------------------------------------------------------
+    def _snapshot(self, record: Dict[str, Any]) -> None:
+        if not self.store_dir:
+            return
+        try:
+            from jepsen_tpu.atomic_io import atomic_write
+            path = os.path.join(self.store_dir, "monitor-refutation.json")
+            atomic_write(path, lambda f: json.dump(record, f, indent=2,
+                                                   default=str))
+        except Exception:  # noqa: BLE001 — artifacts never mask the run
+            logger.exception("writing monitor refutation snapshot")
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "refuted": self.refuted.is_set(),
+                "abort-enabled": self.abort_enabled,
+                "verdict": {k: v for k, v in (self.verdict or {}).items()
+                            if k != "result"} or None,
+                "unconfirmed": bool(self.unconfirmed),
+            }

@@ -75,6 +75,42 @@ def lane_bucket(n_lanes: int, cap: int = MAX_LANE_BUCKET) -> int:
     return min(pow2_at_least(max(1, n_lanes), 1), cap)
 
 
+#: floor / ceiling of the derived wgl start-capacity ladder
+MIN_WGL_CAPACITY = 64
+MAX_WGL_CAPACITY = 65536
+
+
+def wgl_start_capacity(ev_bucket: int, w_bucket: int) -> int:
+    """Derive the wgl engine's *starting* configuration capacity from the
+    bucket shape instead of a fixed knob.
+
+    The config frontier is bounded by (subsets of the pending window) x
+    (reachable model states); in practice it tracks the window width far
+    more than history length, so the ladder is quadratic in the width
+    bucket (w=8 -> 256, the old fixed default; w=16 -> 1024; w=32 ->
+    4096), hard-capped by both 2**w (the true subset bound for small
+    windows) and :data:`MAX_WGL_CAPACITY`.  Longer event streams do not
+    widen the frontier per step, so ``ev_bucket`` only nudges the floor
+    up for big histories (avoids one guaranteed escalation round-trip on
+    multi-thousand-op cells).
+
+    Crucially this is a pure function of the (ev, w) bucket, so the
+    derived capacity is constant per bucket and the compiled-engine
+    cache key stays stable — deriving from raw history shape would leak
+    the unbounded shape universe right back into the cache.
+
+    The ``JEPSEN_TPU_WGL_CAPACITY`` env var overrides the derivation
+    (resolved by the scheduler, not here), and per-request ``capacity``
+    engine opts override both.
+    """
+    cap = pow2_at_least(4 * w_bucket * w_bucket, MIN_WGL_CAPACITY)
+    if ev_bucket >= 4096:
+        cap *= 2
+    if w_bucket < 16:
+        cap = min(cap, 2 ** w_bucket)
+    return max(MIN_WGL_CAPACITY, min(cap, MAX_WGL_CAPACITY))
+
+
 def wgl_bucket(h: History) -> Tuple[int, int]:
     return (events_bucket(h), width_bucket(h))
 

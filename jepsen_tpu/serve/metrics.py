@@ -19,8 +19,20 @@ document (docs/serving.md) and assert on in the smoke test:
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+
+def mono_now() -> float:
+    """The shared monotonic clock for cross-subsystem timelines.
+
+    Request trace spans, scheduler aging, and monitor epochs all stamp
+    times off this one helper, so a span at t=1.2s in a request trace and
+    a monitor epoch at t=1.2s in the same ``/metrics`` snapshot refer to
+    the same instant — timelines are directly comparable instead of each
+    subsystem free-running its own ``time.monotonic()`` call sites."""
+    return time.monotonic()
 
 
 class Metrics:

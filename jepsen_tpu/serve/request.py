@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.history import History
+from jepsen_tpu.serve.metrics import mono_now
 
 _ids = itertools.count(1)
 
@@ -41,7 +41,7 @@ class Request:
         self.history = history
         self.kind = kind
         self.spec = spec            # kind-specific engine options
-        self.submitted = time.monotonic()
+        self.submitted = mono_now()
         self.deadline = (self.submitted + deadline_s
                          if deadline_s is not None else None)
         self.cells: List["Cell"] = []
@@ -55,15 +55,15 @@ class Request:
     def span(self, name: str) -> None:
         """Record a trace span (relative seconds since submit)."""
         self.spans.append({"span": name,
-                           "t": round(time.monotonic() - self.submitted, 6)})
+                           "t": round(mono_now() - self.submitted, 6)})
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline is None:
             return None
-        return self.deadline - time.monotonic()
+        return self.deadline - mono_now()
 
     def expired(self) -> bool:
-        return self.deadline is not None and time.monotonic() > self.deadline
+        return self.deadline is not None and mono_now() > self.deadline
 
     # -- completion -------------------------------------------------------
     def cell_done(self) -> bool:
@@ -100,6 +100,7 @@ class Cell:
     seq: int = 0                    # global admission order (FIFO tiebreak)
     bucket: Tuple = ()              # (kind, engine-identity, shape buckets)
     result: Optional[Dict[str, Any]] = field(default=None)
+    enqueued: float = 0.0           # mono_now() at admission (aging clock)
 
     def sort_key(self) -> Tuple[float, int]:
         """Deadline-first priority, FIFO within a deadline class."""

@@ -27,26 +27,35 @@ Guarantees:
 from __future__ import annotations
 
 import logging
+import os
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
+from jepsen_tpu.serve.metrics import mono_now
 from jepsen_tpu.serve.request import Cell, KIND_ELLE, KIND_WGL
 
 log = logging.getLogger("jepsen.serve")
 
+#: a bucket whose head cell has queued this long outranks deadline order
+DEFAULT_AGE_S = 5.0
+
 
 class Scheduler:
     def __init__(self, metrics, mesh=None, max_lanes: int = 64,
-                 capacity: int = 256, max_capacity: int = 65536):
+                 capacity: Optional[int] = None, max_capacity: int = 65536,
+                 age_s: Optional[float] = DEFAULT_AGE_S):
         self.metrics = metrics
         self.mesh = mesh
         self.max_lanes = max(1, min(max_lanes, buckets.MAX_LANE_BUCKET))
+        # None = derive the start capacity from each dispatch's bucket
+        # shape (buckets.wgl_start_capacity); an int pins the old fixed
+        # knob for every dispatch.
         self.capacity = capacity
         self.max_capacity = max_capacity
+        self.age_s = age_s
         self._groups: Dict[Tuple, deque] = {}
         self._depth = 0
         self._seq = 0               # admission order (FIFO tiebreak)
@@ -68,14 +77,14 @@ class Scheduler:
               timeout: Optional[float]) -> bool:
         """Admit a request's cells (all or nothing).  Blocks while the
         queue is above ``max_depth`` (backpressure); False = rejected."""
-        deadline = (time.monotonic() + timeout) if timeout is not None \
+        deadline = (mono_now() + timeout) if timeout is not None \
             else None
         with self._cond:
             while not self._stop and self._depth + len(cells) > max_depth:
                 if not block:
                     return False
                 rem = None if deadline is None \
-                    else deadline - time.monotonic()
+                    else deadline - mono_now()
                 if rem is not None and rem <= 0:
                     return False
                 if not self._cond.wait(timeout=rem if rem is not None
@@ -83,8 +92,10 @@ class Scheduler:
                     return False
             if self._stop:
                 return False
+            t_in = mono_now()
             for c in cells:
                 c.seq = self._seq = self._seq + 1
+                c.enqueued = t_in
                 self._groups.setdefault(c.bucket, deque()).append(c)
             self._depth += len(cells)
             self._cond.notify_all()
@@ -95,12 +106,12 @@ class Scheduler:
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until the queue is empty and no dispatch is in flight."""
-        deadline = (time.monotonic() + timeout) if timeout is not None \
+        deadline = (mono_now() + timeout) if timeout is not None \
             else None
         with self._cond:
             while self._depth > 0 or self._inflight:
                 rem = None if deadline is None \
-                    else deadline - time.monotonic()
+                    else deadline - mono_now()
                 if rem is not None and rem <= 0:
                     return False
                 self._cond.wait(timeout=rem if rem is not None else 0.1)
@@ -120,16 +131,35 @@ class Scheduler:
 
     # -- the device loop --------------------------------------------------
     def _take_group(self) -> List[Cell]:
-        """Pop the most urgent bucket's head cells (up to max_lanes)."""
+        """Pop the most urgent bucket's head cells (up to max_lanes).
+
+        Deadline-first with aging: the plain pick is the earliest
+        (deadline, seq) head, but a steady stream of near-deadline cells
+        could then starve a far-deadline bucket forever — its compiled
+        engine goes cold and the eventual dispatch pays a recompile.  So
+        any bucket whose head has been queued longer than ``age_s``
+        enters an aged tier that outranks deadline order (oldest wait
+        first); picks decided by the aged tier are counted as
+        ``aged_picks`` in the metrics snapshot."""
         best = None
+        aged = None
+        now = mono_now()
         for key, dq in self._groups.items():
             if not dq:
                 continue
             k = dq[0].sort_key()
             if best is None or k < best[0]:
                 best = (k, key)
+            if self.age_s is not None:
+                waited = now - dq[0].enqueued
+                if waited >= self.age_s and (aged is None
+                                             or waited > aged[0]):
+                    aged = (waited, key)
         if best is None:
             return []
+        if aged is not None and aged[1] != best[1]:
+            best = (None, aged[1])
+            self.metrics.inc("aged_picks")
         dq = self._groups[best[1]]
         out = []
         while dq and len(out) < self.max_lanes:
@@ -177,7 +207,7 @@ class Scheduler:
             return
         for c in live:
             c.request.span("pack")
-        t0 = time.monotonic()
+        t0 = mono_now()
         lanes = [c.history for c in live]
         pad = buckets.lane_bucket(len(lanes), self.max_lanes)
         padded = lanes + [lanes[0]] * (pad - len(lanes))
@@ -194,17 +224,35 @@ class Scheduler:
                         "for %d cell(s)", type(e).__name__, e, len(live))
             self.metrics.inc("host-fallbacks", len(live))
             rs = self._host_fallback(live, e)
-        self.metrics.dispatch(len(live), pad, time.monotonic() - t0)
+        self.metrics.dispatch(len(live), pad, mono_now() - t0)
         for c, r in zip(live, rs):
             self._finalize(c, r)
+
+    def _start_capacity(self, live: List[Cell], ev_bucket: int,
+                        w_bucket: int) -> int:
+        """Resolve the wgl start capacity: per-request ``capacity`` engine
+        opts win, then the ``JEPSEN_TPU_WGL_CAPACITY`` env override, then
+        a service-level fixed knob, then the bucket-shape derivation
+        (buckets.wgl_start_capacity — the default).  Overflowing lanes
+        still escalate automatically, so this only sets where the ladder
+        starts."""
+        explicit = [int(s.request.spec["capacity"]) for s in live
+                    if s.request.spec.get("capacity") is not None]
+        if explicit:
+            return max(explicit)
+        env = os.environ.get("JEPSEN_TPU_WGL_CAPACITY")
+        if env:
+            return max(1, int(env))
+        if self.capacity is not None:
+            return int(self.capacity)
+        return buckets.wgl_start_capacity(ev_bucket, w_bucket)
 
     def _dispatch_wgl(self, live: List[Cell],
                       padded: List[Any]) -> List[Dict[str, Any]]:
         from jepsen_tpu.parallel.batch import _batch_chunk, check_batch
         spec0 = live[0].request.spec
         _, _, ev_bucket, w_bucket = live[0].bucket
-        cap = max(int(s.request.spec.get("capacity", self.capacity))
-                  for s in live)
+        cap = self._start_capacity(live, ev_bucket, w_bucket)
         max_cap = max(int(s.request.spec.get("max_capacity",
                                              self.max_capacity))
                       for s in live)

@@ -68,17 +68,23 @@ class CheckService:
                  default_deadline_s: Optional[float] = None,
                  store_base: Optional[str] = None,
                  mesh=None,
-                 capacity: int = 256,
-                 max_capacity: int = 65536):
+                 capacity: Optional[int] = None,
+                 max_capacity: int = 65536,
+                 age_s: Optional[float] = None):
         # Shared init: repeated service processes skip XLA compiles.
         from jepsen_tpu.ops.cache import init_compilation_cache
+        from jepsen_tpu.serve.scheduler import DEFAULT_AGE_S
         init_compilation_cache(store_base)
         self.max_queue_cells = max_queue_cells
         self.default_deadline_s = default_deadline_s
         self.metrics = Metrics()
+        # capacity None = per-bucket derived wgl start capacity (see
+        # buckets.wgl_start_capacity; JEPSEN_TPU_WGL_CAPACITY overrides)
         self._sched = Scheduler(self.metrics, mesh=mesh,
                                 max_lanes=max_lanes, capacity=capacity,
-                                max_capacity=max_capacity)
+                                max_capacity=max_capacity,
+                                age_s=age_s if age_s is not None
+                                else DEFAULT_AGE_S)
         self._closed = False
         self._lock = threading.Lock()
         self._submitted = 0

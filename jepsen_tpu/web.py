@@ -108,6 +108,11 @@ def make_handler(base: str, service=None):
                 if service is None:
                     return self._send(503, b"no checking service attached")
                 return self._send(200, _queue_html(service).encode())
+            if path == "/monitor":
+                # Live + recent run monitors (jepsen_tpu.monitor): lazy
+                # import so the browser never drags the checker stack in.
+                from jepsen_tpu.monitor import active_statuses
+                return self._send_json(200, {"monitors": active_statuses()})
             if path.startswith("/files/"):
                 return self._files(path[len("/files/"):])
             if path.startswith("/zip/"):

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Monitor smoke: online refutation latency + resumed-final-check parity.
+
+Two legs on the CPU backend, both over 5k-invocation synthetic
+cas-register runs (~10k history entries), streamed op-by-op through the
+monitor exactly as the interpreter's tap would deliver them:
+
+  1. **Corrupted leg** — one read near op 1k is corrupted
+     (``corrupt_reads(within=0.2)``).  The stream is cut the moment the
+     monitor's verdict channel confirms the refutation; asserts the
+     refutation lands before the stream ends and within 2 epochs of the
+     epoch containing the faulty op.
+  2. **Clean leg** — the full stream flushes on the epoch cadence, then
+     the final check *resumes* from monitor state.  Asserts the resumed
+     verdict is identical to the cold offline ``wgl_cpu.check`` (same
+     validity, same ``configs-explored`` — the frontier is the same
+     search) while re-checking only the ops after the last monitor epoch
+     (``ops-rechecked`` strictly below the run's total).
+
+Writes the full monitor metrics report to argv[1] (default
+/tmp/monitor_metrics.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.checker import wgl_cpu  # noqa: E402
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.history import OK, History  # noqa: E402
+from jepsen_tpu.models import CASRegister  # noqa: E402
+from jepsen_tpu.monitor import Monitor  # noqa: E402
+from jepsen_tpu.monitor import resume as mon_resume  # noqa: E402
+from jepsen_tpu.synth import cas_register_history  # noqa: E402
+
+N_OPS = 5000
+EPOCH_OPS = 256
+FAULT_AT = 1000  # first ok-read at or after this index gets corrupted
+
+
+def corrupted_leg():
+    ops = [o.with_() for o in cas_register_history(N_OPS, concurrency=4,
+                                                   seed=0)]
+    i = next(j for j, o in enumerate(ops)
+             if j >= FAULT_AT and o.type == OK and o.f == "read")
+    ops[i] = ops[i].with_(value=9999)  # never a current register value
+    h = History(ops, reindex=True)
+    m = Monitor(kind="wgl", model=CASRegister(), abort=True,
+                epoch_ops=EPOCH_OPS)
+    t0 = time.perf_counter()
+    consumed = len(h)
+    for i, op in enumerate(h):
+        m.offer(op)
+        if (i + 1) % EPOCH_OPS == 0:
+            m.flush()
+        if m.should_abort():
+            consumed = i + 1
+            break
+    wall = time.perf_counter() - t0
+    st = m.channel.status()
+    verdict = st["verdict"] or {}
+    op_index = verdict.get("op-index")
+    refuted_epoch = verdict.get("epoch")
+    # the epoch whose flush first covered the faulty op
+    faulty_epoch = (op_index // EPOCH_OPS) + 1 if op_index is not None \
+        else None
+    m.close()
+    return {
+        "ops": len(h),
+        "consumed-ops": consumed,
+        "refuted": st["refuted"],
+        "op-index": op_index,
+        "refuted-epoch": refuted_epoch,
+        "faulty-op-epoch": faulty_epoch,
+        "epochs-behind": (refuted_epoch - faulty_epoch
+                          if refuted_epoch is not None
+                          and faulty_epoch is not None else None),
+        "wall-s": round(wall, 3),
+        "monitor": m.status(),
+    }
+
+
+def clean_leg():
+    h = cas_register_history(N_OPS, concurrency=4, seed=2)
+    m = Monitor(kind="wgl", model=CASRegister(), epoch_ops=EPOCH_OPS)
+    t0 = time.perf_counter()
+    for i, op in enumerate(h):
+        m.offer(op)
+        if (i + 1) % EPOCH_OPS == 0:
+            m.flush()
+    stream_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    checker = Linearizable(CASRegister(), algorithm="cpu")
+    resumed = mon_resume.resume_final_check({}, checker,
+                                            History(list(h)), m)
+    resume_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    cold = wgl_cpu.check(CASRegister(), h)
+    cold_wall = time.perf_counter() - t0
+
+    total_checked = m.engine.counters()["ops-checked"]
+    m.close()
+    return {
+        "ops": len(h),
+        "epochs": len(m.epochs),
+        "resumed": {k: resumed[k] for k in
+                    ("valid", "analyzer", "resumed-from-epoch",
+                     "ops-rechecked", "tail-ops", "configs-explored")},
+        "cold": {"valid": cold["valid"],
+                 "configs-explored": cold["configs-explored"]},
+        "ops-checked-total": total_checked,
+        "stream-wall-s": round(stream_wall, 3),
+        "resume-wall-s": round(resume_wall, 3),
+        "cold-wall-s": round(cold_wall, 3),
+        "monitor": m.status(),
+    }
+
+
+def main():
+    dump = sys.argv[1] if len(sys.argv) > 1 else "/tmp/monitor_metrics.json"
+    corrupted = corrupted_leg()
+    clean = clean_leg()
+    report = {"epoch-ops": EPOCH_OPS, "corrupted": corrupted,
+              "clean": clean}
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps({"corrupted": {k: corrupted[k] for k in
+                                    ("ops", "consumed-ops", "refuted",
+                                     "op-index", "epochs-behind")},
+                      "clean": {"valid": clean["resumed"]["valid"],
+                                "ops-rechecked":
+                                    clean["resumed"]["ops-rechecked"],
+                                "ops-checked-total":
+                                    clean["ops-checked-total"]}}))
+
+    # -- corrupted leg: early, accurate refutation ------------------------
+    assert corrupted["refuted"], "monitor never refuted the corrupted run"
+    assert corrupted["consumed-ops"] < corrupted["ops"], \
+        "refutation must land before the stream ends"
+    assert corrupted["op-index"] is not None
+    assert corrupted["epochs-behind"] is not None \
+        and corrupted["epochs-behind"] <= 2, \
+        f"refutation lagged {corrupted['epochs-behind']} epochs behind " \
+        f"the faulty op"
+
+    # -- clean leg: resumed verdict == cold verdict, tail-only work -------
+    r, c = clean["resumed"], clean["cold"]
+    assert r["valid"] is True and c["valid"] is True
+    assert r["analyzer"] == "monitor-resume"
+    assert r["configs-explored"] == c["configs-explored"], \
+        "resumed search must explore exactly the cold search's configs"
+    assert r["resumed-from-epoch"] > 0
+    assert 0 <= r["ops-rechecked"] < clean["ops-checked-total"], \
+        "the resumed check must re-check only the post-epoch tail"
+
+    print(f"monitor smoke OK: refuted at op {corrupted['op-index']} "
+          f"after {corrupted['consumed-ops']}/{corrupted['ops']} ops "
+          f"({corrupted['epochs-behind']} epoch(s) behind the fault); "
+          f"clean resume re-checked {r['ops-rechecked']}/"
+          f"{clean['ops-checked-total']} ops, parity exact; "
+          f"metrics dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
