@@ -139,6 +139,7 @@ def check(model: Model, history: History,
 
     named = deepest_stuck if deepest_stuck >= 0 else deepest_e
     bad = ret_op[named] if named >= 0 else None
+    # witness: DFS exhausted with no linearization; deepest stuck op rides
     return {"valid": False, "analyzer": "linear-cpu",
             "op": bad.to_dict() if bad is not None else None,
             "states-explored": len(visited),

@@ -55,6 +55,7 @@ from jax import lax
 from jepsen_tpu.checker.prep import (
     EV_ENTER, EV_RETURN, PreparedHistory, WindowOverflow, prepare,
 )
+from jepsen_tpu.clock import mono_now
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
 from jepsen_tpu.ops import dedup as _dedup
@@ -986,7 +987,7 @@ def check(model: JaxModel, history: Optional[History] = None,
     inflight: deque = deque()  # (pos, carry_before, carry_after, flags)
     pos = 0
     trace = bool(_os.environ.get("JTPU_TRACE"))
-    t_last = _time.time() if trace else 0.0
+    t_last = mono_now() if trace else 0.0
     # n_events >= 512 always, so the loop pops at least once and failed/
     # overflow/carry are always (re)assigned before use below.
     while True:
@@ -1008,7 +1009,7 @@ def check(model: JaxModel, history: Optional[History] = None,
         peak = int(fl[2])
         consumed = int(fl[3])
         if trace:
-            now = _time.time()
+            now = mono_now()
             print(f"[wgl] pos={cpos} cap={cap} peak={peak} "
                   f"consumed={consumed}/{cur_chunk} ovf={int(overflow)} "
                   f"dt={now - t_last:.3f}", file=_sys.stderr, flush=True)
@@ -1091,6 +1092,7 @@ def check(model: JaxModel, history: Optional[History] = None,
                 "window": p.window, "capacity": cap,
                 "max-capacity-reached": max_cap_reached}
     failed_op = p.ops[int(carry[7])]
+    # witness: device frontier emptied on a RETURN; refuting op attached
     res: Dict[str, Any] = {"valid": False, "analyzer": "wgl-tpu",
                            "op": failed_op.to_dict(),
                            "configs-explored": explored,

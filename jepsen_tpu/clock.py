@@ -1,0 +1,27 @@
+"""The shared monotonic clock for every interval, deadline, and timeline.
+
+One helper, one clock: request trace spans, scheduler aging, monitor
+epochs, control-plane retry deadlines, and engine trace intervals all
+stamp times off :func:`mono_now`, so a span at t=1.2s in a request trace
+and a monitor epoch at t=1.2s in the same ``/metrics`` snapshot refer to
+the same instant — timelines are directly comparable instead of each
+subsystem free-running its own ``time.monotonic()`` call sites.
+
+Discipline (enforced by the CONC01 lint rule, see
+docs/static_analysis.md): ``time.time()`` is *wall* clock — NTP steps,
+leap smears, and operator ``date`` calls move it in either direction, so
+an interval or deadline computed from it can fire early, late, or never.
+Inside ``jepsen_tpu/`` every interval/deadline uses :func:`mono_now`;
+wall clock is reserved for user-facing timestamps (artifact metadata,
+log lines) and those sites carry an explicit
+``# lint: disable=CONC01(...)`` pragma.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+
+def mono_now() -> float:
+    """Seconds on the process-wide monotonic clock (never steps back)."""
+    return _time.monotonic()

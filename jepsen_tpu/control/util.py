@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from jepsen_tpu.clock import mono_now
 from jepsen_tpu.control import Lit, RemoteCommandFailed, Session
 
 
@@ -21,8 +22,8 @@ def exists(s: Session, path: str) -> bool:
 def await_tcp_port(s: Session, port: int, timeout_s: float = 60,
                    interval_s: float = 0.5) -> None:
     """Block until something listens on ``port`` (util.clj:14)."""
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = mono_now() + timeout_s
+    while mono_now() < deadline:
         if s.exec_result("bash", "-c",
                          f"exec 3<>/dev/tcp/localhost/{port}").ok:
             return
@@ -195,8 +196,8 @@ def stop_daemon(s: Session, pidfile: str, timeout_s: float = 10) -> None:
     script = (f"if [ -f {pidfile} ]; then pid=$(cat {pidfile}); "
               + group_kill.format(sig="TERM") + "; fi")
     s.exec("bash", "-c", script)
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = mono_now() + timeout_s
+    while mono_now() < deadline:
         if not daemon_running(s, pidfile):
             break
         time.sleep(0.25)

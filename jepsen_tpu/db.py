@@ -112,8 +112,10 @@ class TcpdumpDB(DB, LogFiles):
             "bash", "-c",
             f"[ -f {self.DIR}/pid ] && kill -INT $(cat {self.DIR}/pid)")
         import time as _time
-        deadline = _time.time() + 5
-        while (_time.time() < deadline
+
+        from jepsen_tpu.clock import mono_now
+        deadline = mono_now() + 5
+        while (mono_now() < deadline
                and cu.daemon_running(s, f"{self.DIR}/pid")):
             _time.sleep(0.05)
         cu.stop_daemon(s, f"{self.DIR}/pid")

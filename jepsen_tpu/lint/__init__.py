@@ -1,0 +1,38 @@
+"""Soundness & device-discipline static analysis for jepsen_tpu.
+
+Two tiers prove at CI time the invariants the rest of the stack merely
+promises in docstrings (rule catalog: docs/static_analysis.md):
+
+- the **AST tier** (:mod:`.ast_lint` + :mod:`.rules`) — SOUND01 (verdicts
+  never flip valid -> false without a witness), DEV01 (no host syncs or
+  data-dependent Python in jit-traced engine code), SHAPE01 (serve/
+  engine-entry shapes derive from the bucket ladder), CONC01 (monotonic
+  clock, lock-order manifest, no blocking I/O under a lock);
+- the **trace tier** (:mod:`.jaxpr_lint`) — traces the real engines with
+  ``jax.make_jaxpr`` and proves no callback/transfer primitives survive
+  jit (TRACE01) and the compiled-signature universe equals the bucket
+  ladder (TRACE02).
+
+Escape valves: inline ``# lint: disable=RULE(reason)`` pragmas and the
+committed ledger ``jepsen_tpu/lint/baseline.json`` (see
+:mod:`.findings`).  Entry point: ``scripts/lint.py``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from jepsen_tpu.lint.ast_lint import run_ast_tier
+from jepsen_tpu.lint.findings import (Baseline, Finding,  # noqa: F401
+                                      apply_pragmas)
+
+
+def run_all(root: Optional[str] = None, trace: bool = True,
+            baseline: Optional[Baseline] = None) -> List[Finding]:
+    """Both tiers; findings come back with ``baselined`` marked."""
+    findings, _ = run_ast_tier(root)
+    if trace:
+        from jepsen_tpu.lint.jaxpr_lint import run_trace_tier
+        findings.extend(run_trace_tier())
+    baseline = baseline if baseline is not None else Baseline.load()
+    return baseline.mark(findings)

@@ -1,0 +1,74 @@
+"""The AST tier driver: parse each in-scope module once, run every
+applicable rule, honor pragmas.
+
+Rules live in :mod:`jepsen_tpu.lint.rules` (one invariant per module);
+this driver only handles file discovery, parsing, and suppression.  A
+file that fails to parse yields a ``PARSE`` finding rather than crashing
+the analyzer — a syntax error must fail lint, not hide it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from jepsen_tpu.lint.findings import Finding, apply_pragmas
+from jepsen_tpu.lint.rules import all_rules, in_scope
+
+
+def repo_root() -> str:
+    """The directory containing the ``jepsen_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _iter_py_files(root: str) -> List[str]:
+    out = []
+    pkg = os.path.join(root, "jepsen_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                out.append(rel.replace(os.sep, "/"))
+    return out
+
+
+def run_ast_tier(root: Optional[str] = None,
+                 files: Optional[Dict[str, str]] = None,
+                 ) -> Tuple[List[Finding], Dict[str, List[str]]]:
+    """Run every AST rule over its scope.
+
+    ``files`` (repo-relative path -> source text) overrides disk
+    discovery — the test suite uses it to lint fixture sources under
+    paths inside each rule's scope.  Returns (post-pragma findings,
+    {path: source lines}).
+    """
+    root = root or repo_root()
+    rules = all_rules()
+    if files is None:
+        files = {}
+        for rel in _iter_py_files(root):
+            if any(in_scope(rel, r.SCOPE) for r in rules):
+                with open(os.path.join(root, rel)) as f:
+                    files[rel] = f.read()
+
+    findings: List[Finding] = []
+    sources: Dict[str, List[str]] = {}
+    for rel in sorted(files):
+        src = files[rel]
+        lines = src.splitlines()
+        sources[rel] = lines
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "PARSE", rel, e.lineno or 0,
+                f"file does not parse: {e.msg}",
+                hint="lint requires parseable sources"))
+            continue
+        for rule in rules:
+            if in_scope(rel, rule.SCOPE):
+                findings.extend(rule.check(tree, lines, rel))
+    return apply_pragmas(findings, sources), sources

@@ -1,0 +1,132 @@
+"""Finding/baseline/pragma framework shared by both lint tiers.
+
+A *finding* is one rule violation at one source location, carrying a fix
+hint.  Two escape valves keep the analyzer deployable on a living tree
+without ever silently losing a finding:
+
+- **pragmas** — ``# lint: disable=RULE(reason)`` on the offending line
+  (or the line above) suppresses that rule there, in the source, where
+  reviewers see the reason next to the code it excuses;
+- **baseline** — ``jepsen_tpu/lint/baseline.json`` is the committed
+  ledger of known legacy findings.  CI fails on any finding *not* in the
+  baseline, so new debt is impossible while old debt is burned down
+  explicitly (``scripts/lint.py --update-baseline`` rewrites it).
+
+Baseline entries match on (rule, path, message) — not line numbers — so
+unrelated edits above a legacy finding don't churn the ledger.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: ``# lint: disable=RULE`` / ``disable=RULE(reason), OTHER(reason)``
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,()\- .:'\"/]+)")
+_RULE_IN_PRAGMA_RE = re.compile(r"([A-Z][A-Z0-9]+)(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    """One rule violation: location, what broke, and how to fix it."""
+
+    rule: str
+    path: str           # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint,
+                "baselined": self.baselined}
+
+    def render(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        out = f"{self.path}:{self.line}: {self.rule}{tag}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+def pragma_rules(src_lines: List[str], line: int) -> Dict[str, str]:
+    """Rules disabled at 1-based ``line``: the line itself or the one
+    above may carry ``# lint: disable=RULE(reason)``.  Returns
+    {rule: reason}."""
+    out: Dict[str, str] = {}
+    for ln in (line - 1, line - 2):         # 0-based: same line, line above
+        if 0 <= ln < len(src_lines):
+            m = _PRAGMA_RE.search(src_lines[ln])
+            if m:
+                for rm in _RULE_IN_PRAGMA_RE.finditer(m.group(1)):
+                    out[rm.group(1)] = rm.group(2) or ""
+    return out
+
+
+def apply_pragmas(findings: Iterable[Finding],
+                  sources: Dict[str, List[str]]) -> List[Finding]:
+    """Drop findings whose location carries a matching disable pragma."""
+    out = []
+    for f in findings:
+        lines = sources.get(f.path)
+        if lines is not None and f.rule in pragma_rules(lines, f.line):
+            continue
+        out.append(f)
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class Baseline:
+    """The committed ledger of accepted legacy findings."""
+
+    entries: List[Dict[str, Any]] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "Baseline":
+        path = path or BASELINE_PATH
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            data = json.load(f)
+        return cls(entries=list(data.get("findings", [])))
+
+    def keys(self) -> set:
+        return {(e.get("rule"), e.get("path"), e.get("message"))
+                for e in self.entries}
+
+    def mark(self, findings: List[Finding]) -> List[Finding]:
+        """Set ``baselined`` on findings the ledger already accepts."""
+        known = self.keys()
+        for f in findings:
+            f.baselined = f.key() in known
+        return findings
+
+    @staticmethod
+    def write(findings: List[Finding], path: Optional[str] = None,
+              justification: str = "accepted as legacy debt") -> None:
+        path = path or BASELINE_PATH
+        data = {
+            "version": 1,
+            "comment": "Known legacy findings; every entry needs its own "
+                       "justification.  New findings fail CI regardless.",
+            "findings": [
+                {"rule": f.rule, "path": f.path, "message": f.message,
+                 "justification": justification}
+                for f in sorted(findings, key=lambda f: f.key())
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")

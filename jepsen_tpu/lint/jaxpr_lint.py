@@ -1,0 +1,211 @@
+"""The trace tier: lint the engines' *jaxprs*, not their source.
+
+The AST tier proves properties of the code we wrote; this tier proves
+properties of what XLA will actually compile.  Two checks:
+
+- **TRACE01 — no host round-trips in the compiled body.**  Each device
+  engine is traced with :func:`jax.make_jaxpr` over representative
+  bucket shapes and the resulting jaxpr (recursively, through
+  pjit/scan/cond sub-jaxprs) must contain no callback or infeed/outfeed
+  primitive.  A ``pure_callback`` smuggled into an engine by a future
+  refactor survives jit — it just makes every dispatch block on the
+  host — so source review alone cannot guarantee its absence.
+
+- **TRACE02 — the compiled-signature universe equals the bucket
+  ladder.**  For a synthetic spread of workload shapes (events, widths,
+  lane counts) the derived engine entry signature (window, capacity,
+  chunk, lane pad) must collapse to exactly the bucket ladder's image:
+  ``|signatures| <= |buckets|``.  A raw shape leaking into any
+  signature component makes the signature set grow with the sample set,
+  which is precisely the unbounded-compile-cache failure SHAPE01 guards
+  at the call-site level — this check proves it end-to-end through the
+  real derivation functions.
+
+Tracing is backend-independent (``make_jaxpr`` never compiles), so the
+tier runs fine under ``JAX_PLATFORMS=cpu`` in CI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Sequence, Tuple
+
+from jepsen_tpu.lint.findings import Finding
+
+RULE_CALLBACK = "TRACE01"
+RULE_LADDER = "TRACE02"
+
+#: primitives that force a device<->host transition inside compiled code.
+BANNED_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed",
+})
+
+#: synthetic workload spread: (n_events, width/concurrency, lanes).
+#: Deliberately off-bucket values — the point is that messy real-world
+#: shapes collapse onto the ladder.
+DEFAULT_SAMPLES: Tuple[Tuple[int, int, int], ...] = (
+    (5, 1, 1), (63, 2, 2), (64, 2, 3), (65, 3, 4), (100, 5, 7),
+    (128, 8, 8), (129, 9, 17), (300, 11, 64), (511, 16, 100),
+    (1000, 24, 200), (4097, 33, 513),
+)
+
+
+# -- jaxpr walking -----------------------------------------------------------
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    """Jaxprs nested inside one eqn-params value (ClosedJaxpr, Jaxpr, or
+    lists/tuples of either)."""
+    if hasattr(value, "jaxpr"):               # ClosedJaxpr
+        value = value.jaxpr
+    if hasattr(value, "eqns"):
+        yield value
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every equation in ``jaxpr``, recursing through sub-jaxprs (pjit
+    bodies, scan/while/cond branches)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def check_jaxpr_clean(fn: Callable, args: Sequence[Any], label: str,
+                      path: str = "<trace>") -> List[Finding]:
+    """Trace ``fn(*args)`` and report every banned primitive in the
+    resulting jaxpr.  A trace *failure* is itself a finding: an engine
+    that no longer traces cannot ship."""
+    import jax
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 — any trace error is a finding
+        return [Finding(
+            RULE_CALLBACK, path, 0,
+            f"engine '{label}' failed to trace: {type(e).__name__}: {e}",
+            hint="the engine must stay traceable with make_jaxpr; see "
+                 "docs/static_analysis.md#trace-tier")]
+    out = []
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in BANNED_PRIMITIVES:
+            out.append(Finding(
+                RULE_CALLBACK, path, 0,
+                f"banned primitive '{name}' in traced engine '{label}': "
+                f"a host round-trip inside compiled code",
+                hint="engines must be pure device code; hoist the host "
+                     "interaction into the chunk driver"))
+    return out
+
+
+# -- the engines we trace ----------------------------------------------------
+
+def trace_engine_findings() -> List[Finding]:
+    """Trace the real device engines over representative bucket shapes."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checker.wgl_tpu import make_engine
+    from jepsen_tpu.elle_tpu.closure import lane_flags_fn
+    from jepsen_tpu.models import get_model
+
+    findings: List[Finding] = []
+    model = get_model("cas-register")
+
+    for single_round in (False, True):
+        carry0, _, run_chunk = make_engine(
+            model, window=8, capacity=64, gwords=1,
+            single_round_closure=single_round)
+        label = ("wgl-batch[single-round]" if single_round
+                 else "wgl[multi-round]")
+        events = jnp.zeros((64, 10), jnp.int32)
+        findings.extend(check_jaxpr_clean(
+            run_chunk, (carry0(), events), label,
+            path="jepsen_tpu/checker/wgl_tpu.py"))
+
+    for n_pad, realtime in ((32, False), (32, True), (64, False)):
+        fn = lane_flags_fn(n_pad, realtime)
+        b, e = 2, 64
+        args = (jnp.zeros((b, 3, e), jnp.int32),
+                jnp.zeros((b, 3, e), jnp.int32),
+                jnp.zeros((b, n_pad), jnp.int32),
+                jnp.zeros((b, n_pad), jnp.int32))
+        findings.extend(check_jaxpr_clean(
+            fn, args, f"elle-lane[n={n_pad},rt={realtime}]",
+            path="jepsen_tpu/elle_tpu/closure.py"))
+    return findings
+
+
+# -- ladder/signature stability ----------------------------------------------
+
+def signature_stability_findings(
+        samples: Iterable[Any],
+        derive_signature: Callable[[Any], Tuple],
+        derive_bucket: Callable[[Any], Tuple],
+        label: str, path: str = "<ladder>") -> List[Finding]:
+    """|signatures over samples| must not exceed |buckets over samples|:
+    every signature component is a pure function of the bucket, so a
+    larger signature set means a raw shape leaked into the derivation."""
+    samples = list(samples)
+    sigs = {derive_signature(s) for s in samples}
+    buckets = {derive_bucket(s) for s in samples}
+    if len(sigs) > len(buckets):
+        return [Finding(
+            RULE_LADDER, path, 0,
+            f"{label}: {len(sigs)} distinct compiled signatures from "
+            f"{len(buckets)} buckets over {len(samples)} sample shapes "
+            f"— a raw shape is leaking into the engine signature",
+            hint="every signature component must be derived from the "
+                 "bucket (serve/buckets.py), never from the history")]
+    return []
+
+
+def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
+                    DEFAULT_SAMPLES) -> List[Finding]:
+    """Check the real serve-path derivations against the ladder."""
+    from jepsen_tpu.checker.wgl_tpu import _round_window
+    from jepsen_tpu.parallel.batch import _batch_chunk
+    from jepsen_tpu.serve import buckets
+
+    findings = []
+
+    def wgl_bucket(s):
+        e, w, l = s
+        # the numeric ladder under buckets.events_bucket/width_bucket
+        return (buckets.pow2_at_least(e, buckets.MIN_EVENTS_BUCKET),
+                buckets.pow2_at_least(w, buckets.MIN_WIDTH_BUCKET),
+                buckets.lane_bucket(l))
+
+    def wgl_signature(s):
+        eb, wb, lb = wgl_bucket(s)
+        # exactly what scheduler._dispatch_wgl hands the batch engine
+        return (_round_window(wb), buckets.wgl_start_capacity(eb, wb),
+                _batch_chunk(lb, eb), lb)
+
+    findings.extend(signature_stability_findings(
+        samples, wgl_signature, wgl_bucket, "wgl serve path",
+        path="jepsen_tpu/serve/scheduler.py"))
+
+    def elle_bucket(s):
+        return (buckets.pow2_at_least(max(1, s[0]), buckets.MIN_N_BUCKET),)
+
+    def elle_signature(s):
+        n = s[0]
+        # graphs.pack_group pads txn count to max(raw 32-multiple, floor);
+        # the bucket floor must dominate or the signature tracks raw n.
+        raw = max(32, -(-n // 32) * 32)
+        return (max(raw, elle_bucket(s)[0]),)
+
+    findings.extend(signature_stability_findings(
+        samples, elle_signature, elle_bucket, "elle serve path",
+        path="jepsen_tpu/serve/scheduler.py"))
+    return findings
+
+
+def run_trace_tier(trace_device: bool = True) -> List[Finding]:
+    findings = ladder_findings()
+    if trace_device:
+        findings.extend(trace_engine_findings())
+    return findings

@@ -1,0 +1,60 @@
+"""The declared lock-acquisition order for the threaded subsystems.
+
+serve/ and monitor/ are the two places where several threads (submitters,
+the scheduler device loop, the monitor flusher, web handlers) share
+state.  Deadlock freedom there rests on a total order: a thread holding
+lock L may only acquire locks strictly *later* in this manifest.  The
+CONC01 rule enforces the order syntactically — any ``with`` acquiring a
+declared lock lexically inside a ``with`` holding a later-or-equal one
+is a finding — so a PR that introduces an inversion fails CI instead of
+deadlocking a service under load.
+
+Each entry is ``(name, [(path_regex, expr_regex), ...])``: a ``with``
+item matches the entry when its file path matches ``path_regex`` and the
+unparsed context expression matches ``expr_regex``.  Level = position in
+the tuple (earlier = outermost-permitted).
+
+The declared order mirrors the call graph today:
+
+    service -> scheduler -> request -> metrics
+    monitor-flush -> monitor-registry -> verdict -> tap
+    engine-cache (leaf: parallel.batch's LRU, acquired under anything)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
+    ("service",
+     [(r"serve/service\.py$", r"^self\._lock$")]),
+    ("scheduler",
+     [(r"serve/scheduler\.py$", r"^self\._(lock|cond)$")]),
+    ("request",
+     [(r"serve/request\.py$", r"^self\._lock$"),
+      (r"", r"^(req|request)\._lock$"),
+      (r"", r"^(c|cell)\.request\._lock$")]),
+    ("metrics",
+     [(r"serve/metrics\.py$", r"^self\._lock$")]),
+    ("monitor-flush",
+     [(r"monitor/__init__\.py$", r"^self\._flush_lock$")]),
+    ("monitor-registry",
+     [(r"monitor/__init__\.py$", r"^_REG_LOCK$")]),
+    ("verdict",
+     [(r"monitor/verdict\.py$", r"^self\._lock$")]),
+    ("tap",
+     [(r"monitor/tap\.py$", r"^self\._lock$")]),
+    ("engine-cache",
+     [(r"parallel/batch\.py$", r"^self\._lock$")]),
+)
+
+
+def lock_level(path: str, expr: str) -> Optional[Tuple[int, str]]:
+    """(level, name) of the declared lock a with-item acquires, or None
+    when the expression is not a declared lock."""
+    for level, (name, patterns) in enumerate(LOCK_ORDER):
+        for path_re, expr_re in patterns:
+            if re.search(path_re, path) and re.match(expr_re, expr):
+                return level, name
+    return None

@@ -1,0 +1,84 @@
+"""Project-specific AST rules: one invariant per module.
+
+Each rule module exports:
+
+- ``RULE`` — the finding id (e.g. ``"SOUND01"``);
+- ``SCOPE`` — repo-relative path prefixes the rule audits;
+- ``check(tree, src_lines, path)`` — yields :class:`~jepsen_tpu.lint
+  .findings.Finding` for one parsed module.
+
+The catalog (rationale per rule lives in docs/static_analysis.md):
+
+- SOUND01 — verdicts may degrade valid -> unknown, never valid -> false,
+  so a literal ``valid: False`` is legal only at witness-bearing sites;
+- DEV01   — no host syncs or data-dependent Python branches inside
+  jit-traced engine code;
+- SHAPE01 — every engine-entry shape in serve/ derives from the bucket
+  ladder, never from raw history shape;
+- CONC01  — monotonic-clock discipline, lock-order manifest, no blocking
+  I/O while holding a lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+
+def in_scope(path: str, scope: Tuple[str, ...]) -> bool:
+    return any(path.startswith(p) for p in scope)
+
+
+def walk_with_parents(tree: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk, but every yielded node carries ``.parent``."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    return ast.walk(tree)
+
+
+def qualname_of(node: ast.AST) -> str:
+    """Dotted enclosing-scope name of a node (requires walk_with_parents
+    to have annotated parents)."""
+    parts: List[str] = []
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "parent", None)
+    return ".".join(reversed(parts)) or "<module>"
+
+
+def enclosing_handler(node: ast.AST) -> Optional[ast.ExceptHandler]:
+    """The nearest ``except`` handler lexically containing ``node``, not
+    crossing a function boundary (a nested def's body runs later, outside
+    the handler's dynamic extent)."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        if isinstance(cur, ast.ExceptHandler):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def names_in(node: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(node) if isinstance(n, ast.Name)]
+
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' otherwise."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def all_rules():
+    from jepsen_tpu.lint.rules import conc01, dev01, shape01, sound01
+    return (sound01, dev01, shape01, conc01)

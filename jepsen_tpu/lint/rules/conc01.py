@@ -1,0 +1,149 @@
+"""CONC01: clock discipline and lock discipline for the threaded layers.
+
+Three invariants, one rule:
+
+1. **Monotonic time.**  Every interval, deadline, and timeout in the
+   library uses ``jepsen_tpu.clock.mono_now`` (``time.monotonic``), never
+   ``time.time()``.  Wall clock steps under NTP adjustment — a deadline
+   computed from it can expire hours early or never, and a serve/
+   deadline that never expires wedges a batch slot forever.  Wall-clock
+   *timestamps* for humans are legal but must say so with a pragma:
+   ``# lint: disable=CONC01(user-facing wall clock)``.
+
+2. **Lock order.**  Acquiring a declared lock (see
+   :mod:`jepsen_tpu.lint.lock_order`) lexically inside a ``with`` that
+   holds a later-or-equal one is an inversion: two threads taking the
+   pair in opposite orders deadlock under load.  The check is syntactic
+   (lexical ``with`` nesting, not the dynamic call graph), which is
+   exactly the part a reviewer can't see across files.
+
+3. **No blocking I/O under a declared lock.**  ``time.sleep``,
+   ``subprocess``, sockets, HTTP, and ``open()`` inside a held declared
+   lock stall every thread queued on that lock (the scheduler cond, the
+   monitor flush) for the duration of the I/O.
+
+Nested ``def``s reset the held-lock context: their bodies run later,
+outside the ``with``'s dynamic extent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.lock_order import lock_level
+from jepsen_tpu.lint.rules import dotted, qualname_of, walk_with_parents
+
+RULE = "CONC01"
+
+SCOPE = ("jepsen_tpu/",)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+_BLOCKING_EXACT = {"time.sleep", "sleep", "os.system", "open",
+                   "socket.create_connection"}
+_BLOCKING_PREFIXES = ("subprocess.", "requests.", "urllib.")
+
+
+# -- wall-clock discipline ----------------------------------------------------
+
+def _wallclock_names(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of ``time``, local names bound to ``time.time``)."""
+    mods: Set[str] = set()
+    fns: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mods.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    fns.add(alias.asname or "time")
+    return mods, fns
+
+
+def _check_wallclock(tree: ast.Module, path: str) -> Iterator[Finding]:
+    mods, fns = _wallclock_names(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        parts = d.split(".")
+        if (len(parts) == 2 and parts[0] in mods and parts[1] == "time") \
+                or (len(parts) == 1 and d in fns):
+            yield Finding(
+                RULE, path, node.lineno,
+                f"`{d}()` in {qualname_of(node)}: wall clock is not "
+                f"monotonic — deadlines and intervals computed from it "
+                f"break under NTP steps",
+                hint="use jepsen_tpu.clock.mono_now() for intervals/"
+                     "deadlines; for a user-facing timestamp add "
+                     "`# lint: disable=CONC01(user-facing wall clock)`")
+
+
+# -- lock order + blocking I/O under lock ------------------------------------
+
+class _LockWalker:
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit(self, node: ast.AST,
+              held: List[Tuple[int, str, int]]) -> None:
+        if isinstance(node, _FN):
+            # a nested def's body runs outside the with's dynamic extent
+            for child in ast.iter_child_nodes(node):
+                self.visit(child, [])
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in node.items:
+                try:
+                    expr_s = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover - defensive
+                    expr_s = ""
+                lv = lock_level(self.path, expr_s)
+                if lv is None:
+                    continue
+                level, name = lv
+                for hlevel, hname, hline in new_held:
+                    if level <= hlevel:
+                        self.findings.append(Finding(
+                            RULE, self.path, item.context_expr.lineno,
+                            f"lock-order inversion: acquiring "
+                            f"'{name}' (level {level}) while holding "
+                            f"'{hname}' (level {hlevel}, line {hline})",
+                            hint="acquire locks in the manifest order "
+                                 "declared in jepsen_tpu/lint/"
+                                 "lock_order.py, or split the critical "
+                                 "section"))
+                new_held.append((level, name, item.context_expr.lineno))
+            for child in node.body:
+                self.visit(child, new_held)
+            return
+        if held and isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in _BLOCKING_EXACT \
+                    or any(d.startswith(p) for p in _BLOCKING_PREFIXES):
+                _, hname, _ = held[-1]
+                self.findings.append(Finding(
+                    RULE, self.path, node.lineno,
+                    f"blocking call `{d}(...)` while holding lock "
+                    f"'{hname}': every thread queued on the lock stalls "
+                    f"for the I/O",
+                    hint="move the I/O outside the critical section; "
+                         "snapshot state under the lock, write after "
+                         "releasing it"))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, held)
+
+
+def check(tree: ast.Module, src_lines: List[str],
+          path: str) -> Iterator[Finding]:
+    list(walk_with_parents(tree))            # annotate parents for qualnames
+    yield from _check_wallclock(tree, path)
+    walker = _LockWalker(path)
+    walker.visit(tree, [])
+    yield from walker.findings

@@ -1,0 +1,285 @@
+"""DEV01: no host-sync or recompile hazards inside jit-traced code.
+
+The device engines are compiled once per shape bucket and replayed
+thousands of times; anything inside a traced function that forces a
+host round-trip or a retrace silently turns a device-resident search
+into a device<->host ping-pong (or a compile storm) that only bench
+regressions reveal much later.  Hazards:
+
+- ``.item()`` / ``.tolist()`` — a blocking device->host transfer per
+  call, inside code that is supposed to stay on device;
+- ``float()/int()/bool()`` **on a traced value** — implicit
+  concretization: either a TracerError at trace time or, worse, a baked
+  constant when the value happens to be static at one call site;
+- ``np.*`` **on a traced value** — silently pulls the array to the host
+  (numpy has no tracer protocol);
+- ``if``/``while``/``for`` **on a traced value** — a data-dependent
+  Python branch: trace-time concretization, and a fresh compile per
+  taken path when it survives via static fallback.
+
+What counts as traced: a function referenced inside a ``jax.jit(...)``
+call in its module (``jax.jit(run_chunk)``, ``jax.jit(jax.vmap(lane))``),
+every def nested inside a traced def (scan/cond/switch bodies), and
+every lexically-visible def a traced body calls by name.  *Taint* then
+tracks tracer values: parameters of traced functions are tracers;
+assignments propagate; ``.shape/.ndim/.dtype``, ``len()``,
+``isinstance()``, and ``is (not) None`` tests are static and clear
+taint.  Engine-builder closure variables (``window``, ``capacity``,
+``realtime``) are static Python and stay untainted, so config branches
+like ``if single_round_closure:`` are — correctly — legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.rules import dotted, walk_with_parents
+
+RULE = "DEV01"
+
+SCOPE = (
+    "jepsen_tpu/parallel/",
+    "jepsen_tpu/elle_tpu/",
+    "jepsen_tpu/checker/",
+    "jepsen_tpu/ops/",
+)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr", "range"}
+_JIT_WRAPPERS = {"jax.jit", "jit"}
+
+
+def _scope_chain(node: ast.AST) -> Tuple[ast.AST, ...]:
+    """Enclosing FunctionDef chain, outermost first."""
+    chain: List[ast.AST] = []
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, _FN):
+            chain.append(cur)
+        cur = getattr(cur, "parent", None)
+    return tuple(reversed(chain))
+
+
+def _visible(caller_chain: Tuple[ast.AST, ...],
+             target: ast.AST) -> bool:
+    """Is ``target``'s def lexically visible from a function with scope
+    chain ``caller_chain``?  True when the target's enclosing chain is a
+    prefix of the caller's chain (module-level defs, ancestors' siblings,
+    own siblings)."""
+    tchain = _scope_chain(target)
+    return tchain == caller_chain[:len(tchain)]
+
+
+def _body_names(fn: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _traced_defs(tree: ast.Module) -> Set[ast.AST]:
+    """Fixpoint of jit-traced defs (see module docstring)."""
+    defs = [n for n in ast.walk(tree) if isinstance(n, _FN)]
+    by_name: Dict[str, List[ast.AST]] = {}
+    for d in defs:
+        by_name.setdefault(d.name, []).append(d)
+
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _JIT_WRAPPERS:
+            for arg in node.args:
+                roots.update(n.id for n in ast.walk(arg)
+                             if isinstance(n, ast.Name))
+    traced: Set[ast.AST] = {d for d in defs if d.name in roots}
+    changed = True
+    while changed:
+        changed = False
+        for t in list(traced):
+            chain = _scope_chain(t) + (t,)
+            for name in _body_names(t):
+                for cand in by_name.get(name, ()):
+                    if cand not in traced and _visible(chain, cand):
+                        traced.add(cand)
+                        changed = True
+            for child in ast.walk(t):
+                if isinstance(child, _FN) and child is not t \
+                        and child not in traced:
+                    traced.add(child)
+                    changed = True
+    return traced
+
+
+def _tainted_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does evaluating ``node`` touch a traced value?  Static constructs
+    (shape/dtype reads, len(), is-None tests) clear taint."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _tainted_expr(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname in _STATIC_CALLS:
+            return False
+        operands = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(node.func, ast.Attribute):
+            operands.append(node.func.value)   # method receiver
+        return any(_tainted_expr(a, tainted) for a in operands)
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+    if isinstance(node, (ast.Lambda,) + _FN):
+        return False
+    return any(_tainted_expr(c, tainted)
+               for c in ast.iter_child_nodes(node)
+               if isinstance(c, ast.expr))
+
+
+def _target_names(target: ast.AST) -> Iterator[str]:
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+class _FnAuditor:
+    """Two-pass taint walk over one traced def (pass 1 accumulates taint,
+    pass 2 reports), recursing into nested defs with inherited taint."""
+
+    def __init__(self, path: str, qual: str):
+        self.path = path
+        self.qual = qual
+        self.findings: List[Finding] = []
+
+    def audit(self, fn: ast.AST, inherited: Set[str]) -> None:
+        tainted = set(inherited)
+        tainted.update(a.arg for a in fn.args.args
+                       + fn.args.posonlyargs + fn.args.kwonlyargs)
+        if fn.args.vararg:
+            tainted.add(fn.args.vararg.arg)
+        for report in (False, True):
+            self._stmts(fn.body, tainted, report)
+        for child in fn.body:
+            self._recurse_nested(child, tainted)
+
+    def _recurse_nested(self, node: ast.AST, tainted: Set[str]) -> None:
+        if isinstance(node, _FN):
+            sub = _FnAuditor(self.path, f"{self.qual}.{node.name}")
+            sub.audit(node, tainted)
+            self.findings.extend(sub.findings)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._recurse_nested(child, tainted)
+
+    # -- statements --------------------------------------------------------
+    def _stmts(self, body: List[ast.stmt], tainted: Set[str],
+               report: bool) -> None:
+        for stmt in body:
+            self._stmt(stmt, tainted, report)
+
+    def _stmt(self, stmt: ast.stmt, tainted: Set[str],
+              report: bool) -> None:
+        if isinstance(stmt, _FN):
+            return                            # audited via _recurse_nested
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self._exprs(value, tainted, report)
+                if _tainted_expr(value, tainted):
+                    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    for t in targets:
+                        tainted.update(_target_names(t))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._exprs(stmt.test, tainted, report)
+            if report and _tainted_expr(stmt.test, tainted):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self._find(stmt.lineno,
+                           f"data-dependent Python `{kind}` on a traced "
+                           f"value in jitted code ({self.qual})",
+                           "branch on device with jnp.where/lax.cond; "
+                           "Python control flow concretizes the tracer")
+            for b in (stmt.body, stmt.orelse):
+                self._stmts(b, tainted, report)
+            return
+        if isinstance(stmt, ast.For):
+            self._exprs(stmt.iter, tainted, report)
+            if _tainted_expr(stmt.iter, tainted):
+                if report:
+                    self._find(stmt.lineno,
+                               f"Python `for` over a traced value in "
+                               f"jitted code ({self.qual})",
+                               "use lax.scan/fori_loop; iterating a "
+                               "tracer concretizes it")
+                tainted.update(_target_names(stmt.target))
+            self._stmts(stmt.body, tainted, report)
+            self._stmts(stmt.orelse, tainted, report)
+            return
+        # generic: visit child expressions, then child statement blocks
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._exprs(child, tainted, report)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, tainted, report)
+            elif isinstance(child, (ast.ExceptHandler,)):
+                self._stmts(child.body, tainted, report)
+
+    # -- expressions -------------------------------------------------------
+    def _exprs(self, node: ast.expr, tainted: Set[str],
+               report: bool) -> None:
+        if not report:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Lambda,) + _FN):
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted(sub.func)
+            args = list(sub.args) + [kw.value for kw in sub.keywords]
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _SYNC_METHODS:
+                self._find(sub.lineno,
+                           f"`.{sub.func.attr}()` in jitted code "
+                           f"({self.qual}): blocking device->host sync",
+                           "keep the value on device; read scalars on "
+                           "the host after the dispatch returns")
+            elif fname.split(".")[0] in ("np", "numpy") \
+                    and any(_tainted_expr(a, tainted) for a in args):
+                self._find(sub.lineno,
+                           f"`{fname}` applied to a traced value in "
+                           f"jitted code ({self.qual}): implicit host "
+                           f"transfer",
+                           "use the jnp equivalent; numpy pulls the "
+                           "array off device")
+            elif isinstance(sub.func, ast.Name) \
+                    and sub.func.id in _CONCRETIZERS \
+                    and any(_tainted_expr(a, tainted) for a in args):
+                self._find(sub.lineno,
+                           f"`{sub.func.id}()` on a traced value in "
+                           f"jitted code ({self.qual}): concretizes the "
+                           f"tracer",
+                           "use .astype()/jnp casts on device, or hoist "
+                           "the read to the host driver")
+
+    def _find(self, line: int, message: str, hint: str) -> None:
+        self.findings.append(Finding(RULE, self.path, line, message, hint))
+
+
+def check(tree: ast.Module, src_lines: List[str],
+          path: str) -> Iterator[Finding]:
+    list(walk_with_parents(tree))            # annotate parents
+    traced = _traced_defs(tree)
+    # Audit only "top" traced defs; nested traced defs are covered by the
+    # recursive walk with inherited taint.
+    for fn in traced:
+        parent_fns = _scope_chain(fn)
+        if parent_fns and parent_fns[-1] in traced:
+            continue
+        qual = ".".join([f.name for f in parent_fns] + [fn.name])
+        auditor = _FnAuditor(path, qual)
+        auditor.audit(fn, set())
+        yield from auditor.findings

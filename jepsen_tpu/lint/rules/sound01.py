@@ -1,0 +1,112 @@
+"""SOUND01: a verdict may degrade valid -> unknown, never valid -> false.
+
+Everything in this stack — budget expiry, device failure, deadline
+passes, monitor partial state — is allowed to *weaken* a verdict to
+``unknown``; only a genuine counterexample may say ``false``.  A
+``{"valid": False}`` constructed on a fallback path silently converts
+"we could not check this" into "the system is broken", which corrupts
+every downstream consumer (merge_valid propagates false over
+everything).
+
+The rule therefore audits every literal ``valid: False`` construction
+(dict literals and ``result["valid"] = False`` stores) in the verdict-
+producing subsystems:
+
+- inside an ``except`` handler: always a finding — an exception path has
+  no witness by construction;
+- elsewhere: legal only when the site is *witness-bearing* and says so —
+  either an inline ``# witness: <why>`` annotation on the construction,
+  or an entry in :data:`WHITELIST` keyed by (path, enclosing qualname).
+
+Computed verdicts (``"valid": not errors``) are out of scope: they carry
+their evidence in the same expression.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Tuple
+
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.rules import (enclosing_handler, qualname_of,
+                                   walk_with_parents)
+
+RULE = "SOUND01"
+
+SCOPE = (
+    "jepsen_tpu/checker/",
+    "jepsen_tpu/serve/",
+    "jepsen_tpu/monitor/",
+    "jepsen_tpu/parallel/",
+    "jepsen_tpu/elle_tpu/",
+    "jepsen_tpu/elle/",
+)
+
+#: Registered witness-bearing sites: (path, enclosing qualname) -> one-line
+#: justification.  Prefer the inline ``# witness:`` annotation (reviewers
+#: see it next to the code); register here only when the site is shared by
+#: several constructions in one function.
+WHITELIST: Dict[Tuple[str, str], str] = {
+    # The CPU oracle refutes only when pruning on a RETURN leaves no
+    # surviving configuration; the result carries the refuting op.
+    ("jepsen_tpu/checker/wgl_cpu.py", "check"):
+        "exhaustive WGL prune: refuting op + final configs attached",
+}
+
+_WITNESS_RE = re.compile(r"#\s*witness:\s*\S")
+
+
+def _is_false(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _has_witness(src_lines: List[str], *lines: int) -> bool:
+    for ln in lines:                        # 1-based
+        for cand in (ln, ln - 1):
+            if 0 < cand <= len(src_lines) \
+                    and _WITNESS_RE.search(src_lines[cand - 1]):
+                return True
+    return False
+
+
+def check(tree: ast.Module, src_lines: List[str],
+          path: str) -> Iterator[Finding]:
+    for node in walk_with_parents(tree):
+        site = None                          # (line, description)
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value == "valid" \
+                        and _is_false(v):
+                    site = (k.lineno, "dict literal {'valid': False}")
+        elif isinstance(node, ast.Assign) and _is_false(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and tgt.slice.value == "valid":
+                    site = (node.lineno, "store result['valid'] = False")
+        if site is None:
+            continue
+        line, desc = site
+        qn = qualname_of(node)
+        handler = enclosing_handler(node)
+        if handler is not None:
+            yield Finding(
+                RULE, path, line,
+                f"{desc} inside an except handler ({qn}): an exception "
+                f"path has no witness and must degrade to 'unknown', "
+                f"never flip a verdict to false",
+                hint="return {'valid': 'unknown', 'error': ...} from "
+                     "fallback paths; false requires a counterexample")
+            continue
+        if _has_witness(src_lines, line, getattr(node, "lineno", line)):
+            continue
+        if (path, qn) in WHITELIST:
+            continue
+        yield Finding(
+            RULE, path, line,
+            f"{desc} in {qn} is not a registered witness-bearing site",
+            hint="attach the refuting evidence and annotate the "
+                 "construction with '# witness: <what evidence rides "
+                 "along>', or register (path, qualname) in "
+                 "lint/rules/sound01.py WHITELIST with a justification")

@@ -129,6 +129,7 @@ class KeyFrontier:
         bit = 1 << slot
         survivors = {(m & ~bit, st) for (m, st) in self.configs if m & bit}
         if not survivors:
+            # witness: refuting op, final configs, pending window attached
             self.result = {
                 "valid": False,
                 "analyzer": "wgl-cpu",          # same search, same shape

@@ -259,6 +259,7 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
         if overflow[i]:
             out.append(None)
         elif failed[i]:
+            # witness: the lane's frontier emptied; its refuting op rides
             out.append({"valid": False, "analyzer": "wgl-tpu-batch",
                         "op": preps[i].ops[int(failed_op[i])].to_dict(),
                         "configs-explored": int(explored[i])})

@@ -307,6 +307,7 @@ def check_sharded(model: JaxModel,
                 "configs-explored": explored, "shards": n,
                 "capacity": cap * n,
                 "max-capacity-reached": max_cap_reached * n}
+    # witness: frontier emptied across ALL shards; refuting op attached
     return {"valid": False, "analyzer": "wgl-tpu-sharded",
             "op": p.ops[int(carry[7])].to_dict(),
             "configs-explored": explored, "shards": n}

@@ -19,20 +19,13 @@ document (docs/serving.md) and assert on in the smoke test:
 from __future__ import annotations
 
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-
-def mono_now() -> float:
-    """The shared monotonic clock for cross-subsystem timelines.
-
-    Request trace spans, scheduler aging, and monitor epochs all stamp
-    times off this one helper, so a span at t=1.2s in a request trace and
-    a monitor epoch at t=1.2s in the same ``/metrics`` snapshot refer to
-    the same instant — timelines are directly comparable instead of each
-    subsystem free-running its own ``time.monotonic()`` call sites."""
-    return time.monotonic()
+# Canonical home is jepsen_tpu.clock (the checker/control layers need it
+# without importing serve); re-exported here because every serve/ and
+# monitor/ module already imports it from metrics.
+from jepsen_tpu.clock import mono_now  # noqa: F401
 
 
 class Metrics:

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Run the jepsen_tpu static analyzer (both tiers) and gate CI.
+
+Exit status: 0 when every finding is baselined (or there are none),
+1 when any new finding exists, 2 on analyzer self-failure.
+
+  python scripts/lint.py                    # human-readable report
+  python scripts/lint.py --format json      # machine-readable (CI artifact)
+  python scripts/lint.py --no-trace         # AST tier only (fast)
+  python scripts/lint.py --update-baseline  # accept current findings
+
+The baseline is a ledger, not a dumping ground: --update-baseline
+requires --justification explaining why the debt is accepted, and the
+justification lands in jepsen_tpu/lint/baseline.json next to each entry
+for reviewers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the jaxpr trace tier (AST rules only)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite baseline.json to accept current findings")
+    ap.add_argument("--justification", default=None,
+                    help="why the baselined findings are accepted "
+                         "(required with --update-baseline)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from jepsen_tpu.lint import Baseline, run_all
+        from jepsen_tpu.lint.findings import BASELINE_PATH
+        findings = run_all(trace=not args.no_trace)
+    except Exception as e:  # noqa: BLE001 — analyzer breakage must be loud
+        print(f"lint: analyzer failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not args.justification:
+            print("lint: --update-baseline requires --justification",
+                  file=sys.stderr)
+            return 2
+        Baseline.write(findings, BASELINE_PATH,
+                       justification=args.justification)
+        print(f"lint: baseline rewritten with {len(findings)} finding(s) "
+              f"-> {BASELINE_PATH}")
+        return 0
+
+    new = [f for f in findings if not f.baselined]
+    old = [f for f in findings if f.baselined]
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in old],
+            "ok": not new,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"lint: {len(new)} new finding(s), {len(old)} baselined")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,521 @@
+"""The static analyzer's own test suite.
+
+Three layers: per-rule positive/negative fixtures (each injected defect
+produces exactly the expected finding, each legal idiom produces none),
+the suppression machinery (pragmas and the committed baseline), and the
+jaxpr trace tier (banned primitives, plus a deliberately shape-leaking
+fixture engine the ladder check must catch).  The guard tests at the
+bottom pin the analyzer to exit clean on the repo itself — the PR
+contract is fixed findings, not baselined ones.
+"""
+
+import ast
+import json
+import textwrap
+
+import pytest
+
+from jepsen_tpu.lint.ast_lint import run_ast_tier
+from jepsen_tpu.lint.findings import (Baseline, Finding, apply_pragmas,
+                                      pragma_rules)
+from jepsen_tpu.lint.rules import conc01, dev01, shape01, sound01
+
+
+def run_rule(rule, src, path):
+    src = textwrap.dedent(src)
+    return list(rule.check(ast.parse(src), src.splitlines(), path))
+
+
+# ---------------------------------------------------------------------------
+# SOUND01
+# ---------------------------------------------------------------------------
+
+class TestSound01:
+    PATH = "jepsen_tpu/checker/fixture.py"
+
+    def test_fallback_in_except_flagged(self):
+        fs = run_rule(sound01, """
+            def check(h):
+                try:
+                    return engine(h)
+                except Exception:
+                    return {"valid": False, "analyzer": "x"}
+            """, self.PATH)
+        assert len(fs) == 1
+        assert fs[0].rule == "SOUND01"
+        assert "except handler" in fs[0].message
+
+    def test_unwitnessed_literal_flagged(self):
+        fs = run_rule(sound01, """
+            def check(h):
+                return {"valid": False, "analyzer": "x"}
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "witness-bearing" in fs[0].message
+
+    def test_subscript_store_flagged(self):
+        fs = run_rule(sound01, """
+            def check(h, r):
+                try:
+                    pass
+                except ValueError:
+                    r["valid"] = False
+                return r
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "except handler" in fs[0].message
+
+    def test_witness_annotation_accepted(self):
+        fs = run_rule(sound01, """
+            def check(h):
+                # witness: refuting op attached
+                return {"valid": False, "op": h[0]}
+            """, self.PATH)
+        assert fs == []
+
+    def test_whitelist_accepted(self):
+        fs = run_rule(sound01, """
+            def check(model, history):
+                return {"valid": False, "op": history[0]}
+            """, "jepsen_tpu/checker/wgl_cpu.py")
+        assert fs == []
+
+    def test_unknown_degrade_is_legal(self):
+        fs = run_rule(sound01, """
+            def check(h):
+                try:
+                    return engine(h)
+                except Exception as e:
+                    return {"valid": "unknown", "error": str(e)}
+            """, self.PATH)
+        assert fs == []
+
+    def test_computed_verdict_out_of_scope(self):
+        fs = run_rule(sound01, """
+            def check(h):
+                errors = scan(h)
+                return {"valid": not errors, "errors": errors}
+            """, self.PATH)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# DEV01
+# ---------------------------------------------------------------------------
+
+class TestDev01:
+    PATH = "jepsen_tpu/parallel/fixture.py"
+
+    def test_item_in_jitted_engine_flagged(self):
+        fs = run_rule(dev01, """
+            import jax
+
+            def make(w):
+                def run_chunk(carry, events):
+                    return carry, events.sum().item()
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        assert len(fs) == 1
+        assert ".item()" in fs[0].message
+        assert "run_chunk" in fs[0].message
+
+    def test_data_dependent_branch_flagged(self):
+        fs = run_rule(dev01, """
+            import jax
+
+            def make(w):
+                def run_chunk(carry, events):
+                    x = events.sum()
+                    if x > 0:
+                        carry = carry + 1
+                    return carry
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "data-dependent" in fs[0].message
+
+    def test_numpy_and_concretize_on_tracer_flagged(self):
+        fs = run_rule(dev01, """
+            import jax
+            import numpy as np
+
+            def make(w):
+                def run_chunk(carry, events):
+                    z = np.asarray(events)
+                    n = int(events.sum())
+                    return carry, z, n
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        rules = sorted(f.message.split(" ")[0] for f in fs)
+        assert len(fs) == 2
+        assert any("np.asarray" in f.message for f in fs)
+        assert any("`int()`" in f.message for f in fs)
+
+    def test_static_closure_branch_is_legal(self):
+        fs = run_rule(dev01, """
+            import jax
+
+            def make(w, single_round):
+                def run_chunk(carry, events):
+                    n = events.shape[0]
+                    if single_round:
+                        carry = carry + n
+                    if w > 8:
+                        carry = carry * 2
+                    return carry
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        assert fs == []
+
+    def test_shape_len_isnone_untaint(self):
+        fs = run_rule(dev01, """
+            import jax
+
+            def make(enable):
+                def run_chunk(carry, events):
+                    if events.ndim == 2:
+                        carry = carry + 1
+                    if len(events.shape) == 2:
+                        carry = carry + 1
+                    if enable is not None:
+                        carry = carry + 1
+                    return carry
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        assert fs == []
+
+    def test_called_helper_is_traced_too(self):
+        fs = run_rule(dev01, """
+            import jax
+
+            def helper(x):
+                return x.sum().item()
+
+            def make(w):
+                def run_chunk(carry, events):
+                    return carry, helper(events)
+                return jax.jit(run_chunk)
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "helper" in fs[0].message
+
+    def test_host_driver_not_traced(self):
+        # .item() in the *host* driver (never passed to jit) is fine
+        fs = run_rule(dev01, """
+            import numpy as np
+
+            def drive(flags):
+                return int(np.asarray(flags)[0]), flags.sum().item()
+            """, self.PATH)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# SHAPE01
+# ---------------------------------------------------------------------------
+
+class TestShape01:
+    PATH = "jepsen_tpu/serve/fixture.py"
+
+    def test_raw_shape_floor_flagged(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.parallel.batch import check_batch
+
+            def dispatch(model, hs):
+                return check_batch(model, hs, window_floor=max(
+                    len(h) for h in hs))
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "not derived from the bucket ladder" in fs[0].message
+
+    def test_missing_floor_flagged(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.elle_tpu.engine import check_batch
+
+            def dispatch(hs):
+                return check_batch(hs, workload="list-append")
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "n_pad_floor" in fs[0].message
+
+    def test_nonzero_literal_flagged(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.parallel.batch import check_batch
+
+            def dispatch(model, hs):
+                return check_batch(model, hs, window_floor=24)
+            """, self.PATH)
+        assert len(fs) == 1
+
+    def test_bucket_derived_accepted(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.parallel.batch import _batch_chunk, check_batch
+            from jepsen_tpu.serve import buckets
+
+            def dispatch(model, hs, padded):
+                w_bucket = max(buckets.width_bucket(h) for h in hs)
+                ev_bucket = max(buckets.events_bucket(h) for h in hs)
+                return check_batch(model, padded,
+                                   chunk=_batch_chunk(len(padded), ev_bucket),
+                                   window_floor=w_bucket)
+            """, self.PATH)
+        assert fs == []
+
+    def test_cpu_engine_exempt(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.elle_tpu.engine import check_batch
+
+            def host_fallback(h):
+                return check_batch([h], engine="cpu")[0]
+            """, self.PATH)
+        assert fs == []
+
+    def test_out_of_scope_path_ignored(self):
+        assert not any("jepsen_tpu/parallel/x.py".startswith(p)
+                       for p in shape01.SCOPE)
+
+
+# ---------------------------------------------------------------------------
+# CONC01
+# ---------------------------------------------------------------------------
+
+class TestConc01:
+    def test_wallclock_deadline_in_serve_flagged(self):
+        fs = run_rule(conc01, """
+            import time
+
+            def expired(self, deadline):
+                return time.time() > deadline
+            """, "jepsen_tpu/serve/fixture.py")
+        assert len(fs) == 1
+        assert "wall clock" in fs[0].message
+        assert "mono_now" in fs[0].hint
+
+    def test_wallclock_alias_flagged(self):
+        fs = run_rule(conc01, """
+            import time as _time
+
+            def f():
+                return _time.time()
+            """, "jepsen_tpu/db.py")
+        assert len(fs) == 1
+
+    def test_monotonic_is_legal(self):
+        fs = run_rule(conc01, """
+            import time
+
+            def f():
+                return time.monotonic()
+            """, "jepsen_tpu/serve/fixture.py")
+        assert fs == []
+
+    def test_lock_order_inversion_flagged(self):
+        fs = run_rule(conc01, """
+            class Service:
+                def finalize(self, req):
+                    with req._lock:
+                        with self._lock:
+                            pass
+            """, "jepsen_tpu/serve/service.py")
+        assert len(fs) == 1
+        assert "lock-order inversion" in fs[0].message
+
+    def test_manifest_order_is_legal(self):
+        fs = run_rule(conc01, """
+            class Service:
+                def finalize(self, req):
+                    with self._lock:
+                        with req._lock:
+                            pass
+            """, "jepsen_tpu/serve/service.py")
+        assert fs == []
+
+    def test_blocking_io_under_lock_flagged(self):
+        fs = run_rule(conc01, """
+            import time
+
+            class Service:
+                def f(self):
+                    with self._lock:
+                        time.sleep(1.0)
+            """, "jepsen_tpu/serve/service.py")
+        assert len(fs) == 1
+        assert "blocking call" in fs[0].message
+
+    def test_nested_def_resets_held_locks(self):
+        # the closure body runs later, outside the lock
+        fs = run_rule(conc01, """
+            import time
+
+            class Service:
+                def f(self):
+                    with self._lock:
+                        def later():
+                            time.sleep(1.0)
+                        return later
+            """, "jepsen_tpu/serve/service.py")
+        assert fs == []
+
+    def test_undeclared_locks_not_ordered(self):
+        fs = run_rule(conc01, """
+            class Proxy:
+                def f(self, other):
+                    with other._mu:
+                        with self._mu:
+                            pass
+            """, "jepsen_tpu/net_proxy.py")
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas and baseline
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_pragma_parse(self):
+        lines = ["x = time.time()  # lint: disable=CONC01(user-facing)"]
+        assert pragma_rules(lines, 1) == {"CONC01": "user-facing"}
+
+    def test_pragma_line_above(self):
+        lines = ["# lint: disable=SOUND01(oracle), DEV01",
+                 "return {'valid': False}"]
+        assert pragma_rules(lines, 2) == {"SOUND01": "oracle", "DEV01": ""}
+
+    def test_pragma_suppresses_finding(self):
+        f = Finding("CONC01", "jepsen_tpu/x.py", 2, "m")
+        sources = {"jepsen_tpu/x.py": [
+            "# lint: disable=CONC01(benchmark wall)", "t = time.time()"]}
+        assert apply_pragmas([f], sources) == []
+
+    def test_pragma_other_rule_does_not_suppress(self):
+        f = Finding("SOUND01", "jepsen_tpu/x.py", 2, "m")
+        sources = {"jepsen_tpu/x.py": [
+            "# lint: disable=CONC01(benchmark wall)", "bad()"]}
+        assert apply_pragmas([f], sources) == [f]
+
+    def test_baseline_roundtrip_and_mark(self, tmp_path):
+        p = str(tmp_path / "baseline.json")
+        legacy = Finding("CONC01", "jepsen_tpu/a.py", 5, "legacy msg")
+        Baseline.write([legacy], p, justification="pre-existing debt")
+        data = json.loads(open(p).read())
+        assert data["findings"][0]["justification"] == "pre-existing debt"
+
+        bl = Baseline.load(p)
+        fresh = Finding("CONC01", "jepsen_tpu/a.py", 9, "new msg")
+        moved = Finding("CONC01", "jepsen_tpu/a.py", 50, "legacy msg")
+        marked = bl.mark([fresh, moved])
+        assert not marked[0].baselined          # new finding still fails
+        assert marked[1].baselined              # line drift doesn't churn
+
+    def test_empty_baseline_marks_nothing(self, tmp_path):
+        bl = Baseline.load(str(tmp_path / "missing.json"))
+        f = Finding("DEV01", "jepsen_tpu/a.py", 1, "m")
+        assert bl.mark([f]) == [f] and not f.baselined
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+class TestDriver:
+    def test_injected_files_and_parse_error(self):
+        findings, sources = run_ast_tier(files={
+            "jepsen_tpu/serve/bad.py": "def f(:\n",
+            "jepsen_tpu/checker/ok.py": "def f():\n    return 1\n",
+        })
+        assert [f.rule for f in findings] == ["PARSE"]
+        assert "jepsen_tpu/checker/ok.py" in sources
+
+    def test_driver_applies_pragmas(self):
+        src = ("import time\n"
+               "def f():\n"
+               "    # lint: disable=CONC01(user-facing wall clock)\n"
+               "    return time.time()\n")
+        findings, _ = run_ast_tier(files={"jepsen_tpu/serve/x.py": src})
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# jaxpr trace tier
+# ---------------------------------------------------------------------------
+
+class TestTraceTier:
+    def test_clean_fn_passes(self):
+        import jax.numpy as jnp
+        from jepsen_tpu.lint.jaxpr_lint import check_jaxpr_clean
+        fs = check_jaxpr_clean(lambda x: (x * 2).sum(),
+                               (jnp.zeros((4,), jnp.int32),), "clean")
+        assert fs == []
+
+    def test_callback_engine_caught(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jepsen_tpu.lint.jaxpr_lint import check_jaxpr_clean
+
+        def leaky(x):
+            out = jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return jax.pure_callback(lambda a: np.asarray(a), out, x)
+
+        fs = check_jaxpr_clean(leaky, (jnp.zeros((4,), jnp.float32),),
+                               "leaky-engine")
+        assert len(fs) == 1
+        assert "pure_callback" in fs[0].message
+
+    def test_untraceable_engine_is_a_finding(self):
+        import jax.numpy as jnp
+        from jepsen_tpu.lint.jaxpr_lint import check_jaxpr_clean
+
+        def broken(x):
+            if x.sum() > 0:          # concretization error at trace time
+                return x
+            return -x
+
+        fs = check_jaxpr_clean(broken, (jnp.zeros((4,), jnp.int32),),
+                               "broken-engine")
+        assert len(fs) == 1
+        assert "failed to trace" in fs[0].message
+
+    def test_shape_leaking_fixture_engine_caught(self):
+        from jepsen_tpu.lint.jaxpr_lint import signature_stability_findings
+        # several raw sizes per bucket: the leak shows as |sigs| > |buckets|
+        samples = [(5, 1, 1), (63, 2, 2), (65, 3, 4), (100, 5, 7),
+                   (300, 11, 64), (1000, 24, 200)]
+
+        def bucket(s):
+            return (max(64, 1 << (s[0] - 1).bit_length()),)
+
+        def leaking_signature(s):
+            return (s[0],)           # pads to the raw history length
+
+        fs = signature_stability_findings(samples, leaking_signature,
+                                          bucket, "fixture engine")
+        assert len(fs) == 1
+        assert "raw shape is leaking" in fs[0].message
+
+        fs_ok = signature_stability_findings(samples, bucket, bucket,
+                                             "fixture engine")
+        assert fs_ok == []
+
+    def test_real_ladder_is_stable(self):
+        from jepsen_tpu.lint.jaxpr_lint import ladder_findings
+        assert ladder_findings() == []
+
+    def test_real_engines_trace_clean(self):
+        from jepsen_tpu.lint.jaxpr_lint import trace_engine_findings
+        assert trace_engine_findings() == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+class TestRepoIsClean:
+    def test_ast_tier_clean_on_repo(self):
+        findings, _ = run_ast_tier()
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+    def test_baseline_is_empty(self):
+        assert Baseline.load().entries == [], (
+            "the committed baseline must stay empty: fix findings or "
+            "justify a pragma instead of baselining new debt")
