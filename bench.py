@@ -10,10 +10,14 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
   ceiling   ghost-write burst that must blow past max capacity: clean,
             *timed* degradation to an unknown verdict at the 65536 ceiling
   refuted   10k ops with corrupted reads: early-exit on the failing prefix
-  batch     check_batch throughput over short per-key histories -> hist/sec,
-            plus the same-host CPU-oracle comparison (per core AND per
-            socket) and the break-even core count, on two shapes (96 and
-            512 lanes)
+  batch     megabatch throughput over short per-key histories -> hist/sec
+            (continuous-refill pipeline, parallel/megabatch.py), plus the
+            same-host CPU-oracle comparison (per core AND per socket),
+            lane-for-lane verdict parity on the sampled lanes, and the
+            break-even core count, on two shapes (96 and 512 lanes)
+  batch_sweep  histories/sec vs batch size (96/512/2048/8192) through the
+            megabatch path — the throughput trajectory, tracked like the
+            headline
   ablation  ghost-subsumption on vs off (JTPU_SUBSUME=0) on a ghost burst
             that concludes in O(crashes) configs with subsumption and needs
             ~2^crashes without — the measured evidence for the claim in
@@ -70,6 +74,7 @@ TIER_TIMEOUT_S = {
     "ceiling": 300 if SMOKE else 2400,
     "refuted": 300 if SMOKE else 1200,
     "batch": 300 if SMOKE else 1200,
+    "batch_sweep": 420 if SMOKE else 1800,
     "ablation_on": 300 if SMOKE else 900,
     "ablation_off": 300 if SMOKE else 900,
     "setup2": 300 if SMOKE else 700,
@@ -395,26 +400,31 @@ def tier_batch():
     per socket (this bench host's socket, os.cpu_count() cores), plus the
     break-even core count.  Two shapes: the legacy 96-lane stream
     (round-over-round comparability) and the 512-lane group that is the
-    measured throughput knee (parallel/batch.py MAX_LANES_PER_GROUP)."""
+    measured throughput knee (parallel/batch.py MAX_LANES_PER_GROUP).
+    Since round 6 the timed path is the megabatch pipeline
+    (parallel/megabatch.py) — continuous lane refill, O(1) per-dispatch
+    readback — parity-checked lane for lane against the CPU oracle on
+    the sampled lanes."""
     from jepsen_tpu.checker import wgl_cpu
     from jepsen_tpu.models import CASRegister, get_model
-    from jepsen_tpu.parallel.batch import check_batch
+    from jepsen_tpu.parallel.megabatch import check_megabatch
     model = get_model("cas-register")
     out = {}
     for name, hs in (("96", build_batch()), ("512", build_batch512())):
         progress(f"batch[{name}] warm (jit keys on the batch dim)")
-        check_batch(model, hs)
+        check_megabatch(model, hs)
         progress(f"batch[{name}] timed run")
         t0 = time.time()
-        res = check_batch(model, hs)
+        res = check_megabatch(model, hs)
         wall = time.time() - t0
         n_false = sum(1 for r in res if r["valid"] is False)
         assert n_false == len(hs) // 4, [r["valid"] for r in res]
-        # CPU oracle on a sample of the same lanes, single core.
+        # CPU oracle on a sample of the same lanes, single core — and the
+        # lane-for-lane verdict parity check on that sample.
         sample = hs[:16]
         t0 = time.time()
-        for h in sample:
-            wgl_cpu.check(CASRegister(), h)
+        for h, r in zip(sample, res):
+            assert wgl_cpu.check(CASRegister(), h)["valid"] == r["valid"]
         per = (time.time() - t0) / len(sample)
         cores = os.cpu_count() or 1
         dev_hps = len(hs) / wall
@@ -430,7 +440,49 @@ def tier_batch():
             "device_vs_socket": round(dev_hps / (cores * cpu_core), 2),
             "break_even_cores": round(dev_hps / cpu_core, 1),
         }
-    emit({**out["96"], "shapes": out})
+    emit({**out["96"], "shapes": out, "analyzer": "wgl-tpu-megabatch"})
+
+
+def tier_batch_sweep():
+    """Throughput trajectory of the megabatch path vs batch size — the
+    histories/sec curve at 96/512/2048/8192 lanes (smoke: shrunk), same
+    per-lane workload as the batch tier.  Tracked in the bench JSON like
+    the headline so the batch-throughput race is measured round over
+    round, not anecdotally."""
+    from jepsen_tpu.models import get_model
+    from jepsen_tpu.parallel.megabatch import (check_megabatch,
+                                               megabatch_stats,
+                                               reset_megabatch_stats)
+    from jepsen_tpu.synth import cas_register_history, corrupt_reads
+    model = get_model("cas-register")
+    sizes = (16, 32, 64) if SMOKE else (96, 512, 2048, 8192)
+    n_max = max(sizes)
+    hs = [cas_register_history(BATCH_OPS, concurrency=6, crash_p=0.005,
+                               seed=500 + i) for i in range(n_max)]
+    for i in range(0, n_max, 4):
+        hs[i] = corrupt_reads(hs[i], n=1, seed=i)
+    progress("batch_sweep warm")
+    check_megabatch(model, hs[:sizes[0]])
+    sweep = {}
+    for n in sizes:
+        progress(f"batch_sweep[{n}] timed run")
+        reset_megabatch_stats()
+        t0 = time.time()
+        res = check_megabatch(model, hs[:n])
+        wall = time.time() - t0
+        n_false = sum(1 for r in res if r["valid"] is False)
+        assert n_false == n // 4, n_false
+        st = megabatch_stats()
+        sweep[str(n)] = {
+            "n_histories": n, "ops_each": BATCH_OPS,
+            "wall_s": round(wall, 3),
+            "histories_per_sec": round(n / wall, 1),
+            "dispatches": st["dispatches"], "refills": st["refills"],
+            "groups": st["groups"],
+        }
+    emit({"sweep": sweep, "analyzer": "wgl-tpu-megabatch",
+          "histories_per_sec":
+              sweep[str(sizes[-1])]["histories_per_sec"]})
 
 
 def build_multireg():
@@ -590,6 +642,7 @@ TIER_FNS = {
     "ceiling": tier_ceiling,
     "refuted": tier_refuted,
     "batch": tier_batch,
+    "batch_sweep": tier_batch_sweep,
     "ablation_on": tier_ablation,
     "ablation_off": tier_ablation,
     "setup2": tier_setup2,
@@ -673,8 +726,8 @@ def main():
     # Easy (the headline) runs FIRST so later-tier failures can't starve it
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
-                 "ablation_on", "ablation_off", "setup2", "sched",
-                 "multireg", "elle"):
+                 "batch_sweep", "ablation_on", "ablation_off", "setup2",
+                 "sched", "multireg", "elle"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "
@@ -767,6 +820,9 @@ def main():
                               "host_cores", "analyzer")},
             "batch_vs_cpu_socket": (tiers["batch"].get("shapes") or {}).get(
                 "512", {}),
+            "batch_sweep": {
+                "status": tiers["batch_sweep"].get("status"),
+                **(tiers["batch_sweep"].get("sweep") or {})},
             "full_record": os.path.basename(full_path),
         },
     }))

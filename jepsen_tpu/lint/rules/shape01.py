@@ -10,7 +10,7 @@ every novel history size compiles a fresh executable and the service
 death-spirals under diverse load.
 
 The rule audits engine entry points called from serve/ (``check_batch``,
-``make_engine``, ``events_array``, ``pack_group``):
+``check_megabatch``, ``make_engine``, ``events_array``, ``pack_group``):
 
 - shape-carrying kwargs (``window_floor``, ``n_pad_floor``, ``chunk``,
   ``n_pad``, ``b_pad``, ``window``, ``pad_to``), when present, must be
@@ -21,7 +21,13 @@ The rule audits engine entry points called from serve/ (``check_batch``,
 - a ``check_batch`` call *missing* its floor kwarg fires — the default
   floor of 0 means "pad to this history's own size", exactly the
   unbounded behaviour — except when the call pins ``engine="cpu"``
-  (the host tier compiles nothing).
+  (the host tier compiles nothing);
+- a ``check_megabatch`` call must pass BOTH ``window_floor`` and
+  ``ev_floor`` (the megabatch packer buckets internally, but without
+  the cell's floors successive dispatches of one bucket land in
+  different internal rungs and the lane/shape ladder decoheres), and
+  its ``lanes`` count, when present, must come from the lane ladder
+  (``mega_lane_bucket``) like every other shape.
 """
 
 from __future__ import annotations
@@ -40,6 +46,7 @@ SCOPE = ("jepsen_tpu/serve/",)
 #: kwargs that carry a shape into an engine, per entry-point name.
 _SHAPE_KWARGS = {
     "check_batch": ("window_floor", "n_pad_floor", "chunk", "pad_to"),
+    "check_megabatch": ("window_floor", "ev_floor", "lanes", "chunk"),
     "make_engine": ("window", "capacity", "gwords"),
     "events_array": ("chunk", "pad_to"),
     "pack_group": ("n_pad", "b_pad"),
@@ -50,6 +57,11 @@ _FLOOR_FOR_ORIGIN = {
     "jepsen_tpu.parallel.batch": "window_floor",
     "jepsen_tpu.elle_tpu.engine": "n_pad_floor",
 }
+
+#: floors a check_megabatch call must ALL pass (the packer buckets
+#: internally, but the cell's floors are what pin successive dispatches
+#: of one bucket to one internal rung).
+_MEGABATCH_FLOORS = ("window_floor", "ev_floor")
 
 _BUCKETISH_NAME = re.compile(r"bucket|floor|pow2", re.IGNORECASE)
 _BUCKETISH_FUNC = re.compile(r"bucket|floor|pow2|_batch_chunk|capacity")
@@ -132,6 +144,17 @@ def check(tree: ast.Module, src_lines: List[str],
                     hint="derive it via serve/buckets.py (events_bucket/"
                          "width_bucket/elle_bucket/...) so the compile "
                          "cache stays bounded by the ladder")
+        if fname == "check_megabatch" and not _engine_is_cpu(node):
+            for r in _MEGABATCH_FLOORS:
+                if r not in kwargs:
+                    yield Finding(
+                        RULE, path, node.lineno,
+                        f"`check_megabatch(...)` in {qn} omits `{r}`: "
+                        f"without the cell's floor, successive dispatches "
+                        f"of one bucket land in different internal packer "
+                        f"rungs and the shape ladder decoheres",
+                        hint="pass the cell's bucket as the floor (see "
+                             "scheduler._dispatch_wgl's megabatch arm)")
         if fname == "check_batch" and not _engine_is_cpu(node):
             origin = _origin_of(node, origins, dotted(node.func)
                                 .split(".")[0] or fname)

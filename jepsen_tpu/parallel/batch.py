@@ -55,12 +55,22 @@ class _LRUCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.group_reuses = 0
 
-    def get(self, key):
+    def get(self, key, group_reuse: bool = False):
+        """``group_reuse=True`` marks a lookup made for an additional
+        dispatch group within ONE logical batch (check_batch's >512-lane
+        split, megabatch's grouped vmap): a found entry counts toward
+        ``group_reuses`` instead of ``hits``, so the hit rate keeps
+        measuring cross-call cache effectiveness rather than being
+        inflated by same-dispatch reuse."""
         with self._lock:
             if key in self._d:
                 self._d.move_to_end(key)
-                self.hits += 1
+                if group_reuse:
+                    self.group_reuses += 1
+                else:
+                    self.hits += 1
                 return self._d[key]
             self.misses += 1
             return None
@@ -81,7 +91,8 @@ class _LRUCache:
         with self._lock:
             return {"size": len(self._d), "capacity": self.capacity,
                     "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "group_reuses": self.group_reuses}
 
 
 _CACHE = _LRUCache(int(os.environ.get("JEPSEN_TPU_ENGINE_CACHE", "32")))
@@ -114,6 +125,21 @@ LANE_EVENTS_PER_DISPATCH = 16384
 MAX_LANES_PER_GROUP = 512
 
 
+def donate_carry_argnums() -> tuple:
+    """Argnums to donate for the per-chunk engine carry.
+
+    The carry is the dominant device allocation (capacity x window words
+    per lane); donating it lets XLA update it in place instead of
+    reallocating every dispatch.  The CPU backend cannot honor carry
+    donation (it warns per call and copies anyway), so donation is gated
+    on the real backend — shapes and results are identical either way.
+    """
+    try:
+        return (0,) if jax.default_backend() != "cpu" else ()
+    except Exception:  # backend probe must never break checking
+        return ()
+
+
 def _batch_chunk(bpad: int, longest: int) -> int:
     """Events per dispatch for a ``bpad``-lane batch (multiple of 64,
     clamped to [64, 2048] and to the longest lane rounded up)."""
@@ -129,7 +155,8 @@ def check_batch(model: JaxModel,
                 capacity: int = 256,
                 max_capacity: int = 65536,
                 chunk: Optional[int] = None,
-                window_floor: int = 0) -> List[Dict[str, Any]]:
+                window_floor: int = 0,
+                _group_reuse: bool = False) -> List[Dict[str, Any]]:
     """Check many histories at once; returns one result dict per history.
 
     All lanes share one engine shape (window = max over histories, events
@@ -161,7 +188,8 @@ def check_batch(model: JaxModel,
                                    histories[i:i + MAX_LANES_PER_GROUP],
                                    mesh=mesh, axis=axis, capacity=capacity,
                                    max_capacity=max_capacity, chunk=chunk,
-                                   window_floor=window_floor))
+                                   window_floor=window_floor,
+                                   _group_reuse=_group_reuse or i > 0))
         return out
     from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
@@ -176,7 +204,8 @@ def check_batch(model: JaxModel,
     cap = capacity
     while lanes:
         res = _run_lanes(model, [preps[i] for i in lanes],
-                         window, cap, mesh, axis, chunk, gw, longest)
+                         window, cap, mesh, axis, chunk, gw, longest,
+                         group_reuse=_group_reuse)
         retry = []
         for lane, r in zip(lanes, res):
             if r is None:
@@ -195,7 +224,8 @@ def check_batch(model: JaxModel,
 
 def _run_lanes(model: JaxModel, preps, window: int, cap: int,
                mesh: Optional[Mesh], axis: str, chunk: Optional[int],
-               gwords: int, longest: int) -> List[Optional[Dict[str, Any]]]:
+               gwords: int, longest: int,
+               group_reuse: bool = False) -> List[Optional[Dict[str, Any]]]:
     """One vmapped pass over a set of lanes at a fixed capacity.  Returns a
     result per lane, or None where the lane overflowed (caller escalates).
 
@@ -219,7 +249,8 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
     for i, e in enumerate(evs):
         batch[i, :e.shape[0]] = e
 
-    carry0, vrun = _batched_runner(model, window, cap, gwords, cc, bpad)
+    carry0, vrun = _batched_runner(model, window, cap, gwords, cc, bpad,
+                                   group_reuse=group_reuse)
     c0 = carry0()
     carry = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (bpad,) + x.shape), c0)
@@ -270,11 +301,12 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
 
 
 def _batched_runner(model: JaxModel, window: int, capacity: int,
-                    gwords: int, chunk: int, bpad: int):
+                    gwords: int, chunk: int, bpad: int,
+                    group_reuse: bool = False):
     key = ("batchv", model.name, model.variant, model.state_size,
            tuple(model.init_state_array().tolist()), window, capacity,
            gwords, chunk, bpad)
-    hit = _CACHE.get(key)
+    hit = _CACHE.get(key, group_reuse=group_reuse)
     if hit is not None:
         return hit
     # single_round_closure: under vmap every cond/switch branch executes
@@ -289,5 +321,10 @@ def _batched_runner(model: JaxModel, window: int, capacity: int,
                                        gwords=gwords, work_budget=0,
                                        single_round_closure=True,
                                        steps_per_dispatch=chunk)
-    vrun = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0)))
+    # Donate the carry (argnum 0): the batched carry dominates device
+    # memory and is dead after each dispatch — in-place update instead of
+    # a fresh allocation per chunk.  The events buffer (argnum 1) is NOT
+    # donated; it is reused across every dispatch of the batch.
+    vrun = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0)),
+                   donate_argnums=donate_carry_argnums())
     return _CACHE.put(key, (carry0, vrun))

@@ -75,6 +75,29 @@ def lane_bucket(n_lanes: int, cap: int = MAX_LANE_BUCKET) -> int:
     return min(pow2_at_least(max(1, n_lanes), 1), cap)
 
 
+#: ceiling of the megabatch lane-count ladder: concurrently-resident
+#: device lanes across a bucket's groups.  Lanes beyond MAX_LANE_BUCKET
+#: run as grouped vmaps of <= MAX_LANE_BUCKET width that reuse ONE
+#: compiled executable (the same engine-cache entry; reuse shows up as
+#: the cache's ``group_reuses`` counter) — the vmap width never grows
+#: past the 512-lane bool-scatter cliff documented in parallel.batch.
+MAX_MEGA_LANES = 4096
+
+#: event buckets at or below this route through the megabatch refill
+#: path when it is enabled — the "small-history path" whose steady-state
+#: traffic is thousands of short per-key cells.  Larger buckets keep the
+#: barrier path: their lanes are few and long, so refill wins nothing.
+MEGA_EVENTS_MAX = 1024
+
+
+def mega_lane_bucket(n_lanes: int, cap: int = MAX_MEGA_LANES) -> int:
+    """Concurrently-resident lanes for the megabatch path: a power of
+    two up to :data:`MAX_MEGA_LANES` (>= 512 means multiple grouped
+    vmaps sharing one executable).  Same ladder discipline as
+    :func:`lane_bucket`, one rung higher."""
+    return min(pow2_at_least(max(1, n_lanes), 1), cap)
+
+
 #: floor / ceiling of the derived wgl start-capacity ladder
 MIN_WGL_CAPACITY = 64
 MAX_WGL_CAPACITY = 65536

@@ -11,7 +11,12 @@ document (docs/serving.md) and assert on in the smoke test:
 - occupancy: used vs padded lanes per dispatch, summed — the price of
   shape bucketing, as a ratio;
 - engine-cache: hit/miss/eviction counters of the bounded compiled-
-  engine LRU (parallel.batch) — a miss is a recompile;
+  engine LRU (parallel.batch) — a miss is a recompile, a group_reuse is
+  the same executable serving another dispatch group of one batch;
+- megabatch: the throughput path's staging/refill/readback counters
+  (parallel.megabatch) — dispatches vs summary ints proves the O(1)
+  per-dispatch readback, refills/lanes_refilled measure continuous
+  lane occupancy;
 - traces: the last few completed requests' span lists (enqueue -> pack
   -> dispatch -> verdict, relative seconds).
 """
@@ -69,6 +74,7 @@ class Metrics:
 
     def snapshot(self) -> Dict[str, Any]:
         from jepsen_tpu.parallel.batch import engine_cache_stats
+        from jepsen_tpu.parallel.megabatch import megabatch_stats
         with self._lock:
             counters = dict(self._counters)
             used, padded = self._lanes_used, self._lanes_padded
@@ -89,5 +95,6 @@ class Metrics:
                 "dispatch-seconds": round(dispatch_s, 6),
             },
             "engine-cache": {**cache, "recompiles": cache["misses"]},
+            "megabatch": megabatch_stats(),
             "traces": traces,
         }

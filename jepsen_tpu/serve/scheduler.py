@@ -130,8 +130,29 @@ class Scheduler:
         return ok
 
     # -- the device loop --------------------------------------------------
+    def _mega_eligible(self, bucket: Tuple) -> bool:
+        """Small-bucket wgl cells route through the megabatch refill path
+        (parallel.megabatch) when it is enabled: their steady-state
+        traffic is thousands of short per-key lanes, exactly the shape
+        the continuous-refill pipeline wins on.  Large event buckets and
+        mesh-sharded dispatches keep the barrier path."""
+        from jepsen_tpu.parallel.megabatch import megabatch_enabled
+        return (self.mesh is None and megabatch_enabled()
+                and len(bucket) >= 4 and bucket[0] == KIND_WGL
+                and bucket[2] <= buckets.MEGA_EVENTS_MAX)
+
+    def _group_limit(self, bucket: Tuple) -> int:
+        """Lanes to pop for one dispatch of this bucket: the megabatch
+        path packs up to the mega lane ladder (grouped vmaps reusing one
+        executable), the barrier path stays at max_lanes."""
+        if self._mega_eligible(bucket):
+            return buckets.mega_lane_bucket(buckets.MAX_MEGA_LANES)
+        return self.max_lanes
+
     def _take_group(self) -> List[Cell]:
-        """Pop the most urgent bucket's head cells (up to max_lanes).
+        """Pop the most urgent bucket's head cells (up to the bucket's
+        group limit — max_lanes, or the mega lane ladder for megabatch-
+        eligible buckets).
 
         Deadline-first with aging: the plain pick is the earliest
         (deadline, seq) head, but a steady stream of near-deadline cells
@@ -161,8 +182,9 @@ class Scheduler:
             best = (None, aged[1])
             self.metrics.inc("aged_picks")
         dq = self._groups[best[1]]
+        limit = self._group_limit(best[1])
         out = []
-        while dq and len(out) < self.max_lanes:
+        while dq and len(out) < limit:
             out.append(dq.popleft())
         if not dq:
             del self._groups[best[1]]
@@ -209,14 +231,22 @@ class Scheduler:
             c.request.span("pack")
         t0 = mono_now()
         lanes = [c.history for c in live]
-        pad = buckets.lane_bucket(len(lanes), self.max_lanes)
-        padded = lanes + [lanes[0]] * (pad - len(lanes))
         kind = live[0].request.kind
+        mega = kind == KIND_WGL and self._mega_eligible(live[0].bucket)
+        if mega:
+            # The megabatch packer buckets and pads lanes internally
+            # (its width ladder is part of the engine-cache key); no
+            # caller-side lane padding needed.
+            pad = len(lanes)
+            padded = lanes
+        else:
+            pad = buckets.lane_bucket(len(lanes), self.max_lanes)
+            padded = lanes + [lanes[0]] * (pad - len(lanes))
         for c in live:
             c.request.span("dispatch")
         try:
             if kind == KIND_WGL:
-                rs = self._dispatch_wgl(live, padded)
+                rs = self._dispatch_wgl(live, padded, mega=mega)
             else:
                 rs = self._dispatch_elle(live, padded)
         except Exception as e:  # noqa: BLE001 — device trouble, degrade
@@ -247,8 +277,8 @@ class Scheduler:
             return int(self.capacity)
         return buckets.wgl_start_capacity(ev_bucket, w_bucket)
 
-    def _dispatch_wgl(self, live: List[Cell],
-                      padded: List[Any]) -> List[Dict[str, Any]]:
+    def _dispatch_wgl(self, live: List[Cell], padded: List[Any],
+                      mega: bool = False) -> List[Dict[str, Any]]:
         from jepsen_tpu.parallel.batch import _batch_chunk, check_batch
         spec0 = live[0].request.spec
         _, _, ev_bucket, w_bucket = live[0].bucket
@@ -256,6 +286,15 @@ class Scheduler:
         max_cap = max(int(s.request.spec.get("max_capacity",
                                              self.max_capacity))
                       for s in live)
+        if mega:
+            from jepsen_tpu.parallel.megabatch import check_megabatch
+            self.metrics.inc("megabatch-dispatches")
+            self.metrics.inc("megabatch-lanes", len(padded))
+            return check_megabatch(
+                spec0["model"], padded, capacity=cap,
+                max_capacity=max_cap, window_floor=w_bucket,
+                ev_floor=ev_bucket,
+                lanes=buckets.mega_lane_bucket(len(padded)))
         rs = check_batch(spec0["model"], padded, mesh=self.mesh,
                          capacity=cap, max_capacity=max_cap,
                          chunk=_batch_chunk(len(padded), ev_bucket),

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Megabatch smoke: 2048-history parity + O(1) readback + the sweep.
+
+Three legs on the CPU backend, over 2048 short mixed-length cas-register
+histories (every 4th refuted by a corrupted read — the serving fleet's
+small-history steady state):
+
+  1. **Parity** — ``check_megabatch`` vs the barrier-path ``check_batch``
+     reference, lane for lane: identical verdicts, identical
+     ``configs-explored``, identical refuting op index.  A sample of the
+     lanes is additionally checked against the single-core CPU oracle.
+  2. **Readback discipline** — the megabatch run executes with JAX's
+     device→host transfer guard ARMED (``transfer_guard=True``): any
+     device→host transfer outside the counted chokepoints raises.  The
+     counters then prove the O(1) contract: per-dispatch reads are
+     exactly ``SUMMARY_WIDTH`` ints (``summary_ints == summary_reads *
+     SUMMARY_WIDTH``, ``summary_reads <= dispatches``) and every other
+     read is a refill-amortized harvest.
+  3. **Sweep** — histories/sec at 128/512 lanes on the warmed engines
+     (the 2048 point is the main timed run itself), written to argv[1]
+     (default /tmp/megabatch_sweep.json) — CI uploads it as an artifact
+     so the throughput trajectory is inspectable per run.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.checker import wgl_cpu  # noqa: E402
+from jepsen_tpu.models import CASRegister, get_model  # noqa: E402
+from jepsen_tpu.parallel.batch import check_batch  # noqa: E402
+from jepsen_tpu.parallel.megabatch import (  # noqa: E402
+    SUMMARY_WIDTH, check_megabatch, megabatch_stats, reset_megabatch_stats)
+from jepsen_tpu.synth import cas_register_history, corrupt_reads  # noqa: E402
+
+N = 2048
+SWEEP_SIZES = (128, 512)
+
+
+def build():
+    """Mixed-length short histories (early-retiring lanes next to longer
+    ones, so the refill machinery is actually exercised)."""
+    hs = []
+    for i in range(N):
+        n_ops = (10, 18, 26, 14)[i % 4] + (i % 3) * 2
+        h = cas_register_history(n_ops, concurrency=4, crash_p=0.005,
+                                 seed=7000 + i)
+        if i % 4 == 3:
+            h = corrupt_reads(h, n=1, seed=i)
+        hs.append(h)
+    return hs
+
+
+def key(r):
+    return (r["valid"], r.get("configs-explored"),
+            (r.get("op") or {}).get("index"))
+
+
+def main():
+    dump = sys.argv[1] if len(sys.argv) > 1 else "/tmp/megabatch_sweep.json"
+    model = get_model("cas-register")
+    hs = build()
+
+    print(f"[smoke] reference check_batch over {N} histories", flush=True)
+    t0 = time.perf_counter()
+    ref = check_batch(model, hs)
+    ref_wall = time.perf_counter() - t0
+
+    print("[smoke] megabatch run (transfer guard armed)", flush=True)
+    reset_megabatch_stats()
+    t0 = time.perf_counter()
+    got = check_megabatch(model, hs, transfer_guard=True)
+    mb_wall = time.perf_counter() - t0
+    st = megabatch_stats()
+
+    # -- leg 1: lane-for-lane parity --------------------------------------
+    mismatches = [i for i in range(N) if key(ref[i]) != key(got[i])]
+    assert not mismatches, \
+        f"{len(mismatches)} lanes diverge from check_batch: " \
+        f"{mismatches[:10]}"
+    n_false = sum(1 for r in got if r["valid"] is False)
+    assert n_false == N // 4, n_false
+    for h, r in zip(hs[:16], got[:16]):
+        assert wgl_cpu.check(CASRegister(), h)["valid"] == r["valid"], \
+            "CPU-oracle verdict mismatch on sampled lane"
+
+    # -- leg 2: O(1) per-dispatch readback --------------------------------
+    assert st["dispatches"] > 0 and st["summary_reads"] > 0
+    assert st["summary_ints"] == st["summary_reads"] * SUMMARY_WIDTH, st
+    assert st["summary_reads"] <= st["dispatches"], st
+    assert st["harvests"] <= st["refills"] + st["groups"], st
+    assert st["lanes_retired"] == N, st
+
+    # -- leg 3: the sweep (engines are warm now; the full-N point is the
+    # main timed run above, not re-run) -----------------------------------
+    sweep = {str(N): {
+        "n_histories": N, "wall_s": round(mb_wall, 3),
+        "histories_per_sec": round(N / mb_wall, 1),
+        "dispatches": st["dispatches"], "groups": st["groups"],
+        "refills": st["refills"], "lanes_refilled": st["lanes_refilled"],
+    }}
+    for n in SWEEP_SIZES:
+        print(f"[smoke] sweep[{n}]", flush=True)
+        reset_megabatch_stats()
+        t0 = time.perf_counter()
+        res = check_megabatch(model, hs[:n])
+        wall = time.perf_counter() - t0
+        assert sum(1 for r in res if r["valid"] is False) == n // 4
+        s = megabatch_stats()
+        sweep[str(n)] = {
+            "n_histories": n, "wall_s": round(wall, 3),
+            "histories_per_sec": round(n / wall, 1),
+            "dispatches": s["dispatches"], "groups": s["groups"],
+            "refills": s["refills"], "lanes_refilled": s["lanes_refilled"],
+        }
+
+    report = {"n_histories": N, "backend": "cpu",
+              "check_batch_wall_s": round(ref_wall, 3),
+              "megabatch_wall_s": round(mb_wall, 3),
+              "megabatch_stats": st, "sweep": sweep}
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2)
+
+    print(f"megabatch smoke OK: {N} lanes parity-exact vs check_batch "
+          f"({n_false} refuted), O(1) readback held under an armed "
+          f"transfer guard ({st['summary_reads']} summary reads x "
+          f"{SUMMARY_WIDTH} ints over {st['dispatches']} dispatches, "
+          f"{st['harvests']} harvests), megabatch {mb_wall:.1f}s vs "
+          f"barrier {ref_wall:.1f}s; sweep dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,7 +142,7 @@ class TestEngine:
     def test_fallback_on_device_error(self, monkeypatch):
         def boom(*a, **kw):
             raise RuntimeError("injected device loss")
-        monkeypatch.setattr(et_engine, "_device_flags", boom)
+        monkeypatch.setattr(et_engine, "_device_flags_async", boom)
         res = elle_tpu.check_batch([g0_history(), valid_history()],
                                    workload="list-append")
         for r in res:

@@ -261,6 +261,30 @@ class TestShape01:
             """, self.PATH)
         assert fs == []
 
+    def test_megabatch_missing_floors_flagged(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.parallel.megabatch import check_megabatch
+
+            def dispatch(model, hs):
+                return check_megabatch(model, hs, lanes=len(hs))
+            """, self.PATH)
+        assert len(fs) == 3      # off-ladder lanes + both missing floors
+        msgs = "\n".join(f.message for f in fs)
+        assert "window_floor" in msgs and "ev_floor" in msgs
+        assert "not derived from the bucket ladder" in msgs
+
+    def test_megabatch_ladder_shapes_accepted(self):
+        fs = run_rule(shape01, """
+            from jepsen_tpu.parallel.megabatch import check_megabatch
+            from jepsen_tpu.serve import buckets
+
+            def dispatch(model, hs, ev_bucket, w_bucket):
+                return check_megabatch(
+                    model, hs, window_floor=w_bucket, ev_floor=ev_bucket,
+                    lanes=buckets.mega_lane_bucket(len(hs)))
+            """, self.PATH)
+        assert fs == []
+
     def test_cpu_engine_exempt(self):
         fs = run_rule(shape01, """
             from jepsen_tpu.elle_tpu.engine import check_batch

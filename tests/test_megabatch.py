@@ -1,0 +1,206 @@
+"""The megabatch throughput path (jepsen_tpu.parallel.megabatch).
+
+Covers lane-for-lane parity with check_batch and the CPU oracle,
+packing invariance (shuffled input order and varied group sizes must
+produce identical per-history verdicts and configs-explored, including
+across early-retire/refill boundaries), overflow escalation, the O(1)
+per-dispatch readback counters (with JAX's transfer guard armed), the
+engine-cache group_reuses accounting, the serve lane ladder, and the
+scheduler routing knob.  Everything runs on the CPU backend.
+"""
+
+import pytest
+
+from jepsen_tpu.checker import wgl_cpu
+from jepsen_tpu.models import CASRegister, get_model
+from jepsen_tpu.parallel import batch as pbatch
+from jepsen_tpu.parallel import megabatch as mb
+from jepsen_tpu.parallel.batch import _LRUCache, check_batch
+from jepsen_tpu.parallel.megabatch import (
+    SUMMARY_WIDTH, check_megabatch, megabatch_enabled, megabatch_stats,
+    reset_megabatch_stats,
+)
+from jepsen_tpu.serve import buckets
+from jepsen_tpu.synth import cas_register_history, corrupt_reads
+
+
+@pytest.fixture(scope="module")
+def model():
+    return get_model("cas-register")
+
+
+def mixed_histories(n=24, seed0=900):
+    """Histories of deliberately mixed length (early-retiring short lanes
+    next to long ones) with every 4th refuted by a corrupted read."""
+    hs = []
+    for i in range(n):
+        n_ops = (10, 40, 80, 25)[i % 4] + (i % 3) * 4
+        h = cas_register_history(n_ops, concurrency=4, crash_p=0.01,
+                                 seed=seed0 + i)
+        if i % 4 == 3:
+            h = corrupt_reads(h, n=1, seed=i)
+        hs.append(h)
+    return hs
+
+
+def result_key(r):
+    """The per-history facts that must be packing-invariant."""
+    return (r["valid"], r.get("configs-explored"),
+            (r.get("op") or {}).get("index"))
+
+
+class TestParity:
+    def test_matches_check_batch_and_oracle(self, model):
+        hs = mixed_histories(24)
+        ref = check_batch(model, hs)
+        got = check_megabatch(model, hs, lanes=8)
+        assert [result_key(r) for r in got] \
+            == [result_key(r) for r in ref]
+        for h, g in zip(hs, got):
+            assert g["valid"] == wgl_cpu.check(CASRegister(), h)["valid"]
+        assert sum(1 for g in got if g["valid"] is False) == 6
+
+    def test_refuting_op_rides(self, model):
+        hs = mixed_histories(8)
+        got = check_megabatch(model, hs, lanes=4)
+        bad = [g for g in got if g["valid"] is False]
+        assert bad and all("op" in g and "index" in g["op"] for g in bad)
+        assert all(g["analyzer"] == "wgl-tpu-megabatch" for g in got)
+
+    def test_empty_and_single(self, model):
+        assert check_megabatch(model, []) == []
+        h = cas_register_history(30, concurrency=3, seed=1)
+        (r,) = check_megabatch(model, [h])
+        assert r["valid"] == wgl_cpu.check(CASRegister(), h)["valid"]
+
+
+class TestPackingInvariance:
+    def test_shuffle_and_group_size_fuzz(self, model):
+        import random
+        hs = mixed_histories(20, seed0=950)
+        ref = {i: result_key(r)
+               for i, r in enumerate(check_megabatch(model, hs, lanes=4))}
+        # the oracle pins the verdicts the invariance is measured against
+        oracle = [wgl_cpu.check(CASRegister(), h)["valid"] for h in hs]
+        assert [ref[i][0] for i in range(len(hs))] == oracle
+        rng = random.Random(7)
+        for lanes, quantum in ((8, 1), (16, None), (64, 2)):
+            order = list(range(len(hs)))
+            rng.shuffle(order)
+            got = check_megabatch(model, [hs[i] for i in order],
+                                  lanes=lanes, refill_quantum=quantum)
+            assert [result_key(r) for r in got] \
+                == [ref[i] for i in order]
+
+    def test_refill_boundaries_are_invariant(self, model, monkeypatch):
+        # Tiny groups + quantum 1: every retire is a refill boundary.
+        monkeypatch.setattr(mb, "MAX_LANES_PER_GROUP", 4)
+        hs = mixed_histories(18, seed0=975)
+        ref = [result_key(r) for r in check_batch(model, hs)]
+        reset_megabatch_stats()
+        got = check_megabatch(model, hs, lanes=4, refill_quantum=1)
+        st = megabatch_stats()
+        assert [result_key(r) for r in got] == ref
+        assert st["refills"] > 0 and st["lanes_refilled"] > 0
+        assert st["groups"] >= 2     # grouped vmaps, one executable
+
+
+class TestEscalation:
+    def test_overflow_lanes_escalate_with_parity(self, model):
+        hs = mixed_histories(12, seed0=990)
+        ref = [result_key(r) for r in check_batch(model, hs)]
+        reset_megabatch_stats()
+        got = check_megabatch(model, hs, lanes=8, capacity=8)
+        assert megabatch_stats()["escalated_lanes"] > 0
+        assert [result_key(r) for r in got] == ref
+
+
+class TestReadbackDiscipline:
+    def test_o1_summary_readback(self, model):
+        hs = mixed_histories(20)
+        reset_megabatch_stats()
+        check_megabatch(model, hs, lanes=8, transfer_guard=True)
+        st = megabatch_stats()
+        # per-dispatch readback is exactly SUMMARY_WIDTH ints; everything
+        # else is a (refill-amortized) harvest
+        assert st["summary_ints"] == st["summary_reads"] * SUMMARY_WIDTH
+        assert 0 < st["summary_reads"] <= st["dispatches"]
+        assert st["harvests"] <= st["refills"] + st["groups"]
+        assert st["lanes_retired"] == len(hs)
+
+    def test_stats_reach_serve_metrics(self, model):
+        from jepsen_tpu.serve.metrics import Metrics
+        reset_megabatch_stats()
+        check_megabatch(model, mixed_histories(8), lanes=4)
+        snap = Metrics().snapshot()
+        assert snap["megabatch"]["dispatches"] > 0
+        assert "group_reuses" in snap["engine-cache"]
+
+
+class TestGroupReuses:
+    def test_lru_counts_group_reuse_separately(self):
+        c = _LRUCache(4)
+        c.put("k", "v")
+        assert c.get("k") == "v"
+        assert c.get("k", group_reuse=True) == "v"
+        assert c.get("missing", group_reuse=True) is None
+        st = c.stats()
+        assert st["hits"] == 1 and st["group_reuses"] == 1
+        assert st["misses"] == 1
+
+    def test_megabatch_groups_reuse_one_executable(self, model,
+                                                   monkeypatch):
+        monkeypatch.setattr(mb, "MAX_LANES_PER_GROUP", 4)
+        before = pbatch.engine_cache_stats()["group_reuses"]
+        check_megabatch(model,
+                        [cas_register_history(20, concurrency=3,
+                                              seed=40 + i)
+                         for i in range(16)], lanes=16)
+        assert pbatch.engine_cache_stats()["group_reuses"] > before
+
+
+class TestLaneLadder:
+    def test_mega_lane_bucket(self):
+        assert buckets.mega_lane_bucket(1) == 1
+        assert buckets.mega_lane_bucket(600) == 1024
+        assert buckets.mega_lane_bucket(5000) == buckets.MAX_MEGA_LANES
+        assert buckets.MAX_MEGA_LANES >= 512  # grouped-vmap territory
+
+    def test_enabled_knob(self, monkeypatch):
+        monkeypatch.delenv("JEPSEN_TPU_MEGABATCH", raising=False)
+        assert megabatch_enabled()
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "0")
+        assert not megabatch_enabled()
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "off")
+        assert not megabatch_enabled()
+
+    def test_staging_depth_knob(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_STAGING_DEPTH", "3")
+        assert mb.staging_depth_default() == 3
+        monkeypatch.setenv("JEPSEN_TPU_STAGING_DEPTH", "bogus")
+        assert mb.staging_depth_default() == 2
+
+
+class TestSchedulerRouting:
+    def test_small_wgl_cells_route_megabatch(self, monkeypatch):
+        from jepsen_tpu.serve import CheckService
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "1")
+        with CheckService(max_lanes=8) as svc:
+            reqs = [svc.submit(cas_register_history(30, seed=70 + i),
+                               kind="wgl", model="cas-register")
+                    for i in range(6)]
+            rs = [r.wait(timeout=300.0) for r in reqs]
+            snap = svc.metrics.snapshot()
+        assert all(r["valid"] is True for r in rs)
+        assert snap["counters"].get("megabatch-dispatches", 0) > 0
+        assert snap["counters"].get("megabatch-lanes", 0) >= 6
+
+    def test_kill_switch_restores_barrier_path(self, monkeypatch):
+        from jepsen_tpu.serve import CheckService
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "0")
+        with CheckService(max_lanes=8) as svc:
+            r = svc.submit(cas_register_history(30, seed=80),
+                           kind="wgl", model="cas-register")
+            assert r.wait(timeout=300.0)["valid"] is True
+            snap = svc.metrics.snapshot()
+        assert snap["counters"].get("megabatch-dispatches", 0) == 0

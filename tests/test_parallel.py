@@ -152,9 +152,9 @@ class TestSharded:
         calls = []
         orig = batch_mod._run_lanes
 
-        def spy(model, preps, window, cap, *a):
+        def spy(model, preps, window, cap, *a, **kw):
             calls.append((len(preps), cap))
-            return orig(model, preps, window, cap, *a)
+            return orig(model, preps, window, cap, *a, **kw)
 
         monkeypatch.setattr(batch_mod, "_run_lanes", spy)
         easy = [cas_register_history(60, concurrency=3, crash_p=0.0, seed=s)

@@ -190,8 +190,10 @@ class TestConcurrentStress:
         assert snap["counters"]["requests-completed"] >= 64
         assert snap["occupancy"]["lanes-used"] > 0
         assert snap["engine-cache"]["recompiles"] >= 1
-        # bucketing holds recompiles far below the request count
-        assert snap["engine-cache"]["recompiles"] < 30
+        # bucketing holds recompiles far below the request count (the
+        # megabatch path adds its own step/harvest/reset program family
+        # per bucket shape on top of the barrier engines)
+        assert snap["engine-cache"]["recompiles"] < 48
 
 
 class TestDeadlines:
