@@ -81,6 +81,7 @@ TIER_TIMEOUT_S = {
     "sched": 120 if SMOKE else 300,
     "multireg": 300 if SMOKE else 1500,
     "elle": 300 if SMOKE else 1200,
+    "fleet": 300 if SMOKE else 900,
 }
 
 
@@ -635,6 +636,58 @@ def tier_setup2():
     emit({"setup_s": round(time.time() - t0, 1)})
 
 
+def tier_fleet():
+    """Fleet serving tier: the routed 3-worker fleet vs one CheckService
+    on the same workload (the price of fault tolerance on a healthy
+    fleet), plus the recovery wall when a worker is killed mid-campaign
+    (the bound the chaos smoke asserts against the deadline budget)."""
+    from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.serve.fleet import Fleet
+    from jepsen_tpu.synth import cas_register_history
+    n = 24 if SMOKE else 96
+    hists = [cas_register_history(60, concurrency=4, seed=s)
+             for s in range(n)]
+
+    def run(svc):
+        t0 = time.time()
+        reqs = [svc.submit(h, kind="wgl", model="cas-register",
+                           deadline_s=120.0) for h in hists]
+        vals = [r.wait(timeout=300)["valid"] for r in reqs]
+        return time.time() - t0, vals
+
+    solo = CheckService(max_lanes=32, capacity=64)
+    run(solo)                                   # warm the bucket ladder
+    t_solo, v_solo = run(solo)
+    solo.close(timeout=60.0)
+
+    fleet = Fleet(workers=3, max_lanes=32, capacity=64,
+                  default_deadline_s=120.0)
+    run(fleet)
+    t_fleet, v_fleet = run(fleet)
+    assert v_fleet == v_solo, "fleet verdicts diverge from solo service"
+
+    # Recovery wall: kill a worker with the campaign in flight; every
+    # cell must still complete (rerouted/hedged to the siblings).
+    reqs = [fleet.submit(h, kind="wgl", model="cas-register",
+                         deadline_s=120.0) for h in hists]
+    t0 = time.time()
+    fleet.workers[0].kill()
+    v_kill = [r.wait(timeout=300)["valid"] for r in reqs]
+    recovery_s = time.time() - t0
+    fleet.restart_worker(0)
+    snap = fleet.metrics.snapshot()
+    fleet.close(timeout=60.0)
+    assert v_kill == v_solo, "verdicts diverged under worker kill"
+    emit({"n_histories": n,
+          "solo_s": round(t_solo, 3),
+          "fleet_s": round(t_fleet, 3),
+          "fleet_overhead": round(t_fleet / t_solo, 2) if t_solo else None,
+          "kill_recovery_s": round(recovery_s, 3),
+          "rerouted": snap["counters"].get("cells-rerouted", 0),
+          "hedges": snap["counters"].get("hedges", 0),
+          "worker_failures": snap["counters"].get("worker-failures", 0)})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -649,6 +702,7 @@ TIER_FNS = {
     "sched": tier_sched,
     "multireg": tier_multireg,
     "elle": tier_elle,
+    "fleet": tier_fleet,
 }
 
 
@@ -727,7 +781,7 @@ def main():
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
                  "batch_sweep", "ablation_on", "ablation_off", "setup2",
-                 "sched", "multireg", "elle"):
+                 "sched", "multireg", "elle", "fleet"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "
@@ -818,6 +872,11 @@ def main():
                               "cpu_histories_per_sec_socket",
                               "device_vs_socket", "break_even_cores",
                               "host_cores", "analyzer")},
+            "fleet": {k: v for k, v in tiers["fleet"].items()
+                      if k in ("status", "wall_s", "n_histories",
+                               "solo_s", "fleet_s", "fleet_overhead",
+                               "kill_recovery_s", "rerouted", "hedges",
+                               "worker_failures")},
             "batch_vs_cpu_socket": (tiers["batch"].get("shapes") or {}).get(
                 "512", {}),
             "batch_sweep": {

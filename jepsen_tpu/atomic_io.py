@@ -19,19 +19,45 @@ import tempfile
 from typing import Callable, Iterator
 
 
-def _fsync_dir(d: str) -> None:
-    """Durable rename: fsync the directory entry (best-effort — some
-    filesystems/platforms refuse O_RDONLY dir fds)."""
+def fsync_dir(d: str) -> bool:
+    """Durable rename: fsync the directory so the *entry* created by an
+    ``os.replace`` survives a power cut, not just the file's data blocks.
+    A journal whose rename is still only in the page cache silently
+    vanishes on power loss — the fleet would "recover" zero pending cells
+    and a monitor resume would fall back cold.  Best-effort (some
+    filesystems/platforms refuse directory fds); True = the fsync ran."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
     try:
-        fd = os.open(d, os.O_RDONLY)
+        fd = os.open(d, flags)
     except OSError:
-        return
+        return False
     try:
         os.fsync(fd)
+        return True
     except OSError:
-        pass
+        return False
     finally:
         os.close(fd)
+
+
+def durable_mkdir(path: str) -> str:
+    """``makedirs`` whose directory entries are themselves durable: after
+    creating any missing component, fsync its parent so a crash right
+    after mkdir can't orphan the files later written inside.  Used for
+    fleet journal directories; idempotent.  Returns ``path``."""
+    path = os.path.abspath(path)
+    missing = []
+    p = path
+    while p and not os.path.isdir(p):
+        missing.append(p)
+        parent = os.path.dirname(p)
+        if parent == p:
+            break
+        p = parent
+    os.makedirs(path, exist_ok=True)
+    for d in reversed(missing):
+        fsync_dir(os.path.dirname(d))
+    return path
 
 
 @contextlib.contextmanager
@@ -51,7 +77,7 @@ def atomic_path(path: str) -> Iterator[str]:
         finally:
             os.close(fd)
         os.replace(tmp, path)
-        _fsync_dir(d)
+        fsync_dir(d)
     except BaseException:
         with contextlib.suppress(OSError):
             os.unlink(tmp)

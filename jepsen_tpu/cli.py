@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -123,6 +124,13 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                     help="lanes per device dispatch")
     ps.add_argument("--max-queue", type=int, default=4096,
                     help="admission-control queue depth (cells)")
+    ps.add_argument("--workers", type=int, default=3,
+                    help="checking-service worker replicas (the fault-"
+                         "tolerant fleet; 1 = a single CheckService)")
+    ps.add_argument("--journal-dir", default=None,
+                    help="fleet in-flight journal directory (default "
+                         "<store>/fleet-journal); 'none' disables "
+                         "crash journaling")
 
     pq = sub.add_parser("submit",
                         help="submit a stored history to a running serve")
@@ -174,10 +182,26 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
         from jepsen_tpu.web import serve
         service = None
         if not args.no_service:
-            from jepsen_tpu.serve import CheckService
-            service = CheckService(store_base=args.store,
-                                   max_lanes=args.max_lanes,
-                                   max_queue_cells=args.max_queue)
+            # The fleet is the default serving path: N worker services
+            # behind the fault-tolerant router (serve/fleet.py).
+            # --workers 1 keeps the old single-service behaviour.
+            if max(1, args.workers) > 1:
+                from jepsen_tpu.serve.fleet import Fleet
+                jdir = args.journal_dir
+                if jdir is None:
+                    jdir = os.path.join(args.store, "fleet-journal")
+                elif jdir == "none":
+                    jdir = None
+                service = Fleet(workers=args.workers,
+                                store_base=args.store,
+                                journal_dir=jdir,
+                                max_lanes=args.max_lanes,
+                                max_queue_cells=args.max_queue)
+            else:
+                from jepsen_tpu.serve import CheckService
+                service = CheckService(store_base=args.store,
+                                       max_lanes=args.max_lanes,
+                                       max_queue_cells=args.max_queue)
         try:
             serve(base=args.store, port=args.port, service=service)
         finally:

@@ -37,15 +37,33 @@ class RetryPolicy:
     delay jittered by ±``jitter`` (a fraction) so a cluster-wide flap
     doesn't have every node's session reconnect in lockstep.  ``retry_on``
     is the exception allowlist (connection-level failures only, by
-    default — see module docstring)."""
+    default — see module docstring).
+
+    ``decorrelated=True`` switches to decorrelated jitter: each delay is
+    drawn uniformly from ``[backoff_s, 3 * previous_delay]`` (capped at
+    ``max_backoff_s``) instead of a jittered deterministic ladder.  The
+    fleet's hedge/reroute loop uses this: with plain ±25% jitter, N
+    workers that all saw the same sibling die retry inside one narrow
+    band and arrive as a synchronized storm on the survivor; the
+    decorrelated draw spreads the whole interval."""
 
     tries: int = 5
     backoff_s: float = 1.0
     max_backoff_s: float = 30.0
     jitter: float = 0.25
+    decorrelated: bool = False
     retry_on: Tuple[Type[BaseException], ...] = (RemoteConnectError,)
 
-    def delay(self, attempt: int, rng=random) -> float:
+    def delay(self, attempt: int, rng=random,
+              prev: Optional[float] = None) -> float:
+        """The pause before retry ``attempt + 1``.  ``prev`` (the delay
+        actually slept last time) only matters to the decorrelated mode;
+        callers that don't thread it through still get valid — merely
+        less spread-out — delays."""
+        if self.decorrelated:
+            lo = max(0.0, self.backoff_s)
+            hi = max(lo, 3.0 * (prev if prev is not None else lo))
+            return min(rng.uniform(lo, hi), self.max_backoff_s)
         d = min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
         if self.jitter:
             d *= 1.0 + rng.uniform(-self.jitter, self.jitter)
@@ -90,6 +108,7 @@ def retrying(f: Callable[[], Any], policy: Optional[RetryPolicy] = None,
     are swallowed — the next attempt will surface them."""
     policy = policy or RetryPolicy()
     last: Optional[BaseException] = None
+    prev_delay: Optional[float] = None
     for attempt in range(max(1, policy.tries)):
         try:
             return f()
@@ -99,7 +118,8 @@ def retrying(f: Callable[[], Any], policy: Optional[RetryPolicy] = None,
                 break
             logger.warning("retriable failure (attempt %d/%d): %s",
                            attempt + 1, policy.tries, e)
-            sleep(policy.delay(attempt))
+            prev_delay = policy.delay(attempt, prev=prev_delay)
+            sleep(prev_delay)
             if on_retry is not None:
                 try:
                     on_retry(attempt, e)

@@ -16,7 +16,8 @@ the tuple (earlier = outermost-permitted).
 
 The declared order mirrors the call graph today:
 
-    service -> scheduler -> request -> metrics
+    fleet -> service -> scheduler -> request -> metrics
+    router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
     engine-cache (leaf: parallel.batch's LRU, acquired under anything)
 """
@@ -27,6 +28,8 @@ import re
 from typing import List, Optional, Tuple
 
 LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
+    ("fleet",
+     [(r"serve/fleet\.py$", r"^self\._(lock|cond)$")]),
     ("service",
      [(r"serve/service\.py$", r"^self\._lock$")]),
     ("scheduler",
@@ -37,6 +40,8 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
       (r"", r"^(c|cell)\.request\._lock$")]),
     ("metrics",
      [(r"serve/metrics\.py$", r"^self\._lock$")]),
+    ("router",
+     [(r"serve/router\.py$", r"^self\._lock$")]),
     ("monitor-flush",
      [(r"monitor/__init__\.py$", r"^self\._flush_lock$")]),
     ("monitor-registry",

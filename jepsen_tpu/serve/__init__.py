@@ -16,8 +16,14 @@ Module map: ``request`` (requests/cells/trace spans), ``decompose``
 continuous-batch device loop: priority queue, admission, backpressure,
 deadlines, host-tier degradation), ``aggregate`` (verdict merge),
 ``metrics`` (counters/occupancy/traces for web.py's ``/metrics``),
-``service`` (the CheckService facade + core.analyze routing).  See
-docs/serving.md.
+``service`` (the CheckService facade + core.analyze routing),
+``router`` (rendezvous hashing + per-worker circuit breakers/health),
+``fleet`` (the fault-tolerant multi-worker tier: N worker services,
+retry/hedge, crash journal), ``chaos`` (the fleet's self-nemesis).  See
+docs/serving.md and docs/robustness.md.
+
+``Fleet`` is imported lazily (``from jepsen_tpu.serve.fleet import
+Fleet``) to keep the plain single-service import path light.
 """
 
 from jepsen_tpu.serve.request import Cell, Request  # noqa: F401

@@ -75,6 +75,26 @@ def lane_bucket(n_lanes: int, cap: int = MAX_LANE_BUCKET) -> int:
     return min(pow2_at_least(max(1, n_lanes), 1), cap)
 
 
+#: floor of the per-worker lane ladder: a fleet worker never dispatches
+#: narrower groups than this, however many siblings share the device.
+MIN_WORKER_LANES = 8
+
+
+def worker_lane_share(total_lanes: int, n_workers: int) -> int:
+    """A fleet worker's per-dispatch lane budget when one device's lane
+    allowance is split across N workers: ceil-divide, then round UP onto
+    the power-of-two ladder (floor :data:`MIN_WORKER_LANES`).  Rounding
+    up — not down — keeps every worker's dispatches on the same ladder
+    rungs a solo service would use, so the fleet and the single-service
+    oracle share compiled-engine cache entries instead of doubling the
+    shape universe."""
+    n = max(1, n_workers)
+    share = (max(1, total_lanes) + n - 1) // n
+    return min(MAX_LANE_BUCKET,
+               pow2_at_least(max(share, MIN_WORKER_LANES),
+                             MIN_WORKER_LANES))
+
+
 #: ceiling of the megabatch lane-count ladder: concurrently-resident
 #: device lanes across a bucket's groups.  Lanes beyond MAX_LANE_BUCKET
 #: run as grouped vmaps of <= MAX_LANE_BUCKET width that reuse ONE

@@ -1,0 +1,192 @@
+"""The fleet's self-nemesis: fault injection against our own serving tier.
+
+The paper's discipline, turned inward.  We test databases by injecting
+faults and checking that histories still verify; the fleet is itself a
+distributed system (N workers, a router, retries, a journal), so it gets
+the same treatment: a nemesis that kills and pauses workers, delays and
+drops their responses, and poisons one worker's device dispatches
+mid-campaign — while a parity harness (scripts/fleet_chaos_smoke.py)
+asserts the surviving fleet still produces, lane for lane, the verdicts
+a cold single-service oracle produces, and recovers within a bounded
+time.
+
+Every fault registers its undo in the same :class:`FaultRegistry` the
+real nemeses use (nemesis/registry.py): the moment a fault goes live its
+heal closure is on the ledger, so a harness that crashes mid-chaos still
+heals everything in LIFO order via ``heal_all`` — no test exits with a
+worker secretly poisoned.
+
+Faults are implemented by instance-patching the target worker's
+scheduler (the in-process analogue of SIGKILL / SIGSTOP / netem delay /
+packet drop / disk corruption):
+
+- ``kill_worker``    — abrupt service death, queued cells evicted
+  (undo restarts the worker slot);
+- ``pause_worker``   — every dispatch stalls ``stall_s`` first (a
+  SIGSTOPped or GC-wedged process as seen by its clients);
+- ``delay_responses``— verdicts land late by ``delay_s`` (slow network
+  path back to the router);
+- ``drop_responses`` — a verdict is silently discarded with probability
+  ``p`` (lost response packet: the cell completed nowhere, the fleet's
+  hedge must cover it);
+- ``poison_dispatch``— both device *and* host dispatch tiers raise (bad
+  device state / corrupted executable): the worker's cells resolve as
+  worker-failure unknowns, the breaker opens, the router reroutes.
+
+Undo closures are idempotent; a fault injected on a worker that has
+since been restarted heals as a no-op (the patches died with the old
+service object).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.nemesis.registry import FaultRegistry
+
+
+def _unpatch(obj: Any, name: str) -> None:
+    """Drop an instance-level patch, restoring the class method.
+    Idempotent — healing a healed worker is a no-op."""
+    obj.__dict__.pop(name, None)
+
+
+class ChaosNemesis:
+    """Fault injector for one :class:`~jepsen_tpu.serve.fleet.Fleet`.
+
+    Usage::
+
+        reg = FaultRegistry()
+        chaos = ChaosNemesis(fleet, registry=reg)
+        chaos.kill_worker(0)          # mid-campaign
+        ...
+        chaos.heal("fleet:kill:0")    # restart it
+        chaos.heal_all()              # or unwind everything, LIFO
+    """
+
+    def __init__(self, fleet, registry: Optional[FaultRegistry] = None,
+                 seed: int = 0):
+        self.fleet = fleet
+        self.registry = registry if registry is not None else FaultRegistry()
+        self._rng = random.Random(seed)
+        self.injected: Dict[str, str] = {}  # key -> description (ledger)
+        self._undos: Dict[str, Any] = {}
+
+    # -- bookkeeping ------------------------------------------------------
+    def _register(self, key: str, undo, description: str) -> str:
+        self.registry.register(key, undo, description)
+        self.injected[key] = description
+        self._undos[key] = undo
+        return key
+
+    def heal(self, key: str) -> bool:
+        """Heal one fault now (and resolve its registry entry, so
+        heal_all won't re-run its undo)."""
+        undo = self._undos.get(key)
+        if undo is None or not self.registry.resolve(key):
+            return False
+        undo()
+        return True
+
+    def heal_all(self) -> Dict[str, str]:
+        return self.registry.heal_all()
+
+    # -- faults -----------------------------------------------------------
+    def kill_worker(self, wid: int) -> str:
+        """SIGKILL analogue: abrupt worker death.  Queued cells are
+        evicted unresolved — the fleet's drivers detect the death and
+        reroute; the undo restarts the worker slot with a fresh service."""
+        worker = self.fleet.workers[wid]
+        worker.kill()
+        self.fleet.metrics.inc("chaos-kills")
+
+        def undo():
+            self.fleet.restart_worker(wid)
+
+        return self._register(f"fleet:kill:{wid}", undo,
+                              f"worker {wid} killed")
+
+    def pause_worker(self, wid: int, stall_s: float = 0.5) -> str:
+        """SIGSTOP analogue: every dispatch on this worker stalls
+        ``stall_s`` before running.  The worker stays alive (heartbeats
+        pass) but its latency EWMA climbs and deadline-risky cells hedge
+        to siblings."""
+        sched = self.fleet.workers[wid].service._sched
+        orig = sched._process
+
+        def paused(cells):
+            time.sleep(stall_s)
+            return orig(cells)
+
+        sched._process = paused
+        self.fleet.metrics.inc("chaos-pauses")
+        return self._register(f"fleet:pause:{wid}",
+                              lambda: _unpatch(sched, "_process"),
+                              f"worker {wid} paused {stall_s}s/dispatch")
+
+    def delay_responses(self, wid: int, delay_s: float = 0.25) -> str:
+        """netem-delay analogue: verdicts from this worker land late."""
+        sched = self.fleet.workers[wid].service._sched
+        orig = sched._finalize
+
+        def delayed(cell, result):
+            time.sleep(delay_s)
+            return orig(cell, result)
+
+        sched._finalize = delayed
+        self.fleet.metrics.inc("chaos-delays")
+        return self._register(f"fleet:delay:{wid}",
+                              lambda: _unpatch(sched, "_finalize"),
+                              f"worker {wid} responses +{delay_s}s")
+
+    def drop_responses(self, wid: int, p: float = 1.0) -> str:
+        """Packet-loss analogue: a finished cell's verdict is silently
+        discarded with probability ``p`` — as far as anyone can tell, the
+        check completed nowhere.  The cell's fleet driver must cover this
+        with a hedge (it cannot distinguish a dropped response from a
+        slow worker; nobody can — that's the point)."""
+        sched = self.fleet.workers[wid].service._sched
+        orig = sched._finalize
+        rng = self._rng
+
+        def dropped(cell, result):
+            if rng.random() < p:
+                self.fleet.metrics.inc("chaos-dropped-responses")
+                return None
+            return orig(cell, result)
+
+        sched._finalize = dropped
+        self.fleet.metrics.inc("chaos-drops")
+        return self._register(f"fleet:drop:{wid}",
+                              lambda: _unpatch(sched, "_finalize"),
+                              f"worker {wid} responses dropped p={p}")
+
+    def poison_dispatch(self, wid: int) -> str:
+        """Corrupted-device analogue: every dispatch on this worker fails
+        at BOTH tiers (device engine and host fallback), so its cells
+        resolve as worker-failure unknowns.  This is the fault that
+        proves the verdict lattice: the poisoned worker must never turn
+        a checkable history into ``false`` — the router reroutes, the
+        breaker opens, and the verdict comes from a healthy sibling."""
+        sched = self.fleet.workers[wid].service._sched
+
+        def bad_dispatch(*a, **kw):
+            raise RuntimeError("chaos: poisoned device dispatch")
+
+        def bad_fallback(*a, **kw):
+            raise RuntimeError("chaos: poisoned host fallback")
+
+        sched._dispatch_wgl = bad_dispatch
+        sched._dispatch_elle = bad_dispatch
+        sched._host_fallback = bad_fallback
+        self.fleet.metrics.inc("chaos-poisons")
+
+        def undo():
+            _unpatch(sched, "_dispatch_wgl")
+            _unpatch(sched, "_dispatch_elle")
+            _unpatch(sched, "_host_fallback")
+
+        return self._register(f"fleet:poison:{wid}", undo,
+                              f"worker {wid} dispatches poisoned")

@@ -1,0 +1,757 @@
+"""Fleet: a fault-tolerant multi-worker serving tier.
+
+One :class:`CheckService` is still one scheduler thread on one device: a
+wedged dispatch, a crashed loop, or one slow lane group takes the whole
+service down.  The fleet runs N worker CheckServices (in-process
+replicas today, one per host tomorrow — the submit surface is already
+process-shaped), each pinned to its own slice of the host's devices,
+behind a router that:
+
+- hash-routes cells by key (rendezvous hashing, serve/router.py) so a
+  key's repeat shapes keep hitting the same warm engine cache;
+- health-checks workers (heartbeat thread + per-worker latency/error
+  EWMAs) and circuit-breaks a failing one (open → half-open probe →
+  close);
+- retries and hedges deadline-risky cells onto siblings under a
+  control/retry.py :class:`RetryPolicy` with decorrelated jitter (a
+  worker death must not synchronize the survivors' retries into a
+  storm);
+- journals in-flight cells (atomic_io) so a crash — of a worker or of
+  the whole fleet process — re-enqueues, never drops and never
+  fabricates, its pending work.
+
+Verdict discipline is the repo's: on every unrecoverable path the cell
+degrades to ``valid: "unknown"``; a fleet failure can never produce a
+``false`` the single-service oracle would not.  P-compositionality
+(arXiv:1504.00204) is what makes all of this sound: cells are
+independently-checkable units whose merge is associative, so a cell may
+be retried, relocated, or hedged without changing any verdict.
+
+The self-nemesis proof lives in serve/chaos.py +
+scripts/fleet_chaos_smoke.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from jepsen_tpu import atomic_io
+from jepsen_tpu.control.retry import RetryPolicy
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.serve import buckets
+from jepsen_tpu.serve.aggregate import aggregate, expired_result
+from jepsen_tpu.serve.decompose import decompose
+from jepsen_tpu.serve.metrics import Metrics, mono_now
+from jepsen_tpu.serve.request import Cell, KIND_WGL, Request
+from jepsen_tpu.serve.router import (
+    CircuitBreaker, OPEN, Router, WorkerHealth,
+)
+from jepsen_tpu.serve.service import (
+    CheckService, ServiceClosed, ServiceSaturated, build_spec,
+    submit_kwargs,
+)
+
+log = logging.getLogger("jepsen.serve.fleet")
+
+#: completion-poll quantum while waiting on a worker-side request
+POLL_S = 0.005
+#: hedge trigger when a request carries no deadline
+DEFAULT_HEDGE_S = 1.0
+#: give-up bound for a no-deadline cell stuck on an unresponsive worker
+NO_DEADLINE_WAIT_S = 120.0
+#: default per-request budget — the fleet always runs with deadlines
+#: unless the caller explicitly disables them (deadlines are what make
+#: drop/delay faults recoverable instead of hangs)
+DEFAULT_FLEET_DEADLINE_S = 60.0
+
+#: worker-produced error strings that mean "the worker failed", not "the
+#: history is undecidable" — these reroute to a sibling; every other
+#: unknown is a legitimate verdict and is passed through.  Deliberately
+#: narrow: retrying a budget-truncation unknown would loop forever.
+_WORKER_FAILURE_ERRORS = (
+    "scheduler dispatch crashed",
+    "device and host tiers both failed",
+)
+
+
+def _device_sets(n: int) -> List[list]:
+    """Partition the host's accelerators round-robin across N workers.
+    Fewer devices than workers shares them (CPU CI: every worker pins
+    the one CPU device); no jax at all degrades to unpinned."""
+    try:
+        import jax
+        devs = list(jax.devices())
+    except Exception:  # noqa: BLE001 — fleet works without a backend
+        devs = []
+    if not devs:
+        return [[] for _ in range(n)]
+    if len(devs) >= n:
+        return [devs[i::n] for i in range(n)]
+    return [[devs[i % len(devs)]] for i in range(n)]
+
+
+class FleetWorker:
+    """One worker slot: a CheckService plus its circuit, health, and
+    device pin.  The slot survives its service — ``restart`` replaces
+    the dead service in place, so the router's worker list stays
+    index-stable across crashes."""
+
+    def __init__(self, wid: int, make_service: Callable[[], CheckService],
+                 devices: Optional[list] = None,
+                 fail_threshold: int = 3, open_s: float = 1.0):
+        self.wid = wid
+        self.devices = devices or []
+        self._make_service = make_service
+        self.service = make_service()
+        self.breaker = CircuitBreaker(fail_threshold=fail_threshold,
+                                      open_s=open_s)
+        self.health = WorkerHealth()
+        self.generation = 0
+
+    def alive(self) -> bool:
+        return self.service.alive()
+
+    def kill(self) -> list:
+        """Crash this worker (chaos fault / decommission): abrupt service
+        kill, queued worker-side cells evicted.  The fleet's cell owners
+        detect the death and reroute — nothing here touches fleet state."""
+        return self.service.kill()
+
+    def restart(self) -> None:
+        """Replace a dead service with a fresh one and reset the circuit
+        (a restarted worker earns its traffic back through the normal
+        closed-state accounting)."""
+        try:
+            self.service.kill()
+        except Exception:  # noqa: BLE001 — it's already dead
+            pass
+        self.service = self._make_service()
+        self.generation += 1
+        self.breaker.reset()
+
+    def status(self) -> Dict[str, Any]:
+        try:
+            ping = self.service.ping()
+        except Exception:  # noqa: BLE001
+            ping = {"alive": False, "queue-depth": None,
+                    "inflight-cells": None}
+        return {"worker": self.wid,
+                "alive": bool(ping.get("alive")),
+                "circuit": self.breaker.state,
+                "queue-depth": ping.get("queue-depth"),
+                "inflight-cells": ping.get("inflight-cells"),
+                "generation": self.generation,
+                "devices": [str(d) for d in self.devices],
+                **self.health.snapshot()}
+
+
+class FleetJournal:
+    """The in-flight cell journal: an atomically-replaced JSON snapshot
+    of every cell the fleet has admitted but not finished, durable
+    through the atomic_io rename + directory-fsync discipline.  A fleet
+    (or host) crash re-enqueues this file's cells on restart —
+    :meth:`recover` — so admitted work is never silently dropped; a cell
+    whose deadline budget is already spent is returned under
+    ``expired``, explicitly, rather than re-checked against a deadline
+    it can no longer meet.
+
+    Format (``fleet-journal.json``)::
+
+        {"version": 1,
+         "pending": {"<cid>": {"request-id": int, "kind": "wgl"|"elle",
+                               "key": ..., "deadline-rem-s": float|null,
+                               "spec": {...build_spec kwargs, model by
+                                        name...},
+                               "ops": [history.jsonl op dicts]}}}
+    """
+
+    VERSION = 1
+    FILENAME = "fleet-journal.json"
+
+    def __init__(self, journal_dir: str):
+        self.dir = atomic_io.durable_mkdir(journal_dir)
+        self.path = os.path.join(self.dir, self.FILENAME)
+        self._jlock = threading.Lock()    # pending-map mutations
+        self._wlock = threading.Lock()    # one disk writer at a time
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self.writes = 0
+
+    @staticmethod
+    def _spec_lite(req: Request) -> Dict[str, Any]:
+        spec = dict(req.spec)
+        if req.kind == KIND_WGL:
+            spec["model"] = spec["model"].name
+        return spec
+
+    def record(self, req: Request, cells: List[Cell]) -> None:
+        entries = {}
+        for c in cells:
+            entries[c.cid] = {
+                "request-id": req.id, "kind": req.kind, "key": c.key,
+                "deadline-rem-s": req.remaining_s(),
+                "spec": self._spec_lite(req),
+                "ops": [op.to_dict() for op in c.history]}
+        with self._jlock:
+            self._pending.update(entries)
+        self._flush()
+
+    def complete(self, cid: str) -> None:
+        with self._jlock:
+            self._pending.pop(cid, None)
+        self._flush()
+
+    def pending_count(self) -> int:
+        with self._jlock:
+            return len(self._pending)
+
+    def _flush(self) -> None:
+        # Snapshot INSIDE the writer lock: whoever writes, writes the
+        # freshest state — a slow earlier writer can't clobber a newer
+        # snapshot with a stale one.
+        with self._wlock:
+            with self._jlock:
+                payload = {"version": self.VERSION,
+                           "pending": dict(self._pending)}
+            atomic_io.atomic_write(
+                self.path,
+                lambda f: json.dump(payload, f, default=str))
+            self.writes += 1
+
+    @classmethod
+    def recover(cls, journal_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+        """Read a (possibly crashed) fleet's journal back into
+        resubmittable work items: ``{"pending": [...], "expired":
+        [...]}``, each item ``{"cid", "key", "history", "kwargs"}`` where
+        ``kwargs`` feed :meth:`Fleet.submit` directly.  Entries whose
+        deadline budget was already spent when journaled land in
+        ``expired`` — recovery never invents deadline headroom."""
+        path = os.path.join(journal_dir, cls.FILENAME)
+        out: Dict[str, List[Dict[str, Any]]] = {"pending": [], "expired": []}
+        if not os.path.exists(path):
+            return out
+        with open(path) as f:
+            data = json.load(f)
+        for cid, e in sorted(data.get("pending", {}).items()):
+            spec = dict(e.get("spec") or {})
+            kwargs = {"kind": e.get("kind", KIND_WGL), **spec}
+            rem = e.get("deadline-rem-s")
+            if rem is not None:
+                kwargs["deadline_s"] = max(0.0, rem)
+            item = {"cid": cid, "key": e.get("key"),
+                    "history": History([Op.from_dict(d)
+                                        for d in e.get("ops", [])]),
+                    "kwargs": kwargs}
+            if rem is not None and rem <= 0:
+                out["expired"].append(item)
+            else:
+                out["pending"].append(item)
+        return out
+
+
+class _FleetMetrics(Metrics):
+    """The fleet's Metrics registry plus a ``fleet`` snapshot section
+    (per-worker status/circuits/journal) — web.py's ``/metrics`` payload
+    keeps one schema whether a CheckService or a Fleet is attached."""
+
+    def __init__(self, fleet: "Fleet"):
+        super().__init__()
+        self._fleet = fleet
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = super().snapshot()
+        snap["fleet"] = self._fleet.fleet_status()
+        return snap
+
+
+class Fleet:
+    """N worker CheckServices behind a router — the CheckService facade
+    (submit/check/try_route_analyze/metrics/close) at fleet scale, so
+    ``test["service"]``, the web front end, and the CLI take a Fleet
+    anywhere they take a service."""
+
+    def __init__(self, workers: int = 3, *,
+                 store_base: Optional[str] = None,
+                 journal_dir: Optional[str] = None,
+                 max_lanes: int = 64,
+                 max_queue_cells: int = 4096,
+                 default_deadline_s: Optional[float]
+                 = DEFAULT_FLEET_DEADLINE_S,
+                 mesh=None,
+                 capacity: Optional[int] = None,
+                 max_capacity: int = 65536,
+                 hedge_s: Optional[float] = None,
+                 heartbeat_s: float = 0.25,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker_fail_threshold: int = 3,
+                 breaker_open_s: float = 1.0,
+                 pin_devices: bool = True):
+        n = max(1, int(workers))
+        self.n_workers = n
+        self.max_queue_cells = max_queue_cells
+        self.default_deadline_s = default_deadline_s
+        self.hedge_s = hedge_s
+        self.heartbeat_s = heartbeat_s
+        lanes_each = buckets.worker_lane_share(max_lanes, n)
+        device_sets = _device_sets(n) if pin_devices else [[]] * n
+
+        def make_service(i: int) -> Callable[[], CheckService]:
+            devs = device_sets[i]
+
+            def make() -> CheckService:
+                return CheckService(
+                    max_queue_cells=max_queue_cells,
+                    max_lanes=lanes_each,
+                    store_base=store_base, mesh=mesh,
+                    capacity=capacity, max_capacity=max_capacity,
+                    device=devs[0] if devs else None)
+            return make
+
+        self.workers: List[FleetWorker] = [
+            FleetWorker(i, make_service(i), devices=device_sets[i],
+                        fail_threshold=breaker_fail_threshold,
+                        open_s=breaker_open_s)
+            for i in range(n)]
+        self.router = Router(self.workers)
+        self.metrics = _FleetMetrics(self)
+        # Decorrelated jitter by default: reroutes after a worker death
+        # must not arrive at the survivor in lockstep (retry storm).
+        self.retry_policy = retry_policy or RetryPolicy(
+            tries=4, backoff_s=0.02, max_backoff_s=0.5, decorrelated=True)
+        self._journal = (FleetJournal(journal_dir)
+                         if journal_dir else None)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(8, 4 * n), thread_name_prefix="fleet-cell")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._open_cells: Dict[str, Cell] = {}
+        self._cids = itertools.count(1)
+        self._submitted = 0
+        self._closed = False
+        self.metrics.bind(self.queue_depth, self._inflight)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="fleet-heartbeat")
+        self._hb_thread.start()
+
+    # -- submission -------------------------------------------------------
+    def _inflight(self) -> int:
+        snap = self.metrics._counters
+        return max(0, self._submitted
+                   - snap.get("requests-completed", 0))
+
+    def submit(self, history: History, *,
+               kind: str = KIND_WGL,
+               deadline_s: Optional[float] = None,
+               block: bool = True,
+               timeout: Optional[float] = None,
+               **kw) -> Request:
+        """Enqueue one history check across the fleet; same contract as
+        CheckService.submit, including the admission-race rule: a request
+        whose deadline expires while blocked on fleet backpressure
+        resolves ``unknown`` — never dropped, never false."""
+        if self._closed:
+            raise ServiceClosed("fleet is closed")
+        spec = build_spec(kind, **kw)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = Request(history, kind, spec, deadline_s=deadline_s)
+        cells = decompose(req)
+        for c in cells:
+            c.cid = f"{req.id}.{next(self._cids)}"
+        if not self._admit(cells, block=block, timeout=timeout):
+            if req.expired():
+                for c in cells:
+                    c.result = expired_result(kind)
+                self.metrics.inc("deadline-expired", len(cells))
+                self._count_submit(len(cells))
+                self.metrics.inc("cells-completed", len(cells))
+                self.metrics.inc("requests-completed")
+                req.finish(aggregate(req))
+                self.metrics.trace(req)
+                return req
+            self.metrics.inc("requests-rejected")
+            raise ServiceSaturated(
+                f"fleet at {self.queue_depth()}/{self.max_queue_cells} "
+                f"open cells; request of {len(cells)} cell(s) rejected")
+        self._count_submit(len(cells))
+        if self._journal is not None:
+            self._journal.record(req, cells)
+        for c in cells:
+            self._pool.submit(self._run_cell, c)
+        return req
+
+    def _count_submit(self, n_cells: int) -> None:
+        with self._lock:
+            self._submitted += 1
+        self.metrics.inc("requests-submitted")
+        self.metrics.inc("cells-submitted", n_cells)
+
+    def _admit(self, cells: List[Cell], block: bool,
+               timeout: Optional[float]) -> bool:
+        """Fleet-tier backpressure: all-or-nothing admission against the
+        fleet-wide open-cell count, bounded by the request deadline."""
+        req = cells[0].request
+        deadline = None
+        if timeout is not None:
+            deadline = mono_now() + timeout
+        rem = req.remaining_s()
+        if rem is not None:
+            d = mono_now() + rem
+            deadline = d if deadline is None else min(deadline, d)
+        with self._cond:
+            while (not self._closed
+                   and len(self._open_cells) + len(cells)
+                   > self.max_queue_cells):
+                if not block:
+                    return False
+                left = None if deadline is None else deadline - mono_now()
+                if left is not None and left <= 0:
+                    return False
+                if not self._cond.wait(timeout=left if left is not None
+                                       else 0.1):
+                    return False
+            if self._closed:
+                raise ServiceClosed("fleet is closed")
+            for c in cells:
+                self._open_cells[c.cid] = c
+            return True
+
+    def check(self, history: History, *, timeout: Optional[float] = None,
+              **kw) -> Dict[str, Any]:
+        return self.submit(history, **kw).wait(timeout=timeout)
+
+    # -- the per-cell driver ---------------------------------------------
+    def _run_cell(self, cell: Cell, exclude: Tuple[int, ...] = ()) -> None:
+        """One owner thread drives one cell to a verdict: route, wait,
+        hedge, reroute, and finally — on every path — finalize.  The cell
+        can end unresolved only if this thread dies, so the body is one
+        try/except that degrades to unknown."""
+        try:
+            result = self._drive_cell(cell, exclude)
+        except Exception as e:  # noqa: BLE001 — a driver bug must not
+            log.exception("fleet cell driver crashed for %s", cell.cid)
+            result = {"valid": "unknown", "analyzer": "fleet",
+                      "error": f"fleet cell driver crashed: {e}"}
+        self._finalize_cell(cell, result)
+
+    def _drive_cell(self, cell: Cell,
+                    exclude: Tuple[int, ...]) -> Dict[str, Any]:
+        req = cell.request
+        policy = self.retry_policy
+        token = cell.route_token()
+        excluded = set(exclude)
+        attempts: List[Dict[str, Any]] = []
+        prev_delay: Optional[float] = None
+        tries = max(1, policy.tries)
+        for attempt in range(tries):
+            if req.expired():
+                self.metrics.inc("deadline-expired")
+                return expired_result(req.kind)
+            worker = self.router.pick(token, exclude=excluded)
+            if worker is None:
+                # Every alive worker's circuit is open (or everyone is
+                # dead).  Wait out a cooldown — a half-open probe slot
+                # may appear — then retry against the full fleet.
+                self.metrics.inc("no-worker-available")
+                attempts.append({"worker": None,
+                                 "error": "no routable worker"})
+                if attempt + 1 >= tries:
+                    break
+                prev_delay = policy.delay(attempt, prev=prev_delay)
+                self._sleep_bounded(prev_delay, req)
+                excluded = set(exclude)
+                continue
+            t0 = mono_now()
+            res, failure, offender = self._attempt_on(worker, cell)
+            took = mono_now() - t0
+            offender = offender or worker
+            if not failure:
+                offender.breaker.record_success()
+                offender.health.observe(latency_s=took)
+                if res is None:  # pure expiry surfaced by the wait loop
+                    res = expired_result(req.kind)
+                res.setdefault("fleet", {})
+                res["fleet"].update({"worker": offender.wid,
+                                     "attempts": attempt + 1,
+                                     "rerouted": attempt > 0})
+                return res
+            offender.breaker.record_failure()
+            offender.health.observe(latency_s=took, error=True)
+            self.metrics.inc("worker-failures")
+            attempts.append({"worker": offender.wid, "error": failure})
+            excluded.add(offender.wid)
+            if len(excluded) >= len(self.workers):
+                # Everyone has failed this cell once; a retry round
+                # against recovered/restarted workers is still worth it.
+                excluded = set(exclude)
+            if attempt + 1 < tries:
+                self.metrics.inc("cells-rerouted")
+                prev_delay = policy.delay(attempt, prev=prev_delay)
+                self._sleep_bounded(prev_delay, req)
+        if req.expired():
+            self.metrics.inc("deadline-expired")
+            return expired_result(req.kind)
+        return {"valid": "unknown", "analyzer": "fleet",
+                "error": f"all {tries} fleet attempts failed",
+                "fleet": {"attempts-log": attempts}}
+
+    def _attempt_on(self, worker: FleetWorker,
+                    cell: Cell) -> Tuple[Optional[Dict[str, Any]],
+                                         Optional[str],
+                                         Optional[FleetWorker]]:
+        """One routed attempt: submit the cell to ``worker`` and wait,
+        hedging to a sibling when the wait turns deadline-risky.  Returns
+        ``(result, failure_reason, worker_of_record)``: ``failure_reason``
+        is None on success (including a legitimate unknown) and a string
+        when a worker — not the history — failed; ``worker_of_record`` is
+        whoever actually produced the outcome (the hedge sibling when the
+        hedge won), so the caller credits/penalizes the right breaker.  A
+        hedge that lands on a broken sibling is penalized HERE and
+        dropped — the still-running primary attempt is not abandoned for
+        a sibling's failure."""
+        req = cell.request
+        try:
+            wreq = worker.service.submit(cell.history, block=False,
+                                         deadline_s=req.remaining_s(),
+                                         **submit_kwargs(req))
+        except (ServiceClosed, ServiceSaturated) as e:
+            return None, f"{type(e).__name__}: {e}", worker
+        except Exception as e:  # noqa: BLE001 — submit crashed = worker bug
+            return None, f"submit crashed: {type(e).__name__}: {e}", worker
+        hedge_at = self._hedge_after(req)
+        hreq = None
+        hedge_worker: Optional[FleetWorker] = None
+        hedge_excluded = {worker.wid}
+        t0 = mono_now()
+        cap = req.remaining_s()
+        cap = NO_DEADLINE_WAIT_S if cap is None else cap
+        while True:
+            if wreq.done():
+                res, failure = self._classify(dict(wreq.result or {}), req)
+                return res, failure, worker
+            if hreq is not None and hreq.done():
+                res, failure = self._classify(dict(hreq.result or {}), req)
+                if failure:
+                    # The hedge landed on a broken sibling: penalize IT,
+                    # drop the hedge, keep waiting on the primary (whose
+                    # attempt is still live and may well succeed).
+                    hedge_worker.breaker.record_failure()
+                    hedge_worker.health.observe(error=True)
+                    self.metrics.inc("worker-failures")
+                    hedge_excluded.add(hedge_worker.wid)
+                    hreq = None
+                    hedge_worker = None
+                    hedge_at = (mono_now() - t0) + 0.1
+                else:
+                    self.metrics.inc("hedge-wins")
+                    if res is not None:
+                        res.setdefault("fleet", {})["hedged-from"] = \
+                            worker.wid
+                    return res, None, hedge_worker
+            now = mono_now()
+            if req.expired():
+                return None, None, worker  # pure expiry → unknown upstream
+            if now - t0 > cap:
+                return None, "worker unresponsive past wait cap", worker
+            if not worker.alive() and (hreq is None
+                                       or (hedge_worker is not None
+                                           and not hedge_worker.alive())):
+                return None, "worker died mid-check", worker
+            if hreq is None and hedge_at is not None \
+                    and now - t0 >= hedge_at:
+                hedge_worker = self.router.pick(cell.route_token(),
+                                                exclude=hedge_excluded)
+                if hedge_worker is not None:
+                    try:
+                        hreq = hedge_worker.service.submit(
+                            cell.history, block=False,
+                            deadline_s=req.remaining_s(),
+                            **submit_kwargs(req))
+                        self.metrics.inc("hedges")
+                    except Exception:  # noqa: BLE001 — sibling saturated
+                        hreq = None
+                        hedge_worker = None
+                if hreq is None:
+                    # No sibling available; re-arm the hedge for later.
+                    hedge_at = (now - t0) + max(0.1, self._hedge_after(req)
+                                                or DEFAULT_HEDGE_S)
+            time.sleep(POLL_S)
+
+    def _classify(self, res: Dict[str, Any],
+                  req: Request) -> Tuple[Optional[Dict[str, Any]],
+                                         Optional[str]]:
+        """Worker failure vs legitimate verdict.  Narrow on purpose: only
+        error strings the scheduler emits when *it* (not the history)
+        failed count as retriable — rerouting a budget-truncation or
+        deadline unknown would re-check forever."""
+        err = str(res.get("error") or "")
+        if res.get("valid") == "unknown" and not req.expired() \
+                and any(err.startswith(m) for m in _WORKER_FAILURE_ERRORS):
+            return None, f"worker-tier failure: {err}"
+        return res, None
+
+    def _hedge_after(self, req: Request) -> Optional[float]:
+        """When to fire the hedge: the configured knob, else half the
+        remaining budget clamped to [50 ms, 2 s] (a late hedge is a
+        useless hedge), else the no-deadline default."""
+        if self.hedge_s is not None:
+            return self.hedge_s
+        rem = req.remaining_s()
+        if rem is None:
+            return DEFAULT_HEDGE_S
+        return min(2.0, max(0.05, rem * 0.5))
+
+    def _sleep_bounded(self, d: float, req: Request) -> None:
+        """Backoff that never sleeps through the deadline."""
+        rem = req.remaining_s()
+        if rem is not None:
+            d = max(0.0, min(d, rem))
+        if d > 0:
+            time.sleep(d)
+
+    def _finalize_cell(self, cell: Cell, result: Dict[str, Any]) -> None:
+        cell.result = result
+        self.metrics.inc("cells-completed")
+        req = cell.request
+        if req.claim_finish():
+            req.finish(aggregate(req))
+            self.metrics.inc("requests-completed")
+            self.metrics.trace(req)
+        if self._journal is not None:
+            self._journal.complete(cell.cid)
+        with self._cond:
+            self._open_cells.pop(cell.cid, None)
+            self._cond.notify_all()
+
+    # -- health -----------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._closed:
+            for w in self.workers:
+                try:
+                    p = w.service.ping()
+                except Exception:  # noqa: BLE001
+                    p = {"alive": False}
+                w.health.beat()
+                if not p.get("alive"):
+                    self.metrics.inc("heartbeat-misses")
+            time.sleep(self.heartbeat_s)
+
+    def restart_worker(self, wid: int) -> FleetWorker:
+        """Bring a (dead) worker slot back with a fresh service; its
+        journal-relevant state lives fleet-side, so nothing is replayed
+        here — cells routed to the corpse already rerouted via their
+        owner threads."""
+        w = self.workers[wid]
+        w.restart()
+        self.metrics.inc("worker-restarts")
+        return w
+
+    def fleet_status(self) -> Dict[str, Any]:
+        return {"workers": [w.status() for w in self.workers],
+                "journal": {"enabled": self._journal is not None,
+                            "pending": (self._journal.pending_count()
+                                        if self._journal else 0),
+                            "writes": (self._journal.writes
+                                       if self._journal else 0),
+                            "path": (self._journal.path
+                                     if self._journal else None)},
+                "circuits": {w.wid: dict(w.breaker.transitions)
+                             for w in self.workers}}
+
+    def healthz(self) -> Dict[str, Any]:
+        """The load-balancer/chaos probe payload (web.py GET /healthz):
+        fleet is ``ok`` while at least one worker is alive with a
+        non-open circuit."""
+        st = self.fleet_status()
+        ok = any(w["alive"] and w["circuit"] != OPEN
+                 for w in st["workers"])
+        return {"ok": ok, "queue-depth": self.queue_depth(), **st}
+
+    # -- journal recovery -------------------------------------------------
+    @staticmethod
+    def recover(journal_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+        """Read a crashed fleet's journal: see FleetJournal.recover."""
+        return FleetJournal.recover(journal_dir)
+
+    def resubmit_recovered(self, journal_dir: str) -> Dict[str, Any]:
+        """Re-enqueue a crashed fleet's journaled cells onto THIS fleet.
+        Pending cells are resubmitted with their remaining deadline
+        budget; already-expired cells are NOT re-checked — they are
+        reported so the caller can surface their ``unknown`` explicitly.
+        Returns ``{"requests": [Request...], "expired": [items]}``."""
+        rec = FleetJournal.recover(journal_dir)
+        reqs = []
+        for item in rec["pending"]:
+            reqs.append(self.submit(item["history"], **item["kwargs"]))
+        if rec["pending"]:
+            self.metrics.inc("journal-recovered", len(rec["pending"]))
+        if rec["expired"]:
+            self.metrics.inc("journal-expired", len(rec["expired"]))
+        return {"requests": reqs, "expired": rec["expired"]}
+
+    # -- core.analyze routing (shared with CheckService) ------------------
+    _routable = CheckService._routable
+    try_route_analyze = CheckService.try_route_analyze
+
+    # -- lifecycle --------------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._open_cells)
+
+    def alive(self) -> bool:
+        return not self._closed and any(w.alive() for w in self.workers)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        deadline = (mono_now() + timeout) if timeout is not None else None
+        with self._cond:
+            while self._open_cells:
+                left = None if deadline is None else deadline - mono_now()
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(timeout=left if left is not None else 0.1)
+            return True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, drain every open cell (each admitted request
+        still resolves), then shut the workers down."""
+        with self._lock:
+            if self._closed:
+                return True
+        ok = self.drain(timeout=timeout)
+        with self._lock:
+            self._closed = True
+        self._pool.shutdown(wait=True)
+        for w in self.workers:
+            try:
+                w.service.close(timeout=timeout)
+            except Exception:  # noqa: BLE001 — close the rest regardless
+                log.exception("worker %d close failed", w.wid)
+        if self._hb_thread.is_alive():
+            self._hb_thread.join(timeout=2 * self.heartbeat_s + 1.0)
+        return ok
+
+    def kill(self) -> None:
+        """Abrupt whole-fleet death (crash semantics, for recovery
+        tests): no drain, workers killed, open cells left in the journal
+        for :meth:`recover`."""
+        with self._lock:
+            self._closed = True
+        for w in self.workers:
+            try:
+                w.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self) -> "Fleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -49,6 +49,7 @@ class Request:
         self.result: Optional[Dict[str, Any]] = None
         self._done = threading.Event()
         self._lock = threading.Lock()
+        self._finishing = False
         self.span("enqueue")
 
     # -- trace ------------------------------------------------------------
@@ -70,6 +71,18 @@ class Request:
         """Called (under the service lock) as each cell resolves; True when
         this was the last one."""
         return all(c.result is not None for c in self.cells)
+
+    def claim_finish(self) -> bool:
+        """Atomically claim the right to aggregate and :meth:`finish` this
+        request: True exactly once, when the last cell's result landed.
+        The scheduler's single device loop never races itself here, but
+        the fleet finalizes cells from many driver threads — without the
+        claim, two final cells landing together would double-finish."""
+        with self._lock:
+            if self._finishing or not self.cell_done():
+                return False
+            self._finishing = True
+            return True
 
     def finish(self, result: Dict[str, Any]) -> None:
         self.span("verdict")
@@ -101,8 +114,17 @@ class Cell:
     bucket: Tuple = ()              # (kind, engine-identity, shape buckets)
     result: Optional[Dict[str, Any]] = field(default=None)
     enqueued: float = 0.0           # mono_now() at admission (aging clock)
+    cid: str = ""                   # fleet cell id (journal key, route token)
 
     def sort_key(self) -> Tuple[float, int]:
         """Deadline-first priority, FIFO within a deadline class."""
         d = self.request.deadline
         return (d if d is not None else float("inf"), self.seq)
+
+    def route_token(self) -> str:
+        """What the fleet router hashes: the key for per-key cells (same
+        key → same worker → warm engine cache), the cell id otherwise (a
+        keyless request still spreads across the fleet)."""
+        if self.key is not None:
+            return f"{self.request.kind}:{self.key!r}"
+        return f"cell:{self.cid or self.seq}"

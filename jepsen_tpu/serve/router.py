@@ -1,0 +1,191 @@
+"""Routing policy for the serving fleet: who checks which cell.
+
+Three small, separately-testable pieces:
+
+- :class:`CircuitBreaker` — the classic closed → open → half-open state
+  machine, per worker.  A worker that keeps failing stops receiving
+  traffic (open) for a cooldown, then gets exactly one probe cell
+  (half-open); the probe's outcome closes or re-opens the circuit.
+  Without this, a poisoned worker converts every routed cell into a
+  retry — the fleet survives, but pays 2x latency on a third of its
+  traffic forever.
+
+- :class:`WorkerHealth` — per-worker EWMAs of dispatch latency and error
+  rate plus the last heartbeat, exported through ``GET /healthz`` so an
+  external load balancer and the chaos harness read the same numbers the
+  router acts on.
+
+- :class:`Router` — rendezvous (highest-random-weight) hashing of cells
+  onto workers.  Same key → same worker while the fleet is healthy (warm
+  engine caches see repeat shapes); when a worker is dead or its circuit
+  is open, each of its keys falls to its *own* next-highest sibling — the
+  failover shuffles nothing else, unlike mod-N hashing where one death
+  remaps almost every key.  P-compositionality is what makes this safe
+  at all: cells are independently-checkable units, so relocating one
+  changes no verdict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from jepsen_tpu.serve.metrics import mono_now
+
+#: circuit states (the healthz wire strings)
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Per-worker circuit: ``fail_threshold`` consecutive failures open
+    it; after ``open_s`` one probe is allowed (half-open); the probe's
+    success closes it, failure re-opens it for another cooldown."""
+
+    def __init__(self, fail_threshold: int = 3, open_s: float = 1.0,
+                 clock=mono_now):
+        self.fail_threshold = max(1, fail_threshold)
+        self.open_s = open_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.transitions: Dict[str, int] = {"opened": 0, "half-opened": 0,
+                                            "closed": 0}
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a cell be routed here right now?  Claims the half-open
+        probe slot when it grants one (call only when actually routing)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if (self._opened_at is not None
+                        and self._clock() - self._opened_at >= self.open_s):
+                    self._state = HALF_OPEN
+                    self.transitions["half-opened"] += 1
+                    self._probing = True
+                    return True
+                return False
+            # HALF_OPEN: one outstanding probe at a time
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self.transitions["closed"] += 1
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            probe_failed = self._probing
+            self._probing = False
+            if probe_failed or self._consecutive >= self.fail_threshold:
+                if self._state != OPEN:
+                    self.transitions["opened"] += 1
+                self._state = OPEN
+                self._opened_at = self._clock()
+
+    def reset(self) -> None:
+        """A restarted worker starts with a clean circuit."""
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive = 0
+            self._opened_at = None
+            self._probing = False
+
+
+class WorkerHealth:
+    """EWMAs of latency and error rate + the heartbeat clock, per worker.
+    ``alpha`` weights the newest observation (0.3: ~10 observations of
+    memory — fast enough to see a worker go bad mid-campaign, slow
+    enough that one outlier doesn't flap the numbers)."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self._latency_s: Optional[float] = None
+        self._error_rate = 0.0
+        self._last_beat: Optional[float] = None
+        self._beats = 0
+
+    def observe(self, latency_s: Optional[float] = None,
+                error: bool = False) -> None:
+        with self._lock:
+            a = self.alpha
+            if latency_s is not None:
+                self._latency_s = (latency_s if self._latency_s is None
+                                   else a * latency_s
+                                   + (1 - a) * self._latency_s)
+            self._error_rate = (a * (1.0 if error else 0.0)
+                                + (1 - a) * self._error_rate)
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = mono_now()
+            self._beats += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            age = (round(mono_now() - self._last_beat, 3)
+                   if self._last_beat is not None else None)
+            return {"latency-ewma-s": (round(self._latency_s, 6)
+                                       if self._latency_s is not None
+                                       else None),
+                    "error-ewma": round(self._error_rate, 4),
+                    "heartbeats": self._beats,
+                    "last-beat-age-s": age}
+
+
+def rendezvous_score(token: str, worker_id: str) -> int:
+    """Deterministic per-(cell, worker) weight.  blake2b, not ``hash()``:
+    Python string hashing is salted per process, and the whole point is
+    that every fleet member — and a restarted fleet replaying its
+    journal — ranks workers identically."""
+    h = hashlib.blake2b(f"{token}|{worker_id}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class Router:
+    """Rendezvous-hash a routing token onto the healthiest eligible
+    worker.  ``workers`` is the fleet's (index-stable) worker list; a
+    worker is eligible when it is alive, not excluded, and its circuit
+    admits traffic."""
+
+    def __init__(self, workers: Sequence):
+        self._workers = workers
+
+    def ranked(self, token: str, exclude: Iterable[int] = ()) -> List:
+        """Alive, non-excluded workers, best rendezvous score first
+        (circuit state NOT yet consulted — allow() claims probe slots,
+        so it runs only on the worker actually picked)."""
+        ex = set(exclude)
+        scored = [(rendezvous_score(token, str(w.wid)), w)
+                  for w in self._workers
+                  if w.wid not in ex and w.alive()]
+        scored.sort(key=lambda sw: sw[0], reverse=True)
+        return [w for _, w in scored]
+
+    def pick(self, token: str, exclude: Iterable[int] = ()):
+        """The worker to route ``token`` to, or None when no alive worker
+        currently admits traffic.  Walks the rendezvous ranking so an
+        open circuit fails over to the key's next-highest sibling."""
+        for w in self.ranked(token, exclude):
+            if w.breaker.allow():
+                return w
+        return None

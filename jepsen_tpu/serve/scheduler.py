@@ -46,9 +46,14 @@ DEFAULT_AGE_S = 5.0
 class Scheduler:
     def __init__(self, metrics, mesh=None, max_lanes: int = 64,
                  capacity: Optional[int] = None, max_capacity: int = 65536,
-                 age_s: Optional[float] = DEFAULT_AGE_S):
+                 age_s: Optional[float] = DEFAULT_AGE_S, device=None):
         self.metrics = metrics
         self.mesh = mesh
+        # A fleet worker's device pin: dispatches run under
+        # jax.default_device(device) so N in-process workers partition the
+        # host's devices instead of convoying on device 0.  None = the
+        # backend default (the solo-service behaviour).
+        self.device = device
         self.max_lanes = max(1, min(max_lanes, buckets.MAX_LANE_BUCKET))
         # None = derive the start capacity from each dispatch's bucket
         # shape (buckets.wgl_start_capacity); an int pins the old fixed
@@ -103,6 +108,45 @@ class Scheduler:
 
     def depth(self) -> int:
         return self._depth
+
+    def inflight(self) -> int:
+        return self._inflight
+
+    def alive(self) -> bool:
+        """Is the device loop still able to make progress?  False once the
+        thread died (a crash the loop's own try/except failed to contain)
+        or a stop/kill landed — the fleet's heartbeat probes this."""
+        return (self._started and not self._stop
+                and self._thread.is_alive())
+
+    def evict_pending(self) -> List[Cell]:
+        """Drain hook: pop every *queued* (not yet dispatched) cell and
+        hand it back to the caller unresolved.  The fleet uses this to
+        decommission a worker — its queue moves to a sibling instead of
+        waiting out the corpse.  Cells already in a device dispatch are
+        not evictable; they either resolve normally or hang with the
+        worker (the router's hedge covers that window)."""
+        with self._cond:
+            out: List[Cell] = []
+            for dq in self._groups.values():
+                out.extend(dq)
+                dq.clear()
+            self._groups.clear()
+            self._depth = 0
+            self._cond.notify_all()
+        return sorted(out, key=lambda c: c.seq)
+
+    def kill(self) -> List[Cell]:
+        """Abrupt death (the chaos harness's worker-crash fault): stop the
+        loop WITHOUT draining and evict the queue.  In-flight dispatches
+        may still finalize (a real crash can land before or after the ack;
+        both must be survivable) — everything still queued is returned
+        unresolved, exactly what a restart would recover from the
+        journal."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        return self.evict_pending()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until the queue is empty and no dispatch is in flight."""
@@ -244,11 +288,19 @@ class Scheduler:
             padded = lanes + [lanes[0]] * (pad - len(lanes))
         for c in live:
             c.request.span("dispatch")
-        try:
+
+        def run_dispatch():
             if kind == KIND_WGL:
-                rs = self._dispatch_wgl(live, padded, mega=mega)
+                return self._dispatch_wgl(live, padded, mega=mega)
+            return self._dispatch_elle(live, padded)
+
+        try:
+            if self.device is not None:
+                import jax
+                with jax.default_device(self.device):
+                    rs = run_dispatch()
             else:
-                rs = self._dispatch_elle(live, padded)
+                rs = run_dispatch()
         except Exception as e:  # noqa: BLE001 — device trouble, degrade
             log.warning("device dispatch failed (%s: %s); host fallback "
                         "for %d cell(s)", type(e).__name__, e, len(live))
@@ -360,9 +412,8 @@ class Scheduler:
         cell.result = result
         self.metrics.inc("cells-completed")
         req = cell.request
-        with req._lock:
-            if req.done() or not req.cell_done():
-                return
+        if not req.claim_finish():
+            return
         req.finish(aggregate(req))
         self.metrics.inc("requests-completed")
         self.metrics.trace(req)

@@ -27,6 +27,7 @@ import threading
 from typing import Any, Dict, Optional, Union
 
 from jepsen_tpu.history import History
+from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.decompose import decompose
 from jepsen_tpu.serve.metrics import Metrics
 from jepsen_tpu.serve.request import KIND_ELLE, KIND_WGL, Request
@@ -39,6 +40,32 @@ class ServiceSaturated(RuntimeError):
 
 class ServiceClosed(RuntimeError):
     """The service is shut down; no new requests are admitted."""
+
+
+def build_spec(kind: str, *, model=None, workload: str = "list-append",
+               realtime: bool = False, consistency_models=None,
+               engine: str = "auto", **engine_opts) -> Dict[str, Any]:
+    """Normalize submit kwargs into a request spec — shared by
+    CheckService.submit and the fleet's router (serve.fleet), so the two
+    admission paths cannot drift on what a spec means."""
+    if kind == KIND_WGL:
+        if isinstance(model, str) or model is None:
+            from jepsen_tpu.models import get_model
+            model = get_model(model or "cas-register")
+        return {"model": model, **engine_opts}
+    if kind == KIND_ELLE:
+        return {"workload": workload, "realtime": realtime,
+                "consistency_models": consistency_models,
+                "engine": engine, **engine_opts}
+    raise ValueError(f"unknown kind {kind!r}")
+
+
+def submit_kwargs(req: Request) -> Dict[str, Any]:
+    """Invert :func:`build_spec`: the kwargs that re-submit ``req``'s
+    spec to another service — the fleet's reroute/hedge path and journal
+    recovery both re-enqueue cells this way.  (build_spec is idempotent
+    on its own output, so round-tripping is safe.)"""
+    return {"kind": req.kind, **req.spec}
 
 
 class _ServiceRouted:
@@ -70,7 +97,8 @@ class CheckService:
                  mesh=None,
                  capacity: Optional[int] = None,
                  max_capacity: int = 65536,
-                 age_s: Optional[float] = None):
+                 age_s: Optional[float] = None,
+                 device=None):
         # Shared init: repeated service processes skip XLA compiles.
         from jepsen_tpu.ops.cache import init_compilation_cache
         from jepsen_tpu.serve.scheduler import DEFAULT_AGE_S
@@ -84,7 +112,8 @@ class CheckService:
                                 max_lanes=max_lanes, capacity=capacity,
                                 max_capacity=max_capacity,
                                 age_s=age_s if age_s is not None
-                                else DEFAULT_AGE_S)
+                                else DEFAULT_AGE_S,
+                                device=device)
         self._closed = False
         self._lock = threading.Lock()
         self._submitted = 0
@@ -109,27 +138,45 @@ class CheckService:
                **engine_opts) -> Request:
         """Enqueue one history check; returns a :class:`Request` handle
         (``.wait()`` for the verdict).  ``block=False`` raises
-        :class:`ServiceSaturated` instead of waiting out backpressure."""
+        :class:`ServiceSaturated` instead of waiting out backpressure.
+
+        A request whose deadline expires *while blocked on admission*
+        resolves ``unknown`` (the returned handle is already done) rather
+        than raising: backpressure is indistinguishable from a slow
+        device to the caller, and the deadline contract is "unknown,
+        never dropped, never false" on every path — including the
+        admission path."""
         if self._closed:
             raise ServiceClosed("service is closed")
-        if kind == KIND_WGL:
-            if isinstance(model, str) or model is None:
-                from jepsen_tpu.models import get_model
-                model = get_model(model or "cas-register")
-            spec: Dict[str, Any] = {"model": model, **engine_opts}
-        elif kind == KIND_ELLE:
-            spec = {"workload": workload, "realtime": realtime,
-                    "consistency_models": consistency_models,
-                    "engine": engine, **engine_opts}
-        else:
-            raise ValueError(f"unknown kind {kind!r}")
+        spec = build_spec(kind, model=model, workload=workload,
+                          realtime=realtime,
+                          consistency_models=consistency_models,
+                          engine=engine, **engine_opts)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = Request(history, kind, spec, deadline_s=deadline_s)
         cells = decompose(req)
+        # A blocked offer never outlives the deadline: the expiring
+        # request must surface unknown, not sit in admission forever.
+        rem = req.remaining_s()
+        if rem is not None:
+            timeout = rem if timeout is None else min(timeout, rem)
         if not self._sched.offer(cells, block=block,
                                  max_depth=self.max_queue_cells,
                                  timeout=timeout):
+            if req.expired():
+                for c in cells:
+                    c.result = expired_result(kind)
+                self.metrics.inc("deadline-expired", len(cells))
+                with self._lock:
+                    self._submitted += 1
+                self.metrics.inc("requests-submitted")
+                self.metrics.inc("cells-submitted", len(cells))
+                self.metrics.inc("cells-completed", len(cells))
+                self.metrics.inc("requests-completed")
+                req.finish(aggregate(req))
+                self.metrics.trace(req)
+                return req
             self.metrics.inc("requests-rejected")
             raise ServiceSaturated(
                 f"queue at {self._sched.depth()}/{self.max_queue_cells} "
@@ -222,8 +269,38 @@ class CheckService:
     def queue_depth(self) -> int:
         return self._sched.depth()
 
+    def alive(self) -> bool:
+        """Liveness: the device loop is running and admissions are open."""
+        return not self._closed and self._sched.alive()
+
+    def ping(self) -> Dict[str, Any]:
+        """The heartbeat payload: cheap, lock-light, never dispatches.
+        The fleet's health checker and ``GET /healthz`` both read this."""
+        return {"alive": self.alive(),
+                "queue-depth": self._sched.depth(),
+                "inflight-cells": self._sched.inflight(),
+                "inflight-requests": self._inflight()}
+
+    def healthz(self) -> Dict[str, Any]:
+        """Single-service health probe (the degenerate one-worker fleet
+        view, so load balancers see ONE schema either way)."""
+        p = self.ping()
+        return {"ok": p["alive"], "workers": [
+            {"worker": 0, "alive": p["alive"], "circuit": "closed",
+             "queue-depth": p["queue-depth"],
+             "inflight-cells": p["inflight-cells"]}]}
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         return self._sched.drain(timeout=timeout)
+
+    def kill(self) -> list:
+        """Abrupt shutdown (worker-crash semantics, no drain): stop the
+        loop, evict and return the still-queued cells unresolved.  The
+        fleet reroutes them; in-flight requests hang until a sibling's
+        hedge resolves them — exactly a crashed process's behaviour."""
+        with self._lock:
+            self._closed = True
+        return self._sched.kill()
 
     def close(self, timeout: Optional[float] = None) -> bool:
         """Stop admitting, drain the queue (every admitted request still

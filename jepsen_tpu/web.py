@@ -10,6 +10,9 @@ submission surface:
 
 - ``GET /metrics``  — the full metrics snapshot as JSON (counters, queue
   depth, lane occupancy, engine-cache hit/miss/recompile, traces);
+- ``GET /healthz``  — liveness probe: per-worker alive/circuit/queue
+  status (the fleet's view with ``--workers N``, a degenerate one-worker
+  view for a single service); 503 while no worker can take traffic;
 - ``GET /queue``    — a human-readable queue-status page;
 - ``POST /submit``  — submit a history for checking: a JSON body with
   ``ops`` (op dicts, the history.jsonl shape) plus the submit options of
@@ -98,6 +101,16 @@ def make_handler(base: str, service=None):
             path = unquote(self.path)
             if path in ("/", "/index.html"):
                 return self._send(200, _index_html(base).encode())
+            if path == "/healthz":
+                # Liveness probe: per-worker status, circuit state, queue
+                # depth.  One schema whether a CheckService (degenerate
+                # one-worker view) or a Fleet is attached; 503 while no
+                # worker can take traffic so a load balancer / the chaos
+                # harness can act on the status code alone.
+                if service is None:
+                    return self._send_json(200, {"ok": True, "workers": []})
+                hz = service.healthz()
+                return self._send_json(200 if hz.get("ok") else 503, hz)
             if path == "/metrics":
                 if service is None:
                     from jepsen_tpu.parallel.batch import engine_cache_stats

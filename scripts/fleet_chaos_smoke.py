@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Fleet chaos smoke: the serving fleet vs its own nemesis.
+
+Phase A (parity under fire): runs a 48-history mixed workload (wgl
+cas-register + elle list-append, a third corrupted) through a 3-worker
+Fleet while a ChaosNemesis kills a worker, delays another's responses,
+drops a third's responses, and poisons one worker's device dispatches —
+then asserts, lane for lane, that the surviving fleet's verdicts equal a
+cold single-service oracle's (zero fabricated ``false``s), that every
+request resolved within one deadline budget of the kill, and that the
+in-flight journal drained to empty.
+
+Phase B (journal recovery): pauses a second fleet's workers, submits a
+batch, crashes the whole fleet (no drain), then recovers its journal
+into a fresh fleet and asserts every journaled cell is either re-checked
+to the oracle verdict or explicitly surfaced as expired — admitted work
+is never silently dropped, and recovery never invents a verdict.
+
+Writes the chaos metrics snapshot to argv[1] (default
+/tmp/fleet_chaos_metrics.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.nemesis.registry import FaultRegistry  # noqa: E402
+from jepsen_tpu.serve import CheckService
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.fleet import Fleet
+from jepsen_tpu.synth import (
+    cas_register_history, corrupt_list_append, corrupt_reads,
+    list_append_history,
+)
+
+N_WGL, N_ELLE, CLIENTS = 36, 12, 4
+# One deadline budget is the recovery bound the smoke asserts: every
+# request carries this deadline, and every request — including the
+# killed worker's rerouted cells — must resolve within one budget of
+# the kill.  Sized for CI's CPU backend: the whole 48-job campaign runs
+# inside the window with ~2.5x headroom on a developer box.
+DEADLINE_S = 60.0
+
+
+def build_workload():
+    jobs = []
+    for s in range(N_WGL):
+        h = cas_register_history(60, concurrency=4, seed=s)
+        if s % 3 == 2:
+            h = corrupt_reads(h, n=1, seed=s)
+        jobs.append(("wgl", h))
+    for s in range(N_ELLE):
+        h = list_append_history(25, seed=1000 + s)
+        if s % 3 == 2:
+            h = corrupt_list_append(h, anomaly_p=0.5, seed=s)
+        jobs.append(("elle", h))
+    return jobs
+
+
+def submit_kw(kind):
+    return ({"model": "cas-register"} if kind == "wgl"
+            else {"workload": "list-append"})
+
+
+def run_oracle(svc, jobs):
+    out = []
+    for kind, h in jobs:
+        out.append(svc.check(h, kind=kind, **submit_kw(kind))["valid"])
+    return out
+
+
+def run_fleet(fleet, jobs, deadline_s=DEADLINE_S):
+    out = [None] * len(jobs)
+
+    def client(span):
+        reqs = []
+        for i in span:
+            kind, h = jobs[i]
+            reqs.append((i, fleet.submit(h, kind=kind,
+                                         deadline_s=deadline_s,
+                                         **submit_kw(kind))))
+        for i, r in reqs:
+            out[i] = r.wait(timeout=120)["valid"]
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    return threads, out
+
+
+def phase_a(oracle_svc, jobs, journal_dir):
+    """Parity under kill + delay + drop + poison."""
+    oracle = run_oracle(oracle_svc, jobs)
+
+    fleet = Fleet(workers=3, journal_dir=journal_dir, max_lanes=48,
+                  hedge_s=0.3, default_deadline_s=DEADLINE_S)
+    chaos = ChaosNemesis(fleet, registry=FaultRegistry(), seed=7)
+    # Warm the fleet's bucket ladder (the workers' lane-group shapes are
+    # narrower than the oracle's, so they compile their own engines):
+    # recovery_s must time rerouting, not first-compiles.
+    warm, _ = run_fleet(fleet, jobs[:3] + jobs[-3:])
+    for t in warm:
+        t.join(timeout=180)
+    threads, out = run_fleet(fleet, jobs)
+
+    time.sleep(0.3)                       # let the campaign start flowing
+    t_kill = time.monotonic()
+    chaos.kill_worker(0)
+    chaos.delay_responses(1, delay_s=0.15)
+    chaos.drop_responses(2, p=0.4)
+    time.sleep(1.0)
+    chaos.heal("fleet:kill:0")            # restart the corpse
+    chaos.heal("fleet:delay:1")
+    chaos.heal("fleet:drop:2")
+    chaos.poison_dispatch(2)              # mid-campaign device corruption
+    time.sleep(0.5)
+    chaos.heal("fleet:poison:2")
+
+    for t in threads:
+        t.join(timeout=180)
+    assert not any(t.is_alive() for t in threads), "fleet clients hung"
+    t_recovered = time.monotonic()
+
+    leftover = chaos.heal_all()
+    healthz = fleet.healthz()
+    snap = fleet.metrics.snapshot()
+    journal_pending = fleet._journal.pending_count()
+    fleet.close(timeout=60.0)
+
+    mismatches = [
+        {"lane": i, "oracle": o, "fleet": f}
+        for i, (o, f) in enumerate(zip(oracle, out)) if o != f]
+    fabricated = [m for m in mismatches
+                  if m["fleet"] is False and m["oracle"] is not False]
+    recovery_s = t_recovered - t_kill
+
+    report = {
+        "oracle": oracle, "fleet": out, "mismatches": mismatches,
+        "fabricated_false": fabricated,
+        "recovery_s": round(recovery_s, 3),
+        "journal_pending_at_end": journal_pending,
+        "leftover_faults_healed": leftover,
+        "healthz": healthz, "metrics": snap,
+    }
+
+    assert not fabricated, f"fleet fabricated false verdicts: {fabricated}"
+    assert not mismatches, f"verdict parity broken: {mismatches}"
+    assert oracle.count(False) > 0, "corrupted histories must refute"
+    assert recovery_s < DEADLINE_S, (
+        f"recovery took {recovery_s:.1f}s — past one deadline budget "
+        f"({DEADLINE_S}s): killed worker's cells did not complete on "
+        f"siblings in time")
+    assert journal_pending == 0, (
+        f"{journal_pending} cells still journaled after drain")
+    assert not leftover, f"faults survived heal: {leftover}"
+    c = snap["counters"]
+    assert c.get("worker-restarts", 0) >= 1
+    assert c.get("worker-failures", 0) >= 1, "chaos never bit a worker"
+    assert c.get("cells-rerouted", 0) + c.get("hedges", 0) >= 1, (
+        "no cell ever rerouted or hedged — the nemesis tested nothing")
+    assert healthz["ok"], "fleet unhealthy after full heal"
+    assert all(w["alive"] for w in healthz["workers"])
+    return report
+
+
+def phase_b(oracle_svc, jobs, crash_dir, recover_dir):
+    """Crash the whole fleet mid-flight; recover its journal."""
+    f2 = Fleet(workers=2, journal_dir=crash_dir,
+               default_deadline_s=DEADLINE_S)
+    chaos = ChaosNemesis(f2, registry=FaultRegistry())
+    chaos.pause_worker(0, stall_s=30.0)   # wedge both workers: nothing
+    chaos.pause_worker(1, stall_s=30.0)   # completes before the crash
+    for kind, h in jobs:
+        f2.submit(h, kind=kind, deadline_s=DEADLINE_S, **submit_kw(kind))
+    journaled = f2._journal.pending_count()
+    f2.kill()                             # whole-fleet crash, no drain
+    time.sleep(2.0)                       # let straggler drivers settle
+
+    rec_preview = Fleet.recover(crash_dir)
+    f3 = Fleet(workers=2, journal_dir=recover_dir,
+               default_deadline_s=DEADLINE_S)
+    rec = f3.resubmit_recovered(crash_dir)
+    results = []
+    for req in rec["requests"]:
+        res = req.wait(timeout=120)
+        oracle = oracle_svc.check(req.history, kind=req.kind,
+                                  **({"model": "cas-register"}
+                                     if req.kind == "wgl"
+                                     else {"workload": "list-append"}))
+        results.append({"fleet": res["valid"], "oracle": oracle["valid"]})
+    snap = f3.metrics.snapshot()
+    f3.close(timeout=60.0)
+
+    report = {
+        "journaled_at_crash": journaled,
+        "recovered_pending": len(rec_preview["pending"]),
+        "recovered_expired": len(rec_preview["expired"]),
+        "recovery_results": results,
+        "metrics_counters": snap["counters"],
+    }
+    assert journaled > 0, "crash raced the campaign: nothing journaled"
+    assert rec_preview["pending"] or rec_preview["expired"], (
+        "journal recovery found nothing despite pending cells at crash")
+    fabricated = [r for r in results
+                  if r["fleet"] is False and r["oracle"] is not False]
+    assert not fabricated, f"recovery fabricated false: {fabricated}"
+    mism = [r for r in results
+            if r["fleet"] != r["oracle"] and r["fleet"] != "unknown"]
+    assert not mism, f"recovered verdicts diverge: {mism}"
+    return report
+
+
+def main():
+    dump = (sys.argv[1] if len(sys.argv) > 1
+            else "/tmp/fleet_chaos_metrics.json")
+    jobs = build_workload()
+    tmp = tempfile.mkdtemp(prefix="fleet-chaos-")
+    oracle_svc = CheckService(max_lanes=48, capacity=64)
+    try:
+        report_a = phase_a(oracle_svc, jobs,
+                           os.path.join(tmp, "journal-a"))
+        report_b = phase_b(oracle_svc, jobs[:16],
+                           os.path.join(tmp, "journal-crash"),
+                           os.path.join(tmp, "journal-recover"))
+    finally:
+        oracle_svc.close(timeout=30.0)
+    report = {"phase_a": report_a, "phase_b": report_b}
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "recovery_s": report_a["recovery_s"],
+        "mismatches": report_a["mismatches"],
+        "fabricated_false": report_a["fabricated_false"],
+        "journaled_at_crash": report_b["journaled_at_crash"],
+        "recovered": report_b["recovered_pending"]
+        + report_b["recovered_expired"],
+    }))
+    print(f"fleet chaos smoke OK: parity held under kill+delay+drop+"
+          f"poison, recovery {report_a['recovery_s']:.1f}s < "
+          f"{DEADLINE_S:.0f}s budget, metrics dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

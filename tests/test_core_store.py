@@ -154,6 +154,38 @@ class TestAtomicWrites:
         assert store.load_results(d)["valid"] is True
         assert store.load_history(d)
 
+    def test_fsync_dir_reports_whether_it_ran(self, tmp_path):
+        # the rename-durability fsync: True on a real directory (Linux CI
+        # runs this for real), False — never an exception — on a path
+        # that can't be opened
+        from jepsen_tpu.atomic_io import fsync_dir
+        assert fsync_dir(str(tmp_path)) is True
+        assert fsync_dir(str(tmp_path / "does-not-exist")) is False
+
+    def test_durable_mkdir_nested_idempotent_abspath(self, tmp_path):
+        from jepsen_tpu.atomic_io import durable_mkdir
+        target = str(tmp_path / "a" / "b" / "c")
+        got = durable_mkdir(target)
+        assert got == os.path.abspath(target)
+        assert os.path.isdir(got)
+        assert durable_mkdir(target) == got   # second call is a no-op
+        # an existing dir with content is untouched
+        (tmp_path / "a" / "keep.txt").write_text("x")
+        durable_mkdir(str(tmp_path / "a" / "b"))
+        assert (tmp_path / "a" / "keep.txt").read_text() == "x"
+
+    def test_atomic_write_fsyncs_parent_dir(self, tmp_path, monkeypatch):
+        # the journal's durability contract: after the rename publishes
+        # the file, the parent directory entry is fsynced too
+        import jepsen_tpu.atomic_io as aio
+        synced = []
+        monkeypatch.setattr(aio, "fsync_dir",
+                            lambda d: (synced.append(d), True)[1])
+        p = tmp_path / "sub" / "j.json"
+        os.makedirs(p.parent)
+        aio.atomic_write(str(p), lambda f: f.write("{}"))
+        assert str(p.parent) in synced
+
 
 class TestDbLifecycle:
     def test_db_setup_teardown_called(self, tmp_path):

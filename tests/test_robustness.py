@@ -117,6 +117,81 @@ class TestRetrying:
         assert policy_for(t, "teardown").tries == 2
         assert policy_for({}, "setup").tries >= policy_for({}, "run").tries
 
+
+class TestDecorrelatedJitter:
+    """The fleet's reroute backoff: each delay is drawn uniformly from
+    [backoff_s, 3 * previous_delay], capped — so N workers retrying after
+    the same sibling death spread across the whole interval instead of
+    arriving in a synchronized storm."""
+
+    POLICY = RetryPolicy(tries=6, backoff_s=0.05, max_backoff_s=0.4,
+                         decorrelated=True)
+
+    def test_delay_stays_within_bounds_and_cap(self):
+        import random
+        p = self.POLICY
+        rng = random.Random(7)
+        prev = None
+        for attempt in range(50):
+            d = p.delay(attempt, rng=rng, prev=prev)
+            assert d >= p.backoff_s
+            assert d <= p.max_backoff_s
+            # never above 3x what was actually slept last time
+            assert d <= max(p.backoff_s,
+                            3.0 * (prev if prev is not None else p.backoff_s))
+            prev = d
+
+    def test_cap_binds_even_with_huge_prev(self):
+        d = self.POLICY.delay(3, prev=100.0)
+        assert self.POLICY.backoff_s <= d <= self.POLICY.max_backoff_s
+
+    def test_missing_prev_degrades_to_base_band(self):
+        # callers that don't thread prev through still get valid delays:
+        # uniform over [base, 3*base]
+        import random
+        rng = random.Random(3)
+        for _ in range(20):
+            d = self.POLICY.delay(0, rng=rng, prev=None)
+            assert self.POLICY.backoff_s <= d <= 3.0 * self.POLICY.backoff_s
+
+    def test_decorrelates_where_the_ladder_synchronizes(self):
+        # two "workers" that saw the same failure: the deterministic
+        # ladder (jitter=0) retries in lockstep; the decorrelated draw
+        # must not
+        import random
+        ladder = RetryPolicy(tries=4, backoff_s=0.05, jitter=0.0)
+        assert [ladder.delay(a) for a in range(3)] \
+            == [ladder.delay(a) for a in range(3)]
+        p = self.POLICY
+
+        def chain(seed):
+            rng, prev, out = random.Random(seed), None, []
+            for a in range(4):
+                prev = p.delay(a, rng=rng, prev=prev)
+                out.append(prev)
+            return out
+
+        assert chain(1) != chain(2)
+
+    def test_retrying_threads_prev_through(self):
+        # the combinator feeds each slept delay back as prev: observable
+        # as the widening upper bound across attempts
+        import random
+        p = RetryPolicy(tries=4, backoff_s=0.01, max_backoff_s=10.0,
+                        decorrelated=True)
+        slept = []
+
+        def f():
+            raise RemoteConnectError("down")
+
+        random.seed(11)  # policy.delay defaults to the module-level rng
+        with pytest.raises(RemoteConnectError):
+            retrying(f, p, sleep=slept.append)
+        assert len(slept) == 3
+        for i, d in enumerate(slept):
+            hi = 3.0 * (slept[i - 1] if i else p.backoff_s)
+            assert p.backoff_s <= d <= max(p.backoff_s, hi)
+
     def test_retry_remote_reconnects_mid_run(self):
         """An execute that dies with a connection error is replayed on a
         fresh connection (control/retry.clj:15-67)."""

@@ -10,6 +10,7 @@ init).  Everything runs on the CPU backend.
 
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import pytest
@@ -244,6 +245,27 @@ class TestLifecycle:
         finally:
             svc.close(timeout=30.0)
 
+    def test_admission_race_expiry_surfaces_unknown(self):
+        # queue full AND the deadline expires while blocked on admission:
+        # the request must come back already-done with unknown — not
+        # dropped, not False, not ServiceSaturated, not a hang
+        svc = CheckService(max_queue_cells=0, max_lanes=8)
+        try:
+            req = svc.submit(cas_register_history(10, seed=18),
+                             kind="wgl", model="cas-register",
+                             block=True, deadline_s=0.2)
+            assert req.done()
+            res = req.wait(timeout=5)
+            assert res["valid"] == "unknown"
+            assert res.get("deadline-expired") is True
+            c = svc.metrics.snapshot()["counters"]
+            assert c["deadline-expired"] >= 1
+            assert c["requests-completed"] >= 1
+            # expiry under backpressure is completion, not rejection
+            assert c.get("requests-rejected", 0) == 0
+        finally:
+            svc.close(timeout=30.0)
+
     def test_context_manager(self):
         with CheckService(max_lanes=8) as svc:
             assert svc.check(cas_register_history(20, seed=18),
@@ -332,6 +354,19 @@ class TestWebEndpoints:
         assert "engine-cache" in snap and "gauges" in snap
         page = urllib.request.urlopen(url + "/queue").read().decode()
         assert "requests-submitted" in page
+
+    def test_healthz_endpoint(self, server):
+        url, svc = server
+        body = json.loads(urllib.request.urlopen(url + "/healthz").read())
+        assert body["ok"] is True
+        w = body["workers"][0]
+        assert w["circuit"] == "closed" and w["alive"] is True
+        assert "queue-depth" in w
+        svc.kill()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["ok"] is False
 
     def test_post_submit_round_trip(self, server):
         url, _ = server
