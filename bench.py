@@ -82,6 +82,7 @@ TIER_TIMEOUT_S = {
     "multireg": 300 if SMOKE else 1500,
     "elle": 300 if SMOKE else 1200,
     "fleet": 300 if SMOKE else 900,
+    "procfleet": 420 if SMOKE else 1200,
 }
 
 
@@ -688,6 +689,73 @@ def tier_fleet():
           "worker_failures": snap["counters"].get("worker-failures", 0)})
 
 
+def tier_procfleet():
+    """Out-of-process fleet tier: real worker subprocesses behind the
+    wire protocol + net_proxy links vs one in-process CheckService — the
+    price of the process boundary and the socket hop on a healthy fleet
+    — plus the recovery wall when a worker PROCESS is SIGKILLed
+    mid-campaign (supervisor respawn + reroute, the bound the procfleet
+    chaos smoke asserts against the deadline budget)."""
+    from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.serve.chaos import ChaosNemesis
+    from jepsen_tpu.serve.fleet import ProcFleet
+    from jepsen_tpu.synth import cas_register_history
+    n = 16 if SMOKE else 64
+    hists = [cas_register_history(60, concurrency=4, seed=s)
+             for s in range(n)]
+
+    def run(svc):
+        t0 = time.time()
+        reqs = [svc.submit(h, kind="wgl", model="cas-register",
+                           deadline_s=120.0) for h in hists]
+        vals = [r.wait(timeout=300)["valid"] for r in reqs]
+        return time.time() - t0, vals
+
+    solo = CheckService(max_lanes=32, capacity=64)
+    run(solo)                                   # warm the bucket ladder
+    t_solo, v_solo = run(solo)
+    solo.close(timeout=60.0)
+
+    fleet = ProcFleet(workers=3, spawn=True, max_lanes=32, capacity=64,
+                      default_deadline_s=120.0)
+    run(fleet)                                  # warm the worker procs
+    t_fleet, v_fleet = run(fleet)
+    assert v_fleet == v_solo, "procfleet verdicts diverge from solo"
+
+    # Partition wall: sever one worker's wire mid-campaign, heal it.
+    chaos = ChaosNemesis(fleet)
+    reqs = [fleet.submit(h, kind="wgl", model="cas-register",
+                         deadline_s=120.0) for h in hists]
+    t0 = time.time()
+    key = chaos.partition_worker(0)
+    v_part = [r.wait(timeout=300)["valid"] for r in reqs]
+    partition_s = time.time() - t0
+    chaos.heal(key)
+    assert v_part == v_solo, "verdicts diverged under partition"
+
+    # Recovery wall: SIGKILL a worker process with the campaign in
+    # flight; the supervisor respawns it, the drivers reroute.
+    reqs = [fleet.submit(h, kind="wgl", model="cas-register",
+                         deadline_s=120.0) for h in hists]
+    t0 = time.time()
+    fleet.workers[1].kill()
+    v_kill = [r.wait(timeout=300)["valid"] for r in reqs]
+    recovery_s = time.time() - t0
+    snap = fleet.metrics.snapshot()
+    fleet.close(timeout=60.0)
+    assert v_kill == v_solo, "verdicts diverged under process kill"
+    emit({"n_histories": n,
+          "solo_s": round(t_solo, 3),
+          "procfleet_s": round(t_fleet, 3),
+          "wire_overhead": round(t_fleet / t_solo, 2) if t_solo else None,
+          "partition_recovery_s": round(partition_s, 3),
+          "kill_recovery_s": round(recovery_s, 3),
+          "rerouted": snap["counters"].get("cells-rerouted", 0),
+          "hedges": snap["counters"].get("hedges", 0),
+          "respawns": snap["counters"].get("supervisor-respawns", 0),
+          "worker_failures": snap["counters"].get("worker-failures", 0)})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -703,6 +771,7 @@ TIER_FNS = {
     "multireg": tier_multireg,
     "elle": tier_elle,
     "fleet": tier_fleet,
+    "procfleet": tier_procfleet,
 }
 
 
@@ -781,7 +850,7 @@ def main():
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
                  "batch_sweep", "ablation_on", "ablation_off", "setup2",
-                 "sched", "multireg", "elle", "fleet"):
+                 "sched", "multireg", "elle", "fleet", "procfleet"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "

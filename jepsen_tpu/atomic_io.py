@@ -92,3 +92,25 @@ def atomic_write(path: str, write_fn: Callable, mode: str = "w") -> None:
             write_fn(f)
             f.flush()
             os.fsync(f.fileno())
+
+
+def exclusive_create(path: str, data: str) -> bool:
+    """Create ``path`` with ``data`` iff it does not already exist —
+    ``O_CREAT|O_EXCL``, so of N racing creators exactly one returns True
+    and the rest see False.  This is the single-winner lock primitive for
+    the fleet's journal-recovery claim: unlike ``atomic_write`` (last
+    writer wins, by design) the *first* writer wins here and everyone
+    else finds out.  The file data and its directory entry are fsynced
+    before returning, so a crash after a True cannot resurrect a world
+    where nobody held the claim."""
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, data.encode("utf-8"))
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
+    return True

@@ -131,6 +131,12 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                     help="fleet in-flight journal directory (default "
                          "<store>/fleet-journal); 'none' disables "
                          "crash journaling")
+    ps.add_argument("--procs", action="store_true",
+                    help="run fleet workers as real OS processes behind "
+                         "the wire protocol (serve/transport.py), each "
+                         "dialed through a chaos-controllable net_proxy "
+                         "link; implies the fleet path even with "
+                         "--workers 1")
 
     pq = sub.add_parser("submit",
                         help="submit a stored history to a running serve")
@@ -185,23 +191,36 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
             # The fleet is the default serving path: N worker services
             # behind the fault-tolerant router (serve/fleet.py).
             # --workers 1 keeps the old single-service behaviour.
-            if max(1, args.workers) > 1:
-                from jepsen_tpu.serve.fleet import Fleet
+            if max(1, args.workers) > 1 or args.procs:
+                from jepsen_tpu.serve.fleet import Fleet, ProcFleet
                 jdir = args.journal_dir
                 if jdir is None:
                     jdir = os.path.join(args.store, "fleet-journal")
                 elif jdir == "none":
                     jdir = None
-                service = Fleet(workers=args.workers,
-                                store_base=args.store,
-                                journal_dir=jdir,
-                                max_lanes=args.max_lanes,
-                                max_queue_cells=args.max_queue)
+                fleet_cls = ProcFleet if args.procs else Fleet
+                service = fleet_cls(workers=args.workers,
+                                    store_base=args.store,
+                                    journal_dir=jdir,
+                                    max_lanes=args.max_lanes,
+                                    max_queue_cells=args.max_queue)
             else:
                 from jepsen_tpu.serve import CheckService
                 service = CheckService(store_base=args.store,
                                        max_lanes=args.max_lanes,
                                        max_queue_cells=args.max_queue)
+        # SIGTERM must reach the finally below: with --procs the workers
+        # are setsid'd OS processes — dying without service.close() would
+        # orphan them (SIGINT already raises KeyboardInterrupt).
+        import signal
+
+        def _term(signum, frame):  # noqa: ARG001 — signal signature
+            raise SystemExit(143)
+
+        try:
+            signal.signal(signal.SIGTERM, _term)
+        except ValueError:  # not the main thread (library-embedded call)
+            pass
         try:
             serve(base=args.store, port=args.port, service=service)
         finally:

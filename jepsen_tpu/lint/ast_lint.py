@@ -23,15 +23,25 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+#: top-level trees the AST tier discovers; a rule's SCOPE then narrows
+#: per rule.  suites/ carries real threaded client/runner code (the
+#: localkv/chronos/mongodb suites), so its concurrency invariants are
+#: audited like the package's own.
+_SCAN_TREES = ("jepsen_tpu", "suites")
+
+
 def _iter_py_files(root: str) -> List[str]:
     out = []
-    pkg = os.path.join(root, "jepsen_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                rel = os.path.relpath(os.path.join(dirpath, fn), root)
-                out.append(rel.replace(os.sep, "/"))
+    for tree in _SCAN_TREES:
+        pkg = os.path.join(root, tree)
+        if not os.path.isdir(pkg):
+            continue
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
     return out
 
 

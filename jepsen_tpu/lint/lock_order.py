@@ -16,10 +16,21 @@ the tuple (earlier = outermost-permitted).
 
 The declared order mirrors the call graph today:
 
-    fleet -> service -> scheduler -> request -> metrics
+    fleet-supervisor -> fleet -> fleet-slot
+      -> transport-ready -> transport-state -> transport-send
+      -> procworker-state -> procworker-send
+      -> service -> scheduler -> request -> metrics
     router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
     engine-cache (leaf: parallel.batch's LRU, acquired under anything)
+
+The transport chain follows a respawn end to end: the ProcFleet
+supervisor (``_sup_lock``) restarts a slot (``_restart_lock``), whose
+new ProcWorkerService builds its wire under ``_ready_lock``; the
+WireClient guards connection + pending-table state with its ``_lock``
+and serializes frame writes with ``_send_lock``; worker-side, the
+WorkerServer's table lock precedes each connection's send lock, and a
+ThreadWorker's in-process CheckService sits underneath all of it.
 """
 
 from __future__ import annotations
@@ -28,8 +39,23 @@ import re
 from typing import List, Optional, Tuple
 
 LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
+    ("fleet-supervisor",
+     [(r"serve/fleet\.py$", r"^self\._sup_lock$")]),
     ("fleet",
      [(r"serve/fleet\.py$", r"^self\._(lock|cond)$")]),
+    ("fleet-slot",
+     [(r"serve/fleet\.py$", r"^self\._restart_lock$"),
+      (r"", r"^(w|worker)\._restart_lock$")]),
+    ("transport-ready",
+     [(r"serve/transport\.py$", r"^self\._ready_lock$")]),
+    ("transport-state",
+     [(r"serve/transport\.py$", r"^self\._lock$")]),
+    ("transport-send",
+     [(r"serve/transport\.py$", r"^self\._send_lock$")]),
+    ("procworker-state",
+     [(r"serve/worker_main\.py$", r"^self\._lock$")]),
+    ("procworker-send",
+     [(r"serve/worker_main\.py$", r"^(self|c|cs|conn)\._send_lock$")]),
     ("service",
      [(r"serve/service\.py$", r"^self\._lock$")]),
     ("scheduler",

@@ -37,7 +37,7 @@ from jepsen_tpu.lint.rules import dotted, qualname_of, walk_with_parents
 
 RULE = "CONC01"
 
-SCOPE = ("jepsen_tpu/",)
+SCOPE = ("jepsen_tpu/", "suites/")
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from jepsen_tpu.clock import mono_now
 from jepsen_tpu.net import Net
 
 
@@ -35,12 +36,22 @@ class PairProxy:
     accept-then-close sever was tried first: it turns every op during a
     partition into an indeterminate :info ghost, which is both a worse
     model of a cut link and an unbounded load on the linearizability
-    checker's pending window.)  ``heal()`` re-binds the same port."""
+    checker's pending window.)  ``heal()`` re-binds the same port.
+
+    Beyond partitions, the link also shapes and tears traffic for the
+    serve-tier self-nemesis (serve/chaos.py): ``delay_s`` stalls every
+    forwarded chunk (netem-delay on the wire itself), ``reset_conns()``
+    RSTs live connections without touching the listener (a frame in
+    flight is torn mid-stream; the very next dial succeeds), and
+    ``retarget()`` repoints the upstream address so a respawned worker
+    process keeps its slot's stable proxy port."""
 
     def __init__(self, src: str, dst: str, target: Tuple[str, int]):
         self.src, self.dst = src, dst
         self.target = target
         self.severed = False
+        #: per-chunk forwarding stall (seconds); 0 = unshaped
+        self.delay_s = 0.0
         self._lock = threading.Lock()
         self._conns: List[socket.socket] = []
         srv = socket.socket()
@@ -115,14 +126,87 @@ class PairProxy:
             except OSError:
                 pass
 
-    def heal(self) -> None:
+    def heal(self, rebind_timeout_s: float = 5.0) -> None:
+        """Reopen the link on the same port.  The fast path listens on
+        the placeholder socket reserved at sever time (no unbind window);
+        if that socket is gone or the OS refuses it, fall back to
+        re-binding the port under bounded exponential backoff — the
+        kernel may not have released the old listener yet (close() is
+        asynchronous with respect to the port actually freeing), and a
+        heal that gives up on the first EADDRINUSE leaves the partition
+        permanent.  Raises the last OSError only after
+        ``rebind_timeout_s`` of retries."""
         with self._lock:
             if not self.severed:
                 return
             self.severed = False
             ph, self._placeholder = self._placeholder, None
-            # the reserved socket simply starts listening: no unbind window
-            self._listen(ph)
+        if ph is not None:
+            try:
+                with self._lock:
+                    self._listen(ph)
+                return
+            except OSError:
+                try:
+                    ph.close()
+                except OSError:
+                    pass
+        srv = self._rebind_with_backoff(rebind_timeout_s)
+        with self._lock:
+            if self.severed:
+                # a sever raced the heal: the link stays down, and the
+                # fresh socket becomes the sever's placeholder
+                self._placeholder = srv
+                return
+            self._listen(srv)
+
+    def _rebind_with_backoff(self, timeout_s: float) -> socket.socket:
+        """Bind a fresh socket to our stable port, retrying while the OS
+        still holds the old listener; raises the last error at timeout."""
+        deadline = mono_now() + max(0.0, timeout_s)
+        delay = 0.005
+        while True:
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", self.port))
+                return s
+            except OSError:
+                s.close()
+                left = deadline - mono_now()
+                if left <= 0:
+                    with self._lock:
+                        self.severed = True  # heal failed: link stays down
+                    raise
+                time.sleep(min(delay, left))
+                delay = min(0.1, delay * 2)
+
+    def retarget(self, target: Tuple[str, int]) -> None:
+        """Repoint the upstream address (each proxied connection reads it
+        at dial time): a respawned worker process lands on a new ephemeral
+        port, but its slot's proxy port — what the fleet dials — is
+        stable across the restart."""
+        with self._lock:
+            self.target = target
+
+    def reset_conns(self) -> int:
+        """Mid-frame cut: RST every live proxied connection, listener
+        untouched — a frame in flight is torn mid-stream (both peers see
+        a hard reset, not EOF at a frame boundary), while the very next
+        dial succeeds.  Returns the number of link connections cut."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        return len(conns) // 2  # client+upstream pair per proxied conn
 
     def close(self) -> None:
         self.sever()
@@ -158,8 +242,10 @@ class PairProxy:
                              daemon=True).start()
 
     def _pump_pair(self, client: socket.socket) -> None:
+        with self._lock:
+            target = self.target
         try:
-            upstream = socket.create_connection(self.target, timeout=2)
+            upstream = socket.create_connection(target, timeout=2)
         except OSError:
             try:
                 client.close()
@@ -180,13 +266,15 @@ class PairProxy:
         threading.Thread(target=self._pump, args=(upstream, client),
                          daemon=True).start()
 
-    @staticmethod
-    def _pump(a: socket.socket, b: socket.socket) -> None:
+    def _pump(self, a: socket.socket, b: socket.socket) -> None:
         try:
             while True:
                 data = a.recv(65536)
                 if not data:
                     break
+                d = self.delay_s
+                if d > 0:
+                    time.sleep(d)  # slow-link shaping (chaos slow_link)
                 b.sendall(data)
         except OSError:
             pass

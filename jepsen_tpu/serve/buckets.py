@@ -95,6 +95,24 @@ def worker_lane_share(total_lanes: int, n_workers: int) -> int:
                              MIN_WORKER_LANES))
 
 
+def proc_worker_lanes(total_lanes: int, n_workers: int,
+                      shared_host: bool = True) -> int:
+    """A ProcFleet worker's per-dispatch lane budget.  Out-of-process
+    workers on ONE host (today's shape: N subprocesses sharing the
+    host's device) still split the device's lane allowance, so the
+    budget divides exactly like :func:`worker_lane_share` — same ladder
+    rungs, same shared compile cache with the solo oracle.  Workers that
+    will land on their *own* hosts (``shared_host=False``, the
+    multi-host direction) each take the full rung: nothing is shared,
+    and dividing would just waste their private device."""
+    if not shared_host:
+        return min(MAX_LANE_BUCKET,
+                   pow2_at_least(max(max(1, total_lanes),
+                                     MIN_WORKER_LANES),
+                                 MIN_WORKER_LANES))
+    return worker_lane_share(total_lanes, n_workers)
+
+
 #: ceiling of the megabatch lane-count ladder: concurrently-resident
 #: device lanes across a bucket's groups.  Lanes beyond MAX_LANE_BUCKET
 #: run as grouped vmaps of <= MAX_LANE_BUCKET width that reuse ONE

@@ -33,6 +33,24 @@ packet drop / disk corruption):
   device state / corrupted executable): the worker's cells resolve as
   worker-failure unknowns, the breaker opens, the router reroutes.
 
+Against a :class:`~jepsen_tpu.serve.fleet.ProcFleet` — whose workers
+are real processes dialed through per-slot
+:class:`~jepsen_tpu.net_proxy.PairProxy` links — a second fault family
+targets the *wire itself*, the one layer in-process patching could
+never reach:
+
+- ``partition_worker`` — sever the link: live connections RST, new
+  dials ECONNREFUSED (the undo heals the listener, and the clients'
+  decorrelated reconnect storm is part of what's under test);
+- ``cut_links``      — RST live connections mid-frame, listener
+  untouched: a frame is torn in flight, the very next dial succeeds;
+- ``slow_link``      — per-chunk forwarding stall (netem delay on the
+  actual byte stream, not a patched callback).
+
+The scheduler-patching faults require in-process workers and the link
+faults require proxied ones; asking the wrong family raises
+``ValueError`` with directions rather than silently no-opping.
+
 Undo closures are idempotent; a fault injected on a worker that has
 since been restarted heals as a no-op (the patches died with the old
 service object).
@@ -40,6 +58,7 @@ service object).
 
 from __future__ import annotations
 
+import itertools
 import random
 import time
 from typing import Any, Dict, Optional
@@ -73,6 +92,30 @@ class ChaosNemesis:
         self._rng = random.Random(seed)
         self.injected: Dict[str, str] = {}  # key -> description (ledger)
         self._undos: Dict[str, Any] = {}
+        self._cut_seq = itertools.count(1)
+
+    # -- target resolution -------------------------------------------------
+    def _sched_of(self, wid: int):
+        """The worker's in-process scheduler, for the patching faults.
+        A ProcFleet worker's scheduler lives in another PROCESS — patch
+        faults cannot reach it; use the link faults instead."""
+        svc = self.fleet.workers[wid].service
+        sched = getattr(svc, "_sched", None)
+        if sched is None:
+            raise ValueError(
+                f"worker {wid} is out-of-process: its scheduler is not "
+                f"patchable from here — use partition_worker / "
+                f"cut_links / slow_link to fault its wire instead")
+        return sched
+
+    def _proxy_of(self, wid: int):
+        """The worker's PairProxy link, for the wire faults."""
+        proxy = getattr(self.fleet.workers[wid], "proxy", None)
+        if proxy is None:
+            raise ValueError(
+                f"worker {wid} has no proxy link (in-process fleet) — "
+                f"use pause/delay/drop/poison scheduler faults instead")
+        return proxy
 
     # -- bookkeeping ------------------------------------------------------
     def _register(self, key: str, undo, description: str) -> str:
@@ -113,7 +156,7 @@ class ChaosNemesis:
         ``stall_s`` before running.  The worker stays alive (heartbeats
         pass) but its latency EWMA climbs and deadline-risky cells hedge
         to siblings."""
-        sched = self.fleet.workers[wid].service._sched
+        sched = self._sched_of(wid)
         orig = sched._process
 
         def paused(cells):
@@ -128,7 +171,7 @@ class ChaosNemesis:
 
     def delay_responses(self, wid: int, delay_s: float = 0.25) -> str:
         """netem-delay analogue: verdicts from this worker land late."""
-        sched = self.fleet.workers[wid].service._sched
+        sched = self._sched_of(wid)
         orig = sched._finalize
 
         def delayed(cell, result):
@@ -147,7 +190,7 @@ class ChaosNemesis:
         check completed nowhere.  The cell's fleet driver must cover this
         with a hedge (it cannot distinguish a dropped response from a
         slow worker; nobody can — that's the point)."""
-        sched = self.fleet.workers[wid].service._sched
+        sched = self._sched_of(wid)
         orig = sched._finalize
         rng = self._rng
 
@@ -170,7 +213,7 @@ class ChaosNemesis:
         proves the verdict lattice: the poisoned worker must never turn
         a checkable history into ``false`` — the router reroutes, the
         breaker opens, and the verdict comes from a healthy sibling."""
-        sched = self.fleet.workers[wid].service._sched
+        sched = self._sched_of(wid)
 
         def bad_dispatch(*a, **kw):
             raise RuntimeError("chaos: poisoned device dispatch")
@@ -190,3 +233,49 @@ class ChaosNemesis:
 
         return self._register(f"fleet:poison:{wid}", undo,
                               f"worker {wid} dispatches poisoned")
+
+    # -- link faults (ProcFleet wires) ------------------------------------
+    def partition_worker(self, wid: int) -> str:
+        """Network partition: sever this worker's proxy link.  Live
+        connections are RST mid-flight and new dials get ECONNREFUSED —
+        the worker process keeps running, correctly, on the far side of
+        a dead wire (the distinction the in-process chaos could never
+        draw).  The undo heals the listener; what happens next — the
+        decorrelated reconnect storm, the breaker's half-open probe, the
+        re-sent SUBMITs deduped by id — is the recovery under test."""
+        proxy = self._proxy_of(wid)
+        proxy.sever()
+        self.fleet.metrics.inc("chaos-partitions")
+        return self._register(f"fleet:partition:{wid}", proxy.heal,
+                              f"worker {wid} link severed")
+
+    def cut_links(self, wid: int) -> str:
+        """Mid-frame cut: RST this link's live connections, listener
+        untouched.  A frame in flight is torn — the worker's reader sees
+        a FrameError and drops only that connection; the client re-dials
+        immediately and re-sends unacked SUBMITs under the same ids.
+        Repeatable (each cut gets a unique registry key); the undo is a
+        no-op — there is nothing to heal, the next dial already works."""
+        proxy = self._proxy_of(wid)
+        n = proxy.reset_conns()
+        self.fleet.metrics.inc("chaos-conn-cuts")
+        return self._register(
+            f"fleet:cut:{wid}:{next(self._cut_seq)}",
+            lambda: None,
+            f"worker {wid} link: {n} live connection(s) RST mid-frame")
+
+    def slow_link(self, wid: int, delay_s: float = 0.1) -> str:
+        """netem-delay on the actual byte stream: every chunk the proxy
+        forwards on this link stalls ``delay_s``.  Unlike
+        ``delay_responses`` (a patched callback inside the worker), this
+        slows SUBMITs *and* RESULTs *and* heartbeat RPCs — the whole
+        wire, both directions, exactly what a congested path does."""
+        proxy = self._proxy_of(wid)
+        proxy.delay_s = delay_s
+        self.fleet.metrics.inc("chaos-slow-links")
+
+        def undo():
+            proxy.delay_s = 0.0
+
+        return self._register(f"fleet:slow-link:{wid}", undo,
+                              f"worker {wid} link +{delay_s}s/chunk")

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from jepsen_tpu import atomic_io
 from jepsen_tpu.control.retry import RetryPolicy
+from jepsen_tpu.net_proxy import PairProxy
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
@@ -78,6 +79,11 @@ DEFAULT_FLEET_DEADLINE_S = 60.0
 _WORKER_FAILURE_ERRORS = (
     "scheduler dispatch crashed",
     "device and host tiers both failed",
+    # transport.py's wire-failure verdicts: a lost/torn connection is a
+    # worker(-link) failure by definition — the history never reached a
+    # checker, so rerouting to a sibling is always sound
+    "transport connection lost",
+    "transport frame error",
 )
 
 
@@ -114,6 +120,7 @@ class FleetWorker:
                                       open_s=open_s)
         self.health = WorkerHealth()
         self.generation = 0
+        self._restart_lock = threading.Lock()
 
     def alive(self) -> bool:
         return self.service.alive()
@@ -124,17 +131,25 @@ class FleetWorker:
         detect the death and reroute — nothing here touches fleet state."""
         return self.service.kill()
 
-    def restart(self) -> None:
+    def restart(self, only_if_dead: bool = False) -> bool:
         """Replace a dead service with a fresh one and reset the circuit
         (a restarted worker earns its traffic back through the normal
-        closed-state accounting)."""
-        try:
-            self.service.kill()
-        except Exception:  # noqa: BLE001 — it's already dead
-            pass
-        self.service = self._make_service()
-        self.generation += 1
-        self.breaker.reset()
+        closed-state accounting).  ``only_if_dead`` is the supervisor's
+        guard — a chaos undo and the ProcFleet supervisor may both reach
+        for the same corpse, and the restart lock plus the liveness
+        re-check under it make exactly one of them actually respawn.
+        Returns True iff THIS call replaced the service."""
+        with self._restart_lock:
+            if only_if_dead and self.alive():
+                return False
+            try:
+                self.service.kill()
+            except Exception:  # noqa: BLE001 — it's already dead
+                pass
+            self.service = self._make_service()
+            self.generation += 1
+            self.breaker.reset()
+            return True
 
     def status(self) -> Dict[str, Any]:
         try:
@@ -174,6 +189,8 @@ class FleetJournal:
 
     VERSION = 1
     FILENAME = "fleet-journal.json"
+    #: the recovery-claim lock file (exclusive_create; single winner)
+    CLAIMNAME = "fleet-journal.claim"
 
     def __init__(self, journal_dir: str):
         self.dir = atomic_io.durable_mkdir(journal_dir)
@@ -254,6 +271,75 @@ class FleetJournal:
                 out["pending"].append(item)
         return out
 
+    # -- the recovery claim -----------------------------------------------
+    # Two supervisors recovering the SAME journal directory (a respawned
+    # fleet racing a slow-to-die predecessor, or an operator's manual
+    # recovery racing an automatic one) would each resubmit every pending
+    # cell: not a correctness bug (claim_finish dedups the verdict) but a
+    # 2x re-check of every pending history.  The claim file — created
+    # with O_CREAT|O_EXCL via atomic_io.exclusive_create — makes recovery
+    # single-winner: exactly one claimant resubmits, the loser reports
+    # who beat it.  A claim whose recorded pid is dead is STALE (the
+    # claimant crashed mid-recovery) and may be stolen; the steal itself
+    # races through os.replace, where again only one renamer wins.
+
+    @staticmethod
+    def _pid_alive(pid: Any) -> bool:
+        try:
+            pid = int(pid)
+        except (TypeError, ValueError):
+            return False
+        if pid <= 0:
+            # os.kill(0/-N, 0) signals whole process GROUPS — never probe
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            return True  # e.g. EPERM: exists, not ours
+        return True
+
+    @classmethod
+    def _claim_path(cls, journal_dir: str) -> str:
+        return os.path.join(journal_dir, cls.CLAIMNAME)
+
+    @classmethod
+    def claim_holder(cls, journal_dir: str) -> Optional[Dict[str, Any]]:
+        """The current claim record ({"claimant", "pid"}) or None."""
+        try:
+            with open(cls._claim_path(journal_dir)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @classmethod
+    def claim_recovery(cls, journal_dir: str, claimant: str) -> bool:
+        """Try to become THE recoverer of this journal directory.  True
+        = we hold the claim (fresh win, our own re-claim, or a stale
+        claim stolen); False = a live claimant beat us."""
+        path = cls._claim_path(journal_dir)
+        record = json.dumps({"claimant": claimant, "pid": os.getpid()})
+        if atomic_io.exclusive_create(path, record):
+            return True
+        holder = cls.claim_holder(journal_dir)
+        if holder is not None:
+            if (holder.get("claimant") == claimant
+                    and holder.get("pid") == os.getpid()):
+                return True  # our own claim (idempotent re-claim)
+            if cls._pid_alive(holder.get("pid")):
+                return False
+        # stale (dead pid) or unreadable: steal by renaming it aside —
+        # os.replace is atomic, so of N stealers exactly one moves the
+        # old claim and the rest lose the fresh exclusive_create below
+        try:
+            os.replace(path, path + ".stale")
+        except FileNotFoundError:
+            pass  # someone else already stole it; race them for the file
+        except OSError:
+            return False
+        return atomic_io.exclusive_create(path, record)
+
 
 class _FleetMetrics(Metrics):
     """The fleet's Metrics registry plus a ``fleet`` snapshot section
@@ -294,30 +380,18 @@ class Fleet:
                  pin_devices: bool = True):
         n = max(1, int(workers))
         self.n_workers = n
+        self.max_lanes = max_lanes
         self.max_queue_cells = max_queue_cells
         self.default_deadline_s = default_deadline_s
         self.hedge_s = hedge_s
         self.heartbeat_s = heartbeat_s
-        lanes_each = buckets.worker_lane_share(max_lanes, n)
         device_sets = _device_sets(n) if pin_devices else [[]] * n
-
-        def make_service(i: int) -> Callable[[], CheckService]:
-            devs = device_sets[i]
-
-            def make() -> CheckService:
-                return CheckService(
-                    max_queue_cells=max_queue_cells,
-                    max_lanes=lanes_each,
-                    store_base=store_base, mesh=mesh,
-                    capacity=capacity, max_capacity=max_capacity,
-                    device=devs[0] if devs else None)
-            return make
-
-        self.workers: List[FleetWorker] = [
-            FleetWorker(i, make_service(i), devices=device_sets[i],
-                        fail_threshold=breaker_fail_threshold,
-                        open_s=breaker_open_s)
-            for i in range(n)]
+        self.workers: List[FleetWorker] = self._make_workers(
+            n, buckets.worker_lane_share(max_lanes, n), device_sets,
+            store_base=store_base, mesh=mesh, capacity=capacity,
+            max_capacity=max_capacity,
+            fail_threshold=breaker_fail_threshold,
+            open_s=breaker_open_s)
         self.router = Router(self.workers)
         self.metrics = _FleetMetrics(self)
         # Decorrelated jitter by default: reroutes after a worker death
@@ -339,6 +413,31 @@ class Fleet:
             target=self._heartbeat_loop, daemon=True,
             name="fleet-heartbeat")
         self._hb_thread.start()
+
+    def _make_workers(self, n: int, lanes_each: int,
+                      device_sets: List[list], *,
+                      store_base: Optional[str], mesh,
+                      capacity: Optional[int], max_capacity: int,
+                      fail_threshold: int,
+                      open_s: float) -> List["FleetWorker"]:
+        """Build the worker slots — ProcFleet overrides this to put each
+        slot's service behind the wire instead of in-process."""
+
+        def make_service(i: int) -> Callable[[], CheckService]:
+            devs = device_sets[i]
+
+            def make() -> CheckService:
+                return CheckService(
+                    max_queue_cells=self.max_queue_cells,
+                    max_lanes=lanes_each,
+                    store_base=store_base, mesh=mesh,
+                    capacity=capacity, max_capacity=max_capacity,
+                    device=devs[0] if devs else None)
+            return make
+
+        return [FleetWorker(i, make_service(i), devices=device_sets[i],
+                            fail_threshold=fail_threshold, open_s=open_s)
+                for i in range(n)]
 
     # -- submission -------------------------------------------------------
     def _inflight(self) -> int:
@@ -643,14 +742,15 @@ class Fleet:
                     self.metrics.inc("heartbeat-misses")
             time.sleep(self.heartbeat_s)
 
-    def restart_worker(self, wid: int) -> FleetWorker:
+    def restart_worker(self, wid: int,
+                       only_if_dead: bool = False) -> FleetWorker:
         """Bring a (dead) worker slot back with a fresh service; its
         journal-relevant state lives fleet-side, so nothing is replayed
         here — cells routed to the corpse already rerouted via their
         owner threads."""
         w = self.workers[wid]
-        w.restart()
-        self.metrics.inc("worker-restarts")
+        if w.restart(only_if_dead=only_if_dead):
+            self.metrics.inc("worker-restarts")
         return w
 
     def fleet_status(self) -> Dict[str, Any]:
@@ -665,13 +765,26 @@ class Fleet:
                 "circuits": {w.wid: dict(w.breaker.transitions)
                              for w in self.workers}}
 
-    def healthz(self) -> Dict[str, Any]:
+    def healthz(self, deep: bool = False) -> Dict[str, Any]:
         """The load-balancer/chaos probe payload (web.py GET /healthz):
         fleet is ``ok`` while at least one worker is alive with a
-        non-open circuit."""
+        non-open circuit.  ``deep`` additionally asks each remote worker
+        for its OWN healthz over the wire (``GET /healthz?deep=1``) —
+        best-effort per worker, so one partitioned link degrades that
+        worker's entry, never the probe."""
         st = self.fleet_status()
         ok = any(w["alive"] and w["circuit"] != OPEN
                  for w in st["workers"])
+        if deep:
+            for w, entry in zip(self.workers, st["workers"]):
+                remote_hz = getattr(w.service, "healthz", None)
+                if remote_hz is None:
+                    continue
+                try:
+                    entry["remote"] = remote_hz()
+                except Exception as e:  # noqa: BLE001 — unreachable link
+                    entry["remote"] = {"ok": False,
+                                       "error": f"{type(e).__name__}: {e}"}
         return {"ok": ok, "queue-depth": self.queue_depth(), **st}
 
     # -- journal recovery -------------------------------------------------
@@ -680,12 +793,25 @@ class Fleet:
         """Read a crashed fleet's journal: see FleetJournal.recover."""
         return FleetJournal.recover(journal_dir)
 
-    def resubmit_recovered(self, journal_dir: str) -> Dict[str, Any]:
+    def resubmit_recovered(self, journal_dir: str,
+                           claimant: Optional[str] = None
+                           ) -> Dict[str, Any]:
         """Re-enqueue a crashed fleet's journaled cells onto THIS fleet.
         Pending cells are resubmitted with their remaining deadline
         budget; already-expired cells are NOT re-checked — they are
         reported so the caller can surface their ``unknown`` explicitly.
-        Returns ``{"requests": [Request...], "expired": [items]}``."""
+
+        Recovery is single-winner: the claim file (exclusive_create,
+        stale-stealable when its pid is dead) guarantees that of N
+        supervisors recovering the same directory exactly one resubmits
+        each pending cell.  The loser returns immediately with
+        ``claimed: False`` and who beat it.  Returns ``{"requests":
+        [Request...], "expired": [items], "claimed": bool}``."""
+        me = claimant or f"fleet-{id(self):x}"
+        if not FleetJournal.claim_recovery(journal_dir, me):
+            self.metrics.inc("journal-claim-lost")
+            return {"requests": [], "expired": [], "claimed": False,
+                    "claimed-by": FleetJournal.claim_holder(journal_dir)}
         rec = FleetJournal.recover(journal_dir)
         reqs = []
         for item in rec["pending"]:
@@ -694,7 +820,8 @@ class Fleet:
             self.metrics.inc("journal-recovered", len(rec["pending"]))
         if rec["expired"]:
             self.metrics.inc("journal-expired", len(rec["expired"]))
-        return {"requests": reqs, "expired": rec["expired"]}
+        return {"requests": reqs, "expired": rec["expired"],
+                "claimed": True}
 
     # -- core.analyze routing (shared with CheckService) ------------------
     _routable = CheckService._routable
@@ -755,3 +882,203 @@ class Fleet:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# out-of-process workers on a real wire
+# ---------------------------------------------------------------------------
+
+
+class ProcWorker(FleetWorker):
+    """A worker slot whose service lives across a socket: the
+    :class:`~jepsen_tpu.serve.transport.ProcWorkerService` facade over a
+    launcher (real subprocess or in-process thread server), dialed
+    through this slot's stable :class:`~jepsen_tpu.net_proxy.PairProxy`
+    link so the chaos harness owns the wire."""
+
+    def __init__(self, wid: int, make_service, proxy: PairProxy,
+                 devices: Optional[list] = None,
+                 fail_threshold: int = 3, open_s: float = 1.0):
+        self.proxy = proxy
+        super().__init__(wid, make_service, devices=devices,
+                         fail_threshold=fail_threshold, open_s=open_s)
+
+    def status(self) -> Dict[str, Any]:
+        st = super().status()
+        st["link"] = {"proxy-port": self.proxy.port,
+                      "severed": self.proxy.severed,
+                      "delay-s": self.proxy.delay_s}
+        remote = getattr(self.service, "remote_status", None)
+        if remote is not None:
+            try:
+                st["proc"] = remote()
+            except Exception:  # noqa: BLE001 — status never raises
+                pass
+        return st
+
+
+class ProcFleet(Fleet):
+    """The fleet with every worker out of process and every byte of the
+    submit surface on a real wire.
+
+    Each slot runs ``python -m jepsen_tpu.serve.worker_main`` as its own
+    OS process (``spawn=True``; ``spawn=False`` hosts the identical
+    protocol server on a thread for tier-1 CI), dialed through a
+    per-slot PairProxy whose port is stable across worker respawns
+    (``retarget``).  That link is what upgrades the chaos harness from
+    scheduler-patching faults to true network faults: partition
+    (RST + ECONNREFUSED), mid-frame cuts, slow links, reconnect storms.
+
+    A supervisor thread respawns crashed worker *processes* into their
+    slots — the process-tier analogue of ``restart_worker`` — while the
+    per-cell drivers handle the requests the corpse stranded (transport
+    unknowns → reroute), and the journal claim keeps a crashed
+    *supervisor*'s recovery single-winner."""
+
+    def __init__(self, workers: int = 3, *,
+                 spawn: bool = True,
+                 log_dir: Optional[str] = None,
+                 supervise_s: float = 0.5,
+                 worker_ready_timeout_s: float = 120.0,
+                 **kw):
+        self._spawn = spawn
+        self._log_dir = log_dir
+        self.supervise_s = supervise_s
+        self.worker_ready_timeout_s = worker_ready_timeout_s
+        self.proxies: List[PairProxy] = []
+        self._sup_lock = threading.Lock()
+        self._store_base = kw.get("store_base")
+        # subprocess workers already pin nothing useful from the parent;
+        # device pinning is the worker process's own business
+        kw.setdefault("pin_devices", False)
+        # resolved before super().__init__ because _make_workers (called
+        # from there) builds WireClients that share the fleet's policy
+        kw.setdefault("retry_policy", RetryPolicy(
+            tries=4, backoff_s=0.02, max_backoff_s=0.5, decorrelated=True))
+        self.retry_policy = kw["retry_policy"]
+        super().__init__(workers, **kw)
+        self._sup_thread = threading.Thread(
+            target=self._supervise_loop, daemon=True,
+            name="procfleet-supervisor")
+        self._sup_thread.start()
+
+    def _make_workers(self, n: int, lanes_each: int,
+                      device_sets: List[list], *,
+                      store_base: Optional[str], mesh,
+                      capacity: Optional[int], max_capacity: int,
+                      fail_threshold: int,
+                      open_s: float) -> List[FleetWorker]:
+        lanes = buckets.proc_worker_lanes(self.max_lanes, n)
+        if self._log_dir is None:
+            import tempfile
+            self._log_dir = tempfile.mkdtemp(prefix="procfleet-logs-")
+        workers: List[FleetWorker] = []
+        for i in range(n):
+            # the target is retargeted at the worker's real port once
+            # its launcher reports ready; port 1 can never accept, so a
+            # dial before readiness fails fast instead of hanging
+            proxy = PairProxy("fleet", f"worker-{i}", ("127.0.0.1", 1))
+            self.proxies.append(proxy)
+            workers.append(ProcWorker(
+                i, self._make_proc_service(i, lanes, proxy,
+                                           store_base=store_base,
+                                           capacity=capacity,
+                                           max_capacity=max_capacity),
+                proxy, devices=[],
+                fail_threshold=fail_threshold, open_s=open_s))
+        return workers
+
+    def _make_proc_service(self, i: int, lanes: int, proxy: PairProxy, *,
+                           store_base: Optional[str],
+                           capacity: Optional[int], max_capacity: int):
+        from jepsen_tpu.serve.transport import ProcWorkerService
+        from jepsen_tpu.serve.worker_main import (SubprocessWorker,
+                                                  ThreadWorker)
+        name = f"proc-worker-{i}"
+        spawn = self._spawn
+        log_dir = self._log_dir
+        ready_s = self.worker_ready_timeout_s
+        mqc = self.max_queue_cells
+
+        def make():
+            if spawn:
+                launcher = SubprocessWorker(
+                    name, os.path.join(log_dir, f"{name}.log"),
+                    args={"max-lanes": lanes, "max-queue": mqc,
+                          "store-base": store_base,
+                          "capacity": capacity,
+                          "max-capacity": max_capacity},
+                    ready_timeout_s=ready_s)
+            else:
+                launcher = ThreadWorker(
+                    name,
+                    lambda: CheckService(max_queue_cells=mqc,
+                                         max_lanes=lanes,
+                                         store_base=store_base,
+                                         capacity=capacity,
+                                         max_capacity=max_capacity))
+            return ProcWorkerService(launcher, proxy,
+                                     retry_policy=self.retry_policy,
+                                     name=name)
+        return make
+
+    # -- the supervisor ----------------------------------------------------
+    def _supervise_loop(self) -> None:
+        while not self._closed:
+            for w in self.workers:
+                try:
+                    if self._maybe_respawn(w):
+                        self.metrics.inc("supervisor-respawns")
+                except Exception:  # noqa: BLE001 — a failed respawn
+                    log.exception("supervisor respawn of worker %d "
+                                  "failed", w.wid)  # retries next sweep
+            time.sleep(self.supervise_s)
+
+    def _maybe_respawn(self, w: FleetWorker) -> bool:
+        """Respawn ``w`` iff its process is dead and the fleet is open.
+        The sup lock + ``only_if_dead`` make the supervisor, a chaos
+        undo, and a manual ``restart_worker`` mutually exclusive: one
+        respawner wins, the rest observe the fresh service."""
+        if w.alive():
+            return False
+        with self._sup_lock:
+            if self._closed or w.alive():
+                return False
+            if w.restart(only_if_dead=True):
+                self.metrics.inc("worker-restarts")
+                return True
+            return False
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout: Optional[float] = None) -> bool:
+        ok = super().close(timeout=timeout)
+        self._join_supervisor()
+        # an in-flight respawn may have installed a fresh service after
+        # super().close() swept the old ones: final sweep under the sup
+        # lock catches it (ProcWorkerService.close is idempotent)
+        with self._sup_lock:
+            for w in self.workers:
+                try:
+                    w.service.close(timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    pass
+        for p in self.proxies:
+            p.close()
+        return ok
+
+    def kill(self) -> None:
+        super().kill()
+        self._join_supervisor()
+        with self._sup_lock:
+            for w in self.workers:
+                try:
+                    w.service.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+        for p in self.proxies:
+            p.close()
+
+    def _join_supervisor(self) -> None:
+        t = getattr(self, "_sup_thread", None)
+        if t is not None and t.is_alive():
+            t.join(timeout=2 * self.supervise_s + 1.0)

@@ -68,6 +68,7 @@ class Scheduler:
         self._cond = threading.Condition(self._lock)
         self._stop = False
         self._inflight = 0
+        self._idle_listeners: List[Any] = []
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-scheduler")
         self._started = False
@@ -108,6 +109,16 @@ class Scheduler:
 
     def depth(self) -> int:
         return self._depth
+
+    def add_idle_listener(self, fn) -> None:
+        """Drain hook: ``fn()`` fires on the device-loop thread (outside
+        the lock) each time the scheduler goes idle — queue empty and
+        nothing in flight.  The wire worker (serve/worker_main.py) stamps
+        idle-age into its STATUS replies this way instead of polling the
+        condition variable; a listener must be cheap and must not block,
+        since it runs between dispatches."""
+        with self._lock:
+            self._idle_listeners.append(fn)
 
     def inflight(self) -> int:
         return self._inflight
@@ -260,6 +271,15 @@ class Scheduler:
                 with self._cond:
                     self._inflight = 0
                     self._cond.notify_all()
+                    listeners = (list(self._idle_listeners)
+                                 if self._depth == 0 else [])
+                # idle listeners fire outside the lock: a slow or buggy
+                # listener must neither wedge producers nor kill the loop
+                for fn in listeners:
+                    try:
+                        fn()
+                    except Exception:  # noqa: BLE001
+                        log.exception("scheduler idle listener failed")
 
     def _process(self, cells: List[Cell]) -> None:
         live: List[Cell] = []

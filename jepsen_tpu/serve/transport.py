@@ -1,0 +1,648 @@
+"""The fleet's wire protocol: length-prefixed JSON frames over TCP.
+
+PR 7's fleet proved the serving tier survives its own nemesis — but its
+workers were in-process replicas, so the one fault class the paper is
+*about* (partitions, resets, slow links between real processes on a
+real network) was never exercised.  This module is the client half of
+putting the submit surface on a socket: a :class:`WireClient` that
+dials one out-of-process worker (through a
+:class:`~jepsen_tpu.net_proxy.PairProxy` link, so the chaos harness can
+sever/shape/tear the wire), and a :class:`ProcWorkerService` facade
+that makes the remote worker look exactly like a local
+:class:`~jepsen_tpu.serve.service.CheckService` to the fleet's
+routing/hedging/journal machinery.  The server half lives in
+serve/worker_main.py.
+
+Framing: 4-byte big-endian payload length, then UTF-8 JSON.  Every
+frame is a dict with a ``type`` (SUBMIT/ACK/RESULT/STATUS/HEALTHZ/
+DRAIN/REPLY/ERROR) and, when it belongs to a call, an ``id``.  A
+length prefix over a byte stream makes every failure mode explicit:
+
+- clean EOF *between* frames is a graceful close (``read_frame`` →
+  None);
+- EOF *inside* a header or payload is a torn frame
+  (:class:`FrameError`) — a mid-frame cut, never silently half-parsed;
+- a length past :data:`MAX_FRAME_BYTES` is rejected before a byte of
+  payload is read (:class:`OversizedFrame`) — a corrupt or hostile
+  header cannot make the receiver allocate unbounded memory.
+
+Protocol invariants (the same discipline the rest of serve/ carries):
+
+- **monotonic-deadline propagation** — monotonic clocks do not cross
+  process boundaries, so a SUBMIT carries ``deadline-rem-s`` (remaining
+  seconds at send time) and the worker re-anchors it on its own
+  monotonic clock.  A re-sent SUBMIT re-uses the original remaining
+  figure, which only *under*states headroom — the safe direction.  A
+  frame that arrives already spent resolves ``unknown`` immediately,
+  worker-side, without a dispatch.
+- **idempotent request ids** — the worker dedups SUBMIT by id (live
+  requests re-attach, finished ones re-deliver the cached RESULT), and
+  the client funnels every RESULT through one
+  :class:`~jepsen_tpu.serve.request.Request` whose
+  ``claim_finish()`` makes duplicate delivery after a reconnect a
+  structural no-op: a cell can never double-finish.
+- **verdicts degrade, never invent** — every transport failure path
+  (dial refused, connection lost mid-wait, torn frame) surfaces as
+  ``valid: "unknown"`` with a ``transport ...`` error string the fleet
+  classifies as a *worker* failure (reroute to a sibling), never as a
+  fabricated ``false``.
+- **reconnect storms decorrelate** — re-dials and SUBMIT re-sends back
+  off under a control/retry.py :class:`RetryPolicy` with decorrelated
+  jitter, so a healed partition is not greeted by every client's
+  retries arriving in lockstep.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from jepsen_tpu.clock import mono_now
+from jepsen_tpu.control.retry import RetryPolicy
+from jepsen_tpu.history import History
+from jepsen_tpu.serve.request import Cell, KIND_WGL, Request
+from jepsen_tpu.serve.service import ServiceClosed, ServiceSaturated
+
+log = logging.getLogger("jepsen.serve.transport")
+
+#: hard cap on one frame's JSON payload — a 16 MiB history is ~50k ops,
+#: far past anything the serve tier admits; bigger lengths are garbage
+MAX_FRAME_BYTES = 16 * 1024 * 1024
+
+_HDR = 4  # big-endian payload length
+
+# frame types
+F_SUBMIT = "submit"      # client -> worker: one cell-check
+F_ACK = "ack"            # worker -> client: SUBMIT admitted (or dup)
+F_RESULT = "result"      # worker -> client: the verdict for an id
+F_STATUS = "status"      # client -> worker: ping RPC
+F_HEALTHZ = "healthz"    # client -> worker: health RPC
+F_DRAIN = "drain"        # client -> worker: drain RPC
+F_REPLY = "reply"        # worker -> client: RPC reply payload
+F_ERROR = "error"        # worker -> client: call failed worker-side
+
+
+class TransportError(RuntimeError):
+    """Base class: something on the wire (not the history) went wrong."""
+
+
+class FrameError(TransportError):
+    """A torn or undecodable frame: EOF inside a header/payload (the
+    mid-frame cut signature), non-JSON bytes, or an untyped object."""
+
+
+class OversizedFrame(TransportError):
+    """A frame length past the cap — rejected before reading payload."""
+
+
+class ConnectionLost(TransportError):
+    """The TCP connection died (RST, refused dial, EOF mid-protocol)."""
+
+
+def encode_frame(frame: Dict[str, Any],
+                 max_frame: int = MAX_FRAME_BYTES) -> bytes:
+    """Serialize one frame (header + JSON payload), refusing to *send*
+    anything the peer would reject as oversized."""
+    payload = json.dumps(frame, default=str).encode("utf-8")
+    if len(payload) > max_frame:
+        raise OversizedFrame(
+            f"{len(payload)}-byte frame exceeds the {max_frame}-byte cap")
+    return len(payload).to_bytes(_HDR, "big") + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return buf  # short: EOF mid-read
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket,
+               max_frame: int = MAX_FRAME_BYTES) -> Optional[Dict[str, Any]]:
+    """Read one frame.  None = clean EOF at a frame boundary (graceful
+    close).  Raises :class:`FrameError` for EOF inside a frame (torn),
+    :class:`OversizedFrame` for a length past the cap (the payload is
+    NOT consumed — the stream is poisoned and must be closed), and lets
+    socket errors (RST etc.) propagate as OSError."""
+    hdr = _recv_exact(sock, _HDR)
+    if not hdr:
+        return None
+    if len(hdr) < _HDR:
+        raise FrameError(f"torn header: {len(hdr)}/{_HDR} bytes then EOF")
+    n = int.from_bytes(hdr, "big")
+    if n > max_frame:
+        raise OversizedFrame(
+            f"{n}-byte frame exceeds the {max_frame}-byte cap")
+    if n == 0:
+        raise FrameError("zero-length frame")
+    payload = _recv_exact(sock, n)
+    if len(payload) < n:
+        raise FrameError(f"torn payload: {len(payload)}/{n} bytes then EOF")
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise FrameError(f"undecodable frame: {e}") from e
+    if not isinstance(obj, dict) or "type" not in obj:
+        raise FrameError("frame is not a typed object")
+    return obj
+
+
+def lite_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Wire-safe spec: the wgl DeviceModel object travels by *name*
+    (build_spec on the worker resolves it back via the model registry —
+    the same round-trip the fleet journal already proves), everything
+    else in a spec is JSON already."""
+    out = dict(spec)
+    m = out.get("model")
+    if m is not None and not isinstance(m, str):
+        out["model"] = m.name
+    return out
+
+
+def transport_unknown(reason: str) -> Dict[str, Any]:
+    """The verdict a wire failure degrades to.  The ``transport ...``
+    error prefix is on the fleet's worker-failure allowlist, so the
+    cell reroutes to a sibling — never a fabricated ``false``."""
+    return {"valid": "unknown", "analyzer": "transport", "error": reason}
+
+
+class RemoteCall:
+    """Client-side handle for one wire SUBMIT, quacking like the
+    :class:`Request` a local ``CheckService.submit`` returns (``done()``
+    / ``result`` / ``wait()`` — all the fleet's wait loop touches).
+
+    Backed by a *real* Request with one synthetic cell, so RESULT
+    delivery funnels through ``Request.claim_finish()``: the first
+    delivery (RESULT frame, duplicate RESULT after a reconnect, or the
+    transport-failure path racing a late RESULT) finishes the call and
+    every later one is a structural no-op — a cell can never
+    double-finish, which is the idempotency half of the wire contract."""
+
+    def __init__(self, history: History, kind: str, spec: Dict[str, Any],
+                 deadline_s: Optional[float] = None):
+        self.request = Request(history, kind, spec, deadline_s=deadline_s)
+        self.request.cells = [Cell(self.request, history)]
+
+    def deliver(self, result: Dict[str, Any]) -> bool:
+        """Land a verdict; True iff THIS delivery finished the call."""
+        res = dict(result or {})
+        self.request.cells[0].result = res
+        if self.request.claim_finish():
+            self.request.finish(dict(res))
+            return True
+        return False
+
+    def done(self) -> bool:
+        return self.request.done()
+
+    @property
+    def result(self) -> Optional[Dict[str, Any]]:
+        return self.request.result
+
+    def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.request.wait(timeout=timeout)
+
+
+class _Pending:
+    """One in-flight call on a WireClient: a submit (``call`` set) or an
+    RPC (``call`` None, reply lands in ``reply``)."""
+
+    __slots__ = ("call", "acked", "reply", "error")
+
+    def __init__(self, call: Optional[RemoteCall] = None):
+        self.call = call
+        self.acked = threading.Event()
+        self.reply: Any = None
+        self.error: Optional[Dict[str, Any]] = None
+
+
+def _raise_remote(err: Dict[str, Any], peer: str) -> None:
+    """Re-raise a worker-side ERROR frame as the matching local
+    exception class, so the fleet's submit path sees the same
+    ServiceSaturated/ServiceClosed it would from an in-process worker."""
+    cls = {"ServiceSaturated": ServiceSaturated,
+           "ServiceClosed": ServiceClosed,
+           "OversizedFrame": OversizedFrame}.get(
+               str(err.get("error-class")), TransportError)
+    raise cls(f"{peer}: {err.get('error')}")
+
+
+_rpc_ids = itertools.count(1)
+
+
+class WireClient:
+    """One client endpoint for one worker: a single TCP connection
+    (re-dialed on demand), a reader thread demuxing frames by id, and
+    the pending-call table.  Thread-safe; the fleet's many cell-driver
+    threads submit through one client per worker slot."""
+
+    def __init__(self, addr: Tuple[str, int], *,
+                 policy: Optional[RetryPolicy] = None,
+                 name: str = "",
+                 connect_timeout_s: float = 5.0,
+                 ack_timeout_s: float = 10.0,
+                 max_frame: int = MAX_FRAME_BYTES):
+        self.addr = addr
+        self.name = name or f"{addr[0]}:{addr[1]}"
+        # Decorrelated jitter: a healed partition must not see every
+        # waiting client re-dial and re-send in lockstep.
+        self.policy = policy or RetryPolicy(
+            tries=3, backoff_s=0.02, max_backoff_s=0.3, decorrelated=True)
+        self.connect_timeout_s = connect_timeout_s
+        self.ack_timeout_s = ack_timeout_s
+        self.max_frame = max_frame
+        self._lock = threading.Lock()       # conn + pending table
+        self._send_lock = threading.Lock()  # frame writes are atomic
+        self._sock: Optional[socket.socket] = None
+        self._pending: Dict[str, _Pending] = {}
+        self._closed = False
+        self.reconnects = 0
+
+    # -- connection --------------------------------------------------------
+    def _ensure_conn(self) -> socket.socket:
+        with self._lock:
+            if self._closed:
+                raise TransportError(f"wire client {self.name} is closed")
+            if self._sock is not None:
+                return self._sock
+        # dial OUTSIDE the lock: a slow or refused connect must not
+        # stall every thread touching the pending table
+        try:
+            sock = socket.create_connection(
+                self.addr, timeout=self.connect_timeout_s)
+        except OSError as e:
+            raise ConnectionLost(
+                f"transport connection lost: dial {self.name} failed: "
+                f"{e}") from e
+        sock.settimeout(None)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        with self._lock:
+            if self._closed:
+                sock.close()
+                raise TransportError(f"wire client {self.name} is closed")
+            if self._sock is not None:  # lost a dial race; use the winner
+                sock.close()
+                return self._sock
+            self._sock = sock
+            self.reconnects += 1
+        threading.Thread(target=self._read_loop, args=(sock,),
+                         daemon=True,
+                         name=f"wire-read-{self.name}").start()
+        return sock
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                frame = read_frame(sock, self.max_frame)
+                if frame is None:
+                    raise ConnectionLost(
+                        f"peer {self.name} closed the stream")
+                self._on_frame(frame)
+        except (TransportError, OSError) as e:
+            self._conn_lost(sock, e)
+
+    def _on_frame(self, frame: Dict[str, Any]) -> None:
+        fid = frame.get("id")
+        ftype = frame.get("type")
+        terminal = ftype in (F_RESULT, F_REPLY, F_ERROR)
+        with self._lock:
+            p = self._pending.get(fid)
+            if p is not None and terminal:
+                self._pending.pop(fid, None)
+        if p is None:
+            # unsolicited or duplicate delivery: the call already
+            # resolved (or was abandoned) — dropping here is safe
+            # because RemoteCall.deliver is itself idempotent
+            return
+        if ftype == F_ACK:
+            p.acked.set()
+        elif ftype == F_RESULT:
+            if p.call is not None:
+                p.call.deliver(frame.get("result") or {})
+            p.reply = frame.get("result")
+            p.acked.set()
+        elif ftype == F_REPLY:
+            p.reply = frame.get("payload")
+            p.acked.set()
+        elif ftype == F_ERROR:
+            p.error = frame
+            p.acked.set()
+
+    def _conn_lost(self, sock: socket.socket, exc: Exception) -> None:
+        """The reader (or a failed send) declares this connection dead:
+        acked submits fail over to the fleet (transport-unknown verdicts
+        → reroute), RPCs error out, and UNacked submits stay pending —
+        their submit loop owns the retry (same id, so the worker dedups
+        if the original actually arrived)."""
+        failed_calls = []
+        failed_rpcs = []
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+            for fid in list(self._pending):
+                p = self._pending[fid]
+                if p.call is not None and p.acked.is_set():
+                    failed_calls.append(self._pending.pop(fid))
+                elif p.call is None:
+                    failed_rpcs.append(self._pending.pop(fid))
+        try:
+            sock.close()
+        except OSError:
+            pass
+        reason = (f"transport connection lost to {self.name}: "
+                  f"{type(exc).__name__}: {exc}")
+        for p in failed_calls:
+            p.call.deliver(transport_unknown(reason))
+        for p in failed_rpcs:
+            p.error = {"error": reason, "error-class": "ConnectionLost"}
+            p.acked.set()
+
+    # -- calls -------------------------------------------------------------
+    def submit(self, cid: str, frame: Dict[str, Any], call: RemoteCall,
+               deadline_s: Optional[float] = None) -> None:
+        """Register and send one SUBMIT, re-sending the SAME id across
+        reconnects (the worker dedups) under decorrelated-jitter backoff
+        until the worker ACKs.  Raises when every attempt fails — the
+        fleet then penalizes this worker's breaker and reroutes."""
+        p = _Pending(call=call)
+        with self._lock:
+            self._pending[cid] = p
+        deadline = (mono_now() + deadline_s
+                    if deadline_s is not None else None)
+        tries = max(1, self.policy.tries)
+        prev: Optional[float] = None
+        last_err = "never attempted"
+        attempted = 0
+        try:
+            for attempt in range(tries):
+                attempted = attempt + 1
+                try:
+                    self._send(frame)
+                    wait = self.ack_timeout_s
+                    if deadline is not None:
+                        wait = min(wait, max(0.0, deadline - mono_now()))
+                    if p.acked.wait(timeout=wait):
+                        if p.error is not None:
+                            _raise_remote(p.error, self.name)
+                        return
+                    last_err = f"no ACK within {wait:.1f}s"
+                except ConnectionLost as e:
+                    last_err = str(e)
+                # the ack may have raced the failure we just saw
+                if p.acked.is_set():
+                    if p.error is not None:
+                        _raise_remote(p.error, self.name)
+                    return
+                if deadline is not None and mono_now() >= deadline:
+                    break
+                if attempt + 1 < tries:
+                    prev = self.policy.delay(attempt, prev=prev)
+                    d = prev
+                    if deadline is not None:
+                        d = min(d, max(0.0, deadline - mono_now()))
+                    if d > 0:
+                        time.sleep(d)
+        except BaseException:
+            with self._lock:
+                self._pending.pop(cid, None)
+            raise
+        with self._lock:
+            self._pending.pop(cid, None)
+        raise ConnectionLost(
+            f"transport connection lost: SUBMIT {cid} to {self.name} "
+            f"unacknowledged after {attempted} attempt(s): {last_err}")
+
+    def call(self, ftype: str, extra: Optional[Dict[str, Any]] = None,
+             timeout_s: float = 5.0) -> Any:
+        """One RPC round trip (STATUS/HEALTHZ/DRAIN): send, wait for the
+        REPLY payload.  No retries — RPC callers (ping, healthz) treat a
+        failure as 'unreachable right now' and say so."""
+        fid = f"rpc-{next(_rpc_ids)}"
+        frame = {"type": ftype, "id": fid, **(extra or {})}
+        p = _Pending(call=None)
+        with self._lock:
+            self._pending[fid] = p
+        try:
+            self._send(frame)
+            if not p.acked.wait(timeout=timeout_s):
+                raise TransportError(
+                    f"{ftype} RPC to {self.name} timed out "
+                    f"after {timeout_s:.1f}s")
+            if p.error is not None:
+                _raise_remote(p.error, self.name)
+            return p.reply
+        finally:
+            with self._lock:
+                self._pending.pop(fid, None)
+
+    def _send(self, frame: Dict[str, Any]) -> None:
+        sock = self._ensure_conn()
+        data = encode_frame(frame, self.max_frame)
+        with self._send_lock:
+            try:
+                sock.sendall(data)
+            except OSError as e:
+                raised = e
+            else:
+                return
+        self._conn_lost(sock, raised)
+        raise ConnectionLost(
+            f"transport connection lost: send to {self.name} failed: "
+            f"{raised}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            # the reader thread observes the close and fails over any
+            # still-pending calls via _conn_lost
+
+
+_submit_ids = itertools.count(1)
+
+
+class ProcWorkerService:
+    """The CheckService facade over one out-of-process worker: submit /
+    ping / healthz / drain / alive / kill / close, all over the wire,
+    so :class:`~jepsen_tpu.serve.fleet.Fleet`'s drivers (route, wait,
+    hedge, reroute, journal) run against a remote process unchanged.
+
+    The worker's lifecycle belongs to a *launcher* (worker_main's
+    SubprocessWorker for real OS processes, ThreadWorker for the
+    in-process test tier — both speak the identical protocol over real
+    sockets), and the wire runs through a PairProxy link when one is
+    given, which is what hands the chaos harness true network faults."""
+
+    def __init__(self, launcher, proxy=None, *,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 ack_timeout_s: float = 10.0,
+                 rpc_timeout_s: float = 5.0,
+                 max_frame: int = MAX_FRAME_BYTES,
+                 name: str = ""):
+        self.launcher = launcher
+        self.proxy = proxy
+        self.name = name or getattr(launcher, "name", "proc-worker")
+        self.rpc_timeout_s = rpc_timeout_s
+        self._policy = retry_policy
+        self._ack_timeout_s = ack_timeout_s
+        self._max_frame = max_frame
+        self._ready_lock = threading.Lock()
+        self._client: Optional[WireClient] = None
+        self._closed = False
+
+    def _wire(self) -> WireClient:
+        """The (lazily-dialed) client, created once the launcher reports
+        ready; when a proxy link exists it is retargeted at the worker's
+        real port and the client dials the PROXY — every byte crosses
+        the chaos-controllable wire."""
+        with self._ready_lock:
+            if self._closed:
+                raise ServiceClosed(f"{self.name} is closed")
+            if self._client is None:
+                port = self.launcher.await_ready()
+                addr = ("127.0.0.1", port)
+                if self.proxy is not None:
+                    self.proxy.retarget(addr)
+                    addr = ("127.0.0.1", self.proxy.port)
+                self._client = WireClient(
+                    addr, policy=self._policy, name=self.name,
+                    ack_timeout_s=self._ack_timeout_s,
+                    max_frame=self._max_frame)
+            return self._client
+
+    # -- the CheckService surface -----------------------------------------
+    def submit(self, history: History, *,
+               kind: str = KIND_WGL,
+               deadline_s: Optional[float] = None,
+               block: bool = True,
+               timeout: Optional[float] = None,
+               **spec) -> RemoteCall:
+        """Ship one cell-check over the wire; returns a request-shaped
+        handle.  ``block``/``timeout`` are accepted for facade parity —
+        remote backpressure surfaces as a worker-side ServiceSaturated
+        ERROR frame either way, which the fleet treats exactly like a
+        local saturated worker."""
+        if self._closed:
+            raise ServiceClosed(f"{self.name} is closed")
+        client = self._wire()
+        spec_l = lite_spec(spec)
+        call = RemoteCall(history, kind, spec_l, deadline_s=deadline_s)
+        cid = f"{self.name}.{next(_submit_ids)}.{call.request.id}"
+        frame = {"type": F_SUBMIT, "id": cid, "kind": kind,
+                 "spec": spec_l, "deadline-rem-s": deadline_s,
+                 "ops": [op.to_dict() for op in history]}
+        client.submit(cid, frame, call, deadline_s=deadline_s)
+        return call
+
+    def check(self, history: History, *,
+              timeout: Optional[float] = None, **kw) -> Dict[str, Any]:
+        return self.submit(history, **kw).wait(timeout=timeout)
+
+    def ping(self) -> Dict[str, Any]:
+        """Heartbeat.  ``alive`` reports the *process* (a partitioned
+        worker is alive but unreachable — the breaker, not the
+        supervisor, owns that distinction); ``reachable`` reports the
+        wire."""
+        if not self.launcher.alive():
+            return {"alive": False, "reachable": False,
+                    "queue-depth": None, "inflight-cells": None}
+        try:
+            payload = self._wire().call(F_STATUS,
+                                        timeout_s=self.rpc_timeout_s)
+            return {**(payload or {}), "alive": self.launcher.alive(),
+                    "reachable": True}
+        except Exception as e:  # noqa: BLE001 — unreachable ≠ dead
+            return {"alive": self.launcher.alive(), "reachable": False,
+                    "queue-depth": None, "inflight-cells": None,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def healthz(self) -> Dict[str, Any]:
+        """The remote worker's own healthz, for deep fleet aggregation."""
+        try:
+            payload = self._wire().call(F_HEALTHZ,
+                                        timeout_s=self.rpc_timeout_s)
+            return dict(payload or {"ok": False})
+        except Exception as e:  # noqa: BLE001
+            return {"ok": False, "reachable": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def remote_status(self) -> Dict[str, Any]:
+        """Launcher-side facts (pid/port/log) for fleet_status()."""
+        st = getattr(self.launcher, "status", None)
+        out = dict(st() if st is not None else {})
+        client = self._client
+        if client is not None:
+            out["reconnects"] = client.reconnects
+        return out
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        budget = 30.0 if timeout is None else float(timeout)
+        try:
+            ok = self._wire().call(F_DRAIN, {"timeout-s": timeout},
+                                   timeout_s=budget + 5.0)
+            return bool(ok)
+        except Exception:  # noqa: BLE001 — an unreachable worker did
+            return False   # not drain
+    def queue_depth(self) -> int:
+        p = self.ping()
+        return int(p.get("queue-depth") or 0)
+
+    def alive(self) -> bool:
+        return not self._closed and self.launcher.alive()
+
+    def kill(self) -> list:
+        """Crash semantics: SIGKILL the worker's process group, drop the
+        wire.  Worker-side queued cells die with it — the fleet's
+        drivers see the death and reroute, exactly the in-process
+        contract (which returns the evicted cells; a killed *process*
+        cannot, so this returns [])."""
+        with self._ready_lock:
+            self._closed = True
+            client = self._client
+        if client is not None:
+            client.close()
+        self.launcher.kill()
+        return []
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: remote drain, then SIGTERM (the worker
+        closes its service cleanly), escalating to SIGKILL on a hang."""
+        with self._ready_lock:
+            if self._closed:
+                return True
+            self._closed = True
+            client = self._client
+        ok = True
+        if client is not None:
+            budget = 30.0 if timeout is None else float(timeout)
+            try:
+                ok = bool(client.call(F_DRAIN, {"timeout-s": timeout},
+                                      timeout_s=budget + 5.0))
+            except Exception:  # noqa: BLE001 — unreachable: not drained
+                ok = False
+            client.close()
+        self.launcher.terminate(timeout_s=10.0)
+        return ok
+
+    def __enter__(self) -> "ProcWorkerService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -101,15 +101,24 @@ def make_handler(base: str, service=None):
             path = unquote(self.path)
             if path in ("/", "/index.html"):
                 return self._send(200, _index_html(base).encode())
-            if path == "/healthz":
+            if path == "/healthz" or path.startswith("/healthz?"):
                 # Liveness probe: per-worker status, circuit state, queue
                 # depth.  One schema whether a CheckService (degenerate
                 # one-worker view) or a Fleet is attached; 503 while no
                 # worker can take traffic so a load balancer / the chaos
                 # harness can act on the status code alone.
+                # ``?deep=1`` additionally interrogates each remote
+                # worker over its wire (ProcFleet) — best-effort per
+                # worker; services without a deep view ignore it.
                 if service is None:
                     return self._send_json(200, {"ok": True, "workers": []})
-                hz = service.healthz()
+                if "deep=1" in path:
+                    try:
+                        hz = service.healthz(deep=True)
+                    except TypeError:  # single CheckService: no deep arg
+                        hz = service.healthz()
+                else:
+                    hz = service.healthz()
                 return self._send_json(200 if hz.get("ok") else 503, hz)
             if path == "/metrics":
                 if service is None:

@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Procfleet chaos smoke: out-of-process workers vs true network faults.
+
+Phase A (parity under network fire): runs a 48-history mixed workload
+(wgl cas-register + elle list-append, a third corrupted) through a
+3-worker ProcFleet — every worker a real OS process speaking the
+serve/transport.py wire protocol through its own net_proxy link — while
+the nemesis severs one worker's link (partition: RST + ECONNREFUSED,
+then a heal and the reconnect storm that follows), RSTs another's live
+connections mid-frame, and SIGKILLs the third worker's process so the
+supervisor must respawn it.  Then asserts, lane for lane, that the
+fleet's verdicts equal a cold single-service oracle's (zero fabricated
+``false``), that recovery fit inside one deadline budget, that the
+journal drained, and that the supervisor actually respawned a process.
+
+Phase B (single-winner recovery): partitions every link so submitted
+cells stay pending, crashes the whole fleet (no drain), then races TWO
+fresh fleets' ``resubmit_recovered`` on the same journal directory —
+the claim file must let exactly one of them resubmit each pending cell
+(exactly once), while the loser backs off reporting who beat it.  The
+winner's recovered verdicts are checked against the oracle.
+
+Writes the chaos metrics snapshot to argv[1] (default
+/tmp/procfleet_chaos_metrics.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.control.retry import RetryPolicy  # noqa: E402
+from jepsen_tpu.nemesis.registry import FaultRegistry
+from jepsen_tpu.serve import CheckService
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.fleet import Fleet, ProcFleet
+from jepsen_tpu.synth import (
+    cas_register_history, corrupt_list_append, corrupt_reads,
+    list_append_history,
+)
+
+N_WGL, N_ELLE, CLIENTS = 36, 12, 4
+# One deadline budget is the recovery bound: every request carries this
+# deadline and every request — including cells stranded by the severed
+# link and the SIGKILLed process — must resolve within one budget of the
+# first fault.  Sized for CI's CPU backend with the warm pass excluded.
+DEADLINE_S = 60.0
+
+
+def build_workload():
+    jobs = []
+    for s in range(N_WGL):
+        h = cas_register_history(60, concurrency=4, seed=s)
+        if s % 3 == 2:
+            h = corrupt_reads(h, n=1, seed=s)
+        jobs.append(("wgl", h))
+    for s in range(N_ELLE):
+        h = list_append_history(25, seed=1000 + s)
+        if s % 3 == 2:
+            h = corrupt_list_append(h, anomaly_p=0.5, seed=s)
+        jobs.append(("elle", h))
+    return jobs
+
+
+def submit_kw(kind):
+    return ({"model": "cas-register"} if kind == "wgl"
+            else {"workload": "list-append"})
+
+
+def run_oracle(svc, jobs):
+    out = []
+    for kind, h in jobs:
+        out.append(svc.check(h, kind=kind, **submit_kw(kind))["valid"])
+    return out
+
+
+def run_fleet(fleet, jobs, deadline_s=DEADLINE_S):
+    out = [None] * len(jobs)
+
+    def client(span):
+        reqs = []
+        for i in span:
+            kind, h = jobs[i]
+            reqs.append((i, fleet.submit(h, kind=kind,
+                                         deadline_s=deadline_s,
+                                         **submit_kw(kind))))
+        for i, r in reqs:
+            out[i] = r.wait(timeout=180)["valid"]
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    return threads, out
+
+
+def phase_a(oracle_svc, jobs, journal_dir):
+    """Parity under partition + mid-frame cut + worker-process kill."""
+    oracle = run_oracle(oracle_svc, jobs)
+
+    fleet = ProcFleet(workers=3, spawn=True, journal_dir=journal_dir,
+                      max_lanes=48, hedge_s=0.3,
+                      default_deadline_s=DEADLINE_S,
+                      supervise_s=0.25)
+    chaos = ChaosNemesis(fleet, registry=FaultRegistry(), seed=7)
+    # Warm pass: each worker PROCESS compiles its own engines (no shared
+    # in-process cache across a real process boundary), so recovery_s
+    # must time rerouting + respawn, not first-compiles.
+    warm, _ = run_fleet(fleet, jobs[:3] + jobs[-3:])
+    for t in warm:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in warm), "warm pass hung"
+
+    threads, out = run_fleet(fleet, jobs)
+    time.sleep(0.3)                       # let the campaign start flowing
+    t_fault = time.monotonic()
+    part = chaos.partition_worker(0)      # RST + ECONNREFUSED
+    cuts = [chaos.cut_links(1)]           # torn frame mid-stream
+    victim_pid = fleet.workers[2].service.launcher.proc.pid
+    os.kill(victim_pid, signal.SIGKILL)   # real process crash: the
+    time.sleep(1.0)                       # supervisor must respawn it
+    chaos.heal(part)                      # heal → reconnect storm
+    cuts.append(chaos.cut_links(1))       # and tear it again mid-recovery
+
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "fleet clients hung"
+    t_recovered = time.monotonic()
+
+    for k in cuts:       # one-shot faults: acknowledge their ledger keys
+        chaos.heal(k)
+    leftover = chaos.heal_all()
+    deadline = time.monotonic() + 15      # wait out the respawn sweep
+    while time.monotonic() < deadline:
+        snap = fleet.metrics.snapshot()
+        if snap["counters"].get("supervisor-respawns", 0) >= 1:
+            break
+        time.sleep(0.25)
+    healthz = fleet.healthz(deep=True)
+    snap = fleet.metrics.snapshot()
+    journal_pending = fleet._journal.pending_count()
+    status = fleet.fleet_status()
+    fleet.close(timeout=60.0)
+
+    mismatches = [
+        {"lane": i, "oracle": o, "fleet": f}
+        for i, (o, f) in enumerate(zip(oracle, out)) if o != f]
+    fabricated = [m for m in mismatches
+                  if m["fleet"] is False and m["oracle"] is not False]
+    recovery_s = t_recovered - t_fault
+
+    report = {
+        "oracle": oracle, "fleet": out, "mismatches": mismatches,
+        "fabricated_false": fabricated,
+        "recovery_s": round(recovery_s, 3),
+        "journal_pending_at_end": journal_pending,
+        "leftover_faults_healed": leftover,
+        "killed_worker_pid": victim_pid,
+        "healthz": healthz, "fleet_status": status, "metrics": snap,
+    }
+
+    c = snap["counters"]
+    assert not fabricated, (
+        f"procfleet fabricated false verdicts: {fabricated}")
+    assert not mismatches, f"verdict parity broken: {mismatches}"
+    assert oracle.count(False) > 0, "corrupted histories must refute"
+    assert recovery_s < DEADLINE_S, (
+        f"recovery took {recovery_s:.1f}s — past one deadline budget "
+        f"({DEADLINE_S}s): faulted workers' cells did not complete in "
+        f"time")
+    assert journal_pending == 0, (
+        f"{journal_pending} cells still journaled after drain")
+    assert not leftover, f"faults survived heal: {leftover}"
+    assert c.get("supervisor-respawns", 0) >= 1, (
+        "the SIGKILLed worker process was never respawned")
+    assert c.get("chaos-partitions", 0) >= 1
+    assert c.get("chaos-conn-cuts", 0) >= 2
+    assert c.get("worker-failures", 0) >= 1, "chaos never bit a worker"
+    assert c.get("cells-rerouted", 0) + c.get("hedges", 0) >= 1, (
+        "no cell ever rerouted or hedged — the nemesis tested nothing")
+    assert healthz["ok"], "procfleet unhealthy after full heal"
+    assert all(w["alive"] for w in healthz["workers"])
+    # the wire is genuinely back: every worker answers its own healthz
+    assert all(w.get("remote", {}).get("ok") for w in healthz["workers"]), (
+        "a worker's remote healthz still failing after heal")
+    return report
+
+
+def phase_b(oracle_svc, jobs, crash_dir, recover_dirs):
+    """Whole-supervisor crash; two racing recoveries, one winner."""
+    # A patient retry policy keeps partitioned cells PENDING (the
+    # drivers retry against dead wires instead of giving up) so the
+    # crash strands real journaled work.
+    patient = RetryPolicy(tries=200, backoff_s=0.5, max_backoff_s=2.0,
+                          decorrelated=True)
+    f2 = ProcFleet(workers=2, spawn=True, journal_dir=crash_dir,
+                   default_deadline_s=DEADLINE_S, retry_policy=patient)
+    chaos = ChaosNemesis(f2, registry=FaultRegistry())
+    for w in range(2):
+        chaos.partition_worker(w)         # nothing can complete
+    for kind, h in jobs:
+        f2.submit(h, kind=kind, deadline_s=DEADLINE_S, **submit_kw(kind))
+    time.sleep(0.5)
+    journaled = f2._journal.pending_count()
+    f2.kill()                             # whole-fleet crash, no drain
+    time.sleep(2.0)                       # let straggler drivers settle
+
+    rec_preview = Fleet.recover(crash_dir)
+
+    # Two supervisors race the SAME journal: the claim file must admit
+    # exactly one.  (Same host, same pid here — the claim still
+    # distinguishes them by claimant name; a dead pid would be stolen.)
+    fleets = [ProcFleet(workers=2, spawn=True, journal_dir=rd,
+                        default_deadline_s=DEADLINE_S)
+              for rd in recover_dirs]
+    results_by = [None, None]
+
+    def recover(i):
+        results_by[i] = fleets[i].resubmit_recovered(
+            crash_dir, claimant=f"recoverer-{i}")
+
+    rt = [threading.Thread(target=recover, args=(i,)) for i in range(2)]
+    for t in rt:
+        t.start()
+    for t in rt:
+        t.join(timeout=120)
+
+    winners = [i for i in range(2) if results_by[i]["claimed"]]
+    assert len(winners) == 1, (
+        f"recovery claim admitted {len(winners)} winners "
+        f"(exactly-once broken): {results_by}")
+    win, lose = winners[0], 1 - winners[0]
+    rec = results_by[win]
+    assert not results_by[lose]["requests"], (
+        "the losing recoverer resubmitted cells despite losing the claim")
+    assert len(rec["requests"]) == len(rec_preview["pending"]), (
+        f"winner resubmitted {len(rec['requests'])} of "
+        f"{len(rec_preview['pending'])} pending cells")
+
+    results = []
+    for req in rec["requests"]:
+        res = req.wait(timeout=180)
+        oracle = oracle_svc.check(req.history, kind=req.kind,
+                                  **submit_kw(req.kind))
+        results.append({"fleet": res["valid"], "oracle": oracle["valid"]})
+    snaps = [f.metrics.snapshot()["counters"] for f in fleets]
+    for f in fleets:
+        f.close(timeout=60.0)
+
+    report = {
+        "journaled_at_crash": journaled,
+        "recovered_pending": len(rec_preview["pending"]),
+        "recovered_expired": len(rec_preview["expired"]),
+        "claim_winner": f"recoverer-{win}",
+        "loser_report": {k: v for k, v in results_by[lose].items()
+                         if k != "requests"},
+        "recovery_results": results,
+        "metrics_counters": snaps,
+    }
+    assert journaled > 0, "crash raced the campaign: nothing journaled"
+    assert rec_preview["pending"] or rec_preview["expired"], (
+        "journal recovery found nothing despite pending cells at crash")
+    assert snaps[lose].get("journal-claim-lost", 0) == 1
+    fabricated = [r for r in results
+                  if r["fleet"] is False and r["oracle"] is not False]
+    assert not fabricated, f"recovery fabricated false: {fabricated}"
+    mism = [r for r in results
+            if r["fleet"] != r["oracle"] and r["fleet"] != "unknown"]
+    assert not mism, f"recovered verdicts diverge: {mism}"
+    return report
+
+
+def main():
+    dump = (sys.argv[1] if len(sys.argv) > 1
+            else "/tmp/procfleet_chaos_metrics.json")
+    jobs = build_workload()
+    tmp = tempfile.mkdtemp(prefix="procfleet-chaos-")
+    oracle_svc = CheckService(max_lanes=48, capacity=64)
+    try:
+        report_a = phase_a(oracle_svc, jobs,
+                           os.path.join(tmp, "journal-a"))
+        report_b = phase_b(oracle_svc, jobs[:12],
+                           os.path.join(tmp, "journal-crash"),
+                           [os.path.join(tmp, "journal-rec-0"),
+                            os.path.join(tmp, "journal-rec-1")])
+    finally:
+        oracle_svc.close(timeout=30.0)
+    report = {"phase_a": report_a, "phase_b": report_b}
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps({
+        "recovery_s": report_a["recovery_s"],
+        "mismatches": report_a["mismatches"],
+        "fabricated_false": report_a["fabricated_false"],
+        "respawns": report_a["metrics"]["counters"].get(
+            "supervisor-respawns", 0),
+        "journaled_at_crash": report_b["journaled_at_crash"],
+        "claim_winner": report_b["claim_winner"],
+        "recovered": report_b["recovered_pending"]
+        + report_b["recovered_expired"],
+    }))
+    print(f"procfleet chaos smoke OK: parity held under partition+cut+"
+          f"process-kill, recovery {report_a['recovery_s']:.1f}s < "
+          f"{DEADLINE_S:.0f}s budget, single-winner journal recovery, "
+          f"metrics dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,7 @@ class ChronosClient(jclient.Client):
             if op.f == "read":
                 runs = read_runs(test)
                 return op.with_(type=OK, value=runs,
+                                # lint: disable=CONC01(chronos protocol wall-clock read time)
                                 extra={"read_time": time.time()})
             raise ValueError(op.f)
         except (HttpError, *NET_ERRORS) as e:

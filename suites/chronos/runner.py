@@ -83,6 +83,7 @@ def jobs_workload(opts) -> Dict[str, Any]:
                     + random.randint(0, 29))
         return {"f": "add-job",
                 "value": {"name": next(counter),
+                          # lint: disable=CONC01(chronos schedules jobs by wall clock)
                           "start": time.time() + head_start,
                           "count": 1 + random.randint(0, 98),
                           "duration": duration,

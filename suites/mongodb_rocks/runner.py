@@ -94,6 +94,7 @@ class LoggerClient(jclient.Client):
             if op.f == "write":
                 self.conn.insert(self.COLL,
                                  {"_id": op.value,
+                                  # lint: disable=CONC01(DB document wall-clock timestamp)
                                   "time": int(_time.time() * 1000),
                                   "payload": PAYLOAD})
                 return op.with_(type=OK)
@@ -143,6 +144,7 @@ class ThroughputChecker(Checker):
 def logger_workload(opts) -> Dict[str, Any]:
     def write():
         return {"f": "write",
+                # lint: disable=CONC01(unique document id, not an interval)
                 "value": f"{int(_time.time())}-oempa_"
                          f"{random.randrange(2**31)}"}
 
