@@ -81,6 +81,7 @@ TIER_TIMEOUT_S = {
     "sched": 120 if SMOKE else 300,
     "multireg": 300 if SMOKE else 1500,
     "elle": 300 if SMOKE else 1200,
+    "models": 300 if SMOKE else 900,
     "fleet": 300 if SMOKE else 900,
     "procfleet": 420 if SMOKE else 1200,
 }
@@ -584,6 +585,73 @@ def tier_elle():
     })
 
 
+def build_model_batches():
+    # Queue histories keep concurrency 2: the ring-buffer state is wide
+    # (2 + slots int32 lanes), so the per-capacity sort network is the
+    # compile hog AND the frontier grows fast with overlap — conc 2 keeps
+    # the smoke run inside one compile at capacity 256.  Set/txn states
+    # are 2-3 ints; they afford real overlap.
+    from jepsen_tpu.synth import queue_history, set_history, txn_history
+    n = 8 if SMOKE else 64
+    n_ops = 24 if SMOKE else 48
+    return {
+        "fifo-queue": [queue_history(n_ops=n_ops, concurrency=2, seed=s)
+                       for s in range(n)],
+        "set": [set_history(n_ops=n_ops, concurrency=2 if SMOKE else 4,
+                            seed=s) for s in range(n)],
+        "opacity": [txn_history(n_txns=max(12, n_ops // 2),
+                                concurrency=2 if SMOKE else 4,
+                                seed=s) for s in range(n)],
+    }
+
+
+def tier_models():
+    """Engine-plugin model throughput: hist/s for each of the three
+    drop-in models (fifo-queue, set, opacity via its reduction onto
+    txn-register) through the batch engine — the line the engine-smoke
+    CI job tracks.  Every lane is parity-checked against the host oracle
+    before any number is emitted."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.engine.opacity import derive_history
+    from jepsen_tpu.models import get_model
+    from jepsen_tpu.parallel.batch import check_batch
+    from jepsen_tpu.serve.buckets import MIN_WIDTH_BUCKET, pow2_at_least
+
+    batches = build_model_batches()
+    out = {}
+    for name, hs in batches.items():
+        if name == "opacity":
+            model = get_model("txn-register")
+            runs = [derive_history(h) for h in hs]
+        elif name == "fifo-queue":
+            from jepsen_tpu.engine.model_plugin import derive_queue_slots
+            slots = max(derive_queue_slots(h, {})["slots"] for h in hs)
+            model = get_model(name, slots=slots)
+            runs = hs
+        else:
+            model = get_model(name)
+            runs = hs
+        width = max(len({o.process for o in h.client_ops()})
+                    for h in runs)
+        floor = pow2_at_least(width, MIN_WIDTH_BUCKET)
+        progress(f"models[{name}] warm ({len(runs)} lanes)")
+        check_batch(model, runs, window_floor=floor, capacity=256)
+        progress(f"models[{name}] timed device run")
+        t0 = time.time()
+        res = check_batch(model, runs, window_floor=floor, capacity=256)
+        wall = time.time() - t0
+        for i, (r, h) in enumerate(zip(res, runs)):
+            c = wgl_cpu.check(model.cpu_model(), h)
+            assert r["valid"] == c["valid"], (name, i, r, c)
+        out[name] = {
+            "n_histories": len(runs),
+            "wall_s": round(wall, 3),
+            "histories_per_sec": round(len(runs) / wall, 1),
+            "parity": "all-lanes verdict vs CPU oracle",
+        }
+    emit({"models": out})
+
+
 def tier_sched():
     """Generator scheduler throughput — the committed record behind the
     ~24k ops/s claim (round-4 review: the number lived only in a test
@@ -770,6 +838,7 @@ TIER_FNS = {
     "sched": tier_sched,
     "multireg": tier_multireg,
     "elle": tier_elle,
+    "models": tier_models,
     "fleet": tier_fleet,
     "procfleet": tier_procfleet,
 }
@@ -850,7 +919,8 @@ def main():
     # of its time budget; cpu next (the denominator); the rest follow.
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
                  "batch_sweep", "ablation_on", "ablation_off", "setup2",
-                 "sched", "multireg", "elle", "fleet", "procfleet"):
+                 "sched", "multireg", "elle", "models", "fleet",
+                 "procfleet"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "

@@ -128,6 +128,11 @@ def _register_builtins() -> None:
                      _lazy_elle("list-append", engine="cpu"))
     register_checker("elle-rw-register-cpu",
                      _lazy_elle("rw-register", engine="cpu"))
+    # The engine-substrate plugin seam: device-model checkers (queue, set)
+    # and the opacity reduction register through it (engine/plugins.py is
+    # import-light; each factory resolves its model/engine lazily).
+    from jepsen_tpu.engine.plugins import register_builtin_plugins
+    register_builtin_plugins(register_checker)
 
 
 def merge_valid(valids: List[Any]) -> Any:

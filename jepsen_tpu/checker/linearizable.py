@@ -100,18 +100,19 @@ class Linearizable(Checker):
         decide a verdict).  A TPU failure — XLA OOM, runtime wedge, device
         loss — says nothing about the *history*, so instead of surfacing
         the crash as the result we fall back to the host BFS oracle
-        (wgl_cpu), annotating the verdict with the chain it travelled.
-        Only when the CPU tier is missing or itself gives up (its state
-        set exceeds the budget) does the verdict degrade to UNKNOWN, and
-        then it carries partial-search stats so the operator can tell
-        \"checker overwhelmed\" from \"history lost\"."""
-        import logging
-        chain: List[Dict[str, Any]] = [
-            {"solver": "wgl-tpu", "error": str(exc),
-             "error-type": type(exc).__name__}]
-        logging.getLogger(__name__).warning(
-            "device engine failed (%s: %s); falling back to host oracle",
-            type(exc).__name__, exc)
+        (wgl_cpu), annotating the verdict with the chain it travelled
+        (the engine.fallback discipline, shared with the elle engine and
+        the serve scheduler's host-fallback cells).  Only when the CPU
+        tier is missing or itself gives up (its state set exceeds the
+        budget) does the verdict degrade to UNKNOWN, and then it carries
+        partial-search stats so the operator can tell \"checker
+        overwhelmed\" from \"history lost\"."""
+        from jepsen_tpu.engine.fallback import (
+            annotate_fallback, chain_entry, warn_fallback,
+        )
+        entry = chain_entry("wgl-tpu", exc)
+        chain: List[Dict[str, Any]] = [entry]
+        warn_fallback("wgl-tpu", "wgl-cpu", exc)
         if cm is None:
             return {"valid": UNKNOWN,
                     "error": "device engine failed and model has no "
@@ -126,15 +127,12 @@ class Linearizable(Checker):
                     "partial-search": {"configs-explored": e2.n,
                                        "exhausted": False}}
         except Exception as e2:  # noqa: BLE001
-            chain.append({"solver": "wgl-cpu", "error": str(e2),
-                          "error-type": type(e2).__name__})
+            chain.append(chain_entry("wgl-cpu", e2))
             return {"valid": UNKNOWN,
                     "error": f"device engine and host oracle both "
                              f"failed: {exc}; {e2}",
                     "fallback-chain": chain}
-        res["fallback"] = {"from": "wgl-tpu", "to": "wgl-cpu",
-                           "error": str(exc),
-                           "error-type": type(exc).__name__}
+        annotate_fallback(res, "wgl-tpu", "wgl-cpu", entry, chain)
         res.setdefault("solver", "wgl-cpu")
         return res
 

@@ -25,17 +25,21 @@ closure kernel:
 from __future__ import annotations
 
 import logging
-import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from jepsen_tpu.elle.graph import SearchBudget
 from jepsen_tpu.elle_tpu.anomalies import finish_lane
 from jepsen_tpu.elle_tpu.encode import EncodedHistory, encode
 from jepsen_tpu.elle_tpu.graphs import pack_group, padded_n
+from jepsen_tpu.engine.budget import Deadline
+from jepsen_tpu.engine.fallback import (
+    annotate_fallback, chain_entry, warn_fallback,
+)
+from jepsen_tpu.engine.groups import (
+    MAX_LANES_PER_GROUP, bounded_group_cap,
+)
 from jepsen_tpu.history import History
-from jepsen_tpu.parallel.batch import MAX_LANES_PER_GROUP
 
 log = logging.getLogger(__name__)
 
@@ -58,8 +62,7 @@ def available() -> bool:
 
 
 def group_cap(n_pad: int) -> int:
-    return max(1, min(MAX_LANES_PER_GROUP,
-                      LANE_CELLS_PER_GROUP // max(1, n_pad * n_pad)))
+    return bounded_group_cap(LANE_CELLS_PER_GROUP, n_pad * n_pad)
 
 
 def check(history: History, **kw) -> Dict[str, Any]:
@@ -93,7 +96,7 @@ def check_batch(histories: Sequence[History],
     if consistency_models is None:
         consistency_models = (("strict-serializable",) if realtime
                               else ("serializable",))
-    deadline = (time.monotonic() + budget_s) if budget_s is not None else None
+    deadline = Deadline.after(budget_s)
     encs = [encode(h, workload, **workload_kw) for h in histories]
     n_pad = max(padded_n(encs), ((n_pad_floor + 31) // 32) * 32)
     cap = group_cap(n_pad)
@@ -114,16 +117,12 @@ def check_batch(histories: Sequence[History],
         flags = gflags[gi]
         chain = gchain[gi]
         for j, enc in enumerate(group):
-            budget = (SearchBudget(deadline_s=max(
-                0.0, deadline - time.monotonic()))
-                if deadline is not None else None)
+            budget = deadline.search_budget()
             res = finish_lane(enc, flags[j] if flags is not None else None,
                               realtime, consistency_models, budget=budget)
             if chain is not None:
-                res["fallback"] = {"from": "elle-tpu", "to": "elle-cpu",
-                                   **{k: chain[0][k]
-                                      for k in ("error", "error-type")}}
-                res["fallback-chain"] = chain
+                annotate_fallback(res, "elle-tpu", "elle-cpu", chain[0],
+                                  chain)
                 res["analyzer"] = "elle-cpu"
             elif flags is None:
                 res["analyzer"] = "elle-cpu"
@@ -158,11 +157,8 @@ def _device_flags_pipelined(groups, n_pad: int, realtime: bool, mesh,
     inflight: deque = deque()
 
     def _fail(gi, n, e):
-        log.warning("elle-tpu device pass failed (%s: %s); falling back "
-                    "to CPU search for %d lane(s)",
-                    type(e).__name__, e, n)
-        gchain[gi] = [{"solver": "elle-tpu", "error": str(e),
-                       "error-type": type(e).__name__}]
+        warn_fallback("elle-tpu", "elle-cpu", e, n_lanes=n)
+        gchain[gi] = [chain_entry("elle-tpu", e)]
 
     def _drain():
         gi, b, flags_dev, summ_dev = inflight.popleft()

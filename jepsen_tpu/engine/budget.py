@@ -1,0 +1,59 @@
+"""Deadline/budget plumbing shared by every engine driver.
+
+One discipline, three consumers (wgl batch drivers, the elle engine's
+per-lane CPU finishes, the monitor's epoch checks): a caller's
+``budget_s`` becomes a monotonic deadline once at the call boundary, and
+everything downstream asks the deadline for *remaining* time — so
+budgets compose across fan-out (every lane of a group shares the call's
+one clock) and a wedged stage can never grant its successors more time
+than the caller had.  Exhaustion degrades a verdict to ``unknown``,
+never to false (the SOUND01 contract).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+
+class Deadline:
+    """A monotonic deadline with remaining-time queries.
+
+    ``Deadline.after(None)`` is the unbounded deadline: ``remaining()``
+    is None, ``expired()`` is False — callers thread one object through
+    either way instead of forking on "was a budget set"."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: Optional[float]):
+        self.at = at
+
+    @classmethod
+    def after(cls, budget_s: Optional[float]) -> "Deadline":
+        return cls(None if budget_s is None
+                   else time.monotonic() + float(budget_s))
+
+    def remaining(self) -> Optional[float]:
+        if self.at is None:
+            return None
+        return max(0.0, self.at - time.monotonic())
+
+    def expired(self) -> bool:
+        return self.at is not None and time.monotonic() >= self.at
+
+    def search_budget(self):
+        """The elle cycle-search budget pinned to this deadline (None
+        when unbounded): every lane's host-side witness search shares
+        the call's one clock."""
+        if self.at is None:
+            return None
+        from jepsen_tpu.elle.graph import SearchBudget
+        return SearchBudget(deadline_s=self.remaining())
+
+
+def exhausted_result(analyzer: str, what: str,
+                     **extra: Any) -> Dict[str, Any]:
+    """The canonical budget/capacity-exhaustion verdict: ``unknown`` with
+    the exhausted resource named — never a fabricated false (SOUND01)."""
+    return {"valid": "unknown", "analyzer": analyzer, "error": what,
+            **extra}

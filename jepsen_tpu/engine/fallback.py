@@ -1,0 +1,48 @@
+"""The tpu->cpu fallback chain: a device error never decides a verdict.
+
+A device failure — XLA OOM, runtime wedge, device loss — says nothing
+about the *history*, so every engine degrades the affected work to its
+host oracle and annotates the verdict with the chain it travelled
+(``fallback`` for the winning hop, ``fallback-chain`` for the full
+trail).  Only when the host tier is missing or itself gives up does the
+verdict degrade to ``unknown`` — and then it says why.  One
+implementation of the annotation discipline, consumed by the
+linearizable facade, the elle engine's per-group degradation, and the
+serve scheduler's host-fallback cells.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def chain_entry(solver: str, exc: BaseException) -> Dict[str, Any]:
+    """One hop of a fallback chain: which solver failed, how."""
+    return {"solver": solver, "error": str(exc),
+            "error-type": type(exc).__name__}
+
+
+def annotate_fallback(res: Dict[str, Any], frm: str, to: str,
+                      entry: Dict[str, Any],
+                      chain: Optional[List[Dict[str, Any]]] = None
+                      ) -> Dict[str, Any]:
+    """Mark a verdict as produced by the fallback tier: ``fallback``
+    names the hop (and the device error that forced it), ``fallback-
+    chain`` carries the full trail when there was more than one hop."""
+    res["fallback"] = {"from": frm, "to": to,
+                       "error": entry["error"],
+                       "error-type": entry["error-type"]}
+    res["fallback-chain"] = chain if chain is not None else [entry]
+    return res
+
+
+def warn_fallback(frm: str, to: str, exc: BaseException,
+                  n_lanes: int = 1) -> None:
+    """The operator-facing log line every degradation emits (chains are
+    silent failures otherwise — a fleet quietly running on its host
+    oracle is a fleet whose device died unnoticed)."""
+    log.warning("%s failed (%s: %s); falling back to %s for %d lane(s)",
+                frm, type(exc).__name__, exc, to, n_lanes)

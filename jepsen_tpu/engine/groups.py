@@ -1,0 +1,46 @@
+"""Lane grouping: every vmapped dispatch stays under the 512-lane cap.
+
+Root cause (minimized to pure JAX, reproduces on CPU and TPU backends
+and with eager vmap): a vmapped scatter into a BOOL array inside
+``lax.scan`` computes wrong results at batch >= 1024 —
+``jax.vmap(lambda arr, slot: arr.at[slot].set(False))`` over bool[W]
+carriers, exactly the wgl engine's ``active``/``fresh`` slot updates;
+int32 carriers are unaffected, 1023 lanes are verdict-perfect (see
+tests/test_parallel.py regression and ops/jax_bug_repro.py).  512 is
+also the throughput knee measured in the one-off hardware tuning sweep
+(58.9 h/s at 512 lanes vs 52.1 at 256 on 200-op lanes), so grouping
+costs nothing.
+
+Both device engines group through here: wgl batches slice at the flat
+cap, elle lowers the cap further so one dispatch's adjacency residency
+stays bounded as histories grow.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+#: Max lanes per vmapped dispatch group (the bool-scatter cliff /
+#: measured throughput knee — see module docstring).
+MAX_LANES_PER_GROUP = 512
+
+
+def group_slices(n_items: int,
+                 cap: int = MAX_LANES_PER_GROUP) -> Iterator[Tuple[int, int, bool]]:
+    """Bounded dispatch groups over ``n_items`` lanes: yields
+    ``(start, stop, group_reuse)`` slices of at most ``cap`` lanes.
+    ``group_reuse`` is False only for the first group — later groups of
+    one logical batch count as cache ``group_reuses``, not ``hits`` (see
+    :meth:`EngineCache.get`)."""
+    cap = max(1, int(cap))
+    for start in range(0, n_items, cap):
+        yield start, min(start + cap, n_items), start > 0
+
+
+def bounded_group_cap(cell_budget: int, cells_per_lane: int,
+                      cap: int = MAX_LANES_PER_GROUP) -> int:
+    """Lanes per group when each lane pins ``cells_per_lane`` device
+    cells and one dispatch may hold at most ``cell_budget`` of them (the
+    elle engine's adjacency-residency bound): the flat lane cap, lowered
+    so lanes*cells stays under budget."""
+    return max(1, min(cap, cell_budget // max(1, cells_per_lane)))

@@ -1,0 +1,84 @@
+"""The engine shape ladder: every compiled shape derives from pow2 buckets.
+
+The derivations themselves live in :mod:`jepsen_tpu.serve.buckets` (the
+ladder is a serving-policy decision measured there); this module owns
+the *engine-side* half — turning a set of prepared histories plus a
+bucket floor into the one shared engine shape a dispatch compiles for —
+so the batch driver, the scheduler, and the trace-tier lint all read the
+same derivation instead of three private copies.
+
+Discipline (enforced by SHAPE01 at call sites and TRACE02 end-to-end):
+every component of an engine cache key (window, capacity, chunk, lane
+pad, gwords) must be a pure function of the bucket, never of a raw
+history shape — one raw ``len(h)`` leaking in reopens the unbounded
+compile cache the ladder exists to close.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+# Re-exported bucket derivations: engine consumers import the ladder from
+# here; serve/buckets.py stays the single place the rungs are defined.
+# The re-export is lazy (PEP 562): importing jepsen_tpu.serve.buckets
+# executes serve/__init__, whose service/scheduler chain imports
+# parallel.batch — which imports THIS module.  Resolving the names on
+# first attribute access instead of at import time keeps the engine ->
+# serve edge out of the import graph.
+_BUCKET_EXPORTS = (
+    "MAX_LANE_BUCKET", "MIN_EVENTS_BUCKET", "MIN_N_BUCKET",
+    "MIN_WIDTH_BUCKET", "elle_bucket", "elle_n_bucket", "events_bucket",
+    "lane_bucket", "pow2_at_least", "wgl_bucket", "wgl_start_capacity",
+    "width_bucket",
+)
+
+
+def __getattr__(name: str):
+    if name in _BUCKET_EXPORTS:
+        from jepsen_tpu.serve import buckets
+        return getattr(buckets, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+#: Target lane-events per dispatch: the vmapped scan costs ~(batch x
+#: chunk) lane-event steps, so the chunk shrinks as the batch grows to
+#: keep one XLA program's duration roughly constant regardless of batch
+#: size.
+LANE_EVENTS_PER_DISPATCH = 16384
+
+
+def round_window(w: int) -> int:
+    """Tightest engine window for a history: multiple of 4, >= 8."""
+    return max(8, ((w + 3) // 4) * 4)
+
+
+def batch_chunk(bpad: int, longest: int) -> int:
+    """Events per dispatch for a ``bpad``-lane batch (multiple of 64,
+    clamped to [64, 2048] and to the longest lane rounded up)."""
+    c = max(64, min(2048, (LANE_EVENTS_PER_DISPATCH // max(1, bpad))
+                    // 64 * 64))
+    return min(c, max(64, ((longest + 63) // 64) * 64))
+
+
+def batch_shape(preps: Sequence, window_floor: int = 0) -> Tuple[int, int, int]:
+    """The one shared wgl engine shape for a batch of prepared histories:
+    ``(window, gwords, longest)``.
+
+    All lanes share one engine shape — window = max over histories
+    (rounded onto the window ladder, floored by the caller's bucket),
+    ghost words = max over lanes (lean gwords=0 only when EVERY lane
+    qualifies: the shape is shared, and a non-qualifying lane's
+    ghost_words dominates the max anyway)."""
+    from jepsen_tpu.checker.wgl_tpu import chosen_gwords
+    window = round_window(max(window_floor, max(p.window for p in preps)))
+    gwords = max(chosen_gwords(p) for p in preps)
+    longest = max(len(p) for p in preps)
+    return window, gwords, longest
+
+
+def next_capacity(cap: int, max_capacity: int, growth: int = 8) -> Optional[int]:
+    """The next rung of the capacity-escalation ladder, or None when
+    ``cap`` already hit the ceiling (the caller degrades the remaining
+    lanes to ``unknown`` — never to false)."""
+    if cap >= max_capacity:
+        return None
+    return min(cap * growth, max_capacity)

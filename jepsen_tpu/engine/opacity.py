@@ -1,0 +1,106 @@
+"""Opacity checking via the opacity -> linearizability reduction.
+
+Opacity (Guerraoui & Kapalka) demands that ALL transactions — committed
+*and* aborted — observe one consistent serial order.  The reduction
+(arXiv:1610.01004, "Checking Opacity of Transactional Memories"): a
+transactional history is opaque iff the derived history in which
+
+- a **committed** transaction is one atomic op applying its reads and
+  writes (``f="txn"``),
+- an **aborted** transaction is one atomic *read-only* op — its writes
+  are discarded (they never took effect) but its reads must still have
+  seen a consistent snapshot (``f="txn-ro"``); an aborted txn that
+  observed nothing constrains nothing and is dropped,
+- a **crashed** transaction (info/no completion) stays an open op whose
+  writes may or may not have applied — the engine's standard ghost
+  discipline,
+
+is linearizable over the sequential transactional-register oracle.  The
+derived history runs on the UNCHANGED wgl engine (device tier:
+``models.collections.txn_register_jax``, a plain int32 state machine) —
+opacity rides the substrate as a drop-in model plugin, no engine change.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.checker.core import Checker
+from jepsen_tpu.history import FAIL, History, OK
+
+#: arXiv reference for the reduction this checker implements.
+REDUCTION = "opacity->linearizability (arXiv:1610.01004)"
+
+
+def derive_history(history: History) -> History:
+    """The reduction's history transform (see module docstring).
+
+    Aborted ``txn`` pairs are retyped to ok ``txn-ro`` pairs carrying
+    only their constraining reads (observed, non-nil values); aborted
+    txns with no such reads are dropped entirely.  Committed and crashed
+    txns, and non-txn ops (nemesis lines), pass through untouched.
+    """
+    h = history if isinstance(history, History) else History(history)
+    pairs = h.pair_index()
+    drop = set()
+    replace: Dict[int, Any] = {}
+    for i, op in enumerate(h):
+        if op.type != FAIL or op.f != "txn":
+            continue
+        j = int(pairs[i])
+        mops = op.value
+        if mops is None and j >= 0:
+            mops = h.ops[j].value
+        # Constraining reads only: observed (non-nil) values of keys NOT
+        # written earlier in the same txn — a read-own-write observation
+        # is satisfied internally and says nothing about global state
+        # (the discarded write it saw never happened).
+        written = set()
+        reads = []
+        for m in (mops or ()):
+            if m[0] in ("w", "write"):
+                written.add(m[1])
+            elif m[0] in ("r", "read") and m[2] is not None \
+                    and m[1] not in written:
+                reads.append(list(m))
+        if not reads:
+            drop.add(i)
+            if j >= 0:
+                drop.add(j)
+            continue
+        replace[i] = op.with_(type=OK, f="txn-ro", value=reads,
+                              error=None)
+        if j >= 0:
+            replace[j] = h.ops[j].with_(f="txn-ro", value=reads)
+    ops = [replace.get(i, op) for i, op in enumerate(h.ops)
+           if i not in drop]
+    return History(ops, reindex=True)
+
+
+class OpacityChecker(Checker):
+    """Drop-in checker: opacity of a transactional history, decided by
+    the unchanged wgl engine on the derived history.
+
+    ``keys``/``vbits`` bound the device tier's register domain (the
+    facade falls back to the host oracle outside it); ``algorithm`` and
+    ``engine_opts`` pass straight through to :class:`Linearizable`.
+    """
+
+    def __init__(self, keys: int = 3, vbits: int = 4,
+                 algorithm: Optional[str] = None, **engine_opts):
+        self.keys = keys
+        self.vbits = vbits
+        self.algorithm = algorithm
+        self.engine_opts = engine_opts
+
+    def check(self, test, history: History, opts=None) -> Dict[str, Any]:
+        from jepsen_tpu.checker.linearizable import Linearizable
+        from jepsen_tpu.models import get_model
+        derived = derive_history(history)
+        model = get_model("txn-register", keys=self.keys, vbits=self.vbits)
+        res = Linearizable(model, self.algorithm,
+                           **self.engine_opts).check(test, derived, opts)
+        res["checker"] = "opacity"
+        res["reduction"] = REDUCTION
+        res["derived-ops"] = len(derived)
+        return res

@@ -1,0 +1,77 @@
+"""Device-model plugin seam: new consistency models as registry entries.
+
+A "plugin" is a named checker wired from (a) a registered device model
+(:func:`~jepsen_tpu.models.base.register_model`) and (b) the shared
+engine substrate — ladder, cache, budget, fallback, witness — via the
+:class:`~jepsen_tpu.engine.model_plugin.ModelPluginChecker` facade.
+Writing a new consistency model means writing the int32 step/encode pair
+and one ``register_model_plugin`` line; the engine itself is untouched
+(see docs/engines.md for the walkthrough).
+
+This module is import-light on purpose: ``checker.core`` imports it from
+``_register_builtins()`` while core itself is still mid-import, so
+nothing here may import checker modules (or jax) at module scope —
+factories resolve lazily at checker-construction time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+# name -> {"model": model-name or None, "doc": one-liner} for discovery
+# (docs, the engine smoke, `registered_plugins()`).
+_PLUGINS: Dict[str, Dict[str, Any]] = {}
+
+
+def register_model_plugin(name: str, model: str, register: Callable,
+                          doc: str = "",
+                          derive: Optional[Callable] = None,
+                          **preset: Any) -> None:
+    """Register checker ``name`` as linearizability over device model
+    ``model`` through the substrate facade.  ``register`` is the checker
+    registry hook (checker.core.register_checker — passed in, not
+    imported, to keep this module cycle-free); ``preset`` kwargs become
+    factory defaults the spec's opts override."""
+    def factory(**opts):
+        from jepsen_tpu.engine.model_plugin import ModelPluginChecker
+        merged = {**preset, **opts}
+        model_kw = merged.pop("model_kw", None)
+        return ModelPluginChecker(model, model_kw=model_kw,
+                                  derive=derive, **merged)
+    register(name, factory)
+    _PLUGINS[name] = {"model": model, "doc": doc}
+
+
+def registered_plugins() -> List[str]:
+    """Names of the checkers registered through the plugin seam."""
+    return sorted(_PLUGINS)
+
+
+def plugin_info(name: str) -> Dict[str, Any]:
+    return dict(_PLUGINS[name])
+
+
+def register_builtin_plugins(register: Callable) -> None:
+    """The builtin plugin battery (called by checker.core's
+    ``_register_builtins``): the queue and set device kernels, and the
+    opacity checker via the opacity->linearizability reduction."""
+    from jepsen_tpu.engine.model_plugin import derive_queue_slots
+    register_model_plugin(
+        "linearizable-queue", "fifo-queue", register,
+        doc="FIFO queue linearizability on the device engine "
+            "(ring-buffer kernel; slots derived from the history, "
+            "bucketed pow2)",
+        derive=derive_queue_slots)
+    register_model_plugin(
+        "linearizable-set", "set", register,
+        doc="read-full-set linearizability on the device engine "
+            "(two-word bitmask kernel, domain [0, 62))")
+
+    def opacity_factory(**opts):
+        from jepsen_tpu.engine.opacity import OpacityChecker
+        return OpacityChecker(**opts)
+    register("opacity", opacity_factory)
+    _PLUGINS["opacity"] = {
+        "model": "txn-register",
+        "doc": "opacity via the opacity->linearizability reduction "
+               "(arXiv:1610.01004) on the unchanged wgl engine"}

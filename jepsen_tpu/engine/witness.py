@@ -1,0 +1,55 @@
+"""Witness-recovery discipline: device lanes flag, the CPU recovers.
+
+A device lane refutes by emptying its configuration frontier — it knows
+*which* op killed the last configuration but not the path that led
+there.  The discipline, shared by every device engine: the device
+result carries the refuting op (the lanes *flag*), and the knossos-style
+final-configs witness is re-derived on the host by re-running the CPU
+oracle on the failing prefix (cheap: the prefix is exactly what the
+device already refuted).  A witness search exceeding its budget degrades
+the *witness* to an error note — the refutation verdict itself stands,
+because it was earned by exhaustive search, and conversely no code path
+may fabricate a ``valid: False`` without a refuting op attached
+(the SOUND01 contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+#: Configuration budget for the CPU witness re-derivation on refuted
+#: histories (knossos-style final-paths cost cap; checker.clj:213-216
+#: truncates for the same reason).  Exceeding it degrades the result to
+#: ``witness: {"error": ...}`` — the refutation verdict itself stands.
+WITNESS_BUDGET = 200_000
+
+
+def cpu_witness(model, history, failed_op,
+                budget: int = WITNESS_BUDGET) -> Dict[str, Any]:
+    """Re-run the CPU oracle on the prefix ending at the failing op's
+    completion for a knossos-style final-configs report."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.history import History
+    h = history.client_ops().complete()
+    pairs = h.pair_index()
+    cut = None
+    for i, op in enumerate(h):
+        if op.index == failed_op.index:
+            cut = int(pairs[i]) if pairs[i] >= 0 else i
+            break
+    if cut is None:
+        return {"error": "failing op not found in history"}
+    prefix = History(h.ops[:cut + 1])
+    try:
+        return wgl_cpu.check(model.cpu_model(), prefix, max_configs=budget)
+    except wgl_cpu.SearchExploded:
+        return {"error": "witness search exceeded budget"}
+
+
+def refuted_result(analyzer: str, op, configs_explored: int,
+                   **extra: Any) -> Dict[str, Any]:
+    """The canonical device-lane refutation: the frontier emptied at
+    ``op`` and the refuting op rides the verdict as its evidence."""
+    # witness: exhaustive device search emptied the frontier; refuting op attached
+    return {"valid": False, "analyzer": analyzer, "op": op.to_dict(),
+            "configs-explored": int(configs_explored), **extra}

@@ -135,6 +135,20 @@ def trace_engine_findings() -> List[Finding]:
         findings.extend(check_jaxpr_clean(
             fn, args, f"elle-lane[n={n_pad},rt={realtime}]",
             path="jepsen_tpu/elle_tpu/closure.py"))
+
+    # The engine-plugin kernels (queue/set/txn-register) ride the same
+    # make_engine body, but their step/encode closures are new device
+    # code: trace each through the engine so a host round-trip in a
+    # kernel is caught exactly like one in the engine itself.
+    for name, kw in (("fifo-queue", {"slots": 8}), ("set", {}),
+                     ("txn-register", {})):
+        m = get_model(name, **kw)
+        carry0, _, run_chunk = make_engine(m, window=8, capacity=64,
+                                           gwords=1)
+        events = jnp.zeros((64, 10), jnp.int32)
+        findings.extend(check_jaxpr_clean(
+            run_chunk, (carry0(), events), f"wgl[{name}]",
+            path="jepsen_tpu/models/collections.py"))
     return findings
 
 
@@ -201,6 +215,31 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
     findings.extend(signature_stability_findings(
         samples, elle_signature, elle_bucket, "elle serve path",
         path="jepsen_tpu/serve/scheduler.py"))
+
+    # The queue plugin's per-history model sizing is an engine-cache key
+    # component (JaxModel.variant): run the REAL derivation over synthetic
+    # enqueue streams and require it to collapse onto the pow2 ladder.
+    from jepsen_tpu.engine.model_plugin import derive_queue_slots
+    from jepsen_tpu.history import History, Op
+
+    def _enq_history(n: int) -> History:
+        ops = []
+        for i in range(n):
+            ops.append(Op(process=0, type="invoke", f="enqueue",
+                          value=i, index=2 * i))
+            ops.append(Op(process=0, type="ok", f="enqueue",
+                          value=i, index=2 * i + 1))
+        return History(ops)
+
+    def queue_bucket(s):
+        return (buckets.pow2_at_least(max(1, s[0]), 8),)
+
+    def queue_signature(s):
+        return (derive_queue_slots(_enq_history(s[0]), {})["slots"],)
+
+    findings.extend(signature_stability_findings(
+        samples, queue_signature, queue_bucket, "queue plugin slots",
+        path="jepsen_tpu/engine/model_plugin.py"))
     return findings
 
 

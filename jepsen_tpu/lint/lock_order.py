@@ -22,7 +22,7 @@ The declared order mirrors the call graph today:
       -> service -> scheduler -> request -> metrics
     router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
-    engine-cache (leaf: parallel.batch's LRU, acquired under anything)
+    engine-cache (leaf: engine.cache's shared LRU, acquired under anything)
 
 The transport chain follows a respawn end to end: the ProcFleet
 supervisor (``_sup_lock``) restarts a slot (``_restart_lock``), whose
@@ -77,7 +77,7 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
     ("tap",
      [(r"monitor/tap\.py$", r"^self\._lock$")]),
     ("engine-cache",
-     [(r"parallel/batch\.py$", r"^self\._lock$")]),
+     [(r"engine/cache\.py$", r"^self\._lock$")]),
 )
 
 

@@ -44,6 +44,7 @@ SCOPE = (
     "jepsen_tpu/elle_tpu/",
     "jepsen_tpu/checker/",
     "jepsen_tpu/ops/",
+    "jepsen_tpu/engine/",
 )
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)

@@ -41,7 +41,7 @@ from jepsen_tpu.lint.rules import dotted, qualname_of, walk_with_parents
 
 RULE = "SHAPE01"
 
-SCOPE = ("jepsen_tpu/serve/",)
+SCOPE = ("jepsen_tpu/serve/", "jepsen_tpu/engine/")
 
 #: kwargs that carry a shape into an engine, per entry-point name.
 _SHAPE_KWARGS = {
@@ -50,6 +50,13 @@ _SHAPE_KWARGS = {
     "make_engine": ("window", "capacity", "gwords"),
     "events_array": ("chunk", "pad_to"),
     "pack_group": ("n_pad", "b_pad"),
+    # engine-substrate entry points: the shared shape derivation itself
+    # (ladder.batch_shape) and the model factories whose kwargs become
+    # engine-cache key components (a raw len(h) here is exactly the
+    # unbounded-compile-cache leak the ladder exists to close).
+    "batch_shape": ("window_floor",),
+    "fifo_queue_jax": ("slots",),
+    "txn_register_jax": ("keys", "vbits"),
 }
 
 #: which floor kwarg a check_batch variant requires, by defining module.

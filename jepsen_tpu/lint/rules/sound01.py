@@ -41,6 +41,7 @@ SCOPE = (
     "jepsen_tpu/parallel/",
     "jepsen_tpu/elle_tpu/",
     "jepsen_tpu/elle/",
+    "jepsen_tpu/engine/",
 )
 
 #: Registered witness-bearing sites: (path, enclosing qualname) -> one-line

@@ -16,7 +16,8 @@ from jepsen_tpu.models.register import (  # noqa: F401
     CASRegister, RWRegister, cas_register_jax, rw_register_jax,
 )
 from jepsen_tpu.models.collections import (  # noqa: F401
-    FIFOQueue, MultiRegister, Mutex, SetModel, UnorderedQueue,
+    BitSetModel, FIFOQueue, MultiRegister, Mutex, SET_DOMAIN, SetModel,
+    TxnRegister, UnorderedQueue, fifo_queue_jax, set_jax, txn_register_jax,
 )
 from jepsen_tpu.models.locks import (  # noqa: F401
     AcquiredPermits, FencedMutex, OwnerAwareMutex, ReentrantFencedMutex,

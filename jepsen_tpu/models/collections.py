@@ -95,7 +95,14 @@ class UnorderedQueue(Model):
             if op.value is None:
                 if not self.items:
                     return inconsistent("dequeue from empty queue")
-                return UnorderedQueue(frozenset(list(self.items)[1:]))
+                # Unconstrained dequeue (crashed/info op): SOME element
+                # left, we don't know which.  A single-successor step must
+                # pick one; pick deterministically (smallest by repr) —
+                # ``list(frozenset)[1:]`` depended on hash iteration order,
+                # so verdicts varied run-to-run with PYTHONHASHSEED (see
+                # tests/test_models.py pinning tests).
+                keep = sorted(self.items, key=repr)[1:]
+                return UnorderedQueue(frozenset(keep))
             if op.value not in self.items:
                 return inconsistent(f"{op.value!r} not in queue")
             return UnorderedQueue(self.items - {op.value})
@@ -303,3 +310,276 @@ def bitset256_jax() -> JaxModel:
     return JaxModel(name="bitset-256", state_size=m.state_size,
                     init_state=m.init_state, step=m.step,
                     encode_op=m.encode_op, cpu_model=m.cpu_model)
+
+
+# -- fifo queue, device tier -------------------------------------------------
+
+F_ENQ, F_DEQ = 0, 1
+
+
+@register_model("fifo-queue")
+def fifo_queue_jax(slots: int = 64) -> JaxModel:
+    """Device tier for :class:`FIFOQueue`: a bounded int32 ring buffer.
+
+    State is ``[head, tail, buf[slots]]``; head/tail are monotonic
+    cursors (depth = tail - head), ``buf[2 + cursor % slots]`` holds the
+    element.  Enqueue appends at tail; dequeue pops at head, constrained
+    to the head element when the op observed a value (``b=1``) and
+    unconstrained for crashed/nil dequeues (``b=0``) — matching the host
+    oracle's "None pops the head" semantics exactly, since FIFO leaves no
+    choice of which element leaves.  All scatters are int32 (vmap-safe;
+    see engine.groups for the bool-scatter cliff).
+
+    Soundness bound: a linearization holding more than ``slots`` elements
+    at once would wrongly fail the enqueue, so ``encode_op`` counts the
+    history's enqueues at encode time via the plugin facade picking
+    ``slots`` >= total enqueues — the builtin plugin derives ``slots``
+    from the history; out-of-domain values (non-int, |v| at the int32
+    edge) raise ValueError and the facade falls back to the host oracle.
+    """
+    if slots < 1:
+        raise ValueError(f"fifo-queue needs slots >= 1 (got {slots})")
+
+    def step(state, f, a, b):
+        head, tail = state[0], state[1]
+        depth = tail - head
+        is_enq = f == F_ENQ
+        slot_e = 2 + jnp.mod(tail, slots)
+        slot_d = 2 + jnp.mod(head, slots)
+        head_v = state[slot_d]
+        enq_ok = depth < slots
+        deq_ok = (depth > 0) & ((b == 0) | (head_v == a))
+        ok = jnp.where(is_enq, enq_ok, deq_ok)
+        # Enqueue writes a at tail's slot; dequeue zeroes head's slot (so
+        # drained queues dedup back onto each other).  At depth 0 the two
+        # slots coincide: write the enqueue value first, then zero only on
+        # an actual dequeue.
+        new = state.at[slot_e].set(jnp.where(is_enq, a, state[slot_e]))
+        new = new.at[slot_d].set(jnp.where(is_enq, new[slot_d], 0))
+        new = new.at[0].set(jnp.where(is_enq, head, head + 1))
+        new = new.at[1].set(jnp.where(is_enq, tail + 1, tail))
+        return jnp.where(ok, new, state), ok
+
+    def encode(op: Op):
+        if op.f == "enqueue":
+            v = op.value
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise ValueError(f"fifo-queue device tier needs int "
+                                 f"elements (got {v!r})")
+            if not -2**31 < v < 2**31:
+                raise ValueError(f"element {v} outside int32")
+            return F_ENQ, v, 0
+        if op.f == "dequeue":
+            if op.value is None:
+                return F_DEQ, UNKNOWN32, 0
+            v = op.value
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise ValueError(f"fifo-queue device tier needs int "
+                                 f"elements (got {v!r})")
+            if not -2**31 < v < 2**31:
+                raise ValueError(f"element {v} outside int32")
+            return F_DEQ, v, 1
+        raise ValueError(f"fifo-queue can't encode f={op.f!r}")
+
+    return JaxModel(name="fifo-queue", state_size=2 + slots,
+                    init_state=np.zeros(2 + slots, np.int32),
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: FIFOQueue(),
+                    variant=(slots,))
+
+
+# -- read-full set, device tier ----------------------------------------------
+
+#: Element domain of the device set: two 31-bit words (bit 31 stays clear
+#: so the packed read masks are non-negative int32s, and neither word can
+#: collide with the UNKNOWN32 sentinel).
+SET_DOMAIN = 62
+
+F_SADD, F_SREAD = 0, 1
+
+
+@register_model("set")
+def set_jax() -> JaxModel:
+    """Device tier for :class:`SetModel`: grow-only int set with
+    *read-the-full-set* reads (the jepsen set-full workload shape).
+
+    State is the membership bitmask over [0, 62) split across two 31-bit
+    int32 words.  ``add k`` ORs the bit in; ``read S`` packs S into the
+    same two words and requires exact equality with the state — precisely
+    the host oracle's frozenset equality.  Nil reads (crashed) encode
+    ``a = UNKNOWN32`` and constrain nothing; reads are pure so
+    preprocessing's crashed-read elimination drops them.  Out-of-domain
+    elements raise ValueError and the facade falls back to the host.
+    """
+    def step(state, f, a, b):
+        is_add = f == F_SADD
+        k = jnp.where(is_add, a, 0)
+        word = k // 31
+        mask = jnp.int32(1) << jnp.mod(k, 31)
+        added = state.at[word].set(state[word] | mask)
+        unconstrained = a == UNKNOWN32
+        read_ok = unconstrained | ((state[0] == a) & (state[1] == b))
+        ok = jnp.where(is_add, True, read_ok)
+        new = jnp.where(is_add, added, state)
+        return new, ok
+
+    def _elem(v) -> int:
+        if not isinstance(v, int) or isinstance(v, bool):
+            raise ValueError(f"set device tier needs int elements "
+                             f"(got {v!r})")
+        if not 0 <= v < SET_DOMAIN:
+            raise ValueError(f"element {v} outside [0, {SET_DOMAIN})")
+        return v
+
+    def encode(op: Op):
+        if op.f == "add":
+            return F_SADD, _elem(op.value), 0
+        if op.f == "read":
+            if op.value is None:
+                return F_SREAD, UNKNOWN32, 0
+            lo = hi = 0
+            for e in op.value:
+                k = _elem(e)
+                if k < 31:
+                    lo |= 1 << k
+                else:
+                    hi |= 1 << (k - 31)
+            return F_SREAD, lo, hi
+        raise ValueError(f"set can't encode f={op.f!r}")
+
+    return JaxModel(name="set", state_size=2,
+                    init_state=np.zeros(2, np.int32),
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: SetModel(),
+                    pure_read_fs=(F_SREAD,))
+
+
+# -- transactional register (the opacity reduction's target model) -----------
+
+@dataclass(frozen=True)
+class TxnRegister(Model):
+    """Host oracle for transactions-as-atomic-ops: value is a list of
+    micro-ops ``[op, k, v]`` with op in {"r", "w"}, applied atomically and
+    sequentially (reads after an intra-txn write see the written value).
+    ``f="txn"`` may write; ``f="txn-ro"`` is the opacity reduction's
+    aborted-transaction image and must be read-only.  Nil read values are
+    unfilled placeholders (pending/info), not observations.
+    """
+
+    values: Tuple[Tuple[Any, Any], ...] = ()
+
+    def step(self, op: Op):
+        if op.f not in ("txn", "txn-ro"):
+            return inconsistent(f"unknown f {op.f!r}")
+        local = dict(self.values)
+        wrote = False
+        for mop in (op.value or ()):
+            ftag, k, v = mop[0], mop[1], mop[2]
+            if ftag in ("r", "read"):
+                if v is None:
+                    continue
+                if local.get(k) != v:
+                    return inconsistent(
+                        f"key {k!r}: read {v!r}, have {local.get(k)!r}")
+            elif ftag in ("w", "write"):
+                if op.f == "txn-ro":
+                    return inconsistent("write inside read-only txn")
+                local[k] = v
+                wrote = True
+            else:
+                return inconsistent(f"unknown mop {ftag!r}")
+        if not wrote:
+            return self
+        return TxnRegister(tuple(sorted(local.items(), key=repr)))
+
+
+F_TXN, F_TXN_RO = 0, 1
+
+
+@register_model("txn-register")
+def txn_register_jax(keys: int = 3, vbits: int = 4) -> JaxModel:
+    """Device tier for :class:`TxnRegister`: k int32 lanes, one per key.
+
+    A whole transaction is ONE engine event: ``a`` packs the external
+    read set (touched-key bitmask in the low ``keys`` bits, each touched
+    key's observed value in a ``vbits`` field above), ``b`` packs the
+    write set the same way.  ``encode_op`` folds the sequential intra-txn
+    semantics at encode time: reads after an intra-txn write check the
+    local view and vanish from the external read set; two external reads
+    of one key must agree (else ValueError -> host fallback, where the
+    sequential oracle refutes precisely).  ``f=txn-ro`` (the opacity
+    reduction's aborted transactions) is a pure read.  Needs
+    ``keys * (1 + vbits) <= 31`` so each packed set fits an int32.
+    """
+    if keys * (1 + vbits) > 31:
+        raise ValueError(f"txn-register device tier needs keys*(1+vbits)"
+                         f"<=31 (got {keys}x{vbits})")
+    vmask = (1 << vbits) - 1
+    lanes = np.arange(keys, dtype=np.int32)
+
+    def _unpack(word):
+        touched = ((word >> lanes) & 1) == 1
+        vals = (word >> (keys + lanes * vbits)) & vmask
+        return touched, vals
+
+    def step(state, f, a, b):
+        ra = jnp.where(a == UNKNOWN32, 0, a)
+        rtouch, rvals = _unpack(ra)
+        ok = jnp.all(~rtouch | (state == rvals))
+        wb = jnp.where(b == UNKNOWN32, 0, b)
+        wtouch, wvals = _unpack(wb)
+        new = jnp.where(wtouch, wvals, state)
+        return jnp.where(ok, new, state), ok
+
+    def encode(op: Op):
+        f = {"txn": F_TXN, "txn-ro": F_TXN_RO}.get(op.f)
+        if f is None:
+            raise ValueError(f"txn-register can't encode f={op.f!r}")
+        local: dict = {}
+        rmask = rpack = wmask = wpack = 0
+        for mop in (op.value or ()):
+            ftag, k, v = mop[0], mop[1], mop[2]
+            k = int(k)
+            if not 0 <= k < keys:
+                raise ValueError(f"key {k} outside [0, {keys})")
+            if ftag in ("r", "read"):
+                if v is None:
+                    continue  # unfilled placeholder: unconstraining
+                v = int(v)
+                if not 0 <= v <= vmask:
+                    raise ValueError(f"value {v} outside [0, {vmask}]")
+                if k in local:
+                    if local[k] != v:
+                        raise ValueError(
+                            f"read-own-write mismatch on key {k}")
+                    continue  # satisfied locally: not an external read
+                bit = 1 << k
+                if rmask & bit:
+                    prev = (rpack >> (keys + k * vbits)) & vmask
+                    if prev != v:
+                        raise ValueError(
+                            f"conflicting external reads of key {k}")
+                    continue
+                rmask |= bit
+                rpack |= v << (keys + k * vbits)
+            elif ftag in ("w", "write"):
+                if op.f == "txn-ro":
+                    raise ValueError("write inside read-only txn")
+                v = int(v)
+                if not 0 <= v <= vmask:
+                    raise ValueError(f"value {v} outside [0, {vmask}]")
+                local[k] = v
+            else:
+                raise ValueError(f"unknown mop {ftag!r}")
+        for k, v in local.items():
+            wmask |= 1 << k
+            wpack |= v << (keys + k * vbits)
+        a = (rmask | rpack) if rmask else UNKNOWN32
+        return f, a, wmask | wpack
+
+    return JaxModel(name="txn-register", state_size=keys,
+                    init_state=np.full(keys, UNKNOWN32 + 1, np.int32),
+                    step=step, encode_op=encode,
+                    cpu_model=lambda: TxnRegister(),
+                    pure_read_fs=(F_TXN_RO,),
+                    variant=(keys, vbits))

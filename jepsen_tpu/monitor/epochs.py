@@ -225,10 +225,18 @@ class WglEpochEngine:
     ``independent=True`` mirrors ``independent.subhistory`` exactly: ops
     route by their ``(key, value)`` tuple's key, values are unwrapped,
     unkeyed client ops are dropped (as the cold per-key split drops
-    them); nemesis ops never reach a frontier (prepare strips them)."""
+    them); nemesis ops never reach a frontier (prepare strips them).
 
-    def __init__(self, model: Model, independent: bool = False,
+    ``model`` may be a host :class:`Model` or a registered device-model
+    name (the engine plugin seam): a string resolves through
+    ``models.get_model(name)`` and the frontier runs its host oracle —
+    so any model added as an engine plugin is monitorable for free."""
+
+    def __init__(self, model, independent: bool = False,
                  max_configs: int = 2_000_000, keep_prefix: bool = False):
+        if isinstance(model, str):
+            from jepsen_tpu.models import get_model
+            model = get_model(model).cpu_model()
         self.model = model
         self.independent = independent
         self.max_configs = max_configs

@@ -21,9 +21,6 @@ and lanes progress at fully independent rates with no idle steps.
 
 from __future__ import annotations
 
-import os
-import threading
-from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -32,97 +29,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checker.prep import PreparedHistory, prepare
-from jepsen_tpu.checker.wgl_tpu import (EV_NOP, chosen_gwords,
-                                        events_array, make_engine)
+from jepsen_tpu.checker.wgl_tpu import EV_NOP, events_array, make_engine
+# The ladder/cache/group/budget/witness disciplines live in the shared
+# engine substrate; the historical names stay importable from here (the
+# serve scheduler, megabatch, tests, and external callers bind them).
+from jepsen_tpu.engine.budget import exhausted_result
+from jepsen_tpu.engine.cache import (
+    CACHE as _CACHE, EngineCache as _LRUCache, engine_cache_stats,  # noqa: F401
+)
+from jepsen_tpu.engine.groups import MAX_LANES_PER_GROUP, group_slices
+from jepsen_tpu.engine.ladder import (
+    LANE_EVENTS_PER_DISPATCH, batch_chunk as _batch_chunk, batch_shape,  # noqa: F401
+    next_capacity,
+)
+from jepsen_tpu.engine.witness import refuted_result
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
-
-
-class _LRUCache:
-    """Bounded compiled-engine cache.
-
-    Each entry pins a jitted vmapped engine (traced program + XLA
-    executable) whose size scales with window*capacity*chunk — a service
-    that sees many shapes would grow an unbounded dict without end.  LRU
-    eviction keeps the hot buckets resident; hit/miss/eviction counters
-    feed the serve metrics endpoint (an eviction storm means the bucket
-    ladder is too fine)."""
-
-    def __init__(self, capacity: int):
-        self.capacity = max(1, int(capacity))
-        self._d: "OrderedDict[Any, Any]" = OrderedDict()
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.group_reuses = 0
-
-    def get(self, key, group_reuse: bool = False):
-        """``group_reuse=True`` marks a lookup made for an additional
-        dispatch group within ONE logical batch (check_batch's >512-lane
-        split, megabatch's grouped vmap): a found entry counts toward
-        ``group_reuses`` instead of ``hits``, so the hit rate keeps
-        measuring cross-call cache effectiveness rather than being
-        inflated by same-dispatch reuse."""
-        with self._lock:
-            if key in self._d:
-                self._d.move_to_end(key)
-                if group_reuse:
-                    self.group_reuses += 1
-                else:
-                    self.hits += 1
-                return self._d[key]
-            self.misses += 1
-            return None
-
-    def put(self, key, value):
-        with self._lock:
-            self._d[key] = value
-            self._d.move_to_end(key)
-            while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
-                self.evictions += 1
-            return value
-
-    def __len__(self):
-        return len(self._d)
-
-    def stats(self) -> Dict[str, int]:
-        with self._lock:
-            return {"size": len(self._d), "capacity": self.capacity,
-                    "hits": self.hits, "misses": self.misses,
-                    "evictions": self.evictions,
-                    "group_reuses": self.group_reuses}
-
-
-_CACHE = _LRUCache(int(os.environ.get("JEPSEN_TPU_ENGINE_CACHE", "32")))
-
-
-def engine_cache_stats() -> Dict[str, int]:
-    """Hit/miss/eviction counters of the compiled-engine cache (a miss is
-    a fresh trace+compile — the serve metrics' recompile counter)."""
-    return _CACHE.stats()
-
-#: Target lane-events per dispatch: the vmapped scan costs ~(batch x chunk)
-#: lane-event steps, so the chunk shrinks as the batch grows to keep one
-#: XLA program's duration roughly constant regardless of batch size.
-LANE_EVENTS_PER_DISPATCH = 16384
-
-#: Max lanes per vmapped dispatch group.  Root cause (minimized to pure
-#: JAX, reproduces on CPU and TPU backends and with eager vmap): a
-#: vmapped scatter into a BOOL array inside ``lax.scan`` computes wrong
-#: results at batch >= 1024 — ``jax.vmap(lambda arr, slot:
-#: arr.at[slot].set(False))`` over bool[W] carriers, exactly the engine's
-#: ``active``/``fresh`` slot updates; int32 carriers are unaffected, 1023
-#: lanes are verdict-perfect (see tests/test_parallel.py regression and
-#: ops/jax_bug_repro.py).  Engine-side symptom before the cap: two
-#: distinct valid 8-op histories alternated 512x -> every lane of one
-#: history refuted at its first return.  512 is also the throughput knee
-#: measured in the one-off hardware tuning sweep (58.9 h/s at 512 lanes
-#: vs 52.1 at 256 on 200-op lanes; the committed bench artifact's
-#: 512-lane row reproduces the level at 56.3 h/s), so grouping costs
-#: nothing.
-MAX_LANES_PER_GROUP = 512
 
 
 def donate_carry_argnums() -> tuple:
@@ -138,14 +60,6 @@ def donate_carry_argnums() -> tuple:
         return (0,) if jax.default_backend() != "cpu" else ()
     except Exception:  # backend probe must never break checking
         return ()
-
-
-def _batch_chunk(bpad: int, longest: int) -> int:
-    """Events per dispatch for a ``bpad``-lane batch (multiple of 64,
-    clamped to [64, 2048] and to the longest lane rounded up)."""
-    c = max(64, min(2048, (LANE_EVENTS_PER_DISPATCH // max(1, bpad))
-                    // 64 * 64))
-    return min(c, max(64, ((longest + 63) // 64) * 64))
 
 
 def check_batch(model: JaxModel,
@@ -177,31 +91,23 @@ def check_batch(model: JaxModel,
     if not histories:
         return []
     if len(histories) > MAX_LANES_PER_GROUP:
-        # Dispatch in bounded groups (see MAX_LANES_PER_GROUP): verdicts
-        # corrupt at >= 1024 vmapped lanes, and 512-lane groups are the
-        # measured throughput knee anyway.  Groups share the compiled
-        # engine when their shapes agree (the engine cache keys on
-        # window/capacity/chunk/bpad).
+        # Dispatch in bounded groups (engine.groups owns the cap and its
+        # bool-scatter/throughput-knee rationale).  Groups share the
+        # compiled engine when their shapes agree (the engine cache keys
+        # on window/capacity/chunk/bpad).
         out: List[Dict[str, Any]] = []
-        for i in range(0, len(histories), MAX_LANES_PER_GROUP):
-            out.extend(check_batch(model,
-                                   histories[i:i + MAX_LANES_PER_GROUP],
+        for start, stop, reuse in group_slices(len(histories)):
+            out.extend(check_batch(model, histories[start:stop],
                                    mesh=mesh, axis=axis, capacity=capacity,
                                    max_capacity=max_capacity, chunk=chunk,
                                    window_floor=window_floor,
-                                   _group_reuse=_group_reuse or i > 0))
+                                   _group_reuse=_group_reuse or reuse))
         return out
-    from jepsen_tpu.checker.wgl_tpu import _round_window
     preps = [prepare(h, model) for h in histories]
-    window = _round_window(max(window_floor, max(p.window for p in preps)))
-    longest = max(len(p) for p in preps)
-    # Lean (gwords=0) only when EVERY lane qualifies — the engine shape is
-    # shared across the batch, and a non-qualifying lane's ghost_words
-    # dominates the max anyway.
-    gw = max(chosen_gwords(p) for p in preps)
+    window, gw, longest = batch_shape(preps, window_floor=window_floor)
     out: List[Optional[Dict[str, Any]]] = [None] * len(preps)
     lanes = list(range(len(preps)))
-    cap = capacity
+    cap: Optional[int] = capacity
     while lanes:
         res = _run_lanes(model, [preps[i] for i in lanes],
                          window, cap, mesh, axis, chunk, gw, longest,
@@ -212,13 +118,16 @@ def check_batch(model: JaxModel,
                 retry.append(lane)
             else:
                 out[lane] = r
-        if not retry or cap >= max_capacity:
+        if not retry:
+            break
+        nxt = next_capacity(cap, max_capacity)
+        if nxt is None:
             for lane in retry:
-                out[lane] = {"valid": "unknown", "analyzer": "wgl-tpu-batch",
-                             "error": f"capacity exceeded at {cap}"}
+                out[lane] = exhausted_result(
+                    "wgl-tpu-batch", f"capacity exceeded at {cap}")
             break
         lanes = retry
-        cap = min(cap * 8, max_capacity)
+        cap = nxt
     return out  # type: ignore[return-value]
 
 
@@ -290,10 +199,9 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
         if overflow[i]:
             out.append(None)
         elif failed[i]:
-            # witness: the lane's frontier emptied; its refuting op rides
-            out.append({"valid": False, "analyzer": "wgl-tpu-batch",
-                        "op": preps[i].ops[int(failed_op[i])].to_dict(),
-                        "configs-explored": int(explored[i])})
+            out.append(refuted_result("wgl-tpu-batch",
+                                      preps[i].ops[int(failed_op[i])],
+                                      int(explored[i])))
         else:
             out.append({"valid": True, "analyzer": "wgl-tpu-batch",
                         "configs-explored": int(explored[i])})

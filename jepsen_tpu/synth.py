@@ -506,3 +506,360 @@ def corrupt_reads(history: History, n: int = 1, seed: int = 0,
         bad = values + 1000 + rng.randrange(100)  # outside the value domain
         ops[i] = ops[i].with_(value=bad)
     return History(ops, reindex=True)
+
+
+# -- queue workload (the fifo-queue / unordered-queue engine plugins) --------
+
+def queue_history(n_ops: int = 100,
+                  concurrency: int = 5,
+                  enqueue_p: float = 0.55,
+                  crash_p: float = 0.003,
+                  seed: int = 0) -> History:
+    """Simulate ``n_ops`` enqueues/dequeues against a real FIFO queue:
+    enqueued values are unique ints, dequeues invoke with ``None`` and
+    OK-complete with the popped head (FAIL on empty — a legal no-op),
+    processes can crash mid-op leaving ghost enqueues that may or may not
+    have taken effect.  FIFO-linearizable by construction (and therefore
+    also unordered-queue-linearizable)."""
+    rng = random.Random(seed)
+    q: List[int] = []
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending: dict = {}
+    ghost_effects: List[dict] = []
+    t = 0
+    invoked = 0
+    next_v = 0
+
+    while invoked < n_ops or pending:
+        t += rng.randint(1, 1000)
+        if ghost_effects and rng.random() < 0.3:
+            ge = ghost_effects.pop(rng.randrange(len(ghost_effects)))
+            q.append(ge["op"].value)
+        roll = rng.random()
+        if free and invoked < n_ops and (roll < 0.45 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            if rng.random() < enqueue_p:
+                op = Op(process=p, type=INVOKE, f="enqueue",
+                        value=next_v, time=t)
+                next_v += 1
+            else:
+                op = Op(process=p, type=INVOKE, f="dequeue",
+                        value=None, time=t)
+            history.append(op)
+            pending[p] = {"op": op, "effected": False,
+                          "result_type": None, "result_value": None}
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            d = pending[p]
+            if rng.random() < crash_p:
+                history.append(Op(process=p, type=INFO, f=d["op"].f,
+                                  value=d["op"].value
+                                  if d["op"].f == "enqueue" else None,
+                                  time=t, error="crashed"))
+                if (not d["effected"] and d["op"].f == "enqueue"
+                        and rng.random() < 0.5):
+                    ghost_effects.append(d)
+                del pending[p]
+                free.append(p)
+            elif not d["effected"]:
+                op = d["op"]
+                if op.f == "enqueue":
+                    q.append(op.value)
+                    d["result_type"], d["result_value"] = OK, op.value
+                elif q:
+                    d["result_type"], d["result_value"] = OK, q.pop(0)
+                else:
+                    d["result_type"], d["result_value"] = FAIL, None
+                d["effected"] = True
+            else:
+                history.append(Op(process=p, type=d["result_type"],
+                                  f=d["op"].f, value=d["result_value"],
+                                  time=t,
+                                  error="empty"
+                                  if d["result_type"] == FAIL else None))
+                del pending[p]
+                free.append(p)
+
+    return History(history)
+
+
+def corrupt_queue(history: History, mode: str = "lost", n: int = 1,
+                  seed: int = 0) -> History:
+    """Inject queue anomalies with a known culprit:
+
+    - ``"lost"``: an ok dequeue observes a phantom value that was never
+      enqueued (the real element was lost in flight) — refutes FIFO and
+      unordered queues alike;
+    - ``"duplicated"``: an ok dequeue re-observes a value an earlier
+      dequeue already returned (an element delivered twice);
+    - ``"reordered"``: two ok dequeues swap their observed values —
+      refutes FIFO order but, elements still leaving exactly once, NOT an
+      unordered queue (generate with ``concurrency=1`` to guarantee the
+      refutation isn't absorbed by overlap).
+    """
+    rng = random.Random(seed)
+    ops = [o.with_() for o in history]
+    deq_oks = [i for i, o in enumerate(ops)
+               if o.type == OK and o.f == "dequeue" and o.value is not None]
+    enq_vals = {o.value for o in ops if o.f == "enqueue"}
+    if mode == "lost":
+        if not deq_oks:
+            raise ValueError("no ok dequeues to corrupt")
+        for i in rng.sample(deq_oks, min(n, len(deq_oks))):
+            phantom = max(enq_vals, default=0) + 1000 + rng.randrange(100)
+            ops[i] = ops[i].with_(value=phantom)
+    elif mode == "duplicated":
+        if len(deq_oks) < 2:
+            raise ValueError("need >= 2 ok dequeues to duplicate")
+        for _ in range(n):
+            i, j = sorted(rng.sample(deq_oks, 2))
+            ops[j] = ops[j].with_(value=ops[i].value)
+    elif mode == "reordered":
+        if len(deq_oks) < 2:
+            raise ValueError("need >= 2 ok dequeues to reorder")
+        for _ in range(n):
+            i, j = rng.sample(deq_oks, 2)
+            vi, vj = ops[i].value, ops[j].value
+            ops[i], ops[j] = ops[i].with_(value=vj), ops[j].with_(value=vi)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return History(ops, reindex=True)
+
+
+# -- grow-only set workload (the set engine plugin) --------------------------
+
+def set_history(n_ops: int = 80,
+                domain: int = 62,
+                concurrency: int = 5,
+                read_p: float = 0.4,
+                crash_p: float = 0.003,
+                seed: int = 0) -> History:
+    """Simulate adds of unique elements from ``[0, domain)`` interleaved
+    with full-set reads (the jepsen set-full workload): reads invoke with
+    ``None`` and OK-complete with the sorted membership; crashed adds may
+    or may not have taken effect (ghosts).  Linearizable by construction."""
+    rng = random.Random(seed)
+    s: set = set()
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending: dict = {}
+    ghost_effects: List[dict] = []
+    t = 0
+    invoked = 0
+    unadded = list(range(domain))
+    rng.shuffle(unadded)
+
+    while invoked < n_ops or pending:
+        t += rng.randint(1, 1000)
+        if ghost_effects and rng.random() < 0.3:
+            ge = ghost_effects.pop(rng.randrange(len(ghost_effects)))
+            s.add(ge["op"].value)
+        roll = rng.random()
+        if free and invoked < n_ops and (roll < 0.45 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            if rng.random() >= read_p and unadded:
+                op = Op(process=p, type=INVOKE, f="add",
+                        value=unadded.pop(), time=t)
+            else:
+                op = Op(process=p, type=INVOKE, f="read",
+                        value=None, time=t)
+            history.append(op)
+            pending[p] = {"op": op, "effected": False,
+                          "result_type": None, "result_value": None}
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            d = pending[p]
+            if rng.random() < crash_p:
+                history.append(Op(process=p, type=INFO, f=d["op"].f,
+                                  value=d["op"].value
+                                  if d["op"].f == "add" else None,
+                                  time=t, error="crashed"))
+                if (not d["effected"] and d["op"].f == "add"
+                        and rng.random() < 0.5):
+                    ghost_effects.append(d)
+                del pending[p]
+                free.append(p)
+            elif not d["effected"]:
+                op = d["op"]
+                if op.f == "add":
+                    s.add(op.value)
+                    d["result_value"] = op.value
+                else:
+                    d["result_value"] = sorted(s)
+                d["result_type"] = OK
+                d["effected"] = True
+            else:
+                history.append(Op(process=p, type=d["result_type"],
+                                  f=d["op"].f, value=d["result_value"],
+                                  time=t))
+                del pending[p]
+                free.append(p)
+
+    return History(history)
+
+
+def corrupt_set(history: History, mode: str = "phantom", n: int = 1,
+                seed: int = 0, domain: int = 62) -> History:
+    """Inject set anomalies with a known culprit:
+
+    - ``"phantom"``: an ok read observes an element that was never added;
+    - ``"lost"``: an ok read drops an element it should have observed
+      (corrupts non-empty reads; with concurrent adds in flight the drop
+      can be legal, so refutation tests generate with low concurrency).
+    """
+    rng = random.Random(seed)
+    ops = [o.with_() for o in history]
+    read_oks = [i for i, o in enumerate(ops)
+                if o.type == OK and o.f == "read"]
+    added = {o.value for o in ops if o.f == "add"}
+    if mode == "phantom":
+        if not read_oks:
+            raise ValueError("no ok reads to corrupt")
+        never = [e for e in range(domain) if e not in added]
+        if not never:
+            raise ValueError("domain exhausted; no phantom available")
+        for i in rng.sample(read_oks, min(n, len(read_oks))):
+            ops[i] = ops[i].with_(
+                value=sorted(set(ops[i].value) | {rng.choice(never)}))
+    elif mode == "lost":
+        full = [i for i in read_oks if ops[i].value]
+        if not full:
+            raise ValueError("no non-empty ok reads to corrupt")
+        for i in rng.sample(full, min(n, len(full))):
+            v = list(ops[i].value)
+            v.remove(rng.choice(v))
+            ops[i] = ops[i].with_(value=v)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return History(ops, reindex=True)
+
+
+# -- transactional workload (the opacity checker) ----------------------------
+
+def txn_history(n_txns: int = 60,
+                keys: int = 3,
+                values: int = 16,
+                max_txn_len: int = 4,
+                concurrency: int = 5,
+                abort_p: float = 0.15,
+                crash_p: float = 0.003,
+                seed: int = 0) -> History:
+    """Simulate transactions over a ``keys``-key register: each txn is a
+    random mix of ``["r", k, None]`` / ``["w", k, v]`` micro-ops applied
+    atomically at effect time (reads fill sequentially, seeing the txn's
+    own earlier writes).  With probability ``abort_p`` the txn aborts
+    AFTER its reads observed real state — its writes are discarded and it
+    FAIL-completes carrying the filled reads, exactly the shape the
+    opacity reduction consumes.  Crashes leave indeterminate (info)
+    txns.  Opaque by construction."""
+    rng = random.Random(seed)
+    state: dict = {}
+    history: List[Op] = []
+    free = list(range(concurrency))
+    pending: dict = {}
+    ghost_effects: List[dict] = []
+    t = 0
+    invoked = 0
+
+    def gen_txn():
+        mops = []
+        for _ in range(rng.randint(1, max_txn_len)):
+            k = rng.randrange(keys)
+            if rng.random() < 0.5:
+                mops.append(["r", k, None])
+            else:
+                mops.append(["w", k, rng.randrange(values)])
+        return mops
+
+    def apply_txn(mops, commit: bool):
+        view = dict(state)
+        filled = []
+        for ftag, k, v in mops:
+            if ftag == "r":
+                filled.append(["r", k, view.get(k)])
+            else:
+                view[k] = v
+                filled.append(["w", k, v])
+        if commit:
+            state.clear()
+            state.update(view)
+        return filled
+
+    while invoked < n_txns or pending:
+        t += rng.randint(1, 1000)
+        if ghost_effects and rng.random() < 0.3:
+            ge = ghost_effects.pop(rng.randrange(len(ghost_effects)))
+            apply_txn(ge["op"].value, commit=True)
+        roll = rng.random()
+        if free and invoked < n_txns and (roll < 0.45 or not pending):
+            p = free.pop(rng.randrange(len(free)))
+            op = Op(process=p, type=INVOKE, f="txn", value=gen_txn(),
+                    time=t)
+            history.append(op)
+            pending[p] = {"op": op, "effected": False,
+                          "result_type": None, "result_value": None}
+            invoked += 1
+        elif pending:
+            p = rng.choice(list(pending))
+            d = pending[p]
+            if rng.random() < crash_p:
+                history.append(Op(process=p, type=INFO, f="txn",
+                                  value=d["op"].value, time=t,
+                                  error="crashed"))
+                if not d["effected"] and rng.random() < 0.5:
+                    ghost_effects.append(d)
+                del pending[p]
+                free.append(p)
+            elif not d["effected"]:
+                commit = rng.random() >= abort_p
+                d["result_value"] = apply_txn(d["op"].value, commit)
+                d["result_type"] = OK if commit else FAIL
+                d["effected"] = True
+            else:
+                history.append(Op(process=p, type=d["result_type"],
+                                  f="txn", value=d["result_value"],
+                                  time=t,
+                                  error="aborted"
+                                  if d["result_type"] == FAIL else None))
+                del pending[p]
+                free.append(p)
+
+    return History(history)
+
+
+def corrupt_txn_reads(history: History, n: int = 1, seed: int = 0,
+                      target: str = "fail", values: int = 16) -> History:
+    """Flip one constraining (external, non-nil) read of ``n`` completed
+    txns to a different in-domain value.  ``target="fail"`` corrupts
+    aborted txns — the committed subhistory stays linearizable, so only
+    an *opacity* checker refutes (the reduction's distinguishing case);
+    ``target="ok"`` corrupts committed txns."""
+    rng = random.Random(seed)
+    ops = [o.with_() for o in history]
+    want = FAIL if target == "fail" else OK
+
+    def external_reads(mops):
+        written = set()
+        out = []
+        for idx, m in enumerate(mops):
+            if m[0] == "w":
+                written.add(m[1])
+            elif m[0] == "r" and m[2] is not None and m[1] not in written:
+                out.append(idx)
+        return out
+
+    cands = [i for i, o in enumerate(ops)
+             if o.type == want and o.f == "txn" and o.value
+             and external_reads(o.value)]
+    if not cands:
+        raise ValueError(f"no {target} txns with constraining reads")
+    for i in rng.sample(cands, min(n, len(cands))):
+        mops = [list(m) for m in ops[i].value]
+        j = rng.choice(external_reads(mops))
+        old = mops[j][2]
+        mops[j][2] = (old + 1 + rng.randrange(values - 1)) % values
+        ops[i] = ops[i].with_(value=mops)
+    return History(ops, reindex=True)
