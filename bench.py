@@ -29,6 +29,9 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
   elle      transactional-anomaly engine (elle_tpu) on a 96 x 200-op
             list-append batch, parity-checked lane-by-lane against the CPU
             elle oracle, with the same device-vs-socket comparison as batch
+  obs       observability toll: the same warmed serving campaign with the
+            flight recorder off vs on (budget: <2% overhead), plus nonzero
+            p50/p99 on the enqueue→dispatch / dispatch→verdict histograms
 
 **Isolation:** every tier runs in its own subprocess with its own timeout; a
 tier that crashes the TPU worker (or hangs) degrades to a per-tier
@@ -84,6 +87,7 @@ TIER_TIMEOUT_S = {
     "models": 300 if SMOKE else 900,
     "fleet": 300 if SMOKE else 900,
     "procfleet": 420 if SMOKE else 1200,
+    "obs": 300 if SMOKE else 900,
 }
 
 
@@ -824,6 +828,61 @@ def tier_procfleet():
           "worker_failures": snap["counters"].get("worker-failures", 0)})
 
 
+def tier_obs():
+    """Observability tier: what the flight recorder costs on a hot
+    serving path.  The same warmed campaign runs with the recorder off,
+    then on — the ratio is the toll the ISSUE budget caps at 2% — and
+    the latency histograms filled along the way must report nonzero
+    p50/p99 for the two headline lifecycle edges (enqueue→dispatch,
+    dispatch→verdict), or the instrument measured nothing."""
+    from jepsen_tpu.obs.recorder import RECORDER
+    from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.synth import cas_register_history
+    n = 24 if SMOKE else 96
+    reps = 2 if SMOKE else 3
+    hists = [cas_register_history(60, concurrency=4, seed=s)
+             for s in range(n)]
+
+    def run(svc):
+        t0 = time.time()
+        reqs = [svc.submit(h, kind="wgl", model="cas-register",
+                           deadline_s=120.0) for h in hists]
+        for r in reqs:
+            assert r.wait(timeout=300)["valid"] is True
+        return time.time() - t0
+
+    svc = CheckService(max_lanes=32, capacity=64)
+    run(svc)                                    # warm the bucket ladder
+    # min-of-reps on each side: overhead is a systematic cost, the
+    # best-case walls are the fairest pair to ratio.
+    RECORDER.disable()
+    t_off = min(run(svc) for _ in range(reps))
+    RECORDER.enable()
+    RECORDER.clear()
+    t_on = min(run(svc) for _ in range(reps))
+    rec = RECORDER.stats()
+    snap = svc.metrics.snapshot()
+    svc.close(timeout=60.0)
+
+    assert rec["recorded"] > 0, "recorder captured nothing while enabled"
+    edges = {}
+    for edge in ("edge:enqueue->dispatch", "edge:dispatch->verdict"):
+        h = snap["histograms"].get(edge) or {}
+        assert (h.get("p50") or 0) > 0 and (h.get("p99") or 0) > 0, \
+            f"histogram {edge} is empty/zero: the instrument measured nothing"
+        edges[edge] = {"count": h.get("count"),
+                       "p50_s": h.get("p50"), "p99_s": h.get("p99")}
+    overhead = (t_on / t_off - 1.0) if t_off else None
+    emit({"n_histories": n,
+          "recorder_off_s": round(t_off, 3),
+          "recorder_on_s": round(t_on, 3),
+          "recorder_overhead": (round(overhead, 4)
+                                if overhead is not None else None),
+          "events_recorded": rec["recorded"],
+          "events_buffered": rec["buffered"],
+          "edges": edges})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -841,6 +900,7 @@ TIER_FNS = {
     "models": tier_models,
     "fleet": tier_fleet,
     "procfleet": tier_procfleet,
+    "obs": tier_obs,
 }
 
 
@@ -920,7 +980,7 @@ def main():
     for name in ("easy", "cpu", "hard", "ceiling", "refuted", "batch",
                  "batch_sweep", "ablation_on", "ablation_off", "setup2",
                  "sched", "multireg", "elle", "models", "fleet",
-                 "procfleet"):
+                 "procfleet", "obs"):
         progress(f"tier {name} (budget {TIER_TIMEOUT_S[name]}s)")
         tiers[name] = run_tier(name)
         progress(f"tier {name}: {tiers[name].get('status')} "
@@ -1016,6 +1076,11 @@ def main():
                                "solo_s", "fleet_s", "fleet_overhead",
                                "kill_recovery_s", "rerouted", "hedges",
                                "worker_failures")},
+            "obs": {k: v for k, v in tiers["obs"].items()
+                    if k in ("status", "wall_s", "n_histories",
+                             "recorder_off_s", "recorder_on_s",
+                             "recorder_overhead", "events_recorded",
+                             "edges")},
             "batch_vs_cpu_socket": (tiers["batch"].get("shapes") or {}).get(
                 "512", {}),
             "batch_sweep": {

@@ -827,7 +827,11 @@ def _get_run_chunk(model: JaxModel, window: int, capacity: int,
                                        gwords=gwords)
     # No donation: the overflow-resume path re-uses the chunk-boundary
     # carry snapshot after the call, and the buffers are small anyway.
-    return _ENGINE_CACHE.put(key, (carry0, jax.jit(run_chunk)))
+    from jepsen_tpu.obs.hist import timed_first_call
+    run = timed_first_call(
+        jax.jit(run_chunk),
+        f"compile:singlev:{model.name}:w{window}:c{capacity}")
+    return _ENGINE_CACHE.put(key, (carry0, run))
 
 
 def events_array(p: PreparedHistory, chunk: int) -> np.ndarray:

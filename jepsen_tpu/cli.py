@@ -5,7 +5,10 @@ Parity: jepsen.cli (jepsen/src/jepsen/cli.clj): a shared option vocabulary
 cli.clj:64-168), a ``test`` subcommand built from a suite's test function
 (single-test-cmd, cli.clj:355), ``test-all`` sweeps (cli.clj:491), an
 ``analyze`` mode for re-checking stored histories (the store/REPL pattern),
-and ``serve`` for the results browser.
+and ``serve`` for the results browser.  Beyond the reference: ``submit``
+POSTs a stored history to a running serve, and ``trace`` fetches a
+request's merged distributed trace (optionally exporting Chrome
+trace-event JSON for ui.perfetto.dev).
 """
 
 from __future__ import annotations
@@ -156,6 +159,17 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
     pq.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline in seconds")
 
+    ptr = sub.add_parser("trace",
+                         help="fetch a request's merged distributed trace "
+                              "from a running serve")
+    ptr.add_argument("request_id", help="request id (serve.request-id in a "
+                                        "verdict, or X-Request-Id)")
+    ptr.add_argument("--url", default="http://127.0.0.1:8080",
+                     help="base URL of the running serve")
+    ptr.add_argument("--perfetto", metavar="PATH", default=None,
+                     help="also write the trace as Chrome trace-event JSON "
+                          "to PATH (load it at ui.perfetto.dev)")
+
     args = parser.parse_args(argv)
 
     if args.cmd == "test":
@@ -231,6 +245,9 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
     if args.cmd == "submit":
         return submit_cmd(args)
 
+    if args.cmd == "trace":
+        return trace_cmd(args)
+
     return 2
 
 
@@ -256,6 +273,28 @@ def submit_cmd(args) -> int:
         results = json.loads(resp.read())
     print(json.dumps(results, indent=2, default=str))
     return 0 if results.get("valid") is True else 1
+
+
+def trace_cmd(args) -> int:
+    """GET /trace/<request-id> from a running serve and print the merged
+    causal tree; ``--perfetto PATH`` additionally exports it as Chrome
+    trace-event JSON for ui.perfetto.dev."""
+    import urllib.error
+    import urllib.request
+    url = f"{args.url.rstrip('/')}/trace/{args.request_id}"
+    try:
+        with urllib.request.urlopen(url) as resp:
+            trace = json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        print(json.dumps({"error": f"HTTP {e.code}: {e.read().decode()}"}),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(trace, indent=2, default=str))
+    if args.perfetto:
+        from jepsen_tpu.obs.trace import chrome_events_from_trace, write_chrome
+        write_chrome(args.perfetto, chrome_events_from_trace(trace))
+        print(f"perfetto export: {args.perfetto}", file=sys.stderr)
+    return 0
 
 
 def test_all_cmd(tests_fn: Callable[[Dict[str, Any]], List[Dict[str, Any]]],

@@ -64,12 +64,17 @@ class EngineCache:
     def __len__(self):
         return len(self._d)
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, Any]:
         with self._lock:
+            tags: Dict[str, int] = {}
+            for key in self._d:
+                tag = key[0] if isinstance(key, tuple) and key else "?"
+                tags[str(tag)] = tags.get(str(tag), 0) + 1
             return {"size": len(self._d), "capacity": self.capacity,
                     "hits": self.hits, "misses": self.misses,
                     "evictions": self.evictions,
-                    "group_reuses": self.group_reuses}
+                    "group_reuses": self.group_reuses,
+                    "tags": tags}
 
 
 #: The shared engine cache: batch/single/megabatch runners all live here
@@ -77,7 +82,9 @@ class EngineCache:
 CACHE = EngineCache(int(os.environ.get("JEPSEN_TPU_ENGINE_CACHE", "32")))
 
 
-def engine_cache_stats() -> Dict[str, int]:
+def engine_cache_stats() -> Dict[str, Any]:
     """Hit/miss/eviction counters of the compiled-engine cache (a miss is
-    a fresh trace+compile — the serve metrics' recompile counter)."""
+    a fresh trace+compile — the serve metrics' recompile counter), plus
+    a per-tag resident count so the "singlev"/"batchv"/"megav" key
+    families are all visible on the metrics surface."""
     return CACHE.stats()

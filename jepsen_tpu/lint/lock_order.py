@@ -23,6 +23,10 @@ The declared order mirrors the call graph today:
     router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
     engine-cache (leaf: engine.cache's shared LRU, acquired under anything)
+    obs-hist, obs-recorder (leaves: the histogram set's and flight
+      recorder's own locks — observe/record is called from under
+      scheduler/fleet/metrics code, so these must never wrap another
+      declared lock)
 
 The transport chain follows a respawn end to end: the ProcFleet
 supervisor (``_sup_lock``) restarts a slot (``_restart_lock``), whose
@@ -78,6 +82,10 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
      [(r"monitor/tap\.py$", r"^self\._lock$")]),
     ("engine-cache",
      [(r"engine/cache\.py$", r"^self\._lock$")]),
+    ("obs-hist",
+     [(r"obs/hist\.py$", r"^self\._lock$")]),
+    ("obs-recorder",
+     [(r"obs/recorder\.py$", r"^self\._lock$")]),
 )
 
 

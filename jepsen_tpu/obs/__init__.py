@@ -1,0 +1,38 @@
+"""Observability: distributed tracing, histograms, and a flight recorder.
+
+The telescope the serving tier looks through.  Three instruments, all
+cheap enough to leave on in production and all exportable through the
+existing ``/metrics`` surface:
+
+- ``trace``    — trace-context ids minted at ``Request`` submit and
+                 propagated on every wire frame, plus the Chrome
+                 trace-event (Perfetto) conversion for merged traces.
+- ``hist``     — log-bucketed latency/compile-time histograms on the
+                 same pow2 ladder the serve shape buckets use, so the
+                 histogram buckets *are* the shape buckets.
+- ``recorder`` — a bounded process-wide ring of structured
+                 dispatch/compile/transfer/retry/chaos events with an
+                 atomic Chrome-trace export (``RECORDER``).
+
+Import discipline: nothing here imports ``jepsen_tpu.serve`` at module
+scope (serve's metrics layer imports us — the ladder reuse in ``hist``
+is a lazy import to keep the cycle open).
+"""
+
+from jepsen_tpu.obs.hist import (  # noqa: F401
+    Histogram, HistogramSet, compile_hist_stats, merge_hist_snapshots,
+    observe_compile, timed_first_call,
+)
+from jepsen_tpu.obs.recorder import RECORDER, FlightRecorder  # noqa: F401
+from jepsen_tpu.obs.trace import (  # noqa: F401
+    chrome_document, chrome_events_from_trace, new_span_id, new_trace_id,
+    wall_anchor, write_chrome,
+)
+
+__all__ = [
+    "Histogram", "HistogramSet", "compile_hist_stats",
+    "merge_hist_snapshots", "observe_compile", "timed_first_call",
+    "RECORDER", "FlightRecorder",
+    "chrome_document", "chrome_events_from_trace", "new_span_id",
+    "new_trace_id", "wall_anchor", "write_chrome",
+]

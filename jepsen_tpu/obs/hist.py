@@ -1,0 +1,169 @@
+"""Log-bucketed latency and compile-time histograms.
+
+Buckets ride the same pow2 ladder the serve shape buckets use
+(``serve.buckets.pow2_at_least``): an observation of ``s`` seconds
+lands in the bucket whose upper bound is the smallest power of two of
+microseconds >= ``s``.  That keeps the bucket universe bounded (a
+64-second tail is ~36 rungs from the 1 µs floor), makes histograms from
+different processes mergeable by plain bucket-wise addition (every
+process has the identical ladder), and means a compile-time histogram
+keyed by an engine-cache bucket key reports quantiles over exactly the
+shapes the compile cache distinguishes.
+
+Percentiles are cumulative-walk upper bounds: ``p99`` is the upper edge
+of the first bucket at or past the 99th percentile of the count mass —
+conservative (never under-reports) and exact enough at pow2 resolution
+for dashboard work.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+#: histogram floor: one microsecond
+_FLOOR_US = 1
+
+
+def _bucket_of(us: int) -> int:
+    # lazy import: serve.metrics imports this module, and serve's package
+    # __init__ imports metrics — a module-scope import here would close
+    # an import cycle through jepsen_tpu.serve
+    from jepsen_tpu.serve.buckets import pow2_at_least
+    return pow2_at_least(max(us, _FLOOR_US), _FLOOR_US)
+
+
+class Histogram:
+    """One unlocked log-bucketed histogram (callers hold the set lock)."""
+
+    __slots__ = ("buckets", "count", "sum_s")
+
+    def __init__(self) -> None:
+        self.buckets: Dict[int, int] = {}
+        self.count = 0
+        self.sum_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        us = int(seconds * 1e6)
+        b = _bucket_of(us)
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+        self.count += 1
+        self.sum_s += max(seconds, 0.0)
+
+    def merge_counts(self, buckets: Dict[int, int], count: int,
+                     sum_s: float) -> None:
+        for b, n in buckets.items():
+            self.buckets[b] = self.buckets.get(b, 0) + n
+        self.count += count
+        self.sum_s += sum_s
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket at the ``p``-th percentile, in
+        seconds (0.0 for an empty histogram)."""
+        if self.count <= 0:
+            return 0.0
+        target = p / 100.0 * self.count
+        seen = 0
+        for b in sorted(self.buckets):
+            seen += self.buckets[b]
+            if seen >= target:
+                return b / 1e6
+        return max(self.buckets) / 1e6  # pragma: no cover - defensive
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"count": self.count,
+                "sum-s": round(self.sum_s, 6),
+                "p50": self.percentile(50),
+                "p90": self.percentile(90),
+                "p99": self.percentile(99),
+                "buckets-us": {str(b): self.buckets[b]
+                               for b in sorted(self.buckets)}}
+
+
+class HistogramSet:
+    """A thread-safe named family of histograms (the unit Metrics and
+    the compile sites observe into, and the unit scrapes merge)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hists: Dict[str, Histogram] = {}
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(seconds)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {name: h.snapshot()
+                    for name, h in sorted(self._hists.items())}
+
+
+def merge_hist_snapshots(
+        snaps: Iterable[Optional[Dict[str, Dict[str, Any]]]],
+) -> Dict[str, Dict[str, Any]]:
+    """Bucket-wise merge of ``HistogramSet.snapshot()`` documents from
+    several processes into one fleet-wide document.  Identical ladders
+    make the merge exact; malformed entries are skipped (a scrape must
+    not fail because one worker was mid-crash)."""
+    merged: Dict[str, Histogram] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for name, s in snap.items():
+            if not isinstance(s, dict):
+                continue
+            try:
+                buckets = {int(b): int(n)
+                           for b, n in (s.get("buckets-us") or {}).items()}
+                count = int(s.get("count", 0))
+                sum_s = float(s.get("sum-s", 0.0))
+            except (TypeError, ValueError):
+                continue
+            h = merged.get(name)
+            if h is None:
+                h = merged[name] = Histogram()
+            h.merge_counts(buckets, count, sum_s)
+    return {name: h.snapshot() for name, h in sorted(merged.items())}
+
+
+#: process-wide compile/build histograms, one per engine-cache bucket
+#: key family — global like the engine cache itself, surfaced through
+#: every Metrics.snapshot() in the process
+COMPILES = HistogramSet()
+
+
+def observe_compile(name: str, seconds: float) -> None:
+    COMPILES.observe(name, seconds)
+
+
+def compile_hist_stats() -> Dict[str, Dict[str, Any]]:
+    return COMPILES.snapshot()
+
+
+def timed_first_call(fn, name: str):
+    """Wrap a jitted callable so its *first* invocation — the one that
+    pays XLA compilation — is timed into the compile histogram ``name``
+    and the flight recorder.  Later calls go straight through with one
+    list-lookup of overhead.  The build sites (wgl/batch/megabatch
+    cache misses) apply this to the callable they cache, so the
+    histogram measures real compile latency per cache bucket key, not
+    just host-side trace/wrap time."""
+    fired: List[bool] = []
+
+    def first_timed(*args, **kwargs):
+        if fired:
+            return fn(*args, **kwargs)
+        from jepsen_tpu.clock import mono_now
+        from jepsen_tpu.obs.recorder import RECORDER
+        t0 = mono_now()
+        out = fn(*args, **kwargs)
+        dt = mono_now() - t0
+        fired.append(True)
+        observe_compile(name, dt)
+        RECORDER.record("compile", name, dur_s=dt)
+        return out
+
+    return first_timed

@@ -1,0 +1,139 @@
+"""The flight recorder: a bounded process-wide ring of structured events.
+
+One ``FlightRecorder`` per process (``RECORDER``), recording
+dispatch / compile / transfer / retry / chaos events into a
+``deque(maxlen=...)`` ring.  Disabled by default: the off path is a
+single attribute check (``if not self.enabled: return``) so leaving the
+instrumentation compiled into the hot paths costs ~nothing, and the
+ring bound means the on path cannot grow memory under sustained load —
+old events fall off the back, ``recorded``/``buffered`` in ``stats()``
+tell you how much history survived.
+
+Events carry the local monotonic timestamp plus pid/tid and optional
+trace/span ids; export converts them to absolute microseconds using a
+wall anchor captured once at construction (the same re-anchoring
+discipline as request spans) and writes Chrome trace-event JSON through
+``atomic_io`` — the exported file is loadable in Perfetto as-is.
+
+Knobs: ``JEPSEN_TPU_FLIGHT_RECORDER`` (truthy enables at import),
+``JEPSEN_TPU_FLIGHT_EVENTS`` (ring capacity, default 4096).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.clock import mono_now
+from jepsen_tpu.obs.trace import chrome_document, wall_anchor
+
+#: the structured event categories the serving tier records
+CATEGORIES = ("dispatch", "compile", "transfer", "retry", "chaos")
+
+
+class FlightRecorder:
+    def __init__(self, capacity: Optional[int] = None,
+                 enabled: Optional[bool] = None) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get("JEPSEN_TPU_FLIGHT_EVENTS",
+                                          "4096"))
+        if enabled is None:
+            enabled = os.environ.get("JEPSEN_TPU_FLIGHT_RECORDER",
+                                     "") not in ("", "0")
+        self.capacity = max(int(capacity), 1)
+        self.enabled = bool(enabled)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._recorded = 0
+        # export anchor: relative monotonic timestamps re-anchor onto
+        # this one wall reading; never used for deadlines
+        self._anchor_unix = wall_anchor()
+        self._anchor_mono = mono_now()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def record(self, cat: str, name: str, *, dur_s: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               span_id: Optional[str] = None,
+               args: Optional[Dict[str, Any]] = None) -> None:
+        if not self.enabled:  # the ~0-cost off path
+            return
+        evt: Dict[str, Any] = {"ts": mono_now(), "pid": os.getpid(),
+                               "tid": threading.get_ident(),
+                               "cat": cat, "name": name}
+        if dur_s is not None:
+            evt["dur-s"] = dur_s
+        if trace_id is not None:
+            evt["trace-id"] = trace_id
+        if span_id is not None:
+            evt["span-id"] = span_id
+        if args:
+            evt["args"] = dict(args)
+        with self._lock:
+            self._ring.append(evt)
+            self._recorded += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._recorded = 0
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            buffered = len(self._ring)
+            recorded = self._recorded
+        return {"enabled": self.enabled, "capacity": self.capacity,
+                "recorded": recorded, "buffered": buffered,
+                "dropped": max(recorded - buffered, 0)}
+
+    # -- export ---------------------------------------------------------------
+
+    def _abs_us(self, ts_mono: float) -> float:
+        return (self._anchor_unix + (ts_mono - self._anchor_mono)) * 1e6
+
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        for evt in self.snapshot():
+            args = dict(evt.get("args") or {})
+            for k in ("trace-id", "span-id"):
+                if k in evt:
+                    args[k] = evt[k]
+            out: Dict[str, Any] = {
+                "name": evt["name"], "cat": evt["cat"],
+                "ts": round(self._abs_us(evt["ts"]), 3),
+                "pid": evt["pid"], "tid": evt["tid"], "args": args}
+            dur = evt.get("dur-s")
+            if dur is not None:
+                out["ph"] = "X"
+                out["dur"] = round(max(dur * 1e6, 1.0), 3)
+            else:
+                out["ph"] = "i"
+                out["s"] = "t"
+            events.append(out)
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Atomically write the ring as Chrome trace-event JSON.  The
+        ring is snapshotted under the lock; conversion and the write
+        happen outside it (no blocking I/O under a held lock)."""
+        import json
+
+        from jepsen_tpu.atomic_io import atomic_write
+        doc = chrome_document(self.chrome_events())
+        atomic_write(path,
+                     lambda f: json.dump(doc, f, separators=(",", ":")))
+        return path
+
+
+#: the process-wide recorder every instrumentation site writes to
+RECORDER = FlightRecorder()

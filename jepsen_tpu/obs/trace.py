@@ -1,0 +1,120 @@
+"""Trace context: ids, wall anchors, and the Chrome trace-event export.
+
+A trace is one causal tree per submitted request.  The root ``Request``
+mints a 16-hex ``trace-id`` and an 8-hex root ``span-id`` at submit;
+every hop (fleet -> wire client -> worker process) creates a child
+request that adopts the trace-id and records the sender's span-id as
+its ``parent-span-id``.  Spans themselves stay what they always were —
+relative monotonic seconds on the *local* clock (monotonic clocks do
+not cross process boundaries) — and each request additionally captures
+one wall-clock anchor (``anchor-unix-s``) at submit, used only to place
+its relative spans on an absolute axis at export time.  Deadline logic
+never sees the anchor.
+
+The Chrome trace-event conversion turns a merged trace payload (the
+root request's span list plus the ``remote`` payloads absorbed from
+worker-side requests) into a ``{"traceEvents": [...]}`` document that
+loads directly in Perfetto / ``chrome://tracing``: one duration ("X")
+event per lifecycle edge, grouped by the originating pid so a hedge
+that crossed processes renders as parallel tracks under one tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from jepsen_tpu.atomic_io import atomic_write
+
+#: wire field names for the propagated context (SUBMIT frames and the
+#: ``serve`` section of results)
+CTX_TRACE = "trace-id"
+CTX_PARENT = "parent-span-id"
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (64 random bits)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id (32 random bits)."""
+    return os.urandom(4).hex()
+
+
+def wall_anchor() -> float:
+    """One wall-clock reading, captured at submit and carried only in
+    trace payloads — never compared against deadlines or intervals."""
+    return time.time()  # lint: disable=CONC01(user-facing wall clock)
+
+
+def make_context(trace_id: str, parent_span_id: str) -> Dict[str, str]:
+    """The wire form of a trace context, as shipped on SUBMIT frames."""
+    return {CTX_TRACE: trace_id, CTX_PARENT: parent_span_id}
+
+
+def parse_context(ctx: Any) -> Dict[str, Optional[str]]:
+    """Tolerant read of a wire context: unknown/garbage fields degrade
+    to a fresh root rather than poisoning the receiver."""
+    if not isinstance(ctx, dict):
+        return {CTX_TRACE: None, CTX_PARENT: None}
+    tid = ctx.get(CTX_TRACE)
+    par = ctx.get(CTX_PARENT)
+    return {CTX_TRACE: tid if isinstance(tid, str) and tid else None,
+            CTX_PARENT: par if isinstance(par, str) and par else None}
+
+
+# -- Chrome trace-event conversion --------------------------------------------
+
+def _payload_events(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Duration events for one request payload's span list, placed on
+    the absolute axis by that payload's own wall anchor."""
+    anchor = payload.get("anchor-unix-s")
+    spans = payload.get("spans") or []
+    if anchor is None or not spans:
+        return []
+    pid = payload.get("pid", 0)
+    tid = payload.get("request-id", 0)
+    try:
+        tid = int(tid)
+    except (TypeError, ValueError):
+        tid = 0
+    args = {"trace-id": payload.get("trace-id"),
+            "span-id": payload.get("span-id"),
+            "parent-span-id": payload.get("parent-span-id"),
+            "request-id": payload.get("request-id")}
+    out: List[Dict[str, Any]] = []
+    ordered = sorted((s for s in spans if "t" in s and "span" in s),
+                     key=lambda s: s["t"])
+    for cur, nxt in zip(ordered, ordered[1:]):
+        ts_us = (anchor + cur["t"]) * 1e6
+        dur_us = max((nxt["t"] - cur["t"]) * 1e6, 1.0)
+        out.append({"name": f"{cur['span']}->{nxt['span']}",
+                    "cat": "request", "ph": "X",
+                    "ts": round(ts_us, 3), "dur": round(dur_us, 3),
+                    "pid": pid, "tid": tid, "args": args})
+    return out
+
+
+def chrome_events_from_trace(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """All duration events for a merged trace payload: the root
+    request's spans plus every absorbed ``remote`` worker payload."""
+    events = _payload_events(trace)
+    for remote in trace.get("remote") or []:
+        if isinstance(remote, dict):
+            events.extend(_payload_events(remote))
+    return events
+
+
+def chrome_document(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """The trace-event JSON object format Perfetto ingests."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+
+
+def write_chrome(path: str, events: Iterable[Dict[str, Any]]) -> str:
+    """Atomically write a trace-event document; returns the path."""
+    doc = chrome_document(events)
+    atomic_write(path, lambda f: json.dump(doc, f, separators=(",", ":")))
+    return path

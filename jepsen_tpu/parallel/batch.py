@@ -235,4 +235,8 @@ def _batched_runner(model: JaxModel, window: int, capacity: int,
     # donated; it is reused across every dispatch of the batch.
     vrun = jax.jit(jax.vmap(run_chunk, in_axes=(0, 0)),
                    donate_argnums=donate_carry_argnums())
+    from jepsen_tpu.obs.hist import timed_first_call
+    vrun = timed_first_call(
+        vrun, f"compile:batchv:{model.name}:w{window}:c{capacity}"
+              f":k{chunk}:b{bpad}")
     return _CACHE.put(key, (carry0, vrun))

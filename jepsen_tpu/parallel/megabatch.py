@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict, deque
 from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -160,9 +161,14 @@ def _read_summary(dev) -> np.ndarray:
 
 
 def _read_harvest(dev) -> np.ndarray:
+    from jepsen_tpu.obs.recorder import RECORDER
+    t0 = time.monotonic()
     with _allow_d2h():
         a = np.asarray(dev)
     _bump(harvests=1, harvest_ints=int(a.size))
+    RECORDER.record("transfer", "d2h:harvest",
+                    dur_s=time.monotonic() - t0,
+                    args={"ints": int(a.size)})
     return a
 
 
@@ -284,7 +290,11 @@ def _mega_runner(model: JaxModel, window: int, capacity: int, gwords: int,
         return jax.tree.map(sel, carry, c0b)
 
     donate = donate_carry_argnums()
-    step_j = jax.jit(step, donate_argnums=donate)
+    from jepsen_tpu.obs.hist import timed_first_call
+    step_j = timed_first_call(
+        jax.jit(step, donate_argnums=donate),
+        f"compile:megav:{model.name}:w{window}:c{capacity}"
+        f":k{chunk}:l{width}")
     harvest_j = jax.jit(harvest)
     reset_j = jax.jit(reset, donate_argnums=donate)
     return _CACHE.put(key, (carry0, step_j, harvest_j, reset_j))

@@ -15,12 +15,15 @@ Module map: ``request`` (requests/cells/trace spans), ``decompose``
 (per-key splitting), ``buckets`` (the shape ladder), ``scheduler`` (the
 continuous-batch device loop: priority queue, admission, backpressure,
 deadlines, host-tier degradation), ``aggregate`` (verdict merge),
-``metrics`` (counters/occupancy/traces for web.py's ``/metrics``),
-``service`` (the CheckService facade + core.analyze routing),
-``router`` (rendezvous hashing + per-worker circuit breakers/health),
-``fleet`` (the fault-tolerant multi-worker tier: N worker services,
-retry/hedge, crash journal), ``chaos`` (the fleet's self-nemesis).  See
-docs/serving.md and docs/robustness.md.
+``metrics`` (counters/gauges/histograms/traces for web.py's
+``/metrics``, backed by the jepsen_tpu.obs instruments: distributed
+trace contexts, pow2-ladder latency histograms, the process flight
+recorder), ``service`` (the CheckService facade + core.analyze
+routing), ``router`` (rendezvous hashing + per-worker circuit
+breakers/health), ``fleet`` (the fault-tolerant multi-worker tier: N
+worker services, retry/hedge, crash journal, the fleet-wide metrics
+scrape and ``merged_trace``), ``chaos`` (the fleet's self-nemesis).
+See docs/serving.md, docs/robustness.md and docs/observability.md.
 
 ``Fleet`` is imported lazily (``from jepsen_tpu.serve.fleet import
 Fleet``) to keep the plain single-service import path light.

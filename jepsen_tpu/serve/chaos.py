@@ -64,6 +64,7 @@ import time
 from typing import Any, Dict, Optional
 
 from jepsen_tpu.nemesis.registry import FaultRegistry
+from jepsen_tpu.obs.recorder import RECORDER
 
 
 def _unpatch(obj: Any, name: str) -> None:
@@ -122,6 +123,8 @@ class ChaosNemesis:
         self.registry.register(key, undo, description)
         self.injected[key] = description
         self._undos[key] = undo
+        RECORDER.record("chaos", f"inject:{key}",
+                        args={"description": description})
         return key
 
     def heal(self, key: str) -> bool:
@@ -131,6 +134,7 @@ class ChaosNemesis:
         if undo is None or not self.registry.resolve(key):
             return False
         undo()
+        RECORDER.record("chaos", f"heal:{key}")
         return True
 
     def heal_all(self) -> Dict[str, str]:

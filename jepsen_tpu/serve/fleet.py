@@ -46,6 +46,8 @@ from jepsen_tpu import atomic_io
 from jepsen_tpu.control.retry import RetryPolicy
 from jepsen_tpu.net_proxy import PairProxy
 from jepsen_tpu.history import History, Op
+from jepsen_tpu.obs.hist import merge_hist_snapshots
+from jepsen_tpu.obs.recorder import RECORDER
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.decompose import decompose
@@ -342,9 +344,16 @@ class FleetJournal:
 
 
 class _FleetMetrics(Metrics):
-    """The fleet's Metrics registry plus a ``fleet`` snapshot section
-    (per-worker status/circuits/journal) — web.py's ``/metrics`` payload
-    keeps one schema whether a CheckService or a Fleet is attached."""
+    """The fleet's Metrics registry plus the fleet-wide scrape: a
+    ``fleet`` snapshot section (per-worker status/circuits/journal), a
+    ``workers`` section holding each worker's own ``Metrics.snapshot()``
+    (fetched over the STATUS frame for out-of-process workers,
+    best-effort — a partitioned worker scrapes as ``unreachable``, it
+    never fails the document), and a ``histograms`` section that merges
+    the fleet's own histograms with every reachable worker's, bucket by
+    bucket (the pow2 ladders are identical in every process) — web.py's
+    ``/metrics`` payload keeps one schema whether a CheckService, a
+    Fleet, or a ProcFleet is attached."""
 
     def __init__(self, fleet: "Fleet"):
         super().__init__()
@@ -353,6 +362,21 @@ class _FleetMetrics(Metrics):
     def snapshot(self) -> Dict[str, Any]:
         snap = super().snapshot()
         snap["fleet"] = self._fleet.fleet_status()
+        worker_snaps = self._fleet.worker_snapshots()
+        snap["histograms"] = merge_hist_snapshots(
+            [snap.get("histograms")]
+            + [(w or {}).get("histograms") for w in worker_snaps])
+        workers = []
+        for i, w in enumerate(worker_snaps):
+            if w is None:
+                workers.append({"worker": i, "unreachable": True})
+                continue
+            # traces stay fleet-side (the merged tree already absorbed
+            # the worker spans); per-worker entries keep the numbers
+            entry = {k: v for k, v in w.items()
+                     if k not in ("traces", "fleet", "workers")}
+            workers.append({"worker": i, **entry})
+        snap["workers"] = workers
         return snap
 
 
@@ -450,17 +474,21 @@ class Fleet:
                deadline_s: Optional[float] = None,
                block: bool = True,
                timeout: Optional[float] = None,
+               trace: Optional[Dict[str, Any]] = None,
                **kw) -> Request:
         """Enqueue one history check across the fleet; same contract as
         CheckService.submit, including the admission-race rule: a request
         whose deadline expires while blocked on fleet backpressure
-        resolves ``unknown`` — never dropped, never false."""
+        resolves ``unknown`` — never dropped, never false.  ``trace``
+        rides beside the spec (never inside it — reroute and journal
+        recovery round-trip the spec through build_spec)."""
         if self._closed:
             raise ServiceClosed("fleet is closed")
         spec = build_spec(kind, **kw)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        req = Request(history, kind, spec, deadline_s=deadline_s)
+        req = Request(history, kind, spec, deadline_s=deadline_s,
+                      trace=trace)
         cells = decompose(req)
         for c in cells:
             c.cid = f"{req.id}.{next(self._cids)}"
@@ -592,6 +620,11 @@ class Fleet:
                 excluded = set(exclude)
             if attempt + 1 < tries:
                 self.metrics.inc("cells-rerouted")
+                RECORDER.record(
+                    "retry", f"reroute:{cell.cid}", trace_id=req.trace_id,
+                    span_id=req.span_id,
+                    args={"attempt": attempt + 1, "worker": offender.wid,
+                          "error": (failure or "")[:160]})
                 prev_delay = policy.delay(attempt, prev=prev_delay)
                 self._sleep_bounded(prev_delay, req)
         if req.expired():
@@ -619,6 +652,7 @@ class Fleet:
         try:
             wreq = worker.service.submit(cell.history, block=False,
                                          deadline_s=req.remaining_s(),
+                                         trace=req.trace_context(),
                                          **submit_kwargs(req))
         except (ServiceClosed, ServiceSaturated) as e:
             return None, f"{type(e).__name__}: {e}", worker
@@ -633,11 +667,17 @@ class Fleet:
         cap = NO_DEADLINE_WAIT_S if cap is None else cap
         while True:
             if wreq.done():
+                # a completed hedge loser still contributed spans — keep
+                # them in the tree before abandoning the handle
+                if hreq is not None and hreq.done():
+                    req.absorb_serve(hreq.result)
                 res, failure = self._classify(dict(wreq.result or {}), req)
                 return res, failure, worker
             if hreq is not None and hreq.done():
                 res, failure = self._classify(dict(hreq.result or {}), req)
                 if failure:
+                    req.absorb_serve(hreq.result)  # keep the failed
+                    # sibling's spans — the trace shows the attempt
                     # The hedge landed on a broken sibling: penalize IT,
                     # drop the hedge, keep waiting on the primary (whose
                     # attempt is still live and may well succeed).
@@ -672,8 +712,14 @@ class Fleet:
                         hreq = hedge_worker.service.submit(
                             cell.history, block=False,
                             deadline_s=req.remaining_s(),
+                            trace=req.trace_context(),
                             **submit_kwargs(req))
                         self.metrics.inc("hedges")
+                        RECORDER.record(
+                            "retry", f"hedge:{cell.cid}",
+                            trace_id=req.trace_id, span_id=req.span_id,
+                            args={"primary": worker.wid,
+                                  "hedge": hedge_worker.wid})
                     except Exception:  # noqa: BLE001 — sibling saturated
                         hreq = None
                         hedge_worker = None
@@ -719,6 +765,9 @@ class Fleet:
         cell.result = result
         self.metrics.inc("cells-completed")
         req = cell.request
+        # fold the winning attempt's worker-side spans into the root's
+        # tree before aggregation buries them under per-key results
+        req.absorb_serve(result)
         if req.claim_finish():
             req.finish(aggregate(req))
             self.metrics.inc("requests-completed")
@@ -764,6 +813,36 @@ class Fleet:
                                      if self._journal else None)},
                 "circuits": {w.wid: dict(w.breaker.transitions)
                              for w in self.workers}}
+
+    def worker_snapshots(self) -> List[Optional[Dict[str, Any]]]:
+        """Scrape every worker's ``Metrics.snapshot()`` — for in-process
+        workers straight off the service, for proc workers over the
+        STATUS frame (``metrics_snapshot``).  Best-effort per worker: a
+        partitioned or dead worker contributes ``None``, never an
+        exception — one bad link must not fail the fleet's /metrics
+        document."""
+        out: List[Optional[Dict[str, Any]]] = []
+        for w in self.workers:
+            snap: Optional[Dict[str, Any]] = None
+            try:
+                svc = w.service
+                ms = getattr(svc, "metrics_snapshot", None)
+                if ms is not None:          # ProcWorkerService: over STATUS
+                    snap = ms()
+                else:
+                    m = getattr(svc, "metrics", None)
+                    if m is not None:
+                        snap = m.snapshot()
+            except Exception:  # noqa: BLE001 — a scrape never fails the doc
+                snap = None
+            out.append(snap)
+        return out
+
+    def merged_trace(self, request_id: str) -> Optional[Dict[str, Any]]:
+        """The fully-assembled causal tree for a finished request: root
+        spans from this fleet process plus every worker/hedge subtree
+        absorbed off RESULT frames (see Request.absorb_serve)."""
+        return self.metrics.find_trace(request_id)
 
     def healthz(self, deep: bool = False) -> Dict[str, Any]:
         """The load-balancer/chaos probe payload (web.py GET /healthz):

@@ -1,24 +1,41 @@
-"""Service observability: counters, gauges, occupancy, and trace export.
+"""Service observability: counters, gauges, histograms, and trace export.
 
 One thread-safe registry per service.  Everything lands in one
 ``snapshot()`` dict — the payload of web.py's ``/metrics`` endpoint and
 the body of the queue-status page — so there is exactly one schema to
-document (docs/serving.md) and assert on in the smoke test:
+document (docs/observability.md) and assert on in the tests:
 
 - counters: requests/cells through each lifecycle edge, deadline
   expiries, admission rejections, dispatches, host fallbacks;
 - gauges: queue depth and in-flight requests, sampled live;
 - occupancy: used vs padded lanes per dispatch, summed — the price of
   shape bucketing, as a ratio;
-- engine-cache: hit/miss/eviction counters of the bounded compiled-
-  engine LRU (parallel.batch) — a miss is a recompile, a group_reuse is
-  the same executable serving another dispatch group of one batch;
+- histograms: log-bucketed (pow2 ladder, jepsen_tpu.obs.hist) latency
+  quantiles per lifecycle edge (``edge:enqueue->dispatch``,
+  ``edge:dispatch->verdict``, adjacent pairs, ``dispatch``) merged with
+  the process-wide compile-time histograms (``compile:<cache tag>...``);
+- engine-cache: hit/miss/eviction counters of the shared bounded
+  compiled-engine LRU (jepsen_tpu.engine.cache) — one cache for the
+  "singlev"/"batchv"/"megav" key families, with a per-tag entry count
+  so all three show up in ``/metrics``;
 - megabatch: the throughput path's staging/refill/readback counters
-  (parallel.megabatch) — dispatches vs summary ints proves the O(1)
-  per-dispatch readback, refills/lanes_refilled measure continuous
-  lane occupancy;
-- traces: the last few completed requests' span lists (enqueue -> pack
-  -> dispatch -> verdict, relative seconds).
+  (parallel.megabatch);
+- flight-recorder: the process ring's enabled/recorded/buffered stats;
+- traces: the last few completed requests' merged trace payloads
+  (trace/span ids, wall anchor, spans, absorbed remote payloads).
+
+Snapshot consistency: counters, occupancy, histograms, and traces are
+each captured under their own lock, but the ``gauges`` section samples
+the live ``_depth_fn``/``_inflight_fn`` callbacks *after* the counter
+capture and *outside* this lock — deliberately.  The callbacks walk
+scheduler/fleet state behind locks far earlier in the declared lock
+order (lint/lock_order.py puts ``metrics`` at the leaf of the serve
+chain), so sampling them under the metrics lock would be an inversion.
+The cost is a documented tear: a snapshot's gauges can reflect a
+slightly later instant than its counters (e.g. ``inflight-requests``
+may exceed ``submitted - completed`` computed from the same snapshot).
+Dashboards must treat gauges as point samples, not as derivable from
+the counters; tests/test_serve.py pins this contract.
 """
 
 from __future__ import annotations
@@ -31,6 +48,7 @@ from typing import Any, Dict, List, Optional
 # without importing serve); re-exported here because every serve/ and
 # monitor/ module already imports it from metrics.
 from jepsen_tpu.clock import mono_now  # noqa: F401
+from jepsen_tpu.obs.hist import HistogramSet, compile_hist_stats
 
 
 class Metrics:
@@ -48,6 +66,7 @@ class Metrics:
         self._traces: deque = deque(maxlen=trace_capacity)
         self._depth_fn = None       # live queue-depth callback
         self._inflight_fn = None
+        self.hists = HistogramSet()  # own lock; observed outside ours
 
     def bind(self, depth_fn, inflight_fn) -> None:
         self._depth_fn = depth_fn
@@ -64,16 +83,47 @@ class Metrics:
             self._lanes_used += lanes_used
             self._lanes_padded += lanes_padded
             self._dispatch_s += seconds
+        self.hists.observe("dispatch", seconds)
 
     def trace(self, request) -> None:
+        payload = request.trace_payload()
+        payload["kind"] = request.kind
+        payload["valid"] = (request.result or {}).get("valid")
         with self._lock:
-            self._traces.append({"request-id": request.id,
-                                 "kind": request.kind,
-                                 "valid": (request.result or {}).get("valid"),
-                                 "spans": list(request.spans)})
+            self._traces.append(payload)
+        self._observe_edges(request.spans)
+
+    def _observe_edges(self, spans: List[Dict[str, Any]]) -> None:
+        """Latency histograms per lifecycle edge: each adjacent span
+        pair, plus the two headline edges (queueing+packing delay and
+        device-to-verdict time)."""
+        times: Dict[str, float] = {}
+        prev = None
+        for s in spans:
+            name, t = s.get("span"), s.get("t")
+            if name is None or t is None:
+                continue
+            times.setdefault(name, t)   # first occurrence wins
+            if prev is not None and t >= prev[1]:
+                self.hists.observe(f"edge:{prev[0]}->{name}", t - prev[1])
+            prev = (name, t)
+        for a, b in (("enqueue", "dispatch"), ("dispatch", "verdict")):
+            if a in times and b in times and times[b] >= times[a]:
+                self.hists.observe(f"edge:{a}->{b}", times[b] - times[a])
+
+    def find_trace(self, request_id) -> Optional[Dict[str, Any]]:
+        """The merged trace payload for a completed request still in the
+        ring, or None (evicted / never seen)."""
+        rid = str(request_id)
+        with self._lock:
+            for t in reversed(self._traces):
+                if str(t.get("request-id")) == rid:
+                    return dict(t)
+        return None
 
     def snapshot(self) -> Dict[str, Any]:
-        from jepsen_tpu.parallel.batch import engine_cache_stats
+        from jepsen_tpu.engine.cache import engine_cache_stats
+        from jepsen_tpu.obs.recorder import RECORDER
         from jepsen_tpu.parallel.megabatch import megabatch_stats
         with self._lock:
             counters = dict(self._counters)
@@ -81,6 +131,10 @@ class Metrics:
             dispatch_s = self._dispatch_s
             traces = list(self._traces)
         cache = engine_cache_stats()
+        # gauges sample live state here — after counter capture, outside
+        # our lock (the callbacks take scheduler/fleet locks that must
+        # not nest inside the metrics leaf); see the module docstring
+        # for the resulting tear contract
         return {
             "counters": counters,
             "gauges": {
@@ -94,7 +148,9 @@ class Metrics:
                 "ratio": round(used / padded, 4) if padded else None,
                 "dispatch-seconds": round(dispatch_s, 6),
             },
+            "histograms": {**self.hists.snapshot(), **compile_hist_stats()},
             "engine-cache": {**cache, "recompiles": cache["misses"]},
             "megabatch": megabatch_stats(),
+            "flight-recorder": RECORDER.stats(),
             "traces": traces,
         }

@@ -10,16 +10,30 @@ Every request carries a trace: monotonic spans from ``enqueue`` through
 ``pack``/``dispatch`` to ``verdict``, exported via the metrics endpoint
 so queueing delay, packing delay, and device time are separable without
 a profiler.
+
+Distributed tracing rides on top (jepsen_tpu.obs.trace): the root
+request mints a ``trace-id`` and root ``span-id`` at submit; a child
+request created on another hop (wire client, worker process) adopts the
+trace-id from the propagated context and records the sender's span-id
+as its ``parent-span-id``.  Span times stay relative to the *local*
+monotonic clock — each request also captures one wall anchor
+(``anchor-unix-s``) at submit so export can place spans from different
+processes on a shared absolute axis; the anchor never feeds deadline
+logic.  Completed child payloads are absorbed into the parent's
+``remote`` list, so the root's exported payload is the whole causal
+tree.
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.history import History
+from jepsen_tpu.obs import trace as obs_trace
 from jepsen_tpu.serve.metrics import mono_now
 
 _ids = itertools.count(1)
@@ -34,7 +48,8 @@ class Request:
     """One submitted history check, decomposed into cells by the service."""
 
     def __init__(self, history: History, kind: str, spec: Dict[str, Any],
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace: Optional[Dict[str, Any]] = None):
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}; known: {KINDS}")
         self.id = next(_ids)
@@ -50,6 +65,15 @@ class Request:
         self._done = threading.Event()
         self._lock = threading.Lock()
         self._finishing = False
+        # trace context: adopt a propagated context (child request on a
+        # new hop) or mint a fresh root; the wall anchor is captured
+        # once here and used only for export alignment
+        ctx = obs_trace.parse_context(trace)
+        self.trace_id = ctx[obs_trace.CTX_TRACE] or obs_trace.new_trace_id()
+        self.parent_span_id = ctx[obs_trace.CTX_PARENT]
+        self.span_id = obs_trace.new_span_id()
+        self.anchor_unix_s = round(obs_trace.wall_anchor(), 6)
+        self._remote: List[Dict[str, Any]] = []
         self.span("enqueue")
 
     # -- trace ------------------------------------------------------------
@@ -57,6 +81,53 @@ class Request:
         """Record a trace span (relative seconds since submit)."""
         self.spans.append({"span": name,
                            "t": round(mono_now() - self.submitted, 6)})
+
+    def trace_context(self) -> Dict[str, str]:
+        """The context to propagate on a child submit: same trace, this
+        request's span as the parent."""
+        return obs_trace.make_context(self.trace_id, self.span_id)
+
+    def absorb_serve(self, result: Optional[Dict[str, Any]]) -> None:
+        """Pull a child result's serve payload (and the remotes it
+        already absorbed) into this request's remote-span list, so the
+        causal tree survives aggregation and wire hops.  Payloads from
+        a different trace (a dedup hit on a recycled worker cache) are
+        dropped rather than grafted onto the wrong tree.  Idempotent by
+        span-id: a payload absorbed once per attempt and again when the
+        aggregated result flows through ``finish`` lands once."""
+        serve = (result or {}).get("serve")
+        if not isinstance(serve, dict):
+            return
+        entries: List[Dict[str, Any]] = []
+        for r in serve.get("remote") or []:
+            if isinstance(r, dict) and r.get("trace-id") == self.trace_id:
+                entries.append(r)
+        if serve.get("trace-id") == self.trace_id \
+                and serve.get("span-id") != self.span_id:
+            entries.append({k: serve.get(k) for k in
+                            ("request-id", "trace-id", "span-id",
+                             "parent-span-id", "anchor-unix-s", "pid",
+                             "spans")})
+        if not entries:
+            return
+        with self._lock:
+            seen = {r.get("span-id") for r in self._remote}
+            seen.add(self.span_id)
+            for e in entries:
+                if e.get("span-id") not in seen:
+                    seen.add(e.get("span-id"))
+                    self._remote.append(e)
+
+    def trace_payload(self) -> Dict[str, Any]:
+        """The exported trace for this request: its own identity and
+        spans plus every absorbed child payload."""
+        with self._lock:
+            remote = list(self._remote)
+        return {"request-id": self.id, "trace-id": self.trace_id,
+                "span-id": self.span_id,
+                "parent-span-id": self.parent_span_id,
+                "anchor-unix-s": self.anchor_unix_s, "pid": os.getpid(),
+                "spans": list(self.spans), "remote": remote}
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline is None:
@@ -86,10 +157,13 @@ class Request:
 
     def finish(self, result: Dict[str, Any]) -> None:
         self.span("verdict")
+        # a delivered result may already carry a serve payload (the
+        # worker-side request's, arriving over the wire) — absorb it
+        # into this request's tree before stamping our own
+        self.absorb_serve(result)
         result.setdefault("serve", {})
-        result["serve"].update({"request-id": self.id,
-                                "cells": len(self.cells),
-                                "spans": list(self.spans)})
+        result["serve"].update({"cells": len(self.cells),
+                                **self.trace_payload()})
         self.result = result
         self._done.set()
 

@@ -32,6 +32,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from jepsen_tpu.obs.recorder import RECORDER
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.metrics import mono_now
@@ -326,7 +327,14 @@ class Scheduler:
                         "for %d cell(s)", type(e).__name__, e, len(live))
             self.metrics.inc("host-fallbacks", len(live))
             rs = self._host_fallback(live, e)
-        self.metrics.dispatch(len(live), pad, mono_now() - t0)
+        dt = mono_now() - t0
+        self.metrics.dispatch(len(live), pad, dt)
+        RECORDER.record(
+            "dispatch", f"batch:{kind}:x{len(live)}",
+            dur_s=dt,
+            trace_id=live[0].request.trace_id,
+            span_id=live[0].request.span_id,
+            args={"lanes": len(live), "pad": pad, "mega": mega})
         for c, r in zip(live, rs):
             self._finalize(c, r)
 

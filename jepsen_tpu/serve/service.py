@@ -135,10 +135,17 @@ class CheckService:
                deadline_s: Optional[float] = None,
                block: bool = True,
                timeout: Optional[float] = None,
+               trace: Optional[Dict[str, Any]] = None,
                **engine_opts) -> Request:
         """Enqueue one history check; returns a :class:`Request` handle
         (``.wait()`` for the verdict).  ``block=False`` raises
         :class:`ServiceSaturated` instead of waiting out backpressure.
+
+        ``trace`` is a propagated trace context (obs.trace wire dict)
+        from an upstream hop — the fleet's root request, a remote
+        client.  It rides beside the spec (never inside it, so reroute/
+        journal round-trips through build_spec don't see it) and makes
+        this request a child span of the sender's.
 
         A request whose deadline expires *while blocked on admission*
         resolves ``unknown`` (the returned handle is already done) rather
@@ -154,7 +161,8 @@ class CheckService:
                           engine=engine, **engine_opts)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        req = Request(history, kind, spec, deadline_s=deadline_s)
+        req = Request(history, kind, spec, deadline_s=deadline_s,
+                      trace=trace)
         cells = decompose(req)
         # A blocked offer never outlives the deadline: the expiring
         # request must surface unknown, not sit in admission forever.
@@ -264,6 +272,12 @@ class CheckService:
             render.write_artifacts(test, res, opts)
             return res
         return None
+
+    def merged_trace(self, request_id) -> Optional[Dict[str, Any]]:
+        """The merged trace payload of a completed request (``GET
+        /trace/<request-id>`` and ``cli trace`` read this); None when
+        the id is unknown or already evicted from the trace ring."""
+        return self.metrics.find_trace(request_id)
 
     # -- lifecycle --------------------------------------------------------
     def queue_depth(self) -> int:

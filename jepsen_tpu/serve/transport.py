@@ -50,6 +50,13 @@ Protocol invariants (the same discipline the rest of serve/ carries):
   off under a control/retry.py :class:`RetryPolicy` with decorrelated
   jitter, so a healed partition is not greeted by every client's
   retries arriving in lockstep.
+- **trace context propagates** — a SUBMIT carries a ``trace`` dict
+  (obs.trace wire fields: trace-id + parent-span-id); the worker's
+  request adopts it and re-anchors span times on its own monotonic
+  clock, the ACK echoes it, the RESULT's serve payload carries the
+  worker-side spans back, and RPC REPLYs echo any ``trace`` on the
+  request frame — so a hedge→reroute across processes assembles into
+  one causal tree (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -187,8 +194,10 @@ class RemoteCall:
     double-finish, which is the idempotency half of the wire contract."""
 
     def __init__(self, history: History, kind: str, spec: Dict[str, Any],
-                 deadline_s: Optional[float] = None):
-        self.request = Request(history, kind, spec, deadline_s=deadline_s)
+                 deadline_s: Optional[float] = None,
+                 trace: Optional[Dict[str, Any]] = None):
+        self.request = Request(history, kind, spec, deadline_s=deadline_s,
+                               trace=trace)
         self.request.cells = [Cell(self.request, history)]
 
     def deliver(self, result: Dict[str, Any]) -> bool:
@@ -533,20 +542,29 @@ class ProcWorkerService:
                deadline_s: Optional[float] = None,
                block: bool = True,
                timeout: Optional[float] = None,
+               trace: Optional[Dict[str, Any]] = None,
                **spec) -> RemoteCall:
         """Ship one cell-check over the wire; returns a request-shaped
         handle.  ``block``/``timeout`` are accepted for facade parity —
         remote backpressure surfaces as a worker-side ServiceSaturated
         ERROR frame either way, which the fleet treats exactly like a
-        local saturated worker."""
+        local saturated worker.
+
+        ``trace`` is a propagated trace context: the client-side handle
+        adopts it (child of the sender's span) and the SUBMIT frame
+        ships the handle's own context, so the worker-side request
+        parents to this hop — the tree stays connected across the
+        wire."""
         if self._closed:
             raise ServiceClosed(f"{self.name} is closed")
         client = self._wire()
         spec_l = lite_spec(spec)
-        call = RemoteCall(history, kind, spec_l, deadline_s=deadline_s)
+        call = RemoteCall(history, kind, spec_l, deadline_s=deadline_s,
+                          trace=trace)
         cid = f"{self.name}.{next(_submit_ids)}.{call.request.id}"
         frame = {"type": F_SUBMIT, "id": cid, "kind": kind,
                  "spec": spec_l, "deadline-rem-s": deadline_s,
+                 "trace": call.request.trace_context(),
                  "ops": [op.to_dict() for op in history]}
         client.submit(cid, frame, call, deadline_s=deadline_s)
         return call
@@ -572,6 +590,22 @@ class ProcWorkerService:
             return {"alive": self.launcher.alive(), "reachable": False,
                     "queue-depth": None, "inflight-cells": None,
                     "error": f"{type(e).__name__}: {e}"}
+
+    def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
+        """The remote worker's full ``Metrics.snapshot()`` over the
+        STATUS frame (``metrics: true`` flag) — the fleet-wide scrape
+        reads this to merge per-worker counters and histograms into one
+        ``/metrics`` document.  None when the worker is unreachable (a
+        scrape never fails because one worker was partitioned)."""
+        if not self.launcher.alive():
+            return None
+        try:
+            payload = self._wire().call(F_STATUS, {"metrics": True},
+                                        timeout_s=self.rpc_timeout_s)
+        except Exception:  # noqa: BLE001 — unreachable ≠ dead
+            return None
+        snap = (payload or {}).get("metrics")
+        return snap if isinstance(snap, dict) else None
 
     def healthz(self) -> Dict[str, Any]:
         """The remote worker's own healthz, for deep fleet aggregation."""

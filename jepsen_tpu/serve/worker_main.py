@@ -179,7 +179,7 @@ class WorkerServer:
             if ftype == F_SUBMIT:
                 self._handle_submit(conn, frame)
             elif ftype == F_STATUS:
-                self._reply(conn, frame, self._status_payload())
+                self._reply(conn, frame, self._status_payload(frame))
             elif ftype == F_HEALTHZ:
                 self._reply(conn, frame, self.service.healthz())
             elif ftype == F_DRAIN:
@@ -206,18 +206,19 @@ class WorkerServer:
                 # duplicate of a running SUBMIT (client re-sent across a
                 # reconnect): re-attach its RESULT to this connection
                 self._conn_for[cid] = conn
+        trace = frame.get("trace")
         if cached is not None:
             # duplicate of a FINISHED submit: ack + re-deliver the cached
             # verdict — the client's claim_finish makes a true duplicate
             # delivery a no-op, so resending is always safe
-            conn.send({"type": F_ACK, "id": cid, "dup": True},
-                      self.max_frame)
-            conn.send({"type": F_RESULT, "id": cid, "result": cached},
-                      self.max_frame)
+            conn.send({"type": F_ACK, "id": cid, "dup": True,
+                       "trace": trace}, self.max_frame)
+            conn.send({"type": F_RESULT, "id": cid, "result": cached,
+                       "trace": trace}, self.max_frame)
             return
         if live is not None:
-            conn.send({"type": F_ACK, "id": cid, "dup": True},
-                      self.max_frame)
+            conn.send({"type": F_ACK, "id": cid, "dup": True,
+                       "trace": trace}, self.max_frame)
             return
         kind = frame.get("kind") or "wgl"
         rem = frame.get("deadline-rem-s")
@@ -227,16 +228,21 @@ class WorkerServer:
             # re-anchored here, never a wall clock comparison
             res = expired_result(kind)
             self._remember(cid, res)
-            conn.send({"type": F_ACK, "id": cid}, self.max_frame)
-            conn.send({"type": F_RESULT, "id": cid, "result": res},
+            conn.send({"type": F_ACK, "id": cid, "trace": trace},
                       self.max_frame)
+            conn.send({"type": F_RESULT, "id": cid, "result": res,
+                       "trace": trace}, self.max_frame)
             return
         history = History(frame.get("ops") or [])
         spec = dict(frame.get("spec") or {})
         try:
+            # the propagated trace context makes the worker-side request
+            # a child span of the sender's; span times re-anchor on THIS
+            # process's monotonic clock at submit
             req = self.service.submit(
                 history, kind=kind, block=False,
-                deadline_s=float(rem) if rem is not None else None, **spec)
+                deadline_s=float(rem) if rem is not None else None,
+                trace=trace, **spec)
         except (ServiceSaturated, ServiceClosed) as e:
             conn.send({"type": F_ERROR, "id": cid, "error": str(e),
                        "error-class": type(e).__name__}, self.max_frame)
@@ -244,7 +250,8 @@ class WorkerServer:
         with self._lock:
             self._inflight[cid] = req
             self._conn_for[cid] = conn
-        conn.send({"type": F_ACK, "id": cid}, self.max_frame)
+        conn.send({"type": F_ACK, "id": cid, "trace": trace},
+                  self.max_frame)
         threading.Thread(target=self._await_result, args=(cid, req),
                          daemon=True,
                          name=f"worker-wait-{cid}").start()
@@ -273,24 +280,37 @@ class WorkerServer:
             conn = self._conn_for.pop(cid, None)
         if conn is not None:
             # best-effort push; a client that missed it (cut link) will
-            # re-SUBMIT the same id and hit the _done cache
-            conn.send({"type": F_RESULT, "id": cid, "result": result},
-                      self.max_frame)
+            # re-SUBMIT the same id and hit the _done cache.  The frame
+            # carries the trace ids alongside the serve payload so every
+            # RESULT is self-identifying on the wire.
+            serve = (result or {}).get("serve") or {}
+            trace = ({"trace-id": serve.get("trace-id"),
+                      "parent-span-id": serve.get("parent-span-id")}
+                     if serve.get("trace-id") else None)
+            conn.send({"type": F_RESULT, "id": cid, "result": result,
+                       "trace": trace}, self.max_frame)
 
     # -- RPCs --------------------------------------------------------------
-    def _status_payload(self) -> Dict[str, Any]:
+    def _status_payload(
+            self, frame: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         p = dict(self.service.ping())
         with self._lock:
             p["wire-inflight"] = len(self._inflight)
             p["wire-done-cached"] = len(self._done)
         p["idle-age-s"] = round(mono_now() - self._last_idle, 3)
         p["pid"] = os.getpid()
+        if frame and frame.get("metrics"):
+            # the fleet-wide scrape: full Metrics.snapshot() on demand
+            # over the same STATUS frame the heartbeat already uses
+            p["metrics"] = self.service.metrics.snapshot()
         return p
 
     def _reply(self, conn: _Conn, frame: Dict[str, Any],
                payload: Any) -> None:
-        conn.send({"type": F_REPLY, "id": frame.get("id"),
-                   "payload": payload}, self.max_frame)
+        out = {"type": F_REPLY, "id": frame.get("id"), "payload": payload}
+        if frame.get("trace") is not None:  # context echo, wire symmetry
+            out["trace"] = frame.get("trace")
+        conn.send(out, self.max_frame)
 
     def _handle_drain(self, conn: _Conn, frame: Dict[str, Any]) -> None:
         t = frame.get("timeout-s")

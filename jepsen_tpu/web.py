@@ -14,6 +14,10 @@ submission surface:
   status (the fleet's view with ``--workers N``, a degenerate one-worker
   view for a single service); 503 while no worker can take traffic;
 - ``GET /queue``    — a human-readable queue-status page;
+- ``GET /trace/<request-id>`` — the merged distributed trace of a
+  finished request: one causal tree spanning fleet root, wire clients
+  and worker processes (``?perfetto=1`` exports Chrome trace-event
+  JSON loadable at ui.perfetto.dev; ``cli.py trace`` talks to this);
 - ``POST /submit``  — submit a history for checking: a JSON body with
   ``ops`` (op dicts, the history.jsonl shape) plus the submit options of
   CheckService.submit (kind/model/workload/...); responds with the
@@ -122,10 +126,34 @@ def make_handler(base: str, service=None):
                 return self._send_json(200 if hz.get("ok") else 503, hz)
             if path == "/metrics":
                 if service is None:
-                    from jepsen_tpu.parallel.batch import engine_cache_stats
+                    # Route through the shared engine-cache module, not a
+                    # single engine's re-export: "singlev" keys (wgl_tpu)
+                    # must show beside "batchv"/"megav" ones.
+                    from jepsen_tpu.engine.cache import engine_cache_stats
                     return self._send_json(
                         200, {"engine-cache": engine_cache_stats()})
                 return self._send_json(200, service.metrics.snapshot())
+            if path.startswith("/trace/"):
+                # The merged causal tree for one finished request: root
+                # spans from this process plus every worker subtree
+                # absorbed off RESULT frames.  ``?perfetto=1`` renders it
+                # as a Chrome trace-event document instead (load it at
+                # ui.perfetto.dev).
+                if service is None:
+                    return self._send_json(
+                        503, {"error": "no checking service attached"})
+                rid, _, query = path[len("/trace/"):].partition("?")
+                finder = getattr(service, "merged_trace", None)
+                trace = finder(rid) if finder is not None else None
+                if trace is None:
+                    return self._send_json(
+                        404, {"error": f"no trace for request {rid!r}"})
+                if "perfetto=1" in query:
+                    from jepsen_tpu.obs.trace import (chrome_document,
+                                                      chrome_events_from_trace)
+                    return self._send_json(
+                        200, chrome_document(chrome_events_from_trace(trace)))
+                return self._send_json(200, trace)
             if path == "/queue":
                 if service is None:
                     return self._send(503, b"no checking service attached")

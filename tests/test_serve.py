@@ -413,6 +413,81 @@ class TestWebEndpoints:
         assert ei.value.code == 400
 
 
+class TestMetricsSchema:
+    """Pins the /metrics document shape dashboards scrape.  Renaming a
+    top-level section or a seed counter is a breaking change to every
+    consumer of the endpoint — these tests make that a deliberate edit,
+    not an accident."""
+
+    #: the exact top-level sections of Metrics.snapshot()
+    SECTIONS = {"counters", "gauges", "occupancy", "histograms",
+                "engine-cache", "megabatch", "flight-recorder", "traces"}
+    #: the counters seeded at construction (inc() may add more)
+    SEED_COUNTERS = {"requests-submitted", "requests-completed",
+                     "requests-rejected", "cells-submitted",
+                     "cells-completed", "deadline-expired",
+                     "dispatches", "host-fallbacks"}
+
+    def test_snapshot_schema_pinned(self, svc):
+        svc.check(cas_register_history(30, seed=31), kind="wgl",
+                  model="cas-register")
+        snap = svc.metrics.snapshot()
+        assert set(snap) == self.SECTIONS
+        assert set(snap["counters"]) >= self.SEED_COUNTERS
+        assert set(snap["gauges"]) == {"queue-depth", "inflight-requests"}
+        assert {"lanes-used", "lanes-padded", "ratio",
+                "dispatch-seconds"} <= set(snap["occupancy"])
+        assert {"enabled", "capacity", "recorded", "buffered",
+                "dropped"} == set(snap["flight-recorder"])
+        # engine-cache routes through the shared jepsen_tpu.engine.cache
+        # module: per-tag counts make the "singlev" family visible next
+        # to "batchv"/"megav" (the stale-import satellite)
+        assert "tags" in snap["engine-cache"]
+        for h in snap["histograms"].values():
+            assert {"count", "sum-s", "p50", "p90", "p99",
+                    "buckets-us"} == set(h)
+
+    def test_concurrent_snapshots_never_tear_structurally(self, svc):
+        """Gauges are point samples taken outside the metrics lock
+        (metrics is the lock-order leaf; the depth/inflight callbacks
+        take scheduler locks) — so a snapshot's gauges may reflect a
+        later instant than its counters.  The contract pinned here:
+        concurrent snapshots stay structurally whole and every counter
+        is monotone across them; nothing asserts gauges reconcile with
+        counters, because they deliberately may not (the documented
+        tear in serve/metrics.py)."""
+        stop = threading.Event()
+        errors = []
+
+        def submitter():
+            i = 0
+            while not stop.is_set() and i < 8:
+                svc.submit(cas_register_history(20, seed=100 + i),
+                           kind="wgl", model="cas-register")
+                i += 1
+
+        t = threading.Thread(target=submitter)
+        t.start()
+        last = {}
+        try:
+            for _ in range(25):
+                snap = svc.metrics.snapshot()
+                if set(snap) != TestMetricsSchema.SECTIONS:
+                    errors.append(f"sections torn: {set(snap)}")
+                for k, v in snap["counters"].items():
+                    if v < last.get(k, 0):
+                        errors.append(f"counter {k} went backwards")
+                    last[k] = v
+                for g in snap["gauges"].values():
+                    if not isinstance(g, int) or g < 0:
+                        errors.append(f"gauge not a point sample: {g}")
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        svc.drain(timeout=120)
+        assert not errors, errors
+
+
 class TestSatellites:
     def test_engine_lru_bounded_with_counters(self):
         from jepsen_tpu.parallel.batch import _LRUCache
