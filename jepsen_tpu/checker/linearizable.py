@@ -74,7 +74,12 @@ class Linearizable(Checker):
                 return {"valid": UNKNOWN,
                         "error": "model has no device tier; use cpu"}
             try:
-                res = wgl_tpu.check(jm, history, **self.engine_opts)
+                # The fission layer IS wgl_tpu.check below the threshold;
+                # above it, capacity overflow splits the search instead of
+                # degrading to unknown (engine.fission).  Callers opt out
+                # per-check with fission=False in engine_opts.
+                from jepsen_tpu.engine import fission
+                res = fission.check(jm, history, **self.engine_opts)
             except Exception as e:  # noqa: BLE001
                 res = self._tpu_fallback(history, cm, e)
         elif algo in ("cpu", "linear", "wgl"):

@@ -1086,8 +1086,12 @@ def check(model: JaxModel, history: Optional[History] = None,
         # in-progress (clipped) frontier — its high-water mark — as
         # explored work so the overflow artifact shows what the engine did
         # before degrading.
+        # "capacity-exceeded" is the structured form of the error string:
+        # the fission layer keys its split-don't-escalate decision on it
+        # instead of parsing the message.
         return {"valid": "unknown", "analyzer": "wgl-tpu",
                 "error": f"configuration capacity exceeded at {cap}",
+                "capacity-exceeded": True,
                 "configs-explored": explored + int(carry[11]),
                 "closure-rounds": int(carry[10]),
                 "max-capacity-reached": max_cap_reached}

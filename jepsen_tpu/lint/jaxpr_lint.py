@@ -240,6 +240,44 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
     findings.extend(signature_stability_findings(
         samples, queue_signature, queue_bucket, "queue plugin slots",
         path="jepsen_tpu/engine/model_plugin.py"))
+
+    # The fission sub-dispatch floors (batch window_floor / megabatch
+    # ev_floor, plus the lane bucket) are engine-cache key components
+    # for every post-split dispatch: run the REAL floor derivation over
+    # synthetic sub-problem swarms of messy raw shapes and require the
+    # resulting (window, events, lanes) triple to collapse onto the
+    # ladder — a raw sub-history shape leaking into a floor recompiles
+    # per split.
+    from jepsen_tpu.engine.fission import subproblem_floors
+
+    def _sub_history(n_events: int, width: int) -> History:
+        w = max(1, width)
+        ops = [Op(process=p, type="invoke", f="enqueue", value=p,
+                  index=p) for p in range(w)]
+        ops += [Op(process=p, type="ok", f="enqueue", value=p,
+                   index=w + p) for p in range(w)]
+        i = len(ops)
+        while len(ops) < n_events:
+            ops.append(Op(process=0,
+                          type="invoke" if i % 2 == 0 else "ok",
+                          f="enqueue", value=i, index=i))
+            i += 1
+        return History(ops)
+
+    def fission_bucket(s):
+        e, w, l = s
+        return (buckets.pow2_at_least(max(1, e), buckets.MIN_EVENTS_BUCKET),
+                buckets.pow2_at_least(max(1, w), buckets.MIN_WIDTH_BUCKET),
+                buckets.mega_lane_bucket(l))
+
+    def fission_signature(s):
+        e, w, l = s
+        subs = [_sub_history(e, w)] * min(3, max(1, l))
+        return subproblem_floors(subs)[::-1] + (buckets.mega_lane_bucket(l),)
+
+    findings.extend(signature_stability_findings(
+        samples, fission_signature, fission_bucket, "fission sub-dispatch",
+        path="jepsen_tpu/engine/fission.py"))
     return findings
 
 

@@ -18,7 +18,7 @@ the tiers like knossos.competition does for its two CPU solvers.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, FrozenSet, Optional, Tuple
 
 import numpy as np
 
@@ -91,6 +91,19 @@ class JaxModel:
     # cached by name + shape + variant, and a collision silently runs the
     # wrong step function.
     variant: Tuple = ()
+    # Independence oracle for P-compositionality (engine.fission).  Given a
+    # completion-filled op, returns the set of independent sub-object keys
+    # the op touches or constrains.  The contract is Herlihy–Wing locality:
+    # the model's state must be a product of per-key sub-states, an op may
+    # only read/write the keys it reports, and a history is linearizable
+    # iff every per-component projection is.  Return values:
+    #   None         — the op spans the whole object (model unsplittable);
+    #   frozenset()  — the op is unconstraining (always linearizable,
+    #                  state-preserving; the splitter may elide it);
+    #   frozenset(k) — the keys touched (ops sharing a key are grouped).
+    # Models without true per-key independence (cas-register, queues) must
+    # leave this None.
+    components: Optional[Callable[[Op], Optional[FrozenSet]]] = None
 
     def init_state_array(self) -> np.ndarray:
         return np.asarray(self.init_state, np.int32).reshape(self.state_size)

@@ -170,6 +170,23 @@ class MultiRegister(Model):
 F_MR_READ, F_MR_WRITE = 0, 1
 
 
+def multi_register_components(op: Op):
+    """Per-key independence: the map is a product of one register per key,
+    a write touches exactly its keys, and a read constrains only the keys
+    it observed (nil reads are always legal, multi_key_acid.clj:22-23, so
+    a key read as None constrains nothing)."""
+    if op.f in ("write", "w"):
+        if op.value is None:
+            return None  # crashed write with unknown keys: can't place it
+        return frozenset(dict(op.value).keys())
+    if op.f in ("read", "r"):
+        if op.value is None:
+            return frozenset()
+        return frozenset(k for k, v in dict(op.value).items()
+                         if v is not None)
+    return None
+
+
 @register_model("multi-register")
 def multi_register_jax(keys: int = 3, vbits: int = 4) -> JaxModel:
     """Device tier for :class:`MultiRegister`: k int32 lanes, one per key.
@@ -239,7 +256,8 @@ def multi_register_jax(keys: int = 3, vbits: int = 4) -> JaxModel:
                     step=step, encode_op=encode,
                     cpu_model=lambda: MultiRegister(),
                     pure_read_fs=(F_MR_READ,),
-                    variant=(keys, vbits))
+                    variant=(keys, vbits),
+                    components=multi_register_components)
 
 
 # -- bounded-domain set, device tier ---------------------------------------
@@ -266,6 +284,22 @@ class BitSetModel(Model):
 
 
 F_ADD, F_READBIT = 0, 1
+
+
+def bitset_components(op: Op):
+    """Per-element independence: a grow-only set's state is a product of
+    one bit per element, ``add v`` writes only bit v, and ``read (k, _)``
+    constrains only bit k (Herlihy–Wing locality per element)."""
+    if op.f == "add":
+        if op.value is None:
+            return None  # value unknown: can't place the write
+        return frozenset({int(op.value)})
+    if op.f == "read":
+        if op.value is None:
+            return frozenset()  # crashed read, nothing observed
+        k, _present = op.value
+        return frozenset({int(k)})
+    return None
 
 
 @register_model("bitset")
@@ -298,7 +332,8 @@ def bitset_jax(domain: int = 1024) -> JaxModel:
     return JaxModel(name="bitset", state_size=words,
                     init_state=np.zeros(words, np.int32),
                     step=step, encode_op=encode,
-                    cpu_model=lambda: BitSetModel())
+                    cpu_model=lambda: BitSetModel(),
+                    components=bitset_components)
 
 
 @register_model("bitset-256")
@@ -309,7 +344,8 @@ def bitset256_jax() -> JaxModel:
     m = bitset_jax(256)
     return JaxModel(name="bitset-256", state_size=m.state_size,
                     init_state=m.init_state, step=m.step,
-                    encode_op=m.encode_op, cpu_model=m.cpu_model)
+                    encode_op=m.encode_op, cpu_model=m.cpu_model,
+                    components=m.components)
 
 
 # -- fifo queue, device tier -------------------------------------------------

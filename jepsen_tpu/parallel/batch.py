@@ -70,6 +70,7 @@ def check_batch(model: JaxModel,
                 max_capacity: int = 65536,
                 chunk: Optional[int] = None,
                 window_floor: int = 0,
+                fission: Optional[bool] = None,
                 _group_reuse: bool = False) -> List[Dict[str, Any]]:
     """Check many histories at once; returns one result dict per history.
 
@@ -87,6 +88,14 @@ def check_batch(model: JaxModel,
     so the default capacity starts LOW (measured on hardware: 42 vs 17
     histories/sec at 256 vs 1024 on 200-op crash lanes) and the retry
     loop escalates only the lanes that overflow.
+
+    ``fission`` controls frontier fission for overflowing lanes: once the
+    next escalation rung would cross the fission threshold (and the
+    caller's ``max_capacity`` lies beyond it), the lane is split into
+    independent sub-problems instead of compiling an ever-larger batched
+    engine (see :mod:`jepsen_tpu.engine.fission`).  ``None`` reads the
+    ``JTPU_FISSION`` knob; fission's own sub-dispatches pin it False so a
+    sub-problem can never re-split.
     """
     if not histories:
         return []
@@ -101,6 +110,7 @@ def check_batch(model: JaxModel,
                                    mesh=mesh, axis=axis, capacity=capacity,
                                    max_capacity=max_capacity, chunk=chunk,
                                    window_floor=window_floor,
+                                   fission=fission,
                                    _group_reuse=_group_reuse or reuse))
         return out
     preps = [prepare(h, model) for h in histories]
@@ -121,14 +131,38 @@ def check_batch(model: JaxModel,
         if not retry:
             break
         nxt = next_capacity(cap, max_capacity)
+        if _fission_here(fission, nxt, max_capacity):
+            # Frontier fission: the remaining lanes' next rung would cross
+            # the threshold — split each into sub-problems on small,
+            # cache-hot shapes instead of escalating the whole batched
+            # engine (unknown-never-false recombination; the monolithic
+            # escalation path survives inside fission as the fallback).
+            from jepsen_tpu.engine.fission import split_check
+            for lane in retry:
+                out[lane] = split_check(model, histories[lane],
+                                        capacity=capacity,
+                                        max_capacity=max_capacity)
+            break
         if nxt is None:
             for lane in retry:
                 out[lane] = exhausted_result(
-                    "wgl-tpu-batch", f"capacity exceeded at {cap}")
+                    "wgl-tpu-batch", f"capacity exceeded at {cap}",
+                    **{"capacity-exceeded": True})
             break
         lanes = retry
         cap = nxt
     return out  # type: ignore[return-value]
+
+
+def _fission_here(fission: Optional[bool], nxt: Optional[int],
+                  max_capacity: int) -> bool:
+    """Should the escalation loop split instead of taking rung ``nxt``?"""
+    from jepsen_tpu.engine.fission import fission_enabled, fission_threshold
+    enabled = fission if fission is not None else fission_enabled()
+    if not enabled:
+        return False
+    thr = fission_threshold()
+    return max_capacity > thr and (nxt is None or nxt > thr)
 
 
 def _run_lanes(model: JaxModel, preps, window: int, cap: int,

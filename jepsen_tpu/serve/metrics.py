@@ -20,6 +20,9 @@ document (docs/observability.md) and assert on in the tests:
   so all three show up in ``/metrics``;
 - megabatch: the throughput path's staging/refill/readback counters
   (parallel.megabatch);
+- fission: the frontier-splitting counters (splits, component/ghost
+  sub-problems, recombines, escalations — engine.fission) plus its
+  sub-problem wall-clock histograms;
 - flight-recorder: the process ring's enabled/recorded/buffered stats;
 - traces: the last few completed requests' merged trace payloads
   (trace/span ids, wall anchor, spans, absorbed remote payloads).
@@ -123,6 +126,7 @@ class Metrics:
 
     def snapshot(self) -> Dict[str, Any]:
         from jepsen_tpu.engine.cache import engine_cache_stats
+        from jepsen_tpu.engine import fission
         from jepsen_tpu.obs.recorder import RECORDER
         from jepsen_tpu.parallel.megabatch import megabatch_stats
         with self._lock:
@@ -151,6 +155,8 @@ class Metrics:
             "histograms": {**self.hists.snapshot(), **compile_hist_stats()},
             "engine-cache": {**cache, "recompiles": cache["misses"]},
             "megabatch": megabatch_stats(),
+            "fission": {**fission.fission_stats(),
+                        "histograms": fission.HISTS.snapshot()},
             "flight-recorder": RECORDER.stats(),
             "traces": traces,
         }

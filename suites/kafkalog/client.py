@@ -6,11 +6,12 @@ and subscribe take ownership of the listed partitions and resume from the
 GROUP'S COMMITTED offsets (kafka consumer-group semantics: positions are
 auto-committed after each successful poll, so a rebalance or a fresh
 client re-reads at most the uncommitted tail and NEVER skips unread
-records).  A partition with no committed offset starts at the log end —
-kafka's auto.offset.reset=latest — which at test start is offset 0, so
-the first era is gap-free too.  (The old seek-to-end-on-every-assign
-behavior produced era-jump gaps under load: an acked record that no
-consumer era covered read as a lost-write.)  The final-polls catch-up
+records).  A partition with no committed offset starts at offset 0 —
+kafka's auto.offset.reset=earliest; the suite's log has no retention, so
+0 always exists.  (Starting such a partition at the log END instead let
+the next poll's auto-commit pin never-polled keys to that end, and the
+whole group skipped every record below it — an acked record no consumer
+era covered read as a lost-write.)  The final-polls catch-up
 phase still forces ``op.extra["seek_to_beginning"]``.  ``crash``
 completes :info so the interpreter burns the process and opens a fresh
 client — kafka.clj's crash-client semantics.
@@ -88,16 +89,13 @@ class KafkaLogClient(jclient.Client):
         committed = self.conn.call(
             {"op": "committed", "group": self.group,
              "keys": sorted(self.owned)})["offsets"]
-        need_end = [k for k, pos in committed.items() if int(pos) < 0]
-        ends = {}
-        if need_end:
-            ends = self.conn.call({"op": "end_offsets",
-                                   "keys": sorted(need_end)})["ends"]
-        self.positions = {}
-        for k, pos in committed.items():
-            kk = int(k)
-            self.positions[kk] = (int(pos) if int(pos) >= 0
-                                  else int(ends.get(k, 0)))
+        # A partition with no committed offset starts at offset 0
+        # (auto.offset.reset=earliest).  Seeking to the log END here is
+        # wrong: the next poll's auto-commit would commit that end
+        # position for keys this era never polled, and the whole group
+        # would skip every record below it forever.
+        self.positions = {int(k): max(0, int(pos))
+                          for k, pos in committed.items()}
 
     def _auto_commit(self) -> None:
         """Commit the current positions (kafka auto-commit after poll).

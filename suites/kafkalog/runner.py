@@ -81,24 +81,30 @@ class VanishedLog:
                         if k in firsts:
                             continue  # era's first record already judged
                         prior = observed.get(k, {})
-                        if not prior:
-                            continue
-                        mn = min(prior)
                         if recs:
+                            # Latch the era-first record even with no prior
+                            # observations: this poll's records land in
+                            # ``observed`` below, so skipping the latch here
+                            # would judge the era's SECOND poll against the
+                            # first poll's own records — a false positive
+                            # on any clean two-poll catch-up.
                             firsts[k] = int(recs[0][0])
-                            if int(recs[0][0]) > mn:
+                            if prior and int(recs[0][0]) > min(prior):
                                 vanished.append(
                                     {"key": k, "era-first": int(recs[0][0]),
-                                     "earliest-observed": mn,
+                                     "earliest-observed": min(prior),
                                      "process": op.process})
-                        else:
+                        elif prior:
                             # synchronous read from the beginning returned
                             # nothing although observed records existed
                             firsts[k] = -1
                             vanished.append(
                                 {"key": k, "era-first": None,
-                                 "earliest-observed": mn,
+                                 "earliest-observed": min(prior),
                                  "process": op.process})
+                        # empty poll, nothing observed yet: legitimately
+                        # empty log — the era's first records are still to
+                        # come, so leave the latch open
             if op.type == "ok":
                 for k, o, v in _poll_records(op):
                     observed.setdefault(int(k), {}).setdefault(int(o), v)

@@ -117,6 +117,53 @@ class TestGroupOffsets:
             proc.kill()
             proc.wait()
 
+    def test_uncommitted_assign_starts_at_earliest(self, tmp_path):
+        """Round-5 fix pin: a partition with no committed offset starts
+        at offset 0 (auto.offset.reset=earliest — the suite's log has no
+        retention, so 0 always exists).  The old end_offsets fallback
+        started such partitions at the log END, and the next poll's
+        auto-commit then pinned never-polled keys there: every record
+        below the end was skipped by the whole group forever."""
+        import subprocess
+        import sys
+        import time
+        from suites.kafkalog.client import Conn, KafkaLogClient
+        from suites.kafkalog.server import __file__ as srv_file
+        from suites.localkv.runner import free_ports
+        from jepsen_tpu.history import Op
+        port = free_ports(1)[0]
+        proc = subprocess.Popen(
+            [sys.executable, srv_file, "--node", "n1",
+             "--port", str(port), "--data", str(tmp_path / "d")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            for _ in range(50):
+                try:
+                    Conn(port).call({"op": "ping"})
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.1)
+            test = {"kafkalog_ports": {"n1": port}}
+            # a producer that never polls: nothing is ever committed
+            producer = KafkaLogClient(Conn(port))
+            for v in (10, 11, 12):
+                producer.invoke(test, Op(process=0, type="invoke", f="send",
+                                         value=[["send", 0, v]]))
+            # fresh consumer, group has no committed offset for key 0:
+            # it must start at 0, not at the log end (3)
+            c1 = KafkaLogClient(Conn(port))
+            c1.invoke(test, Op(process=1, type="invoke", f="assign",
+                               value=[0, 1]))
+            assert c1.positions == {0: 0, 1: 0}, c1.positions
+            r = c1.invoke(test, Op(process=1, type="invoke", f="poll",
+                                   value=[["poll", None]]))
+            polled = r.value[0][1][0]
+            assert polled[0][0] == 0
+            assert [v for _, v in polled] == [10, 11, 12]
+        finally:
+            proc.kill()
+            proc.wait()
+
 
 class TestVanishedLog:
     def _h(self, *dicts):
@@ -181,3 +228,43 @@ class TestVanishedLog:
         r = VanishedLog().check({}, h)
         assert r["valid"] is False
         assert r["vanished"][0]["era-first"] == 2
+
+    def test_era_first_poll_without_prior_is_latched(self):
+        """Round-5 fix pin: the era's FIRST poll returns records nothing
+        had observed before.  Those records land in ``observed``, and the
+        old code — which skipped the era-first latch whenever ``prior``
+        was empty — then judged the era's SECOND poll as its first,
+        refuting a perfectly clean two-poll catch-up."""
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10], [1, 11]]}]]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll",
+                 value=[["poll", {0: [[2, 12]]}]]),
+        )
+        r = VanishedLog().check({}, h)
+        assert r["valid"] is True, r
+
+    def test_empty_first_poll_keeps_latch_open(self):
+        """An empty poll on a genuinely empty log must neither refute nor
+        close the era-first latch: the era's first RECORDS come later and
+        are still judged (here: cleanly, starting at offset 0)."""
+        from suites.kafkalog.runner import VanishedLog
+        h = self._h(
+            dict(process=1, type="invoke", f="assign", value=[0],
+                 extra={"seek_to_beginning": True}),
+            dict(process=1, type="ok", f="assign", value=[0]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll", value=[["poll", {0: []}]]),
+            dict(process=0, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10]]}]]),
+            dict(process=1, type="invoke", f="poll", value=[["poll", None]]),
+            dict(process=1, type="ok", f="poll",
+                 value=[["poll", {0: [[0, 10]]}]]),
+        )
+        assert VanishedLog().check({}, h)["valid"] is True

@@ -421,7 +421,8 @@ class TestMetricsSchema:
 
     #: the exact top-level sections of Metrics.snapshot()
     SECTIONS = {"counters", "gauges", "occupancy", "histograms",
-                "engine-cache", "megabatch", "flight-recorder", "traces"}
+                "engine-cache", "megabatch", "flight-recorder", "traces",
+                "fission"}
     #: the counters seeded at construction (inc() may add more)
     SEED_COUNTERS = {"requests-submitted", "requests-completed",
                      "requests-rejected", "cells-submitted",
@@ -443,6 +444,10 @@ class TestMetricsSchema:
         # module: per-tag counts make the "singlev" family visible next
         # to "batchv"/"megav" (the stale-import satellite)
         assert "tags" in snap["engine-cache"]
+        # fission: the process-wide split/recombine counters plus the
+        # sub-problem wall-clock histograms (engine.fission)
+        assert {"checks", "splits", "recombines", "escalations",
+                "histograms"} <= set(snap["fission"])
         for h in snap["histograms"].values():
             assert {"count", "sum-s", "p50", "p90", "p99",
                     "buckets-us"} == set(h)
