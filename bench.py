@@ -512,7 +512,34 @@ def tier_batch_sweep():
             "dispatches": st["dispatches"], "refills": st["refills"],
             "groups": st["groups"],
         }
-    emit({"sweep": sweep, "analyzer": "wgl-tpu-megabatch",
+
+    # The plugin-model lanes through the same sweep: queue/set/opacity
+    # hist/s on the megabatch path vs the check_batch barrier, parity-
+    # asserted lane for lane (the state-width ladder's before/after).
+    from jepsen_tpu.parallel.batch import check_batch
+    models_out = {}
+    for name, m, runs, wf, evf in resolve_model_runs():
+        progress(f"batch_sweep[models:{name}] warm")
+        check_batch(m, runs, window_floor=wf, capacity=256)
+        check_megabatch(m, runs, window_floor=wf, ev_floor=evf,
+                        capacity=256)
+        progress(f"batch_sweep[models:{name}] timed runs")
+        t0 = time.time()
+        mres = check_megabatch(m, runs, window_floor=wf, ev_floor=evf,
+                               capacity=256)
+        mega_wall = time.time() - t0
+        t0 = time.time()
+        bres = check_batch(m, runs, window_floor=wf, capacity=256)
+        batch_wall = time.time() - t0
+        assert [r["valid"] for r in mres] == [r["valid"] for r in bres]
+        models_out[name] = {
+            "n_histories": len(runs),
+            "megabatch_hist_per_sec": round(len(runs) / mega_wall, 1),
+            "check_batch_hist_per_sec": round(len(runs) / batch_wall, 1),
+            "parity": "lane-for-lane valid vs check_batch",
+        }
+    emit({"sweep": sweep, "models": models_out,
+          "analyzer": "wgl-tpu-megabatch",
           "histories_per_sec":
               sweep[str(sizes[-1])]["histories_per_sec"]})
 
@@ -642,26 +669,23 @@ def build_model_batches():
     }
 
 
-def tier_models():
-    """Engine-plugin model throughput: hist/s for each of the three
-    drop-in models (fifo-queue, set, opacity via its reduction onto
-    txn-register) through the batch engine — the line the engine-smoke
-    CI job tracks.  Every lane is parity-checked against the host oracle
-    before any number is emitted."""
-    from jepsen_tpu.checker import wgl_cpu
+def resolve_model_runs():
+    """(name, model, runs, window_floor, ev_floor) per plugin-model
+    family, with the same sizing the serve path derives: queue slots off
+    ``derive_queue_slots``, opacity through its reduction, floors off
+    the pow2 ladder.  Shared by the models tier and the batch_sweep
+    plugin sub-sweep so both measure the same resolved workloads."""
+    from jepsen_tpu.engine.model_plugin import derive_queue_slots
     from jepsen_tpu.engine.opacity import derive_history
     from jepsen_tpu.models import get_model
-    from jepsen_tpu.parallel.batch import check_batch
-    from jepsen_tpu.serve.buckets import MIN_WIDTH_BUCKET, pow2_at_least
-
-    batches = build_model_batches()
-    out = {}
-    for name, hs in batches.items():
+    from jepsen_tpu.serve.buckets import (MIN_EVENTS_BUCKET,
+                                          MIN_WIDTH_BUCKET, pow2_at_least)
+    out = []
+    for name, hs in build_model_batches().items():
         if name == "opacity":
             model = get_model("txn-register")
             runs = [derive_history(h) for h in hs]
         elif name == "fifo-queue":
-            from jepsen_tpu.engine.model_plugin import derive_queue_slots
             slots = max(derive_queue_slots(h, {})["slots"] for h in hs)
             model = get_model(name, slots=slots)
             runs = hs
@@ -670,7 +694,28 @@ def tier_models():
             runs = hs
         width = max(len({o.process for o in h.client_ops()})
                     for h in runs)
-        floor = pow2_at_least(width, MIN_WIDTH_BUCKET)
+        wf = pow2_at_least(width, MIN_WIDTH_BUCKET)
+        evf = pow2_at_least(max(len(h) for h in runs), MIN_EVENTS_BUCKET)
+        out.append((name, model, runs, wf, evf))
+    return out
+
+
+def tier_models():
+    """Engine-plugin model throughput: hist/s for each of the three
+    drop-in models (fifo-queue, set, opacity via its reduction onto
+    txn-register) through the batch engine — the line the engine-smoke
+    CI job tracks.  Every lane is parity-checked against the host oracle
+    before any number is emitted.  Each model also reports its
+    steady-state ``compiles_per_1k_dispatches`` through a warm megabatch
+    pass (the /metrics gauge, measured here: a warm ladder reads 0.0)."""
+    from jepsen_tpu.checker import wgl_cpu
+    from jepsen_tpu.obs.hist import compile_event_count
+    from jepsen_tpu.parallel.batch import check_batch
+    from jepsen_tpu.parallel.megabatch import (check_megabatch,
+                                               megabatch_stats)
+
+    out = {}
+    for name, model, runs, floor, evf in resolve_model_runs():
         progress(f"models[{name}] warm ({len(runs)} lanes)")
         check_batch(model, runs, window_floor=floor, capacity=256)
         progress(f"models[{name}] timed device run")
@@ -680,11 +725,24 @@ def tier_models():
         for i, (r, h) in enumerate(zip(res, runs)):
             c = wgl_cpu.check(model.cpu_model(), h)
             assert r["valid"] == c["valid"], (name, i, r, c)
+        # Steady-state compile pressure on the megabatch path: warm the
+        # ladder with one pass, then count compile events per 1k chunk
+        # dispatches over an identical second pass.
+        mres = check_megabatch(model, runs, window_floor=floor,
+                               ev_floor=evf, capacity=256)
+        assert [r["valid"] for r in mres] == [r["valid"] for r in res]
+        c0, d0 = compile_event_count(), megabatch_stats()["dispatches"]
+        check_megabatch(model, runs, window_floor=floor, ev_floor=evf,
+                        capacity=256)
+        dd = megabatch_stats()["dispatches"] - d0
+        dc = compile_event_count() - c0
         out[name] = {
             "n_histories": len(runs),
             "wall_s": round(wall, 3),
             "histories_per_sec": round(len(runs) / wall, 1),
             "parity": "all-lanes verdict vs CPU oracle",
+            "compiles_per_1k_dispatches":
+                round(1000.0 * dc / max(1, dd), 3),
         }
     emit({"models": out})
 

@@ -98,7 +98,11 @@ def check_batch(histories: Sequence[History],
                               else ("serializable",))
     deadline = Deadline.after(budget_s)
     encs = [encode(h, workload, **workload_kw) for h in histories]
-    n_pad = max(padded_n(encs), ((n_pad_floor + 31) // 32) * 32)
+    # Floor padding shares the ladder's word rounding with padded_n —
+    # one derivation, so the serve elle bucket and a floorless call land
+    # on identical rungs.
+    from jepsen_tpu.engine.ladder import pad_words
+    n_pad = max(padded_n(encs), pad_words(n_pad_floor))
     cap = group_cap(n_pad)
     use_device = engine != "cpu" and available()
     if engine == "tpu" and not use_device:

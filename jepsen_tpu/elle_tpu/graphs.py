@@ -23,15 +23,15 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from jepsen_tpu.elle_tpu.encode import COMPLETE_PAD, KINDS, EncodedHistory
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
+# Word rounding comes off the shared engine ladder (one derivation for
+# the elle adjacency pad, the serve elle bucket, and the engine-side
+# n_pad_floor) instead of a private copy here.
+from jepsen_tpu.engine.ladder import pad_words
 
 
 def padded_n(encs: Sequence[EncodedHistory]) -> int:
     """The shared adjacency dimension for a batch of encodings."""
-    return max(32, _round_up(max((e.n for e in encs), default=1) or 1, 32))
+    return max(32, pad_words(max((e.n for e in encs), default=1) or 1, 32))
 
 
 def pack_group(encs: Sequence[EncodedHistory],
@@ -43,7 +43,7 @@ def pack_group(encs: Sequence[EncodedHistory],
     b = len(encs)
     if b_pad is None:
         b_pad = b
-    e_pad = max(64, _round_up(max(e.src.shape[1] for e in encs), 64))
+    e_pad = max(64, pad_words(max(e.src.shape[1] for e in encs), 64))
     src = np.full((b_pad, len(KINDS), e_pad), -1, np.int32)
     dst = np.full((b_pad, len(KINDS), e_pad), -1, np.int32)
     invoke = np.full((b_pad, n_pad), -1, np.int32)

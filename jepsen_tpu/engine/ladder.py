@@ -27,9 +27,10 @@ from typing import Optional, Sequence, Tuple
 # serve edge out of the import graph.
 _BUCKET_EXPORTS = (
     "MAX_LANE_BUCKET", "MIN_EVENTS_BUCKET", "MIN_N_BUCKET",
-    "MIN_WIDTH_BUCKET", "elle_bucket", "elle_n_bucket", "events_bucket",
-    "lane_bucket", "pow2_at_least", "wgl_bucket", "wgl_start_capacity",
-    "width_bucket",
+    "MIN_STATE_WIDTH_BUCKET", "MIN_WIDTH_BUCKET", "elle_bucket",
+    "elle_n_bucket", "events_bucket", "lane_bucket", "mega_lane_bucket",
+    "pow2_at_least", "state_width_bucket", "wgl_bucket",
+    "wgl_start_capacity", "width_bucket",
 )
 
 
@@ -73,6 +74,57 @@ def batch_shape(preps: Sequence, window_floor: int = 0) -> Tuple[int, int, int]:
     gwords = max(chosen_gwords(p) for p in preps)
     longest = max(len(p) for p in preps)
     return window, gwords, longest
+
+
+def pad_words(n: int, word: int = 32) -> int:
+    """Round ``n`` up to a whole number of ``word``-sized words.  The one
+    word-padding derivation in the stack: the elle adjacency pad
+    (``elle_tpu``'s 32-row closure tiles) and any packed-bitmask state
+    sizing round here instead of keeping private ``(n + 31) // 32 * 32``
+    copies."""
+    return ((max(0, n) + word - 1) // word) * word
+
+
+def _state_halvings(state_width: int) -> int:
+    """Rungs the state-width bucket sits above the register floor — the
+    damping exponent shared by :func:`mega_chunk` and
+    :func:`state_capacity`."""
+    from jepsen_tpu.serve import buckets
+    sw_bucket = buckets.state_width_bucket(state_width)
+    return max(0, sw_bucket.bit_length()
+               - buckets.MIN_STATE_WIDTH_BUCKET.bit_length())
+
+
+def mega_chunk(bpad: int, longest: int, state_width: int) -> int:
+    """Events per dispatch for a megabatch lane group, state-width
+    aware: start from :func:`batch_chunk` and halve once per rung the
+    model's packed state sits above the register floor (a queue ring or
+    txn key vector multiplies the per-step merge cost by its width, so
+    wide-state dispatches shorten to keep one XLA program's duration
+    roughly constant).  Still a multiple of 64 with floor 64, and still
+    a pure function of (lane bucket, events bucket, state-width bucket)
+    — the raw ``state_width`` is quantized internally, so equal buckets
+    always derive equal chunks."""
+    c = batch_chunk(bpad, longest)
+    c = (c >> _state_halvings(state_width)) // 64 * 64
+    return max(64, c)
+
+
+def state_capacity(ev_bucket: int, w_bucket: int, state_width: int) -> int:
+    """The wgl *starting* capacity for a model with a ``state_width``-wide
+    packed state: :func:`~jepsen_tpu.serve.buckets.wgl_start_capacity`
+    shifted down one rung per state-width doubling past the register
+    floor.  Wide states make each resident configuration proportionally
+    more expensive (memory and merge cost both scale with the packed
+    width), and under-starting is safe — overflow lanes escalate up the
+    :func:`next_capacity` ladder — so the derivation trades a possible
+    escalation round-trip for not compiling huge frontiers nobody needs.
+    Pure function of the (ev, w, state-width) bucket triple; floored at
+    ``MIN_WGL_CAPACITY``."""
+    from jepsen_tpu.serve import buckets
+    cap = buckets.wgl_start_capacity(ev_bucket, w_bucket)
+    return max(buckets.MIN_WGL_CAPACITY,
+               cap >> _state_halvings(state_width))
 
 
 def next_capacity(cap: int, max_capacity: int, growth: int = 8) -> Optional[int]:

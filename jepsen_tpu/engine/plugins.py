@@ -47,6 +47,68 @@ def registered_plugins() -> List[str]:
     return sorted(_PLUGINS)
 
 
+# Megabatch carry descriptors: model-family name -> {"doc", "derive"}.
+# Registering says "this family's per-configuration state packs as the
+# flat int32 vector JaxModel.carry_descriptor() describes, so its lanes
+# may bin-pack into the megabatch donated-carry loop".  The scheduler's
+# _mega_eligible consults this instead of hard-coding a family; a model
+# without an entry is never rejected — it just keeps the check_batch
+# barrier path.  ``derive`` names the family's history->sizing hook
+# (e.g. derive_queue_slots) whose pow2 outputs feed the state-width
+# bucket key.  Populated lazily for the same cycle-safety reason as the
+# plugin registry above.
+_CARRIES: Dict[str, Dict[str, Any]] = {}
+_CARRIES_SEEDED = False
+
+
+def register_carry_descriptor(model: str, doc: str = "",
+                              derive: Optional[Callable] = None) -> None:
+    """Opt device-model family ``model`` into megabatch routing."""
+    _CARRIES[model] = {"doc": doc, "derive": derive}
+
+
+def _seed_builtin_carries() -> None:
+    global _CARRIES_SEEDED
+    if _CARRIES_SEEDED:
+        return
+    _CARRIES_SEEDED = True
+    from jepsen_tpu.engine.model_plugin import derive_queue_slots
+    for name, doc in (
+            ("register", "single int32 register cell"),
+            ("cas-register", "register + CAS, same single-cell state"),
+            ("mutex", "single lock-owner cell"),
+            ("owner-aware-mutex", "lock-owner cell keyed by process"),
+            ("reentrant-mutex", "owner + depth pair"),
+            ("multi-register", "one cell per key, width = keys"),
+            ("bitset", "packed mask words, width = ceil(domain/31)"),
+            ("bitset-256", "fixed 9-word packed mask"),
+            ("set", "two-word bitmask, domain [0, 62)"),
+            ("txn-register", "one cell per key, width = keys"),
+    ):
+        register_carry_descriptor(name, doc=doc)
+    register_carry_descriptor(
+        "fifo-queue", doc="ring buffer, width = 2 + slots (pow2-derived)",
+        derive=derive_queue_slots)
+
+
+def has_carry_descriptor(model: str) -> bool:
+    """True when model family ``model`` registered a megabatch carry
+    descriptor (the routing gate ``scheduler._mega_eligible`` asks)."""
+    _seed_builtin_carries()
+    return model in _CARRIES
+
+
+def carry_descriptors() -> List[str]:
+    """Model families opted into megabatch routing."""
+    _seed_builtin_carries()
+    return sorted(_CARRIES)
+
+
+def carry_info(model: str) -> Dict[str, Any]:
+    _seed_builtin_carries()
+    return dict(_CARRIES[model])
+
+
 def plugin_info(name: str) -> Dict[str, Any]:
     return dict(_PLUGINS[name])
 

@@ -180,7 +180,7 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
                     DEFAULT_SAMPLES) -> List[Finding]:
     """Check the real serve-path derivations against the ladder."""
     from jepsen_tpu.checker.wgl_tpu import _round_window
-    from jepsen_tpu.parallel.batch import _batch_chunk
+    from jepsen_tpu.engine.ladder import mega_chunk, state_capacity
     from jepsen_tpu.serve import buckets
 
     findings = []
@@ -195,8 +195,9 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
     def wgl_signature(s):
         eb, wb, lb = wgl_bucket(s)
         # exactly what scheduler._dispatch_wgl hands the batch engine
+        # (register family: state width 1, the ladder's base rung)
         return (_round_window(wb), buckets.wgl_start_capacity(eb, wb),
-                _batch_chunk(lb, eb), lb)
+                mega_chunk(lb, eb, 1), lb)
 
     findings.extend(signature_stability_findings(
         samples, wgl_signature, wgl_bucket, "wgl serve path",
@@ -240,6 +241,35 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
     findings.extend(signature_stability_findings(
         samples, queue_signature, queue_bucket, "queue plugin slots",
         path="jepsen_tpu/engine/model_plugin.py"))
+
+    # The megabatch state-width ladder: a plugin model's packed state
+    # width (queue ring = 2 + derived slots here — the widest, messiest
+    # real derivation) feeds the chunk and start-capacity components of
+    # the "megav" engine-cache key.  Run the REAL ladder derivations
+    # over the raw widths and require the signature to collapse onto
+    # the (events, window, lanes, state-width) bucket tuple — a raw
+    # ring width leaking into chunk or capacity recompiles per queue
+    # size.
+    def _queue_state_width(s) -> int:
+        return 2 + derive_queue_slots(_enq_history(max(1, s[1])), {})["slots"]
+
+    def state_bucket(s):
+        e, w, l = s
+        return (buckets.pow2_at_least(e, buckets.MIN_EVENTS_BUCKET),
+                buckets.pow2_at_least(max(8, w), buckets.MIN_WIDTH_BUCKET),
+                buckets.mega_lane_bucket(l),
+                buckets.state_width_bucket(_queue_state_width(s)))
+
+    def state_signature(s):
+        eb, wb, lb, _ = state_bucket(s)
+        raw_width = _queue_state_width(s)
+        return (mega_chunk(lb, eb, raw_width),
+                state_capacity(eb, wb, raw_width),
+                buckets.state_width_bucket(raw_width))
+
+    findings.extend(signature_stability_findings(
+        samples, state_signature, state_bucket, "megabatch state-width",
+        path="jepsen_tpu/parallel/megabatch.py"))
 
     # The fission sub-dispatch floors (batch window_floor / megabatch
     # ev_floor, plus the lane bucket) are engine-cache key components

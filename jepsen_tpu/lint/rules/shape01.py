@@ -57,6 +57,13 @@ _SHAPE_KWARGS = {
     "batch_shape": ("window_floor",),
     "fifo_queue_jax": ("slots",),
     "txn_register_jax": ("keys", "vbits"),
+    "multi_register_jax": ("keys", "vbits"),
+    "bitset_jax": ("domain",),
+    # the state-width ladder derivations: their state_width argument is
+    # an engine-cache key component (quantized internally, but a call
+    # site threading a raw shape through a kwarg still gets audited)
+    "mega_chunk": ("state_width",),
+    "state_capacity": ("state_width",),
 }
 
 #: which floor kwarg a check_batch variant requires, by defining module.
@@ -71,7 +78,8 @@ _FLOOR_FOR_ORIGIN = {
 _MEGABATCH_FLOORS = ("window_floor", "ev_floor")
 
 _BUCKETISH_NAME = re.compile(r"bucket|floor|pow2", re.IGNORECASE)
-_BUCKETISH_FUNC = re.compile(r"bucket|floor|pow2|_batch_chunk|capacity")
+_BUCKETISH_FUNC = re.compile(
+    r"bucket|floor|pow2|_batch_chunk|mega_chunk|capacity")
 
 
 def _bucket_derived(node: ast.AST) -> bool:

@@ -108,6 +108,17 @@ class JaxModel:
     def init_state_array(self) -> np.ndarray:
         return np.asarray(self.init_state, np.int32).reshape(self.state_size)
 
+    def carry_descriptor(self) -> Tuple[str, Tuple, Tuple[int, ...], str]:
+        """How this model's per-configuration state rides the engine
+        carry: ``(family, variant, shape, dtype)``.  Every JaxModel packs
+        as a flat int32 vector of width ``state_size`` — what varies per
+        family is only the width, which the megabatch bin-packer
+        quantizes through ``state_width_bucket`` so queue rings, bitmask
+        words, and register cells share one bounded carry-shape
+        universe.  Whether a family is *routed* through megabatch is the
+        separate opt-in in ``engine.plugins`` (``has_carry_descriptor``)."""
+        return (self.name, self.variant, (int(self.state_size),), "int32")
+
 
 # ---------------------------------------------------------------------------
 # Registry — name -> JaxModel factory (mirrors how suites name knossos models,

@@ -143,6 +143,14 @@ def compile_hist_stats() -> Dict[str, Dict[str, Any]]:
     return COMPILES.snapshot()
 
 
+def compile_event_count() -> int:
+    """Total compile events observed process-wide — the numerator of the
+    steady-state ``compiles-per-1k-dispatches`` gauge, and the number
+    the megabatch CI smoke asserts goes flat once the ladder is warm."""
+    return sum(int(s.get("count", 0))
+               for s in COMPILES.snapshot().values())
+
+
 def timed_first_call(fn, name: str):
     """Wrap a jitted callable so its *first* invocation — the one that
     pays XLA compilation — is timed into the compile histogram ``name``

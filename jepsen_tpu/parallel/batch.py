@@ -40,7 +40,7 @@ from jepsen_tpu.engine.cache import (
 from jepsen_tpu.engine.groups import MAX_LANES_PER_GROUP, group_slices
 from jepsen_tpu.engine.ladder import (
     LANE_EVENTS_PER_DISPATCH, batch_chunk as _batch_chunk, batch_shape,  # noqa: F401
-    next_capacity,
+    mega_chunk, next_capacity,
 )
 from jepsen_tpu.engine.witness import refuted_result
 from jepsen_tpu.history import History
@@ -181,7 +181,10 @@ def _run_lanes(model: JaxModel, preps, window: int, cap: int,
     if mesh is not None:
         n = mesh.shape[axis]
         bpad = ((b + n - 1) // n) * n
-    cc = chunk if chunk else _batch_chunk(bpad, longest)
+    # The state-width-aware chunk derivation shared with megabatch: one
+    # ladder, one bounded (lane, events, state-width)-bucket chunk
+    # universe for both dispatch paths.
+    cc = chunk if chunk else mega_chunk(bpad, longest, model.state_size)
     evs = [events_array(p, cc) for p in preps]
     # >= 1 trailing NOP row per lane: finished lanes' cursors clamp onto
     # it (the gather-based engine reads events by each lane's absolute

@@ -12,10 +12,19 @@ barrier.
 This module keeps the device saturated instead:
 
 * **Bucket bin-packing.**  Prepared histories are packed into the
-  power-of-two bucket ladder (events x window x ghost-words, the same
-  ladder serve/buckets.py pins the compile cache to), so one compiled
-  engine serves every lane of a bucket and the shape universe stays
-  bounded.
+  power-of-two bucket ladder (events x window x ghost-words x
+  state-width, the same ladder serve/buckets.py pins the compile cache
+  to), so one compiled engine serves every lane of a bucket and the
+  shape universe stays bounded.
+* **Model-agnostic carries.**  The engine carry layout is the same for
+  every device model — only the packed ``states`` width varies — so any
+  model family with a registered carry descriptor
+  (``engine.plugins.has_carry_descriptor``; the
+  ``JaxModel.carry_descriptor()`` shape+dtype seam) bin-packs into this
+  loop: queue rings, set bitmasks, and txn-register key vectors ride
+  the same dispatch machinery as registers, with chunk and start
+  capacity damped per state-width rung (``engine.ladder.mega_chunk`` /
+  ``state_capacity``).
 * **Contiguous staging + double-buffered transfer.**  Each lane group's
   event streams live in ONE contiguous pinned host buffer; refills
   rewrite rows host-side and re-upload with an async ``device_put``
@@ -64,9 +73,8 @@ from jepsen_tpu.checker.wgl_tpu import (EV_NOP, _round_window, chosen_gwords,
                                         events_array, make_engine)
 from jepsen_tpu.history import History
 from jepsen_tpu.models.base import JaxModel
-from jepsen_tpu.parallel.batch import (MAX_LANES_PER_GROUP, _batch_chunk,
-                                       _CACHE, check_batch,
-                                       donate_carry_argnums)
+from jepsen_tpu.parallel.batch import (MAX_LANES_PER_GROUP, _CACHE,
+                                       check_batch, donate_carry_argnums)
 
 __all__ = ["check_megabatch", "megabatch_enabled", "megabatch_stats",
            "reset_megabatch_stats", "SUMMARY_WIDTH"]
@@ -177,15 +185,17 @@ def _read_harvest(dev) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _pow2_at_least(n: int, floor: int) -> int:
-    b = max(1, floor)
-    while b < n:
-        b *= 2
-    return b
+    # One rung definition for the whole stack: delegate to the shared
+    # ladder (resolved lazily — the serve import behind it would cycle at
+    # module-import time).
+    from jepsen_tpu.engine.ladder import pow2_at_least
+    return pow2_at_least(n, max(1, floor))
 
 
-def _prep_bucket(p, window_floor: int, ev_floor: int,
-                 gw_b: int) -> Tuple[int, int, int]:
-    """(events, window, gwords) bucket of one prepared history.
+def _prep_bucket(p, window_floor: int, ev_floor: int, gw_b: int,
+                 sw_b: int) -> Tuple[int, int, int, int]:
+    """(events, window, gwords, state-width) bucket of one prepared
+    history.
 
     Events and window are pure functions of the single history, so
     packing order and group makeup can never change the engine shape a
@@ -195,10 +205,14 @@ def _prep_bucket(p, window_floor: int, ev_floor: int,
     a lane's chosen ghost words is result-identical for that lane
     (LEAN_GHOST_MAX=0 means lean only ever runs zero-ghost histories),
     and one shared rung keeps a mixed call in one bucket instead of
-    fragmenting the lane groups on ghost count."""
+    fragmenting the lane groups on ghost count.  The state-width rung is
+    the model's packed-carry width off the state-width ladder — constant
+    per call (one model per call) but part of the key so the chunk and
+    start-capacity derivations downstream are pure functions of the
+    bucket tuple alone."""
     ev_b = _pow2_at_least(max(1, len(p)), max(64, ev_floor))
     w_b = _pow2_at_least(_round_window(max(p.window, window_floor)), 8)
-    return (ev_b, w_b, gw_b)
+    return (ev_b, w_b, gw_b, sw_b)
 
 
 def _call_gwords(preps) -> int:
@@ -206,9 +220,9 @@ def _call_gwords(preps) -> int:
     return 0 if gw == 0 else _pow2_at_least(gw, 1)
 
 
-def _default_capacity(ev_b: int, w_b: int) -> int:
-    from jepsen_tpu.serve.buckets import wgl_start_capacity
-    return wgl_start_capacity(ev_b, w_b)
+def _default_capacity(ev_b: int, w_b: int, sw_b: int) -> int:
+    from jepsen_tpu.engine.ladder import state_capacity
+    return state_capacity(ev_b, w_b, sw_b)
 
 
 # ---------------------------------------------------------------------------
@@ -380,10 +394,14 @@ def check_megabatch(model: JaxModel,
     preps = [prepare(h, model) for h in histories]
 
     gw_b = _call_gwords(preps)
-    buckets: "OrderedDict[Tuple[int, int, int], List[int]]" = OrderedDict()
+    from jepsen_tpu.engine.ladder import state_width_bucket
+    sw_b = state_width_bucket(model.state_size)
+    buckets: "OrderedDict[Tuple[int, int, int, int], List[int]]" = \
+        OrderedDict()
     for i, p in enumerate(preps):
-        buckets.setdefault(_prep_bucket(p, window_floor, ev_floor, gw_b),
-                           []).append(i)
+        buckets.setdefault(
+            _prep_bucket(p, window_floor, ev_floor, gw_b, sw_b),
+            []).append(i)
 
     out: List[Optional[Dict[str, Any]]] = [None] * len(histories)
     guard = jax.transfer_guard_device_to_host("disallow") \
@@ -401,17 +419,23 @@ def check_megabatch(model: JaxModel,
 def _drain_bucket(model, histories, preps, bucket, idxs, out, *,
                   capacity, max_capacity, lanes, chunk, depth,
                   refill_quantum, group_reuse) -> None:
-    """Run every history of one (events, window, gwords) bucket through
-    a refilled set of lane groups, writing results into ``out``."""
-    ev_b, w_b, gw_b = bucket
+    """Run every history of one (events, window, gwords, state-width)
+    bucket through a refilled set of lane groups, writing results into
+    ``out``."""
+    from jepsen_tpu.engine.ladder import mega_chunk
+    ev_b, w_b, gw_b, sw_b = bucket
     _bump(buckets=1)
     width = min(_pow2_at_least(min(len(idxs), lanes), 1),
                 MAX_LANES_PER_GROUP)
-    cc = chunk if chunk else _batch_chunk(width, ev_b)
+    # Chunk and start capacity come off the state-width-aware ladder
+    # shared with check_batch: pure functions of the bucket tuple, so a
+    # queue ring and a register cell compile into the same bounded shape
+    # universe (just on different state rungs).
+    cc = chunk if chunk else mega_chunk(width, ev_b, sw_b)
     # Buffer rows are a pure function of the bucket (+1 trailing NOP row
     # that finished cursors clamp onto), never of the lanes present.
     rows = max(cc, ((ev_b + cc - 1) // cc) * cc) + 1
-    cap = capacity if capacity else _default_capacity(ev_b, w_b)
+    cap = capacity if capacity else _default_capacity(ev_b, w_b, sw_b)
     cap = min(cap, max_capacity)
     n_groups = max(1, min((len(idxs) + width - 1) // width,
                           max(1, lanes // width)))

@@ -136,6 +136,25 @@ def mega_lane_bucket(n_lanes: int, cap: int = MAX_MEGA_LANES) -> int:
     return min(pow2_at_least(max(1, n_lanes), 1), cap)
 
 
+#: floor of the model state-width ladder: packed per-configuration model
+#: states (register scalars, queue rings, set bitmask words, txn-register
+#: key vectors) quantize onto pow2 widths starting here, so the carry
+#: layout the megabatch path compiles for is a pure function of the
+#: bucket — a queue sized by ``derive_queue_slots`` and a bare register
+#: land on the SAME finite rung set.
+MIN_STATE_WIDTH_BUCKET = 4
+
+
+def state_width_bucket(state_width: int) -> int:
+    """The pow2 rung for a model's packed int32 state width (the
+    ``JaxModel.state_size`` axis of the megabatch carry).  Model sizing
+    hooks (``derive_queue_slots`` etc.) already emit pow2 sizes, so this
+    collapses the per-model width spread onto a handful of rungs shared
+    by every model family — the state axis of the bounded shape universe
+    megabatch and ``check_batch`` dispatch from."""
+    return pow2_at_least(max(1, state_width), MIN_STATE_WIDTH_BUCKET)
+
+
 #: floor / ceiling of the derived wgl start-capacity ladder
 MIN_WGL_CAPACITY = 64
 MAX_WGL_CAPACITY = 65536

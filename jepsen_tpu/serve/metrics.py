@@ -7,7 +7,10 @@ document (docs/observability.md) and assert on in the tests:
 
 - counters: requests/cells through each lifecycle edge, deadline
   expiries, admission rejections, dispatches, host fallbacks;
-- gauges: queue depth and in-flight requests, sampled live;
+- gauges: queue depth and in-flight requests, sampled live, plus the
+  steady-state ``compiles-per-1k-dispatches`` ratio (process-wide
+  compile events over barrier + megabatch dispatches — 0.0 once the
+  shape ladder is warm);
 - occupancy: used vs padded lanes per dispatch, summed — the price of
   shape bucketing, as a ratio;
 - histograms: log-bucketed (pow2 ladder, jepsen_tpu.obs.hist) latency
@@ -51,7 +54,8 @@ from typing import Any, Dict, List, Optional
 # without importing serve); re-exported here because every serve/ and
 # monitor/ module already imports it from metrics.
 from jepsen_tpu.clock import mono_now  # noqa: F401
-from jepsen_tpu.obs.hist import HistogramSet, compile_hist_stats
+from jepsen_tpu.obs.hist import (HistogramSet, compile_event_count,
+                                 compile_hist_stats)
 
 
 class Metrics:
@@ -135,6 +139,16 @@ class Metrics:
             dispatch_s = self._dispatch_s
             traces = list(self._traces)
         cache = engine_cache_stats()
+        mega = megabatch_stats()
+        # Steady-state compile pressure: compile events per 1000 engine
+        # dispatches (scheduler barrier dispatches + megabatch chunk
+        # dispatches), process-wide like the compile histograms that
+        # feed it.  A warm ladder serves at 0.0; anything persistently
+        # above it means a shape is leaking past the buckets.  None
+        # until the first dispatch.
+        disp = counters.get("dispatches", 0) + mega.get("dispatches", 0)
+        compiles_1k = round(1000.0 * compile_event_count() / disp, 3) \
+            if disp else None
         # gauges sample live state here — after counter capture, outside
         # our lock (the callbacks take scheduler/fleet locks that must
         # not nest inside the metrics leaf); see the module docstring
@@ -145,6 +159,7 @@ class Metrics:
                 "queue-depth": self._depth_fn() if self._depth_fn else 0,
                 "inflight-requests":
                     self._inflight_fn() if self._inflight_fn else 0,
+                "compiles-per-1k-dispatches": compiles_1k,
             },
             "occupancy": {
                 "lanes-used": used,
@@ -154,7 +169,7 @@ class Metrics:
             },
             "histograms": {**self.hists.snapshot(), **compile_hist_stats()},
             "engine-cache": {**cache, "recompiles": cache["misses"]},
-            "megabatch": megabatch_stats(),
+            "megabatch": mega,
             "fission": {**fission.fission_stats(),
                         "histograms": fission.HISTS.snapshot()},
             "flight-recorder": RECORDER.stats(),

@@ -191,11 +191,23 @@ class Scheduler:
         (parallel.megabatch) when it is enabled: their steady-state
         traffic is thousands of short per-key lanes, exactly the shape
         the continuous-refill pipeline wins on.  Large event buckets and
-        mesh-sharded dispatches keep the barrier path."""
+        mesh-sharded dispatches keep the barrier path.
+
+        Which model families qualify is the carry-descriptor registry
+        (``engine.plugins.has_carry_descriptor``) — any family that
+        registered its packed-carry descriptor bin-packs, not a
+        hard-coded register list.  A family without one is never
+        rejected: it simply falls back to the ``check_batch`` barrier
+        path this method gates."""
         from jepsen_tpu.parallel.megabatch import megabatch_enabled
-        return (self.mesh is None and megabatch_enabled()
+        if not (self.mesh is None and megabatch_enabled()
                 and len(bucket) >= 4 and bucket[0] == KIND_WGL
-                and bucket[2] <= buckets.MEGA_EVENTS_MAX)
+                and bucket[2] <= buckets.MEGA_EVENTS_MAX):
+            return False
+        from jepsen_tpu.engine.plugins import has_carry_descriptor
+        ident = bucket[1]
+        name = ident[0] if isinstance(ident, tuple) and ident else ident
+        return has_carry_descriptor(str(name))
 
     def _group_limit(self, bucket: Tuple) -> int:
         """Lanes to pop for one dispatch of this bucket: the megabatch

@@ -521,6 +521,38 @@ class TestTraceTier:
                                              "fixture engine")
         assert fs_ok == []
 
+    def test_state_width_leak_fixture_pair(self):
+        # the state-width axis through the real derivations: a signature
+        # built from the quantized bucket is stable (negative fixture),
+        # one threading the RAW model width into chunk/capacity fans a
+        # bucket out into many signatures (positive fixture).
+        from jepsen_tpu.engine.ladder import mega_chunk, state_capacity
+        from jepsen_tpu.lint.jaxpr_lint import signature_stability_findings
+        from jepsen_tpu.serve import buckets
+        # several raw widths per rung: 5..8 share the 8-rung, 9..16 the 16
+        samples = [(64, 8, w) for w in (5, 6, 7, 8, 9, 12, 16, 17, 30)]
+
+        def bucket(s):
+            return (s[0], s[1], buckets.state_width_bucket(s[2]))
+
+        def good_signature(s):
+            # mega_chunk/state_capacity quantize internally — same rung,
+            # same compiled shape
+            return (mega_chunk(64, s[0], s[2]),
+                    state_capacity(s[0], s[1], s[2]))
+
+        assert signature_stability_findings(
+            samples, good_signature, bucket, "state-width fixture") == []
+
+        def leaking_signature(s):
+            return (mega_chunk(64, s[0], s[2]),
+                    s[2])            # raw width reaches the jit boundary
+
+        fs = signature_stability_findings(
+            samples, leaking_signature, bucket, "state-width fixture")
+        assert len(fs) == 1
+        assert "raw shape is leaking" in fs[0].message
+
     def test_real_ladder_is_stable(self):
         from jepsen_tpu.lint.jaxpr_lint import ladder_findings
         assert ladder_findings() == []

@@ -181,6 +181,166 @@ class TestLaneLadder:
         assert mb.staging_depth_default() == 2
 
 
+class TestStateWidthLadder:
+    """The state-width rungs of the chunk/capacity ladder: a finite
+    bucket universe that the model sizing hooks land on, with every
+    derived component a pure function of the bucket tuple."""
+
+    def test_bucket_universe_is_finite(self):
+        widths = list(range(1, 130)) + [200, 500, 1000, 2000, 4096]
+        rungs = {buckets.state_width_bucket(w) for w in widths}
+        assert rungs == {4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                         2048, 4096}
+        assert all(r >= buckets.MIN_STATE_WIDTH_BUCKET
+                   and (r & (r - 1)) == 0 for r in rungs)
+
+    def test_derive_queue_slots_lands_on_ladder(self):
+        from jepsen_tpu.engine.model_plugin import derive_queue_slots
+        from jepsen_tpu.synth import queue_history
+        for seed in range(8):
+            h = queue_history(n_ops=10 + 7 * seed, concurrency=2,
+                              seed=seed)
+            slots = derive_queue_slots(h, {})["slots"]
+            assert slots & (slots - 1) == 0 and slots >= 8
+            # the compiled ring width (2 header + slots) quantizes onto
+            # the same pow2 state ladder the chunk/capacity key on
+            width = 2 + slots
+            assert buckets.state_width_bucket(width) \
+                == buckets.pow2_at_least(width,
+                                         buckets.MIN_STATE_WIDTH_BUCKET)
+
+    def test_chunk_and_capacity_pure_functions_of_bucket(self):
+        from jepsen_tpu.engine.ladder import mega_chunk, state_capacity
+        # raw widths sharing a rung derive identical chunk/capacity
+        for a, b in ((5, 8), (9, 16), (17, 32), (33, 64)):
+            assert buckets.state_width_bucket(a) \
+                == buckets.state_width_bucket(b)
+            assert mega_chunk(64, 128, a) == mega_chunk(64, 128, b)
+            assert state_capacity(128, 8, a) == state_capacity(128, 8, b)
+        # the register rung is undamped: exactly the PR 6 derivations
+        assert mega_chunk(64, 128, 1) == pbatch._batch_chunk(64, 128)
+        assert state_capacity(64, 8, 1) == buckets.wgl_start_capacity(64, 8)
+        # wider rungs damp monotonically and never break the floors
+        caps = [state_capacity(64, 8, w) for w in (1, 8, 34, 128)]
+        assert caps == sorted(caps, reverse=True)
+        assert all(c >= buckets.MIN_WGL_CAPACITY for c in caps)
+        chunks = [mega_chunk(64, 2048, w) for w in (1, 8, 34, 128)]
+        assert chunks == sorted(chunks, reverse=True)
+        assert all(c >= 64 and c % 64 == 0 for c in chunks)
+
+
+class TestPluginModelParity:
+    """Queue/set/opacity lanes through megabatch: lane-for-lane parity
+    with check_batch AND the CPU oracle, over valid + corrupt + crash
+    lanes, plus the overflow-escalation leg at a starved capacity."""
+
+    @staticmethod
+    def _families():
+        from jepsen_tpu.engine.model_plugin import derive_queue_slots
+        from jepsen_tpu.engine.opacity import derive_history
+        from jepsen_tpu.synth import (corrupt_queue, corrupt_set,
+                                      corrupt_txn_reads, queue_history,
+                                      set_history, txn_history)
+        qs = [queue_history(n_ops=24, concurrency=2, crash_p=0.01,
+                            seed=s) for s in range(6)]
+        qs[2] = corrupt_queue(qs[2], mode="lost", seed=2)
+        qs[5] = corrupt_queue(qs[5], mode="duplicated", seed=5)
+        slots = max(derive_queue_slots(h, {})["slots"] for h in qs)
+        ss = [set_history(n_ops=24, concurrency=3, crash_p=0.01, seed=s)
+              for s in range(6)]
+        ss[1] = corrupt_set(ss[1], mode="phantom", seed=1)
+        ss[4] = corrupt_set(ss[4], mode="lost", seed=4)
+        ts = [txn_history(n_txns=12, concurrency=3, crash_p=0.01, seed=s)
+              for s in range(6)]
+        ts[3] = corrupt_txn_reads(ts[3], n=1, seed=3, target="ok")
+        return [
+            ("fifo-queue", get_model("fifo-queue", slots=slots), qs),
+            ("set", get_model("set"), ss),
+            ("txn-register", get_model("txn-register"),
+             [derive_history(h) for h in ts]),
+        ]
+
+    def test_lane_for_lane_parity(self):
+        for name, model, hs in self._families():
+            ref = check_batch(model, hs)
+            got = check_megabatch(model, hs, lanes=4)
+            assert [result_key(r) for r in got] \
+                == [result_key(r) for r in ref], name
+            for i, (h, g) in enumerate(zip(hs, got)):
+                oracle = wgl_cpu.check(model.cpu_model(), h)
+                assert g["valid"] == oracle["valid"], (name, i)
+            assert any(g["valid"] is False for g in got), name
+
+    def test_overflow_escalation_parity(self):
+        # Starved capacity: queue frontiers blow through 8 configs, so
+        # lanes retire with the overflow sentinel and re-run through the
+        # barrier path — verdicts must not move.
+        name, model, hs = self._families()[0]
+        ref = [result_key(r) for r in check_batch(model, hs)]
+        reset_megabatch_stats()
+        got = check_megabatch(model, hs, lanes=4, capacity=8)
+        assert megabatch_stats()["escalated_lanes"] > 0
+        assert [result_key(r) for r in got] == ref
+
+
+class TestRoutingRegistry:
+    """scheduler._mega_eligible consults the carry-descriptor registry
+    (engine.plugins), never a hard-coded model family — and a family
+    without a descriptor falls back to check_batch, never rejected."""
+
+    @staticmethod
+    def _sched():
+        from jepsen_tpu.serve.metrics import Metrics
+        from jepsen_tpu.serve.scheduler import Scheduler
+        return Scheduler(metrics=Metrics(), max_lanes=8)
+
+    def test_registered_families_are_eligible(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "1")
+        s = self._sched()
+        for ident in (("cas-register", ()), ("fifo-queue", (16,)),
+                      ("set", ()), ("txn-register", (3, 4)),
+                      ("multi-register", (3, 4))):
+            assert s._mega_eligible(("wgl", ident, 64, 8)), ident
+
+    def test_unregistered_family_falls_back(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "1")
+        s = self._sched()
+        assert not s._mega_eligible(("wgl", ("no-such-model", ()), 64, 8))
+        # fallback is the barrier path, not a rejection: the group limit
+        # stays a real dispatch width
+        assert s._group_limit(("wgl", ("no-such-model", ()), 64, 8)) \
+            == s.max_lanes
+
+    def test_other_gates_still_hold(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "1")
+        s = self._sched()
+        # elle cells and oversized event buckets keep the barrier path
+        assert not s._mega_eligible(("elle", ("fifo-queue", ()), 64))
+        assert not s._mega_eligible(
+            ("wgl", ("cas-register", ()),
+             buckets.MEGA_EVENTS_MAX * 2, 8))
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "0")
+        assert not s._mega_eligible(("wgl", ("cas-register", ()), 64, 8))
+
+    def test_plugin_model_routes_through_service(self, monkeypatch):
+        from jepsen_tpu.engine.model_plugin import derive_queue_slots
+        from jepsen_tpu.serve import CheckService
+        from jepsen_tpu.synth import queue_history
+        monkeypatch.setenv("JEPSEN_TPU_MEGABATCH", "1")
+        hs = [queue_history(n_ops=20, concurrency=2, seed=200 + i)
+              for i in range(4)]
+        slots = max(derive_queue_slots(h, {})["slots"] for h in hs)
+        model = get_model("fifo-queue", slots=slots)
+        with CheckService(max_lanes=8) as svc:
+            reqs = [svc.submit(h, kind="wgl", model=model) for h in hs]
+            rs = [r.wait(timeout=300.0) for r in reqs]
+            snap = svc.metrics.snapshot()
+        assert all(r["valid"] is True for r in rs)
+        assert snap["counters"].get("megabatch-dispatches", 0) > 0
+        # the steady-state compile gauge rides the same snapshot
+        assert snap["gauges"]["compiles-per-1k-dispatches"] is not None
+
+
 class TestSchedulerRouting:
     def test_small_wgl_cells_route_megabatch(self, monkeypatch):
         from jepsen_tpu.serve import CheckService

@@ -435,7 +435,11 @@ class TestMetricsSchema:
         snap = svc.metrics.snapshot()
         assert set(snap) == self.SECTIONS
         assert set(snap["counters"]) >= self.SEED_COUNTERS
-        assert set(snap["gauges"]) == {"queue-depth", "inflight-requests"}
+        assert set(snap["gauges"]) == {"queue-depth", "inflight-requests",
+                                       "compiles-per-1k-dispatches"}
+        # the steady-state compile gauge is a ratio (or None pre-dispatch)
+        c1k = snap["gauges"]["compiles-per-1k-dispatches"]
+        assert c1k is None or c1k >= 0.0
         assert {"lanes-used", "lanes-padded", "ratio",
                 "dispatch-seconds"} <= set(snap["occupancy"])
         assert {"enabled", "capacity", "recorded", "buffered",
@@ -483,8 +487,14 @@ class TestMetricsSchema:
                     if v < last.get(k, 0):
                         errors.append(f"counter {k} went backwards")
                     last[k] = v
-                for g in snap["gauges"].values():
-                    if not isinstance(g, int) or g < 0:
+                for name, g in snap["gauges"].items():
+                    if name == "compiles-per-1k-dispatches":
+                        # a ratio gauge: None before the first dispatch,
+                        # then a non-negative float
+                        if g is not None and not (isinstance(g, float)
+                                                  and g >= 0.0):
+                            errors.append(f"compile gauge torn: {g}")
+                    elif not isinstance(g, int) or g < 0:
                         errors.append(f"gauge not a point sample: {g}")
         finally:
             stop.set()
