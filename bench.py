@@ -31,7 +31,10 @@ so this bench *measures* the CPU tier (wgl_cpu, the knossos-role oracle) on
             elle oracle, with the same device-vs-socket comparison as batch
   obs       observability toll: the same warmed serving campaign with the
             flight recorder off vs on (budget: <2% overhead), plus nonzero
-            p50/p99 on the enqueue→dispatch / dispatch→verdict histograms
+            p50/p99 on the enqueue→dispatch / dispatch→verdict histograms;
+            the same shape for the Watchtower telemetry plane (push
+            cadence off vs on through a ProcFleet, budget: <2%), and the
+            monitor's epoch spans must land in the merged Perfetto export
 
 **Isolation:** every tier runs in its own subprocess with its own timeout; a
 tier that crashes the TPU worker (or hangs) degrades to a per-tier
@@ -925,9 +928,17 @@ def tier_obs():
     then on — the ratio is the toll the ISSUE budget caps at 2% — and
     the latency histograms filled along the way must report nonzero
     p50/p99 for the two headline lifecycle edges (enqueue→dispatch,
-    dispatch→verdict), or the instrument measured nothing."""
+    dispatch→verdict), or the instrument measured nothing.  Then the
+    same off-vs-on shape for the Watchtower telemetry plane: a warmed
+    ProcFleet campaign with pushes disabled vs pushing at a fast
+    cadence (same <2% budget), and finally a short monitored check so
+    the monitor's per-epoch spans provably land in the merged Perfetto
+    export next to the serving spans."""
+    from jepsen_tpu.models import CASRegister
+    from jepsen_tpu.monitor import Monitor
     from jepsen_tpu.obs.recorder import RECORDER
     from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.serve.fleet import ProcFleet
     from jepsen_tpu.synth import cas_register_history
     n = 24 if SMOKE else 96
     reps = 2 if SMOKE else 3
@@ -964,6 +975,55 @@ def tier_obs():
         edges[edge] = {"count": h.get("count"),
                        "p50_s": h.get("p50"), "p99_s": h.get("p99")}
     overhead = (t_on / t_off - 1.0) if t_off else None
+
+    # -- Watchtower: what the telemetry push plane costs -------------------
+    # Same min-of-reps off-vs-on shape, but through a ProcFleet (the
+    # telemetry plane lives in the fleet tier): telemetry_s=0 disables
+    # both the worker push loops and the fleet sweep entirely.
+    n_tele = 12 if SMOKE else 48
+    tele_hists = [cas_register_history(60, concurrency=4, seed=1000 + s)
+                  for s in range(n_tele)]
+
+    def fleet_run(fleet):
+        t0 = time.time()
+        reqs = [fleet.submit(h, kind="wgl", model="cas-register",
+                             deadline_s=120.0) for h in tele_hists]
+        for r in reqs:
+            assert r.wait(timeout=300)["valid"] is True
+        return time.time() - t0
+
+    def fleet_wall(telemetry_s):
+        fleet = ProcFleet(workers=3, spawn=False, max_lanes=32,
+                          capacity=64, default_deadline_s=120.0,
+                          telemetry_s=telemetry_s)
+        try:
+            fleet_run(fleet)                # warm this fleet's lanes
+            wall = min(fleet_run(fleet) for _ in range(reps))
+            pushes = fleet.telemetry.push_count("fleet")
+        finally:
+            fleet.close(timeout=60.0)
+        return wall, pushes
+
+    t_tele_off, pushes_off = fleet_wall(0.0)
+    t_tele_on, pushes_on = fleet_wall(0.25)
+    assert pushes_off == 0, "telemetry_s=0 must fully disable the plane"
+    assert pushes_on > 0, "telemetry plane pushed nothing while enabled"
+    tele_overhead = ((t_tele_on / t_tele_off - 1.0)
+                     if t_tele_off else None)
+
+    # -- monitor epoch spans in the merged export --------------------------
+    RECORDER.enable()
+    mon = Monitor(kind="wgl", model=CASRegister())
+    for op in cas_register_history(300, concurrency=4, seed=7):
+        mon.offer(op)
+    mon.flush()
+    mon.close()
+    chrome = RECORDER.chrome_events()
+    mon_spans = [e for e in chrome
+                 if e["cat"] == "monitor" and e.get("ph") == "X"]
+    assert mon_spans, ("monitor epoch spans missing from the merged "
+                       "Perfetto export")
+
     emit({"n_histories": n,
           "recorder_off_s": round(t_off, 3),
           "recorder_on_s": round(t_on, 3),
@@ -971,7 +1031,14 @@ def tier_obs():
                                 if overhead is not None else None),
           "events_recorded": rec["recorded"],
           "events_buffered": rec["buffered"],
-          "edges": edges})
+          "edges": edges,
+          "n_telemetry_histories": n_tele,
+          "telemetry_off_s": round(t_tele_off, 3),
+          "telemetry_on_s": round(t_tele_on, 3),
+          "telemetry_overhead": (round(tele_overhead, 4)
+                                 if tele_overhead is not None else None),
+          "telemetry_pushes": pushes_on,
+          "monitor_epoch_spans": len(mon_spans)})
 
 
 TIER_FNS = {

@@ -140,6 +140,14 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                          "dialed through a chaos-controllable net_proxy "
                          "link; implies the fleet path even with "
                          "--workers 1")
+    ps.add_argument("--telemetry-s", type=float, default=None,
+                    help="worker telemetry push interval in seconds "
+                         "(default JEPSEN_TPU_TELEMETRY_S or 1.0; <= 0 "
+                         "disables the push plane)")
+    ps.add_argument("--recorder", action="store_true",
+                    help="arm the flight recorder at startup (fleet-wide "
+                         "with --procs); also togglable at runtime via "
+                         "POST /recorder?on=1")
 
     pq = sub.add_parser("submit",
                         help="submit a stored history to a running serve")
@@ -217,12 +225,20 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                                     store_base=args.store,
                                     journal_dir=jdir,
                                     max_lanes=args.max_lanes,
-                                    max_queue_cells=args.max_queue)
+                                    max_queue_cells=args.max_queue,
+                                    telemetry_s=args.telemetry_s)
             else:
                 from jepsen_tpu.serve import CheckService
                 service = CheckService(store_base=args.store,
                                        max_lanes=args.max_lanes,
                                        max_queue_cells=args.max_queue)
+        if args.recorder:
+            setter = getattr(service, "set_recorder", None)
+            if setter is not None:
+                setter(True)
+            else:
+                from jepsen_tpu.obs.recorder import RECORDER
+                RECORDER.enable()
         # SIGTERM must reach the finally below: with --procs the workers
         # are setsid'd OS processes — dying without service.close() would
         # orphan them (SIGINT already raises KeyboardInterrupt).

@@ -23,10 +23,11 @@ The declared order mirrors the call graph today:
     router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
     engine-cache (leaf: engine.cache's shared LRU, acquired under anything)
-    obs-hist, obs-recorder (leaves: the histogram set's and flight
-      recorder's own locks — observe/record is called from under
-      scheduler/fleet/metrics code, so these must never wrap another
-      declared lock)
+    obs-hist, obs-recorder, obs-telemetry, obs-slo (leaves: the
+      histogram set's, flight recorder's, telemetry store's, and SLO
+      engine's own locks — observe/record/push is called from under
+      scheduler/fleet/metrics code and from wire reader threads, so
+      these must never wrap another declared lock)
 
 The transport chain follows a respawn end to end: the ProcFleet
 supervisor (``_sup_lock``) restarts a slot (``_restart_lock``), whose
@@ -83,9 +84,15 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
     ("engine-cache",
      [(r"engine/cache\.py$", r"^self\._lock$")]),
     ("obs-hist",
-     [(r"obs/hist\.py$", r"^self\._lock$")]),
+     [(r"obs/hist\.py$", r"^self\._lock$"),
+      (r"obs/hist\.py$", r"^_MERGE_LOCK$")]),
     ("obs-recorder",
      [(r"obs/recorder\.py$", r"^self\._lock$")]),
+    ("obs-telemetry",
+     [(r"obs/telemetry\.py$", r"^self\._lock$"),
+      (r"obs/telemetry\.py$", r"^_GAUGE_LOCK$")]),
+    ("obs-slo",
+     [(r"obs/slo\.py$", r"^self\._lock$")]),
 )
 
 

@@ -46,6 +46,8 @@ from jepsen_tpu.history import History, Op
 from jepsen_tpu.monitor.epochs import ElleEpochEngine, WglEpochEngine
 from jepsen_tpu.monitor.tap import DEFAULT_CAPACITY, OpTap
 from jepsen_tpu.monitor.verdict import VerdictChannel
+from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.obs.telemetry import set_gauge
 from jepsen_tpu.serve.metrics import mono_now
 
 logger = logging.getLogger("jepsen.monitor")
@@ -219,15 +221,29 @@ class Monitor:
             ops = self.tap.drain()
             if not ops:
                 return None
+            t_start = mono_now()
             self.engine.feed(ops)
             n = len(self.epochs) + 1
             refutations = self._advance(n)
+            wall = mono_now() - t_start
             rec = {"epoch": n, "t": round(mono_now() - self.t0, 6),
                    "new-ops": len(ops), **self.engine.counters()}
             if refutations:
                 rec["refuted"] = refutations
             self.epochs.append(rec)
-            return rec
+        # Instrumentation rides outside the flush lock (recorder and
+        # gauge table are leaf locks, but there is no reason to hold the
+        # epoch state across them): one "monitor" span per epoch in the
+        # flight recorder — visible in the merged Perfetto export — and
+        # the monitor-lag gauge (ops accepted but not yet folded into a
+        # verdict epoch) for the telemetry plane.
+        set_gauge("epochs-behind-live", int(rec.get("pending-ops", 0)))
+        RECORDER.record(
+            "monitor", f"epoch:{self.kind}:{self.name}:{n}", dur_s=wall,
+            args={"epoch": n, "new-ops": rec["new-ops"],
+                  "pending-ops": rec.get("pending-ops", 0),
+                  "refuted": bool(refutations)})
+        return rec
 
     def _advance(self, epoch: int) -> List[Any]:
         if self.kind == "wgl":
@@ -286,6 +302,14 @@ class Monitor:
                              "configs-explored") if k in post},
             }
             self.finalized = True
+            tail = len(ops)
+        # final drain folded everything in: the lag gauge settles at the
+        # engine's residual (0 for wgl, open invocations for elle)
+        set_gauge("epochs-behind-live",
+                  int(self.engine.counters().get("pending-ops", 0)))
+        RECORDER.record(
+            "monitor", f"epoch:{self.kind}:{self.name}:final",
+            args={"tail-ops": tail})
         from jepsen_tpu.monitor import resume
         resume.save(self)
         with _REG_LOCK:

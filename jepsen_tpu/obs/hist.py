@@ -101,19 +101,44 @@ class HistogramSet:
                     for name, h in sorted(self._hists.items())}
 
 
+#: process-wide count of malformed per-histogram entries dropped by
+#: ``merge_hist_snapshots`` — silently skipping a worker's corrupt
+#: histogram is the right availability call (a scrape must not fail
+#: because one worker was mid-crash), but the drop has to be visible
+#: somewhere, so it lands in every ``Metrics.snapshot()``'s counters as
+#: ``hist-merge-skipped``.  Whole-snapshot ``None`` (the "worker
+#: unreachable" convention) is NOT counted: that is the protocol, not
+#: corruption.
+_MERGE_LOCK = threading.Lock()
+_MERGE_SKIPPED = 0
+
+
+def _note_merge_skip(n: int = 1) -> None:
+    global _MERGE_SKIPPED
+    with _MERGE_LOCK:
+        _MERGE_SKIPPED += n
+
+
+def merge_skipped_count() -> int:
+    with _MERGE_LOCK:
+        return _MERGE_SKIPPED
+
+
 def merge_hist_snapshots(
         snaps: Iterable[Optional[Dict[str, Dict[str, Any]]]],
 ) -> Dict[str, Dict[str, Any]]:
     """Bucket-wise merge of ``HistogramSet.snapshot()`` documents from
     several processes into one fleet-wide document.  Identical ladders
-    make the merge exact; malformed entries are skipped (a scrape must
-    not fail because one worker was mid-crash)."""
+    make the merge exact; malformed entries are skipped — and counted
+    (``merge_skipped_count``) — so a scrape neither fails because one
+    worker was mid-crash nor hides that its data was dropped."""
     merged: Dict[str, Histogram] = {}
     for snap in snaps:
         if not isinstance(snap, dict):
             continue
         for name, s in snap.items():
             if not isinstance(s, dict):
+                _note_merge_skip()
                 continue
             try:
                 buckets = {int(b): int(n)
@@ -121,6 +146,7 @@ def merge_hist_snapshots(
                 count = int(s.get("count", 0))
                 sum_s = float(s.get("sum-s", 0.0))
             except (TypeError, ValueError):
+                _note_merge_skip()
                 continue
             h = merged.get(name)
             if h is None:

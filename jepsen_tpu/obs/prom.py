@@ -1,0 +1,208 @@
+"""Prometheus text-exposition rendering of a ``Metrics.snapshot()``.
+
+The pow2 histogram ladder (obs/hist.py) maps directly onto Prometheus
+histogram conventions: every bucket upper bound (microseconds) becomes a
+cumulative ``le`` label in seconds, with the mandatory ``+Inf`` bucket
+equal to the total count.  Because every process shares the identical
+ladder, the fleet-merged histograms render exactly like single-process
+ones — no re-bucketing, no quantile loss beyond the pow2 resolution the
+ladder already has.
+
+Naming is mechanical and therefore stable: ``metric_name`` lowercases,
+squashes every non-``[a-zA-Z0-9_]`` rune to ``_``, prefixes
+``jepsen_tpu_``, and suffixes by kind (``_total`` for counters,
+``_seconds`` for histograms).  The TestMetricsSchema prom test pins that
+every counter/gauge/histogram in the snapshot appears under this
+mapping, so a rename here is a deliberate, test-visible act.
+
+``validate_exposition`` is the minimal line-format validator the tests
+and the telemetry smoke round-trip the output through: it checks the
+comment grammar, the sample-line grammar, label syntax, and histogram
+bucket monotonicity — the properties a real scraper would reject on.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+#: fixed metric prefix
+PREFIX = "jepsen_tpu"
+
+_SAN_RE = re.compile(r"[^a-zA-Z0-9_]+")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$")
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def sanitize(name: str) -> str:
+    out = _SAN_RE.sub("_", name.strip().lower()).strip("_")
+    return out or "unnamed"
+
+
+def metric_name(kind: str, name: str) -> str:
+    """The stable exposition name for one snapshot entry.  ``kind`` is
+    ``counter`` / ``gauge`` / ``histogram``."""
+    base = f"{PREFIX}_{sanitize(name)}"
+    if kind == "counter":
+        return f"{base}_total"
+    if kind == "histogram":
+        return f"{base}_seconds"
+    return base
+
+
+def _fmt(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _esc(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _hist_lines(name: str, h: Dict[str, Any]) -> List[str]:
+    full = metric_name("histogram", name)
+    lines = [f"# HELP {full} {_help_text(name)}",
+             f"# TYPE {full} histogram"]
+    try:
+        buckets = sorted((int(b), int(n))
+                         for b, n in (h.get("buckets-us") or {}).items())
+        count = int(h.get("count", 0))
+        sum_s = float(h.get("sum-s", 0.0))
+    except (TypeError, ValueError):
+        return []
+    cum = 0
+    for upper_us, n in buckets:
+        cum += n
+        lines.append(f'{full}_bucket{{le="{repr(upper_us / 1e6)}"}} {cum}')
+    lines.append(f'{full}_bucket{{le="+Inf"}} {count}')
+    lines.append(f"{full}_sum {repr(sum_s)}")
+    lines.append(f"{full}_count {count}")
+    return lines
+
+
+def _help_text(name: str) -> str:
+    return f"jepsen-tpu snapshot entry {_esc(name)}"
+
+
+def render_prom(snap: Dict[str, Any]) -> str:
+    """One ``Metrics.snapshot()`` (service- or fleet-shaped) as
+    Prometheus text exposition (content type
+    ``text/plain; version=0.0.4``)."""
+    lines: List[str] = []
+
+    for name, v in sorted((snap.get("counters") or {}).items()):
+        full = metric_name("counter", name)
+        lines.append(f"# HELP {full} {_help_text(name)}")
+        lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full} {_fmt(v)}")
+
+    for name, v in sorted((snap.get("gauges") or {}).items()):
+        if v is None:
+            continue   # e.g. compiles-per-1k before the first dispatch
+        full = metric_name("gauge", name)
+        lines.append(f"# HELP {full} {_help_text(name)}")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {_fmt(v)}")
+
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        if isinstance(h, dict):
+            lines.extend(_hist_lines(name, h))
+
+    # fleet-only extras: per-worker staleness + alert volume, so one
+    # scrape of the fleet endpoint carries the whole Watchtower state
+    tele = snap.get("telemetry")
+    if isinstance(tele, dict):
+        full = f"{PREFIX}_worker_stale"
+        lines.append(f"# HELP {full} 1 when the worker has missed 2+ "
+                     "telemetry intervals")
+        lines.append(f"# TYPE {full} gauge")
+        for wid, entry in sorted((tele.get("workers") or {}).items()):
+            stale = 1 if (isinstance(entry, dict) and entry.get("stale")) \
+                else 0
+            lines.append(f'{full}{{worker="{_esc(wid)}"}} {stale}')
+    slo = snap.get("slo")
+    if isinstance(slo, dict):
+        full = f"{PREFIX}_slo_alerts_total"
+        lines.append(f"# HELP {full} SLO alerts fired since start")
+        lines.append(f"# TYPE {full} counter")
+        lines.append(f"{full} {int(slo.get('fired-total', 0))}")
+
+    return "\n".join(lines) + "\n"
+
+
+def validate_exposition(text: str) -> Dict[str, List[Tuple[str, Dict[str, str], float]]]:
+    """Minimal Prometheus text-format validator: raises ``ValueError``
+    on any malformed line; returns ``{family: [(sample_name, labels,
+    value), ...]}`` for assertions.  Checks line grammar, label syntax,
+    TYPE declarations, and histogram bucket monotonicity."""
+    families: Dict[str, List[Tuple[str, Dict[str, str], float]]] = {}
+    types: Dict[str, str] = {}
+    for ln, raw in enumerate(text.splitlines(), start=1):
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {ln}: malformed comment: {raw!r}")
+            if parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in _TYPES:
+                    raise ValueError(f"line {ln}: bad TYPE: {raw!r}")
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {ln}: malformed sample: {raw!r}")
+        name = m.group("name")
+        labels: Dict[str, str] = {}
+        body = (m.group("labels") or "{}")[1:-1].strip()
+        if body:
+            for pair in body.split(","):
+                lm = _LABEL_RE.match(pair.strip())
+                if lm is None:
+                    raise ValueError(f"line {ln}: bad label {pair!r}")
+                labels[lm.group(1)] = lm.group(2)
+        value = float(m.group("value").replace("Inf", "inf"))
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base is not None and types.get(base) == "histogram":
+                family = base
+                break
+        families.setdefault(family, []).append((name, labels, value))
+    for family, samples in families.items():
+        if types.get(family) != "histogram":
+            continue
+        _validate_hist(family, samples)
+    return families
+
+
+def _validate_hist(family: str,
+                   samples: List[Tuple[str, Dict[str, str], float]]) -> None:
+    buckets: List[Tuple[float, float]] = []
+    count: Optional[float] = None
+    for name, labels, value in samples:
+        if name == f"{family}_bucket":
+            le = labels.get("le")
+            if le is None:
+                raise ValueError(f"{family}: bucket without le label")
+            buckets.append((float(le.replace("+Inf", "inf")), value))
+        elif name == f"{family}_count":
+            count = value
+    prev = -1.0
+    for le, v in sorted(buckets):
+        if v < prev:
+            raise ValueError(f"{family}: non-cumulative bucket at le={le}")
+        prev = v
+    if buckets and count is not None:
+        inf_v = max(buckets)[1]
+        if inf_v != count:
+            raise ValueError(
+                f"{family}: +Inf bucket {inf_v} != count {count}")

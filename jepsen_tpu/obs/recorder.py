@@ -1,10 +1,13 @@
 """The flight recorder: a bounded process-wide ring of structured events.
 
 One ``FlightRecorder`` per process (``RECORDER``), recording
-dispatch / compile / transfer / retry / chaos events into a
-``deque(maxlen=...)`` ring.  Disabled by default: the off path is a
-single attribute check (``if not self.enabled: return``) so leaving the
-instrumentation compiled into the hot paths costs ~nothing, and the
+dispatch / compile / transfer / retry / chaos / monitor / alert events
+into a ``deque(maxlen=...)`` ring.  Disabled by default but armable at
+runtime (``POST /recorder?on=1``, ``Fleet.set_recorder``) so an operator
+can open a capture window around a live alert without restarting: the
+off path is a single attribute check (``if not self.enabled: return``)
+so leaving the instrumentation compiled into the hot paths costs
+~nothing, and the
 ring bound means the on path cannot grow memory under sustained load —
 old events fall off the back, ``recorded``/``buffered`` in ``stats()``
 tell you how much history survived.
@@ -29,8 +32,11 @@ from typing import Any, Dict, List, Optional
 from jepsen_tpu.clock import mono_now
 from jepsen_tpu.obs.trace import chrome_document, wall_anchor
 
-#: the structured event categories the serving tier records
-CATEGORIES = ("dispatch", "compile", "transfer", "retry", "chaos")
+#: the structured event categories the serving tier records — "monitor"
+#: is the epoch spans of the streaming checkers, "alert" the SLO engine's
+#: breach instants (obs/slo.py)
+CATEGORIES = ("dispatch", "compile", "transfer", "retry", "chaos",
+              "monitor", "alert")
 
 
 class FlightRecorder:

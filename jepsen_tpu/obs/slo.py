@@ -1,0 +1,231 @@
+"""Declarative SLOs over the Watchtower telemetry store, with burn
+windows and bounded alerting.
+
+Each ``SloSpec`` names one signal the store can compute per worker, a
+ceiling, and a *burn window*: the signal must sit above the ceiling
+continuously for the full window before an alert fires.  That is the
+standard burn-rate shape — a single bad sample (one slow compile, one
+unknown verdict) is noise; the same signal pinned above the ceiling for
+several push intervals is an incident.  One alert fires per breach
+episode: the episode ends (and the spec re-arms) only when a *measured*
+sample drops back to or under the ceiling — a sustained breach cannot
+flood the ring, and a quiet no-data window mid-breach holds the episode
+open rather than silently re-arming it.
+
+Ceilings and windows are env-tunable without code changes —
+``JEPSEN_TPU_SLO_<NAME>`` / ``JEPSEN_TPU_SLO_<NAME>_WINDOW_S`` with the
+spec name upper-cased (``JEPSEN_TPU_SLO_UNKNOWN_RATE=0.01``) — read at
+engine construction; ``set_ceiling`` retunes a live engine (the smoke
+uses this to tighten a ceiling mid-run).  Alerts land in three places:
+the engine's bounded ring (``GET /alerts``), the flight recorder's
+``alert`` category (so a Perfetto export shows the alert instant on the
+same axis as the spans that caused it), and the fleet snapshot.
+
+The engine's lock is a leaf (lint/lock_order.py, ``obs-slo``):
+``evaluate`` runs on wire reader threads and the fleet heartbeat.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from jepsen_tpu.clock import mono_now
+from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.obs.telemetry import TelemetryStore
+
+#: alert ring capacity (per engine)
+ALERT_CAPACITY = 256
+
+
+@dataclass
+class SloSpec:
+    """One service-level objective: signal, ceiling, burn window."""
+    name: str
+    ceiling: float
+    burn_window_s: float
+    unit: str
+    description: str
+    #: signal extractor: (store, worker, now) -> value or None (no data)
+    value_fn: Callable[[TelemetryStore, Any, float], Optional[float]] = \
+        field(repr=False, default=None)
+
+    def doc_row(self) -> Dict[str, Any]:
+        return {"name": self.name, "ceiling": self.ceiling,
+                "burn-window-s": self.burn_window_s, "unit": self.unit,
+                "description": self.description}
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _p99_dispatch_verdict_us(store, worker, now) -> Optional[float]:
+    return store.rates(worker).get("p99-dispatch-verdict-us")
+
+
+def _unknown_rate(store, worker, now) -> Optional[float]:
+    return store.rates(worker).get("unknown-rate")
+
+
+def _compiles_per_1k(store, worker, now) -> Optional[float]:
+    return store.rates(worker).get("compiles-per-1k")
+
+
+def _worker_stale_s(store, worker, now) -> Optional[float]:
+    return store.stale_s(worker, now=now)
+
+
+def default_specs(interval_s: float) -> List[SloSpec]:
+    """The shipped SLO set.  Ceilings are deliberately loose for the
+    1-core CI world (first-compile dispatches take whole seconds there);
+    production deployments tighten them via the env overrides, and the
+    smoke tightens them at runtime via ``set_ceiling``."""
+    def c(name: str, default: float) -> float:
+        return _env_float(f"JEPSEN_TPU_SLO_{name.upper()}", default)
+
+    def w(name: str, default: float) -> float:
+        return _env_float(f"JEPSEN_TPU_SLO_{name.upper()}_WINDOW_S", default)
+
+    return [
+        SloSpec("p99_dispatch_verdict_us",
+                c("p99_dispatch_verdict_us", 30_000_000.0),
+                w("p99_dispatch_verdict_us", 0.0), "us",
+                "windowed p99 of the dispatch->verdict edge",
+                _p99_dispatch_verdict_us),
+        SloSpec("unknown_rate",
+                c("unknown_rate", 0.5), w("unknown_rate", 0.0), "ratio",
+                "windowed unknown verdicts over completed requests",
+                _unknown_rate),
+        SloSpec("compiles_per_1k",
+                c("compiles_per_1k", 500.0), w("compiles_per_1k", 0.0),
+                "compiles/1k dispatches",
+                "steady-state compile pressure from the newest push",
+                _compiles_per_1k),
+        SloSpec("worker_stale_s",
+                c("worker_stale_s", 0.0), w("worker_stale_s", 0.0), "s",
+                "seconds past the 2-missed-intervals staleness threshold",
+                _worker_stale_s),
+    ]
+
+
+class SloEngine:
+    """Evaluates every spec against every worker the store knows, on
+    each push (``evaluate``) and each heartbeat sweep
+    (``evaluate_all``), firing one bounded alert per breach episode."""
+
+    def __init__(self, store: TelemetryStore,
+                 specs: Optional[List[SloSpec]] = None,
+                 alert_capacity: int = ALERT_CAPACITY):
+        self.store = store
+        self._lock = threading.Lock()
+        self._specs = {s.name: s for s in
+                       (specs if specs is not None
+                        else default_specs(store.interval_s))}
+        self._alerts: deque = deque(maxlen=alert_capacity)
+        self._fired_total = 0
+        # breach bookkeeping per (spec, worker): when the episode began,
+        # and whether its alert already fired
+        self._breach_t0: Dict[Any, float] = {}
+        self._fired: Dict[Any, bool] = {}
+
+    # -- tuning ----------------------------------------------------------------
+
+    def specs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.doc_row() for s in self._specs.values()]
+
+    def set_ceiling(self, name: str, ceiling: float,
+                    burn_window_s: Optional[float] = None) -> None:
+        """Retune a live spec (used by the smoke to inject a breach
+        threshold mid-run); unknown names raise KeyError."""
+        with self._lock:
+            spec = self._specs[name]
+            spec.ceiling = float(ceiling)
+            if burn_window_s is not None:
+                spec.burn_window_s = float(burn_window_s)
+
+    # -- evaluation ------------------------------------------------------------
+
+    def evaluate(self, worker: Any, now: Optional[float] = None,
+                 ) -> List[Dict[str, Any]]:
+        """Check every spec against one worker; returns alerts fired by
+        this call (usually empty)."""
+        now = mono_now() if now is None else now
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            specs = list(self._specs.values())
+        for spec in specs:
+            if spec.value_fn is None:
+                continue
+            try:
+                value = spec.value_fn(self.store, worker, now)
+            except Exception:  # noqa: BLE001 — a torn push must not
+                continue       # poison the evaluation loop
+            alert = self._check(spec, worker, value, now)
+            if alert is not None:
+                fired.append(alert)
+        return fired
+
+    def evaluate_all(self, now: Optional[float] = None,
+                     ) -> List[Dict[str, Any]]:
+        """One sweep over every known worker — the heartbeat-driven path
+        that catches staleness (a stale worker, by definition, delivers
+        no push to trigger ``evaluate`` for it)."""
+        now = mono_now() if now is None else now
+        fired: List[Dict[str, Any]] = []
+        for w in self.store.workers():
+            fired.extend(self.evaluate(w, now=now))
+        return fired
+
+    def _check(self, spec: SloSpec, worker: Any, value: Optional[float],
+               now: float) -> Optional[Dict[str, Any]]:
+        key = (spec.name, worker)
+        if value is None:
+            # no data is not recovery: a quiet window during a breach
+            # must not end the episode (and re-arm the alert) — only a
+            # measured in-SLO sample does
+            return None
+        if value <= spec.ceiling:
+            with self._lock:
+                self._breach_t0.pop(key, None)
+                self._fired.pop(key, None)
+            return None
+        with self._lock:
+            t0 = self._breach_t0.setdefault(key, now)
+            if now - t0 < spec.burn_window_s or self._fired.get(key):
+                return None
+            self._fired[key] = True
+            self._fired_total += 1
+            alert = {"slo": spec.name, "worker": str(worker),
+                     "value": round(float(value), 6),
+                     "ceiling": spec.ceiling,
+                     "burn-window-s": spec.burn_window_s,
+                     "breach-age-s": round(now - t0, 3),
+                     "t": round(now, 6), "unit": spec.unit}
+            self._alerts.append(alert)
+        RECORDER.record("alert", f"slo:{spec.name}:{worker}",
+                        args=dict(alert))
+        return alert
+
+    # -- export ----------------------------------------------------------------
+
+    def alerts(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(a) for a in self._alerts]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"fired-total": self._fired_total,
+                    "active-breaches": sorted(
+                        f"{name}:{worker}"
+                        for (name, worker), on in self._fired.items() if on),
+                    "alerts": [dict(a) for a in self._alerts],
+                    "specs": [s.doc_row() for s in self._specs.values()]}

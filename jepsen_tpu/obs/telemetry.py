@@ -1,0 +1,391 @@
+"""Watchtower: the push-based fleet telemetry plane.
+
+PR 10's scrape is pull-only — a supervisor walking STATUS frames cannot
+notice a silent worker, because silence looks exactly like "nothing to
+report".  Watchtower inverts the direction: every worker pushes a
+TELEMETRY frame (its ``Metrics.snapshot()`` plus pid/uptime/sequence)
+over the already-open wire on a ``JEPSEN_TPU_TELEMETRY_S`` cadence, and
+the fleet side lands each push in a bounded per-worker time-series ring
+(``TelemetryStore``).  The store derives what the raw snapshots cannot
+say alone:
+
+- windowed rates — histories/s and dispatches/s from counter deltas,
+  ``unknown-rate`` from the verdict counters, ``compiles-per-1k`` off
+  the gauge once the worker has enough cumulative dispatches for the
+  ratio to mean anything (cold-start gating, see
+  ``MIN_DISPATCHES_FOR_COMPILE_RATE``);
+- ``breaker-open-s`` — wall seconds each worker's circuit breaker has
+  spent OPEN, integrated from the fleet heartbeat's observations;
+- *staleness* — a worker whose newest push is older than
+  ``STALE_AFTER_INTERVALS`` push intervals is flagged stale.  This is
+  the lease/heartbeat primitive the multi-host supervisor needs: a
+  remote worker that stops pushing is indistinguishable from a dead
+  one, and both must be evicted the same way.  A worker that has never
+  pushed gets ``startup_grace_s`` of extra silence allowance first — a
+  spawned worker process spends real wall time (interpreter + JAX
+  import) before its first frame can possibly exist, and the staleness
+  clock must not race the boot; once the first push lands, the strict
+  2-interval contract governs.
+
+The store's lock is a leaf in the declared lock order
+(lint/lock_order.py, ``obs-telemetry``): pushes arrive on wire reader
+threads and observations on the fleet heartbeat thread, both of which
+may already hold locks earlier in the serve chain.
+
+The module also hosts a small process-wide gauge registry
+(``set_gauge``/``process_gauges``) so tiers without a ``Metrics``
+instance of their own — the monitor's epoch loop, concretely — can
+publish scalars (``epochs-behind-live``) that every snapshot in the
+process picks up and every telemetry push therefore carries.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.clock import mono_now
+
+#: default push cadence, seconds (env-overridable)
+DEFAULT_TELEMETRY_S = 1.0
+
+#: a worker is stale after this many missed push intervals
+STALE_AFTER_INTERVALS = 2
+
+#: per-worker ring length: at the 1 s default cadence this is ~2 min of
+#: history per worker — enough for every burn window shipped in slo.py
+DEFAULT_RING = 128
+
+#: compiles-per-1k is a *steady-state* ratio: below this many cumulative
+#: dispatches it is all cold-start noise (1 compile over 2 dispatches
+#: reads as 500/1k) and the store reports None instead — otherwise every
+#: fresh worker trips the compile-pressure SLO on its first real push
+MIN_DISPATCHES_FOR_COMPILE_RATE = 100
+
+
+def telemetry_interval_s() -> float:
+    """The configured push cadence: ``JEPSEN_TPU_TELEMETRY_S`` (seconds,
+    <= 0 disables pushing) or the 1 s default.  Read at call time, not
+    import time, so tests and the CLI can retune a live process."""
+    raw = os.environ.get("JEPSEN_TPU_TELEMETRY_S", "")
+    try:
+        return float(raw) if raw else DEFAULT_TELEMETRY_S
+    except ValueError:
+        return DEFAULT_TELEMETRY_S
+
+
+# -- process-wide gauges -------------------------------------------------------
+
+_GAUGE_LOCK = threading.Lock()
+_GAUGES: Dict[str, float] = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Publish a process-wide gauge (e.g. the monitor's
+    ``epochs-behind-live``).  Last write wins; snapshot readers see the
+    latest sample."""
+    with _GAUGE_LOCK:
+        _GAUGES[name] = float(value)
+
+
+def process_gauges() -> Dict[str, float]:
+    """A copy of every process-wide gauge published so far."""
+    with _GAUGE_LOCK:
+        return dict(_GAUGES)
+
+
+# -- the store -----------------------------------------------------------------
+
+def _counter(payload: Dict[str, Any], name: str) -> int:
+    m = payload.get("metrics") or {}
+    try:
+        return int((m.get("counters") or {}).get(name, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _gauge(payload: Dict[str, Any], name: str) -> Optional[float]:
+    m = payload.get("metrics") or {}
+    v = (m.get("gauges") or {}).get(name)
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _hist_p99_us(payload: Dict[str, Any], hist: str) -> Optional[float]:
+    m = payload.get("metrics") or {}
+    h = (m.get("histograms") or {}).get(hist)
+    if not isinstance(h, dict) or not h.get("count"):
+        return None
+    try:
+        return float(h["p99"]) * 1e6
+    except (TypeError, ValueError, KeyError):
+        return None
+
+
+def _hist_buckets(payload: Dict[str, Any], hist: str) -> Dict[int, int]:
+    m = payload.get("metrics") or {}
+    h = (m.get("histograms") or {}).get(hist)
+    if not isinstance(h, dict):
+        return {}
+    try:
+        return {int(b): int(n)
+                for b, n in (h.get("buckets-us") or {}).items()}
+    except (TypeError, ValueError):
+        return {}
+
+
+def _windowed_p99_us(newest: Dict[str, Any], oldest: Dict[str, Any],
+                     hist: str) -> Optional[float]:
+    """p99 over only the observations that landed between two pushes —
+    bucket-wise subtraction of cumulative pow2 histograms.  The
+    cumulative p99 is useless as an SLO signal once a cold-start outlier
+    is in the ring (a 2 s first-compile dispatch pins it forever);
+    the windowed delta is what 'latency right now' actually means.
+    None when the window saw no observations."""
+    delta = dict(_hist_buckets(newest, hist))
+    for b, n in _hist_buckets(oldest, hist).items():
+        delta[b] = delta.get(b, 0) - n
+    delta = {b: n for b, n in delta.items() if n > 0}
+    count = sum(delta.values())
+    if count <= 0:
+        return None
+    target = 0.99 * count
+    seen = 0
+    for b in sorted(delta):
+        seen += delta[b]
+        if seen >= target:
+            return float(b)
+    return float(max(delta))  # pragma: no cover - defensive
+
+
+class TelemetryStore:
+    """Bounded per-worker time-series of TELEMETRY pushes, plus the
+    derived fleet-health signals (rates, breaker-open time, staleness).
+
+    Keys are whatever the fleet uses to name workers (slot ints, plus
+    the ``"fleet"`` pseudo-worker for the fleet process's own metrics).
+    ``register`` pins a worker's birth time so one that *never* pushes
+    still goes stale instead of staying invisible forever.
+    """
+
+    def __init__(self, interval_s: Optional[float] = None,
+                 ring: int = DEFAULT_RING, *,
+                 startup_grace_s: float = 0.0):
+        self.interval_s = float(interval_s if interval_s is not None
+                                else telemetry_interval_s())
+        if self.interval_s <= 0:
+            self.interval_s = DEFAULT_TELEMETRY_S
+        # extra silence allowance for workers that have NEVER pushed
+        # (see module docstring); 0.0 keeps the strict 2-interval
+        # contract for in-process stores
+        self.startup_grace_s = max(float(startup_grace_s), 0.0)
+        self._ring = max(int(ring), 2)
+        self._lock = threading.Lock()
+        self._rings: Dict[Any, deque] = {}
+        self._born: Dict[Any, float] = {}
+        self._pushes: Dict[Any, int] = {}
+        # breaker integration: {wid: [is_open, since_t, accumulated_s]}
+        self._breaker: Dict[Any, List[Any]] = {}
+
+    # -- ingest ----------------------------------------------------------------
+
+    def register(self, worker: Any, now: Optional[float] = None) -> None:
+        """Declare a worker exists (staleness clock starts now even if
+        it never manages a single push)."""
+        now = mono_now() if now is None else now
+        with self._lock:
+            self._born.setdefault(worker, now)
+            self._rings.setdefault(worker, deque(maxlen=self._ring))
+
+    def record_push(self, worker: Any, payload: Dict[str, Any],
+                    now: Optional[float] = None) -> Dict[str, Any]:
+        """Land one TELEMETRY payload; returns the stamped entry."""
+        now = mono_now() if now is None else now
+        if not isinstance(payload, dict):
+            payload = {}
+        entry = {"t": now, "payload": payload}
+        with self._lock:
+            ring = self._rings.get(worker)
+            if ring is None:
+                ring = self._rings[worker] = deque(maxlen=self._ring)
+                self._born.setdefault(worker, now)
+            ring.append(entry)
+            self._pushes[worker] = self._pushes.get(worker, 0) + 1
+        return entry
+
+    def observe_breaker(self, worker: Any, is_open: bool,
+                        now: Optional[float] = None) -> None:
+        """Integrate breaker state over time: called from the fleet
+        heartbeat on every sweep; accumulates OPEN wall-seconds."""
+        now = mono_now() if now is None else now
+        with self._lock:
+            st = self._breaker.get(worker)
+            if st is None:
+                self._breaker[worker] = [bool(is_open), now, 0.0]
+                return
+            was_open, since, acc = st
+            if was_open:
+                acc += max(now - since, 0.0)
+            self._breaker[worker] = [bool(is_open), now, acc]
+
+    # -- reads -----------------------------------------------------------------
+
+    def workers(self) -> List[Any]:
+        with self._lock:
+            return sorted(self._rings, key=str)
+
+    def latest(self, worker: Any) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            ring = self._rings.get(worker)
+            return dict(ring[-1]) if ring else None
+
+    def push_count(self, worker: Any) -> int:
+        with self._lock:
+            return self._pushes.get(worker, 0)
+
+    def last_push_age_s(self, worker: Any,
+                        now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the newest push — falling back to the worker's
+        registration time when it has never pushed; None for a worker
+        the store has never heard of at all."""
+        now = mono_now() if now is None else now
+        with self._lock:
+            ring = self._rings.get(worker)
+            if ring:
+                return max(now - ring[-1]["t"], 0.0)
+            born = self._born.get(worker)
+            return max(now - born, 0.0) if born is not None else None
+
+    def stale_s(self, worker: Any, now: Optional[float] = None,
+                ) -> Optional[float]:
+        """How far past the staleness threshold this worker is (0.0 when
+        healthy); None when unknown.  The threshold is 2 push intervals
+        from the newest push — or, for a worker that has never pushed,
+        the larger of that and ``startup_grace_s`` measured from
+        registration (a booting worker process cannot push yet; a booted
+        one that goes silent must not get the grace twice)."""
+        now = mono_now() if now is None else now
+        with self._lock:
+            ring = self._rings.get(worker)
+            last_push_t = ring[-1]["t"] if ring else None
+            born = self._born.get(worker)
+        threshold = STALE_AFTER_INTERVALS * self.interval_s
+        if last_push_t is not None:
+            age = max(now - last_push_t, 0.0)
+        elif born is not None:
+            age = max(now - born, 0.0)
+            threshold = max(threshold, self.startup_grace_s)
+        else:
+            return None
+        return max(age - threshold, 0.0)
+
+    def is_stale(self, worker: Any, now: Optional[float] = None) -> bool:
+        s = self.stale_s(worker, now=now)
+        return bool(s and s > 0.0)
+
+    def stale_workers(self, now: Optional[float] = None) -> List[Any]:
+        now = mono_now() if now is None else now
+        return [w for w in self.workers() if self.is_stale(w, now=now)]
+
+    def breaker_open_s(self, worker: Any,
+                       now: Optional[float] = None) -> float:
+        """Total OPEN wall-seconds integrated so far (including the
+        currently-running OPEN stretch, if any)."""
+        now = mono_now() if now is None else now
+        with self._lock:
+            st = self._breaker.get(worker)
+            if st is None:
+                return 0.0
+            is_open, since, acc = st
+            return acc + (max(now - since, 0.0) if is_open else 0.0)
+
+    def rates(self, worker: Any, window_s: Optional[float] = None,
+              ) -> Dict[str, Any]:
+        """Windowed deltas between the oldest in-window push and the
+        newest: the rate view a dashboard wants and a raw cumulative
+        snapshot cannot give.  Empty-ish dict when fewer than two pushes
+        are in the window."""
+        window_s = (STALE_AFTER_INTERVALS * 4 * self.interval_s
+                    if window_s is None else window_s)
+        with self._lock:
+            ring = self._rings.get(worker)
+            entries = list(ring) if ring else []
+        if not entries:
+            return {}
+        newest = entries[-1]
+        cutoff = newest["t"] - window_s
+        in_window = [e for e in entries if e["t"] >= cutoff]
+        total_dispatches = (
+            _counter(newest["payload"], "dispatches")
+            + int((((newest["payload"].get("metrics") or {})
+                    .get("megabatch") or {}).get("dispatches", 0) or 0)))
+        out: Dict[str, Any] = {
+            "compiles-per-1k": (
+                _gauge(newest["payload"], "compiles-per-1k-dispatches")
+                if total_dispatches >= MIN_DISPATCHES_FOR_COMPILE_RATE
+                else None),
+            "p99-dispatch-verdict-us":
+                _hist_p99_us(newest["payload"], "edge:dispatch->verdict"),
+        }
+        if len(in_window) < 2:
+            return out
+        oldest = in_window[0]
+        dt = newest["t"] - oldest["t"]
+        if dt <= 0:
+            return out
+        # with a real window, the latency signal goes windowed: p99 of
+        # only the observations inside it (None when the window is
+        # quiet), not the forever-pinned cumulative p99
+        out["p99-dispatch-verdict-us"] = _windowed_p99_us(
+            newest["payload"], oldest["payload"], "edge:dispatch->verdict")
+        d_completed = (_counter(newest["payload"], "requests-completed")
+                       - _counter(oldest["payload"], "requests-completed"))
+        d_unknown = (_counter(newest["payload"], "verdicts-unknown")
+                     - _counter(oldest["payload"], "verdicts-unknown"))
+        d_dispatch = (_counter(newest["payload"], "dispatches")
+                      - _counter(oldest["payload"], "dispatches"))
+        out.update({
+            "window-s": round(dt, 3),
+            "hist-per-s": round(max(d_completed, 0) / dt, 4),
+            "dispatch-per-s": round(max(d_dispatch, 0) / dt, 4),
+            "unknown-rate": (round(max(d_unknown, 0) / d_completed, 4)
+                             if d_completed > 0 else None),
+        })
+        return out
+
+    # -- export ----------------------------------------------------------------
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The per-worker health summary the fleet snapshot embeds."""
+        now = mono_now() if now is None else now
+        out: Dict[str, Any] = {"interval-s": self.interval_s,
+                               "workers": {}}
+        for w in self.workers():
+            latest = self.latest(w)
+            payload = (latest or {}).get("payload") or {}
+            out["workers"][str(w)] = {
+                "pushes": self.push_count(w),
+                "last-push-age-s": (
+                    round(self.last_push_age_s(w, now=now) or 0.0, 3)),
+                "stale": self.is_stale(w, now=now),
+                "pid": payload.get("pid"),
+                "generation": payload.get("generation"),
+                "uptime-s": payload.get("uptime-s"),
+                "breaker-open-s": round(self.breaker_open_s(w, now=now), 3),
+                "rates": self.rates(w),
+            }
+        out["stale-workers"] = [str(w) for w in self.stale_workers(now=now)]
+        return out
+
+    def dump(self) -> Dict[str, Any]:
+        """Full ring contents (minus the bulky per-push metrics bodies'
+        trace sections, already stripped at push time) — the artifact
+        the telemetry smoke uploads."""
+        with self._lock:
+            rings = {str(w): [dict(e) for e in ring]
+                     for w, ring in self._rings.items()}
+        return {"interval-s": self.interval_s, "rings": rings}

@@ -40,6 +40,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from jepsen_tpu import atomic_io
@@ -48,6 +49,8 @@ from jepsen_tpu.net_proxy import PairProxy
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.obs.hist import merge_hist_snapshots
 from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.obs.slo import SloEngine
+from jepsen_tpu.obs.telemetry import TelemetryStore, telemetry_interval_s
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.decompose import decompose
@@ -377,6 +380,14 @@ class _FleetMetrics(Metrics):
                      if k not in ("traces", "fleet", "workers")}
             workers.append({"worker": i, **entry})
         snap["workers"] = workers
+        # Watchtower sections (guarded: a snapshot taken while the fleet
+        # is still constructing must not crash on the missing store)
+        tele = getattr(self._fleet, "telemetry", None)
+        if tele is not None:
+            snap["telemetry"] = tele.snapshot()
+        slo = getattr(self._fleet, "slo", None)
+        if slo is not None:
+            snap["slo"] = slo.snapshot()
         return snap
 
 
@@ -401,7 +412,8 @@ class Fleet:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker_fail_threshold: int = 3,
                  breaker_open_s: float = 1.0,
-                 pin_devices: bool = True):
+                 pin_devices: bool = True,
+                 telemetry_s: Optional[float] = None):
         n = max(1, int(workers))
         self.n_workers = n
         self.max_lanes = max_lanes
@@ -409,6 +421,11 @@ class Fleet:
         self.default_deadline_s = default_deadline_s
         self.hedge_s = hedge_s
         self.heartbeat_s = heartbeat_s
+        # resolved before _make_workers: proc slots ship the cadence to
+        # their worker processes as a --telemetry-s argv flag
+        self.telemetry_s = (telemetry_interval_s() if telemetry_s is None
+                            else float(telemetry_s))
+        self._t0 = mono_now()
         device_sets = _device_sets(n) if pin_devices else [[]] * n
         self.workers: List[FleetWorker] = self._make_workers(
             n, buckets.worker_lane_share(max_lanes, n), device_sets,
@@ -418,6 +435,23 @@ class Fleet:
             open_s=breaker_open_s)
         self.router = Router(self.workers)
         self.metrics = _FleetMetrics(self)
+        # Watchtower: the per-worker push ring + the SLO engine over it.
+        # Proc workers push TELEMETRY frames into _note_worker_telemetry;
+        # in-process workers (no wire) are scraped into the same store on
+        # the heartbeat cadence, and the fleet process contributes its
+        # own base snapshot as the "fleet" pseudo-worker.
+        # Spawned workers spend real wall time booting before their
+        # first push can exist; the fleet's ready timeout doubles as the
+        # never-pushed staleness grace (ProcFleet sets it before calling
+        # up here; in-process fleets have no boot gap and get none).
+        self.telemetry = TelemetryStore(
+            interval_s=self.telemetry_s if self.telemetry_s > 0 else None,
+            startup_grace_s=getattr(self, "worker_ready_timeout_s", 0.0))
+        self.slo = SloEngine(self.telemetry)
+        for w in self.workers:
+            self.telemetry.register(w.wid)
+        self.telemetry.register("fleet")
+        self._last_tele_sweep = 0.0
         # Decorrelated jitter by default: reroutes after a worker death
         # must not arrive at the survivor in lockstep (retry storm).
         self.retry_policy = retry_policy or RetryPolicy(
@@ -649,6 +683,11 @@ class Fleet:
         dropped — the still-running primary attempt is not abandoned for
         a sibling's failure."""
         req = cell.request
+        # fleet-side dispatch mark: edge:dispatch->verdict in THIS
+        # process's histograms is the full wire round trip + worker
+        # time — the latency an injected slow link actually inflates
+        # (worker-side spans never see the network)
+        req.span("dispatch")
         try:
             wreq = worker.service.submit(cell.history, block=False,
                                          deadline_s=req.remaining_s(),
@@ -787,9 +826,94 @@ class Fleet:
                 except Exception:  # noqa: BLE001
                     p = {"alive": False}
                 w.health.beat()
+                self.telemetry.observe_breaker(w.wid,
+                                               w.breaker.state == OPEN)
                 if not p.get("alive"):
                     self.metrics.inc("heartbeat-misses")
+            try:
+                self._telemetry_sweep()
+            except Exception:  # noqa: BLE001 — telemetry must never
+                log.exception("telemetry sweep failed")  # kill heartbeat
             time.sleep(self.heartbeat_s)
+
+    # -- Watchtower -------------------------------------------------------
+    def _note_worker_telemetry(self, wid: int,
+                               payload: Dict[str, Any]) -> None:
+        """Sink for one proc worker's TELEMETRY push (runs on that
+        worker's wire reader thread).  Tags the slot's generation —
+        worker processes don't know which respawn they are — then lands
+        the push and evaluates the SLOs against it."""
+        try:
+            w = self.workers[wid]
+        except (IndexError, TypeError):
+            return
+        payload = dict(payload or {})
+        payload.setdefault("generation", w.generation)
+        self.telemetry.record_push(wid, payload)
+        self.slo.evaluate(wid)
+
+    def _telemetry_sweep(self) -> None:
+        """Heartbeat-cadence half of the telemetry plane: once per push
+        interval, contribute the fleet process's own base snapshot as
+        the ``fleet`` pseudo-worker, scrape in-process (wireless) worker
+        services into the store, and run one SLO sweep over everyone —
+        the sweep is what catches staleness, since a stale worker by
+        definition sends no push to evaluate."""
+        if self.telemetry_s <= 0:
+            return
+        now = mono_now()
+        if now - self._last_tele_sweep < self.telemetry.interval_s:
+            return
+        self._last_tele_sweep = now
+        snap = Metrics.snapshot(self.metrics)  # base sections only — the
+        snap.pop("traces", None)               # full fleet snapshot would
+        # re-scrape every worker per interval
+        self.telemetry.record_push("fleet", {
+            "pid": os.getpid(),
+            "uptime-s": round(now - self._t0, 3),
+            "interval-s": self.telemetry.interval_s,
+            "metrics": snap}, now=now)
+        for w in self.workers:
+            svc = w.service
+            if hasattr(svc, "metrics_snapshot"):
+                continue  # wire-backed: its process pushes for itself
+            m = getattr(svc, "metrics", None)
+            if m is None:
+                continue
+            try:
+                ws = dict(m.snapshot())
+            except Exception:  # noqa: BLE001 — mid-crash worker
+                continue
+            ws.pop("traces", None)
+            self.telemetry.record_push(w.wid, {
+                "pid": os.getpid(), "generation": w.generation,
+                "interval-s": self.telemetry.interval_s,
+                "metrics": ws}, now=now)
+        self.slo.evaluate_all(now=now)
+
+    def alerts(self) -> List[Dict[str, Any]]:
+        """The SLO engine's fired-alert ring (web.py GET /alerts)."""
+        return self.slo.alerts()
+
+    def set_recorder(self, on: bool) -> Dict[str, Any]:
+        """Arm/disarm the flight recorder at runtime — locally and, for
+        wire-backed workers, remotely over the STATUS frame (POST
+        /recorder).  Best-effort per worker; returns who acked."""
+        if on:
+            RECORDER.enable()
+        else:
+            RECORDER.disable()
+        acks: Dict[str, bool] = {}
+        for w in self.workers:
+            fn = getattr(w.service, "set_recorder", None)
+            if fn is None:
+                continue   # in-process worker: shares this RECORDER
+            try:
+                acks[str(w.wid)] = bool(fn(on))
+            except Exception:  # noqa: BLE001 — unreachable worker
+                acks[str(w.wid)] = False
+        return {"enabled": RECORDER.enabled, "workers": acks,
+                **RECORDER.stats()}
 
     def restart_worker(self, wid: int,
                        only_if_dead: bool = False) -> FleetWorker:
@@ -844,26 +968,53 @@ class Fleet:
         absorbed off RESULT frames (see Request.absorb_serve)."""
         return self.metrics.find_trace(request_id)
 
-    def healthz(self, deep: bool = False) -> Dict[str, Any]:
+    #: per-probe wall bound on the whole deep-healthz fan-out — one hung
+    #: worker must cost the endpoint at most this, not its rpc timeout
+    #: serially multiplied by the fleet size
+    DEEP_HEALTHZ_TIMEOUT_S = 2.0
+
+    def healthz(self, deep: bool = False,
+                deep_timeout_s: Optional[float] = None) -> Dict[str, Any]:
         """The load-balancer/chaos probe payload (web.py GET /healthz):
         fleet is ``ok`` while at least one worker is alive with a
         non-open circuit.  ``deep`` additionally asks each remote worker
         for its OWN healthz over the wire (``GET /healthz?deep=1``) —
-        best-effort per worker, so one partitioned link degrades that
-        worker's entry, never the probe."""
+        fanned out in parallel with one shared wall bound, so a single
+        hung or partitioned worker degrades ITS entry to a timeout
+        error instead of stalling the whole endpoint behind its RPC."""
         st = self.fleet_status()
         ok = any(w["alive"] and w["circuit"] != OPEN
                  for w in st["workers"])
         if deep:
-            for w, entry in zip(self.workers, st["workers"]):
-                remote_hz = getattr(w.service, "healthz", None)
-                if remote_hz is None:
-                    continue
-                try:
-                    entry["remote"] = remote_hz()
-                except Exception as e:  # noqa: BLE001 — unreachable link
-                    entry["remote"] = {"ok": False,
-                                       "error": f"{type(e).__name__}: {e}"}
+            budget = (self.DEEP_HEALTHZ_TIMEOUT_S
+                      if deep_timeout_s is None else float(deep_timeout_s))
+            targets = [(w, entry)
+                       for w, entry in zip(self.workers, st["workers"])
+                       if getattr(w.service, "healthz", None) is not None]
+            if targets:
+                pool = ThreadPoolExecutor(
+                    max_workers=len(targets),
+                    thread_name_prefix="fleet-deepz")
+                futs = [(pool.submit(w.service.healthz), entry)
+                        for w, entry in targets]
+                deadline = mono_now() + budget
+                for fut, entry in futs:
+                    try:
+                        entry["remote"] = fut.result(
+                            timeout=max(0.0, deadline - mono_now()))
+                    except FutureTimeout:
+                        fut.cancel()
+                        entry["remote"] = {
+                            "ok": False,
+                            "error": f"deep healthz timeout after "
+                                     f"{budget:.2f}s"}
+                    except Exception as e:  # noqa: BLE001 — bad link
+                        entry["remote"] = {
+                            "ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                # never wait on stragglers: a hung probe thread is
+                # abandoned to finish (or not) on its own
+                pool.shutdown(wait=False)
         return {"ok": ok, "queue-depth": self.queue_depth(), **st}
 
     # -- journal recovery -------------------------------------------------
@@ -1079,6 +1230,8 @@ class ProcFleet(Fleet):
         ready_s = self.worker_ready_timeout_s
         mqc = self.max_queue_cells
 
+        tele_s = self.telemetry_s
+
         def make():
             if spawn:
                 launcher = SubprocessWorker(
@@ -1086,7 +1239,8 @@ class ProcFleet(Fleet):
                     args={"max-lanes": lanes, "max-queue": mqc,
                           "store-base": store_base,
                           "capacity": capacity,
-                          "max-capacity": max_capacity},
+                          "max-capacity": max_capacity,
+                          "telemetry-s": tele_s},
                     ready_timeout_s=ready_s)
             else:
                 launcher = ThreadWorker(
@@ -1095,10 +1249,17 @@ class ProcFleet(Fleet):
                                          max_lanes=lanes,
                                          store_base=store_base,
                                          capacity=capacity,
-                                         max_capacity=max_capacity))
-            return ProcWorkerService(launcher, proxy,
-                                     retry_policy=self.retry_policy,
-                                     name=name)
+                                         max_capacity=max_capacity),
+                    telemetry_s=tele_s)
+            svc = ProcWorkerService(launcher, proxy,
+                                    retry_policy=self.retry_policy,
+                                    name=name)
+            # TELEMETRY pushes from this slot land wid-tagged in the
+            # fleet's store (the sink survives respawns: every fresh
+            # service from this factory re-registers it)
+            svc.on_telemetry = \
+                lambda payload: self._note_worker_telemetry(i, payload)
+            return svc
         return make
 
     # -- the supervisor ----------------------------------------------------

@@ -55,7 +55,7 @@ from typing import Any, Dict, List, Optional
 # monitor/ module already imports it from metrics.
 from jepsen_tpu.clock import mono_now  # noqa: F401
 from jepsen_tpu.obs.hist import (HistogramSet, compile_event_count,
-                                 compile_hist_stats)
+                                 compile_hist_stats, merge_skipped_count)
 
 
 class Metrics:
@@ -98,6 +98,12 @@ class Metrics:
         payload["valid"] = (request.result or {}).get("valid")
         with self._lock:
             self._traces.append(payload)
+            # unknown verdicts are the checker punting (frontier blowup,
+            # deadline, fission escalation) — counted here so the SLO
+            # engine can burn on unknown-rate = Δunknown/Δcompleted
+            if payload["valid"] == "unknown":
+                self._counters["verdicts-unknown"] = \
+                    self._counters.get("verdicts-unknown", 0) + 1
         self._observe_edges(request.spans)
 
     def _observe_edges(self, spans: List[Dict[str, Any]]) -> None:
@@ -132,6 +138,7 @@ class Metrics:
         from jepsen_tpu.engine.cache import engine_cache_stats
         from jepsen_tpu.engine import fission
         from jepsen_tpu.obs.recorder import RECORDER
+        from jepsen_tpu.obs.telemetry import process_gauges
         from jepsen_tpu.parallel.megabatch import megabatch_stats
         with self._lock:
             counters = dict(self._counters)
@@ -140,6 +147,9 @@ class Metrics:
             traces = list(self._traces)
         cache = engine_cache_stats()
         mega = megabatch_stats()
+        # process-wide merge-corruption counter: how many malformed
+        # per-histogram entries the fleet scrape path silently dropped
+        counters["hist-merge-skipped"] = merge_skipped_count()
         # Steady-state compile pressure: compile events per 1000 engine
         # dispatches (scheduler barrier dispatches + megabatch chunk
         # dispatches), process-wide like the compile histograms that
@@ -160,6 +170,12 @@ class Metrics:
                 "inflight-requests":
                     self._inflight_fn() if self._inflight_fn else 0,
                 "compiles-per-1k-dispatches": compiles_1k,
+                # monitor lag: ops the streaming checkers have accepted
+                # but not yet folded into a verdict epoch (0 when no
+                # monitor runs in this process) — set by Monitor.flush
+                # through obs.telemetry.set_gauge
+                "epochs-behind-live":
+                    int(process_gauges().get("epochs-behind-live", 0)),
             },
             "occupancy": {
                 "lanes-used": used,

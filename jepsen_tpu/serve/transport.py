@@ -92,6 +92,7 @@ F_HEALTHZ = "healthz"    # client -> worker: health RPC
 F_DRAIN = "drain"        # client -> worker: drain RPC
 F_REPLY = "reply"        # worker -> client: RPC reply payload
 F_ERROR = "error"        # worker -> client: call failed worker-side
+F_TELEMETRY = "telemetry"  # worker -> client: unsolicited metrics push
 
 
 class TransportError(RuntimeError):
@@ -274,6 +275,11 @@ class WireClient:
         self._pending: Dict[str, _Pending] = {}
         self._closed = False
         self.reconnects = 0
+        # Watchtower sink: unsolicited TELEMETRY frames are not replies
+        # to anything in the pending table — they go to whoever owns
+        # this client (the fleet's TelemetryStore).  Settable after
+        # construction; None drops pushes on the floor.
+        self.on_telemetry = None
 
     # -- connection --------------------------------------------------------
     def _ensure_conn(self) -> socket.socket:
@@ -324,6 +330,16 @@ class WireClient:
     def _on_frame(self, frame: Dict[str, Any]) -> None:
         fid = frame.get("id")
         ftype = frame.get("type")
+        if ftype == F_TELEMETRY:
+            # push, not reply: never touches the pending table, and a
+            # sink failure must not kill the reader thread
+            cb = self.on_telemetry
+            if cb is not None:
+                try:
+                    cb(frame.get("payload") or {})
+                except Exception:  # noqa: BLE001
+                    log.debug("telemetry sink failed", exc_info=True)
+            return
         terminal = ftype in (F_RESULT, F_REPLY, F_ERROR)
         with self._lock:
             p = self._pending.get(fid)
@@ -515,6 +531,16 @@ class ProcWorkerService:
         self._ready_lock = threading.Lock()
         self._client: Optional[WireClient] = None
         self._closed = False
+        # Watchtower sink for this worker's TELEMETRY pushes; the fleet
+        # sets it (wid-tagged) before the first dial.  Read through a
+        # closure at dispatch time, so setting it after the wire exists
+        # also works.
+        self.on_telemetry = None
+
+    def _dispatch_telemetry(self, payload: Dict[str, Any]) -> None:
+        cb = self.on_telemetry
+        if cb is not None:
+            cb(payload)
 
     def _wire(self) -> WireClient:
         """The (lazily-dialed) client, created once the launcher reports
@@ -534,6 +560,7 @@ class ProcWorkerService:
                     addr, policy=self._policy, name=self.name,
                     ack_timeout_s=self._ack_timeout_s,
                     max_frame=self._max_frame)
+                self._client.on_telemetry = self._dispatch_telemetry
             return self._client
 
     # -- the CheckService surface -----------------------------------------
@@ -616,6 +643,18 @@ class ProcWorkerService:
         except Exception as e:  # noqa: BLE001
             return {"ok": False, "reachable": False,
                     "error": f"{type(e).__name__}: {e}"}
+
+    def set_recorder(self, on: bool) -> bool:
+        """Arm/disarm the remote worker's flight recorder over the
+        STATUS frame (the runtime half of ``POST /recorder``).  False
+        when the worker is unreachable — arming is best-effort, like
+        every other scrape-path RPC."""
+        try:
+            self._wire().call(F_STATUS, {"recorder": bool(on)},
+                              timeout_s=self.rpc_timeout_s)
+            return True
+        except Exception:  # noqa: BLE001 — unreachable ≠ dead
+            return False
 
     def remote_status(self) -> Dict[str, Any]:
         """Launcher-side facts (pid/port/log) for fleet_status()."""

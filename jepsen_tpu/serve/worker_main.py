@@ -52,9 +52,10 @@ from jepsen_tpu.serve.aggregate import expired_result
 from jepsen_tpu.serve.request import Request
 from jepsen_tpu.serve.service import (CheckService, ServiceClosed,
                                       ServiceSaturated)
+from jepsen_tpu.obs.telemetry import telemetry_interval_s
 from jepsen_tpu.serve.transport import (F_ACK, F_DRAIN, F_ERROR, F_HEALTHZ,
                                         F_REPLY, F_RESULT, F_STATUS,
-                                        F_SUBMIT, FrameError,
+                                        F_SUBMIT, F_TELEMETRY, FrameError,
                                         MAX_FRAME_BYTES, OversizedFrame,
                                         encode_frame, read_frame)
 
@@ -101,7 +102,8 @@ class WorkerServer:
     """Serve one CheckService over the frame protocol."""
 
     def __init__(self, service: CheckService, host: str = "127.0.0.1",
-                 port: int = 0, max_frame: int = MAX_FRAME_BYTES):
+                 port: int = 0, max_frame: int = MAX_FRAME_BYTES,
+                 telemetry_s: Optional[float] = None):
         self.service = service
         self.max_frame = max_frame
         self._lock = threading.Lock()  # inflight/done/conn tables
@@ -111,6 +113,13 @@ class WorkerServer:
         self._conns: List[_Conn] = []
         self._closed = False
         self._last_idle = mono_now()
+        self._t0 = mono_now()
+        # Watchtower push cadence: None = the env-configured default;
+        # <= 0 disables the push thread entirely
+        self.telemetry_s = (telemetry_interval_s() if telemetry_s is None
+                            else float(telemetry_s))
+        self._tele_stop = threading.Event()
+        self._tele_seq = 0
         sched = getattr(service, "_sched", None)
         if sched is not None and hasattr(sched, "add_idle_listener"):
             sched.add_idle_listener(self._note_idle)
@@ -121,9 +130,47 @@ class WorkerServer:
         self.port = self._srv.getsockname()[1]
         threading.Thread(target=self._accept_loop, daemon=True,
                          name=f"worker-accept-{self.port}").start()
+        if self.telemetry_s > 0:
+            threading.Thread(target=self._telemetry_loop, daemon=True,
+                             name=f"worker-tele-{self.port}").start()
 
     def _note_idle(self) -> None:
         self._last_idle = mono_now()
+
+    # -- telemetry push ----------------------------------------------------
+    def telemetry_payload(self) -> Dict[str, Any]:
+        """One TELEMETRY frame body: process identity plus the full
+        metrics snapshot minus the trace ring (traces are bulky and
+        already travel on RESULT frames)."""
+        snap = dict(self.service.metrics.snapshot())
+        snap.pop("traces", None)
+        self._tele_seq += 1
+        return {"pid": os.getpid(),
+                "uptime-s": round(mono_now() - self._t0, 3),
+                "seq": self._tele_seq,
+                "interval-s": self.telemetry_s,
+                "metrics": snap}
+
+    def _telemetry_loop(self) -> None:
+        """Push the payload to every open connection on the cadence.
+        Best-effort by design: a dead connection drops the frame (its
+        reader cleanup already prunes the conn table), and the *absence*
+        of pushes is itself the signal — the fleet-side TelemetryStore
+        flags this worker stale after 2 missed intervals."""
+        while not self._tele_stop.wait(timeout=self.telemetry_s):
+            with self._lock:
+                if self._closed:
+                    return
+                conns = list(self._conns)
+            if not conns:
+                continue
+            try:
+                frame = {"type": F_TELEMETRY,
+                         "payload": self.telemetry_payload()}
+                for conn in conns:
+                    conn.send(frame, self.max_frame)
+            except Exception:  # noqa: BLE001 — a torn snapshot must not
+                log.debug("telemetry push failed", exc_info=True)
 
     # -- accept/read -------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -299,6 +346,15 @@ class WorkerServer:
             p["wire-done-cached"] = len(self._done)
         p["idle-age-s"] = round(mono_now() - self._last_idle, 3)
         p["pid"] = os.getpid()
+        if frame and frame.get("recorder") is not None:
+            # runtime arm/disarm of this process's flight recorder — the
+            # worker half of POST /recorder
+            from jepsen_tpu.obs.recorder import RECORDER
+            if frame.get("recorder"):
+                RECORDER.enable()
+            else:
+                RECORDER.disable()
+            p["recorder"] = RECORDER.stats()
         if frame and frame.get("metrics"):
             # the fleet-wide scrape: full Metrics.snapshot() on demand
             # over the same STATUS frame the heartbeat already uses
@@ -322,6 +378,7 @@ class WorkerServer:
         return not self._closed and self.service.alive()
 
     def close(self) -> None:
+        self._tele_stop.set()
         with self._lock:
             self._closed = True
         try:
@@ -463,10 +520,12 @@ class ThreadWorker:
     this so the frame/dedup/fault paths run on CPU CI in milliseconds."""
 
     def __init__(self, name: str, make_service, *,
-                 max_frame: int = MAX_FRAME_BYTES):
+                 max_frame: int = MAX_FRAME_BYTES,
+                 telemetry_s: Optional[float] = None):
         self.name = name
         self.service = make_service()
-        self.server = WorkerServer(self.service, max_frame=max_frame)
+        self.server = WorkerServer(self.service, max_frame=max_frame,
+                                   telemetry_s=telemetry_s)
         self._killed = False
 
     def await_ready(self) -> int:
@@ -506,6 +565,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--capacity", type=int, default=None)
     ap.add_argument("--max-capacity", type=int, default=None)
     ap.add_argument("--max-frame", type=int, default=MAX_FRAME_BYTES)
+    ap.add_argument("--telemetry-s", type=float, default=None,
+                    help="TELEMETRY push cadence in seconds (default: "
+                         "JEPSEN_TPU_TELEMETRY_S or 1.0; <= 0 disables)")
     args = ap.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO, stream=sys.stderr,
@@ -519,7 +581,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         svc_kw["max_capacity"] = args.max_capacity
     service = CheckService(**svc_kw)
     server = WorkerServer(service, host=args.host, port=args.port,
-                          max_frame=args.max_frame)
+                          max_frame=args.max_frame,
+                          telemetry_s=args.telemetry_s)
     stop = threading.Event()
 
     def _on_signal(signum, frame):  # noqa: ARG001 — signal signature

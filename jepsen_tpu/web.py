@@ -10,6 +10,13 @@ submission surface:
 
 - ``GET /metrics``  — the full metrics snapshot as JSON (counters, queue
   depth, lane occupancy, engine-cache hit/miss/recompile, traces);
+- ``GET /metrics.prom`` — the same snapshot in Prometheus text
+  exposition (obs/prom.py), pow2 histogram buckets rendered as ``le``
+  labels; fleet snapshots add per-worker staleness and alert counters;
+- ``GET /alerts``   — the SLO engine's alert ring + spec/breach state
+  (obs/slo.py); empty document when no engine is attached;
+- ``POST /recorder?on=1|0`` — arm/disarm the flight recorder at
+  runtime (fans out to worker processes through a Fleet);
 - ``GET /healthz``  — liveness probe: per-worker alive/circuit/queue
   status (the fleet's view with ``--workers N``, a degenerate one-worker
   view for a single service); 503 while no worker can take traffic;
@@ -124,6 +131,29 @@ def make_handler(base: str, service=None):
                 else:
                     hz = service.healthz()
                 return self._send_json(200 if hz.get("ok") else 503, hz)
+            if path == "/metrics.prom":
+                # The same snapshot in Prometheus text exposition —
+                # fleet-shaped snapshots additionally carry per-worker
+                # staleness gauges and the SLO alert counter, so one
+                # scrape of the fleet endpoint sees the whole plane.
+                from jepsen_tpu.obs.prom import render_prom
+                if service is None:
+                    from jepsen_tpu.engine.cache import engine_cache_stats
+                    snap = {"counters": engine_cache_stats()}
+                else:
+                    snap = service.metrics.snapshot()
+                return self._send(
+                    200, render_prom(snap).encode(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            if path == "/alerts":
+                # SLO alert ring (obs/slo.py).  Degenerate services with
+                # no SLO engine answer an empty document, not a 404 — a
+                # dashboard can poll every deployment shape uniformly.
+                alerts_fn = getattr(service, "alerts", None)
+                slo = getattr(service, "slo", None)
+                return self._send_json(200, {
+                    "alerts": alerts_fn() if alerts_fn else [],
+                    "slo": slo.snapshot() if slo is not None else {}})
             if path == "/metrics":
                 if service is None:
                     # Route through the shared engine-cache module, not a
@@ -170,7 +200,22 @@ def make_handler(base: str, service=None):
             return self._send(404, b"not found")
 
         def do_POST(self):  # noqa: N802
-            if unquote(self.path) != "/submit":
+            path = unquote(self.path)
+            if path == "/recorder" or path.startswith("/recorder?"):
+                # Runtime arm/disarm of the flight recorder:
+                # ``POST /recorder?on=1`` opens a capture window around a
+                # live incident without a restart.  A Fleet fans the
+                # toggle out to every worker process; anything else arms
+                # the local process ring.
+                on = "on=1" in path
+                setter = getattr(service, "set_recorder", None)
+                if setter is not None:
+                    return self._send_json(200, setter(on))
+                from jepsen_tpu.obs.recorder import RECORDER
+                (RECORDER.enable if on else RECORDER.disable)()
+                return self._send_json(
+                    200, {"enabled": RECORDER.enabled, **RECORDER.stats()})
+            if path != "/submit":
                 return self._send(404, b"not found")
             if service is None:
                 return self._send_json(

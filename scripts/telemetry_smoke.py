@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Watchtower smoke: push telemetry + SLO burn alerts under real chaos.
+
+A 3-worker spawned ProcFleet — real OS worker processes pushing
+TELEMETRY frames over the serve/transport.py wire at a fast cadence —
+driven through four phases:
+
+1. **clean** — a warm mixed campaign; asserts every worker (and the
+   ``fleet`` pseudo-worker) is pushing, nobody is stale, and the SLO
+   engine fired ZERO alerts: the shipped ceilings must be quiet on a
+   healthy fleet, or the alert channel trains operators to ignore it;
+2. **latency breach** — tightens the ``p99_dispatch_verdict_us``
+   ceiling to just above the measured clean p99, then injects
+   ``slow_link`` wire latency on every worker link.  The wire delay
+   itself is only visible from the *fleet-side* dispatch->verdict
+   histogram (worker-side spans never see the network), so that vantage
+   MUST breach and fire EXACTLY ONE alert: one breach episode, one
+   alert, no flood while the breach persists.  Workers may *also*
+   legitimately breach — delayed links bunch arrivals and worker-side
+   queue wait genuinely grows — but never more than once per
+   (slo, worker) episode;
+3. **SIGKILL staleness** — kills one worker process (supervision slowed
+   so the slot stays dead) and asserts the store flags it stale within
+   the 2-missed-intervals contract, and that the ``worker_stale_s`` SLO
+   fires for exactly that worker;
+4. **exposition** — the fleet's /metrics.prom document passes the
+   line-format validator and carries the staleness gauge + alert
+   counter.
+
+Writes the report to argv[1] (default /tmp/telemetry_report.json) and
+the full telemetry store dump + alert ring to argv[2] (default
+/tmp/telemetry_store.json) — CI uploads both as artifacts.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Arm the flight recorder before the singleton is constructed and before
+# worker processes are spawned (they inherit the env knob): the smoke
+# also proves alerts land in the recorder ring.
+os.environ["JEPSEN_TPU_FLIGHT_RECORDER"] = "1"
+
+from jepsen_tpu.nemesis.registry import FaultRegistry  # noqa: E402
+from jepsen_tpu.obs.prom import render_prom, validate_exposition
+from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.fleet import ProcFleet
+from jepsen_tpu.synth import cas_register_history, list_append_history
+
+TELEMETRY_S = 0.3
+DEADLINE_S = 90.0
+N_WGL, N_ELLE, CLIENTS = 12, 4, 4
+SLOW_LINK_S = 0.5
+
+
+def build_jobs():
+    jobs = [("wgl", cas_register_history(50, concurrency=4, seed=s))
+            for s in range(N_WGL)]
+    jobs += [("elle", list_append_history(20, seed=500 + s))
+             for s in range(N_ELLE)]
+    return jobs
+
+
+def submit_kw(kind):
+    return ({"model": "cas-register"} if kind == "wgl"
+            else {"workload": "list-append"})
+
+
+def run_campaign(fleet, jobs):
+    def client(span):
+        for i in span:
+            kind, h = jobs[i]
+            fleet.submit(h, kind=kind, deadline_s=DEADLINE_S,
+                         **submit_kw(kind)).wait(timeout=300)
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads), "campaign hung"
+
+
+def wait_until(pred, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return time.monotonic()
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def wait_until_value(fn, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main():
+    report_path = (sys.argv[1] if len(sys.argv) > 1
+                   else "/tmp/telemetry_report.json")
+    store_path = (sys.argv[2] if len(sys.argv) > 2
+                  else "/tmp/telemetry_store.json")
+    jobs = build_jobs()
+    report = {}
+
+    fleet = ProcFleet(workers=3, spawn=True, max_lanes=32,
+                      default_deadline_s=DEADLINE_S,
+                      telemetry_s=TELEMETRY_S, heartbeat_s=0.15,
+                      supervise_s=60.0)   # a killed slot STAYS dead here
+    chaos = ChaosNemesis(fleet, registry=FaultRegistry(), seed=11)
+    try:
+        # -- phase 1: clean ------------------------------------------------
+        run_campaign(fleet, jobs)
+        wait_until(lambda: all(fleet.telemetry.push_count(w.wid) >= 3
+                               for w in fleet.workers)
+                   and fleet.telemetry.push_count("fleet") >= 3,
+                   20.0, "3 pushes from every worker")
+        assert fleet.telemetry.stale_workers() == [], (
+            f"stale workers on a healthy fleet: "
+            f"{fleet.telemetry.stale_workers()}")
+        clean_alerts = fleet.alerts()
+        assert clean_alerts == [], (
+            f"false alerts on a clean fleet: {clean_alerts}")
+        tele = fleet.telemetry.snapshot()
+        pids = {w: e["pid"] for w, e in tele["workers"].items()}
+        assert len({p for p in pids.values() if p}) >= 4, (
+            f"expected 4 distinct pids (3 workers + fleet): {pids}")
+        report["clean"] = {"workers": sorted(tele["workers"]),
+                           "pids": pids, "alerts": 0}
+
+        # -- phase 2: injected wire latency must breach p99 ---------------
+        # one warm wgl mini-campaign so the measurement window holds
+        # warm-path observations only (the clean campaign's tail may be
+        # elle first-compiles, which would inflate the baseline)
+        run_campaign(fleet, [j for j in jobs if j[0] == "wgl"][:6])
+        clean_p99 = wait_until_value(
+            lambda: fleet.telemetry.rates(
+                "fleet").get("p99-dispatch-verdict-us"),
+            10.0, "a windowed fleet-side dispatch->verdict p99")
+        # staleness gets a pass during the injection: the slowed links
+        # also delay TELEMETRY frames, and that is not the signal under
+        # test in this phase
+        fleet.slo.set_ceiling("worker_stale_s", 1e9)
+        ceiling = clean_p99 + 250_000.0     # clean p99 + 0.25 s
+        fleet.slo.set_ceiling("p99_dispatch_verdict_us", ceiling)
+        faults = [chaos.slow_link(w.wid, delay_s=SLOW_LINK_S)
+                  for w in fleet.workers]
+        run_campaign(fleet, [j for j in jobs if j[0] == "wgl"][:8])
+        wait_until(lambda: fleet.alerts(), 20.0, "the latency alert")
+        for f in faults:
+            chaos.heal(f)
+        time.sleep(4 * TELEMETRY_S)         # a few post-heal evaluations
+        alerts = fleet.alerts()
+        lat = [a for a in alerts if a["slo"] == "p99_dispatch_verdict_us"]
+        fleet_lat = [a for a in lat if a["worker"] == "fleet"]
+        assert len(fleet_lat) == 1, (
+            f"the fleet vantage (the one that sees the wire) must fire "
+            f"exactly one alert for its one breach episode, got "
+            f"{len(fleet_lat)}: {lat}")
+        assert fleet_lat[0]["value"] > ceiling
+        episodes = [(a["slo"], a["worker"]) for a in alerts]
+        assert len(episodes) == len(set(episodes)), (
+            f"alert flood: some (slo, worker) episode fired more than "
+            f"once: {alerts}")
+        others = [a for a in alerts if a["slo"] != "p99_dispatch_verdict_us"]
+        assert others == [], f"collateral alerts during injection: {others}"
+        report["latency"] = {"clean_p99_us": clean_p99,
+                             "ceiling_us": ceiling,
+                             "alert": fleet_lat[0],
+                             "worker_vantage_alerts": len(lat) - 1}
+
+        # -- phase 3: SIGKILL -> stale within 2 intervals ------------------
+        fleet.slo.set_ceiling("worker_stale_s", 0.0)
+        victim = fleet.workers[2]
+        wait_until(lambda: not fleet.telemetry.is_stale(victim.wid),
+                   10.0, "victim healthy before the kill")
+        t_kill = time.monotonic()
+        os.kill(victim.service.launcher.proc.pid, signal.SIGKILL)
+        t_stale = wait_until(
+            lambda: fleet.telemetry.is_stale(victim.wid),
+            20.0, "the killed worker to go stale")
+        detect_s = t_stale - t_kill
+        # contract: stale once 2 push intervals pass with no push; give
+        # one interval of polling/clock slack on a shared CI box
+        bound = 2 * TELEMETRY_S + TELEMETRY_S + 1.0
+        assert detect_s <= bound, (
+            f"staleness detected after {detect_s:.2f}s > {bound:.2f}s "
+            f"(2 intervals + slack)")
+        wait_until(lambda: any(a["slo"] == "worker_stale_s"
+                               and a["worker"] == str(victim.wid)
+                               for a in fleet.alerts()),
+                   10.0, "the worker_stale_s alert")
+        report["sigkill"] = {"victim": victim.wid,
+                             "detect_s": round(detect_s, 3),
+                             "bound_s": round(bound, 3)}
+
+        # -- phase 4: exposition -------------------------------------------
+        snap = fleet.metrics.snapshot()
+        text = render_prom(snap)
+        families = validate_exposition(text)
+        stale_gauge = {labels.get("worker"): v
+                       for name, labels, v
+                       in families["jepsen_tpu_worker_stale"]}
+        assert stale_gauge.get(str(victim.wid)) == 1, (
+            f"killed worker not stale in the exposition: {stale_gauge}")
+        fired = families["jepsen_tpu_slo_alerts_total"][0][2]
+        assert fired >= 2, f"alert counter too low: {fired}"
+        alert_events = [e for e in RECORDER.snapshot()
+                        if e["cat"] == "alert"]
+        assert alert_events, "alerts never reached the flight recorder"
+        report["exposition"] = {"families": len(families),
+                                "slo_alerts_total": fired,
+                                "recorder_alert_events":
+                                    len(alert_events)}
+
+        with open(store_path, "w") as f:
+            json.dump({"store": fleet.telemetry.dump(),
+                       "alerts": fleet.alerts(),
+                       "slo": fleet.slo.snapshot()}, f, indent=2,
+                      default=str)
+    finally:
+        fleet.close(timeout=60.0)
+
+    report["ok"] = True
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps(report, indent=2, default=str))
+
+
+if __name__ == "__main__":
+    main()

@@ -142,6 +142,25 @@ class TestHistograms:
         # malformed worker snapshots are skipped, not fatal
         assert "junk" not in merged
 
+    def test_merge_skips_are_counted(self):
+        """Silent drops are the availability call; *silent* silent drops
+        are not — every malformed per-histogram entry bumps the
+        process-wide counter that Metrics.snapshot() surfaces as
+        ``hist-merge-skipped``.  A whole-snapshot None (the worker-
+        unreachable convention) is protocol, not corruption, and must
+        NOT count."""
+        from jepsen_tpu.obs.hist import merge_skipped_count
+        before = merge_skipped_count()
+        hs = HistogramSet()
+        hs.observe("edge:a->b", 0.001)
+        merge_hist_snapshots([hs.snapshot(), None])   # protocol: free
+        assert merge_skipped_count() == before
+        merge_hist_snapshots([
+            {"junk": 3},                              # non-dict entry
+            {"bad": {"buckets-us": {"x": "y"}}},      # uncastable buckets
+            hs.snapshot()])
+        assert merge_skipped_count() == before + 2
+
     def test_concurrent_observe(self):
         hs = HistogramSet()
 

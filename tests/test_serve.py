@@ -435,8 +435,12 @@ class TestMetricsSchema:
         snap = svc.metrics.snapshot()
         assert set(snap) == self.SECTIONS
         assert set(snap["counters"]) >= self.SEED_COUNTERS
+        # hist-merge-skipped: the fleet-scrape corruption counter
+        # (obs/hist.py) surfaces in every snapshot
+        assert "hist-merge-skipped" in snap["counters"]
         assert set(snap["gauges"]) == {"queue-depth", "inflight-requests",
-                                       "compiles-per-1k-dispatches"}
+                                       "compiles-per-1k-dispatches",
+                                       "epochs-behind-live"}
         # the steady-state compile gauge is a ratio (or None pre-dispatch)
         c1k = snap["gauges"]["compiles-per-1k-dispatches"]
         assert c1k is None or c1k >= 0.0
@@ -455,6 +459,28 @@ class TestMetricsSchema:
         for h in snap["histograms"].values():
             assert {"count", "sum-s", "p50", "p90", "p99",
                     "buckets-us"} == set(h)
+
+    def test_prometheus_exposition_schema(self, svc):
+        """The /metrics.prom contract: every counter, gauge, and
+        histogram in the snapshot appears in the text exposition under
+        its mechanical ``metric_name`` mapping, and the whole document
+        passes the line-format validator (grammar, label syntax,
+        histogram bucket monotonicity).  A rename anywhere in the
+        snapshot schema is therefore a test-visible act."""
+        from jepsen_tpu.obs.prom import (metric_name, render_prom,
+                                         validate_exposition)
+        svc.check(cas_register_history(30, seed=32), kind="wgl",
+                  model="cas-register")
+        snap = svc.metrics.snapshot()
+        text = render_prom(snap)
+        families = validate_exposition(text)
+        for name in snap["counters"]:
+            assert metric_name("counter", name) in families
+        for name, v in snap["gauges"].items():
+            if v is not None:   # None gauges are deliberately unscraped
+                assert metric_name("gauge", name) in families
+        for name in snap["histograms"]:
+            assert metric_name("histogram", name) in families
 
     def test_concurrent_snapshots_never_tear_structurally(self, svc):
         """Gauges are point samples taken outside the metrics lock
