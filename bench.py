@@ -91,6 +91,7 @@ TIER_TIMEOUT_S = {
     "fleet": 300 if SMOKE else 900,
     "procfleet": 420 if SMOKE else 1200,
     "obs": 300 if SMOKE else 900,
+    "elastic": 300 if SMOKE else 900,
 }
 
 
@@ -1041,6 +1042,130 @@ def tier_obs():
           "monitor_epoch_spans": len(mon_spans)})
 
 
+def tier_elastic():
+    """Elastic fleet tier: the Fleetport control plane under membership
+    churn.  Workers join (REGISTER over the authenticated wire) and
+    leave (lease force-expired by chaos, evicted by the reaper — no
+    local signal) while a campaign is in flight; every verdict must
+    stay lane-for-lane identical to a solo service.  Join and leave
+    walls land in the log-bucketed latency histograms
+    (jepsen_tpu.obs.hist) so the tier reports real p50/p99, and the
+    flight-recorder toll is re-measured on this topology against the
+    same <2% budget tier_obs holds the fixed fleet to."""
+    from jepsen_tpu.obs.recorder import RECORDER
+    from jepsen_tpu.serve import CheckService
+    from jepsen_tpu.serve.chaos import ChaosNemesis
+    from jepsen_tpu.serve.fleetport import Fleetport
+    from jepsen_tpu.serve.worker_main import FleetRegistration, ThreadWorker
+    from jepsen_tpu.synth import cas_register_history
+    n = 12 if SMOKE else 48
+    reps = 2 if SMOKE else 3
+    cycles = 3 if SMOKE else 8
+    token = "elastic-bench-token"   # exercised, never emitted
+    hists = [cas_register_history(60, concurrency=4, seed=s)
+             for s in range(n)]
+
+    solo = CheckService(max_lanes=32, capacity=64)
+    reqs = [solo.submit(h, kind="wgl", model="cas-register",
+                        deadline_s=120.0) for h in hists]
+    v_solo = [r.wait(timeout=300)["valid"] for r in reqs]
+    solo.close(timeout=60.0)
+
+    fp = Fleetport(listen_host="127.0.0.1", lease_s=1.0,
+                   token=token, max_lanes=32, capacity=64,
+                   default_deadline_s=120.0, telemetry_s=0.2)
+    live = {}
+
+    def join(name):
+        tw = ThreadWorker(name,
+                          lambda: CheckService(max_lanes=32, capacity=64),
+                          telemetry_s=0.2)
+        reg = FleetRegistration(
+            tw.server, fleet_addr=("127.0.0.1", fp.listen_port),
+            name=name, advertise_host="127.0.0.1", port=tw.server.port,
+            token=token)
+        t0 = time.time()
+        reg.start()
+        assert reg.wait_registered(30), f"{name} never registered"
+        fp.metrics.hists.observe("fleet:join-s", time.time() - t0)
+        live[name] = (tw, reg)
+
+    def leave(name, chaos):
+        tw, reg = live.pop(name)
+        reg.stop()                      # no comeback after the heal
+        key = chaos.expire_lease(name)
+        t0 = time.time()
+        deadline = t0 + 30
+        while time.time() < deadline and fp.registry.is_live(name):
+            time.sleep(0.01)
+        assert not fp.registry.is_live(name), f"{name} never evicted"
+        fp.metrics.hists.observe("fleet:leave-s", time.time() - t0)
+        chaos.heal(key)
+        tw.terminate()
+
+    def run(svc):
+        t0 = time.time()
+        rr = [svc.submit(h, kind="wgl", model="cas-register",
+                         deadline_s=120.0) for h in hists]
+        vals = [r.wait(timeout=300)["valid"] for r in rr]
+        return time.time() - t0, vals
+
+    try:
+        join("ew0")
+        join("ew1")
+        run(fp)                         # warm the bucket ladder
+
+        # churn under load: a campaign in flight while a worker joins
+        # and another leaves, every cycle
+        chaos = ChaosNemesis(fp)
+        for c in range(cycles):
+            name = f"churn{c}"
+            rr = [fp.submit(h, kind="wgl", model="cas-register",
+                            deadline_s=120.0) for h in hists]
+            join(name)
+            leave(name, chaos)
+            v = [r.wait(timeout=300)["valid"] for r in rr]
+            assert v == v_solo, "verdicts diverged under membership churn"
+
+        # recorder toll on the elastic topology (min-of-reps each side)
+        RECORDER.disable()
+        t_off = min(run(fp)[0] for _ in range(reps))
+        RECORDER.enable()
+        RECORDER.clear()
+        t_on = min(run(fp)[0] for _ in range(reps))
+        _, v_final = run(fp)
+        assert v_final == v_solo, "verdicts diverged on elastic fleet"
+        snap = fp.metrics.snapshot()
+    finally:
+        for nm in list(live):
+            tw, reg = live.pop(nm)
+            reg.stop()
+            tw.terminate()
+        fp.close(timeout=60.0)
+
+    overhead = (t_on / t_off - 1.0) if t_off else None
+    edges = {}
+    for edge in ("fleet:join-s", "fleet:leave-s"):
+        h = snap["histograms"].get(edge) or {}
+        assert (h.get("count") or 0) >= cycles and (h.get("p99") or 0) > 0, \
+            f"histogram {edge} is empty: the churn measured nothing"
+        edges[edge] = {"count": h.get("count"),
+                       "p50_s": h.get("p50"), "p99_s": h.get("p99")}
+    emit({"n_histories": n,
+          "churn_cycles": cycles,
+          "join": edges["fleet:join-s"],
+          "leave": edges["fleet:leave-s"],
+          "recorder_off_s": round(t_off, 3),
+          "recorder_on_s": round(t_on, 3),
+          "recorder_overhead": (round(overhead, 4)
+                                if overhead is not None else None),
+          "evictions": snap["counters"].get("lease-evictions", 0),
+          "joins": snap["counters"].get("fleet-joins", 0),
+          "rejoins": snap["counters"].get("fleet-rejoins", 0),
+          "rerouted": snap["counters"].get("cells-rerouted", 0),
+          "auth_rejections": snap["counters"].get("auth-rejections", 0)})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -1059,6 +1184,7 @@ TIER_FNS = {
     "fleet": tier_fleet,
     "procfleet": tier_procfleet,
     "obs": tier_obs,
+    "elastic": tier_elastic,
 }
 
 

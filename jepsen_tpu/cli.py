@@ -149,6 +149,27 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                          "with --procs); also togglable at runtime via "
                          "POST /recorder?on=1")
 
+    pf = sub.add_parser("fleet",
+                        help="run a fleetport: the multi-host control "
+                             "plane workers register with "
+                             "(serve/fleetport.py)")
+    pf.add_argument("--listen", default="0.0.0.0:7600",
+                    metavar="HOST:PORT",
+                    help="address the REGISTER/renewal listener binds "
+                         "(default 0.0.0.0:7600)")
+    pf.add_argument("--port", type=int, default=8080,
+                    help="web port (GET /fleet, /metrics, /healthz)")
+    pf.add_argument("--store", default="store")
+    pf.add_argument("--lease-s", type=float, default=None,
+                    help="worker lease duration in seconds (default "
+                         "JEPSEN_TPU_LEASE_S or 10)")
+    pf.add_argument("--max-lanes", type=int, default=64)
+    pf.add_argument("--max-queue", type=int, default=4096)
+    pf.add_argument("--journal-dir", default=None,
+                    help="in-flight journal directory (default "
+                         "<store>/fleet-journal); 'none' disables")
+    pf.add_argument("--telemetry-s", type=float, default=None)
+
     pq = sub.add_parser("submit",
                         help="submit a stored history to a running serve")
     pq.add_argument("dir", help="store run directory (or .../latest)")
@@ -256,6 +277,44 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
         finally:
             if service is not None:
                 service.close(timeout=30.0)
+        return 0
+
+    if args.cmd == "fleet":
+        from jepsen_tpu.serve.fleetport import Fleetport
+        from jepsen_tpu.web import serve
+        lhost, _, lport = args.listen.rpartition(":")
+        jdir = args.journal_dir
+        if jdir is None:
+            jdir = os.path.join(args.store, "fleet-journal")
+        elif jdir == "none":
+            jdir = None
+        service = Fleetport(listen_host=lhost or "0.0.0.0",
+                            listen_port=int(lport),
+                            lease_s=args.lease_s,
+                            store_base=args.store,
+                            journal_dir=jdir,
+                            max_lanes=args.max_lanes,
+                            max_queue_cells=args.max_queue,
+                            telemetry_s=args.telemetry_s)
+        print(json.dumps({
+            "fleetport": {"host": service.listen_host,
+                          "port": service.listen_port},
+            "lease-s": service.registry.lease_s,
+            # boolean only — the token itself is never printed
+            "auth-enabled": bool(service._token)}), flush=True)
+        import signal as _signal
+
+        def _fterm(signum, frame):  # noqa: ARG001 — signal signature
+            raise SystemExit(143)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _fterm)
+        except ValueError:  # not the main thread
+            pass
+        try:
+            serve(base=args.store, port=args.port, service=service)
+        finally:
+            service.close(timeout=30.0)
         return 0
 
     if args.cmd == "submit":

@@ -16,7 +16,7 @@ the tuple (earlier = outermost-permitted).
 
 The declared order mirrors the call graph today:
 
-    fleet-supervisor -> fleet -> fleet-slot
+    fleet-supervisor -> fleet -> fleet-registry -> fleet-slot
       -> transport-ready -> transport-state -> transport-send
       -> procworker-state -> procworker-send
       -> service -> scheduler -> request -> metrics
@@ -30,7 +30,10 @@ The declared order mirrors the call graph today:
       these must never wrap another declared lock)
 
 The transport chain follows a respawn end to end: the ProcFleet
-supervisor (``_sup_lock``) restarts a slot (``_restart_lock``), whose
+supervisor (``_sup_lock`` — the Fleetport's slot-admission/eviction
+lock sits at the same level, and holds the registry's membership lock
+(``fleet-registry``) beneath it when binding slots), restarts a slot
+(``_restart_lock``), whose
 new ProcWorkerService builds its wire under ``_ready_lock``; the
 WireClient guards connection + pending-table state with its ``_lock``
 and serializes frame writes with ``_send_lock``; worker-side, the
@@ -45,9 +48,12 @@ from typing import List, Optional, Tuple
 
 LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
     ("fleet-supervisor",
-     [(r"serve/fleet\.py$", r"^self\._sup_lock$")]),
+     [(r"serve/fleet\.py$", r"^self\._sup_lock$"),
+      (r"serve/fleetport\.py$", r"^self\._sup_lock$")]),
     ("fleet",
      [(r"serve/fleet\.py$", r"^self\._(lock|cond)$")]),
+    ("fleet-registry",
+     [(r"serve/registry\.py$", r"^self\._lock$")]),
     ("fleet-slot",
      [(r"serve/fleet\.py$", r"^self\._restart_lock$"),
       (r"", r"^(w|worker)\._restart_lock$")]),

@@ -215,6 +215,17 @@ class SloEngine:
                         args=dict(alert))
         return alert
 
+    def forget(self, worker: Any) -> None:
+        """Close every open breach episode for an evicted worker.  The
+        registry removed it, so ``worker_stale_s`` (and everything else)
+        can never measure an in-SLO sample to re-arm on — without this
+        the episode would stay open forever against a ghost."""
+        with self._lock:
+            for key in [k for k in self._breach_t0 if k[1] == worker]:
+                self._breach_t0.pop(key, None)
+            for key in [k for k in self._fired if k[1] == worker]:
+                self._fired.pop(key, None)
+
     # -- export ----------------------------------------------------------------
 
     def alerts(self) -> List[Dict[str, Any]]:

@@ -190,6 +190,7 @@ class TelemetryStore:
         self._pushes: Dict[Any, int] = {}
         # breaker integration: {wid: [is_open, since_t, accumulated_s]}
         self._breaker: Dict[Any, List[Any]] = {}
+        self._evictions = 0
 
     # -- ingest ----------------------------------------------------------------
 
@@ -216,6 +217,22 @@ class TelemetryStore:
             ring.append(entry)
             self._pushes[worker] = self._pushes.get(worker, 0) + 1
         return entry
+
+    def evict(self, worker: Any) -> bool:
+        """Forget a worker the registry evicted (lease expiry): its
+        ring, birth time, push count, and breaker integral all go — the
+        staleness sweep must not alert on a member that no longer
+        exists, and a later re-registration under the same key starts a
+        fresh staleness clock.  Returns True when the worker was known."""
+        with self._lock:
+            known = worker in self._rings or worker in self._born
+            self._rings.pop(worker, None)
+            self._born.pop(worker, None)
+            self._pushes.pop(worker, None)
+            self._breaker.pop(worker, None)
+            if known:
+                self._evictions += 1
+            return known
 
     def observe_breaker(self, worker: Any, is_open: bool,
                         now: Optional[float] = None) -> None:
@@ -379,6 +396,8 @@ class TelemetryStore:
                 "rates": self.rates(w),
             }
         out["stale-workers"] = [str(w) for w in self.stale_workers(now=now)]
+        with self._lock:
+            out["evictions"] = self._evictions
         return out
 
     def dump(self) -> Dict[str, Any]:

@@ -1,0 +1,104 @@
+"""Frame authentication for the multi-host wire: HMAC envelopes.
+
+On one host the wire's trust boundary is the loopback interface; the
+moment workers REGISTER from other machines (serve/fleetport.py) every
+frame crosses a real network, and an unauthenticated control plane
+would accept SUBMITs, REGISTERs, and lease renewals from anyone who can
+reach the port.  The envelope is deliberately small: a shared secret
+(``JEPSEN_TPU_FLEET_TOKEN``) and an HMAC-SHA256 over the frame's
+canonical JSON, carried in an ``auth`` field beside the payload.
+
+Discipline:
+
+- **constant-time verify** — :func:`verify_frame` compares digests with
+  ``hmac.compare_digest`` only; a byte-at-a-time comparison would leak
+  the mac through timing.
+- **the token never travels and is never logged** — only the keyed
+  digest crosses the wire; no function in this module (or any caller)
+  may put the token into a log record, an ERROR frame, a trace span, or
+  a telemetry payload.  Export surfaces carry at most
+  ``auth-enabled: true``.
+- **no token = auth off** — an unset/empty env var keeps the wire
+  exactly as it was (single-host CI, loopback fleets).  Mixed
+  deployments fail closed: a verifying side with a token rejects
+  unsigned frames with a typed ERROR (``error-class: AuthError``) and a
+  hangup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+from typing import Any, Dict, Optional
+
+#: the env var holding the shared fleet secret
+TOKEN_ENV = "JEPSEN_TPU_FLEET_TOKEN"
+
+#: the frame field carrying the mac (stripped before digesting)
+AUTH_FIELD = "auth"
+
+
+class AuthError(Exception):
+    """A frame failed authentication (missing or wrong mac).  The
+    message never contains token material — only which peer and why."""
+
+
+def fleet_token(env: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """The configured shared secret, or None when auth is disabled.
+    Read at call time, not import time, so tests and long-lived
+    processes see a freshly-set env var."""
+    raw = (env if env is not None else os.environ).get(TOKEN_ENV, "")
+    raw = raw.strip()
+    return raw or None
+
+
+def canonical_frame_bytes(frame: Dict[str, Any]) -> bytes:
+    """The digest input: the frame minus its ``auth`` field, serialized
+    canonically (sorted keys, minimal separators) so both ends of the
+    wire — which each hold a *parsed* dict, not the original bytes —
+    compute the identical preimage."""
+    body = {k: v for k, v in frame.items() if k != AUTH_FIELD}
+    return json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str).encode("utf-8")
+
+
+def frame_mac(frame: Dict[str, Any], token: str) -> str:
+    return hmac.new(token.encode("utf-8"), canonical_frame_bytes(frame),
+                    hashlib.sha256).hexdigest()
+
+
+def sign_frame(frame: Dict[str, Any],
+               token: Optional[str]) -> Dict[str, Any]:
+    """A copy of ``frame`` carrying its mac; the frame itself when auth
+    is disabled (no token)."""
+    if not token:
+        return frame
+    out = dict(frame)
+    out[AUTH_FIELD] = frame_mac(out, token)
+    return out
+
+
+def verify_frame(frame: Dict[str, Any], token: Optional[str]) -> bool:
+    """Constant-time mac check.  No token configured = every frame
+    passes (auth off); with a token, a frame with a missing, non-string,
+    or wrong mac fails."""
+    if not token:
+        return True
+    mac = frame.get(AUTH_FIELD)
+    if not isinstance(mac, str):
+        return False
+    return hmac.compare_digest(mac, frame_mac(frame, token))
+
+
+def require_frame(frame: Dict[str, Any], token: Optional[str],
+                  peer: str = "peer") -> None:
+    """Verify or raise :class:`AuthError` — the server-side gate.  The
+    error text names the peer and the failure mode only; it is safe to
+    put on the wire as a typed ERROR frame."""
+    if not verify_frame(frame, token):
+        what = ("unauthenticated frame"
+                if not isinstance(frame.get(AUTH_FIELD), str)
+                else "bad frame mac")
+        raise AuthError(f"{what} from {peer}")

@@ -238,6 +238,46 @@ class ChaosNemesis:
         return self._register(f"fleet:poison:{wid}", undo,
                               f"worker {wid} dispatches poisoned")
 
+    # -- lease faults (Fleetport registries) ------------------------------
+    def expire_lease(self, name_or_wid) -> str:
+        """Lease-expiry fault: the multi-host eviction path, with no
+        local signal anywhere.  Renewals from the target worker are
+        blocked (its pushes keep arriving — a blocked renewal must not
+        resurrect the lease) and the lease is backdated to expired-now,
+        so the fleetport's reaper evicts it on the next sweep exactly as
+        if the worker had gone silent.  The worker process itself is
+        never touched: it keeps running, correctly, on the far side of a
+        revoked membership.  The undo unblocks renewals — the worker's
+        own registration loop re-registers it as a new generation."""
+        registry = getattr(self.fleet, "registry", None)
+        if registry is None or not hasattr(registry, "force_expire"):
+            raise ValueError(
+                "this fleet has no lease registry (fixed worker set) — "
+                "lease faults need a serve/fleetport.py Fleetport; use "
+                "kill_worker / partition_worker against fixed fleets")
+        if isinstance(name_or_wid, int):
+            names = [n for n in registry.names()
+                     if getattr(registry.get(n), "wid", None)
+                     == name_or_wid]
+            if not names:
+                raise ValueError(
+                    f"no live registered worker holds wid {name_or_wid}")
+            name = names[0]
+        else:
+            name = str(name_or_wid)
+        registry.block_renewals(name)
+        if not registry.force_expire(name):
+            registry.unblock_renewals(name)
+            raise ValueError(f"worker {name!r} is not a live member")
+        self.fleet.metrics.inc("chaos-lease-expiries")
+
+        def undo():
+            registry.unblock_renewals(name)
+
+        return self._register(f"fleet:lease:{name}", undo,
+                              f"worker {name} lease force-expired, "
+                              f"renewals blocked")
+
     # -- link faults (ProcFleet wires) ------------------------------------
     def partition_worker(self, wid: int) -> str:
         """Network partition: sever this worker's proxy link.  Live

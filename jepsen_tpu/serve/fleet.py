@@ -130,6 +130,13 @@ class FleetWorker:
     def alive(self) -> bool:
         return self.service.alive()
 
+    def fits(self, cell) -> bool:
+        """Mesh/capability placement predicate for the router's ranked
+        walk.  The base slot accepts every cell — today's fixed fleets
+        are homogeneous; registry-backed slots (serve/fleetport.py)
+        override this with the worker's advertised mesh capacity."""
+        return True
+
     def kill(self) -> list:
         """Crash this worker (chaos fault / decommission): abrupt service
         kill, queued worker-side cells evicted.  The fleet's cell owners
@@ -615,7 +622,7 @@ class Fleet:
             if req.expired():
                 self.metrics.inc("deadline-expired")
                 return expired_result(req.kind)
-            worker = self.router.pick(token, exclude=excluded)
+            worker = self.router.pick(token, exclude=excluded, cell=cell)
             if worker is None:
                 # Every alive worker's circuit is open (or everyone is
                 # dead).  Wait out a cooldown — a half-open probe slot
@@ -745,7 +752,8 @@ class Fleet:
             if hreq is None and hedge_at is not None \
                     and now - t0 >= hedge_at:
                 hedge_worker = self.router.pick(cell.route_token(),
-                                                exclude=hedge_excluded)
+                                                exclude=hedge_excluded,
+                                                cell=cell)
                 if hedge_worker is not None:
                     try:
                         hreq = hedge_worker.service.submit(
@@ -970,8 +978,22 @@ class Fleet:
 
     #: per-probe wall bound on the whole deep-healthz fan-out — one hung
     #: worker must cost the endpoint at most this, not its rpc timeout
-    #: serially multiplied by the fleet size
+    #: serially multiplied by the fleet size.  Env-overridable
+    #: (JEPSEN_TPU_DEEP_HEALTHZ_S): a WAN-hop worker in a multi-host
+    #: fleet cannot answer inside the loopback-tuned 2 s window.
     DEEP_HEALTHZ_TIMEOUT_S = 2.0
+
+    @classmethod
+    def deep_healthz_timeout_s(cls) -> float:
+        """The deep-healthz fan-out budget: ``JEPSEN_TPU_DEEP_HEALTHZ_S``
+        (seconds, > 0) or the 2 s default.  Read at call time so a
+        running fleet picks up a re-tune without restart."""
+        raw = os.environ.get("JEPSEN_TPU_DEEP_HEALTHZ_S", "")
+        try:
+            v = float(raw) if raw else cls.DEEP_HEALTHZ_TIMEOUT_S
+        except ValueError:
+            return cls.DEEP_HEALTHZ_TIMEOUT_S
+        return v if v > 0 else cls.DEEP_HEALTHZ_TIMEOUT_S
 
     def healthz(self, deep: bool = False,
                 deep_timeout_s: Optional[float] = None) -> Dict[str, Any]:
@@ -986,7 +1008,7 @@ class Fleet:
         ok = any(w["alive"] and w["circuit"] != OPEN
                  for w in st["workers"])
         if deep:
-            budget = (self.DEEP_HEALTHZ_TIMEOUT_S
+            budget = (self.deep_healthz_timeout_s()
                       if deep_timeout_s is None else float(deep_timeout_s))
             targets = [(w, entry)
                        for w, entry in zip(self.workers, st["workers"])

@@ -1,0 +1,467 @@
+"""Fleetport: the multi-host control plane over the existing wire.
+
+serve/fleet.py builds its worker set in the constructor — N slots, all
+local, supervised by SIGKILL and respawn.  Fleetport inverts every one
+of those assumptions while keeping the *entire* driver stack (route,
+wait, hedge, reroute, journal, telemetry, SLOs) unchanged:
+
+- **discovery** — workers on any host dial in with a REGISTER frame
+  (serve/transport.py's sixth frame type) carrying their dial-back
+  ``host:port``, device inventory, mesh shape, and capability buckets.
+  Each admitted worker becomes a registry-backed slot appended to the
+  fleet's (index-stable, append-only) worker list, so the router's
+  rendezvous ranking and ``_note_worker_telemetry``'s ``wid == index``
+  invariant hold exactly as they do for fixed fleets.
+- **leases, not signals** — a registered worker holds a lease
+  (serve/registry.py) renewed by its TELEMETRY pushes; the supervisor
+  here is a *lease reaper*, not a process killer.  A worker that stops
+  renewing — crashed, partitioned, decommissioned — is evicted with no
+  local signal of any kind: its slot goes dead, the rendezvous walk
+  reroutes its keys to siblings (cells in flight degrade to transport
+  unknowns and reroute through the normal driver path), and its journal
+  entries drain as those cells finalize.  This is the property the
+  whole PR exists for: P-compositionality (arXiv:1504.00204) makes a
+  relocated cell verdict-identical, so losing a host changes *where*
+  checking happens and nothing else.
+- **authenticated frames** — with ``JEPSEN_TPU_FLEET_TOKEN`` set, every
+  frame in either direction carries an HMAC envelope (serve/auth.py):
+  constant-time verify, typed ERROR (``error-class: AuthError``) +
+  hangup on failure, and the token itself never appears in any log,
+  trace, telemetry payload, or metrics artifact — export surfaces carry
+  at most ``auth-enabled: true``.
+- **mesh-aware placement** — each record advertises a device-mesh shape;
+  :meth:`FleetportWorker.fits` admits a cell only when the worker's
+  lane capacity covers the cell's bucketed demand, and the router's
+  ranked walk filters on it (falling back to the unfiltered ranking
+  when nobody fits — placement is an optimization, never an
+  availability loss).  CPU CI workers advertise the degenerate 1-mesh
+  and take everything today's tests route.
+
+Lock order (lint/lock_order.py): the slot-create/evict lock here is
+``fleet-supervisor`` (``self._sup_lock``), above the registry's own
+lock (``fleet-registry``), above the per-slot restart lock
+(``fleet-slot``).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.clock import mono_now
+from jepsen_tpu.obs.telemetry import set_gauge
+from jepsen_tpu.serve.auth import fleet_token, sign_frame, verify_frame
+from jepsen_tpu.serve.fleet import Fleet, FleetWorker
+from jepsen_tpu.serve.registry import FleetRegistry, WorkerRecord
+from jepsen_tpu.serve.transport import (F_ERROR, F_REGISTER, F_REPLY,
+                                        F_TELEMETRY, FrameError,
+                                        MAX_FRAME_BYTES, ProcWorkerService,
+                                        encode_frame, read_frame)
+
+log = logging.getLogger("jepsen.serve.fleetport")
+
+
+def cell_lane_demand(cell) -> int:
+    """The lane capacity a cell's bucket asks of its worker.  Buckets
+    are ``(kind, engine-identity, *shape)`` (serve/decompose.py): for
+    elle the shape is the lane-group size (``elle_n_bucket`` — a
+    512-lane group demands a 512-lane worker); for wgl it is
+    ``(events, width)`` and the width bucket bounds the per-dispatch
+    lane fan-out.  Anything unbucketed demands 1 — an unknown shape
+    must not be unroutable."""
+    b = getattr(cell, "bucket", ()) or ()
+    if len(b) < 3:
+        return 1
+    try:
+        if b[0] == "elle":
+            return max(1, int(b[2]))
+        return max(1, int(b[-1]))
+    except (TypeError, ValueError):
+        return 1
+
+
+class RemoteWorkerLauncher:
+    """The launcher facade for a worker the fleet did NOT spawn.  The
+    usual launcher contract (``await_ready``/``alive``/``kill``/
+    ``terminate``/``status``) backed by the registry instead of a child
+    process: liveness IS lease liveness for this generation, and kill /
+    terminate are deliberate no-ops — eviction is lease-expiry-first,
+    and this process holds no signal authority over a worker on another
+    machine anyway."""
+
+    def __init__(self, record: WorkerRecord, registry: FleetRegistry):
+        self.record = record
+        self.name = record.name
+        self._registry = registry
+
+    @property
+    def host(self) -> str:
+        return self.record.host
+
+    @property
+    def port(self) -> int:
+        return self.record.port
+
+    def await_ready(self) -> int:
+        # a registered worker was listening when it dialed in; its
+        # advertised port is the readiness handshake
+        return self.record.port
+
+    def alive(self) -> bool:
+        return self._registry.is_live(self.name,
+                                      generation=self.record.generation)
+
+    def retarget(self, record: WorkerRecord) -> None:
+        """Adopt a re-registration: new address, new generation.  The
+        slot's ProcWorkerService re-reads host/port on every dial
+        (serve/transport.py ``_wire``), so no client surgery is needed
+        beyond the record swap."""
+        self.record = record
+
+    def kill(self) -> None:
+        """No local signal — the lease reaper already owns eviction."""
+
+    def terminate(self, timeout_s: float = 10.0) -> None:
+        """No local signal; a remote worker outlives this fleet."""
+
+    def status(self) -> Dict[str, Any]:
+        return {"kind": "remote", "name": self.name,
+                "host": self.record.host, "port": self.record.port,
+                "pid": self.record.pid,
+                "generation": self.record.generation,
+                "alive": self.alive()}
+
+
+class FleetportWorker(FleetWorker):
+    """A registry-backed worker slot: a FleetWorker whose service is a
+    wire facade over a :class:`RemoteWorkerLauncher` and whose placement
+    predicate is the record's advertised mesh capacity."""
+
+    def __init__(self, wid: int, make_service,
+                 launcher: RemoteWorkerLauncher,
+                 fail_threshold: int = 3, open_s: float = 1.0):
+        self.launcher = launcher
+        super().__init__(wid, make_service, devices=[],
+                         fail_threshold=fail_threshold, open_s=open_s)
+
+    def fits(self, cell) -> bool:
+        return self.launcher.record.fits_lanes(cell_lane_demand(cell))
+
+    def status(self) -> Dict[str, Any]:
+        st = super().status()
+        rec = self.launcher.record
+        st["remote"] = {"name": rec.name, "host": rec.host,
+                        "port": rec.port,
+                        "mesh": "x".join(str(d) for d in rec.mesh),
+                        "max-lanes": rec.max_lanes,
+                        "generation": rec.generation,
+                        "lease-remaining-s":
+                            round(rec.lease_remaining_s(), 3),
+                        "evicted": rec.evicted}
+        return st
+
+
+class Fleetport(Fleet):
+    """The registry-backed fleet: zero constructor slots, membership by
+    REGISTER frame, supervision by lease reaper.  The whole Fleet
+    surface (submit/check/metrics/healthz/close) works unchanged; the
+    worker list simply starts empty and grows as workers dial in."""
+
+    def __init__(self, *, listen_host: str = "127.0.0.1",
+                 listen_port: int = 0,
+                 lease_s: Optional[float] = None,
+                 reap_s: Optional[float] = None,
+                 token: Optional[str] = None,
+                 **kw):
+        self.registry = FleetRegistry(lease_s)
+        # the shared secret: held for mac computation only, NEVER logged
+        # or exported (snapshots carry the auth-enabled boolean)
+        self._token = token if token is not None else fleet_token()
+        self._slots: Dict[str, FleetportWorker] = {}  # by worker name
+        self._sup_lock = threading.Lock()   # slot create/rejoin/evict
+        self._fp_stop = threading.Event()
+        self.auth_rejections = 0
+        self._reap_s = (float(reap_s) if reap_s
+                        else max(min(self.registry.lease_s / 4.0, 1.0),
+                                 0.05))
+        kw.setdefault("pin_devices", False)
+        super().__init__(workers=1, **kw)   # n floor only; slots are
+        # registry-backed — _make_workers below returns the empty,
+        # append-only list every later join extends in place
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((listen_host, listen_port))
+        self._srv.listen(64)
+        self.listen_host = listen_host
+        self.listen_port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="fleetport-accept").start()
+        self._reap_thread = threading.Thread(
+            target=self._reap_loop, daemon=True, name="fleetport-reaper")
+        self._reap_thread.start()
+
+    def _make_workers(self, n, lanes_each, device_sets, **kw):
+        return []
+
+    # -- the wire ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, peer = self._srv.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            threading.Thread(target=self._serve_conn,
+                             args=(sock, f"{peer[0]}:{peer[1]}"),
+                             daemon=True, name="fleetport-conn").start()
+
+    def _send(self, sock: socket.socket, frame: Dict[str, Any]) -> None:
+        try:
+            sock.sendall(encode_frame(sign_frame(frame, self._token),
+                                      MAX_FRAME_BYTES))
+        except OSError:
+            pass  # the peer is gone; its next dial starts over
+
+    def _serve_conn(self, sock: socket.socket, peer: str) -> None:
+        try:
+            while not self._fp_stop.is_set():
+                frame = read_frame(sock, MAX_FRAME_BYTES)
+                if frame is None:
+                    return  # clean close
+                if not verify_frame(frame, self._token):
+                    # fail closed: typed ERROR, then hangup.  Count it —
+                    # the smoke asserts rejected workers never reach the
+                    # registry — and log the failure MODE only, never
+                    # any token or mac material.
+                    self.auth_rejections += 1
+                    self.metrics.inc("auth-rejections")
+                    what = ("unauthenticated frame"
+                            if not isinstance(frame.get("auth"), str)
+                            else "bad frame mac")
+                    log.warning("rejected %s from %s", what, peer)
+                    self._send(sock, {"type": F_ERROR,
+                                      "id": frame.get("id"),
+                                      "error": f"{what} rejected",
+                                      "error-class": "AuthError"})
+                    return
+                ftype = frame.get("type")
+                if ftype == F_REGISTER:
+                    payload = self._handle_register(frame, peer)
+                    if payload is None:
+                        # the name is chaos-blocked (a simulated
+                        # partition): refuse + hangup.  The worker sees
+                        # a TransportError and keeps backoff-retrying;
+                        # the heal's unblock lets the next try in.
+                        self._send(sock, {"type": F_ERROR,
+                                          "id": frame.get("id"),
+                                          "error": "registration blocked "
+                                                   "for this worker",
+                                          "error-class":
+                                              "RegistrationBlocked"})
+                        return
+                    self._send(sock, {"type": F_REPLY,
+                                      "id": frame.get("id"),
+                                      "payload": payload})
+                elif ftype == F_TELEMETRY:
+                    if not self._handle_renewal(frame):
+                        # renewing a name that is no member (evicted or
+                        # never registered): typed ERROR + hangup so the
+                        # worker's registration loop notices the lost
+                        # link and re-registers as a new generation
+                        self._send(sock, {"type": F_ERROR,
+                                          "id": frame.get("id"),
+                                          "error": "not a registered "
+                                                   "member; re-register",
+                                          "error-class": "NotRegistered"})
+                        return
+                    if frame.get("id") is not None:
+                        # the registration client renews via RPC so a
+                        # refusal is observable; ack the happy path
+                        self._send(sock, {"type": F_REPLY,
+                                          "id": frame.get("id"),
+                                          "payload": {"renewed": True}})
+                else:
+                    self._send(sock, {"type": F_ERROR,
+                                      "id": frame.get("id"),
+                                      "error": f"unexpected frame type "
+                                               f"{ftype!r} at fleetport",
+                                      "error-class": "FrameError"})
+        except (FrameError, OSError):
+            return  # torn frame / RST: this connection only
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- membership --------------------------------------------------------
+    def _handle_register(self, frame: Dict[str, Any],
+                         peer: str) -> Optional[Dict[str, Any]]:
+        name = str(frame.get("name") or peer)
+        host = str(frame.get("host") or peer.rsplit(":", 1)[0])
+        port = int(frame.get("port") or 0)
+        rec, created = self.registry.register(
+            name, host, port, pid=frame.get("pid"),
+            devices=frame.get("devices") or (),
+            mesh=frame.get("mesh") or (1,),
+            buckets=frame.get("buckets") or ())
+        if rec is None:
+            log.warning("refused blocked registration for %s from %s",
+                        name, peer)
+            self.metrics.inc("registrations-refused")
+            return None
+        with self._sup_lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                slot = self._admit_slot(rec)
+            else:
+                slot.launcher.retarget(rec)
+                self.registry.bind_slot(name, slot.wid)
+                if created:
+                    # comeback after eviction: fresh service (the old
+                    # wire client died with the lease), clean breaker,
+                    # fresh staleness clock
+                    slot.restart()
+                    self.telemetry.register(slot.wid)
+                    self.metrics.inc("fleet-rejoins")
+        log.info("worker %s registered from %s (wid %d, mesh %s, "
+                 "gen %d)", name, peer, slot.wid,
+                 "x".join(str(d) for d in rec.mesh), rec.generation)
+        return {"registered": True, "wid": slot.wid,
+                "lease-s": self.registry.lease_s,
+                "generation": rec.generation}
+
+    def _admit_slot(self, rec: WorkerRecord) -> FleetportWorker:
+        """Append one registry-backed slot (caller holds the sup lock).
+        Append-only: a wid is an index into ``self.workers`` forever —
+        eviction marks the slot dead, it never removes it."""
+        wid = len(self.workers)
+        launcher = RemoteWorkerLauncher(rec, self.registry)
+        slot = FleetportWorker(wid, self._make_slot_service(launcher),
+                               launcher)
+        self.workers.append(slot)
+        self._slots[rec.name] = slot
+        self.registry.bind_slot(rec.name, wid)
+        self.telemetry.register(wid)
+        self.metrics.inc("fleet-joins")
+        return slot
+
+    def _make_slot_service(self, launcher: RemoteWorkerLauncher):
+        name = launcher.name
+
+        def make():
+            svc = ProcWorkerService(launcher, None,
+                                    retry_policy=self.retry_policy,
+                                    name=name)
+            # pushes over the service wire are lease renewals too: any
+            # frame that proves the worker is alive extends the lease
+            # (unless chaos has renewals blocked)
+            svc.on_telemetry = \
+                lambda payload: self._note_named_telemetry(name, payload)
+            return svc
+        return make
+
+    # -- leases ------------------------------------------------------------
+    def _handle_renewal(self, frame: Dict[str, Any]) -> bool:
+        """A named TELEMETRY frame at the listener: the worker's
+        registration client heartbeating.  Renews the lease and lands
+        the payload in the same Watchtower store the wired pushes
+        feed.  Returns False when the name is no member (evicted or
+        unknown) — the caller hangs up so the worker re-registers.  A
+        live-but-chaos-blocked member is accepted silently (the renewal
+        itself is discarded so the fault can expire the lease)."""
+        name = frame.get("name")
+        if not name:
+            return True  # unnamed telemetry: nothing to renew
+        name = str(name)
+        rec = self.registry.get(name)
+        if rec is None or rec.evicted:
+            return False
+        self._note_named_telemetry(name, frame.get("payload") or {})
+        return True
+
+    def _note_named_telemetry(self, name: str,
+                              payload: Dict[str, Any]) -> None:
+        if self.registry.renew(name):
+            self.metrics.inc("lease-renewals")
+        rec = self.registry.get(name)
+        if rec is not None and rec.wid is not None:
+            self._note_worker_telemetry(rec.wid, payload)
+
+    def _reap_loop(self) -> None:
+        """The supervisor, reimagined: no respawn, no SIGKILL — sweep
+        the registry for spent leases and evict.  Also exports the
+        lease-age high-water gauge every sweep."""
+        while not self._fp_stop.is_set():
+            try:
+                for rec in self.registry.expire_leases():
+                    self._evict(rec)
+                set_gauge("fleet-lease-age-max-s",
+                          round(self.registry.max_lease_age_s(), 3))
+            except Exception:  # noqa: BLE001 — the reaper must outlive
+                log.exception("lease reap sweep failed")  # one bad sweep
+            self._fp_stop.wait(timeout=self._reap_s)
+
+    def _evict(self, rec: WorkerRecord) -> None:
+        """One lease eviction: the slot goes dead (wire dropped — its
+        in-flight cells degrade to transport unknowns and reroute via
+        the rendezvous ranking, draining their journal entries through
+        the normal finalize path), and the telemetry plane forgets the
+        member so the staleness sweep cannot alert on a ghost."""
+        log.warning("lease expired for worker %s (wid %s): evicting — "
+                    "no local signal; keys reroute to siblings",
+                    rec.name, rec.wid)
+        self.metrics.inc("lease-evictions")
+        with self._sup_lock:
+            slot = self._slots.get(rec.name)
+            if (slot is not None
+                    and slot.launcher.record.generation == rec.generation):
+                try:
+                    slot.service.kill()   # closes the wire client only:
+                except Exception:  # noqa: BLE001 — already dead
+                    pass           # RemoteWorkerLauncher.kill is a no-op
+        if rec.wid is not None:
+            self.telemetry.evict(rec.wid)
+            self.slo.forget(rec.wid)
+
+    # -- export ------------------------------------------------------------
+    def fleet_view(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /fleet`` membership document.  Secret-free by
+        construction: the registry snapshot carries addresses and lease
+        arithmetic; auth status is a boolean."""
+        now = mono_now() if now is None else now
+        return {"listen": {"host": self.listen_host,
+                           "port": self.listen_port},
+                "auth-enabled": bool(self._token),
+                "auth-rejections": self.auth_rejections,
+                "reap-s": self._reap_s,
+                **self.registry.snapshot(now)}
+
+    def fleet_status(self) -> Dict[str, Any]:
+        st = super().fleet_status()
+        st["registry"] = self.registry.snapshot()
+        st["auth-enabled"] = bool(self._token)
+        return st
+
+    # -- lifecycle ---------------------------------------------------------
+    def _shutdown_port(self) -> None:
+        self._fp_stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._reap_thread.is_alive():
+            self._reap_thread.join(timeout=2 * self._reap_s + 1.0)
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        self._shutdown_port()
+        return super().close(timeout=timeout)
+
+    def kill(self) -> None:
+        self._shutdown_port()
+        super().kill()

@@ -170,22 +170,36 @@ class Router:
     def __init__(self, workers: Sequence):
         self._workers = workers
 
-    def ranked(self, token: str, exclude: Iterable[int] = ()) -> List:
+    def ranked(self, token: str, exclude: Iterable[int] = (),
+               cell=None) -> List:
         """Alive, non-excluded workers, best rendezvous score first
         (circuit state NOT yet consulted — allow() claims probe slots,
-        so it runs only on the worker actually picked)."""
+        so it runs only on the worker actually picked).
+
+        With ``cell``, the walk is additionally **mesh-aware**: workers
+        whose advertised placement (``FleetWorker.fits``) cannot take
+        the cell's lane demand are filtered out — a 512-lane elle group
+        ranks only the 4×2-mesh workers.  Placement is an optimization,
+        never an availability loss: when NO eligible worker fits, the
+        unfiltered ranking is used (a too-big cell on a small worker
+        degrades to the service's own saturation/unknown handling
+        rather than being unroutable)."""
         ex = set(exclude)
-        scored = [(rendezvous_score(token, str(w.wid)), w)
-                  for w in self._workers
-                  if w.wid not in ex and w.alive()]
+        alive = [w for w in self._workers
+                 if w.wid not in ex and w.alive()]
+        if cell is not None:
+            fitting = [w for w in alive if w.fits(cell)]
+            if fitting:
+                alive = fitting
+        scored = [(rendezvous_score(token, str(w.wid)), w) for w in alive]
         scored.sort(key=lambda sw: sw[0], reverse=True)
         return [w for _, w in scored]
 
-    def pick(self, token: str, exclude: Iterable[int] = ()):
+    def pick(self, token: str, exclude: Iterable[int] = (), cell=None):
         """The worker to route ``token`` to, or None when no alive worker
         currently admits traffic.  Walks the rendezvous ranking so an
         open circuit fails over to the key's next-highest sibling."""
-        for w in self.ranked(token, exclude):
+        for w in self.ranked(token, exclude, cell=cell):
             if w.breaker.allow():
                 return w
         return None

@@ -72,6 +72,8 @@ from typing import Any, Dict, Optional, Tuple
 from jepsen_tpu.clock import mono_now
 from jepsen_tpu.control.retry import RetryPolicy
 from jepsen_tpu.history import History
+from jepsen_tpu.serve.auth import (AuthError, fleet_token, sign_frame,
+                                   verify_frame)
 from jepsen_tpu.serve.request import Cell, KIND_WGL, Request
 from jepsen_tpu.serve.service import ServiceClosed, ServiceSaturated
 
@@ -93,6 +95,9 @@ F_DRAIN = "drain"        # client -> worker: drain RPC
 F_REPLY = "reply"        # worker -> client: RPC reply payload
 F_ERROR = "error"        # worker -> client: call failed worker-side
 F_TELEMETRY = "telemetry"  # worker -> client: unsolicited metrics push
+F_REGISTER = "register"  # worker -> fleetport: join the fleet (host:port,
+#                          devices, mesh, capability buckets); REPLY
+#                          carries the assigned wid + lease duration
 
 
 class TransportError(RuntimeError):
@@ -240,7 +245,8 @@ def _raise_remote(err: Dict[str, Any], peer: str) -> None:
     ServiceSaturated/ServiceClosed it would from an in-process worker."""
     cls = {"ServiceSaturated": ServiceSaturated,
            "ServiceClosed": ServiceClosed,
-           "OversizedFrame": OversizedFrame}.get(
+           "OversizedFrame": OversizedFrame,
+           "AuthError": AuthError}.get(
                str(err.get("error-class")), TransportError)
     raise cls(f"{peer}: {err.get('error')}")
 
@@ -259,9 +265,14 @@ class WireClient:
                  name: str = "",
                  connect_timeout_s: float = 5.0,
                  ack_timeout_s: float = 10.0,
-                 max_frame: int = MAX_FRAME_BYTES):
-        self.addr = addr
+                 max_frame: int = MAX_FRAME_BYTES,
+                 token: Optional[str] = None):
+        self.addr = tuple(addr)
         self.name = name or f"{addr[0]}:{addr[1]}"
+        # frame auth: sign everything outbound, verify everything
+        # inbound, when a fleet token is configured (serve/auth.py).
+        # The token itself never appears in logs or error strings.
+        self._token = token if token is not None else fleet_token()
         # Decorrelated jitter: a healed partition must not see every
         # waiting client re-dial and re-send in lockstep.
         self.policy = policy or RetryPolicy(
@@ -290,9 +301,10 @@ class WireClient:
                 return self._sock
         # dial OUTSIDE the lock: a slow or refused connect must not
         # stall every thread touching the pending table
+        addr = self.addr  # snapshot: retarget() may swap it mid-dial
         try:
             sock = socket.create_connection(
-                self.addr, timeout=self.connect_timeout_s)
+                addr, timeout=self.connect_timeout_s)
         except OSError as e:
             raise ConnectionLost(
                 f"transport connection lost: dial {self.name} failed: "
@@ -323,6 +335,13 @@ class WireClient:
                 if frame is None:
                     raise ConnectionLost(
                         f"peer {self.name} closed the stream")
+                if not verify_frame(frame, self._token):
+                    # an unauthenticated frame poisons the stream the
+                    # same way a torn one does: drop the connection,
+                    # fail over the pending calls (reroute), re-dial
+                    raise ConnectionLost(
+                        f"transport connection lost: unauthenticated "
+                        f"frame from {self.name}")
                 self._on_frame(frame)
         except (TransportError, OSError) as e:
             self._conn_lost(sock, e)
@@ -471,9 +490,33 @@ class WireClient:
             with self._lock:
                 self._pending.pop(fid, None)
 
+    def push(self, frame: Dict[str, Any]) -> None:
+        """Send one unsolicited frame (no id, no reply expected) — the
+        worker-side registration client uses this for its TELEMETRY
+        lease renewals.  Raises :class:`ConnectionLost` when the wire is
+        down; the caller owns the re-register/backoff loop."""
+        self._send(frame)
+
+    def retarget(self, addr: Tuple[str, int]) -> None:
+        """Point future dials at a new (host, port) — a worker that
+        respawned on a different address (non-loopback hosts do not get
+        the same ephemeral port back).  The live connection, if any, is
+        dropped so the very next call dials the new address; its pending
+        calls fail over exactly as on a connection loss (acked submits
+        degrade to transport-unknown → reroute, unacked ones re-send)."""
+        with self._lock:
+            if tuple(addr) == self.addr:
+                return
+            self.addr = tuple(addr)
+            sock = self._sock
+        if sock is not None:
+            self._conn_lost(sock, ConnectionLost(
+                f"retargeted to {addr[0]}:{addr[1]}"))
+
     def _send(self, frame: Dict[str, Any]) -> None:
         sock = self._ensure_conn()
-        data = encode_frame(frame, self.max_frame)
+        data = encode_frame(sign_frame(frame, self._token),
+                            self.max_frame)
         with self._send_lock:
             try:
                 sock.sendall(data)
@@ -545,22 +588,31 @@ class ProcWorkerService:
     def _wire(self) -> WireClient:
         """The (lazily-dialed) client, created once the launcher reports
         ready; when a proxy link exists it is retargeted at the worker's
-        real port and the client dials the PROXY — every byte crosses
-        the chaos-controllable wire."""
+        real (host, port) and the client dials the PROXY — every byte
+        crosses the chaos-controllable wire.  The worker's address comes
+        from the launcher (``host`` attribute + ``await_ready`` port),
+        never a hardcoded loopback: a worker on another machine — or one
+        that respawned onto a new ephemeral port — is dialed where it
+        actually listens, and an existing client follows the move via
+        ``retarget``."""
         with self._ready_lock:
             if self._closed:
                 raise ServiceClosed(f"{self.name} is closed")
+            port = self.launcher.await_ready()
+            host = getattr(self.launcher, "host", None) or "127.0.0.1"
+            addr = (host, port)
+            if self.proxy is not None:
+                self.proxy.retarget(addr)
+                # the proxy listens locally; ITS address is the dial
+                addr = ("127.0.0.1", self.proxy.port)
             if self._client is None:
-                port = self.launcher.await_ready()
-                addr = ("127.0.0.1", port)
-                if self.proxy is not None:
-                    self.proxy.retarget(addr)
-                    addr = ("127.0.0.1", self.proxy.port)
                 self._client = WireClient(
                     addr, policy=self._policy, name=self.name,
                     ack_timeout_s=self._ack_timeout_s,
                     max_frame=self._max_frame)
                 self._client.on_telemetry = self._dispatch_telemetry
+            elif self._client.addr != addr:
+                self._client.retarget(addr)
             return self._client
 
     # -- the CheckService surface -----------------------------------------

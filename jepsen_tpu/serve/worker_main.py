@@ -53,11 +53,16 @@ from jepsen_tpu.serve.request import Request
 from jepsen_tpu.serve.service import (CheckService, ServiceClosed,
                                       ServiceSaturated)
 from jepsen_tpu.obs.telemetry import telemetry_interval_s
+from jepsen_tpu.serve.auth import (AuthError, fleet_token, sign_frame,
+                                   verify_frame)
+from jepsen_tpu.serve.registry import parse_mesh
 from jepsen_tpu.serve.transport import (F_ACK, F_DRAIN, F_ERROR, F_HEALTHZ,
-                                        F_REPLY, F_RESULT, F_STATUS,
-                                        F_SUBMIT, F_TELEMETRY, FrameError,
-                                        MAX_FRAME_BYTES, OversizedFrame,
-                                        encode_frame, read_frame)
+                                        F_REGISTER, F_REPLY, F_RESULT,
+                                        F_STATUS, F_SUBMIT, F_TELEMETRY,
+                                        FrameError, MAX_FRAME_BYTES,
+                                        OversizedFrame, TransportError,
+                                        WireClient, encode_frame,
+                                        read_frame)
 
 log = logging.getLogger("jepsen.serve.worker")
 
@@ -72,13 +77,15 @@ class _Conn:
     lock so concurrent RESULT pushes and RPC replies interleave at frame
     boundaries, never mid-frame."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket,
+                 token: Optional[str] = None):
         self.sock = sock
+        self.token = token  # outbound frames are signed when set
         self._send_lock = threading.Lock()
         self.open = True
 
     def send(self, frame: Dict[str, Any], max_frame: int) -> bool:
-        data = encode_frame(frame, max_frame)
+        data = encode_frame(sign_frame(frame, self.token), max_frame)
         with self._send_lock:
             if not self.open:
                 return False
@@ -103,9 +110,15 @@ class WorkerServer:
 
     def __init__(self, service: CheckService, host: str = "127.0.0.1",
                  port: int = 0, max_frame: int = MAX_FRAME_BYTES,
-                 telemetry_s: Optional[float] = None):
+                 telemetry_s: Optional[float] = None,
+                 token: Optional[str] = None):
         self.service = service
         self.max_frame = max_frame
+        # frame auth (serve/auth.py): with a configured fleet token,
+        # every inbound frame must verify or the connection is answered
+        # with a typed ERROR and hung up.  The token is held, used for
+        # mac computation, and NEVER logged or exported.
+        self._token = token if token is not None else fleet_token()
         self._lock = threading.Lock()  # inflight/done/conn tables
         self._inflight: Dict[str, Request] = {}
         self._done: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
@@ -183,7 +196,7 @@ class WorkerServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            conn = _Conn(sock)
+            conn = _Conn(sock, token=self._token)
             with self._lock:
                 if self._closed:
                     conn.close()
@@ -213,6 +226,18 @@ class WorkerServer:
                     return
                 if frame is None:
                     return  # clean close
+                if not verify_frame(frame, self._token):
+                    # auth fail-closed: typed ERROR, then hangup.  The
+                    # message names the failure mode only — never the
+                    # token or the mac (serve/auth.py discipline).
+                    what = ("unauthenticated frame"
+                            if not isinstance(frame.get("auth"), str)
+                            else "bad frame mac")
+                    conn.send({"type": F_ERROR, "id": frame.get("id"),
+                               "error": f"{what} rejected",
+                               "error-class": "AuthError"},
+                              self.max_frame)
+                    return
                 self._dispatch(conn, frame)
         finally:
             conn.close()
@@ -420,6 +445,12 @@ class SubprocessWorker:
         self.log_path = log_path
         self.ready_timeout_s = ready_timeout_s
         self.port: Optional[int] = None
+        # where a client dials this worker back.  A wildcard bind
+        # (0.0.0.0/::) is not dialable; local supervision reaches it on
+        # loopback, remote fleets advertise a real host via REGISTER.
+        bind = (args or {}).get("host")
+        self.host = ("127.0.0.1" if bind in (None, "", "0.0.0.0", "::")
+                     else str(bind))
         argv = [sys.executable, "-m", "jepsen_tpu.serve.worker_main"]
         for k, v in (args or {}).items():
             if v is None:
@@ -523,6 +554,7 @@ class ThreadWorker:
                  max_frame: int = MAX_FRAME_BYTES,
                  telemetry_s: Optional[float] = None):
         self.name = name
+        self.host = "127.0.0.1"  # in-process: always loopback-dialable
         self.service = make_service()
         self.server = WorkerServer(self.service, max_frame=max_frame,
                                    telemetry_s=telemetry_s)
@@ -549,6 +581,121 @@ class ThreadWorker:
 
 
 # ---------------------------------------------------------------------------
+# fleet registration (the worker side of serve/fleetport.py)
+# ---------------------------------------------------------------------------
+
+
+class FleetRegistration:
+    """Register this worker with a fleetport and keep its lease alive.
+
+    The worker dials the fleet (not the other way around) exactly once
+    per incarnation: a REGISTER frame carries its dial-back address,
+    device inventory, mesh shape, and capability buckets; the REPLY
+    brings back the slot id and the lease duration.  From then on the
+    renewal loop pushes *named* TELEMETRY frames at a third of the lease
+    — the same frames Watchtower already aggregates double as
+    heartbeats, so there is no separate keepalive protocol to keep
+    honest.
+
+    Failure discipline mirrors the verdict discipline: a transport cut
+    degrades (re-register with backoff — the fleet treats a comeback
+    after eviction as a new generation), but an :class:`AuthError` is
+    **permanent** — a worker holding the wrong token must not hammer
+    the control plane with frames it can never authenticate."""
+
+    def __init__(self, server: WorkerServer, *,
+                 fleet_addr, name: str,
+                 advertise_host: str, port: Optional[int] = None,
+                 mesh: Any = (1,), devices=(), buckets=(),
+                 token: Optional[str] = None):
+        self.server = server
+        self.name = name
+        self.host = advertise_host
+        self.port = int(port if port is not None else server.port)
+        self.mesh = parse_mesh(mesh)
+        self.devices = tuple(devices)
+        self.buckets = tuple(buckets)
+        self.wid: Optional[int] = None
+        self.lease_s: float = 10.0
+        self.registrations = 0
+        self.rejected = False  # permanent auth rejection
+        self.registered = threading.Event()
+        self._stop = threading.Event()
+        self._client = WireClient(tuple(fleet_addr),
+                                  name=f"fleet@{fleet_addr[0]}",
+                                  token=token)
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FleetRegistration":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fleet-reg-{self.name}")
+        self._thread.start()
+        return self
+
+    def wait_registered(self, timeout: Optional[float] = None) -> bool:
+        return self.registered.wait(timeout=timeout)
+
+    def _register(self) -> None:
+        reply = self._client.call(
+            F_REGISTER,
+            {"name": self.name, "host": self.host, "port": self.port,
+             "pid": os.getpid(), "devices": list(self.devices),
+             "mesh": "x".join(str(d) for d in self.mesh),
+             "buckets": list(self.buckets)},
+            timeout_s=10.0) or {}
+        self.wid = reply.get("wid")
+        lease = reply.get("lease-s")
+        if lease:
+            self.lease_s = float(lease)
+        self.registrations += 1
+        self.registered.set()
+
+    def _loop(self) -> None:
+        backoff = 0.2
+        joined = False
+        while not self._stop.is_set():
+            try:
+                if not joined:
+                    self._register()
+                    joined = True
+                    backoff = 0.2
+                # the renewal IS a telemetry frame — sent as an RPC so a
+                # refusal is observable: the fleetport replies REPLY to a
+                # member, and a typed ERROR ("NotRegistered") to an
+                # evicted name, which lands here as a TransportError and
+                # drives the re-register below
+                self._client.call(
+                    F_TELEMETRY,
+                    {"name": self.name,
+                     "payload": self.server.telemetry_payload()},
+                    timeout_s=max(self.lease_s / 2.0, 1.0))
+            except AuthError:
+                # wrong/missing token: permanent — stop, never retry.
+                # The log line names the condition, never the token.
+                log.error("fleet registration rejected: auth failure")
+                self.rejected = True
+                return
+            except (TransportError, OSError) as e:
+                # cut link / refused dial / torn frame: transient —
+                # re-register next round (the fleet sees a comeback as
+                # a new generation if the lease lapsed meanwhile)
+                log.warning("fleet link lost (%s); re-registering",
+                            type(e).__name__)
+                joined = False
+                self._stop.wait(timeout=backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            self._stop.wait(timeout=max(self.lease_s / 3.0, 0.05))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._client.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
 # entrypoint
 # ---------------------------------------------------------------------------
 
@@ -568,6 +715,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--telemetry-s", type=float, default=None,
                     help="TELEMETRY push cadence in seconds (default: "
                          "JEPSEN_TPU_TELEMETRY_S or 1.0; <= 0 disables)")
+    ap.add_argument("--name", default=None,
+                    help="worker name to register under (default: "
+                         "worker-<pid>)")
+    ap.add_argument("--fleet-addr", default=None, metavar="HOST:PORT",
+                    help="register with the fleetport at this address "
+                         "and hold a lease there")
+    ap.add_argument("--advertise-host", default=None,
+                    help="dial-back host to advertise in REGISTER "
+                         "(required sense when binding 0.0.0.0; "
+                         "default: --host, or 127.0.0.1 on a wildcard "
+                         "bind)")
+    ap.add_argument("--mesh", default="1",
+                    help="device-mesh shape to advertise, e.g. 4x2 "
+                         "(default: the degenerate 1-mesh)")
     args = ap.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO, stream=sys.stderr,
@@ -583,6 +744,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = WorkerServer(service, host=args.host, port=args.port,
                           max_frame=args.max_frame,
                           telemetry_s=args.telemetry_s)
+    registration: Optional[FleetRegistration] = None
+    if args.fleet_addr:
+        fhost, _, fport = args.fleet_addr.rpartition(":")
+        adv = args.advertise_host or (
+            "127.0.0.1" if args.host in ("0.0.0.0", "::") else args.host)
+        registration = FleetRegistration(
+            server, fleet_addr=(fhost or "127.0.0.1", int(fport)),
+            name=args.name or f"worker-{os.getpid()}",
+            advertise_host=adv, mesh=args.mesh,
+            buckets=("wgl", "elle")).start()
     stop = threading.Event()
 
     def _on_signal(signum, frame):  # noqa: ARG001 — signal signature
@@ -596,6 +767,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the wait is the whole main thread's job; everything else runs
         # on the accept/conn/waiter threads
         stop.wait(timeout=1.0)
+    if registration is not None:
+        registration.stop()
     server.close()
     service.close(timeout=30.0)
     return 0

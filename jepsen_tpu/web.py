@@ -145,6 +145,18 @@ def make_handler(base: str, service=None):
                 return self._send(
                     200, render_prom(snap).encode(),
                     "text/plain; version=0.0.4; charset=utf-8")
+            if path == "/fleet":
+                # Fleetport membership (serve/fleetport.py): who is
+                # registered, from where, with what mesh, and how much
+                # lease each holds.  Secret-free by construction — the
+                # document carries an auth-enabled boolean, never any
+                # token material.  Fixed fleets (no registry) answer a
+                # null membership, not a 404, for uniform polling.
+                view = getattr(service, "fleet_view", None)
+                if view is not None:
+                    return self._send_json(200, view())
+                return self._send_json(200, {"registry": None,
+                                             "workers": []})
             if path == "/alerts":
                 # SLO alert ring (obs/slo.py).  Degenerate services with
                 # no SLO engine answer an empty document, not a 404 — a

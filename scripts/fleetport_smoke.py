@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Fleetport smoke: the multi-host control plane end to end.
+
+Three REAL worker processes (``python -m jepsen_tpu.serve.worker_main``)
+register with a Fleetport over real sockets with frame auth ON, then a
+mixed wgl+elle campaign (a third corrupted) runs while the nemesis
+force-expires one worker's lease.  The eviction must be lease-first —
+no local signal of any kind: the victim process stays alive, its slot
+goes dead, its keys reroute via the rendezvous ranking, and its journal
+entries drain through the normal finalize path.  Mid-campaign a fourth
+worker registers and must take cells.  Asserts, lane for lane, that
+fleet verdicts equal a cold single-service oracle's (zero fabricated
+``false``), that the journal drained, that the healed victim
+re-registers itself as a new generation, that a wrong-token worker is
+rejected (typed AuthError at the port) and never appears in ``GET
+/fleet``, and that the fleet token appears in NO artifact this smoke
+can reach: fleet view, fleet status, metrics, telemetry, healthz, the
+HTTP ``/fleet`` document, worker logs, or the report file itself.
+
+Writes the report to argv[1] (default /tmp/fleetport_smoke.json) — CI
+uploads it as an artifact.
+"""
+
+import json
+import os
+import secrets
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TOKEN = secrets.token_hex(16)
+os.environ["JEPSEN_TPU_FLEET_TOKEN"] = TOKEN
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.serve import CheckService  # noqa: E402
+from jepsen_tpu.serve.chaos import ChaosNemesis  # noqa: E402
+from jepsen_tpu.serve.fleetport import Fleetport  # noqa: E402
+from jepsen_tpu.synth import (  # noqa: E402
+    cas_register_history, corrupt_list_append, corrupt_reads,
+    list_append_history,
+)
+
+N_WGL, N_ELLE, CLIENTS = 24, 8, 4
+DEADLINE_S = 60.0
+LEASE_S = 1.5
+
+
+def build_workload():
+    jobs = []
+    for s in range(N_WGL):
+        h = cas_register_history(60, concurrency=4, seed=s)
+        if s % 3 == 2:
+            h = corrupt_reads(h, n=1, seed=s)
+        jobs.append(("wgl", h))
+    for s in range(N_ELLE):
+        h = list_append_history(25, seed=1000 + s)
+        if s % 3 == 2:
+            h = corrupt_list_append(h, anomaly_p=0.5, seed=s)
+        jobs.append(("elle", h))
+    return jobs
+
+
+def submit_kw(kind):
+    return ({"model": "cas-register"} if kind == "wgl"
+            else {"workload": "list-append"})
+
+
+def spawn_worker(name, fleet_port, logf, token=None):
+    """One real worker process, registering itself at the fleetport.
+    Returns the Popen; the ready line on stdout carries its port."""
+    env = dict(os.environ)
+    if token is not None:
+        env["JEPSEN_TPU_FLEET_TOKEN"] = token
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.serve.worker_main",
+         "--name", name, "--port", "0", "--max-lanes", "48",
+         "--telemetry-s", "0.25", "--mesh", "1",
+         "--fleet-addr", f"127.0.0.1:{fleet_port}"],
+        stdout=subprocess.PIPE, stderr=logf, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    line = proc.stdout.readline().decode()
+    ready = json.loads(line)
+    assert ready.get("ready"), f"worker {name} never came up: {line!r}"
+    return proc
+
+
+def wait_live(fp, name, timeout=20.0, live=True):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fp.registry.is_live(name) == live:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_campaign(fp, jobs):
+    out = [None] * len(jobs)
+
+    def client(span):
+        reqs = []
+        for i in span:
+            kind, h = jobs[i]
+            reqs.append((i, fp.submit(h, kind=kind,
+                                      deadline_s=DEADLINE_S,
+                                      **submit_kw(kind))))
+        for i, r in reqs:
+            res = r.wait(timeout=180)
+            out[i] = (res["valid"], (res.get("fleet") or {}).get("worker"))
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    return threads, out
+
+
+def main():
+    dump = (sys.argv[1] if len(sys.argv) > 1
+            else "/tmp/fleetport_smoke.json")
+    jobs = build_workload()
+    tmp = tempfile.mkdtemp(prefix="fleetport-smoke-")
+    logs = {}
+
+    oracle_svc = CheckService(max_lanes=48, capacity=64)
+    oracle = [oracle_svc.check(h, kind=kind, **submit_kw(kind))["valid"]
+              for kind, h in jobs]
+    oracle_svc.close(timeout=30.0)
+    assert oracle.count(False) > 0, "corrupted histories must refute"
+
+    fp = Fleetport(listen_host="127.0.0.1", lease_s=LEASE_S,
+                   journal_dir=os.path.join(tmp, "journal"),
+                   max_lanes=48, default_deadline_s=DEADLINE_S,
+                   telemetry_s=0.25)
+    procs = {}
+
+    def spawn(name, token=None):
+        logs[name] = open(os.path.join(tmp, f"{name}.log"), "wb")
+        procs[name] = spawn_worker(name, fp.listen_port, logs[name],
+                                   token=token)
+
+    try:
+        for i in range(3):
+            spawn(f"w{i}")
+        for i in range(3):
+            assert wait_live(fp, f"w{i}"), f"w{i} never registered"
+
+        # warm pass: each worker process compiles its own engines
+        warm, _ = run_campaign(fp, jobs[:2] + jobs[-2:])
+        for t in warm:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in warm), "warm pass hung"
+
+        threads, out = run_campaign(fp, jobs)
+        time.sleep(0.3)                   # let the campaign start flowing
+        chaos = ChaosNemesis(fp)
+        t_fault = time.monotonic()
+        key = chaos.expire_lease("w0")    # lease-expiry-first eviction
+        spawn("w3")                       # mid-campaign join
+        assert wait_live(fp, "w0", live=False), "w0 never evicted"
+        assert wait_live(fp, "w3"), "mid-campaign joiner never admitted"
+        # no local signal: the victim PROCESS is untouched by eviction
+        assert procs["w0"].poll() is None, (
+            "evicted worker's process died — eviction must be "
+            "lease-only, never a local signal")
+
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "clients hung"
+        recovery_s = time.monotonic() - t_fault
+
+        # the joiner took cells: enough keys rendezvous onto 3 live
+        # workers that wid 3 must appear in the verdict attributions
+        verdicts = [v for v, _ in out]
+        wids = {w for _, w in out if w is not None}
+        w3_wid = fp.registry.get("w3").wid
+        assert w3_wid in wids, (
+            f"mid-campaign joiner (wid {w3_wid}) took no cells: {wids}")
+
+        mismatches = [
+            {"lane": i, "oracle": o, "fleet": f}
+            for i, (o, f) in enumerate(zip(oracle, verdicts)) if o != f]
+        fabricated = [m for m in mismatches
+                      if m["fleet"] is False and m["oracle"] is not False]
+        assert not fabricated, f"fabricated false verdicts: {fabricated}"
+        assert not mismatches, f"verdict parity broken: {mismatches}"
+        assert recovery_s < DEADLINE_S, (
+            f"recovery took {recovery_s:.1f}s — past one deadline budget")
+        journal_pending = fp._journal.pending_count()
+        assert journal_pending == 0, (
+            f"{journal_pending} cells still journaled after drain")
+
+        # heal → the evicted worker's own registration loop re-registers
+        # it as a new generation (comeback, not resurrection)
+        chaos.heal(key)
+        assert wait_live(fp, "w0"), "w0 never re-registered after heal"
+        gen = fp.registry.get("w0").generation
+        assert gen >= 1, f"comeback must bump the generation, got {gen}"
+
+        # wrong-token worker: rejected at the port, never a member
+        rejections_before = fp.auth_rejections
+        spawn("intruder", token="not-the-fleet-token")
+        time.sleep(3.0)
+        assert "intruder" not in fp.registry.names(), (
+            "a wrong-token worker reached the registry")
+        assert fp.auth_rejections > rejections_before, (
+            "the wrong-token worker was never counted as rejected")
+
+        # the HTTP /fleet document agrees, and carries no secret
+        from jepsen_tpu.web import serve
+        httpd = serve(base=os.path.join(tmp, "store"), port=0,
+                      block=False, service=fp)
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        try:
+            http_fleet = urllib.request.urlopen(
+                "http://127.0.0.1:%d/fleet"
+                % httpd.server_address[1]).read().decode()
+        finally:
+            httpd.shutdown()
+        doc = json.loads(http_fleet)
+        assert doc["auth-enabled"] is True
+        names = {w["name"] for w in doc["workers"]}
+        assert "intruder" not in names and {"w0", "w1", "w2",
+                                            "w3"} <= names
+
+        snap = fp.metrics.snapshot()
+        report = {
+            "oracle": oracle, "fleet": verdicts,
+            "worker_attribution": sorted(wids),
+            "recovery_s": round(recovery_s, 3),
+            "journal_pending_at_end": journal_pending,
+            "comeback_generation": gen,
+            "auth_rejections": fp.auth_rejections,
+            "http_fleet": doc,
+            "fleet_status": fp.fleet_status(),
+            "healthz": fp.healthz(deep=True),
+            "telemetry": fp.telemetry.snapshot(),
+            "metrics": snap,
+        }
+    finally:
+        for name, proc in procs.items():
+            proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        fp.close(timeout=60.0)
+        for f in logs.values():
+            f.close()
+
+    # token-leak scan: the secret must appear in NO artifact — exports,
+    # logs, HTTP documents, or this report itself
+    leaks = []
+    rendered = json.dumps(report, default=str)
+    if TOKEN in rendered:
+        leaks.append("report")
+    if TOKEN in http_fleet:
+        leaks.append("GET /fleet")
+    for name in logs:
+        with open(os.path.join(tmp, f"{name}.log"), "rb") as f:
+            if TOKEN.encode() in f.read():
+                leaks.append(f"{name}.log")
+    assert not leaks, f"fleet token leaked into: {leaks}"
+    report["token_leak_scan"] = {"artifacts_scanned": 2 + len(logs),
+                                 "leaks": leaks}
+
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps({
+        "recovery_s": report["recovery_s"],
+        "mismatches": 0,
+        "fabricated_false": 0,
+        "evictions": snap["counters"].get("lease-evictions", 0),
+        "joins": snap["counters"].get("fleet-joins", 0),
+        "rejoins": snap["counters"].get("fleet-rejoins", 0),
+        "auth_rejections": report["auth_rejections"],
+        "comeback_generation": gen,
+    }))
+    print(f"fleetport smoke OK: lease-expiry eviction with no local "
+          f"signal, parity held lane for lane, journal drained, "
+          f"mid-campaign join took cells, wrong-token worker rejected, "
+          f"token in no artifact; report at {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

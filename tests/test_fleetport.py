@@ -1,0 +1,474 @@
+"""Fleetport: the multi-host control plane (serve/fleetport.py), its
+membership registry (serve/registry.py), and the HMAC frame-auth
+envelope (serve/auth.py).
+
+The auth and registry tests are pure (explicit ``now``, no sockets, no
+sleeps).  The control-plane tests run a real Fleetport listener with
+in-process ThreadWorkers registering over genuine sockets — frames on
+the wire carry real macs — at sub-second leases so eviction, comeback,
+and chaos-block semantics are exercised in a few hundred milliseconds.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from jepsen_tpu.serve.auth import (
+    AuthError, canonical_frame_bytes, fleet_token, frame_mac, require_frame,
+    sign_frame, verify_frame,
+)
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.fleet import Fleet
+from jepsen_tpu.serve.fleetport import (
+    Fleetport, FleetportWorker, RemoteWorkerLauncher, cell_lane_demand,
+)
+from jepsen_tpu.serve.registry import (
+    FleetRegistry, WorkerRecord, mesh_lanes, parse_mesh,
+)
+from jepsen_tpu.serve.router import CircuitBreaker, Router
+from jepsen_tpu.serve.service import CheckService
+from jepsen_tpu.serve.worker_main import FleetRegistration, ThreadWorker
+from jepsen_tpu.synth import cas_register_history
+
+TOKEN = "unit-test-fleet-token"
+
+
+# ---------------------------------------------------------------------------
+# auth envelope
+# ---------------------------------------------------------------------------
+
+
+class TestAuth:
+    def test_sign_verify_round_trip(self):
+        frame = {"type": "register", "name": "w0", "port": 7}
+        signed = sign_frame(frame, TOKEN)
+        assert isinstance(signed["auth"], str)
+        assert verify_frame(signed, TOKEN)
+
+    def test_canonical_bytes_ignore_key_order_and_auth(self):
+        a = {"type": "submit", "id": "c1", "n": 2}
+        b = {"n": 2, "id": "c1", "type": "submit", "auth": "junk"}
+        assert canonical_frame_bytes(a) == canonical_frame_bytes(b)
+        assert frame_mac(a, TOKEN) == frame_mac(b, TOKEN)
+
+    def test_tampered_frame_fails(self):
+        signed = sign_frame({"type": "register", "port": 7}, TOKEN)
+        signed["port"] = 8
+        assert not verify_frame(signed, TOKEN)
+
+    def test_wrong_token_fails(self):
+        signed = sign_frame({"type": "register"}, TOKEN)
+        assert not verify_frame(signed, "some-other-token")
+
+    def test_missing_or_malformed_mac_fails(self):
+        assert not verify_frame({"type": "register"}, TOKEN)
+        assert not verify_frame({"type": "register", "auth": 7}, TOKEN)
+
+    def test_no_token_means_auth_off(self):
+        frame = {"type": "register"}
+        assert sign_frame(frame, None) is frame     # no copy, no mac
+        assert verify_frame({"type": "register"}, None)
+
+    def test_require_frame_raises_typed_error(self):
+        with pytest.raises(AuthError, match="unauthenticated frame"):
+            require_frame({"type": "register"}, TOKEN, peer="1.2.3.4:5")
+        bad = sign_frame({"type": "register"}, "wrong")
+        with pytest.raises(AuthError, match="bad frame mac"):
+            require_frame(bad, TOKEN, peer="1.2.3.4:5")
+
+    def test_error_text_never_carries_token_material(self):
+        bad = sign_frame({"type": "register"}, "wrong")
+        for frame in ({"type": "register"}, bad):
+            try:
+                require_frame(frame, TOKEN, peer="p")
+            except AuthError as e:
+                assert TOKEN not in str(e)
+                assert "wrong" not in str(e)
+
+    def test_env_token_read_at_call_time(self, monkeypatch):
+        monkeypatch.delenv("JEPSEN_TPU_FLEET_TOKEN", raising=False)
+        assert fleet_token() is None
+        monkeypatch.setenv("JEPSEN_TPU_FLEET_TOKEN", "  t0k3n  ")
+        assert fleet_token() == "t0k3n"
+        monkeypatch.setenv("JEPSEN_TPU_FLEET_TOKEN", "   ")
+        assert fleet_token() is None
+
+
+# ---------------------------------------------------------------------------
+# mesh vocabulary
+# ---------------------------------------------------------------------------
+
+
+class TestMesh:
+    def test_parse_mesh_forms(self):
+        assert parse_mesh("4x2") == (4, 2)
+        assert parse_mesh("4X2") == (4, 2)
+        assert parse_mesh([4, 2]) == (4, 2)
+        assert parse_mesh((8,)) == (8,)
+
+    def test_malformed_mesh_degrades_to_smallest_claim(self):
+        for bad in ("", "4xtwo", None, 3.5, [0, 2], [], "0"):
+            assert parse_mesh(bad) == (1,)
+
+    def test_mesh_lanes(self):
+        assert mesh_lanes((1,)) == 64
+        assert mesh_lanes((4, 2)) == 512
+
+    def test_cell_lane_demand_by_bucket(self):
+        elle = SimpleNamespace(bucket=("elle", "eng", 512))
+        wgl = SimpleNamespace(bucket=("wgl", "eng", 256, 64))
+        assert cell_lane_demand(elle) == 512
+        assert cell_lane_demand(wgl) == 64
+
+    def test_unbucketed_cell_demands_one_lane(self):
+        for b in ((), ("wgl", "eng"), ("wgl", "eng", "junk"), None):
+            assert cell_lane_demand(SimpleNamespace(bucket=b)) == 1
+        assert cell_lane_demand(SimpleNamespace()) == 1
+
+
+# ---------------------------------------------------------------------------
+# registry + leases (explicit now, no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRegistry:
+    def test_register_renew_expire_cycle(self):
+        reg = FleetRegistry(lease_s=10.0)
+        rec, created = reg.register("w0", "10.0.0.2", 7000, mesh="4x2",
+                                    now=100.0)
+        assert created and rec.generation == 0
+        assert rec.max_lanes == 512
+        assert reg.is_live("w0")
+        assert reg.renew("w0", now=105.0)
+        assert reg.expire_leases(now=110.0) == []    # renewed at 105
+        popped = reg.expire_leases(now=115.5)
+        assert [r.name for r in popped] == ["w0"]
+        assert popped[0].evicted and not reg.is_live("w0")
+        assert reg.evictions == 1
+        assert not reg.renew("w0", now=116.0)        # evicted: no renewal
+
+    def test_reregister_is_refresh_not_new_generation(self):
+        reg = FleetRegistry(lease_s=10.0)
+        reg.register("w0", "h", 1, now=100.0)
+        rec, created = reg.register("w0", "h2", 2, now=105.0)
+        assert not created and rec.generation == 0
+        assert rec.host == "h2" and rec.port == 2    # address updated
+
+    def test_comeback_bumps_generation(self):
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.expire_leases(now=102.0)
+        rec, created = reg.register("w0", "h", 1, now=103.0)
+        assert created and rec.generation == 1
+
+    def test_is_live_pins_the_generation(self):
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.expire_leases(now=102.0)
+        reg.register("w0", "h", 1, now=103.0)
+        # the old incarnation's launcher must read dead forever
+        assert not reg.is_live("w0", generation=0)
+        assert reg.is_live("w0", generation=1)
+
+    def test_blocked_renewals_cannot_resurrect(self):
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.block_renewals("w0")
+        assert not reg.renew("w0", now=100.5)
+        assert reg.force_expire("w0", now=100.6)
+        assert [r.name for r in reg.expire_leases(now=100.7)] == ["w0"]
+
+    def test_blocked_name_cannot_reregister_until_heal(self):
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.block_renewals("w0")
+        reg.expire_leases(now=102.0)
+        rec, created = reg.register("w0", "h", 1, now=103.0)
+        assert rec is None and not created            # partition holds
+        reg.unblock_renewals("w0")
+        rec, created = reg.register("w0", "h", 1, now=104.0)
+        assert created and rec.generation == 1
+
+    def test_block_does_not_refuse_a_live_member_refresh(self):
+        # a block only pins the lease; a live record's re-register still
+        # updates its address, but the lease must NOT extend — a refresh
+        # racing the reaper between force_expire and the sweep would
+        # otherwise resurrect the member the fault is expiring
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.block_renewals("w0")
+        reg.force_expire("w0", now=100.1)
+        rec, created = reg.register("w0", "h2", 2, now=100.2)
+        assert rec is not None and not created
+        assert rec.host == "h2"
+        assert [r.name for r in reg.expire_leases(now=100.3)] == ["w0"]
+
+    def test_lease_age_and_high_water(self):
+        reg = FleetRegistry(lease_s=10.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.register("w1", "h", 2, now=100.0)
+        reg.renew("w1", now=104.0)
+        assert reg.lease_age_s("w0", now=105.0) == pytest.approx(5.0)
+        assert reg.lease_age_s("w1", now=105.0) == pytest.approx(1.0)
+        assert reg.max_lease_age_s(now=105.0) == pytest.approx(5.0)
+        assert reg.lease_age_s("ghost") is None
+
+    def test_snapshot_shape_and_eviction_ring(self):
+        reg = FleetRegistry(lease_s=1.0)
+        reg.register("w0", "h", 1, now=100.0)
+        reg.bind_slot("w0", 0)
+        reg.expire_leases(now=102.0)
+        reg.register("w1", "h", 2, now=103.0)
+        snap = reg.snapshot(now=103.5)
+        assert snap["lease-s"] == 1.0
+        assert [w["name"] for w in snap["workers"]] == ["w1"]
+        assert snap["evictions"] == 1 and snap["registrations"] == 2
+        assert [e["name"] for e in snap["recent-evictions"]] == ["w0"]
+        assert snap["recent-evictions"][0]["wid"] == 0
+
+
+# ---------------------------------------------------------------------------
+# mesh-aware routing
+# ---------------------------------------------------------------------------
+
+
+class _MeshWorker:
+    """Router-shaped stub with a capacity-driven fits()."""
+
+    def __init__(self, wid, max_lanes=64):
+        self.wid = wid
+        self.max_lanes = max_lanes
+        self.breaker = CircuitBreaker(fail_threshold=1)
+
+    def alive(self):
+        return True
+
+    def fits(self, cell):
+        return cell_lane_demand(cell) <= self.max_lanes
+
+
+class TestMeshRouting:
+    def test_ranked_filters_to_fitting_workers(self):
+        small = [_MeshWorker(i, max_lanes=64) for i in range(3)]
+        big = _MeshWorker(3, max_lanes=512)
+        router = Router(small + [big])
+        cell = SimpleNamespace(bucket=("elle", "eng", 512))
+        for k in range(16):
+            picked = router.pick(f"elle:{k}", cell=cell)
+            assert picked.wid == 3   # only the 4x2-mesh worker fits
+
+    def test_small_cells_spread_over_everyone(self):
+        workers = [_MeshWorker(i, max_lanes=64) for i in range(3)] \
+            + [_MeshWorker(3, max_lanes=512)]
+        router = Router(workers)
+        cell = SimpleNamespace(bucket=("wgl", "eng", 256, 64))
+        wids = {router.pick(f"wgl:{k}", cell=cell).wid for k in range(64)}
+        assert len(wids) > 1          # placement filter keeps the spread
+
+    def test_nobody_fits_falls_back_to_unfiltered(self):
+        # placement is an optimization, never an availability loss
+        workers = [_MeshWorker(i, max_lanes=64) for i in range(2)]
+        router = Router(workers)
+        cell = SimpleNamespace(bucket=("elle", "eng", 512))
+        assert router.pick("elle:1", cell=cell) is not None
+
+    def test_no_cell_keeps_legacy_ranking(self):
+        workers = [_MeshWorker(i) for i in range(3)]
+        router = Router(workers)
+        assert router.pick("wgl:1") is not None
+
+    def test_base_fleet_worker_fits_everything(self):
+        fleet = Fleet(workers=1, max_lanes=8)
+        try:
+            cell = SimpleNamespace(bucket=("elle", "eng", 512))
+            assert fleet.workers[0].fits(cell)
+        finally:
+            fleet.close(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# launcher facade
+# ---------------------------------------------------------------------------
+
+
+class TestRemoteWorkerLauncher:
+    def test_liveness_is_lease_liveness_for_this_generation(self):
+        reg = FleetRegistry(lease_s=1.0)
+        rec, _ = reg.register("w0", "10.0.0.2", 7000, now=100.0)
+        launcher = RemoteWorkerLauncher(rec, reg)
+        assert launcher.alive()
+        assert launcher.await_ready() == 7000
+        reg.expire_leases(now=102.0)
+        assert not launcher.alive()
+        rec2, _ = reg.register("w0", "10.0.0.3", 7001, now=103.0)
+        assert not launcher.alive()      # old generation stays dead
+        launcher.retarget(rec2)
+        assert launcher.alive() and launcher.host == "10.0.0.3"
+
+    def test_kill_and_terminate_are_no_ops(self):
+        reg = FleetRegistry(lease_s=1.0)
+        rec, _ = reg.register("w0", "h", 1, now=100.0)
+        launcher = RemoteWorkerLauncher(rec, reg)
+        launcher.kill()
+        launcher.terminate()
+        assert reg.is_live("w0")         # no local signal authority
+
+    def test_fleetport_worker_fits_by_record_mesh(self):
+        reg = FleetRegistry(lease_s=1.0)
+        rec, _ = reg.register("w0", "h", 1, mesh="4x2", now=100.0)
+        launcher = RemoteWorkerLauncher(rec, reg)
+        w = FleetportWorker(0, lambda: None, launcher)
+        assert w.fits(SimpleNamespace(bucket=("elle", "eng", 512)))
+        assert not w.fits(SimpleNamespace(bucket=("elle", "eng", 1024)))
+
+
+# ---------------------------------------------------------------------------
+# the control plane, end to end (real sockets, in-process workers)
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(name, fleet_port, mesh="1", token=TOKEN):
+    tw = ThreadWorker(name, lambda: CheckService(max_lanes=8),
+                      telemetry_s=0.1)
+    reg = FleetRegistration(
+        tw.server, fleet_addr=("127.0.0.1", fleet_port), name=name,
+        advertise_host="127.0.0.1", port=tw.server.port, mesh=mesh,
+        token=token).start()
+    return tw, reg
+
+
+class TestFleetport:
+    @pytest.fixture()
+    def fp(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_FLEET_TOKEN", TOKEN)
+        # lease long enough that a full-suite compile/GIL stall can't
+        # starve the 3-per-lease renewal cadence and evict a healthy
+        # worker mid-test; eviction tests force-expire, they don't wait
+        port = Fleetport(listen_host="127.0.0.1", lease_s=2.5,
+                         max_lanes=8, telemetry_s=0.1,
+                         default_deadline_s=30.0)
+        spawned = []
+
+        def add(name, **kw):
+            tw, reg = _spawn_worker(name, port.listen_port, **kw)
+            spawned.append((tw, reg))
+            return tw, reg
+
+        yield port, add
+        for tw, reg in spawned:
+            reg.stop()
+            tw.terminate()
+        port.close(timeout=15.0)
+
+    def _wait(self, cond, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_register_route_and_fleet_view(self, fp):
+        port, add = fp
+        add("w0")
+        add("w1")
+        assert self._wait(lambda: len(port.registry.names()) == 2)
+        h = cas_register_history(40, concurrency=3, seed=1)
+        res = port.check(h, kind="wgl", model="cas-register")
+        assert res["valid"] is True
+        view = port.fleet_view()
+        assert view["auth-enabled"] is True
+        assert {w["name"] for w in view["workers"]} == {"w0", "w1"}
+        assert TOKEN not in str(view)
+        assert TOKEN not in str(port.fleet_status())
+        assert TOKEN not in str(port.metrics.snapshot())
+
+    def test_lease_eviction_reroutes_and_comeback_rebinds(self, fp):
+        port, add = fp
+        add("w0")
+        add("w1")
+        assert self._wait(lambda: len(port.registry.names()) == 2)
+        chaos = ChaosNemesis(port)
+        key = chaos.expire_lease("w0")
+        assert self._wait(lambda: not port.registry.is_live("w0"))
+        wid = port._slots["w0"].wid
+        assert not port.workers[wid].alive()
+        # verdicts keep flowing through the survivor
+        h = cas_register_history(40, concurrency=3, seed=2)
+        assert port.check(h, kind="wgl",
+                          model="cas-register")["valid"] is True
+        # while the fault holds, the worker's own re-register attempts
+        # are refused — a simulated partition cannot resurrect itself
+        time.sleep(1.0)
+        assert not port.registry.is_live("w0")
+        chaos.heal(key)
+        assert self._wait(lambda: port.registry.is_live("w0"))
+        rec = port.registry.get("w0")
+        assert rec.generation >= 1 and rec.wid == wid   # same slot
+        assert self._wait(lambda: port.workers[wid].alive())
+
+    def test_eviction_scrubs_telemetry_and_slo(self, fp):
+        port, add = fp
+        tw, reg = add("w0")
+        assert self._wait(lambda: port.registry.is_live("w0"))
+        wid = port.registry.get("w0").wid
+        # the worker genuinely dies — ANY frame it sends would renew
+        # (wire pushes count), so silence means gone — and the reaper
+        # evicts on natural expiry, no fault injection involved
+        reg.stop()
+        tw.terminate()
+        assert self._wait(lambda: not port.registry.is_live("w0"),
+                          timeout=15.0)
+        assert self._wait(
+            lambda: wid not in port.telemetry.stale_workers())
+        assert port.telemetry.snapshot()["evictions"] >= 1
+
+    def test_wrong_token_worker_rejected_and_never_admitted(self, fp):
+        port, add = fp
+        add("good")
+        assert self._wait(lambda: port.registry.is_live("good"))
+        add("intruder", token="not-the-token")
+        assert self._wait(lambda: port.auth_rejections > 0)
+        time.sleep(0.5)
+        assert port.registry.names() == ["good"]
+
+    def test_unauthenticated_frame_gets_typed_error(self, fp):
+        port, add = fp
+        # a no-token client's REGISTER must come back as a typed
+        # AuthError (the reply is readable: no-token verify passes)
+        from jepsen_tpu.serve.transport import F_REGISTER, WireClient
+        client = WireClient(("127.0.0.1", port.listen_port),
+                            name="naked", token="")
+        try:
+            with pytest.raises(AuthError):
+                client.call(F_REGISTER, {"name": "naked", "host": "h",
+                                         "port": 1}, timeout_s=5.0)
+        finally:
+            client.close()
+        assert "naked" not in port.registry.names()
+
+    def test_mesh_placement_lands_big_cells_on_big_workers(self, fp):
+        port, add = fp
+        add("cpu0", mesh="1")
+        add("tpu0", mesh="4x2")
+        assert self._wait(lambda: len(port.registry.names()) == 2)
+        big = SimpleNamespace(bucket=("elle", "eng", 512))
+        tpu_wid = port.registry.get("tpu0").wid
+        for k in range(8):
+            assert port.router.pick(f"elle:{k}", cell=big).wid == tpu_wid
+
+
+class TestDeepHealthzKnob:
+    def test_env_overrides_deadline(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_DEEP_HEALTHZ_S", "7.5")
+        assert Fleet.deep_healthz_timeout_s() == pytest.approx(7.5)
+
+    def test_garbage_and_nonpositive_fall_back(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_DEEP_HEALTHZ_S", "soon")
+        assert Fleet.deep_healthz_timeout_s() == pytest.approx(2.0)
+        monkeypatch.setenv("JEPSEN_TPU_DEEP_HEALTHZ_S", "-1")
+        assert Fleet.deep_healthz_timeout_s() == pytest.approx(2.0)
+        monkeypatch.delenv("JEPSEN_TPU_DEEP_HEALTHZ_S")
+        assert Fleet.deep_healthz_timeout_s() == pytest.approx(2.0)

@@ -333,6 +333,51 @@ class TestConc01:
             """, "jepsen_tpu/serve/fixture.py")
         assert fs == []
 
+    def test_wallclock_lease_bookkeeping_flagged(self):
+        # lease arithmetic on the wall clock steps under NTP adjustment
+        # and evicts healthy workers (or keeps dead ones) on a time jump
+        fs = run_rule(conc01, """
+            import time
+
+            def renew(self, rec, lease_s):
+                rec.lease_expires_at = time.time() + lease_s
+                return rec.lease_expires_at - time.time()
+            """, "jepsen_tpu/serve/registry.py")
+        assert len(fs) == 2
+        assert all("wall clock" in f.message for f in fs)
+        assert all("mono_now" in f.hint for f in fs)
+
+    def test_monotonic_lease_bookkeeping_legal(self):
+        fs = run_rule(conc01, """
+            from jepsen_tpu.clock import mono_now
+
+            def renew(self, rec, lease_s):
+                rec.lease_expires_at = mono_now() + lease_s
+                return rec.lease_expires_at - mono_now()
+            """, "jepsen_tpu/serve/registry.py")
+        assert fs == []
+
+    def test_registry_above_slot_lock_legal(self):
+        fs = run_rule(conc01, """
+            class FleetRegistry:
+                def bind(self, worker):
+                    with self._lock:
+                        with worker._restart_lock:
+                            pass
+            """, "jepsen_tpu/serve/registry.py")
+        assert fs == []
+
+    def test_registry_under_slot_lock_flagged(self):
+        fs = run_rule(conc01, """
+            class FleetRegistry:
+                def bind(self, worker):
+                    with worker._restart_lock:
+                        with self._lock:
+                            pass
+            """, "jepsen_tpu/serve/registry.py")
+        assert len(fs) == 1
+        assert "lock-order inversion" in fs[0].message
+
     def test_lock_order_inversion_flagged(self):
         fs = run_rule(conc01, """
             class Service:

@@ -85,6 +85,30 @@ class TestTelemetryStore:
         assert st.stale_s("ghost") is None
         assert not st.is_stale("ghost")
 
+    def test_evicted_worker_leaves_the_staleness_sweep(self):
+        # a lease-evicted member mid-window must vanish from the sweep:
+        # alerting on a worker the reaper already removed is a ghost page
+        st = TelemetryStore(interval_s=1.0)
+        st.register("w", now=100.0)
+        st.register("survivor", now=100.0)
+        st.record_push("survivor", _payload(), now=104.5)
+        assert st.evict("w") is True
+        assert st.stale_workers(now=105.0) == []
+        assert st.stale_s("w", now=105.0) is None   # unknown, not stale
+        assert st.snapshot()["evictions"] == 1
+        # an unknown name is a no-op, not a counted eviction
+        assert st.evict("ghost") is False
+        assert st.snapshot()["evictions"] == 1
+
+    def test_comeback_after_eviction_gets_fresh_clock(self):
+        st = TelemetryStore(interval_s=1.0)
+        st.register("w", now=100.0)
+        assert st.evict("w")
+        st.register("w", now=200.0)                 # new generation
+        assert not st.is_stale("w", now=201.5)      # fresh grace window
+        assert st.is_stale("w", now=202.5)
+        assert st.snapshot()["evictions"] == 1
+
     def test_windowed_rates_from_counter_deltas(self):
         st = TelemetryStore(interval_s=1.0)
         st.record_push("w", _payload(completed=10, unknown=1,
@@ -247,6 +271,29 @@ class TestSloEngine:
         assert len(fired) == 1
         assert fired[0]["slo"] == "worker_stale_s"
         assert fired[0]["worker"] == "w"
+
+    def test_forget_closes_episodes_on_eviction(self):
+        """Evicting a member mid-breach must close its episodes: the
+        sweep stops alerting on the ghost, and a comeback (new
+        generation under the same name) that breaches again is a NEW
+        incident that fires afresh."""
+        st = TelemetryStore(interval_s=0.5)
+        st.register("w", now=100.0)
+        specs = [s for s in default_specs(0.5)
+                 if s.name == "worker_stale_s"]
+        eng = SloEngine(st, specs=specs)
+        fired = eng.evaluate_all(
+            now=100.0 + STALE_AFTER_INTERVALS * 0.5 + 0.3)
+        assert len(fired) == 1
+        st.evict("w")
+        eng.forget("w")
+        assert eng.evaluate_all(now=110.0) == []     # no ghost alerts
+        st.register("w", now=200.0)                  # comeback
+        fired2 = eng.evaluate_all(
+            now=200.0 + STALE_AFTER_INTERVALS * 0.5 + 0.3)
+        assert len(fired2) == 1, (
+            "a re-registered worker's fresh breach must open a new "
+            "episode, not inherit the evicted incarnation's")
 
     def test_env_override_retunes_ceiling(self, monkeypatch):
         monkeypatch.setenv("JEPSEN_TPU_SLO_UNKNOWN_RATE", "0.01")
