@@ -1,6 +1,6 @@
 """Soundness & device-discipline static analysis for jepsen_tpu.
 
-Two tiers prove at CI time the invariants the rest of the stack merely
+Three tiers prove at CI time the invariants the rest of the stack merely
 promises in docstrings (rule catalog: docs/static_analysis.md):
 
 - the **AST tier** (:mod:`.ast_lint` + :mod:`.rules`) — SOUND01 (verdicts
@@ -8,6 +8,10 @@ promises in docstrings (rule catalog: docs/static_analysis.md):
   data-dependent Python in jit-traced engine code), SHAPE01 (serve/
   engine-entry shapes derive from the bucket ladder), CONC01 (monotonic
   clock, lock-order manifest, no blocking I/O under a lock);
+- the **interprocedural tier** (:mod:`.interp_lint` + :mod:`.callgraph`)
+  — CONC02 (lock-chain inversions across function boundaries, manifest
+  drift), SEC01 (the fleet token never reaches any artifact), DL01
+  (deadlines cross processes only as remaining budget);
 - the **trace tier** (:mod:`.jaxpr_lint`) — traces the real engines with
   ``jax.make_jaxpr`` and proves no callback/transfer primitives survive
   jit (TRACE01) and the compiled-signature universe equals the bucket
@@ -24,13 +28,18 @@ from typing import List, Optional
 
 from jepsen_tpu.lint.ast_lint import run_ast_tier
 from jepsen_tpu.lint.findings import (Baseline, Finding,  # noqa: F401
-                                      apply_pragmas)
+                                      apply_pragmas, to_sarif)
 
 
 def run_all(root: Optional[str] = None, trace: bool = True,
+            interp: bool = True,
             baseline: Optional[Baseline] = None) -> List[Finding]:
-    """Both tiers; findings come back with ``baselined`` marked."""
+    """All tiers; findings come back with ``baselined`` marked."""
     findings, _ = run_ast_tier(root)
+    if interp:
+        from jepsen_tpu.lint.interp_lint import run_interp_tier
+        interp_findings, _ = run_interp_tier(root)
+        findings.extend(interp_findings)
     if trace:
         from jepsen_tpu.lint.jaxpr_lint import run_trace_tier
         findings.extend(run_trace_tier())

@@ -1,0 +1,553 @@
+"""Repo-wide call graph: the substrate for the interprocedural lint tier.
+
+The PR 5 rules are deliberately lexical — CONC01 sees a lock inversion
+only when both ``with`` blocks share a function.  The whole-program
+invariants (cross-function lock chains, the fleet token never reaching
+an artifact, deadlines crossing process boundaries only as remaining
+budget) need to follow calls, so this module builds one graph over
+``jepsen_tpu/`` + ``suites/`` that the CONC02/SEC01/DL01 rules consume.
+
+Resolution is *intraprocedural*: no dataflow across functions is needed
+to name the callee.  What resolves:
+
+- **direct calls** — module-level functions, nested ``def``s called from
+  their enclosing function, and names reached through ``import`` /
+  ``from ... import`` chains, following package ``__init__`` re-exports;
+- **method calls** — ``self.m()`` through the class (and repo-resolvable
+  bases, including ``super().m()``); ``Cls.m()`` / ``Cls()``
+  (constructor -> ``__init__`` through the MRO); ``self.attr.m()`` and
+  ``local.m()`` when the attribute/local was assigned a repo-class
+  constructor anywhere in the class / earlier in the function;
+- **thread-entry seams** — ``threading.Thread(target=f)`` adds a
+  ``kind="thread"`` edge to ``f``.  Every long-lived loop in the repo
+  (scheduler device loop, wire reader threads, heartbeat/reaper/
+  telemetry loops) starts exactly this way, so thread entries are edges,
+  not holes.  Rules decide per-invariant whether a thread edge
+  propagates (CONC02 does not: the target runs without the spawner's
+  locks).
+
+Everything else — calls through dynamic dispatch tables, stored
+callbacks, non-constructor-typed attributes — lands in the per-function
+``unresolved`` ledger with its source text and line.  That is the
+documented conservatism contract: the graph **over-approximates nothing
+silently and under-approximates nothing silently** — a rule walking
+edges sees every call it could resolve, and the dump shows every call it
+could not, so "no finding" is auditable rather than assumed.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    """One function/method: ``id`` is ``"<path>::<qual>"``."""
+
+    id: str
+    path: str
+    qual: str                   # "Fleet.submit", "fleet_token", "f.inner"
+    lineno: int
+    node: Any                   # the ast.FunctionDef / AsyncFunctionDef
+    cls: Optional[str] = None   # owning class id, for methods
+
+    @property
+    def label(self) -> str:
+        """Stable line-free symbol for finding messages."""
+        return f"{os.path.basename(self.path)}::{self.qual}"
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+        names += [p.arg for p in a.kwonlyargs]
+        return names
+
+
+@dataclass
+class ClassInfo:
+    id: str
+    path: str
+    name: str
+    bases: List[str] = field(default_factory=list)   # dotted source text
+    methods: Dict[str, str] = field(default_factory=dict)   # name -> func id
+    #: ``self.x = Cls(...)`` assignments seen anywhere in the class body:
+    #: attribute name -> the constructor's dotted callee text (resolved
+    #: lazily, once the whole symbol table exists)
+    attr_ctors: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Edge:
+    caller: str
+    callee: str
+    lineno: int
+    col: int
+    kind: str = "call"          # "call" | "thread"
+    bound: bool = False         # instance-bound: args map to params[1:]
+
+
+@dataclass
+class _Module:
+    path: str
+    name: str                   # dotted module name
+    tree: Any
+    defs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # local name -> ("mod", modname) | ("sym", modname, orig)
+    #            | ("ext", dotted-external)
+    imports: Dict[str, Tuple] = field(default_factory=dict)
+    consts: Dict[str, str] = field(default_factory=dict)  # str constants
+
+
+class CallGraph:
+    """The finished graph plus the indices rules need."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.modules: Dict[str, _Module] = {}        # by path
+        self.by_name: Dict[str, _Module] = {}        # by dotted name
+        self.out: Dict[str, List[Edge]] = {}
+        self.unresolved: Dict[str, List[Tuple[str, int]]] = {}
+        #: call-site index: fid -> {(lineno, col): Edge}
+        self.edge_at: Dict[str, Dict[Tuple[int, int], Edge]] = {}
+        self.sources: Dict[str, List[str]] = {}
+
+    # -- queries -----------------------------------------------------------
+
+    def in_edges(self, fid: str) -> List[Edge]:
+        return [e for edges in self.out.values() for e in edges
+                if e.callee == fid]
+
+    def find(self, path_suffix: str, qual: str) -> Optional[FuncInfo]:
+        for f in self.funcs.values():
+            if f.qual == qual and f.path.endswith(path_suffix):
+                return f
+        return None
+
+    def class_attr_taintable(self, cid: str, attr: str,
+                             tainted: set) -> bool:
+        """Is ``(cls-or-ancestor, attr)`` in the tainted-attribute set?"""
+        seen = set()
+        stack = [cid]
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            if (c, attr) in tainted:
+                return True
+            info = self.classes.get(c)
+            if info:
+                for b in info.bases:
+                    t = self.resolve_dotted(self.modules[info.path], b)
+                    if t and t[0] == "class":
+                        stack.append(t[1])
+        return False
+
+    def method_of(self, cid: str, name: str) -> Optional[str]:
+        """MRO walk (repo classes only) for a method."""
+        seen = set()
+        stack = [cid]
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            info = self.classes.get(c)
+            if info is None:
+                continue
+            if name in info.methods:
+                return info.methods[name]
+            for b in info.bases:
+                t = self.resolve_dotted(self.modules[info.path], b)
+                if t and t[0] == "class":
+                    stack.append(t[1])
+        return None
+
+    def module_const(self, path: str, name: str) -> Optional[str]:
+        m = self.modules.get(path)
+        return m.consts.get(name) if m else None
+
+    def _is_pkg_prefix(self, name: str) -> bool:
+        """True when repo modules live under ``name.`` even though
+        ``name`` itself has no indexed module (namespace package)."""
+        prefix = name + "."
+        return any(k.startswith(prefix) for k in self.by_name)
+
+    # -- symbol resolution -------------------------------------------------
+
+    def resolve_symbol(self, modname: str, name: str,
+                       _seen: Optional[set] = None) -> Optional[Tuple]:
+        """("func", fid) | ("class", cid) | ("mod", modname) |
+        ("ext", dotted) for ``name`` in module ``modname``, following
+        re-export chains."""
+        _seen = _seen if _seen is not None else set()
+        if (modname, name) in _seen:
+            return None
+        _seen.add((modname, name))
+        m = self.by_name.get(modname)
+        if m is None:
+            # namespace package: no __init__ module of its own, but
+            # submodules exist under the prefix
+            sub = f"{modname}.{name}"
+            if sub in self.by_name or self._is_pkg_prefix(sub):
+                return ("mod", sub)
+            return None
+        if name in m.defs:
+            return m.defs[name]
+        imp = m.imports.get(name)
+        if imp is not None:
+            if imp[0] == "mod":
+                # classified lazily: the module may not have been indexed
+                # yet when the import was recorded
+                if imp[1] in self.by_name or self._is_pkg_prefix(imp[1]):
+                    return ("mod", imp[1])
+                return ("ext", imp[1])
+            if imp[0] == "ext":
+                return imp
+            if imp[0] == "sym":
+                sub = f"{imp[1]}.{imp[2]}"
+                if sub in self.by_name:
+                    return ("mod", sub)
+                if imp[1] in self.by_name:
+                    return self.resolve_symbol(imp[1], imp[2], _seen)
+                return ("ext", sub)
+        # a submodule never explicitly imported into the package ns
+        sub = f"{modname}.{name}"
+        if sub in self.by_name:
+            return ("mod", sub)
+        return None
+
+    def resolve_dotted(self, m: _Module, dotted: str) -> Optional[Tuple]:
+        """Resolve ``a.b.c`` source text in module ``m`` to a target:
+        ("func", fid) | ("class", cid) | ("classmethod", fid) |
+        ("ext", canonical-dotted) | None."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        tgt = self.resolve_symbol(m.name, parts[0])
+        if tgt is None:
+            return None
+        i = 1
+        while tgt is not None and tgt[0] == "mod" and i < len(parts):
+            tgt = self.resolve_symbol(tgt[1], parts[i])
+            i += 1
+        if tgt is None:
+            return None
+        if tgt[0] == "ext":
+            rest = parts[i:]
+            return ("ext", ".".join([tgt[1]] + rest))
+        if i == len(parts):
+            return tgt
+        if tgt[0] == "class" and i == len(parts) - 1:
+            fid = self.method_of(tgt[1], parts[i])
+            if fid:
+                return ("classmethod", fid)
+        return None
+
+    def external_name(self, m: _Module, dotted: str) -> Optional[str]:
+        """Canonical external dotted name (``log.warning`` with ``import
+        logging as log`` -> ``logging.warning``), or None if the name is
+        repo-internal / unknown."""
+        t = self.resolve_dotted(m, dotted)
+        if t is not None and t[0] == "ext":
+            return t[1]
+        if t is None and dotted and dotted.split(".")[0] in _BUILTINS:
+            return dotted
+        return None
+
+    # -- export ------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "functions": {
+                fid: {"path": f.path, "qual": f.qual, "line": f.lineno,
+                      "class": f.cls,
+                      "calls": [{"callee": e.callee, "line": e.lineno,
+                                 "kind": e.kind} for e in
+                                self.out.get(fid, [])],
+                      "unresolved": [{"call": c, "line": ln} for c, ln in
+                                     self.unresolved.get(fid, [])]}
+                for fid, f in sorted(self.funcs.items())
+            },
+            "classes": {
+                cid: {"path": c.path, "bases": c.bases,
+                      "methods": sorted(c.methods)}
+                for cid, c in sorted(self.classes.items())
+            },
+        }
+
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def _mod_name(path: str) -> str:
+    p = path[:-3] if path.endswith(".py") else path
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def _index_module(g: CallGraph, path: str, tree: ast.Module) -> None:
+    m = _Module(path=path, name=_mod_name(path), tree=tree)
+    g.modules[path] = m
+    g.by_name[m.name] = m
+
+    # imports anywhere in the file fold into the module namespace — a
+    # function-local `from x import y` resolves the same way
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                top = alias.name if alias.asname else alias.name.split(".")[0]
+                m.imports.setdefault(local, ("mod", top))
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                local = alias.asname or alias.name
+                m.imports.setdefault(
+                    local, ("sym", node.module, alias.name))
+
+    def reg_func(fn: ast.AST, qual: str, cls: Optional[str]) -> None:
+        fid = f"{path}::{qual}"
+        g.funcs[fid] = FuncInfo(id=fid, path=path, qual=qual,
+                                lineno=fn.lineno, node=fn, cls=cls)
+        if cls is None and "." not in qual:
+            m.defs[qual] = ("func", fid)
+        for child in ast.iter_child_nodes(fn):
+            _walk_nested(child, qual, cls)
+
+    def _walk_nested(node: ast.AST, outer: str, cls: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reg_func(node, f"{outer}.{node.name}", cls)
+            return
+        for child in ast.iter_child_nodes(node):
+            _walk_nested(child, outer, cls)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reg_func(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            cid = f"{path}::{node.name}"
+            ci = ClassInfo(id=cid, path=path, name=node.name,
+                           bases=[_dotted(b) for b in node.bases
+                                  if _dotted(b)])
+            g.classes[cid] = ci
+            m.defs[node.name] = ("class", cid)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    mq = f"{node.name}.{item.name}"
+                    reg_func(item, mq, cid)
+                    ci.methods[item.name] = f"{path}::{mq}"
+            # self.x = Ctor(...) anywhere in the class body
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Attribute) \
+                        and isinstance(sub.targets[0].value, ast.Name) \
+                        and sub.targets[0].value.id == "self" \
+                        and isinstance(sub.value, ast.Call):
+                    callee = _dotted(sub.value.func)
+                    if callee:
+                        ci.attr_ctors.setdefault(
+                            sub.targets[0].attr, callee)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            m.consts[node.targets[0].id] = node.value.value
+
+
+def _class_of_target(g: CallGraph, m: _Module,
+                     t: Optional[Tuple]) -> Optional[str]:
+    return t[1] if t is not None and t[0] == "class" else None
+
+
+def _resolve_calls(g: CallGraph, f: FuncInfo) -> None:
+    m = g.modules[f.path]
+    edges: List[Edge] = []
+    unresolved: List[Tuple[str, int]] = []
+    edge_at: Dict[Tuple[int, int], Edge] = {}
+
+    # names of defs nested directly inside this function
+    local_funcs = {
+        g.funcs[fid].qual.rsplit(".", 1)[1]: fid
+        for fid in g.funcs
+        if g.funcs[fid].path == f.path
+        and g.funcs[fid].qual.startswith(f.qual + ".")
+        and "." not in g.funcs[fid].qual[len(f.qual) + 1:]
+    }
+    # locals assigned a repo-class constructor, in statement order
+    var_types: Dict[str, str] = {}
+
+    def resolve_target(expr: ast.AST) -> Optional[Tuple[str, bool]]:
+        """-> (callee fid, bound) for a callable reference."""
+        d = _dotted(expr)
+        if isinstance(expr, ast.Name):
+            if expr.id in local_funcs:
+                return local_funcs[expr.id], False
+            t = g.resolve_dotted(m, expr.id)
+            if t is None:
+                return None
+            if t[0] == "func":
+                return t[1], False
+            if t[0] == "class":
+                init = g.method_of(t[1], "__init__")
+                return (init, True) if init else None
+            return None
+        if isinstance(expr, ast.Attribute):
+            # super().m()
+            if isinstance(expr.value, ast.Call) \
+                    and isinstance(expr.value.func, ast.Name) \
+                    and expr.value.func.id == "super" and f.cls:
+                ci = g.classes[f.cls]
+                for b in ci.bases:
+                    bt = g.resolve_dotted(m, b)
+                    if bt and bt[0] == "class":
+                        fid = g.method_of(bt[1], expr.attr)
+                        if fid:
+                            return fid, True
+                return None
+            parts = d.split(".") if d else []
+            if parts and parts[0] == "self" and f.cls:
+                if len(parts) == 2:
+                    fid = g.method_of(f.cls, parts[1])
+                    return (fid, True) if fid else None
+                if len(parts) == 3:
+                    ctor = g.classes[f.cls].attr_ctors.get(parts[1])
+                    if ctor:
+                        t = g.resolve_dotted(m, ctor)
+                        cid = _class_of_target(g, m, t)
+                        if cid:
+                            fid = g.method_of(cid, parts[2])
+                            return (fid, True) if fid else None
+                return None
+            if len(parts) == 2 and parts[0] in var_types:
+                fid = g.method_of(var_types[parts[0]], parts[1])
+                return (fid, True) if fid else None
+            if d:
+                t = g.resolve_dotted(m, d)
+                if t is None:
+                    return None
+                if t[0] == "func":
+                    return t[1], False
+                if t[0] == "classmethod":
+                    return t[1], False
+                if t[0] == "class":
+                    init = g.method_of(t[1], "__init__")
+                    return (init, True) if init else None
+            return None
+        return None
+
+    def is_thread_ctor(call: ast.Call) -> bool:
+        d = _dotted(call.func)
+        if not d:
+            return False
+        ext = g.external_name(m, d)
+        return ext == "threading.Thread" or d == "threading.Thread"
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return                      # its own graph node
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            t = g.resolve_dotted(m, _dotted(node.value.func))
+            cid = _class_of_target(g, m, t)
+            if cid:
+                var_types[node.targets[0].id] = cid
+        if isinstance(node, ast.Call):
+            if is_thread_ctor(node):
+                tgt = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = resolve_target(kw.value)
+                if tgt is not None:
+                    e = Edge(f.id, tgt[0], node.lineno, node.col_offset,
+                             kind="thread", bound=tgt[1])
+                    edges.append(e)
+                    edge_at[(node.lineno, node.col_offset)] = e
+            else:
+                r = resolve_target(node.func)
+                if r is not None:
+                    e = Edge(f.id, r[0], node.lineno, node.col_offset,
+                             bound=r[1])
+                    edges.append(e)
+                    edge_at[(node.lineno, node.col_offset)] = e
+                else:
+                    d = _dotted(node.func)
+                    known_ext = d and g.external_name(m, d) is not None
+                    if not known_ext:
+                        unresolved.append(
+                            (d or type(node.func).__name__, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in f.node.body:
+        visit(stmt)
+    g.out[f.id] = edges
+    g.unresolved[f.id] = unresolved
+    g.edge_at[f.id] = edge_at
+
+
+def build_graph(files: Dict[str, str]) -> CallGraph:
+    """Build the graph from ``{repo-relative path: source text}``.
+    Files that fail to parse are skipped here — the AST tier already
+    turns them into PARSE findings, which fail lint on their own."""
+    g = CallGraph()
+    trees: Dict[str, ast.Module] = {}
+    for path in sorted(files):
+        try:
+            trees[path] = ast.parse(files[path], filename=path)
+        except SyntaxError:
+            continue
+        g.sources[path] = files[path].splitlines()
+    for path, tree in trees.items():
+        _index_module(g, path, tree)
+    for f in list(g.funcs.values()):
+        _resolve_calls(g, f)
+    return g
+
+
+def map_args_to_params(edge: Edge, call: ast.Call,
+                       callee: FuncInfo) -> Dict[str, ast.AST]:
+    """Which argument expression feeds which callee parameter.  Bound
+    calls (``self.m(x)``, constructors) skip the receiver slot."""
+    params = callee.params()
+    if edge.bound and params:
+        params = params[1:]
+    elif params and params[0] in ("self", "cls"):
+        # unbound call through the class is rare; be permissive
+        if len(call.args) < len(params):
+            params = params[1:]
+    out: Dict[str, ast.AST] = {}
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            break
+        if i < len(params):
+            out[params[i]] = a
+    for kw in call.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out

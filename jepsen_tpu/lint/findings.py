@@ -82,6 +82,64 @@ def apply_pragmas(findings: Iterable[Finding],
     return out
 
 
+# -- SARIF -------------------------------------------------------------------
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: Iterable[Finding]) -> Dict[str, Any]:
+    """SARIF 2.1.0 document for GitHub code scanning.
+
+    ``partialFingerprints`` carries the same (rule, path, message) key
+    the baseline ledger uses, so code-scanning dedup tracks findings
+    across unrelated line churn exactly like the ledger does.
+    Baselined findings come through as ``note`` so they appear without
+    failing the scan; new findings are ``error``.
+    """
+    rules_meta: Dict[str, Dict[str, Any]] = {}
+    results: List[Dict[str, Any]] = []
+    for f in findings:
+        rules_meta.setdefault(f.rule, {
+            "id": f.rule,
+            "shortDescription": {"text": f.rule},
+            "helpUri": "https://github.com/jepsen-tpu/jepsen-tpu/blob/"
+                       "main/docs/static_analysis.md",
+        })
+        text = f.message if not f.hint else f"{f.message}\nhint: {f.hint}"
+        results.append({
+            "ruleId": f.rule,
+            "level": "note" if f.baselined else "error",
+            "message": {"text": text},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {
+                "jepsenTpuLint/v1": "|".join(f.key()),
+            },
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jepsen-tpu-lint",
+                "informationUri": "https://github.com/jepsen-tpu/"
+                                  "jepsen-tpu/blob/main/docs/"
+                                  "static_analysis.md",
+                "rules": sorted(rules_meta.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
 # -- baseline ----------------------------------------------------------------
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")

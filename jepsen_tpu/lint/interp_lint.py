@@ -1,0 +1,52 @@
+"""The interprocedural tier driver: build the whole-program call graph
+once, run every graph-consuming rule over it, honor pragmas.
+
+Unlike the AST tier — where each rule sees one parsed module at a time —
+the rules here (:mod:`.rules.conc02`, :mod:`.rules.sec01`,
+:mod:`.rules.dl01`) export ``check_program(graph)`` and see the entire
+repo through :mod:`.callgraph`.  The graph is built once per run and
+shared; at ~270 files it costs about two seconds, which is also why CI
+budgets the whole tier under a minute (tests/test_lint.py asserts it).
+
+Suppression composes exactly as in the AST tier: an inline ``# lint:
+disable=RULE(reason)`` pragma at the finding's line wins.  The baseline
+ledger keys on (rule, path, message), and every interprocedural message
+is deliberately line-free (symbol chains only), so unrelated edits don't
+churn the ledger — see the satellite contract in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jepsen_tpu.lint.ast_lint import _iter_py_files, repo_root
+from jepsen_tpu.lint.callgraph import CallGraph, build_graph
+from jepsen_tpu.lint.findings import Finding, apply_pragmas
+from jepsen_tpu.lint.rules import in_scope, interp_rules
+
+
+def run_interp_tier(root: Optional[str] = None,
+                    files: Optional[Dict[str, str]] = None,
+                    rules: Optional[Sequence] = None,
+                    ) -> Tuple[List[Finding], CallGraph]:
+    """Run every interprocedural rule over one shared call graph.
+
+    ``files`` (repo-relative path -> source text) overrides disk
+    discovery, mirroring :func:`.ast_lint.run_ast_tier` — the test
+    suite uses it to analyze fixture programs.  Returns (post-pragma
+    findings, the graph) so callers can archive the graph dump.
+    """
+    root = root or repo_root()
+    if files is None:
+        files = {}
+        for rel in _iter_py_files(root):
+            with open(os.path.join(root, rel)) as f:
+                files[rel] = f.read()
+    graph = build_graph(files)
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else interp_rules()):
+        findings.extend(f for f in rule.check_program(graph)
+                        if in_scope(f.path, rule.SCOPE))
+    sources = {rel: src.splitlines() for rel, src in files.items()}
+    return apply_pragmas(findings, sources), graph

@@ -17,6 +17,7 @@ the tuple (earlier = outermost-permitted).
 The declared order mirrors the call graph today:
 
     fleet-supervisor -> fleet -> fleet-registry -> fleet-slot
+      -> fleet-journal-write -> fleet-journal-pending
       -> transport-ready -> transport-state -> transport-send
       -> procworker-state -> procworker-send
       -> service -> scheduler -> request -> metrics
@@ -28,6 +29,12 @@ The declared order mirrors the call graph today:
       engine's own locks — observe/record/push is called from under
       scheduler/fleet/metrics code and from wire reader threads, so
       these must never wrap another declared lock)
+
+The journal pair is the FleetJournal's write/pending discipline:
+``_flush`` snapshots the pending map *inside* the writer lock
+(``fleet-journal-write`` then ``fleet-journal-pending``) so a slow
+earlier writer can't clobber a newer snapshot; record/complete take the
+pending lock alone and flush after releasing it.
 
 The transport chain follows a respawn end to end: the ProcFleet
 supervisor (``_sup_lock`` — the Fleetport's slot-admission/eviction
@@ -57,6 +64,10 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
     ("fleet-slot",
      [(r"serve/fleet\.py$", r"^self\._restart_lock$"),
       (r"", r"^(w|worker)\._restart_lock$")]),
+    ("fleet-journal-write",
+     [(r"serve/fleet\.py$", r"^self\._wlock$")]),
+    ("fleet-journal-pending",
+     [(r"serve/fleet\.py$", r"^self\._jlock$")]),
     ("transport-ready",
      [(r"serve/transport\.py$", r"^self\._ready_lock$")]),
     ("transport-state",

@@ -82,3 +82,20 @@ def dotted(node: ast.AST) -> str:
 def all_rules():
     from jepsen_tpu.lint.rules import conc01, dev01, shape01, sound01
     return (sound01, dev01, shape01, conc01)
+
+
+def interp_rules():
+    """The interprocedural (call-graph) rules.  Unlike :func:`all_rules`
+    modules, these export ``check_program(graph)`` — they see the whole
+    program through :mod:`jepsen_tpu.lint.callgraph`, not one module:
+
+    - CONC02 — cross-function lock-chain inversions + lock-manifest
+      drift (every Lock() under serve|monitor|obs must be declared);
+    - SEC01  — the fleet token (and HMAC material derived from it)
+      never reaches logs, exceptions, metrics, frames outside the
+      ``auth`` field, or files;
+    - DL01   — deadlines cross process boundaries only as remaining
+      budget, never as wall-clock or absolute monotonic values.
+    """
+    from jepsen_tpu.lint.rules import conc02, dl01, sec01
+    return (conc02, sec01, dl01)

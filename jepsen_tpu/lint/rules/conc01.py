@@ -13,9 +13,11 @@ Three invariants, one rule:
 2. **Lock order.**  Acquiring a declared lock (see
    :mod:`jepsen_tpu.lint.lock_order`) lexically inside a ``with`` that
    holds a later-or-equal one is an inversion: two threads taking the
-   pair in opposite orders deadlock under load.  The check is syntactic
-   (lexical ``with`` nesting, not the dynamic call graph), which is
-   exactly the part a reviewer can't see across files.
+   pair in opposite orders deadlock under load.  This check is
+   deliberately syntactic — lexical ``with`` nesting only; inversions
+   that span function boundaries are CONC02's job
+   (:mod:`jepsen_tpu.lint.rules.conc02`, which propagates held-lock
+   sets through the whole-program call graph).
 
 3. **No blocking I/O under a declared lock.**  ``time.sleep``,
    ``subprocess``, sockets, HTTP, and ``open()`` inside a held declared

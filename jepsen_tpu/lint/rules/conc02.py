@@ -1,0 +1,246 @@
+"""CONC02: interprocedural lock discipline — cross-function lock chains
+and manifest drift.
+
+CONC01 proves the lock order only where both acquisitions share a
+function; the deepest stacks in the repo (fleet-supervisor -> fleet ->
+fleet-registry -> fleet-slot -> transport) span five files, so a PR can
+introduce an inversion no single function shows.  Two whole-program
+checks close that hole:
+
+1. **Held-lock propagation.**  For every function the rule computes the
+   set of declared locks (lock_order.py manifest) it *may acquire*,
+   transitively through resolved call edges.  A call site that holds a
+   declared lock and reaches — through any chain of calls — an
+   acquisition of an earlier-or-equal-level lock is an inversion, and
+   the finding prints the offending chain.  ``kind="thread"`` edges do
+   not propagate: the target runs on a fresh stack without the
+   spawner's locks.  The propagation is an over-approximation (every
+   call edge is assumed feasible); calls the graph cannot resolve are
+   listed in the call-graph dump's ``unresolved`` ledger rather than
+   silently assumed lock-free — see callgraph.py's conservatism
+   contract.
+
+2. **Manifest drift.**  Every ``threading.Lock()`` / ``RLock()``
+   construction under ``jepsen_tpu/serve|monitor|obs`` must match a
+   lock_order.py manifest entry (by the expression its holders will
+   acquire it through) or carry a pragma.  Without this, a brand-new
+   lock silently escapes both CONC01 and the propagation above — the
+   analyzer would vouch for an order it never saw.
+
+Finding messages carry symbol chains, never line numbers, so the
+baseline ledger keys (rule, path, symbol-chain) and unrelated edits
+don't churn it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint.callgraph import CallGraph, FuncInfo
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.lock_order import lock_level
+
+RULE = "CONC02"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+#: trees whose Lock constructions must be manifest-covered
+_DRIFT_SCOPE = ("jepsen_tpu/serve/", "jepsen_tpu/monitor/",
+                "jepsen_tpu/obs/")
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+# -- per-function local summaries ---------------------------------------------
+
+class _Local:
+    """What one function does with declared locks, lexically."""
+
+    def __init__(self) -> None:
+        #: (level, name) acquired anywhere in the body
+        self.acquires: Set[Tuple[int, str]] = set()
+        #: call sites: (lineno, col, held [(level, name)])
+        self.callsites: List[Tuple[int, int,
+                                   Tuple[Tuple[int, str], ...]]] = []
+
+
+def _summarize(f: FuncInfo) -> _Local:
+    out = _Local()
+
+    def visit(node: ast.AST, held: Tuple[Tuple[int, str], ...]) -> None:
+        if isinstance(node, _FN):
+            return                      # separate graph node / deferred
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                try:
+                    expr_s = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover - defensive
+                    expr_s = ""
+                lv = lock_level(f.path, expr_s)
+                if lv is not None:
+                    out.acquires.add(lv)
+                    new_held = new_held + (lv,)
+            for child in node.body:
+                visit(child, new_held)
+            return
+        if isinstance(node, ast.Call):
+            out.callsites.append((node.lineno, node.col_offset, held))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in f.node.body:
+        visit(stmt, ())
+    return out
+
+
+# -- transitive may-acquire ----------------------------------------------------
+
+def _fixpoint(graph: CallGraph,
+              local: Dict[str, _Local]) -> Dict[str, Set[Tuple[int, str]]]:
+    summary = {fid: set(loc.acquires) for fid, loc in local.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, edges in graph.out.items():
+            s = summary.get(fid)
+            if s is None:
+                continue
+            for e in edges:
+                if e.kind != "call":
+                    continue
+                callee = summary.get(e.callee)
+                if callee and not callee <= s:
+                    s |= callee
+                    changed = True
+    return summary
+
+
+def _chain_to(graph: CallGraph, start: str, lock: Tuple[int, str],
+              local: Dict[str, _Local],
+              summary: Dict[str, Set[Tuple[int, str]]]) -> List[str]:
+    """Shortest call chain (function ids) from ``start`` to a function
+    that lexically acquires ``lock``."""
+    seen = {start}
+    queue: List[Tuple[str, List[str]]] = [(start, [start])]
+    while queue:
+        fid, path = queue.pop(0)
+        if lock in local[fid].acquires:
+            return path
+        for e in graph.out.get(fid, []):
+            if e.kind != "call" or e.callee in seen:
+                continue
+            if e.callee in summary and lock in summary[e.callee]:
+                seen.add(e.callee)
+                queue.append((e.callee, path + [e.callee]))
+    return [start]                      # pragma: no cover - summary invariant
+
+
+def _check_chains(graph: CallGraph) -> List[Finding]:
+    local = {fid: _summarize(f) for fid, f in graph.funcs.items()}
+    summary = _fixpoint(graph, local)
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, str, str, str]] = set()
+    for fid, loc in local.items():
+        f = graph.funcs[fid]
+        for lineno, col, held in loc.callsites:
+            if not held:
+                continue
+            edge = graph.edge_at.get(fid, {}).get((lineno, col))
+            if edge is None or edge.kind != "call":
+                continue
+            for lock in sorted(summary.get(edge.callee, ())):
+                level, name = lock
+                for hlevel, hname in held:
+                    if level > hlevel:
+                        continue
+                    chain = [fid] + _chain_to(graph, edge.callee, lock,
+                                              local, summary)
+                    chain_s = " -> ".join(graph.funcs[c].label
+                                          for c in chain)
+                    key = (f.path, chain_s, name, hname)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        RULE, f.path, lineno,
+                        f"interprocedural lock-order inversion: call "
+                        f"chain {chain_s} may acquire '{name}' (level "
+                        f"{level}) while '{hname}' (level {hlevel}) is "
+                        f"held at the call site",
+                        hint="acquire locks in the lock_order.py "
+                             "manifest order along every call chain, or "
+                             "move the call outside the critical "
+                             "section"))
+    return findings
+
+
+# -- manifest drift ------------------------------------------------------------
+
+def _lock_ctor(graph: CallGraph, path: str, value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    d = ""
+    if isinstance(value.func, (ast.Name, ast.Attribute)):
+        try:
+            d = ast.unparse(value.func)
+        except Exception:  # pragma: no cover - defensive
+            return False
+    m = graph.modules.get(path)
+    ext = graph.external_name(m, d) if m else None
+    return (ext or d) in ("threading.Lock", "threading.RLock",
+                          "Lock", "RLock")
+
+
+def _qual_at(f_by_line: List[Tuple[int, int, str]], lineno: int) -> str:
+    best = "<module>"
+    for start, end, qual in f_by_line:
+        if start <= lineno <= end:
+            best = qual
+    return best
+
+
+def _check_drift(graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, m in sorted(graph.modules.items()):
+        if not any(path.startswith(p) for p in _DRIFT_SCOPE):
+            continue
+        spans = [(f.lineno, max(f.lineno,
+                                getattr(f.node, "end_lineno", f.lineno)),
+                  f.qual)
+                 for f in graph.funcs.values() if f.path == path]
+        spans.sort()
+        for node in ast.walk(m.tree):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not _lock_ctor(graph, path, value):
+                continue
+            for t in targets:
+                try:
+                    t_s = ast.unparse(t)
+                except Exception:  # pragma: no cover - defensive
+                    continue
+                if lock_level(path, t_s) is not None:
+                    continue
+                qual = _qual_at(spans, node.lineno)
+                findings.append(Finding(
+                    RULE, path, node.lineno,
+                    f"undeclared lock `{t_s}` constructed in {qual}: "
+                    f"every Lock()/RLock() under serve|monitor|obs "
+                    f"must match a lock_order.py manifest entry, or "
+                    f"both CONC01 and CONC02 are blind to it",
+                    hint="add a manifest entry at the level matching "
+                         "its acquisition order, or add `# lint: "
+                         "disable=CONC02(reason)` if the lock is "
+                         "provably leaf-local"))
+    return findings
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    return _check_chains(graph) + _check_drift(graph)

@@ -1,0 +1,330 @@
+"""DL01: no wall clock crosses a process boundary — deadlines travel as
+remaining budget.
+
+The transport's deadline discipline (serve/transport.py, serve/fleet.py)
+is that every cross-process send — SUBMIT frames, journal records,
+telemetry pushes — carries ``deadline-rem-s``: the *remaining* seconds
+of the caller's ``engine.budget.Deadline``, re-anchored on the
+receiver's own monotonic clock.  Absolute timestamps are meaningless
+across hosts (wall clocks disagree; monotonic clocks have per-process
+epochs), so a ``time.time()`` value or a bare ``mono_now()`` reading
+flowing into a deadline field silently corrupts budget accounting on
+the far side.  Until now that was proven only dynamically; this rule
+makes it a static check over the call graph.
+
+**Provenance classes** for an expression feeding a deadline field:
+
+- *bad / wall-clock*: ``time.time``/``time.time_ns``, ``datetime.now``
+  family, and anything built from them — including differences:
+  two hosts' wall clocks disagree, so even ``wall - wall`` is
+  untrustworthy budget.
+- *bad / absolute-monotonic*: bare ``time.monotonic`` / ``mono_now()``
+  readings and ``Deadline.at``-style absolute attributes.  Subtraction
+  launders absoluteness here: ``deadline_at - mono_now()`` is a
+  relative remainder and is fine — that is exactly how
+  ``Deadline.remaining`` is implemented.
+- *ok*: ``.remaining()`` / ``.remaining_s()`` calls, constants, and
+  anything else — the rule reports positively-detected bad flows only;
+  unknown provenance is not a finding.
+- *parameter*: the obligation propagates to every caller through the
+  call graph's in-edges — a wall-clock argument three frames up still
+  produces a finding, with the symbol chain printed.
+
+A second check is structural: any dict literal that is recognizably a
+SUBMIT frame (a ``"type"`` key whose value resolves to ``"submit"``)
+must carry a deadline key at all — a frame with no budget is as wrong
+as one with an absolute one.
+
+Messages are line-free symbol chains, keying the baseline ledger on
+(rule, path, symbol-chain).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint.callgraph import CallGraph, map_args_to_params
+from jepsen_tpu.lint.findings import Finding
+
+RULE = "DL01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+#: frame/journal keys that must carry *remaining* (relative) budget
+_DEADLINE_KEYS = {"deadline-rem-s", "deadline_rem_s"}
+
+_WALL = {"time.time", "time.time_ns"}
+_WALL_DT_SUFFIX = (".now", ".utcnow", ".today")
+_MONO = {"time.monotonic", "time.monotonic_ns", "time.perf_counter"}
+_MONO_QUALS = ("mono_now",)
+_OK_METHODS = {"remaining", "remaining_s"}
+_COMBINE_FUNCS = {"max", "min", "abs", "float", "int", "round"}
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# provenance lattice: OK < PARAM < BAD
+_OK, _PARAM, _BAD = 0, 1, 2
+
+
+class _Prov:
+    __slots__ = ("rank", "reason", "param")
+
+    def __init__(self, rank: int, reason: str = "",
+                 param: Optional[str] = None):
+        self.rank = rank
+        self.reason = reason
+        self.param = param
+
+
+def _join(a: _Prov, b: _Prov) -> _Prov:
+    return a if a.rank >= b.rank else b
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+class _FnFacts:
+    """One function's deadline-relevant facts."""
+
+    def __init__(self) -> None:
+        #: name -> provenance of its last assignment
+        self.env: Dict[str, _Prov] = {}
+        #: direct findings: (lineno, key, reason)
+        self.direct: List[Tuple[int, str, str]] = []
+        #: param name -> (lineno, key): the param flows into a deadline
+        #: field, so callers owe a relative value
+        self.param_sinks: Dict[str, Tuple[int, str]] = {}
+        #: submit-frame dict literals with no deadline key
+        self.missing: List[int] = []
+        #: call nodes by position, for arg->param mapping at in-edges
+        self.calls: Dict[Tuple[int, int], ast.Call] = {}
+
+
+class _Dl01:
+
+    def __init__(self, graph: CallGraph):
+        self.g = graph
+        self.facts: Dict[str, _FnFacts] = {}
+
+    # -- provenance classifier --------------------------------------------
+
+    def classify(self, fid: str, e: ast.AST) -> _Prov:
+        g = self.g
+        f = g.funcs[fid]
+        m = g.modules.get(f.path)
+        facts = self.facts[fid]
+        if isinstance(e, ast.Call):
+            d = _dotted(e.func)
+            ext = g.external_name(m, d) if (d and m) else None
+            if ext in _WALL or (ext and ext.startswith("datetime.")
+                                and ext.endswith(_WALL_DT_SUFFIX)):
+                return _Prov(_BAD, f"wall-clock reading `{d}()`")
+            if ext in _MONO:
+                return _Prov(_BAD,
+                             f"absolute monotonic reading `{d}()` "
+                             f"(per-process epoch)")
+            edge = g.edge_at.get(fid, {}).get((e.lineno, e.col_offset))
+            if edge is not None and edge.kind == "call" \
+                    and g.funcs[edge.callee].qual.rsplit(
+                        ".", 1)[-1] in _MONO_QUALS:
+                return _Prov(_BAD,
+                             f"absolute monotonic reading `{d}()` "
+                             f"(per-process epoch)")
+            parts = d.split(".") if d else []
+            if parts and parts[-1] in _OK_METHODS:
+                return _Prov(_OK)
+            if parts and parts[-1] in _COMBINE_FUNCS:
+                p = _Prov(_OK)
+                for a in list(e.args) + [kw.value for kw in e.keywords]:
+                    p = _join(p, self.classify(fid, a))
+                return p
+            return _Prov(_OK)
+        if isinstance(e, ast.BinOp):
+            left = self.classify(fid, e.left)
+            right = self.classify(fid, e.right)
+            if isinstance(e.op, ast.Sub):
+                # differences of monotonic readings are relative; wall
+                # stays bad (two hosts' wall clocks disagree)
+                for p in (left, right):
+                    if p.rank == _BAD and "wall-clock" in p.reason:
+                        return p
+                if _PARAM in (left.rank, right.rank):
+                    return left if left.rank == _PARAM else right
+                return _Prov(_OK)
+            return _join(left, right)
+        if isinstance(e, ast.Name):
+            if e.id in facts.env:
+                return facts.env[e.id]
+            if e.id in f.params():
+                return _Prov(_PARAM, param=e.id)
+            return _Prov(_OK)
+        if isinstance(e, ast.Attribute):
+            d = _dotted(e)
+            if e.attr == "at" and "deadline" in d.lower():
+                return _Prov(_BAD, f"absolute deadline attribute `{d}`")
+            return _Prov(_OK)
+        if isinstance(e, ast.IfExp):
+            return _join(self.classify(fid, e.body),
+                         self.classify(fid, e.orelse))
+        if isinstance(e, ast.BoolOp):
+            p = _Prov(_OK)
+            for v in e.values:
+                p = _join(p, self.classify(fid, v))
+            return p
+        return _Prov(_OK)
+
+    # -- per-function pass ------------------------------------------------
+
+    def _const_key(self, path: str, k: Optional[ast.AST]) -> Optional[str]:
+        if k is None:
+            return None
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            return k.value
+        if isinstance(k, ast.Name):
+            return self.g.module_const(path, k.id)
+        return None
+
+    def _analyze_fn(self, fid: str) -> None:
+        f = self.g.funcs[fid]
+        facts = _FnFacts()
+        self.facts[fid] = facts
+
+        def sink(lineno: int, key: str, value: ast.AST) -> None:
+            p = self.classify(fid, value)
+            if p.rank == _BAD:
+                facts.direct.append((lineno, key, p.reason))
+            elif p.rank == _PARAM and p.param is not None:
+                facts.param_sinks.setdefault(p.param, (lineno, key))
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, _FN) or isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, ast.Call):
+                facts.calls[(node.lineno, node.col_offset)] = node
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    facts.env[tgt.id] = self.classify(fid, node.value)
+                elif isinstance(tgt, ast.Subscript):
+                    k = self._const_key(f.path, tgt.slice)
+                    if k in _DEADLINE_KEYS:
+                        sink(node.lineno, k, node.value)
+            if isinstance(node, ast.Dict):
+                keys = [self._const_key(f.path, k) for k in node.keys]
+                for k, v in zip(keys, node.values):
+                    if k in _DEADLINE_KEYS:
+                        sink(v.lineno, k, v)
+                type_val: Optional[str] = None
+                for k, v in zip(keys, node.values):
+                    if k == "type":
+                        if isinstance(v, ast.Constant) \
+                                and isinstance(v.value, str):
+                            type_val = v.value
+                        elif isinstance(v, ast.Name):
+                            type_val = self.g.module_const(f.path, v.id)
+                if type_val == "submit" \
+                        and not (set(keys) & _DEADLINE_KEYS):
+                    facts.missing.append(node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        # two passes so names assigned textually after first use (loop
+        # bodies) still classify on the re-walk
+        for _ in range(2):
+            facts.direct.clear()
+            facts.param_sinks.clear()
+            facts.missing.clear()
+            facts.calls.clear()
+            for stmt in f.node.body:
+                visit(stmt)
+
+    # -- whole-program ----------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        g = self.g
+        for fid in g.funcs:
+            self._analyze_fn(fid)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str, str]] = set()
+
+        def emit(path: str, lineno: int, key: str, reason: str,
+                 chain: Tuple[str, ...]) -> None:
+            chain_s = " -> ".join(chain)
+            k = (path, chain_s, key)
+            if k in seen:
+                return
+            seen.add(k)
+            findings.append(Finding(
+                RULE, path, lineno,
+                f"non-relative deadline flows into frame field '{key}' "
+                f"via {chain_s}: {reason}; cross-process deadlines must "
+                f"travel as remaining budget "
+                f"(engine.budget.Deadline.remaining)",
+                hint="send deadline.remaining() (or deadline_at - "
+                     "mono_now()) and re-anchor on the receiver's "
+                     "monotonic clock"))
+
+        for fid in sorted(self.facts):
+            f = g.funcs[fid]
+            facts = self.facts[fid]
+            for lineno, key, reason in facts.direct:
+                emit(f.path, lineno, key, reason, (f.label,))
+            for lineno in facts.missing:
+                k = (f.path, f.label, "<missing>")
+                if k in seen:
+                    continue
+                seen.add(k)
+                findings.append(Finding(
+                    RULE, f.path, lineno,
+                    f"submit frame constructed in {f.label} carries no "
+                    f"deadline field: every cross-process send must "
+                    f"carry remaining budget",
+                    hint="add 'deadline-rem-s': deadline.remaining() "
+                         "to the frame"))
+
+        # parameter obligations propagate to callers through in-edges
+        work: List[Tuple[str, str, Tuple[str, ...],
+                         Tuple[int, str]]] = []
+        for fid in sorted(self.facts):
+            for param, at in sorted(self.facts[fid].param_sinks.items()):
+                work.append((fid, param, (g.funcs[fid].label,), at))
+        visited: Set[Tuple[str, str]] = set()
+        while work:
+            fid, param, chain, at = work.pop()
+            if (fid, param) in visited:
+                continue
+            visited.add((fid, param))
+            callee = g.funcs[fid]
+            for e in g.in_edges(fid):
+                if e.kind != "call":
+                    continue
+                cfacts = self.facts.get(e.caller)
+                if cfacts is None:
+                    continue
+                call = cfacts.calls.get((e.lineno, e.col))
+                if call is None:
+                    continue
+                mapped = map_args_to_params(e, call, callee)
+                arg = mapped.get(param)
+                if arg is None:
+                    continue          # default applies: callee's choice
+                caller = g.funcs[e.caller]
+                p = self.classify(e.caller, arg)
+                if p.rank == _BAD:
+                    emit(caller.path, e.lineno, at[1], p.reason,
+                         (caller.label,) + chain)
+                elif p.rank == _PARAM and p.param is not None:
+                    work.append((e.caller, p.param,
+                                 (caller.label,) + chain, at))
+        return findings
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    return _Dl01(graph).run()

@@ -1,0 +1,329 @@
+"""SEC01: the fleet token never reaches an artifact — statically.
+
+serve/auth.py promises "the token never travels and is never logged":
+only the keyed HMAC digest crosses the wire, inside the frame's ``auth``
+envelope field.  Until now that invariant was proven dynamically — the
+fleetport smoke greps every artifact and log for the token.  This rule
+makes it a whole-program static guarantee.
+
+**Sources.**  The return value of ``serve/auth.py::fleet_token`` and any
+direct read of the ``JEPSEN_TPU_FLEET_TOKEN`` env var.  Anything
+HMAC-derived from a tainted value (``hmac.new(token, ...)`` and string
+methods on tainted values) stays tainted: the mac is token *material*
+and is only ever allowed in the ``auth`` field.
+
+**Propagation.**  Through assignments, f-strings/``%``/``+`` string
+building, dict/list/tuple literals, ``self.<attr>`` stores (the attr
+taints class-wide, through subclasses), and call arguments into resolved
+callees — the call-graph edges — with return-taint flowing back.
+Placing a tainted value under the ``auth`` key of a dict (literal or
+subscript store) does NOT taint the dict: that is the one sanctioned
+envelope.  ``bool()/len()/int()`` and friends untaint (existence checks
+like ``auth-enabled`` are legal exports).
+
+**Sinks.**  Logging calls, exception construction (exception text ends
+up in logs and typed ERROR frames), metrics/telemetry emission
+(``record``/``observe``/``set_gauge``/``push``), frame encoding/sends,
+file writes, and tainted returns from snapshot/status-shaped functions.
+
+Finding messages carry the symbol chain from the function that minted
+the taint to the sink — no line numbers — so the baseline ledger keys
+on (rule, path, symbol-chain).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint.callgraph import (CallGraph, map_args_to_params)
+from jepsen_tpu.lint.findings import Finding
+
+RULE = "SEC01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+_TOKEN_ENV = "FLEET_TOKEN"
+_AUTH_KEY = "auth"
+
+_LOG_BASES = {"logging", "logger", "log", "LOG", "_log"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_METRIC_METHODS = {"record", "observe", "set_gauge", "push",
+                   "observe_compile"}
+_FRAME_NAMES = {"encode_frame", "send_frame", "sendall"}
+_WRITE_METHODS = {"write", "writelines"}
+_WRITE_EXT = {"json.dump", "os.write"}
+_STR_FUNCS = {"str", "repr", "format"}
+_UNTAINT = {"bool", "len", "int", "float", "hash", "id", "isinstance",
+            "type", "callable"}
+_SNAPSHOT_RE = re.compile(
+    r"(snapshot|status|healthz|payload|to_dict|to_wire|metrics)", re.I)
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+class _Sec01:
+    """The global fixpoint: token-returning functions and tainted class
+    attributes grow monotonically; per-(function, tainted-params)
+    analyses are memoized within each iteration."""
+
+    MAX_ITERS = 8
+
+    def __init__(self, graph: CallGraph):
+        self.g = graph
+        self.token_fns: Set[str] = set()
+        self.tainted_attrs: Set[Tuple[str, str]] = set()
+        self.memo: Dict[Tuple[str, FrozenSet[str]], bool] = {}
+        self.findings: Dict[Tuple, Finding] = {}
+        self._grew = False
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        src = self.g.find("serve/auth.py", "fleet_token")
+        if src is not None:
+            self.token_fns.add(src.id)
+        for _ in range(self.MAX_ITERS):
+            self.memo.clear()
+            self.findings.clear()
+            self._grew = False
+            for fid in sorted(self.g.funcs):
+                ret = self._analyze(fid, frozenset(), ())
+                if ret and fid not in self.token_fns:
+                    self.token_fns.add(fid)
+                    self._grew = True
+            if not self._grew:
+                break
+        return sorted(self.findings.values(),
+                      key=lambda f: (f.path, f.line, f.message))
+
+    # -- helpers -----------------------------------------------------------
+
+    def _const_key(self, path: str, key: Optional[ast.AST]) -> Optional[str]:
+        if key is None:
+            return None
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return key.value
+        if isinstance(key, ast.Name):
+            return self.g.module_const(path, key.id)
+        return None
+
+    def _emit(self, fam: str, path: str, lineno: int,
+              chain: Tuple[str, ...]) -> None:
+        chain_s = " -> ".join(chain)
+        key = (fam, path, chain_s)
+        if key in self.findings:
+            return
+        self.findings[key] = Finding(
+            RULE, path, lineno,
+            f"fleet-token material may reach a {fam} sink via {chain_s}: "
+            f"the token (and anything HMAC-derived from it) may only "
+            f"appear in a frame's 'auth' envelope field",
+            hint="export at most `auth-enabled: bool(token)`; strip the "
+                 "token before the value reaches logs, errors, metrics, "
+                 "frames, or files")
+
+    # -- per-function analysis --------------------------------------------
+
+    def _analyze(self, fid: str, params: FrozenSet[str],
+                 stack: Tuple[str, ...]) -> bool:
+        key = (fid, params)
+        if key in self.memo:
+            return self.memo[key]
+        if fid in stack:
+            return False                 # cycle: converges via iterations
+        f = self.g.funcs[fid]
+        m = self.g.modules.get(f.path)
+        if m is None:                    # pragma: no cover - defensive
+            return False
+        stack = stack + (fid,)
+        chain = tuple(self.g.funcs[s].label for s in stack)
+        tainted: Set[str] = set(params)
+        ret_tainted = False
+        edge_at = self.g.edge_at.get(fid, {})
+
+        def is_tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if isinstance(e.value, ast.Name) and e.value.id == "self" \
+                        and f.cls:
+                    return self.g.class_attr_taintable(
+                        f.cls, e.attr, self.tainted_attrs)
+                return is_tainted(e.value)
+            if isinstance(e, ast.Call):
+                return call_taint(e)
+            if isinstance(e, ast.JoinedStr):
+                return any(is_tainted(v.value) for v in e.values
+                           if isinstance(v, ast.FormattedValue))
+            if isinstance(e, ast.FormattedValue):
+                return is_tainted(e.value)
+            if isinstance(e, ast.BinOp):
+                return is_tainted(e.left) or is_tainted(e.right)
+            if isinstance(e, ast.BoolOp):
+                return any(is_tainted(v) for v in e.values)
+            if isinstance(e, ast.IfExp):
+                return is_tainted(e.body) or is_tainted(e.orelse)
+            if isinstance(e, ast.Dict):
+                return any(
+                    is_tainted(v) for k, v in zip(e.keys, e.values)
+                    if self._const_key(f.path, k) != _AUTH_KEY)
+            if isinstance(e, (ast.List, ast.Tuple, ast.Set)):
+                return any(is_tainted(v) for v in e.elts)
+            if isinstance(e, ast.Subscript):
+                return is_tainted(e.value)
+            if isinstance(e, ast.Starred):
+                return is_tainted(e.value)
+            if isinstance(e, ast.NamedExpr):
+                return is_tainted(e.value)
+            if isinstance(e, ast.Await):
+                return is_tainted(e.value)
+            return False
+
+        def env_token_read(call: ast.Call) -> bool:
+            ext = self.g.external_name(m, _dotted(call.func)) or ""
+            if ext not in ("os.environ.get", "os.getenv"):
+                return False
+            if not call.args:
+                return False
+            k = call.args[0]
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                return _TOKEN_ENV in k.value
+            if isinstance(k, ast.Name):
+                v = self.g.module_const(f.path, k.id)
+                return v is not None and _TOKEN_ENV in v
+            return False
+
+        def sink_family(call: ast.Call, d: str,
+                        ext: Optional[str]) -> Optional[str]:
+            parts = d.split(".") if d else []
+            last = parts[-1] if parts else ""
+            if (ext or "").split(".")[0] == "logging" \
+                    or d == "print" or ext in ("print", "warnings.warn") \
+                    or (len(parts) >= 2 and parts[0] in _LOG_BASES
+                        and last in _LOG_METHODS):
+                return "logging"
+            if last in _WRITE_METHODS or ext in _WRITE_EXT:
+                return "file-write"
+            if last in _METRIC_METHODS:
+                return "metrics/telemetry"
+            if last in _FRAME_NAMES:
+                return "frame"
+            if re.search(r"(Error|Exception)$", last or ""):
+                return "exception"
+            return None
+
+        def call_taint(call: ast.Call) -> bool:
+            d = _dotted(call.func)
+            ext = self.g.external_name(m, d) if d else None
+            if env_token_read(call):
+                return True
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            any_taint = any(is_tainted(a) for a in args)
+            if any_taint:
+                fam = sink_family(call, d, ext)
+                if fam is not None:
+                    self._emit(fam, f.path, call.lineno, chain)
+            edge = edge_at.get((call.lineno, call.col_offset))
+            sub_ret = False
+            if edge is not None and edge.kind == "call":
+                callee = self.g.funcs[edge.callee]
+                if any_taint:
+                    mapped = map_args_to_params(edge, call, callee)
+                    tp = frozenset(p for p, ex in mapped.items()
+                                   if is_tainted(ex))
+                    if tp:
+                        sub_ret = self._analyze(callee.id, tp, stack)
+                if edge.callee in self.token_fns:
+                    return True
+                return sub_ret
+            if ext is not None:
+                if ext in _UNTAINT:
+                    return False
+                if ext.startswith("hmac.new") and any_taint:
+                    return True
+                if ext in _STR_FUNCS and any_taint:
+                    return True
+            # a method invoked on a tainted object yields token material
+            # (.encode/.strip/.hexdigest/.format/...)
+            if isinstance(call.func, ast.Attribute) \
+                    and is_tainted(call.func.value):
+                return True
+            return False
+
+        def store(target: ast.AST, value_tainted: bool) -> None:
+            if not value_tainted:
+                return
+            if isinstance(target, ast.Name):
+                tainted.add(target.id)
+            elif isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" and f.cls:
+                if (f.cls, target.attr) not in self.tainted_attrs:
+                    self.tainted_attrs.add((f.cls, target.attr))
+                    self._grew = True
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for el in target.elts:
+                    store(el, value_tainted)
+
+        def visit(node: ast.AST) -> None:
+            nonlocal ret_tainted
+            if isinstance(node, _FN) or isinstance(node, ast.Lambda):
+                return                   # separate graph node
+            if isinstance(node, ast.Assign):
+                t = is_tainted(node.value)
+                for tg in node.targets:
+                    if isinstance(tg, ast.Subscript):
+                        k = self._const_key(
+                            f.path, tg.slice
+                            if not isinstance(tg.slice, ast.Tuple)
+                            else None)
+                        if t and k != _AUTH_KEY:
+                            store(tg.value, True)
+                    else:
+                        store(tg, t)
+            elif isinstance(node, ast.AugAssign):
+                if is_tainted(node.value):
+                    store(node.target, True)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                store(node.target, is_tainted(node.value))
+            elif isinstance(node, ast.Return) and node.value is not None:
+                if is_tainted(node.value):
+                    ret_tainted = True
+                    if _SNAPSHOT_RE.search(f.qual.rsplit(".", 1)[-1]):
+                        self._emit("snapshot-payload", f.path,
+                                   node.lineno, chain)
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                if isinstance(node.exc, ast.Call):
+                    args = (list(node.exc.args)
+                            + [kw.value for kw in node.exc.keywords])
+                    if any(is_tainted(a) for a in args):
+                        self._emit("exception", f.path, node.exc.lineno,
+                                   chain)
+            elif isinstance(node, ast.Call):
+                call_taint(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        # two passes: taint assigned late in a loop body reaches uses
+        # earlier in the (next) iteration
+        for _ in range(2):
+            for stmt in f.node.body:
+                visit(stmt)
+        self.memo[key] = ret_tainted
+        return ret_tainted
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    return _Sec01(graph).run()

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
-"""Run the jepsen_tpu static analyzer (both tiers) and gate CI.
+"""Run the jepsen_tpu static analyzer (all tiers) and gate CI.
 
 Exit status: 0 when every finding is baselined (or there are none),
 1 when any new finding exists, 2 on analyzer self-failure.
 
   python scripts/lint.py                    # human-readable report
   python scripts/lint.py --format json      # machine-readable (CI artifact)
-  python scripts/lint.py --no-trace         # AST tier only (fast)
+  python scripts/lint.py --format sarif     # GitHub code scanning upload
+  python scripts/lint.py --no-trace         # skip the slow jaxpr tier
+  python scripts/lint.py --rule CONC02,SEC01  # just these rules (fast
+                                            # local iteration; only the
+                                            # tiers they live in run)
+  python scripts/lint.py --dump-callgraph /tmp/cg.json  # archive the
+                                            # interprocedural call graph
   python scripts/lint.py --update-baseline  # accept current findings
 
 The baseline is a ledger, not a dumping ground: --update-baseline
@@ -25,12 +31,22 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+_TRACE_RULES = {"TRACE01", "TRACE02"}
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--no-trace", action="store_true",
-                    help="skip the jaxpr trace tier (AST rules only)")
+                    help="skip the jaxpr trace tier (AST + interp only)")
+    ap.add_argument("--rule", default=None,
+                    help="comma-separated rule ids to run (e.g. "
+                         "CONC02,SEC01); tiers with no selected rule "
+                         "are skipped entirely")
+    ap.add_argument("--dump-callgraph", default=None, metavar="PATH",
+                    help="write the interprocedural call-graph dump "
+                         "(JSON) to PATH for offline queries")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite baseline.json to accept current findings")
     ap.add_argument("--justification", default=None,
@@ -39,10 +55,44 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    selected = (None if args.rule is None else
+                {r.strip().upper() for r in args.rule.split(",")
+                 if r.strip()})
     try:
-        from jepsen_tpu.lint import Baseline, run_all
+        from jepsen_tpu.lint import Baseline, to_sarif
+        from jepsen_tpu.lint.ast_lint import run_ast_tier
         from jepsen_tpu.lint.findings import BASELINE_PATH
-        findings = run_all(trace=not args.no_trace)
+        from jepsen_tpu.lint.interp_lint import run_interp_tier
+        from jepsen_tpu.lint.rules import all_rules, interp_rules
+
+        ast_sel = [r for r in all_rules()
+                   if selected is None or r.RULE in selected]
+        interp_sel = [r for r in interp_rules()
+                      if selected is None or r.RULE in selected]
+        want_trace = (not args.no_trace
+                      and (selected is None or selected & _TRACE_RULES))
+
+        findings = []
+        if ast_sel:
+            ast_findings, _ = run_ast_tier()
+            findings.extend(
+                f for f in ast_findings
+                if selected is None or f.rule in selected
+                or f.rule == "PARSE")
+        if interp_sel or args.dump_callgraph:
+            interp_findings, graph = run_interp_tier(rules=interp_sel)
+            findings.extend(interp_findings)
+            if args.dump_callgraph:
+                with open(args.dump_callgraph, "w") as fh:
+                    json.dump(graph.to_dict(), fh, indent=1)
+                print(f"lint: call graph -> {args.dump_callgraph}",
+                      file=sys.stderr)
+        if want_trace:
+            from jepsen_tpu.lint.jaxpr_lint import run_trace_tier
+            findings.extend(
+                f for f in run_trace_tier()
+                if selected is None or f.rule in selected)
+        findings = Baseline.load().mark(findings)
     except Exception as e:  # noqa: BLE001 — analyzer breakage must be loud
         print(f"lint: analyzer failed: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -51,6 +101,11 @@ def main(argv=None) -> int:
     if args.update_baseline:
         if not args.justification:
             print("lint: --update-baseline requires --justification",
+                  file=sys.stderr)
+            return 2
+        if selected is not None:
+            print("lint: refusing --update-baseline with --rule: the "
+                  "ledger must be rewritten from a full run",
                   file=sys.stderr)
             return 2
         Baseline.write(findings, BASELINE_PATH,
@@ -68,6 +123,8 @@ def main(argv=None) -> int:
             "baselined": [f.to_dict() for f in old],
             "ok": not new,
         }, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2))
     else:
         for f in findings:
             print(f.render())

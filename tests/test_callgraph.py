@@ -1,0 +1,271 @@
+"""Unit suite for the interprocedural call graph (lint/callgraph.py).
+
+The graph is the substrate under CONC02/SEC01/DL01, so its resolution
+contract is pinned here directly: direct calls through import and
+re-export chains, method calls via self / MRO / constructor-typed
+attributes and locals, thread-entry seams as ``kind="thread"`` edges,
+and — the conservatism contract — every call it cannot resolve lands in
+the per-function ``unresolved`` ledger instead of vanishing.  The rules
+over-approximate reachability (every resolved edge is assumed feasible)
+and the dump makes the under-approximation auditable; neither happens
+silently.
+"""
+
+import textwrap
+
+from jepsen_tpu.lint.callgraph import build_graph, map_args_to_params
+
+
+def g(files):
+    return build_graph({p: textwrap.dedent(s) for p, s in files.items()})
+
+
+def edge_pairs(graph, kind=None):
+    return {(e.caller, e.callee)
+            for edges in graph.out.values() for e in edges
+            if kind is None or e.kind == kind}
+
+
+class TestDirectCalls:
+    def test_module_function_call(self):
+        gr = g({"jepsen_tpu/a.py": """
+            def helper():
+                pass
+            def top():
+                helper()
+            """})
+        assert ("jepsen_tpu/a.py::top",
+                "jepsen_tpu/a.py::helper") in edge_pairs(gr)
+
+    def test_from_import_call(self):
+        gr = g({
+            "jepsen_tpu/a.py": "def helper():\n    pass\n",
+            "jepsen_tpu/b.py": ("from jepsen_tpu.a import helper\n"
+                                "def top():\n    helper()\n"),
+        })
+        assert ("jepsen_tpu/b.py::top",
+                "jepsen_tpu/a.py::helper") in edge_pairs(gr)
+
+    def test_reexport_chain(self):
+        """from pkg import f where pkg/__init__ re-exports pkg.impl.f."""
+        gr = g({
+            "jepsen_tpu/pkg/__init__.py":
+                "from jepsen_tpu.pkg.impl import f\n",
+            "jepsen_tpu/pkg/impl.py": "def f():\n    pass\n",
+            "jepsen_tpu/use.py": ("from jepsen_tpu.pkg import f\n"
+                                  "def top():\n    f()\n"),
+        })
+        assert ("jepsen_tpu/use.py::top",
+                "jepsen_tpu/pkg/impl.py::f") in edge_pairs(gr)
+
+    def test_dotted_module_call(self):
+        gr = g({
+            "jepsen_tpu/a.py": "def helper():\n    pass\n",
+            "jepsen_tpu/b.py": ("import jepsen_tpu.a\n"
+                                "def top():\n    jepsen_tpu.a.helper()\n"),
+        })
+        assert ("jepsen_tpu/b.py::top",
+                "jepsen_tpu/a.py::helper") in edge_pairs(gr)
+
+    def test_nested_def_call(self):
+        gr = g({"jepsen_tpu/a.py": """
+            def top():
+                def inner():
+                    pass
+                inner()
+            """})
+        assert ("jepsen_tpu/a.py::top",
+                "jepsen_tpu/a.py::top.inner") in edge_pairs(gr)
+
+
+class TestMethodResolution:
+    SRC = {
+        "jepsen_tpu/m.py": """
+            class Base:
+                def shared(self):
+                    pass
+            class C(Base):
+                def __init__(self):
+                    self.helper = H()
+                def run(self):
+                    self.step()
+                    self.shared()
+                    self.helper.poke()
+                def step(self):
+                    super().shared()
+            class H:
+                def poke(self):
+                    pass
+            def make():
+                c = C()
+                c.run()
+            """,
+    }
+
+    def test_self_method(self):
+        pairs = edge_pairs(g(self.SRC))
+        assert ("jepsen_tpu/m.py::C.run",
+                "jepsen_tpu/m.py::C.step") in pairs
+
+    def test_inherited_method_via_mro(self):
+        pairs = edge_pairs(g(self.SRC))
+        assert ("jepsen_tpu/m.py::C.run",
+                "jepsen_tpu/m.py::Base.shared") in pairs
+
+    def test_super_call(self):
+        pairs = edge_pairs(g(self.SRC))
+        assert ("jepsen_tpu/m.py::C.step",
+                "jepsen_tpu/m.py::Base.shared") in pairs
+
+    def test_attr_ctor_typing(self):
+        pairs = edge_pairs(g(self.SRC))
+        assert ("jepsen_tpu/m.py::C.run",
+                "jepsen_tpu/m.py::H.poke") in pairs
+
+    def test_constructor_edge_and_local_var_typing(self):
+        pairs = edge_pairs(g(self.SRC))
+        assert ("jepsen_tpu/m.py::make",
+                "jepsen_tpu/m.py::C.__init__") in pairs
+        assert ("jepsen_tpu/m.py::make",
+                "jepsen_tpu/m.py::C.run") in pairs
+
+
+class TestThreadSeams:
+    def test_thread_target_is_thread_edge(self):
+        gr = g({"jepsen_tpu/t.py": """
+            import threading
+            class Loop:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+                def _run(self):
+                    pass
+            """})
+        assert ("jepsen_tpu/t.py::Loop.start",
+                "jepsen_tpu/t.py::Loop._run") in edge_pairs(
+                    gr, kind="thread")
+        assert ("jepsen_tpu/t.py::Loop.start",
+                "jepsen_tpu/t.py::Loop._run") not in edge_pairs(
+                    gr, kind="call")
+
+    def test_aliased_thread_import(self):
+        gr = g({"jepsen_tpu/t.py": """
+            import threading as th
+            def run():
+                pass
+            def start():
+                th.Thread(target=run).start()
+            """})
+        assert ("jepsen_tpu/t.py::start",
+                "jepsen_tpu/t.py::run") in edge_pairs(gr, kind="thread")
+
+
+class TestConservatism:
+    def test_unresolvable_call_lands_in_ledger(self):
+        """Dynamic dispatch is never silently skipped: the call graph
+        over-approximates via edges and documents what it could NOT
+        resolve in the unresolved ledger."""
+        gr = g({"jepsen_tpu/u.py": """
+            def top(cb, table):
+                cb()
+                table["k"]()
+                obj.unknown_method()
+            """})
+        unres = gr.unresolved["jepsen_tpu/u.py::top"]
+        names = [c for c, _ in unres]
+        assert "cb" in names
+        assert "obj.unknown_method" in names
+        # every entry carries a line for offline audit
+        assert all(isinstance(ln, int) and ln > 0 for _, ln in unres)
+
+    def test_known_externals_are_not_noise(self):
+        gr = g({"jepsen_tpu/u.py": """
+            import time, logging
+            def top():
+                time.sleep(1)
+                logging.getLogger(__name__)
+                len([])
+            """})
+        assert gr.unresolved["jepsen_tpu/u.py::top"] == []
+
+    def test_unparseable_file_skipped_not_fatal(self):
+        gr = g({
+            "jepsen_tpu/bad.py": "def broken(:\n",
+            "jepsen_tpu/ok.py": "def f():\n    pass\n",
+        })
+        assert "jepsen_tpu/ok.py::f" in gr.funcs
+        assert "jepsen_tpu/bad.py" not in gr.modules
+
+
+class TestQueries:
+    def test_labels_are_line_free(self):
+        gr = g({"jepsen_tpu/serve/x.py": """
+            class C:
+                def m(self):
+                    pass
+            """})
+        f = gr.find("serve/x.py", "C.m")
+        assert f is not None
+        assert f.label == "x.py::C.m"
+
+    def test_external_name_canonicalizes_alias(self):
+        gr = g({"jepsen_tpu/x.py": """
+            import logging as log
+            def f():
+                log.warning("x")
+            """})
+        m = gr.modules["jepsen_tpu/x.py"]
+        assert gr.external_name(m, "log.warning") == "logging.warning"
+
+    def test_module_const(self):
+        gr = g({"jepsen_tpu/x.py": 'AUTH_FIELD = "auth"\n'})
+        assert gr.module_const("jepsen_tpu/x.py", "AUTH_FIELD") == "auth"
+
+    def test_in_edges(self):
+        gr = g({"jepsen_tpu/x.py": """
+            def helper():
+                pass
+            def a():
+                helper()
+            def b():
+                helper()
+            """})
+        callers = {e.caller for e in gr.in_edges("jepsen_tpu/x.py::helper")}
+        assert callers == {"jepsen_tpu/x.py::a", "jepsen_tpu/x.py::b"}
+
+    def test_to_dict_dump_shape(self):
+        gr = g({"jepsen_tpu/x.py": """
+            def helper():
+                pass
+            def top(cb):
+                helper()
+                cb()
+            """})
+        d = gr.to_dict()
+        top = d["functions"]["jepsen_tpu/x.py::top"]
+        assert top["calls"][0]["callee"] == "jepsen_tpu/x.py::helper"
+        assert top["unresolved"][0]["call"] == "cb"
+
+
+class TestArgMapping:
+    def test_bound_call_skips_receiver(self):
+        gr = g({"jepsen_tpu/x.py": """
+            class C:
+                def m(self, a, b=1, *, c=2):
+                    pass
+            def top():
+                obj = C()
+                obj.m(10, c=30)
+            """})
+        top = "jepsen_tpu/x.py::top"
+        callee = gr.find("x.py", "C.m")
+        edge = next(e for e in gr.out[top] if e.callee == callee.id)
+        import ast as _ast
+        call = next(
+            n for n in _ast.walk(gr.funcs[top].node)
+            if isinstance(n, _ast.Call)
+            and (n.lineno, n.col_offset) == (edge.lineno, edge.col))
+        mapped = map_args_to_params(edge, call, callee)
+        assert set(mapped) == {"a", "c"}
+        assert mapped["a"].value == 10
+        assert mapped["c"].value == 30

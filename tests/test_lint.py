@@ -11,14 +11,18 @@ contract is fixed findings, not baselined ones.
 
 import ast
 import json
+import re
 import textwrap
+import time
 
 import pytest
 
 from jepsen_tpu.lint.ast_lint import run_ast_tier
 from jepsen_tpu.lint.findings import (Baseline, Finding, apply_pragmas,
-                                      pragma_rules)
-from jepsen_tpu.lint.rules import conc01, dev01, shape01, sound01
+                                      pragma_rules, to_sarif)
+from jepsen_tpu.lint.interp_lint import run_interp_tier
+from jepsen_tpu.lint.rules import (conc01, conc02, dev01, dl01, sec01,
+                                   shape01, sound01)
 
 
 def run_rule(rule, src, path):
@@ -608,6 +612,322 @@ class TestTraceTier:
 
 
 # ---------------------------------------------------------------------------
+# interprocedural tier: CONC02 / SEC01 / DL01 fixture pairs
+# ---------------------------------------------------------------------------
+
+def run_interp(files, rules=None):
+    files = {p: textwrap.dedent(s) for p, s in files.items()}
+    findings, _ = run_interp_tier(files=files, rules=rules)
+    return findings
+
+
+class TestConc02:
+    #: the PR 14 pair: a registry-lock holder calling into a fleet-lock
+    #: acquirer — invisible to CONC01 (two functions), caught by CONC02
+    INVERSION = {
+        "jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def poke(self):
+                    with self._lock:
+                        pass
+            """,
+        "jepsen_tpu/serve/registry.py": """
+            import threading
+            from jepsen_tpu.serve.fleet import Fleet
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.fleet = Fleet()
+                def bad(self):
+                    with self._lock:
+                        self.fleet.poke()
+            """,
+    }
+
+    def test_cross_function_inversion_caught(self):
+        fs = [f for f in run_interp(self.INVERSION, rules=[conc02])
+              if "inversion" in f.message]
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "CONC02"
+        assert f.path == "jepsen_tpu/serve/registry.py"
+        assert "registry.py::Registry.bad -> fleet.py::Fleet.poke" \
+            in f.message
+        assert "'fleet'" in f.message and "'fleet-registry'" in f.message
+
+    def test_conc01_cannot_see_it(self):
+        src = textwrap.dedent(
+            self.INVERSION["jepsen_tpu/serve/registry.py"])
+        fs = run_rule(conc01, src, "jepsen_tpu/serve/registry.py")
+        assert [f for f in fs if "order" in f.message] == []
+
+    def test_manifest_order_negative(self):
+        files = {
+            "jepsen_tpu/serve/fleet.py": """
+                import threading
+                from jepsen_tpu.serve.registry import Registry
+                class Fleet:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.reg = Registry()
+                    def ok(self):
+                        with self._lock:
+                            self.reg.bind()
+                """,
+            "jepsen_tpu/serve/registry.py": """
+                import threading
+                class Registry:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                    def bind(self):
+                        with self._lock:
+                            pass
+                """,
+        }
+        fs = [f for f in run_interp(files, rules=[conc02])
+              if "inversion" in f.message]
+        assert fs == []
+
+    def test_thread_seam_does_not_propagate(self):
+        """Spawning a thread under a lock is not an inversion: the
+        target runs on a fresh stack without the spawner's locks."""
+        files = dict(self.INVERSION)
+        files["jepsen_tpu/serve/registry.py"] = """
+            import threading
+            from jepsen_tpu.serve.fleet import Fleet
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.fleet = Fleet()
+                def spawn(self):
+                    with self._lock:
+                        threading.Thread(target=self.fleet.poke).start()
+            """
+        fs = [f for f in run_interp(files, rules=[conc02])
+              if "inversion" in f.message]
+        assert fs == []
+
+    def test_interprocedural_message_is_line_free(self):
+        fs = [f for f in run_interp(self.INVERSION, rules=[conc02])
+              if "inversion" in f.message]
+        assert not re.search(r"\d+:\d+|line \d+", fs[0].message)
+
+    def test_undeclared_lock_drift_flagged(self):
+        files = {"jepsen_tpu/serve/widget.py": """
+            import threading
+            class Widget:
+                def __init__(self):
+                    self._zlock = threading.Lock()
+            """}
+        fs = run_interp(files, rules=[conc02])
+        assert len(fs) == 1
+        assert "undeclared lock `self._zlock`" in fs[0].message
+        assert "Widget.__init__" in fs[0].message
+
+    def test_drift_pragma_suppresses(self):
+        files = {"jepsen_tpu/serve/widget.py": """
+            import threading
+            class Widget:
+                def __init__(self):
+                    # lint: disable=CONC02(leaf lock, never nested)
+                    self._zlock = threading.Lock()
+            """}
+        assert run_interp(files, rules=[conc02]) == []
+
+    def test_drift_out_of_scope_tree_ignored(self):
+        files = {"jepsen_tpu/engine/widget.py": """
+            import threading
+            class Widget:
+                def __init__(self):
+                    self._zlock = threading.Lock()
+            """}
+        assert run_interp(files, rules=[conc02]) == []
+
+
+class TestSec01:
+    AUTH = {
+        "jepsen_tpu/serve/auth.py": """
+            import os
+            TOKEN_ENV = "JEPSEN_TPU_FLEET_TOKEN"
+            AUTH_FIELD = "auth"
+            def fleet_token():
+                return os.environ.get(TOKEN_ENV, "") or None
+            """,
+    }
+
+    def test_token_through_helper_into_log_caught(self):
+        files = dict(self.AUTH)
+        files["jepsen_tpu/serve/boot.py"] = """
+            import logging
+            from jepsen_tpu.serve.auth import fleet_token
+            log = logging.getLogger(__name__)
+            def _banner(tok):
+                log.info("fleet token in use: %s", tok)
+            def boot():
+                _banner(fleet_token())
+            """
+        fs = run_interp(files, rules=[sec01])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "SEC01"
+        assert "logging sink" in f.message
+        assert "boot.py::boot -> boot.py::_banner" in f.message
+
+    def test_auth_envelope_negative(self):
+        files = dict(self.AUTH)
+        files["jepsen_tpu/serve/sign.py"] = """
+            import hashlib
+            import hmac
+            from jepsen_tpu.serve.auth import AUTH_FIELD, fleet_token
+            def sign(frame):
+                tok = fleet_token()
+                mac = hmac.new(tok.encode(), b"payload",
+                               hashlib.sha256).hexdigest()
+                frame[AUTH_FIELD] = mac
+                return frame
+            """
+        assert run_interp(files, rules=[sec01]) == []
+
+    def test_hmac_outside_envelope_caught(self):
+        """The mac is token material: placing it under any key but
+        ``auth`` is a leak."""
+        files = dict(self.AUTH)
+        files["jepsen_tpu/serve/sign.py"] = """
+            import hashlib
+            import hmac
+            def status_snapshot():
+                from jepsen_tpu.serve.auth import fleet_token
+                tok = fleet_token()
+                mac = hmac.new(tok.encode(), b"p",
+                               hashlib.sha256).hexdigest()
+                return {"type": "status", "mac-debug": mac}
+            """
+        fs = run_interp(files, rules=[sec01])
+        assert len(fs) == 1
+        assert "snapshot-payload sink" in fs[0].message
+        assert "sign.py::status_snapshot" in fs[0].message
+
+    def test_class_attr_token_into_exception_caught(self):
+        files = dict(self.AUTH)
+        files["jepsen_tpu/serve/cli.py"] = """
+            from jepsen_tpu.serve.auth import fleet_token
+            class Client:
+                def __init__(self):
+                    self._token = fleet_token()
+                def fail(self):
+                    raise RuntimeError(f"auth rejected: {self._token}")
+            """
+        fs = run_interp(files, rules=[sec01])
+        assert len(fs) == 1
+        assert "exception sink" in fs[0].message
+        assert "cli.py::Client.fail" in fs[0].message
+
+    def test_existence_check_negative(self):
+        files = dict(self.AUTH)
+        files["jepsen_tpu/serve/cli.py"] = """
+            import logging
+            from jepsen_tpu.serve.auth import fleet_token
+            log = logging.getLogger(__name__)
+            def boot():
+                log.info("auth enabled: %s", bool(fleet_token()))
+            """
+        assert run_interp(files, rules=[sec01]) == []
+
+
+class TestDl01:
+    def test_wall_clock_into_frame_caught(self):
+        fs = run_interp({"jepsen_tpu/serve/tx.py": """
+            import time
+            def send(sock):
+                frame = {"type": "submit", "id": 1,
+                         "deadline-rem-s": time.time() + 30.0}
+                sock.sendall(frame)
+            """}, rules=[dl01])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "DL01"
+        assert "wall-clock reading `time.time()`" in f.message
+        assert "tx.py::send" in f.message
+
+    def test_remaining_budget_negative(self):
+        assert run_interp({"jepsen_tpu/serve/tx.py": """
+            def send(sock, deadline):
+                frame = {"type": "submit", "id": 1,
+                         "deadline-rem-s": deadline.remaining()}
+                sock.sendall(frame)
+            """}, rules=[dl01]) == []
+
+    def test_wall_clock_two_frames_up_caught(self):
+        fs = run_interp({"jepsen_tpu/serve/tx.py": """
+            import time
+            def build(deadline_s):
+                return {"type": "submit", "id": 1,
+                        "deadline-rem-s": deadline_s}
+            def mid(d):
+                return build(d)
+            def top():
+                return mid(time.time())
+            """}, rules=[dl01])
+        assert len(fs) == 1
+        assert "tx.py::top -> tx.py::mid -> tx.py::build" in fs[0].message
+
+    def test_bare_monotonic_caught_difference_negative(self):
+        fs = run_interp({"jepsen_tpu/serve/tx.py": """
+            import time
+            def bad(sock):
+                frame = {"type": "submit", "id": 1,
+                         "deadline-rem-s": time.monotonic() + 5}
+                sock.sendall(frame)
+            def good(sock, deadline_at):
+                frame = {"type": "submit", "id": 2,
+                         "deadline-rem-s": deadline_at - time.monotonic()}
+                sock.sendall(frame)
+            """}, rules=[dl01])
+        assert len(fs) == 1
+        assert "absolute monotonic" in fs[0].message
+        assert "tx.py::bad" in fs[0].message
+
+    def test_submit_frame_without_deadline_caught(self):
+        fs = run_interp({"jepsen_tpu/serve/tx.py": """
+            def send(sock):
+                frame = {"type": "submit", "id": 1}
+                sock.sendall(frame)
+            """}, rules=[dl01])
+        assert len(fs) == 1
+        assert "carries no deadline field" in fs[0].message
+
+    def test_non_submit_frame_needs_no_deadline(self):
+        assert run_interp({"jepsen_tpu/serve/tx.py": """
+            def send(sock):
+                frame = {"type": "register", "worker": "w0"}
+                sock.sendall(frame)
+            """}, rules=[dl01]) == []
+
+
+class TestSarif:
+    def test_sarif_fingerprints_match_baseline_keys(self):
+        fs = [Finding("SEC01", "jepsen_tpu/serve/x.py", 3, "msg",
+                      hint="h"),
+              Finding("DL01", "jepsen_tpu/serve/y.py", 0, "msg2",
+                      baselined=True)]
+        doc = to_sarif(fs)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+            "DL01", "SEC01"]
+        r0, r1 = run["results"]
+        assert r0["level"] == "error" and r1["level"] == "note"
+        assert r0["partialFingerprints"]["jepsenTpuLint/v1"] == \
+            "SEC01|jepsen_tpu/serve/x.py|msg"
+        # SARIF regions are 1-based even when the finding is file-level
+        assert r1["locations"][0]["physicalLocation"]["region"][
+            "startLine"] == 1
+
+
+# ---------------------------------------------------------------------------
 # the repo itself
 # ---------------------------------------------------------------------------
 
@@ -615,6 +935,24 @@ class TestRepoIsClean:
     def test_ast_tier_clean_on_repo(self):
         findings, _ = run_ast_tier()
         assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+    def test_interp_tier_clean_on_repo_within_budget(self):
+        """The whole interprocedural tier — graph build plus all three
+        rules — must stay clean AND inside the CI wall-time budget
+        (<60 s on a 1-core runner; we assert a third of that here to
+        leave headroom)."""
+        start = time.monotonic()
+        findings, graph = run_interp_tier()
+        elapsed = time.monotonic() - start
+        assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+        assert elapsed < 20.0, (
+            f"interp tier took {elapsed:.1f}s locally; the CI budget "
+            f"is 60s on a slower runner")
+        # the graph actually covered the repo (guards against a
+        # discovery regression silently analyzing nothing)
+        assert len(graph.funcs) > 1000
+        assert any(e.kind == "thread"
+                   for es in graph.out.values() for e in es)
 
     def test_baseline_is_empty(self):
         assert Baseline.load().entries == [], (
