@@ -187,6 +187,14 @@ def single_test_cmd(test_fn: Callable[[Dict[str, Any]], Dict[str, Any]],
                          "splits per key")
     pq.add_argument("--deadline", type=float, default=None,
                     help="per-request deadline in seconds")
+    pq.add_argument("--tenant", default=None,
+                    help="attribute the request to this tenant (quota, "
+                         "priority, per-tenant SLO cut)")
+    pq.add_argument("--tenant-token", default=None,
+                    help="tenant auth token, sent as X-Tenant-Token; "
+                         "defaults to JEPSEN_TPU_TENANT_TOKEN from the "
+                         "environment (prefer the env — argv leaks into "
+                         "process listings)")
 
     ptr = sub.add_parser("trace",
                          help="fetch a request's merged distributed trace "
@@ -340,10 +348,17 @@ def submit_cmd(args) -> int:
         body["workload"] = args.workload
     if args.deadline is not None:
         body["deadline_s"] = args.deadline
+    headers = {"Content-Type": "application/json"}
+    if args.tenant is not None:
+        body["tenant"] = args.tenant
+        token = args.tenant_token \
+            or os.environ.get("JEPSEN_TPU_TENANT_TOKEN", "")
+        if token:
+            headers["X-Tenant-Token"] = token
     req = urllib.request.Request(
         args.url.rstrip("/") + "/submit",
         data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers=headers, method="POST")
     with urllib.request.urlopen(req) as resp:
         results = json.loads(resp.read())
     print(json.dumps(results, indent=2, default=str))

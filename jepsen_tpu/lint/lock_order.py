@@ -16,11 +16,12 @@ the tuple (earlier = outermost-permitted).
 
 The declared order mirrors the call graph today:
 
-    fleet-supervisor -> fleet -> fleet-registry -> fleet-slot
+    fleet-supervisor -> autoscale -> fleet -> fleet-registry
+      -> fleet-slot
       -> fleet-journal-write -> fleet-journal-pending
       -> transport-ready -> transport-state -> transport-send
       -> procworker-state -> procworker-send
-      -> service -> scheduler -> request -> metrics
+      -> service -> scheduler -> request -> metrics -> tenants
     router (leaf: breaker/health state, never wraps another lock)
     monitor-flush -> monitor-registry -> verdict -> tap
     engine-cache (leaf: engine.cache's shared LRU, acquired under anything)
@@ -57,6 +58,12 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
     ("fleet-supervisor",
      [(r"serve/fleet\.py$", r"^self\._sup_lock$"),
       (r"serve/fleetport\.py$", r"^self\._sup_lock$")]),
+    # the Governor's policy-state lock (serve/autoscale.py): decisions
+    # are made under it, but signal reads and scale actions — which take
+    # fleet/scheduler locks — happen outside; it sits above "fleet" so
+    # holding it across a fleet call could never invert
+    ("autoscale",
+     [(r"serve/autoscale\.py$", r"^self\._lock$")]),
     ("fleet",
      [(r"serve/fleet\.py$", r"^self\._(lock|cond)$")]),
     ("fleet-registry",
@@ -88,6 +95,11 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
       (r"", r"^(c|cell)\.request\._lock$")]),
     ("metrics",
      [(r"serve/metrics\.py$", r"^self\._lock$")]),
+    # the tenant table's quota condition (serve/tenants.py): submit
+    # paths block on it BEFORE touching the scheduler, and exports read
+    # counts outside the metrics lock — near-leaf, wraps nothing
+    ("tenants",
+     [(r"serve/tenants\.py$", r"^self\._cond$")]),
     ("router",
      [(r"serve/router\.py$", r"^self\._lock$")]),
     ("monitor-flush",

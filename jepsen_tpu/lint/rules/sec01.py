@@ -6,11 +6,16 @@ envelope field.  Until now that invariant was proven dynamically — the
 fleetport smoke greps every artifact and log for the token.  This rule
 makes it a whole-program static guarantee.
 
-**Sources.**  The return value of ``serve/auth.py::fleet_token`` and any
-direct read of the ``JEPSEN_TPU_FLEET_TOKEN`` env var.  Anything
-HMAC-derived from a tainted value (``hmac.new(token, ...)`` and string
-methods on tainted values) stays tainted: the mac is token *material*
-and is only ever allowed in the ``auth`` field.
+**Sources.**  The return values of ``serve/auth.py::fleet_token`` and
+``serve/auth.py::tenant_tokens`` (per-tenant secrets are credential
+material exactly like the fleet secret), plus any direct read of the
+``JEPSEN_TPU_FLEET_TOKEN`` / ``JEPSEN_TPU_TENANT_TOKENS`` /
+``JEPSEN_TPU_TENANT_TOKEN`` env vars.  Anything HMAC-derived from a
+tainted value (``hmac.new(token, ...)`` and string methods on tainted
+values) stays tainted: the mac is token *material* and is only ever
+allowed in the ``auth`` field.  Tenant *names* are identity, not
+credential — ``tenant_names`` launders through ``sorted()`` (a
+non-string builtin), which correctly drops taint.
 
 **Propagation.**  Through assignments, f-strings/``%``/``+`` string
 building, dict/list/tuple literals, ``self.<attr>`` stores (the attr
@@ -44,7 +49,8 @@ RULE = "SEC01"
 
 SCOPE = ("jepsen_tpu/", "suites/")
 
-_TOKEN_ENV = "FLEET_TOKEN"
+_TOKEN_ENVS = ("FLEET_TOKEN", "TENANT_TOKEN")   # substring match: the
+# second also covers JEPSEN_TPU_TENANT_TOKENS (the per-tenant secret map)
 _AUTH_KEY = "auth"
 
 _LOG_BASES = {"logging", "logger", "log", "LOG", "_log"}
@@ -91,9 +97,10 @@ class _Sec01:
     # -- entry -------------------------------------------------------------
 
     def run(self) -> List[Finding]:
-        src = self.g.find("serve/auth.py", "fleet_token")
-        if src is not None:
-            self.token_fns.add(src.id)
+        for fn in ("fleet_token", "tenant_tokens"):
+            src = self.g.find("serve/auth.py", fn)
+            if src is not None:
+                self.token_fns.add(src.id)
         for _ in range(self.MAX_ITERS):
             self.memo.clear()
             self.findings.clear()
@@ -127,9 +134,9 @@ class _Sec01:
             return
         self.findings[key] = Finding(
             RULE, path, lineno,
-            f"fleet-token material may reach a {fam} sink via {chain_s}: "
-            f"the token (and anything HMAC-derived from it) may only "
-            f"appear in a frame's 'auth' envelope field",
+            f"token material (fleet or tenant) may reach a {fam} sink "
+            f"via {chain_s}: a token (and anything HMAC-derived from it) "
+            f"may only appear in a frame's 'auth' envelope field",
             hint="export at most `auth-enabled: bool(token)`; strip the "
                  "token before the value reaches logs, errors, metrics, "
                  "frames, or files")
@@ -199,10 +206,10 @@ class _Sec01:
                 return False
             k = call.args[0]
             if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                return _TOKEN_ENV in k.value
+                return any(t in k.value for t in _TOKEN_ENVS)
             if isinstance(k, ast.Name):
                 v = self.g.module_const(f.path, k.id)
-                return v is not None and _TOKEN_ENV in v
+                return v is not None and any(t in v for t in _TOKEN_ENVS)
             return False
 
         def sink_family(call: ast.Call, d: str,

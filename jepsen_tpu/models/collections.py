@@ -8,6 +8,7 @@ bounded-domain set, and the multi-register (k int32 lanes).
 
 from __future__ import annotations
 
+import numbers
 from dataclasses import dataclass, field
 from typing import Any, FrozenSet, Optional, Tuple
 
@@ -240,6 +241,18 @@ def multi_register_jax(keys: int = 3, vbits: int = 4) -> JaxModel:
                     raise ValueError("multi-register can't encode a nil "
                                      f"write for key {k!r}")
                 continue  # nil read: unconstraining
+            # Coercion must not widen the domain: ``int("1")`` would make
+            # the device treat a string key as key 1 while the host
+            # MultiRegister compares raw keys ("1" != 1) — the tiers
+            # would silently disagree.  Only integral keys/values encode;
+            # anything else raises, and the facade falls back to the
+            # host oracle, which handles arbitrary keys correctly.
+            if not isinstance(k, numbers.Integral):
+                raise ValueError(f"multi-register can't encode non-int "
+                                 f"key {k!r}")
+            if not isinstance(v, numbers.Integral):
+                raise ValueError(f"multi-register can't encode non-int "
+                                 f"value {v!r} for key {k!r}")
             k, v = int(k), int(v)
             if not 0 <= k < keys:
                 raise ValueError(f"key {k} outside [0, {keys})")

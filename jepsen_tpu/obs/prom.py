@@ -66,6 +66,14 @@ def _esc(v: Any) -> str:
             .replace("\n", "\\n"))
 
 
+def _label_token(v: Any) -> str:
+    """Scheduler bucket keys are ``str((kind, ident, shape))`` — flatten
+    to a quote- and comma-free token (``wgl:cas-register:64``) so naive
+    label splitters (including validate_exposition) survive the value."""
+    s = re.sub(r"[\s'\"()\[\]{}]", "", str(v))
+    return s.replace(",", ":") or "none"
+
+
 def _hist_lines(name: str, h: Dict[str, Any]) -> List[str]:
     full = metric_name("histogram", name)
     lines = [f"# HELP {full} {_help_text(name)}",
@@ -133,6 +141,69 @@ def render_prom(snap: Dict[str, Any]) -> str:
         lines.append(f"# HELP {full} SLO alerts fired since start")
         lines.append(f"# TYPE {full} counter")
         lines.append(f"{full} {int(slo.get('fired-total', 0))}")
+
+    # per-tenant cut: labeled families so one scrape answers "which
+    # tenant is burning" without parsing the JSON snapshot.  Tenant
+    # NAMES are labels by design; token material never enters the
+    # snapshot in the first place (serve/tenants.py, SEC01).
+    tenants = snap.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        for key, fam in (("requests-completed", "tenant_requests"),
+                         ("verdicts-unknown", "tenant_unknown_verdicts"),
+                         ("deadline-expired", "tenant_deadline_expired"),
+                         ("quota-rejections", "tenant_quota_rejections"),
+                         ("admitted", "tenant_admitted")):
+            full = f"{PREFIX}_{fam}_total"
+            lines.append(f"# HELP {full} per-tenant {_esc(key)}")
+            lines.append(f"# TYPE {full} counter")
+            for name, cut in sorted(tenants.items()):
+                v = int(cut.get(key) or 0)
+                lines.append(f'{full}{{tenant="{_esc(name)}"}} {v}')
+        for key, fam, scale in (("open", "tenant_open_requests", 1.0),
+                                ("quota", "tenant_quota", 1.0),
+                                ("priority", "tenant_priority", 1.0),
+                                ("p99-dispatch-verdict-us",
+                                 "tenant_p99_dispatch_verdict_seconds",
+                                 1e-6)):
+            full = f"{PREFIX}_{fam}"
+            lines.append(f"# HELP {full} per-tenant {_esc(key)}")
+            lines.append(f"# TYPE {full} gauge")
+            for name, cut in sorted(tenants.items()):
+                v = cut.get(key)
+                if v is None:
+                    continue   # unlimited quota / no latency data yet
+                lines.append(f'{full}{{tenant="{_esc(name)}"}} '
+                             f"{_fmt(float(v) * scale)}")
+
+    # queue shape: per-bucket depth (the autoscaler's occupancy input,
+    # broken out by (kind, ident, shape) bucket key)
+    queue = snap.get("queue")
+    if isinstance(queue, dict):
+        buckets = queue.get("buckets")
+        if isinstance(buckets, dict) and buckets:
+            full = f"{PREFIX}_queue_bucket_depth"
+            lines.append(f"# HELP {full} queued cells per scheduler bucket")
+            lines.append(f"# TYPE {full} gauge")
+            for bucket, n in sorted(buckets.items()):
+                lines.append(
+                    f'{full}{{bucket="{_label_token(bucket)}"}} {int(n)}')
+
+    # Governor (serve/autoscale.py): decision counters + pending
+    # structured scale requests, distinct from the fleet's
+    # autoscale-ups/-downs action counters rendered above
+    scale = snap.get("autoscale")
+    if isinstance(scale, dict):
+        for key, v in sorted((scale.get("counters") or {}).items()):
+            full = f"{PREFIX}_governor_{sanitize(key)}_total"
+            lines.append(f"# HELP {full} governor decision counter "
+                         f"{_esc(key)}")
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {int(v)}")
+        full = f"{PREFIX}_governor_scale_requests_pending"
+        lines.append(f"# HELP {full} structured scale requests awaiting "
+                     "the deployment layer")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {len(scale.get('scale-requests') or [])}")
 
     return "\n".join(lines) + "\n"
 

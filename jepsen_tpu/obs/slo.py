@@ -116,6 +116,44 @@ def default_specs(interval_s: float) -> List[SloSpec]:
     ]
 
 
+def tenant_slo_specs(slo_config: Dict[str, Dict[str, float]],
+                     interval_s: float) -> List[SloSpec]:
+    """Per-tenant burn specs from a tenant table's SLO config
+    (serve/tenants.py ``slo_config()``): ``p99_us`` and/or
+    ``unknown_rate`` ceilings with an optional shared ``window_s``.
+    The signals are the tenant cuts of the **fleet pseudo-worker's**
+    pushes (TelemetryStore.tenant_rates), so each value_fn answers only
+    for worker ``"fleet"`` — per-worker evaluation of a fleet-wide
+    tenant signal would fire one duplicate episode per worker."""
+    out: List[SloSpec] = []
+    for tenant, cfg in sorted(slo_config.items()):
+        window = float(cfg.get("window_s", max(0.0, 2 * interval_s)))
+
+        def p99_fn(store, worker, now, _t=tenant):
+            if worker != "fleet":
+                return None
+            return store.tenant_rates("fleet", _t).get(
+                "p99-dispatch-verdict-us")
+
+        def unknown_fn(store, worker, now, _t=tenant):
+            if worker != "fleet":
+                return None
+            return store.tenant_rates("fleet", _t).get("unknown-rate")
+
+        if cfg.get("p99_us") is not None:
+            out.append(SloSpec(
+                f"tenant_p99_us:{tenant}", float(cfg["p99_us"]), window,
+                "us", f"tenant {tenant}: windowed p99 of the "
+                      "dispatch->verdict edge", p99_fn))
+        if cfg.get("unknown_rate") is not None:
+            out.append(SloSpec(
+                f"tenant_unknown_rate:{tenant}", float(cfg["unknown_rate"]),
+                window, "ratio",
+                f"tenant {tenant}: windowed unknown verdicts over "
+                "completed requests", unknown_fn))
+    return out
+
+
 class SloEngine:
     """Evaluates every spec against every worker the store knows, on
     each push (``evaluate``) and each heartbeat sweep
@@ -142,15 +180,42 @@ class SloEngine:
         with self._lock:
             return [s.doc_row() for s in self._specs.values()]
 
+    def add_spec(self, spec: SloSpec) -> None:
+        """Register one more spec on a live engine (per-tenant specs
+        arrive after construction, when the tenant table is parsed); a
+        same-named spec is replaced, its open episodes kept — the next
+        evaluation re-judges them against the new ceiling."""
+        with self._lock:
+            self._specs[spec.name] = spec
+
     def set_ceiling(self, name: str, ceiling: float,
                     burn_window_s: Optional[float] = None) -> None:
         """Retune a live spec (used by the smoke to inject a breach
-        threshold mid-run); unknown names raise KeyError."""
+        threshold mid-run); unknown names raise KeyError.
+
+        Open breach episodes for the spec are re-evaluated against the
+        new ceiling with a freshly measured sample: an episode the new
+        ceiling puts back in-SLO closes (and re-arms) immediately rather
+        than waiting for the next push, while a still-breaching episode
+        keeps its ``fired`` state — a retune never double-fires."""
         with self._lock:
             spec = self._specs[name]
             spec.ceiling = float(ceiling)
             if burn_window_s is not None:
                 spec.burn_window_s = float(burn_window_s)
+            open_workers = [w for (n, w) in self._breach_t0 if n == name]
+        now = mono_now()
+        for worker in open_workers:
+            if spec.value_fn is None:
+                continue
+            try:
+                value = spec.value_fn(self.store, worker, now)
+            except Exception:  # noqa: BLE001 — a torn push holds the
+                continue       # episode open, same as evaluate
+            if value is not None and value <= spec.ceiling:
+                with self._lock:
+                    self._breach_t0.pop((name, worker), None)
+                    self._fired.pop((name, worker), None)
 
     # -- evaluation ------------------------------------------------------------
 

@@ -374,6 +374,58 @@ class TelemetryStore:
         })
         return out
 
+    def tenant_rates(self, worker: Any, tenant: str,
+                     window_s: Optional[float] = None) -> Dict[str, Any]:
+        """The per-tenant analogue of :meth:`rates`: windowed deltas over
+        one tenant's cut of a worker's pushes — the ``tenants`` snapshot
+        section for the counters, the ``tenant:<name>:edge:...``
+        histogram for the latency signal.  Normally read against the
+        ``"fleet"`` pseudo-worker, whose pushes carry the fleet-wide
+        tenant accounting (obs/slo.py ``tenant_slo_specs``)."""
+        window_s = (STALE_AFTER_INTERVALS * 4 * self.interval_s
+                    if window_s is None else window_s)
+        with self._lock:
+            ring = self._rings.get(worker)
+            entries = list(ring) if ring else []
+        if not entries:
+            return {}
+
+        def tcounter(payload: Dict[str, Any], name: str) -> int:
+            m = payload.get("metrics") or {}
+            cut = (m.get("tenants") or {}).get(tenant) or {}
+            try:
+                return int(cut.get(name, 0) or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        hist = f"tenant:{tenant}:edge:dispatch->verdict"
+        newest = entries[-1]
+        out: Dict[str, Any] = {
+            "p99-dispatch-verdict-us": _hist_p99_us(newest["payload"],
+                                                    hist),
+        }
+        cutoff = newest["t"] - window_s
+        in_window = [e for e in entries if e["t"] >= cutoff]
+        if len(in_window) < 2:
+            return out
+        oldest = in_window[0]
+        dt = newest["t"] - oldest["t"]
+        if dt <= 0:
+            return out
+        out["p99-dispatch-verdict-us"] = _windowed_p99_us(
+            newest["payload"], oldest["payload"], hist)
+        d_completed = (tcounter(newest["payload"], "requests-completed")
+                       - tcounter(oldest["payload"], "requests-completed"))
+        d_unknown = (tcounter(newest["payload"], "verdicts-unknown")
+                     - tcounter(oldest["payload"], "verdicts-unknown"))
+        out.update({
+            "window-s": round(dt, 3),
+            "hist-per-s": round(max(d_completed, 0) / dt, 4),
+            "unknown-rate": (round(max(d_unknown, 0) / d_completed, 4)
+                             if d_completed > 0 else None),
+        })
+        return out
+
     # -- export ----------------------------------------------------------------
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:

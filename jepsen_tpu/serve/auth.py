@@ -23,6 +23,15 @@ Discipline:
   deployments fail closed: a verifying side with a token rejects
   unsigned frames with a typed ERROR (``error-class: AuthError``) and a
   hangup.
+
+Multi-tenancy rides on the same envelope: ``JEPSEN_TPU_TENANT_TOKENS``
+holds per-tenant secrets (``name:secret,name:secret``); a frame that
+names a ``tenant`` is verified against *that tenant's* token instead of
+the fleet secret (:func:`resolve_frame_token`), so a tenant can submit
+work without ever holding the fleet-wide credential.  A claimed tenant
+with no issued token fails closed while tenant auth is configured.
+Tenant tokens obey the same discipline as the fleet token: never
+travel, never logged, never in any export surface.
 """
 
 from __future__ import annotations
@@ -31,13 +40,20 @@ import hashlib
 import hmac
 import json
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 #: the env var holding the shared fleet secret
 TOKEN_ENV = "JEPSEN_TPU_FLEET_TOKEN"
 
+#: the env var holding per-tenant secrets: ``name:secret,name:secret``
+TENANT_TOKENS_ENV = "JEPSEN_TPU_TENANT_TOKENS"
+
 #: the frame field carrying the mac (stripped before digesting)
 AUTH_FIELD = "auth"
+
+#: the frame field naming the submitting tenant (part of the digest —
+#: a mac minted for tenant A cannot be replayed as tenant B)
+TENANT_FIELD = "tenant"
 
 
 class AuthError(Exception):
@@ -52,6 +68,46 @@ def fleet_token(env: Optional[Dict[str, str]] = None) -> Optional[str]:
     raw = (env if env is not None else os.environ).get(TOKEN_ENV, "")
     raw = raw.strip()
     return raw or None
+
+
+def tenant_tokens(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Per-tenant secrets parsed from ``JEPSEN_TPU_TENANT_TOKENS``
+    (``name:secret,name:secret``).  Empty dict = tenant auth off.
+    Malformed entries (no colon, empty name or secret) are skipped
+    rather than raising — a bad entry must not take the wire down.
+    Read at call time, like :func:`fleet_token`."""
+    raw = (env if env is not None else os.environ).get(TENANT_TOKENS_ENV, "")
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        name, _, secret = part.strip().partition(":")
+        name, secret = name.strip(), secret.strip()
+        if name and secret:
+            out[name] = secret
+    return out
+
+
+def tenant_names(env: Optional[Dict[str, str]] = None) -> Tuple[str, ...]:
+    """The tenant *names* with issued tokens — safe to export (names are
+    identity, not credential)."""
+    return tuple(sorted(tenant_tokens(env)))
+
+
+def resolve_frame_token(frame: Dict[str, Any],
+                        env: Optional[Dict[str, str]] = None,
+                        ) -> Tuple[Optional[str], bool]:
+    """The secret this frame must verify against, and whether the frame
+    is resolvable at all.  A frame naming a ``tenant`` while tenant
+    tokens are configured resolves to that tenant's token — or to
+    ``(None, False)`` when the tenant has no issued token, which the
+    caller must treat as a hard reject (fail closed: an unknown tenant
+    must not fall back to fleet-level or unauthenticated acceptance).
+    Everything else resolves to the fleet token (None = auth off)."""
+    tenant = frame.get(TENANT_FIELD)
+    toks = tenant_tokens(env)
+    if tenant is not None and toks:
+        tok = toks.get(str(tenant))
+        return tok, tok is not None
+    return fleet_token(env), True
 
 
 def canonical_frame_bytes(frame: Dict[str, Any]) -> bytes:

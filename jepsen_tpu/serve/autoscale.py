@@ -1,0 +1,358 @@
+"""Governor: the SLO-burn-driven autoscaler policy loop.
+
+Watchtower (obs/telemetry.py, obs/slo.py) observes; the fleet can admit
+and evict slots at runtime; this module closes the loop.  Each tick the
+Governor reads three signals — active SLO breach episodes, queue
+occupancy (open cells over the admission ceiling), and the oldest head
+wait-age (the scheduler's aged-tier signal, surfaced via
+``queue_occupancy``) — and decides **up**, **down**, or **hold**.
+
+The policy is deliberately boring, because an autoscaler that reacts to
+every alert IS an outage amplifier:
+
+- **hysteresis** — scale up only after the hot condition has been
+  continuously true for ``up_after_s``; scale down only after
+  continuously quiet for ``down_after_s``.  A breach/recover oscillation
+  (an alert storm) keeps resetting both clocks and produces nothing.
+- **cooldown** — after ANY action, no further action for ``cooldown_s``:
+  at most one scale action per cooldown window, by construction.
+- **bounded** — never below ``min_workers``, never above
+  ``max_workers``.
+- **drain-clean scale-down** — the victim slot is marked draining (the
+  router stops ranking it), and dies only once it is idle AND the fleet
+  journal has zero pending cells (Fleet.decommission_worker).  A drain
+  that cannot complete aborts and the slot returns to service.
+
+Scale-up runs through the fleet when it can build slots in-process
+(Fleet.add_worker); fleets whose workers live elsewhere (ProcFleet,
+registry-backed Fleetport deployments) get a **structured scale
+request** instead — a dict the deployment layer consumes from
+``snapshot()["scale-requests"]`` (or a ``scale_request_sink`` callback)
+to actually provision a machine, mirroring how the worker then joins by
+REGISTER frame.
+
+Every decision — including holds that changed the hysteresis state —
+lands in a bounded ring exported on ``/metrics`` (the fleet snapshot's
+``autoscale`` section) and in the flight recorder (category ``scale``),
+so a post-incident export shows scale actions on the same axis as the
+alerts that caused them.
+
+Env knobs (read by ``AutoscalePolicy.from_env``)::
+
+    JEPSEN_TPU_AUTOSCALE_MIN            floor, default 1
+    JEPSEN_TPU_AUTOSCALE_MAX            ceiling, default 8
+    JEPSEN_TPU_AUTOSCALE_COOLDOWN_S     action cooldown, default 30
+    JEPSEN_TPU_AUTOSCALE_UP_S           hot sustain, default 5
+    JEPSEN_TPU_AUTOSCALE_DOWN_S         quiet sustain, default 60
+    JEPSEN_TPU_AUTOSCALE_INTERVAL_S     tick cadence, default 1
+    JEPSEN_TPU_AUTOSCALE_QUEUE_HIGH     hot occupancy fraction, 0.8
+    JEPSEN_TPU_AUTOSCALE_QUEUE_LOW      quiet occupancy fraction, 0.1
+    JEPSEN_TPU_AUTOSCALE_WAIT_HIGH_S    hot oldest-wait-age, default 10
+    JEPSEN_TPU_AUTOSCALE_DRAIN_TIMEOUT_S  scale-down drain bound, 30
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.serve.metrics import mono_now
+
+#: decision ring capacity
+DECISION_CAPACITY = 256
+#: pending structured scale requests kept for the deployment layer
+REQUEST_CAPACITY = 64
+
+
+def _env_num(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class AutoscalePolicy:
+    """The Governor's tuning — see the module docstring for semantics."""
+
+    min_workers: int = 1
+    max_workers: int = 8
+    cooldown_s: float = 30.0
+    up_after_s: float = 5.0
+    down_after_s: float = 60.0
+    interval_s: float = 1.0
+    queue_high: float = 0.8
+    queue_low: float = 0.1
+    wait_high_s: float = 10.0
+    drain_timeout_s: float = 30.0
+
+    @classmethod
+    def from_env(cls) -> "AutoscalePolicy":
+        e = "JEPSEN_TPU_AUTOSCALE"
+        return cls(
+            min_workers=int(_env_num(f"{e}_MIN", 1)),
+            max_workers=int(_env_num(f"{e}_MAX", 8)),
+            cooldown_s=_env_num(f"{e}_COOLDOWN_S", 30.0),
+            up_after_s=_env_num(f"{e}_UP_S", 5.0),
+            down_after_s=_env_num(f"{e}_DOWN_S", 60.0),
+            interval_s=_env_num(f"{e}_INTERVAL_S", 1.0),
+            queue_high=_env_num(f"{e}_QUEUE_HIGH", 0.8),
+            queue_low=_env_num(f"{e}_QUEUE_LOW", 0.1),
+            wait_high_s=_env_num(f"{e}_WAIT_HIGH_S", 10.0),
+            drain_timeout_s=_env_num(f"{e}_DRAIN_TIMEOUT_S", 30.0))
+
+    def doc(self) -> Dict[str, Any]:
+        return {"min-workers": self.min_workers,
+                "max-workers": self.max_workers,
+                "cooldown-s": self.cooldown_s,
+                "up-after-s": self.up_after_s,
+                "down-after-s": self.down_after_s,
+                "interval-s": self.interval_s,
+                "queue-high": self.queue_high,
+                "queue-low": self.queue_low,
+                "wait-high-s": self.wait_high_s,
+                "drain-timeout-s": self.drain_timeout_s}
+
+
+class Autoscaler:
+    """The policy loop.  ``fleet`` may be None for pure policy testing —
+    every action then becomes a structured scale request.  A custom
+    ``signals_fn`` overrides the fleet-derived signal read (the
+    alert-storm hysteresis tests drive the loop with a synthetic signal
+    box and an explicit clock)."""
+
+    def __init__(self, fleet=None,
+                 policy: Optional[AutoscalePolicy] = None,
+                 signals_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 scale_request_sink: Optional[
+                     Callable[[Dict[str, Any]], None]] = None):
+        self.fleet = fleet
+        self.policy = policy or AutoscalePolicy.from_env()
+        self._signals_fn = signals_fn
+        self._sink = scale_request_sink
+        # policy state only under this lock — signal reads and scale
+        # actions (which take fleet/scheduler locks) happen outside it
+        self._lock = threading.Lock()
+        self._hot_since: Optional[float] = None
+        self._quiet_since: Optional[float] = None
+        self._last_action_t = float("-inf")
+        self._decisions: deque = deque(maxlen=DECISION_CAPACITY)
+        self._requests: deque = deque(maxlen=REQUEST_CAPACITY)
+        self._counters = {"ups": 0, "downs": 0, "holds": 0,
+                          "drain-aborts": 0, "requests-emitted": 0}
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if fleet is not None:
+            # the fleet snapshot exports our decision ring (/metrics)
+            fleet.governor = self
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="governor")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2 * self.policy.interval_s + 1.0)
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _loop(self) -> None:
+        import time
+        while not self._closed:
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive a
+                pass           # torn signal read or a failed action
+            time.sleep(self.policy.interval_s)
+
+    # -- signals ----------------------------------------------------------
+    def _signals(self) -> Dict[str, Any]:
+        if self._signals_fn is not None:
+            return dict(self._signals_fn())
+        f = self.fleet
+        if f is None:
+            return {"breaches": 0, "occupancy": 0.0, "oldest-wait-s": 0.0,
+                    "workers": 0, "journal-pending": 0}
+        occ_info = f.queue_occupancy()
+        depth = int(occ_info.get("depth", 0))
+        return {
+            "breaches": len(f.slo.snapshot().get("active-breaches", [])),
+            "occupancy": round(depth / max(1, f.max_queue_cells), 4),
+            "depth": depth,
+            "oldest-wait-s": float(occ_info.get("oldest-wait-s", 0.0)),
+            "workers": f.active_workers(),
+            "journal-pending": f.journal_pending(),
+        }
+
+    # -- the decision -----------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One policy evaluation.  Returns the decision dict when an
+        action (or an emitted scale request) happened, None on hold."""
+        now = mono_now() if now is None else now
+        p = self.policy
+        sig = self._signals()
+        hot = (sig.get("breaches", 0) > 0
+               or sig.get("occupancy", 0.0) >= p.queue_high
+               or sig.get("oldest-wait-s", 0.0) >= p.wait_high_s)
+        quiet = (sig.get("breaches", 0) == 0
+                 and sig.get("occupancy", 0.0) <= p.queue_low
+                 and sig.get("oldest-wait-s", 0.0) < p.wait_high_s)
+        workers = int(sig.get("workers", 0))
+        action = None
+        with self._lock:
+            if hot:
+                self._quiet_since = None
+                if self._hot_since is None:
+                    self._hot_since = now
+            elif quiet:
+                self._hot_since = None
+                if self._quiet_since is None:
+                    self._quiet_since = now
+            else:
+                # neither hot nor quiet: both hysteresis clocks reset —
+                # a half-recovered system earns neither direction
+                self._hot_since = self._quiet_since = None
+            if now - self._last_action_t >= p.cooldown_s:
+                if (hot and self._hot_since is not None
+                        and now - self._hot_since >= p.up_after_s
+                        and workers < p.max_workers):
+                    action = "up"
+                elif (quiet and self._quiet_since is not None
+                        and now - self._quiet_since >= p.down_after_s
+                        and workers > p.min_workers):
+                    action = "down"
+            if action is None:
+                self._counters["holds"] += 1
+                return None
+            # one action per cooldown window, and a fresh sustain is
+            # required before the next — both clocks restart here
+            self._last_action_t = now
+            self._hot_since = self._quiet_since = None
+        if action == "up":
+            return self._scale_up(sig, now)
+        return self._scale_down(sig, now)
+
+    # -- actions ----------------------------------------------------------
+    def _scale_up(self, sig: Dict[str, Any], now: float) -> Dict[str, Any]:
+        workers = int(sig.get("workers", 0))
+        f = self.fleet
+        if f is not None and f.can_scale_locally():
+            w = f.add_worker()
+            decision = self._record({
+                "t": round(now, 6), "action": "up", "mode": "spawn",
+                "from": workers, "to": workers + 1, "worker": w.wid,
+                "reason": self._reason(sig), "signals": sig})
+            self._counters["ups"] += 1
+            return decision
+        req = {"t": round(now, 6), "action": "scale-up",
+               "from": workers, "to": workers + 1,
+               "reason": self._reason(sig), "signals": sig}
+        with self._lock:
+            self._requests.append(req)
+            self._counters["requests-emitted"] += 1
+            self._counters["ups"] += 1
+        if self._sink is not None:
+            try:
+                self._sink(dict(req))
+            except Exception:  # noqa: BLE001 — a broken sink must not
+                pass           # kill the policy loop
+        return self._record({**req, "action": "up", "mode": "request"})
+
+    def _scale_down(self, sig: Dict[str, Any], now: float) -> Dict[str, Any]:
+        workers = int(sig.get("workers", 0))
+        f = self.fleet
+        if f is None:
+            req = {"t": round(now, 6), "action": "scale-down",
+                   "from": workers, "to": workers - 1,
+                   "reason": self._reason(sig), "signals": sig}
+            with self._lock:
+                self._requests.append(req)
+                self._counters["requests-emitted"] += 1
+                self._counters["downs"] += 1
+            return self._record({**req, "action": "down",
+                                 "mode": "request"})
+        victim = self._pick_victim()
+        if victim is None:
+            return self._record({
+                "t": round(now, 6), "action": "down", "mode": "skip",
+                "from": workers, "to": workers,
+                "reason": "no drainable worker", "signals": sig})
+        res = f.decommission_worker(victim, timeout_s=p_drain(self.policy))
+        with self._lock:
+            if res.get("drained"):
+                self._counters["downs"] += 1
+            else:
+                self._counters["drain-aborts"] += 1
+        return self._record({
+            "t": round(now, 6), "action": "down", "mode": "drain",
+            "from": workers,
+            "to": workers - 1 if res.get("drained") else workers,
+            "worker": victim, "drained": bool(res.get("drained")),
+            "journal-pending": res.get("journal-pending"),
+            "reason": self._reason(sig), "signals": sig})
+
+    def _pick_victim(self) -> Optional[int]:
+        """Newest slot first (highest wid): wid 0 stays the stable
+        anchor, and append-only wids mean the retired id never comes
+        back."""
+        f = self.fleet
+        best = None
+        for w in f.workers:
+            if w.alive() and not w.draining and not w.retired:
+                best = w.wid if best is None else max(best, w.wid)
+        return best
+
+    @staticmethod
+    def _reason(sig: Dict[str, Any]) -> str:
+        parts = []
+        if sig.get("breaches", 0) > 0:
+            parts.append(f"{sig['breaches']} SLO breach(es)")
+        parts.append(f"occupancy {sig.get('occupancy', 0.0)}")
+        parts.append(f"oldest-wait {sig.get('oldest-wait-s', 0.0)}s")
+        return ", ".join(parts)
+
+    def _record(self, decision: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            self._decisions.append(decision)
+        RECORDER.record("scale", f"governor:{decision['action']}",
+                        args=dict(decision))
+        f = self.fleet
+        if f is not None:
+            f.metrics.inc(f"autoscale-{decision['action']}s")
+        return decision
+
+    # -- export -----------------------------------------------------------
+    def scale_requests(self, clear: bool = False) -> list:
+        """Pending structured scale requests for the deployment layer.
+        ``clear=True`` consumes them (the deployment layer acked)."""
+        with self._lock:
+            out = [dict(r) for r in self._requests]
+            if clear:
+                self._requests.clear()
+            return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"policy": self.policy.doc(),
+                    "counters": dict(self._counters),
+                    "decisions": [dict(d) for d in self._decisions],
+                    "scale-requests": [dict(r) for r in self._requests]}
+
+
+def p_drain(policy: AutoscalePolicy) -> float:
+    return max(policy.drain_timeout_s, 0.0)

@@ -49,7 +49,7 @@ from jepsen_tpu.net_proxy import PairProxy
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.obs.hist import merge_hist_snapshots
 from jepsen_tpu.obs.recorder import RECORDER
-from jepsen_tpu.obs.slo import SloEngine
+from jepsen_tpu.obs.slo import SloEngine, tenant_slo_specs
 from jepsen_tpu.obs.telemetry import TelemetryStore, telemetry_interval_s
 from jepsen_tpu.serve import buckets
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
@@ -63,6 +63,7 @@ from jepsen_tpu.serve.service import (
     CheckService, ServiceClosed, ServiceSaturated, build_spec,
     submit_kwargs,
 )
+from jepsen_tpu.serve.tenants import TenantTable
 
 log = logging.getLogger("jepsen.serve.fleet")
 
@@ -125,6 +126,11 @@ class FleetWorker:
                                       open_s=open_s)
         self.health = WorkerHealth()
         self.generation = 0
+        # scale-down lifecycle (serve/autoscale.py): a draining slot
+        # takes no new cells (router filters it); a retired slot is dead
+        # for good — the supervisor must not respawn it
+        self.draining = False
+        self.retired = False
         self._restart_lock = threading.Lock()
 
     def alive(self) -> bool:
@@ -175,6 +181,8 @@ class FleetWorker:
                 "queue-depth": ping.get("queue-depth"),
                 "inflight-cells": ping.get("inflight-cells"),
                 "generation": self.generation,
+                "draining": self.draining,
+                "retired": self.retired,
                 "devices": [str(d) for d in self.devices],
                 **self.health.snapshot()}
 
@@ -395,6 +403,9 @@ class _FleetMetrics(Metrics):
         slo = getattr(self._fleet, "slo", None)
         if slo is not None:
             snap["slo"] = slo.snapshot()
+        gov = getattr(self._fleet, "governor", None)
+        if gov is not None:
+            snap["autoscale"] = gov.snapshot()
         return snap
 
 
@@ -459,6 +470,16 @@ class Fleet:
             self.telemetry.register(w.wid)
         self.telemetry.register("fleet")
         self._last_tele_sweep = 0.0
+        # Multi-tenant QoS (serve/tenants.py): quotas/priorities from
+        # JEPSEN_TPU_TENANT_*; tenants with configured SLO ceilings get
+        # their own burn specs over the fleet pseudo-worker's pushes.
+        self.tenants = TenantTable.from_env()
+        for spec in tenant_slo_specs(self.tenants.slo_config(),
+                                     self.telemetry_s):
+            self.slo.add_spec(spec)
+        # the Governor (serve/autoscale.py) attaches itself here so the
+        # metrics snapshot can carry its decision ring
+        self.governor = None
         # Decorrelated jitter by default: reroutes after a worker death
         # must not arrive at the survivor in lockstep (retry storm).
         self.retry_policy = retry_policy or RetryPolicy(
@@ -474,6 +495,8 @@ class Fleet:
         self._submitted = 0
         self._closed = False
         self.metrics.bind(self.queue_depth, self._inflight)
+        self.metrics.bind_queue(self.queue_occupancy)
+        self.metrics.bind_tenants(self.tenants.counts)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True,
             name="fleet-heartbeat")
@@ -489,7 +512,7 @@ class Fleet:
         slot's service behind the wire instead of in-process."""
 
         def make_service(i: int) -> Callable[[], CheckService]:
-            devs = device_sets[i]
+            devs = device_sets[i] if i < len(device_sets) else []
 
             def make() -> CheckService:
                 return CheckService(
@@ -500,9 +523,15 @@ class Fleet:
                     device=devs[0] if devs else None)
             return make
 
-        return [FleetWorker(i, make_service(i), devices=device_sets[i],
-                            fail_threshold=fail_threshold, open_s=open_s)
-                for i in range(n)]
+        # kept for scale-up (add_worker): a slot built past the initial
+        # N runs unpinned — on CPU CI that is every slot anyway, and a
+        # scaled-up accelerator slot sharing device 0 still adds queue
+        # capacity and host-tier throughput
+        self._slot_factory = lambda wid: FleetWorker(
+            wid, make_service(wid),
+            devices=device_sets[wid] if wid < len(device_sets) else [],
+            fail_threshold=fail_threshold, open_s=open_s)
+        return [self._slot_factory(i) for i in range(n)]
 
     # -- submission -------------------------------------------------------
     def _inflight(self) -> int:
@@ -516,34 +545,45 @@ class Fleet:
                block: bool = True,
                timeout: Optional[float] = None,
                trace: Optional[Dict[str, Any]] = None,
+               tenant: Optional[str] = None,
                **kw) -> Request:
         """Enqueue one history check across the fleet; same contract as
         CheckService.submit, including the admission-race rule: a request
-        whose deadline expires while blocked on fleet backpressure
-        resolves ``unknown`` — never dropped, never false.  ``trace``
-        rides beside the spec (never inside it — reroute and journal
-        recovery round-trip the spec through build_spec)."""
+        whose deadline expires while blocked on admission — its tenant's
+        quota or fleet backpressure — resolves ``unknown`` — never
+        dropped, never false.  ``trace`` and ``tenant`` ride beside the
+        spec (never inside it — reroute and journal recovery round-trip
+        the spec through build_spec)."""
         if self._closed:
             raise ServiceClosed("fleet is closed")
         spec = build_spec(kind, **kw)
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = Request(history, kind, spec, deadline_s=deadline_s,
-                      trace=trace)
+                      trace=trace, tenant=tenant,
+                      priority=self.tenants.priority(tenant))
         cells = decompose(req)
         for c in cells:
             c.cid = f"{req.id}.{next(self._cids)}"
+        adm_deadline = req.deadline
+        if timeout is not None:
+            t_lim = mono_now() + timeout
+            adm_deadline = t_lim if adm_deadline is None \
+                else min(adm_deadline, t_lim)
+        if not self.tenants.acquire(tenant, block=block,
+                                    deadline=adm_deadline):
+            if req.expired():
+                return self._finish_expired(req, cells)
+            self.metrics.inc("requests-rejected")
+            raise ServiceSaturated(
+                f"tenant {tenant!r} at quota; request of "
+                f"{len(cells)} cell(s) rejected")
+        req.on_finish = lambda t=tenant: self.tenants.release(t)
         if not self._admit(cells, block=block, timeout=timeout):
             if req.expired():
-                for c in cells:
-                    c.result = expired_result(kind)
-                self.metrics.inc("deadline-expired", len(cells))
-                self._count_submit(len(cells))
-                self.metrics.inc("cells-completed", len(cells))
-                self.metrics.inc("requests-completed")
-                req.finish(aggregate(req))
-                self.metrics.trace(req)
-                return req
+                return self._finish_expired(req, cells)
+            self.tenants.release(tenant)
+            req.on_finish = None
             self.metrics.inc("requests-rejected")
             raise ServiceSaturated(
                 f"fleet at {self.queue_depth()}/{self.max_queue_cells} "
@@ -560,6 +600,19 @@ class Fleet:
             self._submitted += 1
         self.metrics.inc("requests-submitted")
         self.metrics.inc("cells-submitted", n_cells)
+
+    def _finish_expired(self, req: Request, cells: List[Cell]) -> Request:
+        """Expiry-while-blocked (quota or backpressure): every cell
+        resolves unknown and the handle comes back already done."""
+        for c in cells:
+            c.result = expired_result(req.kind)
+        self.metrics.inc("deadline-expired", len(cells))
+        self._count_submit(len(cells))
+        self.metrics.inc("cells-completed", len(cells))
+        self.metrics.inc("requests-completed")
+        req.finish(aggregate(req))
+        self.metrics.trace(req)
+        return req
 
     def _admit(self, cells: List[Cell], block: bool,
                timeout: Optional[float]) -> bool:
@@ -829,6 +882,8 @@ class Fleet:
     def _heartbeat_loop(self) -> None:
         while not self._closed:
             for w in self.workers:
+                if w.retired:
+                    continue  # decommissioned slot: dead for good
                 try:
                     p = w.service.ping()
                 except Exception:  # noqa: BLE001
@@ -883,6 +938,8 @@ class Fleet:
             "metrics": snap}, now=now)
         for w in self.workers:
             svc = w.service
+            if w.retired:
+                continue  # evicted from the store; must not re-register
             if hasattr(svc, "metrics_snapshot"):
                 continue  # wire-backed: its process pushes for itself
             m = getattr(svc, "metrics", None)
@@ -933,6 +990,100 @@ class Fleet:
         if w.restart(only_if_dead=only_if_dead):
             self.metrics.inc("worker-restarts")
         return w
+
+    # -- Governor scale plane (serve/autoscale.py) ------------------------
+    def can_scale_locally(self) -> bool:
+        """Can this fleet spawn a worker slot in-process?  ProcFleet and
+        registry-backed fleets answer False — the Governor emits a
+        structured scale request for the deployment layer instead."""
+        return getattr(self, "_slot_factory", None) is not None
+
+    def active_workers(self) -> int:
+        """Slots currently able to take traffic: alive, not draining,
+        not retired — the autoscaler's worker-count signal."""
+        return sum(1 for w in self.workers
+                   if w.alive() and not w.draining and not w.retired)
+
+    def journal_pending(self) -> int:
+        return self._journal.pending_count() if self._journal else 0
+
+    def queue_occupancy(self) -> Dict[str, Any]:
+        """Fleet-tier occupancy: open cells by bucket plus the oldest
+        open request's wait-age — the same shape CheckService exposes
+        from its scheduler, so the autoscaler (and the prom rendering)
+        read one schema at either tier."""
+        now = mono_now()
+        with self._lock:
+            cells = list(self._open_cells.values())
+        buckets_out: Dict[str, int] = {}
+        oldest = 0.0
+        for c in cells:
+            b = str(c.bucket)
+            buckets_out[b] = buckets_out.get(b, 0) + 1
+            oldest = max(oldest, now - c.request.submitted)
+        return {"depth": len(cells), "buckets": buckets_out,
+                "oldest-wait-s": round(oldest, 6)}
+
+    def add_worker(self) -> FleetWorker:
+        """Scale up: append one fresh worker slot.  The router shares the
+        live worker list, so the new slot starts taking rendezvous
+        traffic immediately; its wid is append-only (never reused) to
+        keep journal records and telemetry history unambiguous."""
+        if not self.can_scale_locally():
+            raise RuntimeError("fleet cannot spawn worker slots locally; "
+                               "consume the Governor's scale requests "
+                               "instead")
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("fleet is closed")
+            wid = len(self.workers)
+            w = self._slot_factory(wid)
+            self.workers.append(w)
+            self.n_workers = len(self.workers)
+        self.telemetry.register(w.wid)
+        self.metrics.inc("workers-added")
+        return w
+
+    def decommission_worker(self, wid: int,
+                            timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Scale down strictly by lease drain: mark the slot draining
+        (the router stops ranking it), wait until it is idle AND the
+        journal has zero pending cells, then retire and kill it.  A
+        drain that cannot complete within ``timeout_s`` ABORTS — the
+        slot un-drains and keeps serving, because killing a worker with
+        journal-pending work would turn bounded unknowns into recovery
+        churn.  Returns the decision evidence either way."""
+        w = self.workers[wid]
+        w.draining = True
+        deadline = mono_now() + timeout_s
+        drained = False
+        while mono_now() < deadline and not self._closed:
+            try:
+                p = w.service.ping()
+            except Exception:  # noqa: BLE001 — already dead is idle
+                p = {"alive": False, "queue-depth": 0, "inflight-cells": 0}
+            idle = (not p.get("alive")
+                    or (p.get("queue-depth") == 0
+                        and p.get("inflight-cells") == 0))
+            if idle and self.journal_pending() == 0:
+                drained = True
+                break
+            time.sleep(0.05)
+        pending = self.journal_pending()
+        if not drained:
+            w.draining = False
+            self.metrics.inc("decommission-aborts")
+            return {"worker": wid, "drained": False,
+                    "journal-pending": pending}
+        w.retired = True
+        try:
+            w.kill()
+        except Exception:  # noqa: BLE001 — racing a chaos kill is fine
+            pass
+        self.slo.forget(wid)
+        self.telemetry.evict(wid)
+        self.metrics.inc("workers-decommissioned")
+        return {"worker": wid, "drained": True, "journal-pending": pending}
 
     def fleet_status(self) -> Dict[str, Any]:
         return {"workers": [w.status() for w in self.workers],
@@ -1300,11 +1451,13 @@ class ProcFleet(Fleet):
         """Respawn ``w`` iff its process is dead and the fleet is open.
         The sup lock + ``only_if_dead`` make the supervisor, a chaos
         undo, and a manual ``restart_worker`` mutually exclusive: one
-        respawner wins, the rest observe the fresh service."""
-        if w.alive():
+        respawner wins, the rest observe the fresh service.  Retired
+        slots (scale-down, decommission_worker) stay dead: respawning
+        one would undo the Governor's drain."""
+        if w.alive() or w.retired:
             return False
         with self._sup_lock:
-            if self._closed or w.alive():
+            if self._closed or w.alive() or w.retired:
                 return False
             if w.restart(only_if_dead=True):
                 self.metrics.inc("worker-restarts")

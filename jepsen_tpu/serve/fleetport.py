@@ -52,7 +52,8 @@ from typing import Any, Dict, Optional
 
 from jepsen_tpu.clock import mono_now
 from jepsen_tpu.obs.telemetry import set_gauge
-from jepsen_tpu.serve.auth import fleet_token, sign_frame, verify_frame
+from jepsen_tpu.serve.auth import (TENANT_FIELD, fleet_token, sign_frame,
+                                   tenant_tokens, verify_frame)
 from jepsen_tpu.serve.fleet import Fleet, FleetWorker
 from jepsen_tpu.serve.registry import FleetRegistry, WorkerRecord
 from jepsen_tpu.serve.transport import (F_ERROR, F_REGISTER, F_REPLY,
@@ -233,14 +234,27 @@ class Fleetport(Fleet):
                 frame = read_frame(sock, MAX_FRAME_BYTES)
                 if frame is None:
                     return  # clean close
-                if not verify_frame(frame, self._token):
+                # a frame naming a tenant (while tenant tokens are
+                # configured) verifies against THAT tenant's secret —
+                # the tenant field is inside the digest, so a mac minted
+                # for one tenant cannot be replayed as another; a
+                # claimed tenant with no issued token is a hard reject
+                tok, known = self._token, True
+                if frame.get(TENANT_FIELD) is not None:
+                    ttoks = tenant_tokens()
+                    if ttoks:
+                        tok = ttoks.get(str(frame[TENANT_FIELD]))
+                        known = tok is not None
+                if not known or not verify_frame(frame, tok):
                     # fail closed: typed ERROR, then hangup.  Count it —
                     # the smoke asserts rejected workers never reach the
                     # registry — and log the failure MODE only, never
-                    # any token or mac material.
+                    # any token or mac material (nor the claimed tenant
+                    # string: it arrived unauthenticated).
                     self.auth_rejections += 1
                     self.metrics.inc("auth-rejections")
-                    what = ("unauthenticated frame"
+                    what = ("unknown tenant" if not known
+                            else "unauthenticated frame"
                             if not isinstance(frame.get("auth"), str)
                             else "bad frame mac")
                     log.warning("rejected %s from %s", what, peer)

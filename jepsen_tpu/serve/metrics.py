@@ -11,6 +11,11 @@ document (docs/observability.md) and assert on in the tests:
   steady-state ``compiles-per-1k-dispatches`` ratio (process-wide
   compile events over barrier + megabatch dispatches — 0.0 once the
   shape ladder is warm);
+- queue: per-bucket queue depth and oldest head wait-age (the
+  autoscaler's occupancy signal, bound via ``bind_queue``);
+- tenants: per-tenant lifecycle counters, verdict-edge p99, and the
+  tenant table's quota/priority/accounting cut (``bind_tenants``) —
+  names and numbers only, never token material;
 - occupancy: used vs padded lanes per dispatch, summed — the price of
   shape bucketing, as a ratio;
 - histograms: log-bucketed (pow2 ladder, jepsen_tpu.obs.hist) latency
@@ -73,11 +78,34 @@ class Metrics:
         self._traces: deque = deque(maxlen=trace_capacity)
         self._depth_fn = None       # live queue-depth callback
         self._inflight_fn = None
+        self._queue_fn = None       # live per-bucket occupancy callback
+        self._tenants_fn = None     # live tenant-table counts callback
+        self._tenants: Dict[str, Dict[str, int]] = {}  # per-tenant counters
         self.hists = HistogramSet()  # own lock; observed outside ours
 
     def bind(self, depth_fn, inflight_fn) -> None:
         self._depth_fn = depth_fn
         self._inflight_fn = inflight_fn
+
+    def bind_queue(self, queue_fn) -> None:
+        """Wire the scheduler/fleet occupancy callback: per-bucket depth
+        + oldest-wait-age, sampled live like the other gauges (outside
+        the metrics lock — same tear contract)."""
+        self._queue_fn = queue_fn
+
+    def bind_tenants(self, tenants_fn) -> None:
+        """Wire the tenant table's counts() callback (serve/tenants.py):
+        quota/priority policy + open/admitted/rejected accounting,
+        merged into the snapshot's per-tenant cut."""
+        self._tenants_fn = tenants_fn
+
+    def tenant_inc(self, tenant: Optional[str], name: str,
+                   n: int = 1) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            t = self._tenants.setdefault(tenant, {})
+            t[name] = t.get(name, 0) + n
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -96,6 +124,8 @@ class Metrics:
         payload = request.trace_payload()
         payload["kind"] = request.kind
         payload["valid"] = (request.result or {}).get("valid")
+        tenant = getattr(request, "tenant", None)
+        expired = bool((request.result or {}).get("deadline-expired"))
         with self._lock:
             self._traces.append(payload)
             # unknown verdicts are the checker punting (frontier blowup,
@@ -104,12 +134,22 @@ class Metrics:
             if payload["valid"] == "unknown":
                 self._counters["verdicts-unknown"] = \
                     self._counters.get("verdicts-unknown", 0) + 1
-        self._observe_edges(request.spans)
+            if tenant is not None:
+                t = self._tenants.setdefault(tenant, {})
+                t["requests-completed"] = t.get("requests-completed", 0) + 1
+                if payload["valid"] == "unknown":
+                    t["verdicts-unknown"] = t.get("verdicts-unknown", 0) + 1
+                if expired:
+                    t["deadline-expired"] = t.get("deadline-expired", 0) + 1
+        self._observe_edges(request.spans, tenant=tenant)
 
-    def _observe_edges(self, spans: List[Dict[str, Any]]) -> None:
+    def _observe_edges(self, spans: List[Dict[str, Any]],
+                       tenant: Optional[str] = None) -> None:
         """Latency histograms per lifecycle edge: each adjacent span
         pair, plus the two headline edges (queueing+packing delay and
-        device-to-verdict time)."""
+        device-to-verdict time).  Tenant-attributed requests additionally
+        observe the headline verdict edge under a per-tenant histogram —
+        the source of the tenant p99 cut and the tenant SLO burn."""
         times: Dict[str, float] = {}
         prev = None
         for s in spans:
@@ -123,6 +163,10 @@ class Metrics:
         for a, b in (("enqueue", "dispatch"), ("dispatch", "verdict")):
             if a in times and b in times and times[b] >= times[a]:
                 self.hists.observe(f"edge:{a}->{b}", times[b] - times[a])
+                if tenant is not None and (a, b) == ("dispatch", "verdict"):
+                    self.hists.observe(
+                        f"tenant:{tenant}:edge:dispatch->verdict",
+                        times[b] - times[a])
 
     def find_trace(self, request_id) -> Optional[Dict[str, Any]]:
         """The merged trace payload for a completed request still in the
@@ -145,6 +189,7 @@ class Metrics:
             used, padded = self._lanes_used, self._lanes_padded
             dispatch_s = self._dispatch_s
             traces = list(self._traces)
+            tenant_counters = {t: dict(c) for t, c in self._tenants.items()}
         cache = engine_cache_stats()
         mega = megabatch_stats()
         # process-wide merge-corruption counter: how many malformed
@@ -163,6 +208,22 @@ class Metrics:
         # our lock (the callbacks take scheduler/fleet locks that must
         # not nest inside the metrics leaf); see the module docstring
         # for the resulting tear contract
+        queue = self._queue_fn() if self._queue_fn else \
+            {"depth": 0, "buckets": {}, "oldest-wait-s": 0.0}
+        hists = {**self.hists.snapshot(), **compile_hist_stats()}
+        # per-tenant cut: lifecycle counters + the tenant verdict-edge
+        # p99 + the tenant table's policy/accounting (quota, priority,
+        # open, quota-rejections).  Names and numbers only — never token
+        # material (SEC01's export-sink discipline).
+        table = self._tenants_fn() if self._tenants_fn else {}
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for name in sorted(set(tenant_counters) | set(table)):
+            cut: Dict[str, Any] = dict(tenant_counters.get(name, {}))
+            h = hists.get(f"tenant:{name}:edge:dispatch->verdict")
+            cut["p99-dispatch-verdict-us"] = \
+                round(h["p99"] * 1e6, 3) if h else None
+            cut.update(table.get(name, {}))
+            tenants[name] = cut
         return {
             "counters": counters,
             "gauges": {
@@ -176,14 +237,19 @@ class Metrics:
                 # through obs.telemetry.set_gauge
                 "epochs-behind-live":
                     int(process_gauges().get("epochs-behind-live", 0)),
+                # the autoscaler's wait-age input signal, sampled with
+                # the other gauges (same tear contract)
+                "queue-oldest-wait-s": queue.get("oldest-wait-s", 0.0),
             },
+            "queue": queue,
+            "tenants": tenants,
             "occupancy": {
                 "lanes-used": used,
                 "lanes-padded": padded,
                 "ratio": round(used / padded, 4) if padded else None,
                 "dispatch-seconds": round(dispatch_s, 6),
             },
-            "histograms": {**self.hists.snapshot(), **compile_hist_stats()},
+            "histograms": hists,
             "engine-cache": {**cache, "recompiles": cache["misses"]},
             "megabatch": mega,
             "fission": {**fission.fission_stats(),

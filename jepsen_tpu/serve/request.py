@@ -49,13 +49,20 @@ class Request:
 
     def __init__(self, history: History, kind: str, spec: Dict[str, Any],
                  deadline_s: Optional[float] = None,
-                 trace: Optional[Dict[str, Any]] = None):
+                 trace: Optional[Dict[str, Any]] = None,
+                 tenant: Optional[str] = None, priority: int = 0):
         if kind not in KINDS:
             raise ValueError(f"unknown request kind {kind!r}; known: {KINDS}")
         self.id = next(_ids)
         self.history = history
         self.kind = kind
         self.spec = spec            # kind-specific engine options
+        # tenant identity and priority class ride *beside* the spec (like
+        # the trace context) so engine option round-trips — build_spec,
+        # journal recovery, wire submit kwargs — never see them
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.on_finish = None       # e.g. tenant quota release (tenants.py)
         self.submitted = mono_now()
         self.deadline = (self.submitted + deadline_s
                          if deadline_s is not None else None)
@@ -166,6 +173,15 @@ class Request:
                                 **self.trace_payload()})
         self.result = result
         self._done.set()
+        # release side-effects (tenant quota slot) fire on *every* finish
+        # path — normal aggregation and expiry-while-blocked alike — so an
+        # admitted request can never leak its slot
+        cb, self.on_finish = self.on_finish, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — release must not mask result
+                pass
 
     def wait(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         if not self._done.wait(timeout):
@@ -190,10 +206,14 @@ class Cell:
     enqueued: float = 0.0           # mono_now() at admission (aging clock)
     cid: str = ""                   # fleet cell id (journal key, route token)
 
-    def sort_key(self) -> Tuple[float, int]:
-        """Deadline-first priority, FIFO within a deadline class."""
+    def sort_key(self) -> Tuple[int, float, int]:
+        """Priority-class first (higher request priority sorts earlier),
+        deadline within a class, FIFO within a deadline.  The
+        scheduler's aged tier still outranks all of this, so a
+        low-priority tenant is delayed, never starved."""
         d = self.request.deadline
-        return (d if d is not None else float("inf"), self.seq)
+        return (-self.request.priority,
+                d if d is not None else float("inf"), self.seq)
 
     def route_token(self) -> str:
         """What the fleet router hashes: the key for per-key cells (same

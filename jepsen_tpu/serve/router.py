@@ -183,10 +183,15 @@ class Router:
         never an availability loss: when NO eligible worker fits, the
         unfiltered ranking is used (a too-big cell on a small worker
         degrades to the service's own saturation/unknown handling
-        rather than being unroutable)."""
+        rather than being unroutable).
+
+        Workers marked ``draining`` (a scale-down in progress —
+        serve/autoscale.py) take no new cells: they finish what they
+        have while the rest of the fleet absorbs their share."""
         ex = set(exclude)
         alive = [w for w in self._workers
-                 if w.wid not in ex and w.alive()]
+                 if w.wid not in ex and w.alive()
+                 and not getattr(w, "draining", False)]
         if cell is not None:
             fitting = [w for w in alive if w.fits(cell)]
             if fitting:

@@ -2,7 +2,8 @@
 
 The device is a single serially-dispatched resource, so the scheduler is
 one thread: each iteration it picks the most urgent shape bucket
-(earliest deadline, FIFO within a deadline class), packs up to a lane
+(tenant priority class, then earliest deadline, FIFO within a deadline
+class), packs up to a lane
 bucket's worth of that bucket's cells into ONE vmapped dispatch — wgl
 cells through parallel.batch.check_batch, elle cells through
 elle_tpu.engine.check_batch — and loops.  New cells admitted while a
@@ -110,6 +111,24 @@ class Scheduler:
 
     def depth(self) -> int:
         return self._depth
+
+    def occupancy(self) -> Dict[str, Any]:
+        """The autoscaler's input signals as first-class data: per-bucket
+        queue depth and the oldest head wait-age (the same age the aged
+        tier of :meth:`_take_group` acts on).  Rides in the metrics
+        snapshot — and therefore in every telemetry push frame — via
+        Metrics.bind_queue."""
+        now = mono_now()
+        with self._lock:
+            buckets: Dict[str, int] = {}
+            oldest = 0.0
+            for key, dq in self._groups.items():
+                if not dq:
+                    continue
+                buckets[str(key)] = len(dq)
+                oldest = max(oldest, now - dq[0].enqueued)
+            return {"depth": self._depth, "buckets": buckets,
+                    "oldest-wait-s": round(oldest, 6)}
 
     def add_idle_listener(self, fn) -> None:
         """Drain hook: ``fn()`` fires on the device-loop thread (outside
@@ -222,8 +241,10 @@ class Scheduler:
         group limit — max_lanes, or the mega lane ladder for megabatch-
         eligible buckets).
 
-        Deadline-first with aging: the plain pick is the earliest
-        (deadline, seq) head, but a steady stream of near-deadline cells
+        Priority-then-deadline with aging: the plain pick is the
+        smallest (-priority, deadline, seq) head — a tenant's priority
+        class outranks deadline order (serve/tenants.py), deadline
+        orders within a class — but a steady stream of urgent cells
         could then starve a far-deadline bucket forever — its compiled
         engine goes cold and the eventual dispatch pays a recompile.  So
         any bucket whose head has been queued longer than ``age_s``

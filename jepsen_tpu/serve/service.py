@@ -29,9 +29,10 @@ from typing import Any, Dict, Optional, Union
 from jepsen_tpu.history import History
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.decompose import decompose
-from jepsen_tpu.serve.metrics import Metrics
+from jepsen_tpu.serve.metrics import Metrics, mono_now
 from jepsen_tpu.serve.request import KIND_ELLE, KIND_WGL, Request
 from jepsen_tpu.serve.scheduler import Scheduler
+from jepsen_tpu.serve.tenants import TenantTable
 
 
 class ServiceSaturated(RuntimeError):
@@ -117,7 +118,12 @@ class CheckService:
         self._closed = False
         self._lock = threading.Lock()
         self._submitted = 0
+        # multi-tenant QoS: quotas/priorities from JEPSEN_TPU_TENANT_*
+        # (serve/tenants.py); tenantless submits bypass the table
+        self.tenants = TenantTable.from_env()
         self.metrics.bind(self._sched.depth, self._inflight)
+        self.metrics.bind_queue(self._sched.occupancy)
+        self.metrics.bind_tenants(self.tenants.counts)
         self._sched.start()
 
     def _inflight(self) -> int:
@@ -136,6 +142,7 @@ class CheckService:
                block: bool = True,
                timeout: Optional[float] = None,
                trace: Optional[Dict[str, Any]] = None,
+               tenant: Optional[str] = None,
                **engine_opts) -> Request:
         """Enqueue one history check; returns a :class:`Request` handle
         (``.wait()`` for the verdict).  ``block=False`` raises
@@ -145,9 +152,12 @@ class CheckService:
         from an upstream hop — the fleet's root request, a remote
         client.  It rides beside the spec (never inside it, so reroute/
         journal round-trips through build_spec don't see it) and makes
-        this request a child span of the sender's.
+        this request a child span of the sender's.  ``tenant`` rides the
+        same way: it names the submitting tenant for quota accounting,
+        priority class, and the per-tenant metrics cut (serve/tenants.py).
 
-        A request whose deadline expires *while blocked on admission*
+        A request whose deadline expires *while blocked on admission* —
+        whether on its tenant's quota or on global backpressure —
         resolves ``unknown`` (the returned handle is already done) rather
         than raising: backpressure is indistinguishable from a slow
         device to the caller, and the deadline contract is "unknown,
@@ -162,29 +172,40 @@ class CheckService:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         req = Request(history, kind, spec, deadline_s=deadline_s,
-                      trace=trace)
+                      trace=trace, tenant=tenant,
+                      priority=self.tenants.priority(tenant))
         cells = decompose(req)
         # A blocked offer never outlives the deadline: the expiring
         # request must surface unknown, not sit in admission forever.
         rem = req.remaining_s()
         if rem is not None:
             timeout = rem if timeout is None else min(timeout, rem)
+        # Tenant quota gate (before global backpressure): a blocked
+        # acquire is bounded by the same deadline/timeout as the offer,
+        # and the same expiry contract applies — over quota at deadline
+        # is unknown, never false, never dropped.
+        adm_deadline = req.deadline
+        if timeout is not None:
+            t_lim = mono_now() + timeout
+            adm_deadline = t_lim if adm_deadline is None \
+                else min(adm_deadline, t_lim)
+        if not self.tenants.acquire(tenant, block=block,
+                                    deadline=adm_deadline):
+            if req.expired():
+                return self._finish_expired(req, cells)
+            self.metrics.inc("requests-rejected")
+            raise ServiceSaturated(
+                f"tenant {tenant!r} at quota; request of "
+                f"{len(cells)} cell(s) rejected")
+        # the slot frees on *every* finish path (request.finish fires it)
+        req.on_finish = lambda t=tenant: self.tenants.release(t)
         if not self._sched.offer(cells, block=block,
                                  max_depth=self.max_queue_cells,
                                  timeout=timeout):
             if req.expired():
-                for c in cells:
-                    c.result = expired_result(kind)
-                self.metrics.inc("deadline-expired", len(cells))
-                with self._lock:
-                    self._submitted += 1
-                self.metrics.inc("requests-submitted")
-                self.metrics.inc("cells-submitted", len(cells))
-                self.metrics.inc("cells-completed", len(cells))
-                self.metrics.inc("requests-completed")
-                req.finish(aggregate(req))
-                self.metrics.trace(req)
-                return req
+                return self._finish_expired(req, cells)
+            self.tenants.release(tenant)
+            req.on_finish = None
             self.metrics.inc("requests-rejected")
             raise ServiceSaturated(
                 f"queue at {self._sched.depth()}/{self.max_queue_cells} "
@@ -193,6 +214,23 @@ class CheckService:
             self._submitted += 1
         self.metrics.inc("requests-submitted")
         self.metrics.inc("cells-submitted", len(cells))
+        return req
+
+    def _finish_expired(self, req: Request, cells) -> Request:
+        """The expiry-while-blocked path: resolve every cell unknown and
+        hand back a completed request — shared by the tenant-quota and
+        global-backpressure admission gates."""
+        for c in cells:
+            c.result = expired_result(req.kind)
+        self.metrics.inc("deadline-expired", len(cells))
+        with self._lock:
+            self._submitted += 1
+        self.metrics.inc("requests-submitted")
+        self.metrics.inc("cells-submitted", len(cells))
+        self.metrics.inc("cells-completed", len(cells))
+        self.metrics.inc("requests-completed")
+        req.finish(aggregate(req))
+        self.metrics.trace(req)
         return req
 
     def check(self, history: History, *, timeout: Optional[float] = None,

@@ -1,0 +1,237 @@
+"""Multi-tenant QoS: per-tenant admission quotas and deadline priorities.
+
+One tenant's 10k-op monster must not starve everyone's 200-op streams.
+The table holds the *policy* — how many requests a tenant may have open
+at once (quota) and how urgently its cells sort in the scheduler's
+group pick (priority) — and the *accounting* — open requests, admitted,
+quota rejections.  Admission sites (CheckService.submit, Fleet.submit)
+gate on :meth:`TenantTable.acquire` before offering work, and release
+on request finish, so the quota bounds a tenant's share of the queue
+end to end.
+
+Invariants, inherited from the admission plane:
+
+- an over-quota *blocked* submit whose deadline expires resolves
+  ``unknown`` (never ``false``, never dropped) — the caller reuses the
+  existing expiry-while-blocked path;
+- an over-quota non-blocking submit raises ``ServiceSaturated`` with a
+  quota reason, counted per tenant;
+- the table never holds token material — tenant *secrets* live only in
+  serve/auth.py and are resolved at verification time.
+
+Configuration (all read at construction; programmatic
+:meth:`configure` overrides):
+
+- ``JEPSEN_TPU_TENANT_QUOTA`` — default max open requests for any named
+  tenant (unset = unlimited);
+- ``JEPSEN_TPU_TENANT_QUOTA_<NAME>`` — per-tenant quota override;
+- ``JEPSEN_TPU_TENANT_PRIORITY_<NAME>`` — integer priority class
+  (higher = more urgent; default 0);
+- ``JEPSEN_TPU_TENANT_SLO_P99_US_<NAME>``,
+  ``JEPSEN_TPU_TENANT_SLO_UNKNOWN_RATE_<NAME>``,
+  ``JEPSEN_TPU_TENANT_SLO_WINDOW_S_<NAME>`` — per-tenant SLO ceilings
+  and burn window, consumed by obs/slo.py tenant specs.
+
+``<NAME>`` is the tenant name upper-cased with ``-`` → ``_``.  Requests
+with no tenant (single-tenant deployments) bypass the table entirely —
+unlimited, priority 0, exactly the pre-tenancy behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.serve.metrics import mono_now
+
+_QUOTA_ENV = "JEPSEN_TPU_TENANT_QUOTA"
+_PRIORITY_ENV = "JEPSEN_TPU_TENANT_PRIORITY"
+_SLO_ENVS = {"p99_us": "JEPSEN_TPU_TENANT_SLO_P99_US",
+             "unknown_rate": "JEPSEN_TPU_TENANT_SLO_UNKNOWN_RATE",
+             "window_s": "JEPSEN_TPU_TENANT_SLO_WINDOW_S"}
+
+
+def _env_name(tenant: str) -> str:
+    return tenant.upper().replace("-", "_")
+
+
+@dataclass
+class TenantSpec:
+    """Policy for one tenant.  ``quota`` is max open requests (None =
+    unlimited); ``priority`` is an integer class, higher = more urgent;
+    ``slo`` holds optional per-tenant ceilings (p99_us, unknown_rate,
+    window_s) for obs/slo.py."""
+
+    name: str
+    quota: Optional[int] = None
+    priority: int = 0
+    slo: Dict[str, float] = field(default_factory=dict)
+
+
+class TenantTable:
+    """Quota/priority policy plus open-request accounting, shared by
+    every admission site of one service or fleet."""
+
+    def __init__(self, specs: Optional[Dict[str, TenantSpec]] = None,
+                 default_quota: Optional[int] = None):
+        self._specs: Dict[str, TenantSpec] = dict(specs or {})
+        self._default_quota = default_quota
+        self._open: Dict[str, int] = {}
+        self._admitted: Dict[str, int] = {}
+        self._rejected: Dict[str, int] = {}
+        self._cond = threading.Condition(threading.Lock())
+
+    # -- configuration ----------------------------------------------------
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "TenantTable":
+        """Parse tenant policy from the environment.  Tenant names are
+        discovered from issued tokens (auth.tenant_names) and from any
+        per-tenant env key; unknown tenants stay unlimited/priority 0."""
+        e = env if env is not None else os.environ
+        default_quota = _int_or_none(e.get(_QUOTA_ENV))
+        names = set()
+        from jepsen_tpu.serve.auth import tenant_names
+        names.update(tenant_names(env))
+        prefixes = ([_QUOTA_ENV + "_", _PRIORITY_ENV + "_"]
+                    + [v + "_" for v in _SLO_ENVS.values()])
+        for key in e:
+            for p in prefixes:
+                if key.startswith(p):
+                    names.add(key[len(p):].lower().replace("_", "-"))
+        specs: Dict[str, TenantSpec] = {}
+        for name in sorted(names):
+            n = _env_name(name)
+            slo = {}
+            for field_name, env_base in _SLO_ENVS.items():
+                v = _float_or_none(e.get(f"{env_base}_{n}"))
+                if v is not None:
+                    slo[field_name] = v
+            specs[name] = TenantSpec(
+                name=name,
+                quota=_int_or_none(e.get(f"{_QUOTA_ENV}_{n}"),
+                                   default_quota),
+                priority=_int_or_none(e.get(f"{_PRIORITY_ENV}_{n}"), 0) or 0,
+                slo=slo)
+        return cls(specs, default_quota=default_quota)
+
+    def configure(self, name: str, quota: Optional[int] = None,
+                  priority: Optional[int] = None,
+                  slo: Optional[Dict[str, float]] = None) -> TenantSpec:
+        """Programmatic policy: create or update one tenant's spec."""
+        with self._cond:
+            spec = self._specs.get(name) or TenantSpec(name=name,
+                                                       quota=self._default_quota)
+            if quota is not None:
+                spec.quota = quota
+            if priority is not None:
+                spec.priority = priority
+            if slo:
+                spec.slo.update(slo)
+            self._specs[name] = spec
+            return spec
+
+    def spec(self, tenant: Optional[str]) -> Optional[TenantSpec]:
+        if tenant is None:
+            return None
+        with self._cond:
+            return self._specs.get(tenant)
+
+    def priority(self, tenant: Optional[str]) -> int:
+        s = self.spec(tenant)
+        return s.priority if s is not None else 0
+
+    def names(self):
+        with self._cond:
+            return sorted(set(self._specs) | set(self._open)
+                          | set(self._admitted) | set(self._rejected))
+
+    # -- admission --------------------------------------------------------
+    def _quota(self, tenant: str) -> Optional[int]:
+        # caller holds self._cond; tenants with no spec are unlimited
+        # (the env default applies only to *named* tenants — see from_env)
+        spec = self._specs.get(tenant)
+        return spec.quota if spec is not None else None
+
+    def acquire(self, tenant: Optional[str], block: bool = True,
+                deadline: Optional[float] = None) -> bool:
+        """Take one open-request slot for ``tenant``.  Untracked tenants
+        (None, or no quota configured) always succeed.  A blocked
+        acquire waits until a slot frees or ``deadline`` (monotonic,
+        same clock as Request.deadline) passes; False = over quota.
+        The caller decides whether False becomes ServiceSaturated or
+        the expiry-while-blocked ``unknown`` path."""
+        if tenant is None:
+            return True
+        with self._cond:
+            while True:
+                quota = self._quota(tenant)
+                if quota is None or self._open.get(tenant, 0) < quota:
+                    self._open[tenant] = self._open.get(tenant, 0) + 1
+                    self._admitted[tenant] = self._admitted.get(tenant, 0) + 1
+                    return True
+                if not block:
+                    self._rejected[tenant] = self._rejected.get(tenant, 0) + 1
+                    return False
+                rem = (deadline - mono_now()) if deadline is not None else None
+                if rem is not None and rem <= 0:
+                    self._rejected[tenant] = self._rejected.get(tenant, 0) + 1
+                    return False
+                self._cond.wait(timeout=min(rem, 0.1) if rem is not None
+                                else 0.1)
+
+    def release(self, tenant: Optional[str]) -> None:
+        if tenant is None:
+            return
+        with self._cond:
+            n = self._open.get(tenant, 0)
+            if n <= 1:
+                self._open.pop(tenant, None)
+            else:
+                self._open[tenant] = n - 1
+            self._cond.notify_all()
+
+    # -- export -----------------------------------------------------------
+    def counts(self) -> Dict[str, Dict[str, Any]]:
+        """The per-tenant policy + accounting cut for /metrics.  Names
+        and counters only — never token material."""
+        with self._cond:
+            out: Dict[str, Dict[str, Any]] = {}
+            for name in sorted(set(self._specs) | set(self._open)
+                               | set(self._admitted) | set(self._rejected)):
+                spec = self._specs.get(name)
+                out[name] = {
+                    "open": self._open.get(name, 0),
+                    "admitted": self._admitted.get(name, 0),
+                    "quota-rejections": self._rejected.get(name, 0),
+                    "quota": (spec.quota if spec is not None
+                              else self._default_quota),
+                    "priority": spec.priority if spec is not None else 0,
+                }
+            return out
+
+    def slo_config(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant SLO ceilings for obs/slo.py tenant specs."""
+        with self._cond:
+            return {name: dict(spec.slo)
+                    for name, spec in self._specs.items() if spec.slo}
+
+
+def _int_or_none(raw: Optional[str],
+                 default: Optional[int] = None) -> Optional[int]:
+    if raw is None or not str(raw).strip():
+        return default
+    try:
+        return int(str(raw).strip())
+    except ValueError:
+        return default
+
+
+def _float_or_none(raw: Optional[str]) -> Optional[float]:
+    if raw is None or not str(raw).strip():
+        return None
+    try:
+        return float(str(raw).strip())
+    except ValueError:
+        return None

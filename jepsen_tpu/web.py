@@ -28,7 +28,16 @@ submission surface:
 - ``POST /submit``  — submit a history for checking: a JSON body with
   ``ops`` (op dicts, the history.jsonl shape) plus the submit options of
   CheckService.submit (kind/model/workload/...); responds with the
-  verdict JSON.  This is what ``cli.py submit`` talks to.
+  verdict JSON.  This is what ``cli.py submit`` talks to.  A body
+  ``tenant`` attributes the request to that tenant (quota, priority,
+  per-tenant SLO cut — serve/tenants.py); when per-tenant tokens are
+  configured (``JEPSEN_TPU_TENANT_TOKENS``), a tenant-attributed submit
+  must present the matching ``X-Tenant-Token`` header — unknown tenant
+  or wrong token is a 403, constant-time compare, and the error body
+  never echoes token material;
+- ``GET /autoscale`` — the Governor's state (serve/autoscale.py):
+  policy, decision ring, pending structured scale requests; a null
+  document when no autoscaler is attached.
 """
 
 from __future__ import annotations
@@ -157,6 +166,14 @@ def make_handler(base: str, service=None):
                     return self._send_json(200, view())
                 return self._send_json(200, {"registry": None,
                                              "workers": []})
+            if path == "/autoscale":
+                # Governor state (serve/autoscale.py), reached through
+                # the fleet's ``governor`` attribute; services without
+                # one answer null, not 404, for uniform polling.
+                gov = getattr(service, "governor", None)
+                return self._send_json(200, {
+                    "governor": gov.snapshot() if gov is not None
+                    else None})
             if path == "/alerts":
                 # SLO alert ring (obs/slo.py).  Degenerate services with
                 # no SLO engine answer an empty document, not a 404 — a
@@ -247,6 +264,21 @@ def make_handler(base: str, service=None):
                 timeout = body.pop("timeout_s", None)
             except Exception as e:  # noqa: BLE001
                 return self._send_json(400, {"error": f"bad request: {e}"})
+            tenant = body.get("tenant")
+            if tenant is not None:
+                import hmac
+                from jepsen_tpu.serve.auth import tenant_tokens
+                toks = tenant_tokens()
+                if toks:
+                    # fail closed: unknown tenant and wrong token are
+                    # the same 403, and the body never names which —
+                    # nor, ever, any token material
+                    expected = toks.get(str(tenant), "")
+                    presented = self.headers.get("X-Tenant-Token", "")
+                    if not expected or not hmac.compare_digest(
+                            presented.encode(), expected.encode()):
+                        return self._send_json(
+                            403, {"error": "tenant authentication failed"})
             try:
                 res = service.check(hist, timeout=timeout, **body)
             except TimeoutError as e:

@@ -1,0 +1,470 @@
+#!/usr/bin/env python
+"""Governor smoke: SLO-burn autoscaling + multi-tenant QoS vs its nemesis.
+
+Phase A (alert storm, bounded actions): a 2-worker ProcFleet
+(``spawn=False``: thread-hosted protocol servers behind real PairProxy
+sockets, so netem-style faults bite actual bytes) runs a mixed
+valid/corrupted wgl campaign while the nemesis cycles slow links on
+every worker and SIGKILLs one mid-storm (the supervisor respawns it).
+The p99 SLO ceiling is tightened so the storm genuinely breaches — the
+Governor sees a flapping breach signal.  Asserts: at most one scale
+action per cooldown window (consecutive action timestamps >= cooldown
+apart), a bounded total, ZERO scale-downs (non-oscillating: a storm
+must not whipsaw the fleet), structured scale-up requests (a ProcFleet
+cannot spawn slots in-process), and lane-for-lane verdict parity with a
+cold single-service oracle — zero fabricated ``false``.
+
+Phase B (deterministic spawn + drain-clean scale-down): an in-process
+journaled Fleet under an explicit-clock Governor.  A hot tick must
+spawn a second slot through ``Fleet.add_worker``; after the campaign
+quiesces, a quiet tick must decommission it strictly by lease drain —
+``drained`` true, journal pending 0, the retired slot stays dead, and
+the surviving fleet still answers with oracle parity.
+
+Phase C (tenant QoS): a saturating ``bulk`` tenant (quota 2, priority
+0) floods a 4-lane service while a light ``gold`` tenant (priority 5,
+p99 SLO) streams small checks.  Asserts: gold's per-tenant p99 stays
+inside its SLO (the flood cannot starve it), bulk's verdicts keep
+oracle parity (zero fabricated false across tenants), an over-quota
+non-blocking submit raises ServiceSaturated, an over-quota *blocked*
+submit whose deadline expires resolves ``unknown`` — never false,
+never dropped — and the quota-rejection counter shows on bulk's cut.
+
+Finale (token hygiene): fleet and tenant tokens are sentinel secrets
+set before import.  Every artifact this smoke writes — the report, the
+governor decision rings, the Prometheus expositions — plus every
+captured log line and the flight-recorder ring is scanned for the
+sentinels: no token material (fleet or tenant) may appear in any
+artifact or log.
+
+Writes the report to argv[1] (default /tmp/governor_report.json), the
+governor decision rings to argv[2] (default /tmp/governor_decisions.json)
+and the per-phase Prometheus text to argv[3] (default
+/tmp/governor_metrics.prom) — CI uploads all three.
+"""
+
+import json
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Sentinel token material, armed BEFORE any jepsen_tpu import so the
+# auth layer reads it the same way a deployment would.  The finale
+# greps every artifact for these exact strings.
+FLEET_SECRET = "smoke-fleet-secret-0f3d9a"
+GOLD_SECRET = "smoke-gold-secret-77aa01"
+BULK_SECRET = "smoke-bulk-secret-4cc2b8"
+SECRETS = (FLEET_SECRET, GOLD_SECRET, BULK_SECRET)
+os.environ["JEPSEN_TPU_FLIGHT_RECORDER"] = "1"
+os.environ["JEPSEN_TPU_FLEET_TOKEN"] = FLEET_SECRET
+os.environ["JEPSEN_TPU_TENANT_TOKENS"] = \
+    f"gold:{GOLD_SECRET},bulk:{BULK_SECRET}"
+
+from jepsen_tpu.nemesis.registry import FaultRegistry  # noqa: E402
+from jepsen_tpu.obs.prom import render_prom, validate_exposition
+from jepsen_tpu.obs.recorder import RECORDER
+from jepsen_tpu.serve import CheckService
+from jepsen_tpu.serve.autoscale import AutoscalePolicy, Autoscaler
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.fleet import Fleet, ProcFleet
+from jepsen_tpu.serve.metrics import mono_now
+from jepsen_tpu.serve.service import ServiceSaturated
+from jepsen_tpu.synth import cas_register_history, corrupt_reads
+
+N_JOBS, CLIENTS = 18, 4
+DEADLINE_S = 60.0
+STORM_CYCLES = 6
+SLOW_LINK_S = 0.35
+COOLDOWN_S = 3.0
+GOLD_P99_US = 20_000_000.0       # 20 s: generous for CI, catches starvation
+
+
+class LogTap(logging.Handler):
+    """Captures every formatted log message the run emits, so the
+    finale can assert no token material ever reached a log line."""
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.lines = []
+
+    def emit(self, record):
+        try:
+            self.lines.append(record.getMessage())
+        except Exception:  # noqa: BLE001 — a torn record is not the test
+            pass
+
+
+def build_jobs(n=N_JOBS, ops=50, base_seed=0):
+    jobs = []
+    for s in range(n):
+        h = cas_register_history(ops, concurrency=4, seed=base_seed + s)
+        if s % 3 == 2:
+            h = corrupt_reads(h, n=1, seed=s)
+        jobs.append(h)
+    return jobs
+
+
+def run_oracle(svc, jobs):
+    return [svc.check(h, model="cas-register")["valid"] for h in jobs]
+
+
+def run_fleet(fleet, jobs):
+    out = [None] * len(jobs)
+
+    def client(span):
+        reqs = [(i, fleet.submit(jobs[i], model="cas-register",
+                                 deadline_s=DEADLINE_S)) for i in span]
+        for i, r in reqs:
+            out[i] = r.wait(timeout=300)["valid"]
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), CLIENTS),))
+               for j in range(CLIENTS)]
+    for t in threads:
+        t.start()
+    return threads, out
+
+
+def wait_until_value(fn, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def parity(oracle, out):
+    mismatches = [{"lane": i, "oracle": o, "fleet": f}
+                  for i, (o, f) in enumerate(zip(oracle, out)) if o != f]
+    fabricated = [m for m in mismatches
+                  if m["fleet"] is False and m["oracle"] is not False]
+    return mismatches, fabricated
+
+
+def phase_a(jobs, oracle):
+    """Alert storm: slow-link cycles + a worker kill must not flap the
+    Governor — bounded, non-oscillating, one action per cooldown."""
+    fleet = ProcFleet(workers=2, spawn=False, max_lanes=24,
+                      default_deadline_s=DEADLINE_S,
+                      telemetry_s=0.2, heartbeat_s=0.15,
+                      supervise_s=0.25)
+    chaos = ChaosNemesis(fleet, registry=FaultRegistry(), seed=16)
+    policy = AutoscalePolicy(
+        min_workers=1, max_workers=4, cooldown_s=COOLDOWN_S,
+        up_after_s=0.4, down_after_s=30.0, interval_s=0.1,
+        queue_high=0.9, queue_low=0.05, wait_high_s=30.0,
+        drain_timeout_s=10.0)
+    gov = Autoscaler(fleet=fleet, policy=policy).start()
+    try:
+        # warm so the breach ceiling measures warm-path latency
+        warm, _ = run_fleet(fleet, jobs[:4])
+        for t in warm:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in warm), "warm pass hung"
+        clean_p99 = wait_until_value(
+            lambda: fleet.telemetry.rates(
+                "fleet").get("p99-dispatch-verdict-us"),
+            15.0, "a windowed fleet dispatch->verdict p99")
+        # staleness gets a pass (slowed links also delay TELEMETRY
+        # frames — not the signal under test); the latency ceiling is
+        # tightened so the storm genuinely breaches
+        fleet.slo.set_ceiling("worker_stale_s", 1e9)
+        fleet.slo.set_ceiling("p99_dispatch_verdict_us",
+                              clean_p99 + 150_000.0)
+
+        threads, out = run_fleet(fleet, jobs)
+        t_storm0 = mono_now()
+        for cycle in range(STORM_CYCLES):
+            faults = [chaos.slow_link(w.wid, delay_s=SLOW_LINK_S)
+                      for w in fleet.workers if w.alive()]
+            time.sleep(0.7)
+            for f in faults:
+                chaos.heal(f)
+            if cycle == 2:
+                # SIGKILL analogue mid-storm; the supervisor respawns it
+                fleet.workers[1].kill()
+            time.sleep(0.45)
+        chaos.heal_all()
+        t_storm1 = mono_now()
+        fleet.slo.set_ceiling("p99_dispatch_verdict_us", 30_000_000.0)
+
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "campaign hung"
+        gov.close()
+
+        snap = gov.snapshot()
+        requests = gov.scale_requests()
+        prom = render_prom(fleet.metrics.snapshot())
+        validate_exposition(prom)
+    finally:
+        gov.close()
+        fleet.close(timeout=60.0)
+
+    mismatches, fabricated = parity(oracle, out)
+    actions = [d for d in snap["decisions"]
+               if d["action"] in ("up", "down") and d.get("mode") != "skip"]
+    gaps = [round(b["t"] - a["t"], 3)
+            for a, b in zip(actions, actions[1:])]
+    storm_s = t_storm1 - t_storm0
+    report = {
+        "storm_s": round(storm_s, 3),
+        "actions": actions, "gaps_s": gaps,
+        "counters": snap["counters"],
+        "scale_requests": len(requests),
+        "mismatches": mismatches, "fabricated_false": fabricated,
+    }
+
+    assert not fabricated, f"fabricated false under storm: {fabricated}"
+    assert not mismatches, f"verdict parity broken: {mismatches}"
+    assert oracle.count(False) > 0, "corrupted histories must refute"
+    assert actions, "an alert storm this hot must provoke a scale-up"
+    assert all(g >= COOLDOWN_S - 0.05 for g in gaps), (
+        f"two scale actions inside one cooldown window: {gaps}")
+    assert len(actions) <= int(storm_s / COOLDOWN_S) + 2, (
+        f"{len(actions)} actions in a {storm_s:.1f}s storm — the "
+        f"Governor is amplifying the outage")
+    assert all(d["action"] == "up" for d in actions), (
+        f"the Governor oscillated (scaled DOWN during/after a storm): "
+        f"{actions}")
+    assert snap["counters"]["downs"] == 0
+    assert snap["counters"]["drain-aborts"] == 0
+    assert all(d["mode"] == "request" for d in actions), (
+        "a ProcFleet cannot spawn slots in-process — ups must be "
+        "structured scale requests")
+    assert requests, "no structured scale request for the deploy layer"
+    assert "jepsen_tpu_governor_ups_total" in prom
+    return report, snap, prom
+
+
+def phase_b(jobs, oracle, journal_dir):
+    """Explicit-clock Governor on a journaled in-process fleet: hot tick
+    spawns, quiet tick drains clean (journal pending 0) and the
+    survivor keeps oracle parity."""
+    fleet = Fleet(workers=1, max_lanes=16, pin_devices=False,
+                  journal_dir=journal_dir, default_deadline_s=DEADLINE_S)
+    box = {"breaches": 2, "occupancy": 0.95, "oldest-wait-s": 0.0}
+    gov = Autoscaler(
+        fleet=fleet,
+        policy=AutoscalePolicy(min_workers=1, max_workers=2,
+                               cooldown_s=0.5, up_after_s=0.0,
+                               down_after_s=0.0, interval_s=1.0,
+                               drain_timeout_s=20.0),
+        signals_fn=lambda: {**box,
+                            "workers": fleet.active_workers(),
+                            "journal-pending": fleet.journal_pending()})
+    try:
+        up = gov.tick(now=0.0)
+        assert up and up["action"] == "up" and up["mode"] == "spawn", up
+        assert fleet.active_workers() == 2, "add_worker did not land"
+
+        threads, out = run_fleet(fleet, jobs)
+        for t in threads:
+            t.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), "campaign hung"
+        mismatches, fabricated = parity(oracle, out)
+        assert not fabricated, f"fabricated false: {fabricated}"
+        assert not mismatches, f"parity broken at 2 workers: {mismatches}"
+
+        box.update(breaches=0, occupancy=0.0)
+        down = gov.tick(now=100.0)
+        assert down and down["action"] == "down" and \
+            down["mode"] == "drain", down
+        assert down["drained"] is True, (
+            f"scale-down did not drain clean: {down}")
+        assert down["journal-pending"] == 0, (
+            f"journal still pending at decommission: {down}")
+        victim = fleet.workers[down["worker"]]
+        assert victim.retired and not victim.alive()
+        assert fleet.active_workers() == 1
+        assert fleet.journal_pending() == 0
+
+        # the survivor still answers, verdicts still match the oracle
+        after = [fleet.check(h, model="cas-register",
+                             timeout=300)["valid"] for h in jobs[:2]]
+        assert after == oracle[:2], (
+            f"post-drain verdicts diverged: {after} != {oracle[:2]}")
+
+        snap = gov.snapshot()
+        fleet_snap = fleet.metrics.snapshot()
+        prom = render_prom(fleet_snap)
+        validate_exposition(prom)
+        assert fleet_snap["autoscale"]["counters"]["ups"] == 1
+        assert fleet_snap["autoscale"]["counters"]["downs"] == 1
+        assert "jepsen_tpu_governor_downs_total 1" in prom
+        report = {"up": up, "down": down,
+                  "counters": snap["counters"],
+                  "post_drain_verdicts": after}
+        return report, snap, prom
+    finally:
+        gov.close()
+        fleet.close()
+
+
+def phase_c():
+    """Tenant QoS: a saturating bulk tenant must not starve gold's p99,
+    and quota pressure resolves unknown — never false, never dropped."""
+    svc = CheckService(max_lanes=4)
+    svc.tenants.configure("bulk", quota=2, priority=0)
+    svc.tenants.configure("gold", priority=5,
+                          slo={"p99_us": GOLD_P99_US})
+
+    bulk_jobs = build_jobs(n=8, ops=60, base_seed=100)
+    gold_jobs = [cas_register_history(30, concurrency=3, seed=900 + s)
+                 for s in range(5)]
+    oracle = run_oracle(svc, bulk_jobs)      # also warms the engines
+
+    bulk_out = [None] * len(bulk_jobs)
+
+    def flood(span):
+        for i in span:
+            bulk_out[i] = svc.check(bulk_jobs[i], model="cas-register",
+                                    tenant="bulk", deadline_s=DEADLINE_S,
+                                    timeout=300)["valid"]
+
+    flooders = [threading.Thread(target=flood,
+                                 args=(range(j, len(bulk_jobs), 3),))
+                for j in range(3)]
+    for t in flooders:
+        t.start()
+    gold_wall = []
+    gold_out = []
+    for h in gold_jobs:
+        t0 = mono_now()
+        gold_out.append(svc.check(h, model="cas-register", tenant="gold",
+                                  deadline_s=DEADLINE_S,
+                                  timeout=300)["valid"])
+        gold_wall.append(round(mono_now() - t0, 3))
+    for t in flooders:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in flooders), "bulk flood hung"
+
+    # -- quota pressure: park bulk's whole quota, then push past it ------
+    assert svc.tenants.acquire("bulk", block=False)
+    assert svc.tenants.acquire("bulk", block=False)
+    try:
+        try:
+            svc.submit(bulk_jobs[0], model="cas-register", tenant="bulk",
+                       block=False)
+            raise AssertionError(
+                "over-quota non-blocking submit did not saturate")
+        except ServiceSaturated as e:
+            assert "quota" in str(e), e
+        expired = svc.check(bulk_jobs[0], model="cas-register",
+                            tenant="bulk", deadline_s=0.8, timeout=30)
+        assert expired["valid"] == "unknown", (
+            f"expiry-while-blocked must resolve unknown, never false: "
+            f"{expired}")
+        assert expired.get("deadline-expired"), expired
+    finally:
+        svc.tenants.release("bulk")
+        svc.tenants.release("bulk")
+
+    snap = svc.metrics.snapshot()
+    prom = render_prom(snap)
+    validate_exposition(prom)
+    svc.close()
+
+    mismatches, fabricated = parity(oracle, bulk_out)
+    gold_cut = snap["tenants"]["gold"]
+    bulk_cut = snap["tenants"]["bulk"]
+    report = {
+        "gold_wall_s": gold_wall, "gold_verdicts": gold_out,
+        "gold_cut": gold_cut, "bulk_cut": bulk_cut,
+        "bulk_mismatches": mismatches, "fabricated_false": fabricated,
+        "expired_under_quota": {"valid": expired["valid"],
+                                "deadline-expired":
+                                    expired.get("deadline-expired")},
+    }
+
+    assert not fabricated, (
+        f"fabricated false across tenants: {fabricated}")
+    assert not mismatches, f"bulk parity broken: {mismatches}"
+    assert all(v is True for v in gold_out), (
+        f"gold's valid histories must all pass: {gold_out}")
+    p99 = gold_cut.get("p99-dispatch-verdict-us")
+    assert p99 is not None and p99 <= GOLD_P99_US, (
+        f"bulk flood starved gold past its SLO: p99 {p99}us > "
+        f"{GOLD_P99_US}us")
+    assert bulk_cut.get("quota-rejections", 0) >= 1, bulk_cut
+    assert gold_cut.get("priority") == 5 and bulk_cut.get("quota") == 2
+    assert 'jepsen_tpu_tenant_requests_total{tenant="gold"}' in prom
+    assert "jepsen_tpu_tenant_quota_rejections_total" in prom
+    return report, prom
+
+
+def main():
+    report_path = (sys.argv[1] if len(sys.argv) > 1
+                   else "/tmp/governor_report.json")
+    decisions_path = (sys.argv[2] if len(sys.argv) > 2
+                      else "/tmp/governor_decisions.json")
+    prom_path = (sys.argv[3] if len(sys.argv) > 3
+                 else "/tmp/governor_metrics.prom")
+
+    tap = LogTap()
+    root = logging.getLogger()
+    root.addHandler(tap)
+    root.setLevel(logging.DEBUG)
+
+    jobs = build_jobs()
+    oracle_svc = CheckService(max_lanes=16)
+    oracle = run_oracle(oracle_svc, jobs)
+    oracle_svc.close()
+
+    report = {}
+    t0 = time.monotonic()
+    report["phase_a"], snap_a, prom_a = phase_a(jobs, oracle)
+    print(f"phase A (alert storm) ok: {len(report['phase_a']['actions'])} "
+          f"action(s), gaps {report['phase_a']['gaps_s']}")
+    with tempfile.TemporaryDirectory(prefix="governor-journal-") as jd:
+        report["phase_b"], snap_b, prom_b = phase_b(jobs[:6], oracle[:6], jd)
+    print("phase B (spawn + drain-clean scale-down) ok")
+    report["phase_c"], prom_c = phase_c()
+    print(f"phase C (tenant QoS) ok: gold walls "
+          f"{report['phase_c']['gold_wall_s']}s")
+    report["wall_s"] = round(time.monotonic() - t0, 3)
+
+    # flight recorder carries every scale decision
+    rec = RECORDER.snapshot()
+    scale_events = [e for e in rec if e.get("cat") == "scale"]
+    assert scale_events, "no scale events in the flight recorder"
+    report["flight_recorder_scale_events"] = len(scale_events)
+
+    decisions = {"phase_a": snap_a, "phase_b": snap_b}
+    prom_text = ("# ---- phase A (ProcFleet under storm) ----\n" + prom_a
+                 + "\n# ---- phase B (journaled Fleet) ----\n" + prom_b
+                 + "\n# ---- phase C (tenant service) ----\n" + prom_c)
+
+    # -- token hygiene: the whole point of the sentinel secrets ----------
+    artifacts = {
+        report_path: json.dumps(report, indent=2, default=str),
+        decisions_path: json.dumps(decisions, indent=2, default=str),
+        prom_path: prom_text,
+    }
+    surfaces = dict(artifacts)
+    surfaces["<captured logs>"] = "\n".join(tap.lines)
+    surfaces["<flight recorder>"] = json.dumps(rec, default=str)
+    for where, text in surfaces.items():
+        for secret in SECRETS:
+            assert secret not in text, (
+                f"token material leaked into {where}")
+    for path, text in artifacts.items():
+        with open(path, "w") as f:
+            f.write(text)
+
+    print(f"governor smoke ok in {report['wall_s']}s — report "
+          f"{report_path}, decisions {decisions_path}, prom {prom_path}; "
+          f"{len(tap.lines)} log lines and 3 artifacts clean of token "
+          f"material")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,440 @@
+"""Governor (serve/autoscale.py) + multi-tenant QoS (serve/tenants.py).
+
+Covers the autoscaler policy loop deterministically (injected signals +
+explicit clock: alert-storm hysteresis, one-action-per-cooldown, min/max
+bounds, structured scale requests), the live SLO retune contract
+(set_ceiling mid-breach re-evaluates the open episode against the new
+ceiling without double-firing), tenant quotas on the admission plane
+(over-quota blocked + deadline expiry resolves ``unknown`` — never
+false, never dropped — mirroring the global admission-vs-expiry test),
+priority ordering in the scheduler's sort key, the per-tenant metrics /
+Prometheus cut, fleet scale-up/drain-clean scale-down, and the tenant
+token envelope.  Everything runs on the CPU backend.
+"""
+
+import json
+
+import pytest
+
+from jepsen_tpu.obs.prom import render_prom, validate_exposition
+from jepsen_tpu.obs.slo import SloEngine, SloSpec
+from jepsen_tpu.obs.telemetry import TelemetryStore
+from jepsen_tpu.serve import CheckService, ServiceSaturated
+from jepsen_tpu.serve.autoscale import Autoscaler, AutoscalePolicy
+from jepsen_tpu.serve.auth import (resolve_frame_token, sign_frame,
+                                   tenant_names, tenant_tokens,
+                                   verify_frame)
+from jepsen_tpu.serve.fleet import Fleet
+from jepsen_tpu.serve.metrics import mono_now
+from jepsen_tpu.serve.request import Cell, Request
+from jepsen_tpu.serve.tenants import TenantTable
+from jepsen_tpu.synth import cas_register_history
+
+
+# -- autoscaler policy loop, deterministically ------------------------------
+
+
+class _SignalBox:
+    """Mutable signal source for Autoscaler(signals_fn=...)."""
+
+    def __init__(self, **sig):
+        self.sig = {"breaches": 0, "occupancy": 0.0, "oldest-wait-s": 0.0,
+                    "workers": 2, "journal-pending": 0}
+        self.sig.update(sig)
+
+    def __call__(self):
+        return dict(self.sig)
+
+
+def _policy(**kw):
+    base = dict(min_workers=1, max_workers=4, cooldown_s=10.0,
+                up_after_s=2.0, down_after_s=5.0, interval_s=0.5,
+                queue_high=0.8, queue_low=0.1, wait_high_s=10.0,
+                drain_timeout_s=5.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+class TestAutoscalerHysteresis:
+    def test_alert_storm_produces_no_actions(self):
+        # breach/recover oscillation faster than the hot-sustain window:
+        # the hysteresis clock resets on every recover, so N storm
+        # cycles produce ZERO scale actions — an autoscaler that chases
+        # alert storms is an outage amplifier
+        box = _SignalBox()
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        t = 0.0
+        for i in range(80):            # 40 s of 1 Hz flapping
+            box.sig["breaches"] = i % 2
+            # a recovered instant is genuinely quiet (occupancy 0)
+            gov.tick(now=t)
+            t += 0.5
+        c = gov.snapshot()["counters"]
+        assert c["ups"] == 0
+        # quiet never sustains either: the storm resets both clocks
+        assert c["downs"] == 0
+
+    def test_sustained_hot_scales_once_per_cooldown(self):
+        box = _SignalBox(breaches=1)
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        t = 0.0
+        while t <= 30.0:
+            gov.tick(now=t)
+            t += 0.5
+        snap = gov.snapshot()
+        ups = [d for d in snap["decisions"] if d["action"] == "up"]
+        # sustained breach for 30 s, cooldown 10 s, sustain 2 s:
+        # actions at ~2, ~12, ~22 — never two inside one cooldown window
+        assert len(ups) == 3, snap["decisions"]
+        ts = [d["t"] for d in ups]
+        assert all(b - a >= 10.0 for a, b in zip(ts, ts[1:]))
+        # fleetless governor emits structured scale requests instead
+        reqs = snap["scale-requests"]
+        assert len(reqs) == 3
+        assert all(r["action"] == "scale-up" and r["to"] == r["from"] + 1
+                   for r in reqs)
+
+    def test_bounded_by_max_workers(self):
+        box = _SignalBox(breaches=1, workers=4)   # already at max
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        for i in range(60):
+            gov.tick(now=i * 0.5)
+        assert gov.snapshot()["counters"]["ups"] == 0
+
+    def test_sustained_quiet_scales_down_to_min(self):
+        box = _SignalBox(workers=2)
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        t = 0.0
+        while t <= 8.0:                # quiet sustain 5 s
+            gov.tick(now=t)
+            t += 0.5
+        snap = gov.snapshot()
+        downs = [d for d in snap["decisions"] if d["action"] == "down"]
+        assert len(downs) == 1
+        # at the floor: quiet forever, no further downs
+        box.sig["workers"] = 1
+        while t <= 60.0:
+            gov.tick(now=t)
+            t += 0.5
+        assert gov.snapshot()["counters"]["downs"] == 1
+
+    def test_half_recovered_earns_neither_direction(self):
+        # occupancy between low and high, no breaches: not hot, not
+        # quiet — both clocks reset, nothing ever fires
+        box = _SignalBox(occupancy=0.5)
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        for i in range(100):
+            gov.tick(now=i * 0.5)
+        c = gov.snapshot()["counters"]
+        assert c["ups"] == 0 and c["downs"] == 0
+
+    def test_wait_age_signal_is_hot(self):
+        box = _SignalBox(**{"oldest-wait-s": 30.0})
+        gov = Autoscaler(fleet=None, policy=_policy(), signals_fn=box)
+        for i in range(10):
+            gov.tick(now=i * 0.5)
+        assert gov.snapshot()["counters"]["ups"] == 1
+
+    def test_scale_request_sink_and_clear(self):
+        got = []
+        box = _SignalBox(breaches=1)
+        gov = Autoscaler(fleet=None, policy=_policy(up_after_s=0.0),
+                         signals_fn=box, scale_request_sink=got.append)
+        gov.tick(now=0.0)
+        assert len(got) == 1 and got[0]["action"] == "scale-up"
+        assert len(gov.scale_requests()) == 1
+        assert len(gov.scale_requests(clear=True)) == 1
+        assert gov.scale_requests() == []
+
+
+# -- SLO retune: set_ceiling mid-breach -------------------------------------
+
+
+class TestSetCeilingRetune:
+    def _engine(self, value_box):
+        spec = SloSpec("test_sig", ceiling=50.0, burn_window_s=0.0,
+                       unit="u", description="test signal",
+                       value_fn=lambda store, worker, now: value_box["v"])
+        return SloEngine(TelemetryStore(interval_s=1.0), specs=[spec])
+
+    def test_retune_above_value_closes_and_rearms(self):
+        val = {"v": 100.0}
+        eng = self._engine(val)
+        assert len(eng.evaluate("w0")) == 1          # breach fires
+        assert len(eng.evaluate("w0")) == 0          # one per episode
+        # raising the ceiling puts the open episode back in-SLO: it
+        # closes immediately (no waiting for the next push) and re-arms
+        eng.set_ceiling("test_sig", 150.0)
+        assert eng.snapshot()["active-breaches"] == []
+        assert len(eng.evaluate("w0")) == 0          # 100 <= 150: in SLO
+        val["v"] = 200.0
+        assert len(eng.evaluate("w0")) == 1          # fresh episode fires
+        assert eng.snapshot()["fired-total"] == 2
+
+    def test_retune_still_breaching_never_double_fires(self):
+        val = {"v": 100.0}
+        eng = self._engine(val)
+        assert len(eng.evaluate("w0")) == 1
+        # tighten mid-breach: 100 still > 60 — the episode keeps its
+        # fired state, the retune must not fire a second alert
+        eng.set_ceiling("test_sig", 60.0)
+        assert len(eng.evaluate("w0")) == 0
+        assert eng.snapshot()["fired-total"] == 1
+        assert eng.snapshot()["active-breaches"] == ["test_sig:w0"]
+
+    def test_add_spec_replaces_in_place(self):
+        val = {"v": 10.0}
+        eng = self._engine(val)
+        eng.add_spec(SloSpec("extra", 5.0, 0.0, "u", "added later",
+                             value_fn=lambda s, w, n: val["v"]))
+        fired = eng.evaluate("w0")
+        assert [a["slo"] for a in fired] == ["extra"]   # 10 > 5, 10 <= 50
+
+
+# -- tenant quotas on the admission plane -----------------------------------
+
+
+class TestTenantQuota:
+    def test_over_quota_blocked_expiry_resolves_unknown(self):
+        # the PR 7 admission-vs-expiry contract, tenant edition: at
+        # quota AND the deadline expires while blocked on the quota —
+        # the request comes back already-done with unknown, never
+        # false, never dropped, never ServiceSaturated
+        svc = CheckService(max_lanes=8)
+        try:
+            svc.tenants.configure("bulk", quota=1)
+            assert svc.tenants.acquire("bulk", block=False)  # park the slot
+            try:
+                req = svc.submit(cas_register_history(10, seed=101),
+                                 kind="wgl", model="cas-register",
+                                 tenant="bulk", block=True, deadline_s=0.3)
+                assert req.done()
+                res = req.wait(timeout=5)
+                assert res["valid"] == "unknown"
+                assert res.get("deadline-expired") is True
+                snap = svc.metrics.snapshot()
+                # expiry under quota pressure is completion, not rejection
+                assert snap["counters"].get("requests-rejected", 0) == 0
+                cut = snap["tenants"]["bulk"]
+                assert cut["verdicts-unknown"] >= 1
+                assert cut["deadline-expired"] >= 1
+                assert cut["quota-rejections"] >= 1
+            finally:
+                svc.tenants.release("bulk")
+        finally:
+            svc.close(timeout=30.0)
+
+    def test_over_quota_nonblocking_saturates(self):
+        svc = CheckService(max_lanes=8)
+        try:
+            svc.tenants.configure("bulk", quota=1)
+            assert svc.tenants.acquire("bulk", block=False)
+            try:
+                with pytest.raises(ServiceSaturated, match="quota"):
+                    svc.submit(cas_register_history(10, seed=102),
+                               kind="wgl", model="cas-register",
+                               tenant="bulk", block=False)
+                assert svc.tenants.counts()["bulk"]["quota-rejections"] >= 1
+                assert svc.metrics.snapshot()["counters"][
+                    "requests-rejected"] >= 1
+            finally:
+                svc.tenants.release("bulk")
+        finally:
+            svc.close(timeout=30.0)
+
+    def test_quota_slot_released_on_finish(self):
+        svc = CheckService(max_lanes=8)
+        try:
+            svc.tenants.configure("gold", quota=1)
+            for seed in (103, 104):   # second submit needs the freed slot
+                res = svc.check(cas_register_history(10, seed=seed),
+                                kind="wgl", model="cas-register",
+                                tenant="gold", timeout=60)
+                assert res["valid"] is True
+            counts = svc.tenants.counts()["gold"]
+            assert counts["open"] == 0
+            assert counts["admitted"] == 2
+        finally:
+            svc.close(timeout=30.0)
+
+    def test_untracked_tenant_and_none_bypass(self):
+        t = TenantTable()
+        assert t.acquire(None, block=False)
+        assert t.acquire("anyone", block=False)   # no spec: unlimited
+        t.release("anyone")
+        t.release(None)
+
+    def test_from_env_parses_policy(self):
+        env = {"JEPSEN_TPU_TENANT_QUOTA": "8",
+               "JEPSEN_TPU_TENANT_QUOTA_BULK_LOADER": "2",
+               "JEPSEN_TPU_TENANT_PRIORITY_GOLD": "5",
+               "JEPSEN_TPU_TENANT_SLO_P99_US_GOLD": "2000000",
+               "JEPSEN_TPU_TENANT_TOKENS": "gold:g-secret,edge:e-secret"}
+        t = TenantTable.from_env(env)
+        counts = t.counts()
+        # names discovered from env keys AND from issued tokens
+        assert set(counts) == {"bulk-loader", "gold", "edge"}
+        assert counts["bulk-loader"]["quota"] == 2
+        assert counts["gold"]["quota"] == 8        # env default
+        assert counts["gold"]["priority"] == 5
+        assert t.slo_config() == {"gold": {"p99_us": 2000000.0}}
+        # the table never holds token material
+        assert "secret" not in json.dumps(counts)
+        assert "secret" not in json.dumps(t.slo_config())
+
+
+class TestTenantPriority:
+    def _cell(self, priority, deadline_s, seq):
+        req = Request(cas_register_history(4, seed=1), "wgl", {},
+                      deadline_s=deadline_s, priority=priority)
+        return Cell(request=req, history=req.history, seq=seq)
+
+    def test_sort_key_priority_then_deadline_then_fifo(self):
+        hi = self._cell(5, 60.0, seq=3)
+        lo_tight = self._cell(0, 1.0, seq=1)
+        lo_loose = self._cell(0, None, seq=0)
+        lo_loose2 = self._cell(0, None, seq=2)
+        order = sorted([lo_loose2, lo_loose, lo_tight, hi],
+                       key=lambda c: c.sort_key())
+        assert order[0] is hi                      # class outranks deadline
+        assert order[1] is lo_tight                # deadline within a class
+        assert order[2] is lo_loose and order[3] is lo_loose2   # FIFO
+
+    def test_service_stamps_tenant_priority(self):
+        svc = CheckService(max_lanes=8)
+        try:
+            svc.tenants.configure("gold", priority=7)
+            req = svc.submit(cas_register_history(10, seed=105),
+                             kind="wgl", model="cas-register",
+                             tenant="gold")
+            assert req.priority == 7 and req.tenant == "gold"
+            assert req.wait(timeout=60)["valid"] is True
+        finally:
+            svc.close(timeout=30.0)
+
+
+# -- per-tenant metrics + Prometheus cut ------------------------------------
+
+
+class TestTenantExport:
+    def test_snapshot_and_prom_carry_tenant_cut(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_TENANT_TOKENS",
+                           "gold:prom-test-secret-material")
+        svc = CheckService(max_lanes=8)
+        try:
+            assert svc.check(cas_register_history(20, seed=106),
+                             kind="wgl", model="cas-register",
+                             tenant="gold", timeout=60)["valid"] is True
+            snap = svc.metrics.snapshot()
+            cut = snap["tenants"]["gold"]
+            assert cut["requests-completed"] >= 1
+            assert cut["p99-dispatch-verdict-us"] is not None
+            assert "queue" in snap and "oldest-wait-s" in snap["queue"]
+            assert "queue-oldest-wait-s" in snap["gauges"]
+            text = render_prom(snap)
+            families = validate_exposition(text)
+            assert 'jepsen_tpu_tenant_requests_total{tenant="gold"}' in text
+            assert "jepsen_tpu_tenant_p99_dispatch_verdict_seconds" in text
+            assert "jepsen_tpu_queue_oldest_wait_s" in text
+            assert "jepsen_tpu_tenant_quota_rejections_total" in text
+            assert families
+            # SEC01's dynamic twin: no token material in any export
+            assert "prom-test-secret-material" not in text
+            assert "prom-test-secret-material" not in json.dumps(
+                snap, default=str)
+        finally:
+            svc.close(timeout=30.0)
+
+
+# -- fleet scale plane ------------------------------------------------------
+
+
+class TestFleetScale:
+    def test_add_worker_and_drain_clean_decommission(self):
+        f = Fleet(workers=1, max_lanes=8, pin_devices=False)
+        try:
+            w = f.add_worker()
+            assert w.wid == 1
+            assert f.active_workers() == 2
+            assert f.check(cas_register_history(20, seed=107),
+                           kind="wgl", model="cas-register",
+                           timeout=60)["valid"] is True
+            dec = f.decommission_worker(1, timeout_s=10.0)
+            assert dec["drained"] is True
+            assert dec["journal-pending"] == 0
+            assert f.workers[1].retired
+            assert f.active_workers() == 1
+            # the surviving slot still serves, verdicts unchanged
+            assert f.check(cas_register_history(20, seed=108),
+                           kind="wgl", model="cas-register",
+                           timeout=60)["valid"] is True
+            c = f.metrics.snapshot()["counters"]
+            assert c["workers-added"] >= 1
+            assert c["workers-decommissioned"] >= 1
+        finally:
+            f.close()
+
+    def test_governor_spawns_through_fleet(self):
+        f = Fleet(workers=1, max_lanes=8, pin_devices=False)
+        try:
+            box = _SignalBox(breaches=1, workers=1)
+            gov = Autoscaler(fleet=f, policy=_policy(up_after_s=0.0),
+                             signals_fn=box)
+            d = gov.tick(now=mono_now())
+            assert d is not None and d["mode"] == "spawn"
+            assert len(f.workers) == 2
+            # the governor's state rides the fleet /metrics snapshot
+            snap = f.metrics.snapshot()
+            assert snap["autoscale"]["counters"]["ups"] == 1
+            text = render_prom(snap)
+            validate_exposition(text)
+            assert "jepsen_tpu_governor_ups_total 1" in text
+            assert "jepsen_tpu_governor_scale_requests_pending 0" in text
+        finally:
+            f.close()
+
+    def test_queue_occupancy_shape(self):
+        svc = CheckService(max_lanes=8)
+        try:
+            occ = svc._sched.occupancy()
+            assert occ == {"depth": 0, "buckets": {}, "oldest-wait-s": 0.0}
+        finally:
+            svc.close(timeout=30.0)
+
+
+# -- tenant token envelope --------------------------------------------------
+
+
+class TestTenantAuth:
+    def test_tenant_tokens_parsing_skips_malformed(self):
+        env = {"JEPSEN_TPU_TENANT_TOKENS":
+               "a:one, b:two ,malformed, :nameless, empty: "}
+        assert tenant_tokens(env) == {"a": "one", "b": "two"}
+        assert tenant_names(env) == ("a", "b")
+
+    def test_resolve_frame_token_fail_closed(self):
+        env = {"JEPSEN_TPU_FLEET_TOKEN": "fleet-secret",
+               "JEPSEN_TPU_TENANT_TOKENS": "gold:gold-secret"}
+        tok, known = resolve_frame_token({"tenant": "gold"}, env)
+        assert (tok, known) == ("gold-secret", True)
+        # a claimed tenant with no issued token must NOT fall back to
+        # fleet-level (or unauthenticated) acceptance
+        tok, known = resolve_frame_token({"tenant": "ghost"}, env)
+        assert (tok, known) == (None, False)
+        tok, known = resolve_frame_token({"type": "SUBMIT"}, env)
+        assert (tok, known) == ("fleet-secret", True)
+        # no tenant tokens configured: tenant frames verify fleet-wide
+        env2 = {"JEPSEN_TPU_FLEET_TOKEN": "fleet-secret"}
+        tok, known = resolve_frame_token({"tenant": "gold"}, env2)
+        assert (tok, known) == ("fleet-secret", True)
+
+    def test_mac_binds_tenant_identity(self):
+        frame = sign_frame({"type": "SUBMIT", "tenant": "gold",
+                            "payload": {"n": 1}}, "gold-secret")
+        assert verify_frame(frame, "gold-secret")
+        # the tenant field is inside the digest: a mac minted for one
+        # tenant cannot be replayed as another
+        stolen = dict(frame)
+        stolen["tenant"] = "edge"
+        assert not verify_frame(stolen, "gold-secret")
+        assert not verify_frame(frame, "edge-secret")

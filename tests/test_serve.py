@@ -422,7 +422,7 @@ class TestMetricsSchema:
     #: the exact top-level sections of Metrics.snapshot()
     SECTIONS = {"counters", "gauges", "occupancy", "histograms",
                 "engine-cache", "megabatch", "flight-recorder", "traces",
-                "fission"}
+                "fission", "queue", "tenants"}
     #: the counters seeded at construction (inc() may add more)
     SEED_COUNTERS = {"requests-submitted", "requests-completed",
                      "requests-rejected", "cells-submitted",
@@ -440,7 +440,11 @@ class TestMetricsSchema:
         assert "hist-merge-skipped" in snap["counters"]
         assert set(snap["gauges"]) == {"queue-depth", "inflight-requests",
                                        "compiles-per-1k-dispatches",
-                                       "epochs-behind-live"}
+                                       "epochs-behind-live",
+                                       "queue-oldest-wait-s"}
+        # the Governor's wait-age input: per-bucket depths + oldest age
+        assert {"depth", "buckets", "oldest-wait-s"} <= set(snap["queue"])
+        assert isinstance(snap["tenants"], dict)
         # the steady-state compile gauge is a ratio (or None pre-dispatch)
         c1k = snap["gauges"]["compiles-per-1k-dispatches"]
         assert c1k is None or c1k >= 0.0
@@ -520,6 +524,10 @@ class TestMetricsSchema:
                         if g is not None and not (isinstance(g, float)
                                                   and g >= 0.0):
                             errors.append(f"compile gauge torn: {g}")
+                    elif name == "queue-oldest-wait-s":
+                        # a wall-age gauge: non-negative float seconds
+                        if not isinstance(g, float) or g < 0.0:
+                            errors.append(f"wait-age gauge torn: {g}")
                     elif not isinstance(g, int) or g < 0:
                         errors.append(f"gauge not a point sample: {g}")
         finally:

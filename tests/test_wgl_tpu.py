@@ -490,6 +490,38 @@ class TestMultiRegisterDevice:
         with pytest.raises(ValueError):
             get_model("multi-register", keys=16, vbits=4)
 
+    def test_string_key_rejected_not_coerced(self):
+        # r5 advice regression: encode used int(k)/int(v) on raw keys,
+        # so a string key "1" silently became device key 1 while the
+        # host MultiRegister compares raw keys ("1" != 1) — the tiers
+        # could disagree on the same history.  Non-integral keys and
+        # values must refuse to encode; the facade then falls back to
+        # the host oracle, which handles arbitrary keys correctly.
+        m = self._model()
+        with pytest.raises(ValueError, match="non-int key"):
+            m.encode_op(mk(0, INVOKE, "write", [["1", 3]]))
+        with pytest.raises(ValueError, match="non-int value"):
+            m.encode_op(mk(0, OK, "read", [[0, "3"]]))
+        # bools ARE integral (True == 1 on both tiers): still encode
+        f, a, b = m.encode_op(mk(0, INVOKE, "write", [[True, 1]]))
+        assert a == 0b010 and b == (1 << 4)
+
+    def test_string_key_history_falls_back_to_host(self):
+        # end to end through the competition facade: a string-keyed
+        # history must produce the HOST verdict (with the fallback chain
+        # annotated), not a silently-coerced device verdict
+        from jepsen_tpu.checker.linearizable import Linearizable
+        ops = [
+            mk(0, INVOKE, "write", [["k", 1]]),
+            mk(0, OK, "write", [["k", 1]]),
+            mk(1, INVOKE, "read", [["k", None]]),
+            mk(1, OK, "read", [["k", 1]]),
+        ]
+        res = Linearizable(self._model(), algorithm="tpu").check(
+            None, History(ops))
+        assert res["valid"] is True
+        assert res.get("fallback-chain"), res
+
 
 class TestTiledFullMerge:
     def test_full_merge_tiled_matches(self, monkeypatch):
