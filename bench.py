@@ -92,6 +92,7 @@ TIER_TIMEOUT_S = {
     "procfleet": 420 if SMOKE else 1200,
     "obs": 300 if SMOKE else 900,
     "elastic": 300 if SMOKE else 900,
+    "fleetfission": 420 if SMOKE else 1200,
 }
 
 
@@ -1166,6 +1167,87 @@ def tier_elastic():
           "auth_rejections": snap["counters"].get("auth-rejections", 0)})
 
 
+def tier_fleetfission():
+    """Hydra tier: giant bitset ceiling histories (2^8-wide frontiers —
+    arXiv 2410.04581's undedupable shape) checked three ways: the CPU
+    oracle, single-worker window fission at an unpinned ceiling, and the
+    3-worker fleet with the per-worker ceiling pinned to 64 configs so
+    no lone worker can decide any of them — the verdict only exists
+    because the scatter plane fans component projections across the
+    fleet and recombines under the unknown-never-false table.  Reports
+    the scatter wall against the single-worker wall and the plane
+    counters that /metrics exposes."""
+    from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+    from jepsen_tpu.engine import fission
+    from jepsen_tpu.models import get_model
+    from jepsen_tpu.serve import fission_plane
+    from jepsen_tpu.serve.fleet import Fleet
+    from jepsen_tpu.synth import bitset_ceiling_history
+    # the orchestrator pins these in the tier subprocess's env before
+    # any engine import; a direct --tier run must bring its own pins
+    assert os.environ.get("JTPU_FLEETFISSION_THRESHOLD") == "16", \
+        "fleetfission tier needs its env pins (run via the orchestrator)"
+    n = 4 if SMOKE else 8
+    worker_cap = int(os.environ["JTPU_FISSION_THRESHOLD"])
+    m = get_model("bitset")
+    hists = [bitset_ceiling_history(8, n_clean=3 + (s % 4), concurrency=2)
+             for s in range(n)]
+    oracle = [wgl_cpu.check(m.cpu_model(), h)["valid"] for h in hists]
+
+    # premise: at the pinned worker ceiling every giant overflows
+    progress("fleetfission: proving the per-worker ceiling premise")
+    for h in hists:
+        r = wgl_tpu.check(m, h, capacity=worker_cap,
+                          max_capacity=worker_cap)
+        assert r["valid"] == "unknown" and r.get("capacity-exceeded"), \
+            "premise broken: a single worker's ceiling decided a giant"
+
+    # single-worker baseline: window fission, ceiling unpinned
+    def run_single():
+        return [fission.split_check(m, h, capacity=16, max_capacity=65536,
+                                    threshold=32)["valid"] for h in hists]
+
+    run_single()                                # warm the engines
+    t0 = time.time()
+    v_single = run_single()
+    t_single = time.time() - t0
+    assert v_single == oracle, "single-worker fission diverged from oracle"
+
+    fleet = Fleet(workers=3, max_lanes=16, capacity=worker_cap,
+                  hedge_s=5.0, default_deadline_s=240.0)
+    try:
+        def run_fleet():
+            reqs = [fleet.submit(h, kind="wgl", model="bitset",
+                                 deadline_s=240.0) for h in hists]
+            return [r.wait(timeout=300) for r in reqs]
+
+        progress("fleetfission: warm fleet pass")
+        run_fleet()
+        t0 = time.time()
+        out = run_fleet()
+        t_fleet = time.time() - t0
+        v_fleet = [r["valid"] for r in out]
+        assert v_fleet == oracle, "fleet-scattered verdicts diverged"
+        assert all((r.get("fission") or {}).get("distributed")
+                   for r in out), "a giant never scattered"
+        snap = fleet.metrics.snapshot()
+        plane = fission_plane.plane_stats()
+    finally:
+        fleet.close(timeout=60.0)
+    emit({"n_histories": n,
+          "events_per_history": [len(h.ops) for h in hists],
+          "worker_ceiling": worker_cap,
+          "single_s": round(t_single, 3),
+          "fleet_s": round(t_fleet, 3),
+          "scatter_overhead": (round(t_fleet / t_single, 2)
+                               if t_single else None),
+          "scattered": plane.get("scattered", 0),
+          "remote_subproblems": plane.get("remote-subproblems", 0),
+          "cancelled": plane.get("cancelled", 0),
+          "witness_recoveries": plane.get("witness-recoveries", 0),
+          "hedges": snap["counters"].get("hedges", 0)})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -1185,6 +1267,7 @@ TIER_FNS = {
     "procfleet": tier_procfleet,
     "obs": tier_obs,
     "elastic": tier_elastic,
+    "fleetfission": tier_fleetfission,
 }
 
 
@@ -1201,6 +1284,11 @@ def run_tier(name: str) -> dict:
         env["JTPU_SUBSUME"] = "1"
     elif name == "ablation_off":
         env["JTPU_SUBSUME"] = "0"
+    elif name == "fleetfission":
+        # pinned BEFORE the tier subprocess imports any engine: every
+        # worker's WGL ceiling is 64 configs, scatter threshold 16 events
+        env["JTPU_FISSION_THRESHOLD"] = "64"
+        env["JTPU_FLEETFISSION_THRESHOLD"] = "16"
     t0 = time.time()
     stderr_tail: list = []
 

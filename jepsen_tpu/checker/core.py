@@ -254,8 +254,9 @@ unbridled_optimism = NoopChecker  # the reference's cheekily-named default
 
 
 class Stats(Checker):
-    """Per-f ok/fail/info/crash counts; valid unless some f never succeeded
-    (checker.clj:166-183)."""
+    """Per-f ok/fail/info/crash counts; some f never succeeding degrades
+    the verdict to unknown (a deliberate softening of checker.clj:166-183,
+    which fails it — see the block comment in :meth:`check`)."""
 
     def check(self, test, history, opts=None):
         by_f: Dict[Any, _Counter] = defaultdict(_Counter)
@@ -265,11 +266,19 @@ class Stats(Checker):
                 continue
             by_f[op.f][op.type] += 1
             total[op.type] += 1
-        # Per-f verdicts, reference-style (checker.clj:145-183: stats- puts
-        # a :valid? in every by-f block and the top level merges them): an
-        # f that never succeeded is UNKNOWN *in its own block* — the block
-        # is self-documenting, no top-level error string shouting at
-        # whoever reads a passing run's artifact under incident pressure.
+        # Per-f verdict STRUCTURE is reference-style (checker.clj:145-183:
+        # stats- puts a :valid? in every by-f block and the top level
+        # merges them), but the zero-OK VERDICT deliberately diverges: the
+        # reference sets ``:valid? (pos? ok-count)`` — an f that never
+        # succeeded makes the block (and thus the run) *false*.  Here it
+        # is UNKNOWN: zero successes is evidence of a broken client or
+        # nemesis schedule, not of a consistency violation, and this
+        # repo's false-means-witnessed discipline (every False carries a
+        # refuting op; docs/fission.md) has no witness to attach.  The
+        # self-documenting block still flags WHICH f starved — no
+        # top-level error string shouting at whoever reads a passing
+        # run's artifact under incident pressure.  Pinned by
+        # tests/test_checkers.py::TestStats.
         blocks = {}
         never = False
         for f, c in by_f.items():

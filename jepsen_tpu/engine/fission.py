@@ -221,7 +221,7 @@ def split_check(model: JaxModel, history: History,
         res = _escalate(model, history, capacity=capacity,
                         max_capacity=max_capacity, explain=explain,
                         why=f"fission error: {type(e).__name__}: {e}",
-                        **opts)
+                        threshold=thr, **opts)
     dt = time.monotonic() - t0
     HISTS.observe("fission:split", dt)
     RECORDER.record("fission", "split", dur_s=dt,
@@ -446,13 +446,15 @@ def _ghost_split(model: JaxModel, history: History, *, capacity: int,
     if ghosts is None or not ghosts:
         return _escalate(model, history, capacity=capacity,
                          max_capacity=max_capacity, explain=explain,
-                         why="no ghosts to split on", **opts)
+                         why="no ghosts to split on",
+                         threshold=threshold, **opts)
     k = len(ghosts)
     if (1 << k) > max_subproblems:
         return _escalate(model, history, capacity=capacity,
                          max_capacity=max_capacity, explain=explain,
                          why=f"2^{k} ghost variants exceed the "
-                             f"{max_subproblems} sub-problem cap", **opts)
+                             f"{max_subproblems} sub-problem cap",
+                         threshold=threshold, **opts)
     _bump(ghost_splits=1, ghost_subproblems=1 << k)
     RECORDER.record("fission", "ghost-split",
                     args={"ghosts": k, "variants": 1 << k})
@@ -495,7 +497,8 @@ def _ghost_split(model: JaxModel, history: History, *, capacity: int,
     # false, if that overflows too).
     return _escalate(model, history, capacity=capacity,
                      max_capacity=max_capacity, explain=explain,
-                     why="ghost case-split indefinite", **opts)
+                     why="ghost case-split indefinite",
+                     threshold=threshold, **opts)
 
 
 # ---------------------------------------------------------------------------
@@ -558,11 +561,16 @@ def _mega_events_max() -> int:
 
 def _escalate(model: JaxModel, history: History, *, capacity: int,
               max_capacity: int, explain: bool, why: str,
+              threshold: Optional[int] = None,
               **opts: Any) -> Dict[str, Any]:
     """The pre-fission behavior: escalate the monolithic frontier to the
     caller's real ceiling.  Taken only when neither splitter applies or
     the split could not decide — fission never returns a worse verdict
-    than the escalation ladder would have."""
+    than the escalation ladder would have.  When even the real ceiling
+    overflows and a ``threshold`` is known, the window-shrinking recursion
+    (engine.shrink, arXiv 2410.04581) gets one last shot at a refutation
+    on threshold-sized prefixes; its False-or-unknown envelope means this
+    can only improve the verdict, never change a concluded one."""
     from jepsen_tpu.checker import wgl_tpu
     _bump(escalations=1)
     RECORDER.record("fission", "escalate", args={"why": why})
@@ -571,4 +579,16 @@ def _escalate(model: JaxModel, history: History, *, capacity: int,
                         max_capacity=max_capacity, explain=explain, **opts)
     HISTS.observe("fission:escalate", time.monotonic() - t0)
     res.setdefault("fission", {"mode": "escalate", "why": why})
+    if threshold is not None and res.get("valid") not in (True, False) \
+            and (res.get("capacity-exceeded")
+                 or "capacity exceeded" in str(res.get("error", ""))):
+        from jepsen_tpu.engine import shrink
+        if shrink.shrink_enabled():
+            sres = shrink.shrink_check(model, history, threshold=threshold,
+                                       capacity=min(capacity, threshold),
+                                       explain=explain, **opts)
+            if sres.get("valid") is False:
+                # witness: shrink refutation carries the refuting prefix's op + witness (engine.shrink soundness)
+                sres["fission"]["escalate-why"] = why
+                return sres
     return res

@@ -1,0 +1,201 @@
+"""Window-shrinking recursion: refute a giant un-splittable search.
+
+Fission (engine.fission) handles an overflowing frontier by splitting it
+— per-key components (arXiv 1504.00204) or ghost case-splits
+(arXiv 2410.04581).  When neither splitter applies (one giant connected
+component, too many ghosts to enumerate), the pre-fission behavior is a
+monolithic escalation to the caller's real ceiling — a capacity a fleet
+worker may simply not have.  This module is the third fallback, the
+decrease-and-conquer recursion of arXiv 2410.04581 applied to the
+*event window* instead of the crash set: recursively narrow the checked
+prefix of the history until the frontier fits the threshold, hunting
+for a refutation the full-width search could not reach.
+
+Soundness (why a prefix refutation is a real refutation): take the
+prefix of the first ``m`` op records.  Ops invoked before the cut but
+completed after it lose their completions and are treated as
+concurrent-to-the-end (exactly the crashed-op semantics every checker
+in this repo already implements).  If the full history were
+linearizable, ordering its witness by linearization points and
+truncating at the cut yields a legal sequential prefix containing every
+op completed before the cut, no op invoked after it, and some subset of
+the cut-spanning ops — which is precisely a crash-semantics
+linearization of the prefix.  Contrapositive: a refuted prefix refutes
+the whole history.  The converse direction does NOT hold — a passing
+prefix proves nothing about the suffix — so the shrink verdict envelope
+is **False (with the prefix's witness) or unknown, never True**: the
+recursion widens the window after a pass, narrows it after an overflow,
+and gives up (``unknown``) when the interval closes without a
+refutation.  Unknown-never-false holds on every path.
+
+Knobs (README env table): ``JTPU_SHRINK`` (default on — engaged only
+after escalation has already failed), ``JTPU_SHRINK_DEPTH`` (default 6
+prefix probes), ``JTPU_SHRINK_MIN_EVENTS`` (default 64 — the narrowest
+window worth checking).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from jepsen_tpu.history import History
+from jepsen_tpu.models.base import JaxModel
+from jepsen_tpu.obs.hist import HistogramSet
+from jepsen_tpu.obs.recorder import RECORDER
+
+ANALYZER = "wgl-tpu-shrink"
+
+DEFAULT_DEPTH = 6
+DEFAULT_MIN_EVENTS = 64
+
+#: Per-probe wall-clock histogram, merged into the /metrics fission
+#: section beside engine.fission's.
+HISTS = HistogramSet()
+
+
+def shrink_enabled() -> bool:
+    return os.environ.get("JTPU_SHRINK", "1").lower() \
+        not in ("0", "false", "no", "off", "")
+
+
+def shrink_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("JTPU_SHRINK_DEPTH",
+                                         DEFAULT_DEPTH)))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+def shrink_min_events() -> int:
+    try:
+        return max(2, int(os.environ.get("JTPU_SHRINK_MIN_EVENTS",
+                                         DEFAULT_MIN_EVENTS)))
+    except ValueError:
+        return DEFAULT_MIN_EVENTS
+
+
+# ---------------------------------------------------------------------------
+# Counters (fission_stats idiom; exported in the /metrics fission section)
+# ---------------------------------------------------------------------------
+
+_STATS_LOCK = threading.Lock()
+
+
+def _zero_stats() -> Dict[str, int]:
+    return {"shrink_checks": 0, "shrink_probes": 0,
+            "shrink_refutes": 0, "shrink_exhausted": 0}
+
+
+_STATS = _zero_stats()
+
+
+def shrink_stats() -> Dict[str, int]:
+    """Counters over every shrink recursion in this process: recursions
+    entered, prefix probes run, refutations found, and recursions that
+    closed their interval without concluding."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_shrink_stats() -> None:
+    with _STATS_LOCK:
+        _STATS.update(_zero_stats())
+
+
+def _bump(**kw: int) -> None:
+    with _STATS_LOCK:
+        for k, v in kw.items():
+            _STATS[k] += v
+
+
+# ---------------------------------------------------------------------------
+# The recursion
+# ---------------------------------------------------------------------------
+
+def prefix_history(history: History, m: int) -> History:
+    """The first ``m`` op records as a standalone history.  Invokes whose
+    completions fall past the cut stay open — prepare() treats them as
+    crashed (concurrent-to-the-end), which is exactly the weakening the
+    soundness argument in the module docstring needs."""
+    return History(list(history.ops[:m]), reindex=True)
+
+
+def shrink_check(model: JaxModel, history: History, *,
+                 threshold: int,
+                 capacity: int = 256,
+                 max_depth: Optional[int] = None,
+                 min_events: Optional[int] = None,
+                 explain: bool = True, **opts: Any) -> Dict[str, Any]:
+    """Hunt for a refutation of ``history`` inside prefixes whose
+    frontiers fit ``threshold``.
+
+    Returns a refuted result (op + witness, both derived on the refuting
+    prefix only) or ``unknown`` — never True: a prefix pass widens the
+    probe window instead of concluding.  ``capacity`` seeds each probe's
+    ladder; remaining kwargs pass through to ``wgl_tpu.check``."""
+    from jepsen_tpu.checker import wgl_tpu
+    depth = max_depth if max_depth is not None else shrink_depth()
+    floor = min_events if min_events is not None else shrink_min_events()
+    h = history.client_ops()
+    n = len(h.ops)
+    _bump(shrink_checks=1)
+    t0 = time.monotonic()
+    lo, hi = min(floor, n), n          # (lo..hi]: the probe interval
+    m = max(lo, n // 2)
+    probes = 0
+    windows = []
+    while probes < depth and lo < hi:
+        probes += 1
+        p = prefix_history(h, m)
+        tp = time.monotonic()
+        r = wgl_tpu.check(model, p, capacity=min(capacity, threshold),
+                          max_capacity=threshold, explain=explain, **opts)
+        HISTS.observe("fission:shrink-probe", time.monotonic() - tp)
+        windows.append({"events": m, "valid": r.get("valid"),
+                        "configs-explored": r.get("configs-explored", 0)})
+        if r.get("valid") is False:
+            _bump(shrink_probes=probes, shrink_refutes=1)
+            RECORDER.record("fission", "shrink-refute",
+                            dur_s=time.monotonic() - t0,
+                            args={"events": m, "probes": probes})
+            # The prefix's refutation IS the history's (prefix closure
+            # under crash semantics — module docstring); op and witness
+            # come from the refuting prefix only.
+            # witness: refuting prefix's op + witness attached verbatim; prefix refutation is sound for the whole history
+            out = {"valid": False, "analyzer": ANALYZER,
+                   "op": r.get("op"),
+                   "configs-explored": int(r.get("configs-explored", 0)
+                                           or 0),
+                   "fission": {"mode": "shrink", "events": m,
+                               "probes": probes, "windows": windows}}
+            if "witness" in r:
+                out["witness"] = r["witness"]
+            return out
+        if r.get("capacity-exceeded") \
+                or "capacity exceeded" in str(r.get("error", "")):
+            hi = m                     # too wide: narrow the window
+            m = max(lo, (lo + m) // 2)
+            if m >= hi:
+                break
+        elif r.get("valid") is True:
+            lo = m                     # passes: the refutation (if any)
+            m = (m + hi + 1) // 2      # needs more events — widen
+            if m > hi or m == lo:
+                break
+        else:
+            break                      # a non-overflow unknown (budget,
+            #                            deadline) — shrinking won't help
+    _bump(shrink_probes=probes, shrink_exhausted=1)
+    RECORDER.record("fission", "shrink-exhausted",
+                    dur_s=time.monotonic() - t0,
+                    args={"probes": probes, "lo": lo, "hi": hi})
+    return {"valid": "unknown", "analyzer": ANALYZER,
+            "error": f"shrink recursion exhausted after {probes} prefix "
+                     f"probe(s) without a refutation",
+            "configs-explored": sum(int(w.get("configs-explored", 0) or 0)
+                                    for w in windows),
+            "fission": {"mode": "shrink", "probes": probes,
+                        "windows": windows}}

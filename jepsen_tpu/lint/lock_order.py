@@ -112,6 +112,13 @@ LOCK_ORDER: Tuple[Tuple[str, List[Tuple[str, str]]], ...] = (
      [(r"monitor/tap\.py$", r"^self\._lock$")]),
     ("engine-cache",
      [(r"engine/cache\.py$", r"^self\._lock$")]),
+    # the fission planes' stats-counter locks (fleet edge and the
+    # engine's shrink recursion): _bump/snapshot only — touched from
+    # under fleet/scheduler/metrics code, so leaves by construction
+    ("fission-plane",
+     [(r"serve/fission_plane\.py$", r"^_STATS_LOCK$")]),
+    ("shrink",
+     [(r"engine/shrink\.py$", r"^_STATS_LOCK$")]),
     ("obs-hist",
      [(r"obs/hist\.py$", r"^self\._lock$"),
       (r"obs/hist\.py$", r"^_MERGE_LOCK$")]),

@@ -95,7 +95,10 @@ def interp_rules():
       never reaches logs, exceptions, metrics, frames outside the
       ``auth`` field, or files;
     - DL01   — deadlines cross process boundaries only as remaining
-      budget, never as wall-clock or absolute monotonic values.
+      budget, never as wall-clock or absolute monotonic values;
+    - SOUND02 — unknown-never-false dataflow-proven across the fission
+      merge surface: any 'valid: False' sub-result reaching a
+      recombined verdict flows through a witness-bearing site.
     """
-    from jepsen_tpu.lint.rules import conc02, dl01, sec01
-    return (conc02, sec01, dl01)
+    from jepsen_tpu.lint.rules import conc02, dl01, sec01, sound02
+    return (conc02, sec01, dl01, sound02)

@@ -1,0 +1,325 @@
+"""SOUND02: unknown-never-false, proven across fission merge sites.
+
+SOUND01 audits each ``valid: False`` construction lexically — an inline
+``# witness:`` annotation or a whitelist entry attests that evidence
+rides along.  Fission recombination raised the stakes: a verdict now
+*flows* — a sub-problem's False crosses ``engine/fission.py`` merge
+loops, the ``engine/shrink.py`` prefix recursion, the fleet-side
+``serve/aggregate.py`` recombiner, and the ``serve/fission_plane.py``
+witness-recovery seam before a caller sees it.  An annotation on the
+construction says nothing about the *path*: a merge function that does
+``if r.get("valid") is False: return r`` launders an unwitnessed child
+refutation into a recombined verdict without constructing anything.
+
+This rule therefore dataflow-proves the table contract from
+docs/fission.md over the call graph, in the fission subsystems only
+(:data:`SCOPE`):
+
+- **construction sites** (dict literal ``{"valid": False}`` or a
+  ``result["valid"] = False`` store) must be *witness-bearing*: carry
+  literal ``"op"`` and ``"witness"`` keys, sit under a dominating guard
+  that tests both ``"op" in r`` and ``"witness" in r``, or carry the
+  SOUND01 ``# witness:`` annotation.  Inside an ``except`` handler the
+  site is a finding regardless — exception paths have no witness;
+- **pass-through returns** — ``return r`` on a refutation path (an
+  enclosing ``... is False`` guard) — must either sit under a
+  witness-presence guard, or return a value produced by an in-scope
+  callee, in which case the obligation follows the call edge: if that
+  callee has any unwitnessed False path, the whole chain is reported
+  with its symbols (``aggregate.py::merge -> shrink.py::probe``).
+
+Like DL01, the rule reports positively-detected violations only:
+unknown provenance (dynamic dispatch, out-of-scope callees — SOUND01's
+jurisdiction) is not a finding.  Messages are line-free symbol chains,
+keying the baseline ledger on (rule, path, message).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint.callgraph import CallGraph, FuncInfo
+from jepsen_tpu.lint.findings import Finding
+
+RULE = "SOUND02"
+
+#: The fission merge surface: every module a sub-verdict crosses between
+#: a worker's refutation and the recombined verdict a caller sees.
+SCOPE = (
+    "jepsen_tpu/engine/fission.py",
+    "jepsen_tpu/engine/shrink.py",
+    "jepsen_tpu/serve/aggregate.py",
+    "jepsen_tpu/serve/fission_plane.py",
+)
+
+_WITNESS_RE = re.compile(r"#\s*witness:\s*\S")
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_false(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _walk_fn(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk one function's own body — nested defs are their own graph
+    nodes and are not descended into — annotating ``.parent``."""
+    stack: List[ast.AST] = []
+    for stmt in fn.body:
+        stmt.parent = fn                    # type: ignore[attr-defined]
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FN):
+            continue
+        for child in ast.iter_child_nodes(node):
+            child.parent = node             # type: ignore[attr-defined]
+            stack.append(child)
+
+
+def _guards(node: ast.AST) -> List[ast.If]:
+    """Enclosing ``if`` tests dominating ``node`` (body branch only —
+    an ``else`` arm runs exactly when the test failed), innermost
+    first, not crossing the function boundary."""
+    out: List[ast.If] = []
+    child, cur = node, getattr(node, "parent", None)
+    while cur is not None and not isinstance(cur, _FN):
+        if isinstance(cur, ast.If) and child in cur.body:
+            out.append(cur)
+        child, cur = cur, getattr(cur, "parent", None)
+    return out
+
+
+def _test_has_false_cmp(test: ast.AST) -> bool:
+    """A verdict-refutation test: ``... is/== False`` whose left side
+    reads the ``"valid"`` field (``r.get("valid")``, ``r["valid"]``).
+    A bare ``x is False`` on anything else (feature knobs, flags) is
+    not a refutation path."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) \
+                and any(isinstance(op, (ast.Is, ast.Eq))
+                        for op in sub.ops) \
+                and any(_is_false(c) for c in sub.comparators) \
+                and any(isinstance(n, ast.Constant) and n.value == "valid"
+                        for n in ast.walk(sub.left)):
+            return True
+    return False
+
+
+def _test_witness_keys(test: ast.AST) -> Set[str]:
+    found: Set[str] = set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) \
+                and any(isinstance(op, ast.In) for op in sub.ops) \
+                and isinstance(sub.left, ast.Constant) \
+                and sub.left.value in ("op", "witness"):
+            found.add(sub.left.value)
+    return found
+
+
+def _in_handler(node: ast.AST) -> bool:
+    cur = getattr(node, "parent", None)
+    while cur is not None and not isinstance(cur, _FN):
+        if isinstance(cur, ast.ExceptHandler):
+            return True
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+class _Sound02:
+
+    def __init__(self, graph: CallGraph):
+        self.g = graph
+        self.scoped = [f for f in graph.funcs.values()
+                       if f.path.startswith(SCOPE)]
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, str]] = set()
+        #: fid -> symbol chain proving it can emit an unwitnessed False
+        self.tainted: Dict[str, Tuple[str, ...]] = {}
+        #: return-flow deferrals: (returner fid, callee fid, lineno)
+        self.retdeps: List[Tuple[str, str, int]] = []
+
+    def _emit(self, path: str, lineno: int, msg: str, hint: str) -> None:
+        key = (path, msg)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(RULE, path, lineno, msg, hint=hint))
+
+    def _annotated(self, path: str, *lines: int) -> bool:
+        src = self.g.sources.get(path) or []
+        for ln in lines:                    # 1-based; look on and above
+            for cand in (ln, ln - 1):
+                if 0 < cand <= len(src) \
+                        and _WITNESS_RE.search(src[cand - 1]):
+                    return True
+        return False
+
+    def _witness_guarded(self, node: ast.AST) -> bool:
+        keys: Set[str] = set()
+        for g in _guards(node):
+            keys |= _test_witness_keys(g.test)
+        return keys >= {"op", "witness"}
+
+    def _on_false_path(self, node: ast.AST) -> bool:
+        return any(_test_has_false_cmp(g.test) for g in _guards(node))
+
+    # -- provenance of a returned name ------------------------------------
+
+    def _callee_of(self, fid: str, value: ast.AST) -> Optional[str]:
+        """In-scope callee fid a call expression resolves to, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        edge = self.g.edge_at.get(fid, {}).get(
+            (value.lineno, value.col_offset))
+        if edge is None or edge.kind != "call":
+            return None
+        callee = self.g.funcs[edge.callee]
+        return callee.id if callee.path.startswith(SCOPE) else None
+
+    def _build_env(self, f: FuncInfo) -> Dict[str, Tuple]:
+        """name -> ("scope", callee fid) | ("opaque",) | ("raw",) for
+        single-target assignments.  "opaque" covers dict literals (the
+        construction site carries its own obligation) and calls outside
+        the fission surface (SOUND01's jurisdiction); "raw" means the
+        name holds a sub-result reaching us from a parameter."""
+        env: Dict[str, Tuple] = {}
+        for node in _walk_fn(f.node):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            v = node.value
+            callee = self._callee_of(f.id, v)
+            if callee is not None:
+                env[name] = ("scope", callee)
+            elif isinstance(v, (ast.Call, ast.Dict)):
+                env[name] = ("opaque",)
+            else:
+                env[name] = ("raw",)
+        return env
+
+    # -- per-function pass ------------------------------------------------
+
+    def _analyze(self, f: FuncInfo) -> None:
+        env = self._build_env(f)
+        for node in _walk_fn(f.node):
+            site = None                      # (lineno, description)
+            if isinstance(node, ast.Dict):
+                keys = {k.value for k in node.keys
+                        if isinstance(k, ast.Constant)}
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "valid" \
+                            and _is_false(v):
+                        if {"op", "witness"} <= keys:
+                            site = None      # evidence in the literal
+                        else:
+                            site = (k.lineno, "dict literal "
+                                              "{'valid': False}")
+                        if _in_handler(node):
+                            self._handler_finding(f, k.lineno)
+                            site = None
+            elif isinstance(node, ast.Assign) and _is_false(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.slice, ast.Constant) \
+                            and tgt.slice.value == "valid":
+                        if _in_handler(node):
+                            self._handler_finding(f, node.lineno)
+                        else:
+                            site = (node.lineno,
+                                    "store result['valid'] = False")
+            if site is not None:
+                lineno, desc = site
+                if not (self._witness_guarded(node)
+                        or self._annotated(f.path, lineno,
+                                           getattr(node, "lineno",
+                                                   lineno))):
+                    self.tainted.setdefault(f.id, (f.label,))
+                    self._emit(
+                        f.path, lineno,
+                        f"unwitnessed {desc} at a fission merge site "
+                        f"({f.label}): a recombined false must carry the "
+                        f"refuting sub-problem's op + witness",
+                        hint="guard on '\"op\" in r and \"witness\" in "
+                             "r', put the evidence in the verdict, or "
+                             "degrade to 'unknown'")
+            if isinstance(node, ast.Return) and node.value is not None \
+                    and not _in_handler(node) \
+                    and self._on_false_path(node) \
+                    and not self._witness_guarded(node) \
+                    and not self._annotated(f.path, node.lineno):
+                self._ret_site(f, env, node)
+
+    def _handler_finding(self, f: FuncInfo, lineno: int) -> None:
+        self.tainted.setdefault(f.id, (f.label,))
+        self._emit(
+            f.path, lineno,
+            f"'valid: False' constructed inside an except handler at a "
+            f"fission merge site ({f.label}): an exception path has no "
+            f"witness and must degrade to 'unknown'",
+            hint="return {'valid': 'unknown', 'error': ...}; false "
+                 "requires a counterexample")
+
+    def _ret_site(self, f: FuncInfo, env: Dict[str, Tuple],
+                  node: ast.Return) -> None:
+        v = node.value
+        callee = self._callee_of(f.id, v)
+        if callee is None and isinstance(v, ast.Name):
+            prov = env.get(v.id, ("raw",))
+            if prov[0] == "scope":
+                callee = prov[1]
+            elif prov[0] == "opaque":
+                return
+        elif callee is None and isinstance(v, (ast.Call, ast.Dict)):
+            return                # construction/other-jurisdiction
+        if callee is not None:
+            self.retdeps.append((f.id, callee, node.lineno))
+            return
+        self._emit(
+            f.path, node.lineno,
+            f"sub-result passed through as the recombined verdict on a "
+            f"refutation path in {f.label} with no witness guard: any "
+            f"path from a 'valid: False' sub-result into a recombined "
+            f"verdict must flow through a witness-bearing refutation "
+            f"site",
+            hint="test '\"op\" in r and \"witness\" in r' before "
+                 "returning a child refutation, or degrade to "
+                 "'unknown'")
+        self.tainted.setdefault(f.id, (f.label,))
+
+    # -- whole-program ----------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for f in sorted(self.scoped, key=lambda f: f.id):
+            self._analyze(f)
+        # return-flow taint: a merge function returning an in-scope
+        # callee's refutation inherits that callee's obligation
+        changed = True
+        while changed:
+            changed = False
+            for fid, callee, lineno in self.retdeps:
+                if callee in self.tainted and fid not in self.tainted:
+                    f = self.g.funcs[fid]
+                    chain = (f.label,) + self.tainted[callee]
+                    self.tainted[fid] = chain
+                    self._emit(
+                        f.path, lineno,
+                        f"refutation flows {' -> '.join(chain)} but "
+                        f"originates at an unwitnessed 'valid: False' "
+                        f"site: every false entering a recombined "
+                        f"verdict must flow through a witness-bearing "
+                        f"refutation site",
+                        hint="fix the origin site (attach op + witness "
+                             "there) — the pass-through is only as "
+                             "sound as its source")
+                    changed = True
+        return self.findings
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    return _Sound02(graph).run()

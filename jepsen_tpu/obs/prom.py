@@ -188,6 +188,24 @@ def render_prom(snap: Dict[str, Any]) -> str:
                 lines.append(
                     f'{full}{{bucket="{_label_token(bucket)}"}} {int(n)}')
 
+    # fission plane (engine splitters + shrink recursion + Hydra's
+    # fleet-edge counters): the section nests its own counters and
+    # histograms under snap["fission"], so it needs its own renderer —
+    # names prefixed ``fission_`` to keep them out of the flat
+    # counter namespace
+    fission = snap.get("fission")
+    if isinstance(fission, dict):
+        for key, v in sorted(fission.items()):
+            if key == "histograms" or not isinstance(v, (int, float)):
+                continue
+            full = f"{PREFIX}_fission_{sanitize(key)}_total"
+            lines.append(f"# HELP {full} fission counter {_esc(key)}")
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {_fmt(v)}")
+        for name, h in sorted((fission.get("histograms") or {}).items()):
+            if isinstance(h, dict):
+                lines.extend(_hist_lines(name, h))
+
     # Governor (serve/autoscale.py): decision counters + pending
     # structured scale requests, distinct from the fleet's
     # autoscale-ups/-downs action counters rendered above

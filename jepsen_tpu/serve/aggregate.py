@@ -12,28 +12,116 @@ IndependentChecker result shape ({"valid", "key-count", "results",
 validity coloring, run_tests exit codes) cannot tell a serviced check
 from a direct one.  Single-cell requests return the engine result
 itself, annotated.
+
+Distributed fission (serve.fission_plane) adds a pre-pass: child cells
+carrying a ``fission`` group membership recombine into one verdict per
+group — under the exact unknown-never-false table from docs/fission.md
+— *before* the ordinary per-key merge sees them, so a scattered cell
+aggregates byte-compatibly with the whole cell it replaced.  The
+distributed table is stricter than the engine's on evidence: a group
+``False`` REQUIRES the refuting sub-problem's op and witness (the
+fission plane's witness-recovery seam guarantees they were pursued);
+an unwitnessed refutation degrades the group to unknown.  There is
+also no fleet-side escalation ceiling: the engine's "ghosts: else →
+monolithic escalation" row becomes unknown here (the worker-local
+shrink recursion already ran inside each sub-problem).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu.checker.core import merge_valid
-from jepsen_tpu.serve.request import Request
+from jepsen_tpu.serve.request import Cell, Request
 
 
 def aggregate(req: Request) -> Dict[str, Any]:
-    cells = req.cells
-    if len(cells) == 1 and cells[0].key is None:
-        return dict(cells[0].result or {})
-    results = {c.key: c.result for c in cells}  # decompose order = key order
+    slots = _grouped_slots(req)
+    if len(slots) == 1 and slots[0][0] is None:
+        return dict(slots[0][1] or {})
+    results = {k: r for k, r in slots}  # decompose order = key order
     bad = {k: r for k, r in results.items()
            if (r or {}).get("valid") is not True}
     return {"valid": merge_valid([(r or {}).get("valid")
                                   for r in results.values()]),
-            "key-count": len(cells),
+            "key-count": len(slots),
             "results": results,
             "failures": sorted(bad, key=repr)}
+
+
+def _grouped_slots(req: Request) -> List[Tuple[Any, Optional[Dict]]]:
+    """The per-key (key, result) sequence the merge runs over, with each
+    fission group recombined into the single slot its parent cell held.
+    Non-fission cells pass through in decompose order."""
+    slots: List[Tuple[Any, Optional[Dict]]] = []
+    groups: Dict[str, Tuple[int, List[Cell]]] = {}
+    for c in req.cells:
+        if c.fission is None:
+            slots.append((c.key, c.result))
+            continue
+        gid = c.fission["group"]
+        if gid not in groups:
+            groups[gid] = (len(slots), [])
+            slots.append((c.key, None))  # placeholder at the parent's slot
+        groups[gid][1].append(c)
+    for gid, (pos, children) in groups.items():
+        children.sort(key=lambda c: c.fission["index"])
+        slots[pos] = (slots[pos][0], recombine_group(children))
+    return slots
+
+
+def recombine_group(children: List[Cell]) -> Dict[str, Any]:
+    """Fold one fission group's child verdicts into the verdict of the
+    cell that scattered (docs/fission.md, "Distributed recombination").
+
+    components: any witnessed False → False (that child's op/witness);
+    all True → True; else unknown.  ghosts: any True → True; all False
+    with a witnessed all-elided branch → False (its op/witness); else
+    unknown.  Cancelled and lost children contribute unknown, which the
+    deciding rows dominate and the unknown rows absorb — no path
+    fabricates False."""
+    mode = children[0].fission["mode"]
+    n = children[0].fission["subproblems"]
+    results = [c.result or {} for c in children]
+    explored = sum(int(r.get("configs-explored", 0) or 0) for r in results)
+    meta = {"mode": mode, "distributed": True, "subproblems": n}
+    if mode == "components":
+        for i, r in enumerate(results):
+            if r.get("valid") is False and "op" in r and "witness" in r:
+                # witness: the refuting sub-problem's own op + witness travel with the group False (P-compositionality: a refuted projection refutes the whole)
+                return {"valid": False, "analyzer": r.get("analyzer"),
+                        "op": r["op"], "witness": r["witness"],
+                        "configs-explored": explored,
+                        "fission": {**meta, "refuting-subproblem": i}}
+        if len(results) == n and all(r.get("valid") is True
+                                     for r in results):
+            return {"valid": True, "analyzer": "fleet-fission",
+                    "configs-explored": explored, "fission": meta}
+        return _indefinite(results, explored, meta,
+                           "component conjunction indefinite")
+    # ghosts: an exact disjunction over crashed-op outcomes
+    for r in results:
+        if r.get("valid") is True:
+            return {"valid": True, "analyzer": "fleet-fission",
+                    "configs-explored": explored, "fission": meta}
+    r0 = results[0] if children[0].fission["index"] == 0 else {}
+    if len(results) == n and all(r.get("valid") is False for r in results) \
+            and "op" in r0 and "witness" in r0:
+        # witness: all 2^ghosts branches refuted; the all-elided branch's op + witness are the canonical evidence
+        return {"valid": False, "analyzer": r0.get("analyzer"),
+                "op": r0["op"], "witness": r0["witness"],
+                "configs-explored": explored, "fission": meta}
+    return _indefinite(results, explored, meta,
+                       "ghost case-split indefinite "
+                       "(no fleet-side escalation ceiling)")
+
+
+def _indefinite(results: List[Dict[str, Any]], explored: int,
+                meta: Dict[str, Any], why: str) -> Dict[str, Any]:
+    errs = [str(r.get("error")) for r in results if r.get("error")]
+    return {"valid": "unknown", "analyzer": "fleet-fission",
+            "error": f"{why}: {errs[0]}" if errs else why,
+            "configs-explored": explored, "fission": dict(meta)}
 
 
 def expired_result(kind: str) -> Dict[str, Any]:

@@ -238,6 +238,35 @@ class ChaosNemesis:
         return self._register(f"fleet:poison:{wid}", undo,
                               f"worker {wid} dispatches poisoned")
 
+    def strip_witness(self, wid: int) -> str:
+        """Evidence-loss analogue: this worker's refutations come back
+        WITHOUT their witness (a truncated wire frame, an exhausted
+        witness budget).  Exercises Hydra's witness-recovery seam: a
+        distributed refutation must be re-witnessed on the refuting
+        worker — and if that worker then dies, the group must resolve
+        unknown, never a fabricated false (serve/fission_plane.py)."""
+        sched = self._sched_of(wid)
+        orig_wgl = sched._dispatch_wgl
+        orig_fb = sched._host_fallback
+
+        def strip(rs):
+            for r in rs:
+                if isinstance(r, dict) and r.get("valid") is False:
+                    r.pop("witness", None)
+            return rs
+
+        sched._dispatch_wgl = lambda *a, **kw: strip(orig_wgl(*a, **kw))
+        sched._host_fallback = lambda *a, **kw: strip(orig_fb(*a, **kw))
+        self.fleet.metrics.inc("chaos-witness-strips")
+
+        def undo():
+            _unpatch(sched, "_dispatch_wgl")
+            _unpatch(sched, "_host_fallback")
+
+        return self._register(f"fleet:strip-witness:{wid}", undo,
+                              f"worker {wid} refutations stripped of "
+                              f"witnesses")
+
     # -- lease faults (Fleetport registries) ------------------------------
     def expire_lease(self, name_or_wid) -> str:
         """Lease-expiry fault: the multi-host eviction path, with no

@@ -29,7 +29,10 @@ def _engine_identity(req: Request):
     call using the group head's spec."""
     if req.kind == KIND_WGL:
         m = req.spec["model"]
-        return (m.name, m.variant)
+        # the fission flag changes the engine a lane runs through
+        # (split-and-recombine vs pure ladder), so cells carrying
+        # different flags must never share one dispatch group
+        return (m.name, m.variant, req.spec.get("fission"))
     return (req.spec.get("workload", "list-append"),
             bool(req.spec.get("realtime", False)),
             req.spec.get("engine", "auto"),

@@ -51,7 +51,7 @@ from jepsen_tpu.obs.hist import merge_hist_snapshots
 from jepsen_tpu.obs.recorder import RECORDER
 from jepsen_tpu.obs.slo import SloEngine, tenant_slo_specs
 from jepsen_tpu.obs.telemetry import TelemetryStore, telemetry_interval_s
-from jepsen_tpu.serve import buckets
+from jepsen_tpu.serve import buckets, fission_plane
 from jepsen_tpu.serve.aggregate import aggregate, expired_result
 from jepsen_tpu.serve.decompose import decompose
 from jepsen_tpu.serve.metrics import Metrics, mono_now
@@ -230,10 +230,15 @@ class FleetJournal:
     def record(self, req: Request, cells: List[Cell]) -> None:
         entries = {}
         for c in cells:
+            # fission children journal their per-cell spec overrides so
+            # whole-fleet-crash recovery re-checks each sub-problem under
+            # the exact engine options it scattered with (the group
+            # context is gone — recovered children run as independent
+            # requests, which the unknown-never-false table tolerates)
             entries[c.cid] = {
                 "request-id": req.id, "kind": req.kind, "key": c.key,
                 "deadline-rem-s": req.remaining_s(),
-                "spec": self._spec_lite(req),
+                "spec": {**self._spec_lite(req), **c.spec_overrides},
                 "ops": [op.to_dict() for op in c.history]}
         with self._jlock:
             self._pending.update(entries)
@@ -563,6 +568,11 @@ class Fleet:
                       trace=trace, tenant=tenant,
                       priority=self.tenants.priority(tenant))
         cells = decompose(req)
+        # Hydra: over-threshold WGL cells scatter into fission child
+        # cells HERE, before admission/journaling, so backpressure,
+        # quotas, the journal, and the router all see the real
+        # per-sub-problem work (serve/fission_plane.py)
+        cells = fission_plane.scatter(req)
         for c in cells:
             c.cid = f"{req.id}.{next(self._cids)}"
         adm_deadline = req.deadline
@@ -672,6 +682,8 @@ class Fleet:
         prev_delay: Optional[float] = None
         tries = max(1, policy.tries)
         for attempt in range(tries):
+            if cell.cancelled:
+                return fission_plane.cancelled_result()
             if req.expired():
                 self.metrics.inc("deadline-expired")
                 return expired_result(req.kind)
@@ -752,7 +764,7 @@ class Fleet:
             wreq = worker.service.submit(cell.history, block=False,
                                          deadline_s=req.remaining_s(),
                                          trace=req.trace_context(),
-                                         **submit_kwargs(req))
+                                         **self._cell_kwargs(cell))
         except (ServiceClosed, ServiceSaturated) as e:
             return None, f"{type(e).__name__}: {e}", worker
         except Exception as e:  # noqa: BLE001 — submit crashed = worker bug
@@ -794,6 +806,11 @@ class Fleet:
                             worker.wid
                     return res, None, hedge_worker
             now = mono_now()
+            if cell.cancelled:
+                # a sibling decided this cell's fission group; the worker
+                # keeps computing (never interrupted) but its verdict no
+                # longer matters — release the driver thread now
+                return fission_plane.cancelled_result(), None, worker
             if req.expired():
                 return None, None, worker  # pure expiry → unknown upstream
             if now - t0 > cap:
@@ -813,7 +830,7 @@ class Fleet:
                             cell.history, block=False,
                             deadline_s=req.remaining_s(),
                             trace=req.trace_context(),
-                            **submit_kwargs(req))
+                            **self._cell_kwargs(cell))
                         self.metrics.inc("hedges")
                         RECORDER.record(
                             "retry", f"hedge:{cell.cid}",
@@ -828,6 +845,15 @@ class Fleet:
                     hedge_at = (now - t0) + max(0.1, self._hedge_after(req)
                                                 or DEFAULT_HEDGE_S)
             time.sleep(POLL_S)
+
+    def _cell_kwargs(self, cell: Cell) -> Dict[str, Any]:
+        """The worker submit kwargs for one cell: the request spec with
+        the cell's fission overrides merged over it (ghost-variant
+        children pin worker fission off and a threshold-sized ceiling;
+        ordinary cells have no overrides and this IS submit_kwargs)."""
+        kw = submit_kwargs(cell.request)
+        kw.update(cell.spec_overrides)
+        return kw
 
     def _classify(self, res: Dict[str, Any],
                   req: Request) -> Tuple[Optional[Dict[str, Any]],
@@ -862,6 +888,15 @@ class Fleet:
             time.sleep(d)
 
     def _finalize_cell(self, cell: Cell, result: Dict[str, Any]) -> None:
+        # Hydra's evidence seam: fission children get witness recovery
+        # (pinned to the refuting worker) and trigger sibling cancel
+        # before the verdict is committed; ordinary cells pass through.
+        try:
+            result = fission_plane.on_child_result(self, cell, result)
+        except Exception as e:  # noqa: BLE001 — the seam must never lose
+            log.exception("fission finalize seam failed for %s", cell.cid)
+            result = {"valid": "unknown", "analyzer": "fleet-fission",
+                      "error": f"fission finalize seam crashed: {e}"}
         cell.result = result
         self.metrics.inc("cells-completed")
         req = cell.request

@@ -346,9 +346,16 @@ class Fleetport(Fleet):
         log.info("worker %s registered from %s (wid %d, mesh %s, "
                  "gen %d)", name, peer, slot.wid,
                  "x".join(str(d) for d in rec.mesh), rec.generation)
+        from jepsen_tpu.serve.fission_plane import fleetfission_threshold
         return {"registered": True, "wid": slot.wid,
                 "lease-s": self.registry.lease_s,
-                "generation": rec.generation}
+                "generation": rec.generation,
+                # sizing handshake (docs/deployment.md, "Sizing fleet
+                # fission"): the fleet edge's scatter threshold rides
+                # the ack so a joining worker can log when its own
+                # JTPU_FISSION_THRESHOLD exceeds what the edge will
+                # ever hand it in one sub-problem
+                "fleetfission-threshold": fleetfission_threshold()}
 
     def _admit_slot(self, rec: WorkerRecord) -> FleetportWorker:
         """Append one registry-backed slot (caller holds the sup lock).

@@ -178,6 +178,24 @@ class Metrics:
                     return dict(t)
         return None
 
+    @staticmethod
+    def _fission_section(fission) -> Dict[str, Any]:
+        """One merged view of the whole fission story: the engine
+        splitter counters, the shrink-recursion counters, the fleet
+        plane's scattered/remote-subproblems/cancelled counters, and
+        every tier's histograms (keys are disjoint by construction:
+        engine ``fission:*``, shrink ``fission:shrink-*``, plane
+        ``fleetfission:*``).  Lazy imports keep the metrics leaf free of
+        serve-layer import cycles."""
+        from jepsen_tpu.engine import shrink
+        from jepsen_tpu.serve import fission_plane
+        return {**fission.fission_stats(),
+                **shrink.shrink_stats(),
+                **fission_plane.plane_stats(),
+                "histograms": {**fission.HISTS.snapshot(),
+                               **shrink.HISTS.snapshot(),
+                               **fission_plane.HISTS.snapshot()}}
+
     def snapshot(self) -> Dict[str, Any]:
         from jepsen_tpu.engine.cache import engine_cache_stats
         from jepsen_tpu.engine import fission
@@ -252,8 +270,7 @@ class Metrics:
             "histograms": hists,
             "engine-cache": {**cache, "recompiles": cache["misses"]},
             "megabatch": mega,
-            "fission": {**fission.fission_stats(),
-                        "histograms": fission.HISTS.snapshot()},
+            "fission": self._fission_section(fission),
             "flight-recorder": RECORDER.stats(),
             "traces": traces,
         }

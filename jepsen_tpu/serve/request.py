@@ -205,6 +205,15 @@ class Cell:
     result: Optional[Dict[str, Any]] = field(default=None)
     enqueued: float = 0.0           # mono_now() at admission (aging clock)
     cid: str = ""                   # fleet cell id (journal key, route token)
+    #: distributed-fission membership (serve.fission_plane): the group id,
+    #: split mode, and index of this sub-problem; None for ordinary cells
+    fission: Optional[Dict[str, Any]] = field(default=None)
+    #: set by the fission plane when a sibling already decided the group —
+    #: the drive loop stops re-dispatching; the worker is never interrupted
+    cancelled: bool = False
+    #: per-cell engine-spec overrides merged over submit_kwargs at dispatch
+    #: (ghost-variant children pin fission off + a threshold-sized ceiling)
+    spec_overrides: Dict[str, Any] = field(default_factory=dict)
 
     def sort_key(self) -> Tuple[int, float, int]:
         """Priority-class first (higher request priority sorts earlier),

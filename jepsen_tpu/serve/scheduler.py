@@ -403,16 +403,43 @@ class Scheduler:
             from jepsen_tpu.parallel.megabatch import check_megabatch
             self.metrics.inc("megabatch-dispatches")
             self.metrics.inc("megabatch-lanes", len(padded))
-            return check_megabatch(
+            rs = check_megabatch(
                 spec0["model"], padded, capacity=cap,
                 max_capacity=max_cap, window_floor=w_bucket,
                 ev_floor=ev_bucket,
                 lanes=buckets.mega_lane_bucket(len(padded)))
-        rs = check_batch(spec0["model"], padded, mesh=self.mesh,
-                         capacity=cap, max_capacity=max_cap,
-                         chunk=_batch_chunk(len(padded), ev_bucket),
-                         window_floor=w_bucket)
-        return rs[:len(live)]
+        else:
+            rs = check_batch(spec0["model"], padded, mesh=self.mesh,
+                             capacity=cap, max_capacity=max_cap,
+                             chunk=_batch_chunk(len(padded), ev_bucket),
+                             window_floor=w_bucket,
+                             fission=spec0.get("fission"))
+        return [self._explain_witness(c, r) for c, r in zip(live, rs)]
+
+    def _explain_witness(self, cell: Cell, r):
+        """Device lanes flag, the CPU recovers (engine.witness): the
+        batched engines refute with the op alone, so when the submitter
+        asked for an explanation the knossos-style witness is re-derived
+        here, before the verdict leaves the dispatch path — the same
+        discipline wgl_tpu.check applies directly.  The fission plane's
+        witness-recovery re-checks depend on this seam: an explain=True
+        re-submit to the refuting worker must come back witnessed.  A
+        budget overrun degrades the witness to an error note, never the
+        earned verdict."""
+        if not (isinstance(r, dict) and r.get("valid") is False
+                and "witness" not in r and isinstance(r.get("op"), dict)
+                and cell.request.spec.get("explain")):
+            return r
+        from jepsen_tpu.engine.witness import cpu_witness
+        model = cell.request.spec.get("model")
+        idx = r["op"].get("index")
+        failed = next((o for o in cell.history if o.index == idx), None)
+        if model is None or failed is None:
+            return r
+        out = dict(r)
+        # witness: CPU re-derivation on the refuted prefix rides the flagged op
+        out["witness"] = cpu_witness(model, cell.history, failed)
+        return out
 
     def _dispatch_elle(self, live: List[Cell],
                        padded: List[Any]) -> List[Dict[str, Any]]:

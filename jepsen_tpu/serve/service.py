@@ -328,10 +328,16 @@ class CheckService:
     def ping(self) -> Dict[str, Any]:
         """The heartbeat payload: cheap, lock-light, never dispatches.
         The fleet's health checker and ``GET /healthz`` both read this."""
+        from jepsen_tpu.engine.fission import fission_threshold
         return {"alive": self.alive(),
                 "queue-depth": self._sched.depth(),
                 "inflight-cells": self._sched.inflight(),
-                "inflight-requests": self._inflight()}
+                "inflight-requests": self._inflight(),
+                # sizing advertisement: the capacity rung past which THIS
+                # worker splits instead of escalating (docs/deployment.md
+                # "Sizing fleet fission") — the fleet edge reads it to
+                # sanity-check per-worker vs fleet-aggregate capacity
+                "fission-threshold": fission_threshold()}
 
     def healthz(self) -> Dict[str, Any]:
         """Single-service health probe (the degenerate one-worker fleet

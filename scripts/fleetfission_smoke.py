@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Fleet-fission (Hydra) smoke: one history no single worker can hold.
+
+Every worker's WGL ceiling is pinned to 64 configurations
+(``JTPU_FISSION_THRESHOLD=64`` — spawned worker processes inherit it),
+and the fleet-edge scatter threshold is pinned low
+(``JTPU_FLEETFISSION_THRESHOLD=16``), so the giant bitset histories
+built here (8 crashed adds → a 2^8-configuration frontier that no
+subsumption can collapse, arXiv 2410.04581's ceiling shape) are
+strictly larger than any single worker's cap: the smoke first PROVES
+that, by checking one monolithically at the worker ceiling
+(``unknown`` + capacity-exceeded), then asserts the 3-worker spawned
+ProcFleet returns the REAL verdict by scattering ~10 component
+projections across worker processes.
+
+Phase A (parity): clean + corrupted giants through the fleet vs
+single-worker ``fission.split_check`` at an unpinned ceiling vs the CPU
+oracle — verdict parity lane for lane, refuting op + recovered witness
+on every distributed False (the witness-recovery seam re-derives it on
+the refuting worker), and the scattered/remote-subproblem counters
+visible in /metrics.
+
+Phase B (mid-recombination kill): a concurrent campaign of giants, one
+worker process SIGKILLed mid-scatter.  The journal re-runs only the
+dead worker's sub-problems; asserts zero fabricated ``false`` (verdicts
+match the oracle or degrade to unknown — never False on a valid
+history), journal pending 0 after drain, and a supervisor respawn.
+
+Writes the metrics + parity report to argv[1] (default
+/tmp/fleetfission_smoke.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Pin BEFORE jax/engine imports: worker processes inherit this env.
+os.environ["JTPU_FISSION_THRESHOLD"] = "64"
+os.environ["JTPU_FLEETFISSION_THRESHOLD"] = "16"
+
+from jepsen_tpu.checker import wgl_cpu, wgl_tpu  # noqa: E402
+from jepsen_tpu.engine import fission  # noqa: E402
+from jepsen_tpu.history import History, INVOKE, OK, Op  # noqa: E402
+from jepsen_tpu.models import get_model  # noqa: E402
+from jepsen_tpu.serve import fission_plane  # noqa: E402
+from jepsen_tpu.serve.fleet import ProcFleet  # noqa: E402
+from jepsen_tpu.synth import bitset_ceiling_history  # noqa: E402
+
+DEADLINE_S = 240.0
+WORKER_CAP = 64          # the pinned per-worker ceiling (JTPU_FISSION_THRESHOLD)
+
+
+def log(msg):
+    print(f"[fleetfission-smoke +{time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def giant_history(n_clean=3, corrupt=False) -> History:
+    """8 crashed adds of distinct bitset elements + a clean overlapped
+    stream: a 2^8-wide frontier no 64-config worker can hold, splitting
+    into ~10 trivially-small component projections."""
+    h = bitset_ceiling_history(8, n_clean=n_clean, concurrency=2)
+    if corrupt:
+        # contradict a clean element: read it absent after its add OK'd
+        # (a grow-only set can never un-contain it)
+        e = next(int(o.value) for o in h.ops
+                 if o.type == OK and o.f == "add" and o.value is not None)
+        ops = [o.with_() for o in h.ops]
+        ops += [Op(process=4000, type=INVOKE, f="read", value=(e, 0)),
+                Op(process=4000, type=OK, f="read", value=(e, 0))]
+        h = History(ops, reindex=True)
+    return h
+
+
+def prove_single_worker_cannot(m, h):
+    """The premise: at the pinned worker ceiling, the monolithic check
+    overflows — the verdict a lone worker would be stuck with."""
+    r = wgl_tpu.check(m, h, capacity=WORKER_CAP, max_capacity=WORKER_CAP,
+                      explain=True)
+    assert r["valid"] == "unknown" and r.get("capacity-exceeded"), (
+        "premise broken: a single worker's ceiling decided the giant", r)
+    return r
+
+
+def run_fleet(fleet, jobs, deadline_s=DEADLINE_S):
+    out = [None] * len(jobs)
+
+    def client(span):
+        reqs = [(i, fleet.submit(jobs[i], kind="wgl", model="bitset",
+                                 deadline_s=deadline_s))
+                for i in span]
+        for i, r in reqs:
+            out[i] = r.wait(timeout=deadline_s + 60)
+
+    threads = [threading.Thread(target=client,
+                                args=(range(j, len(jobs), 2),))
+               for j in range(2)]
+    for t in threads:
+        t.start()
+    return threads, out
+
+
+def phase_a(fleet):
+    """Parity: fleet-scattered verdicts vs single-worker fission vs the
+    CPU oracle, witnessed on every distributed refutation."""
+    m = get_model("bitset")
+    lanes = []
+    for n_clean, corrupt in ((3, False), (4, True)):
+        h = giant_history(n_clean, corrupt=corrupt)
+        prove_single_worker_cannot(m, h)
+        log(f"phase A: n_clean={n_clean} corrupt={corrupt} "
+            f"events={len(h.ops)} — fleet check")
+        r = fleet.check(h, model="bitset", deadline_s=DEADLINE_S)
+        single = fission.split_check(m, h, capacity=16,
+                                     max_capacity=65536, threshold=32)
+        oracle = wgl_cpu.check(m.cpu_model(), h)
+        lane = {"n_clean": n_clean, "corrupt": corrupt,
+                "events": len(h.ops),
+                "fleet": r.get("valid"), "single": single.get("valid"),
+                "oracle": oracle.get("valid"),
+                "fission": r.get("fission"),
+                "witnessed": bool("op" in r and "witness" in r)}
+        lanes.append(lane)
+        assert r.get("fission", {}).get("distributed"), (
+            "the giant never scattered", lane)
+        assert r["valid"] == oracle["valid"], (
+            "fleet verdict diverged from the oracle", lane)
+        assert r["valid"] == single["valid"], (
+            "fleet verdict diverged from single-worker fission", lane)
+        if corrupt:
+            assert r["valid"] is False, lane
+            assert "op" in r and "witness" in r, (
+                "distributed refutation arrived unwitnessed", lane)
+        else:
+            assert r["valid"] is True, lane
+    stats = fission_plane.plane_stats()
+    assert stats["scattered"] >= 2, stats
+    assert stats["remote-subproblems"] >= 16, stats
+    return lanes, stats
+
+
+def phase_b(fleet):
+    """Mid-recombination SIGKILL: re-run only the dead worker's
+    sub-problems, fabricate nothing."""
+    m = get_model("bitset")
+    jobs = [giant_history(3 + s, corrupt=(s % 3 == 2)) for s in range(4)]
+    oracle = [wgl_cpu.check(m.cpu_model(), h)["valid"] for h in jobs]
+    threads, out = run_fleet(fleet, jobs)
+    time.sleep(2.0)                       # let the scatter start flowing
+    victim_pid = fleet.workers[1].service.launcher.proc.pid
+    os.kill(victim_pid, signal.SIGKILL)   # mid-recombination crash
+    log(f"phase B: SIGKILLed worker pid={victim_pid}")
+    for t in threads:
+        t.join(timeout=DEADLINE_S + 120)
+    assert not any(t.is_alive() for t in threads), "fleet clients hung"
+
+    verdicts = [(r or {}).get("valid") for r in out]
+    fabricated = [
+        {"lane": i, "oracle": o, "fleet": v}
+        for i, (o, v) in enumerate(zip(oracle, verdicts))
+        if v is False and o is not False]
+    # wait out the respawn sweep, then the journal must be drained
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        snap = fleet.metrics.snapshot()
+        if snap["counters"].get("supervisor-respawns", 0) >= 1:
+            break
+        time.sleep(0.25)
+    journal_pending = fleet._journal.pending_count()
+    snap = fleet.metrics.snapshot()
+    report = {
+        "oracle": oracle, "fleet": verdicts,
+        "fabricated_false": fabricated,
+        "killed_worker_pid": victim_pid,
+        "journal_pending_at_end": journal_pending,
+    }
+    assert not fabricated, (
+        f"fleet fission fabricated false verdicts: {fabricated}")
+    # a kill may cost evidence (unknown) but every concluded verdict
+    # must be the oracle's
+    wrong = [i for i, (o, v) in enumerate(zip(oracle, verdicts))
+             if v in (True, False) and v != o]
+    assert not wrong, f"concluded verdicts diverged at lanes {wrong}"
+    concluded = sum(1 for v in verdicts if v in (True, False))
+    assert concluded >= 1, "the kill starved every verdict to unknown"
+    assert journal_pending == 0, (
+        f"{journal_pending} cells still journaled after drain")
+    assert snap["counters"].get("supervisor-respawns", 0) >= 1, (
+        "the SIGKILLed worker process was never respawned")
+    report["concluded"] = concluded
+    return report, snap
+
+
+def main():
+    out_path = sys.argv[1] if len(sys.argv) > 1 else \
+        "/tmp/fleetfission_smoke.json"
+    t0 = time.monotonic()
+    journal_dir = tempfile.mkdtemp(prefix="jtpu-fleetfission-")
+    fleet = ProcFleet(workers=3, spawn=True, journal_dir=journal_dir,
+                      max_lanes=16, hedge_s=8.0,
+                      default_deadline_s=DEADLINE_S, supervise_s=0.25)
+    try:
+        # warm pass: each worker process compiles its own engines
+        log("warm pass")
+        warm = giant_history(5)
+        fleet.check(warm, model="bitset", deadline_s=DEADLINE_S)
+        log("phase A: parity")
+        lanes, plane = phase_a(fleet)
+        log("phase B: mid-recombination SIGKILL")
+        kill_report, snap = phase_b(fleet)
+    finally:
+        fleet.close(timeout=60.0)
+    report = {
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "parity_lanes": lanes,
+        "plane_stats": plane,
+        "kill": kill_report,
+        "fission_metrics": snap.get("fission"),
+        "counters": snap.get("counters"),
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    log(f"OK — report at {out_path} "
+        f"({report['elapsed_s']}s, scattered={plane['scattered']})")
+
+
+if __name__ == "__main__":
+    main()

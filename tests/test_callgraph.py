@@ -14,6 +14,8 @@ silently.
 import textwrap
 
 from jepsen_tpu.lint.callgraph import build_graph, map_args_to_params
+from jepsen_tpu.lint.interp_lint import run_interp_tier
+from jepsen_tpu.lint.rules import sound02
 
 
 def g(files):
@@ -269,3 +271,145 @@ class TestArgMapping:
         assert set(mapped) == {"a", "c"}
         assert mapped["a"].value == 10
         assert mapped["c"].value == 30
+
+
+# ---------------------------------------------------------------------------
+# SOUND02: unknown-never-false across fission merge sites
+# ---------------------------------------------------------------------------
+
+def sound02_findings(files):
+    files = {p: textwrap.dedent(s) for p, s in files.items()}
+    findings, _ = run_interp_tier(files=files, rules=[sound02])
+    return findings
+
+
+class TestSound02:
+    #: The fixture pair for the distributed-recombination contract
+    #: (docs/fission.md): a merge loop that launders a child's False
+    #: into the group verdict without checking its evidence, against
+    #: the witness-guarded version the repo actually ships.
+    BAD_PASSTHROUGH = {
+        "jepsen_tpu/serve/aggregate.py": """
+            def recombine(children):
+                for r in children:
+                    if r.get("valid") is False:
+                        return r
+                return {"valid": True}
+            """,
+    }
+    GOOD_PASSTHROUGH = {
+        "jepsen_tpu/serve/aggregate.py": """
+            def recombine(children):
+                for r in children:
+                    if r.get("valid") is False and "op" in r \\
+                            and "witness" in r:
+                        return r
+                return {"valid": "unknown"}
+            """,
+    }
+
+    def test_unguarded_passthrough_caught(self):
+        fs = sound02_findings(self.BAD_PASSTHROUGH)
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "SOUND02"
+        assert "aggregate.py::recombine" in f.message
+        assert "witness" in f.message
+
+    def test_witness_guarded_passthrough_clean(self):
+        assert sound02_findings(self.GOOD_PASSTHROUGH) == []
+
+    def test_unwitnessed_origin_taints_merge_chain(self):
+        """The interprocedural half: the construction site is in
+        shrink.py, the laundering return is in aggregate.py — the
+        finding names the whole symbol chain."""
+        fs = sound02_findings({
+            "jepsen_tpu/engine/shrink.py": """
+                def probe(h):
+                    if len(h) > 2:
+                        return {"valid": False, "error": "boom"}
+                    return {"valid": True}
+                """,
+            "jepsen_tpu/serve/aggregate.py": """
+                from jepsen_tpu.engine.shrink import probe
+                def merge(h):
+                    r = probe(h)
+                    if r.get("valid") is False:
+                        return r
+                    return {"valid": True}
+                """,
+        })
+        msgs = [f.message for f in fs]
+        assert any("shrink.py::probe" in m
+                   and "unwitnessed dict literal" in m for m in msgs)
+        assert any("aggregate.py::merge -> shrink.py::probe" in m
+                   for m in msgs)
+
+    def test_witnessed_origin_keeps_chain_clean(self):
+        """Same shape, but the origin carries op + witness in the
+        literal: the pass-through inherits the callee's proof."""
+        assert sound02_findings({
+            "jepsen_tpu/engine/shrink.py": """
+                def probe(h):
+                    if len(h) > 2:
+                        return {"valid": False, "op": h[0],
+                                "witness": h[1:]}
+                    return {"valid": True}
+                """,
+            "jepsen_tpu/serve/aggregate.py": """
+                from jepsen_tpu.engine.shrink import probe
+                def merge(h):
+                    r = probe(h)
+                    if r.get("valid") is False:
+                        return r
+                    return {"valid": True}
+                """,
+        }) == []
+
+    def test_except_handler_false_always_caught(self):
+        """Evidence keys don't launder an exception path: a handler
+        has no witness by construction."""
+        fs = sound02_findings({
+            "jepsen_tpu/serve/aggregate.py": """
+                def merge(children):
+                    try:
+                        return {"valid": True}
+                    except Exception:
+                        return {"valid": False, "op": 1, "witness": 2}
+                """,
+        })
+        assert len(fs) == 1
+        assert "except handler" in fs[0].message
+
+    def test_knob_false_test_is_not_a_refutation_path(self):
+        """`spec.get("fission") is False` gates a feature, not a
+        verdict — returning under it carries no witness obligation."""
+        assert sound02_findings({
+            "jepsen_tpu/serve/fission_plane.py": """
+                def scatter(req):
+                    if req.spec.get("fission") is False:
+                        return req.cells
+                    return []
+                """,
+        }) == []
+
+    def test_out_of_scope_modules_not_audited(self):
+        """SOUND02 is the fission merge surface only; the same code
+        elsewhere is SOUND01's jurisdiction."""
+        assert sound02_findings({
+            "jepsen_tpu/serve/other.py": """
+                def merge(children):
+                    for r in children:
+                        if r.get("valid") is False:
+                            return r
+                return_ = None
+                """,
+        }) == []
+
+    def test_repo_is_sound02_clean(self):
+        """The shipped fission surface (engine/fission.py,
+        engine/shrink.py, serve/aggregate.py, serve/fission_plane.py)
+        proves its own unknown-never-false table."""
+        findings, _ = run_interp_tier(rules=[sound02])
+        assert findings == [], "\n" + "\n".join(
+            f.render() for f in findings)

@@ -60,6 +60,33 @@ class TestStats:
         # write never succeeded -> unknown
         assert r["valid"] == UNKNOWN
 
+    def test_zero_ok_f_is_unknown_never_false(self):
+        # ADVICE r5 pin: the reference (checker.clj:166-183) sets
+        # ``:valid? (pos? ok-count)`` — a zero-OK f fails the run.  This
+        # repo DELIBERATELY softens that to unknown (no refuting op
+        # exists to witness a False; a starved f is a client/schedule
+        # problem, not a consistency violation).  The per-f block still
+        # carries its own verdict, reference-style.
+        h = History([
+            mk(0, INVOKE, "write", 1), mk(0, FAIL, "write", 1),
+            mk(1, INVOKE, "write", 2), mk(1, INFO, "write"),
+        ])
+        r = Stats().check(T, h)
+        assert r["valid"] == UNKNOWN          # never False
+        assert r["valid"] is not False
+        assert r["by-f"]["write"]["valid"] == UNKNOWN
+        assert r["ok-count"] == 0
+
+    def test_all_f_succeeding_is_valid(self):
+        h = History([
+            mk(0, INVOKE, "read"), mk(0, OK, "read", 1),
+            mk(0, INVOKE, "write", 2), mk(0, OK, "write", 2),
+            mk(1, INVOKE, "write", 3), mk(1, FAIL, "write", 3),
+        ])
+        r = Stats().check(T, h)
+        assert r["valid"] is True
+        assert r["by-f"]["write"]["valid"] is True
+
     def test_unhandled_exceptions(self):
         h = History([mk(0, INFO, "read", error="ConnectionRefused")])
         r = UnhandledExceptions().check(T, h)

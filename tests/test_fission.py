@@ -11,7 +11,7 @@ the /metrics export."""
 import pytest
 
 from jepsen_tpu.checker import wgl_cpu
-from jepsen_tpu.engine import fission
+from jepsen_tpu.engine import fission, shrink
 from jepsen_tpu.history import History, INFO, INVOKE, OK, Op
 from jepsen_tpu.models import get_model
 from jepsen_tpu.synth import (bitset_ceiling_history, cas_register_history,
@@ -293,3 +293,117 @@ class TestObservability:
         assert fission.fission_enabled() is False
         monkeypatch.setenv("JTPU_FISSION_THRESHOLD", "not-a-number")
         assert fission.fission_threshold() == fission.DEFAULT_THRESHOLD
+
+
+class TestShrink:
+    """The window-shrinking recursion (engine.shrink): the third
+    fallback when neither splitter applies.  Envelope: False with the
+    refuting prefix's op + witness, or unknown — never True (a passing
+    prefix proves nothing about the suffix)."""
+
+    def _giant(self, seed, corrupt):
+        # one register (no components), 10 crashed writes appended at
+        # the tail (2^10 outcome masks — past any threshold-sized
+        # frontier), optional early corruption a narrow prefix can catch
+        h = cas_register_history(20, concurrency=3, crash_p=0.0,
+                                 seed=seed)
+        if corrupt:
+            h = corrupt_reads(h, n=1, seed=seed, within=0.3)
+        return History([o.with_() for o in h] + ghost_write_burst(10),
+                       reindex=True)
+
+    def test_prefix_history_reindexes_and_keeps_open_invokes(self):
+        h = cas_register_history(10, concurrency=3, crash_p=0.0, seed=0)
+        p = shrink.prefix_history(h, 7)
+        assert len(p.ops) == 7
+        assert [o.index for o in p.ops] == list(range(7))
+
+    def test_early_corruption_refuted_within_a_prefix(self):
+        shrink.reset_shrink_stats()
+        m = get_model("cas-register")
+        h = self._giant(0, corrupt=True)
+        r = shrink.shrink_check(m, h, threshold=64, capacity=16,
+                                min_events=4)
+        assert r["valid"] is False
+        assert r["analyzer"] == "wgl-tpu-shrink"
+        assert r.get("op") and "witness" in r
+        assert r["fission"]["mode"] == "shrink"
+        assert r["fission"]["events"] < len(h.client_ops().ops)
+        assert r["fission"]["windows"]
+        st = shrink.shrink_stats()
+        assert st["shrink_checks"] == 1 and st["shrink_refutes"] == 1
+        assert st["shrink_probes"] >= 1
+
+    def test_clean_history_is_unknown_never_true(self):
+        # every full-width probe overflows the threshold and every
+        # narrow prefix passes: the interval must close on unknown —
+        # a prefix pass may NOT be promoted to True
+        m = get_model("cas-register")
+        h = self._giant(1, corrupt=False)
+        r = shrink.shrink_check(m, h, threshold=64, capacity=16,
+                                min_events=4)
+        assert r["valid"] == "unknown"
+        assert r["analyzer"] == "wgl-tpu-shrink"
+        assert "exhausted" in r["error"]
+        assert r["fission"]["windows"]
+        assert all(w["valid"] is not False
+                   for w in r["fission"]["windows"])
+
+    def test_escalate_falls_through_to_shrink(self, monkeypatch):
+        # the ceiling itself overflows: _escalate must hand the history
+        # to the shrink recursion, whose prefix refutation comes back
+        # tagged with the escalation's why
+        from jepsen_tpu.checker import wgl_tpu
+        # the escalate seam takes the knob-level floor: drop it under
+        # this history's 60 events or the interval closes without a probe
+        monkeypatch.setenv("JTPU_SHRINK_MIN_EVENTS", "4")
+        m = get_model("cas-register")
+        h = self._giant(2, corrupt=True)
+        full = len(h.client_ops().ops)
+        orig = wgl_tpu.check
+
+        def fake(model, hist, **kw):
+            if len(hist.ops) >= full:
+                return {"valid": "unknown", "capacity-exceeded": True,
+                        "error": "capacity exceeded at 64",
+                        "configs-explored": 0}
+            return orig(model, hist, **kw)
+
+        monkeypatch.setattr(wgl_tpu, "check", fake)
+        r = fission._escalate(m, h, capacity=16, max_capacity=64,
+                              explain=True, why="no ghosts to split on",
+                              threshold=64)
+        assert r["valid"] is False
+        assert r["analyzer"] == "wgl-tpu-shrink"
+        assert r["fission"]["escalate-why"] == "no ghosts to split on"
+        assert r.get("op") and "witness" in r
+
+    def test_shrink_off_keeps_the_exceeded_unknown(self, monkeypatch):
+        from jepsen_tpu.checker import wgl_tpu
+        monkeypatch.setenv("JTPU_SHRINK", "0")
+        m = get_model("cas-register")
+        h = self._giant(2, corrupt=True)
+
+        def fake(model, hist, **kw):
+            return {"valid": "unknown", "capacity-exceeded": True,
+                    "error": "capacity exceeded at 64",
+                    "configs-explored": 0}
+
+        monkeypatch.setattr(wgl_tpu, "check", fake)
+        r = fission._escalate(m, h, capacity=16, max_capacity=64,
+                              explain=True, why="no ghosts to split on",
+                              threshold=64)
+        assert r["valid"] == "unknown"
+        assert r.get("capacity-exceeded")
+
+    def test_knob_defaults(self, monkeypatch):
+        monkeypatch.delenv("JTPU_SHRINK", raising=False)
+        monkeypatch.delenv("JTPU_SHRINK_DEPTH", raising=False)
+        monkeypatch.delenv("JTPU_SHRINK_MIN_EVENTS", raising=False)
+        assert shrink.shrink_enabled() is True
+        assert shrink.shrink_depth() == shrink.DEFAULT_DEPTH
+        assert shrink.shrink_min_events() == shrink.DEFAULT_MIN_EVENTS
+        monkeypatch.setenv("JTPU_SHRINK", "off")
+        assert shrink.shrink_enabled() is False
+        monkeypatch.setenv("JTPU_SHRINK_DEPTH", "not-a-number")
+        assert shrink.shrink_depth() == shrink.DEFAULT_DEPTH

@@ -1,0 +1,474 @@
+"""Hydra — the fleet-scale fission plane (serve.fission_plane +
+serve.aggregate recombination).
+
+Covers scatter as a unit (threshold gate, component children, ghost
+variants with their pinned lean overrides, the opt-out and over-cap
+degradations), the distributed recombination table (unknown never
+false: an unwitnessed refutation can NOT decide a group), the
+finalize seam (sibling early-cancel, witness recovery, and the
+kill-the-refuting-worker-before-recovery case degrading to unknown),
+parity fuzz of the scattered pipeline against single-worker
+``fission.split_check`` and the CPU oracle, and one real-Fleet
+integration run including the evidence-loss nemesis."""
+
+import pytest
+
+from jepsen_tpu.checker import wgl_cpu, wgl_tpu
+from jepsen_tpu.engine import fission
+from jepsen_tpu.history import History, INVOKE, OK, Op
+from jepsen_tpu.models import get_model
+from jepsen_tpu.nemesis.registry import FaultRegistry
+from jepsen_tpu.serve import fission_plane
+from jepsen_tpu.serve.aggregate import aggregate, recombine_group
+from jepsen_tpu.serve.chaos import ChaosNemesis
+from jepsen_tpu.serve.decompose import decompose
+from jepsen_tpu.serve.fleet import Fleet
+from jepsen_tpu.serve.request import Cell, KIND_WGL, Request
+from jepsen_tpu.serve.service import build_spec
+from jepsen_tpu.synth import (bitset_ceiling_history, cas_register_history,
+                              corrupt_reads, ghost_write_burst)
+
+
+@pytest.fixture(autouse=True)
+def _hydra_env(monkeypatch):
+    """A scatter threshold small enough that test-sized histories fan
+    out, with the plane's counters zeroed around every test."""
+    monkeypatch.setenv("JTPU_FLEETFISSION", "1")
+    monkeypatch.setenv("JTPU_FLEETFISSION_THRESHOLD", "8")
+    monkeypatch.delenv("JTPU_FLEETFISSION_MAX_SUBPROBLEMS", raising=False)
+    fission_plane.reset_plane_stats()
+    yield
+    fission_plane.reset_plane_stats()
+
+
+def make_req(h, model="bitset", deadline_s=None, **kw) -> Request:
+    spec = build_spec(KIND_WGL, model=model, **kw)
+    req = Request(h, KIND_WGL, spec, deadline_s=deadline_s)
+    decompose(req)
+    return req
+
+
+def refuted_bitset_history() -> History:
+    """Two grow-only-set elements (two components), with element 1 read
+    absent strictly after its add OK'd — refuted, and exactly at the
+    8-event scatter threshold."""
+    return History([
+        Op(process=1, type=INVOKE, f="add", value=1),
+        Op(process=1, type=OK, f="add", value=1),
+        Op(process=2, type=INVOKE, f="add", value=2),
+        Op(process=2, type=OK, f="add", value=2),
+        Op(process=3, type=INVOKE, f="read", value=(2, 1)),
+        Op(process=3, type=OK, f="read", value=(2, 1)),
+        Op(process=4, type=INVOKE, f="read", value=(1, 0)),
+        Op(process=4, type=OK, f="read", value=(1, 0)),
+    ], reindex=True)
+
+
+def corrupt_bitset_read(h: History) -> History:
+    """Flip one read whose element's add OK'd strictly earlier to
+    absent: a grow-only set can never un-contain it (same corruption
+    the single-worker parity tests in test_fission.py use)."""
+    added_ok = set()
+    ops = [o.with_() for o in h.ops]
+    flip = None
+    for i, op in enumerate(ops):
+        if op.type == OK and op.f == "add" and op.value is not None:
+            added_ok.add(int(op.value))
+        if op.type == INVOKE and op.f == "read" and op.value \
+                and int(op.value[0]) in added_ok:
+            flip = (i, int(op.value[0]))
+            break
+    if flip is not None:
+        i, e = flip
+        ops[i] = ops[i].with_(value=(e, 0))
+        for j in range(i + 1, len(ops)):
+            if ops[j].process == ops[i].process and ops[j].type == OK \
+                    and ops[j].f == "read":
+                ops[j] = ops[j].with_(value=(e, 0))
+                break
+    else:
+        assert added_ok, "no OK'd add to contradict"
+        e = min(added_ok)
+        ops += [Op(process=4000, type=INVOKE, f="read", value=(e, 0)),
+                Op(process=4000, type=OK, f="read", value=(e, 0))]
+    return History(ops, reindex=True)
+
+
+def ghost_register_history(seed=0, n_ops=24, k=2) -> History:
+    """One register (one component — component split can't apply) with
+    ``k`` crashed writes: scatter must take the ghost case-split path."""
+    burst = [o.with_(value=o.value % 3 if o.value is not None else None)
+             for o in ghost_write_burst(k, base_value=0)]
+    h = cas_register_history(n_ops, concurrency=3, crash_p=0.0, seed=seed)
+    return History(burst + [o.with_() for o in h], reindex=True)
+
+
+# ---------------------------------------------------------------------------
+# scatter
+# ---------------------------------------------------------------------------
+
+
+class TestScatter:
+    def test_under_threshold_cell_passes_through(self):
+        h = bitset_ceiling_history(2, n_clean=1, concurrency=1)
+        req = make_req(h)
+        assert len(h.ops) < 8
+        before = list(req.cells)
+        assert fission_plane.scatter(req) == before
+        assert all(c.fission is None for c in req.cells)
+        assert fission_plane.plane_stats()["scattered"] == 0
+
+    def test_components_scatter_into_first_class_cells(self):
+        h = bitset_ceiling_history(2, n_clean=3, concurrency=2)
+        req = make_req(h)
+        assert len(req.cells) == 1
+        cells = fission_plane.scatter(req)
+        assert len(cells) >= 2
+        assert req.cells is cells
+        gid = cells[0].fission["group"]
+        for i, c in enumerate(cells):
+            assert c.fission["mode"] == "components"
+            assert c.fission["group"] == gid
+            assert c.fission["index"] == i
+            assert c.fission["subproblems"] == len(cells)
+            # component children keep worker-local fission ON
+            assert c.spec_overrides == {}
+            assert c.bucket[0] == KIND_WGL
+            assert c.enqueued > 0
+        # every parent event lands in exactly one projection
+        assert sum(len(c.history.ops) for c in cells) == len(h.ops)
+        stats = fission_plane.plane_stats()
+        assert stats["scattered"] == 1
+        assert stats["remote-subproblems"] == len(cells)
+
+    def test_ghost_scatter_pins_lean_overrides(self):
+        h = ghost_register_history(k=2)
+        req = make_req(h, model="cas-register")
+        cells = fission_plane.scatter(req)
+        assert len(cells) == 4  # 2^k crashed-write outcome masks
+        wthr = fission.fission_threshold()
+        for c in cells:
+            assert c.fission["mode"] == "ghosts"
+            # each variant is ghost-free: the worker checks it lean,
+            # fission OFF, at a threshold-sized ceiling
+            assert c.spec_overrides == {"fission": False,
+                                        "capacity": min(256, wthr),
+                                        "max_capacity": wthr}
+
+    def test_spec_opt_out_is_respected(self):
+        h = bitset_ceiling_history(2, n_clean=3, concurrency=2)
+        req = make_req(h, fission=False)
+        before = list(req.cells)
+        assert fission_plane.scatter(req) == before
+        assert all(c.fission is None for c in req.cells)
+
+    def test_disabled_knob_is_respected(self, monkeypatch):
+        monkeypatch.setenv("JTPU_FLEETFISSION", "0")
+        h = bitset_ceiling_history(2, n_clean=3, concurrency=2)
+        req = make_req(h)
+        fission_plane.scatter(req)
+        assert all(c.fission is None for c in req.cells)
+
+    def test_over_cap_cell_stays_whole(self, monkeypatch):
+        # 2-subproblem cap: >2 components AND no ghosts → no split
+        # applies; the cell must pass through whole, never be lost
+        monkeypatch.setenv("JTPU_FLEETFISSION_MAX_SUBPROBLEMS", "2")
+        h = bitset_ceiling_history(3, n_clean=8, concurrency=2)
+        req = make_req(h)
+        cells = fission_plane.scatter(req)
+        assert len(cells) == 1
+        assert cells[0].fission is None
+        assert fission_plane.plane_stats()["scattered"] == 0
+
+
+# ---------------------------------------------------------------------------
+# recombination table (unknown never false)
+# ---------------------------------------------------------------------------
+
+
+def _group(mode, results, n=None):
+    """Fake fission children with pre-set results for recombine_group."""
+    req = make_req(refuted_bitset_history())
+    n = len(results) if n is None else n
+    cells = []
+    for i, r in enumerate(results):
+        c = Cell(request=req, history=req.history,
+                 fission={"group": "g", "mode": mode, "index": i,
+                          "subproblems": n})
+        c.result = r
+        cells.append(c)
+    return cells
+
+
+_T = {"valid": True, "configs-explored": 3}
+_F = {"valid": False, "op": {"f": "read"}, "witness": {"why": "x"},
+      "analyzer": "wgl-tpu", "configs-explored": 5}
+_F_BARE = {"valid": False, "analyzer": "wgl-tpu", "configs-explored": 5}
+_U = {"valid": "unknown", "error": "capacity exceeded"}
+
+
+class TestRecombine:
+    def test_components_all_true_is_true(self):
+        r = recombine_group(_group("components", [_T, _T, _T]))
+        assert r["valid"] is True
+        assert r["configs-explored"] == 9
+        assert r["fission"] == {"mode": "components", "distributed": True,
+                                "subproblems": 3}
+
+    def test_components_witnessed_false_decides(self):
+        r = recombine_group(_group("components", [_T, _F, _U]))
+        assert r["valid"] is False
+        assert r["op"] == _F["op"] and r["witness"] == _F["witness"]
+        assert r["fission"]["refuting-subproblem"] == 1
+
+    def test_components_unwitnessed_false_is_unknown_never_false(self):
+        # the distributed table is stricter than the engine's: a False
+        # without its op+witness cannot decide the group
+        r = recombine_group(_group("components", [_T, _F_BARE, _T]))
+        assert r["valid"] == "unknown"
+        assert "indefinite" in r["error"]
+
+    def test_components_incomplete_trues_are_unknown(self):
+        r = recombine_group(_group("components", [_T, _T], n=3))
+        assert r["valid"] == "unknown"
+
+    def test_components_false_dominates_cancelled_siblings(self):
+        cancelled = fission_plane.cancelled_result()
+        r = recombine_group(_group("components", [_F, cancelled, cancelled]))
+        assert r["valid"] is False
+
+    def test_ghosts_any_true_is_true(self):
+        r = recombine_group(_group("ghosts", [_F_BARE, _U, _T, _U]))
+        assert r["valid"] is True
+
+    def test_ghosts_all_false_with_witnessed_base_is_false(self):
+        r = recombine_group(_group("ghosts", [_F, _F_BARE, _F_BARE,
+                                              _F_BARE]))
+        assert r["valid"] is False
+        assert r["op"] == _F["op"] and r["witness"] == _F["witness"]
+
+    def test_ghosts_all_false_unwitnessed_base_is_unknown(self):
+        r = recombine_group(_group("ghosts", [_F_BARE, _F, _F, _F]))
+        assert r["valid"] == "unknown"
+
+    def test_ghosts_indefinite_mentions_no_escalation_ceiling(self):
+        r = recombine_group(_group("ghosts", [_F_BARE, _U, _F_BARE, _U]))
+        assert r["valid"] == "unknown"
+        assert "no fleet-side escalation ceiling" in r["error"]
+
+    def test_aggregate_folds_a_scattered_request_to_one_slot(self):
+        h = bitset_ceiling_history(2, n_clean=3, concurrency=2)
+        req = make_req(h)
+        cells = fission_plane.scatter(req)
+        assert len(cells) >= 2
+        for c in cells:
+            c.result = dict(_T)
+        r = aggregate(req)
+        assert r["valid"] is True
+        assert r["fission"]["distributed"] is True
+        # byte-compatible with a whole-cell result: no per-key shape
+        assert "key-count" not in r
+
+
+# ---------------------------------------------------------------------------
+# finalize seam: evidence discipline + sibling cancel
+# ---------------------------------------------------------------------------
+
+
+class _DeadWorker:
+    def __init__(self, wid):
+        self.wid = wid
+
+    def alive(self):
+        return False
+
+
+class _FakeFleet:
+    def __init__(self, workers=()):
+        self.workers = list(workers)
+
+
+def _scattered(h=None, model="bitset"):
+    req = make_req(h if h is not None else refuted_bitset_history(),
+                   model=model)
+    cells = fission_plane.scatter(req)
+    assert len(cells) >= 2
+    for c in cells:
+        c.enqueued = 0.0  # skip the turnaround histogram in unit tests
+    return req, cells
+
+
+class TestOnChildResult:
+    def test_plain_cell_passes_through(self):
+        req = make_req(bitset_ceiling_history(2, n_clean=1, concurrency=1))
+        cell = req.cells[0]
+        res = {"valid": False}  # no witness — and no fission contract
+        assert fission_plane.on_child_result(_FakeFleet(), cell, res) is res
+
+    def test_witnessed_false_cancels_siblings(self):
+        req, cells = _scattered()
+        out = fission_plane.on_child_result(_FakeFleet(), cells[0],
+                                            dict(_F))
+        assert out["valid"] is False
+        assert all(c.cancelled for c in cells[1:])
+        assert fission_plane.plane_stats()["cancelled"] == len(cells) - 1
+
+    def test_ghost_true_cancels_siblings(self):
+        req, cells = _scattered(ghost_register_history(), "cas-register")
+        fission_plane.on_child_result(_FakeFleet(), cells[2], dict(_T))
+        assert all(c.cancelled for c in cells if c is not cells[2])
+
+    def test_resolved_sibling_is_not_cancelled(self):
+        req, cells = _scattered()
+        cells[1].result = dict(_T)
+        fission_plane.on_child_result(_FakeFleet(), cells[0], dict(_F))
+        assert not cells[1].cancelled
+
+    def test_unwitnessed_false_worker_not_found_degrades(self):
+        req, cells = _scattered()
+        res = {"valid": False, "fleet": {"worker": 7},
+               "configs-explored": 5}
+        out = fission_plane.on_child_result(_FakeFleet(), cells[0], res)
+        assert out["valid"] == "unknown"
+        assert "refuting worker not found" in out["error"]
+        assert out["configs-explored"] == 5
+        # an unknown decides nothing: siblings keep running
+        assert not any(c.cancelled for c in cells[1:])
+        stats = fission_plane.plane_stats()
+        assert stats["witness-recoveries"] == 1
+        assert stats["witness-recovery-failures"] == 1
+
+    def test_refuting_worker_died_before_recovery_degrades(self):
+        # THE kill case: the only worker holding the refutation's warm
+        # cache is dead — the group must resolve unknown, never a
+        # fabricated False
+        req, cells = _scattered()
+        fleet = _FakeFleet([_DeadWorker(3)])
+        res = {"valid": False, "fleet": {"worker": 3}}
+        out = fission_plane.on_child_result(fleet, cells[0], res)
+        assert out["valid"] == "unknown"
+        assert "died before witness recovery" in out["error"]
+        assert not any(c.cancelled for c in cells[1:])
+        # ... and the group therefore recombines unknown, never False
+        cells[0].result = out
+        for c in cells[1:]:
+            c.result = dict(_U)
+        assert recombine_group(cells)["valid"] == "unknown"
+
+    def test_ghost_nonbase_false_bears_no_evidence(self):
+        req, cells = _scattered(ghost_register_history(), "cas-register")
+        res = {"valid": False, "fleet": {"worker": 9}}
+        # index != 0: not the canonical all-elided branch — no recovery
+        out = fission_plane.on_child_result(_FakeFleet(), cells[1], res)
+        assert out is res
+        assert fission_plane.plane_stats()["witness-recoveries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# parity fuzz: scattered pipeline vs single-worker fission vs CPU oracle
+# ---------------------------------------------------------------------------
+
+
+def _run_child(model, cell):
+    """What a worker does with one fission child, per its overrides:
+    ghost variants run lean at the pinned ceiling; component children
+    keep worker-local fission on."""
+    ov = cell.spec_overrides
+    if ov.get("fission") is False:
+        return wgl_tpu.check(model, cell.history, capacity=ov["capacity"],
+                             max_capacity=ov["max_capacity"], explain=True)
+    return fission.split_check(model, cell.history, capacity=16,
+                               max_capacity=65536, threshold=32)
+
+
+def _scattered_verdict(h, model_name):
+    model = get_model(model_name)
+    req = make_req(h, model=model_name)
+    cells = fission_plane.scatter(req)
+    assert len(cells) >= 2, "shape did not scatter"
+    for c in cells:
+        c.result = _run_child(model, c)
+    return recombine_group(cells), cells
+
+
+class TestScatterParity:
+    """The scattered pipeline (scatter → per-child worker check →
+    recombine) against single-worker ``fission.split_check`` and the
+    CPU oracle.  The distributed table may degrade to unknown (it has
+    no fleet-side escalation ceiling and demands witnessed Falses) but
+    must never contradict the oracle — and never report False without
+    the refuting op and witness."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("corrupt", [False, True])
+    def test_bitset_component_parity(self, seed, corrupt):
+        m = get_model("bitset")
+        h = bitset_ceiling_history(2, n_clean=6 + seed, concurrency=3)
+        if corrupt:
+            h = corrupt_bitset_read(h)
+        rec, cells = _scattered_verdict(h, "bitset")
+        single = fission.split_check(m, h, capacity=16, max_capacity=65536,
+                                     threshold=32)
+        oracle = wgl_cpu.check(m.cpu_model(), h)
+        assert rec["valid"] in (oracle["valid"], "unknown")
+        assert rec["valid"] in (single["valid"], "unknown")
+        if corrupt:
+            assert oracle["valid"] is False
+            assert rec["valid"] is False
+            assert "op" in rec and "witness" in rec
+        else:
+            assert rec["valid"] is True
+        # internal consistency: the group's explored count is the sum
+        # of its children's
+        assert rec["configs-explored"] == sum(
+            int((c.result or {}).get("configs-explored", 0) or 0)
+            for c in cells)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("corrupt", [False, True])
+    def test_register_ghost_parity(self, seed, corrupt):
+        m = get_model("cas-register")
+        h = ghost_register_history(seed=seed)
+        if corrupt:
+            h = corrupt_reads(h, n=1, seed=seed)
+        rec, _ = _scattered_verdict(h, "cas-register")
+        oracle = wgl_cpu.check(m.cpu_model(), h)
+        assert rec["valid"] in (oracle["valid"], "unknown")
+        if rec["valid"] is False:
+            assert oracle["valid"] is False
+            assert "op" in rec and "witness" in rec
+        if not corrupt:
+            assert oracle["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# real fleet integration (one spin-up: refutation, then evidence loss)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetIntegration:
+    def test_scattered_refutation_and_witness_strip(self):
+        h = refuted_bitset_history()
+        oracle = wgl_cpu.check(get_model("bitset").cpu_model(), h)
+        assert oracle["valid"] is False
+        with Fleet(workers=3, max_lanes=16, capacity=64, hedge_s=5.0,
+                   default_deadline_s=300.0, pin_devices=False) as f:
+            r = f.check(h, model="bitset", deadline_s=300.0)
+            assert r["valid"] is False
+            assert "op" in r and "witness" in r
+            assert r["fission"]["distributed"] is True
+            assert fission_plane.plane_stats()["scattered"] >= 1
+            # evidence-loss nemesis on EVERY worker: refutations (and
+            # the recovery re-checks) arrive witness-less — the group
+            # must degrade to unknown, never fabricate False
+            nem = ChaosNemesis(f, registry=FaultRegistry())
+            for w in f.workers:
+                nem.strip_witness(w.wid)
+            try:
+                r2 = f.check(h, model="bitset", deadline_s=300.0)
+            finally:
+                nem.heal_all()
+            assert r2["valid"] is not False
+            assert r2["valid"] == "unknown"
+            assert fission_plane.plane_stats()[
+                "witness-recovery-failures"] >= 1

@@ -456,9 +456,15 @@ class TestMetricsSchema:
         # module: per-tag counts make the "singlev" family visible next
         # to "batchv"/"megav" (the stale-import satellite)
         assert "tags" in snap["engine-cache"]
-        # fission: the process-wide split/recombine counters plus the
-        # sub-problem wall-clock histograms (engine.fission)
+        # fission: one merged section for the whole story — the engine
+        # splitter counters (engine.fission), the shrink recursion's
+        # (engine.shrink), Hydra's fleet-plane counters
+        # (serve.fission_plane), and every tier's histograms
         assert {"checks", "splits", "recombines", "escalations",
+                "shrink_checks", "shrink_probes", "shrink_refutes",
+                "shrink_exhausted",
+                "scattered", "remote-subproblems", "cancelled",
+                "witness-recoveries", "witness-recovery-failures",
                 "histograms"} <= set(snap["fission"])
         for h in snap["histograms"].values():
             assert {"count", "sum-s", "p50", "p90", "p99",
@@ -485,6 +491,11 @@ class TestMetricsSchema:
                 assert metric_name("gauge", name) in families
         for name in snap["histograms"]:
             assert metric_name("histogram", name) in families
+        # the merged fission section rides its own renderer: every tier's
+        # counters surface as jepsen_tpu_fission_* (hyphens sanitized)
+        for name in ("scattered", "shrink_probes", "witness-recoveries"):
+            assert f"jepsen_tpu_fission_{name.replace('-', '_')}_total" \
+                in families
 
     def test_concurrent_snapshots_never_tear_structurally(self, svc):
         """Gauges are point samples taken outside the metrics lock
