@@ -93,6 +93,7 @@ TIER_TIMEOUT_S = {
     "obs": 300 if SMOKE else 900,
     "elastic": 300 if SMOKE else 900,
     "fleetfission": 420 if SMOKE else 1200,
+    "stream": 300 if SMOKE else 900,
 }
 
 
@@ -1248,6 +1249,74 @@ def tier_fleetfission():
           "hedges": snap["counters"].get("hedges", 0)})
 
 
+def tier_stream():
+    """Pulse tier: one long cas-register stream checked live by the
+    device-resident frontier, one epoch at a time, against the cold
+    one-shot check of the same history.  The claims under measurement:
+    per-epoch wall stays flat from the first post-warmup quarter to the
+    last (the frontier extends, never recomputes), steady state makes
+    zero recompiles, and the summed stream wall stays within a small
+    factor of the single cold check it replaces — the price of getting
+    a verdict at every epoch instead of once at the end."""
+    from jepsen_tpu.checker import wgl_tpu
+    from jepsen_tpu.engine.stream import DeviceKeyFrontier
+    from jepsen_tpu.models import CASRegister, get_model
+    from jepsen_tpu.obs.hist import compile_event_count
+    from jepsen_tpu.synth import cas_register_history
+    n_ops = 2_000 if SMOKE else 40_000
+    epoch_ops = 256
+    jm = get_model("cas-register")
+    h = cas_register_history(n_ops, concurrency=4, crash_p=0.0, seed=0)
+    ops = list(h)
+
+    def run_stream(record=None):
+        f = DeviceKeyFrontier(jm, CASRegister())
+        for i in range(0, len(ops), epoch_ops):
+            for op in ops[i:i + epoch_ops]:
+                f.feed(op)
+            t0 = time.time()
+            f.advance()
+            if record is not None:
+                record.append(time.time() - t0)
+        f.finalize()
+        assert f.verdict()["valid"] is True, "stream tier history refuted"
+        assert f.fallback_reason is None, f.fallback_reason
+        return f
+
+    progress("stream: warm pass (compiles the epoch-bucket ladder)")
+    run_stream()
+    warm_compiles = compile_event_count()
+
+    progress("stream: measured pass")
+    walls: list = []
+    t0 = time.time()
+    f = run_stream(record=walls)
+    stream_s = time.time() - t0
+    recompiles = compile_event_count() - warm_compiles
+
+    progress("stream: cold one-shot baseline")
+    wgl_tpu.check(jm, h)                        # warm the one-shot engine
+    t0 = time.time()
+    cold = wgl_tpu.check(jm, h)
+    cold_s = time.time() - t0
+    assert cold["valid"] is True
+
+    q = max(1, len(walls) // 4)
+    early = statistics.median(walls[1:1 + q])   # skip the first epoch
+    late = statistics.median(walls[-q:])
+    emit({"n_ops": n_ops, "epoch_ops": epoch_ops,
+          "epochs": len(walls),
+          "epoch_dispatches": f.epoch_dispatches,
+          "steady_recompiles": recompiles,
+          "stream_s": round(stream_s, 3),
+          "cold_oneshot_s": round(cold_s, 3),
+          "stream_over_cold": (round(stream_s / cold_s, 2)
+                               if cold_s else None),
+          "epoch_wall_early_s": round(early, 4),
+          "epoch_wall_late_s": round(late, 4),
+          "late_over_early": round(late / early, 2) if early else None})
+
+
 TIER_FNS = {
     "cpu": tier_cpu,
     "easy": tier_easy,
@@ -1268,6 +1337,7 @@ TIER_FNS = {
     "obs": tier_obs,
     "elastic": tier_elastic,
     "fleetfission": tier_fleetfission,
+    "stream": tier_stream,
 }
 
 

@@ -26,10 +26,11 @@ from typing import Optional, Sequence, Tuple
 # first attribute access instead of at import time keeps the engine ->
 # serve edge out of the import graph.
 _BUCKET_EXPORTS = (
-    "MAX_LANE_BUCKET", "MIN_EVENTS_BUCKET", "MIN_N_BUCKET",
+    "MAX_EPOCH_EVENTS_BUCKET", "MAX_LANE_BUCKET",
+    "MIN_EPOCH_EVENTS_BUCKET", "MIN_EVENTS_BUCKET", "MIN_N_BUCKET",
     "MIN_STATE_WIDTH_BUCKET", "MIN_WIDTH_BUCKET", "elle_bucket",
-    "elle_n_bucket", "events_bucket", "lane_bucket", "mega_lane_bucket",
-    "pow2_at_least", "state_width_bucket", "wgl_bucket",
+    "elle_n_bucket", "epoch_events_bucket", "events_bucket", "lane_bucket",
+    "mega_lane_bucket", "pow2_at_least", "state_width_bucket", "wgl_bucket",
     "wgl_start_capacity", "width_bucket",
 )
 

@@ -308,6 +308,29 @@ def ladder_findings(samples: Sequence[Tuple[int, int, int]] =
     findings.extend(signature_stability_findings(
         samples, fission_signature, fission_bucket, "fission sub-dispatch",
         path="jepsen_tpu/engine/fission.py"))
+
+    # The streaming monitor's epoch dispatch (engine/stream.py): the
+    # (window, capacity, epoch-events) rung triple is the shape cut of
+    # the "streamv" engine-cache key.  Run the REAL rung derivation over
+    # raw (new-op count, concurrency) samples and require it to collapse
+    # onto the (width-bucket, epoch-events-bucket) image — a raw
+    # per-epoch op count leaking into the chunk shape recompiles every
+    # epoch, which is exactly the steady-state-zero-recompiles property
+    # the stream smoke asserts end-to-end.
+    from jepsen_tpu.engine.stream import stream_engine_rungs
+
+    def stream_bucket(s):
+        e, w, _ = s
+        return (buckets.pow2_at_least(max(1, w), buckets.MIN_WIDTH_BUCKET),
+                buckets.epoch_events_bucket(e))
+
+    def stream_signature(s):
+        e, w, _ = s
+        return stream_engine_rungs(w, e)
+
+    findings.extend(signature_stability_findings(
+        samples, stream_signature, stream_bucket, "stream epoch dispatch",
+        path="jepsen_tpu/engine/stream.py"))
     return findings
 
 

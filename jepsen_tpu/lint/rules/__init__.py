@@ -16,7 +16,10 @@ The catalog (rationale per rule lives in docs/static_analysis.md):
 - SHAPE01 — every engine-entry shape in serve/ derives from the bucket
   ladder, never from raw history shape;
 - CONC01  — monotonic-clock discipline, lock-order manifest, no blocking
-  I/O while holding a lock.
+  I/O while holding a lock;
+- OBS01   — span discipline on the tracing plane: exported durations
+  are monotonic intervals, the wall anchor is export-alignment only,
+  trace identity is plumbed, never minted from literals.
 """
 
 from __future__ import annotations
@@ -80,8 +83,9 @@ def dotted(node: ast.AST) -> str:
 
 
 def all_rules():
-    from jepsen_tpu.lint.rules import conc01, dev01, shape01, sound01
-    return (sound01, dev01, shape01, conc01)
+    from jepsen_tpu.lint.rules import (conc01, dev01, obs01, shape01,
+                                       sound01)
+    return (sound01, dev01, shape01, conc01, obs01)
 
 
 def interp_rules():

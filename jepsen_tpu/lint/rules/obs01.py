@@ -1,0 +1,143 @@
+"""OBS01: span discipline for the tracing plane (serve/ + monitor/).
+
+The trace story only merges cleanly — worker spans absorbed into fleet
+traces, Perfetto exports lining up with flight-recorder records — when
+three invariants hold everywhere spans are made:
+
+1. **Span and record durations are monotonic intervals.**  A
+   ``RECORDER.record(..., dur_s=...)`` (or ``t=...``) whose duration
+   expression involves wall-clock material (``time.time``, a
+   ``wall_anchor``/``anchor_unix_s`` attribute) breaks under NTP steps
+   exactly like a CONC01 deadline — except it corrupts *exported* data,
+   which is worse: a dashboard can't re-measure the past.
+
+2. **The wall anchor is for export alignment only.**  Each trace
+   carries one ``anchor_unix_s`` so exporters can place monotonic spans
+   on a calendar axis; arithmetic on it anywhere in serve/ or monitor/
+   means someone is deriving intervals from wall clock again, one
+   attribute-hop removed from check 1.
+
+3. **Trace identity comes from the request plumbing, never literals.**
+   A dict literal carrying both a ``"trace-id"`` key and a span-id key
+   with a *constant or f-string* trace-id value is a hand-built trace
+   context — it forks the request's identity, so the fleet's absorb
+   step files those spans under a trace nobody else shares.  Plumbed
+   ids (``self.trace_id``, ``serve.get("trace-id")``) are attribute or
+   call expressions and stay clean.
+
+Legitimate wall-clock *display* sites carry the usual pragma:
+``# lint: disable=OBS01(export-only wall anchor)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.rules import dotted, qualname_of, walk_with_parents
+
+RULE = "OBS01"
+
+SCOPE = ("jepsen_tpu/serve/", "jepsen_tpu/monitor/")
+
+#: names whose appearance inside a duration expression marks it as
+#: wall-clock-derived
+_WALL_MARKERS = ("time.time", "wall_anchor", "anchor_unix_s")
+
+#: RECORDER.record kwargs that carry durations/instants and must be
+#: monotonic-derived
+_DUR_KWARGS = ("dur_s", "t")
+
+_SPAN_KEYS = ("span-id", "parent-span-id")
+
+
+def _expr_names(node: ast.AST) -> List[str]:
+    """Dotted names of every Name/Attribute/Call-func inside ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            d = dotted(n)
+            if d:
+                out.append(d)
+            elif isinstance(n, ast.Attribute):
+                out.append(n.attr)      # a.b().c — keep the leaf attr
+    return out
+
+
+def _check_record_durations(tree: ast.Module,
+                            path: str) -> Iterator[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not d.endswith("RECORDER.record"):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in _DUR_KWARGS:
+                continue
+            names = _expr_names(kw.value)
+            bad = [n for n in names
+                   if any(n == m or n.endswith("." + m)
+                          for m in _WALL_MARKERS)]
+            if bad:
+                yield Finding(
+                    RULE, path, node.lineno,
+                    f"wall-clock material `{bad[0]}` in "
+                    f"`{kw.arg}=` of RECORDER.record in "
+                    f"{qualname_of(node)}: exported durations must be "
+                    f"monotonic intervals",
+                    hint="measure with jepsen_tpu.clock.mono_now() "
+                         "deltas; the wall anchor exists only so "
+                         "exporters can place monotonic spans on a "
+                         "calendar axis")
+
+
+def _check_anchor_arithmetic(tree: ast.Module,
+                             path: str) -> Iterator[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.BinOp):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr == "anchor_unix_s":
+                yield Finding(
+                    RULE, path, node.lineno,
+                    f"arithmetic on `{dotted(sub) or sub.attr}` in "
+                    f"{qualname_of(node)}: the wall anchor aligns "
+                    f"exports, it is not an interval operand",
+                    hint="derive intervals from mono_now() deltas; if "
+                         "this is a display-only conversion, add "
+                         "`# lint: disable=OBS01(export-only wall "
+                         "anchor)`")
+                break
+
+
+def _check_handbuilt_trace_dicts(tree: ast.Module,
+                                 path: str) -> Iterator[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {k.value: v for k, v in zip(node.keys, node.values)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+        if "trace-id" not in keys \
+                or not any(s in keys for s in _SPAN_KEYS):
+            continue
+        tid = keys["trace-id"]
+        if isinstance(tid, (ast.Constant, ast.JoinedStr)):
+            yield Finding(
+                RULE, path, node.lineno,
+                f"hand-built trace context in {qualname_of(node)}: "
+                f"literal `trace-id` next to a span-id key forks the "
+                f"request's trace identity",
+                hint="thread the request's own trace_id/span_id "
+                     "through (request.span / trace_payload); never "
+                     "mint trace ids from literals")
+
+
+def check(tree: ast.Module, src_lines: List[str],
+          path: str) -> Iterator[Finding]:
+    list(walk_with_parents(tree))       # annotate parents for qualnames
+    yield from _check_record_durations(tree, path)
+    yield from _check_anchor_arithmetic(tree, path)
+    yield from _check_handbuilt_trace_dicts(tree, path)

@@ -42,13 +42,27 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+import os
+
 from jepsen_tpu.history import History, Op
 from jepsen_tpu.monitor.epochs import ElleEpochEngine, WglEpochEngine
 from jepsen_tpu.monitor.tap import DEFAULT_CAPACITY, OpTap
 from jepsen_tpu.monitor.verdict import VerdictChannel
+from jepsen_tpu.obs.hist import observe_monitor_epoch
 from jepsen_tpu.obs.recorder import RECORDER
 from jepsen_tpu.obs.telemetry import set_gauge
 from jepsen_tpu.serve.metrics import mono_now
+
+
+def stream_engine_enabled() -> bool:
+    """The ``JTPU_STREAM_ENGINE`` knob, read at call time (tests and the
+    CLI flip it per monitor): route epoch advances through the
+    device-resident stream tier (engine/stream.py wgl frontiers,
+    elle_tpu/incremental.py extended closures).  Off by default — the
+    host tier stays the reference; the stream tier degrades back to it
+    per frontier on any device trouble."""
+    return os.environ.get("JTPU_STREAM_ENGINE", "") not in ("", "0",
+                                                            "false", "off")
 
 logger = logging.getLogger("jepsen.monitor")
 
@@ -98,15 +112,32 @@ class Monitor:
         self.service = service
         self.store_dir = store_dir
         self.tap = OpTap(tap_capacity)
+        streaming = stream_engine_enabled()
         if kind == "wgl":
-            self.engine = WglEpochEngine(model, independent=independent,
-                                         max_configs=max_configs,
-                                         keep_prefix=service is not None)
+            if streaming and jax_model is not None:
+                from jepsen_tpu.engine.stream import StreamWglEpochEngine
+                self.engine = StreamWglEpochEngine(
+                    model, jax_model=jax_model, independent=independent,
+                    max_configs=max_configs,
+                    keep_prefix=service is not None, service=service)
+            else:
+                self.engine = WglEpochEngine(
+                    model, independent=independent,
+                    max_configs=max_configs,
+                    keep_prefix=service is not None)
         else:
-            self.engine = ElleEpochEngine(workload=workload,
-                                          realtime=realtime,
-                                          service=service,
-                                          budget_s=budget_s)
+            if streaming:
+                from jepsen_tpu.elle_tpu.incremental import \
+                    IncrementalElleEngine
+                self.engine = IncrementalElleEngine(workload=workload,
+                                                    realtime=realtime,
+                                                    service=service,
+                                                    budget_s=budget_s)
+            else:
+                self.engine = ElleEpochEngine(workload=workload,
+                                              realtime=realtime,
+                                              service=service,
+                                              budget_s=budget_s)
         self.channel = VerdictChannel(abort=abort, store_dir=store_dir,
                                       service=service)
         self.epochs: List[Dict[str, Any]] = []
@@ -237,7 +268,15 @@ class Monitor:
         # flight recorder — visible in the merged Perfetto export — and
         # the monitor-lag gauge (ops accepted but not yet folded into a
         # verdict epoch) for the telemetry plane.
-        set_gauge("epochs-behind-live", int(rec.get("pending-ops", 0)))
+        pending = int(rec.get("pending-ops", 0))
+        set_gauge("epochs-behind-live", pending)
+        # per-stream lag, measured in epochs (ceil of pending / epoch
+        # size) — the unit the monitor-lag SLO burns in — plus the
+        # epoch-wall histogram the stream bench reads for flatness
+        set_gauge(f"monitor-lag-epochs:{self.name}",
+                  -(-pending // self.epoch_ops))
+        observe_monitor_epoch(f"monitor-epoch:{self.kind}:{self.name}",
+                              wall)
         RECORDER.record(
             "monitor", f"epoch:{self.kind}:{self.name}:{n}", dur_s=wall,
             args={"epoch": n, "new-ops": rec["new-ops"],
@@ -305,8 +344,10 @@ class Monitor:
             tail = len(ops)
         # final drain folded everything in: the lag gauge settles at the
         # engine's residual (0 for wgl, open invocations for elle)
-        set_gauge("epochs-behind-live",
-                  int(self.engine.counters().get("pending-ops", 0)))
+        residual = int(self.engine.counters().get("pending-ops", 0))
+        set_gauge("epochs-behind-live", residual)
+        set_gauge(f"monitor-lag-epochs:{self.name}",
+                  -(-residual // self.epoch_ops))
         RECORDER.record(
             "monitor", f"epoch:{self.kind}:{self.name}:final",
             args={"tail-ops": tail})

@@ -177,6 +177,22 @@ def compile_event_count() -> int:
                for s in COMPILES.snapshot().values())
 
 
+#: process-wide monitor epoch-wall histograms, one per
+#: ``monitor-epoch:<kind>:<stream>`` family — global like the monitors
+#: themselves (they outlive any one service), surfaced through every
+#: Metrics.snapshot() next to the compile histograms.  The stream bench
+#: reads these to assert per-epoch wall stays flat in history length.
+MONITOR_EPOCHS = HistogramSet()
+
+
+def observe_monitor_epoch(name: str, seconds: float) -> None:
+    MONITOR_EPOCHS.observe(name, seconds)
+
+
+def monitor_epoch_hist_stats() -> Dict[str, Dict[str, Any]]:
+    return MONITOR_EPOCHS.snapshot()
+
+
 def timed_first_call(fn, name: str):
     """Wrap a jitted callable so its *first* invocation — the one that
     pays XLA compilation — is timed into the compile histogram ``name``

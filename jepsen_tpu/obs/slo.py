@@ -83,6 +83,10 @@ def _worker_stale_s(store, worker, now) -> Optional[float]:
     return store.stale_s(worker, now=now)
 
 
+def _monitor_lag_epochs(store, worker, now) -> Optional[float]:
+    return store.rates(worker).get("monitor-lag-epochs")
+
+
 def default_specs(interval_s: float) -> List[SloSpec]:
     """The shipped SLO set.  Ceilings are deliberately loose for the
     1-core CI world (first-compile dispatches take whole seconds there);
@@ -113,6 +117,12 @@ def default_specs(interval_s: float) -> List[SloSpec]:
                 c("worker_stale_s", 0.0), w("worker_stale_s", 0.0), "s",
                 "seconds past the 2-missed-intervals staleness threshold",
                 _worker_stale_s),
+        SloSpec("monitor_lag_epochs",
+                c("monitor_lag_epochs", 8.0),
+                w("monitor_lag_epochs", max(0.0, 2 * interval_s)),
+                "epochs",
+                "worst per-stream streaming-monitor lag behind live",
+                _monitor_lag_epochs),
     ]
 
 

@@ -347,6 +347,11 @@ class TelemetryStore:
                 else None),
             "p99-dispatch-verdict-us":
                 _hist_p99_us(newest["payload"], "edge:dispatch->verdict"),
+            # worst per-stream streaming-monitor lag, in epochs, off the
+            # newest push (Metrics.snapshot folds the per-stream gauges
+            # to their max) — the monitor_lag_epochs SLO's signal
+            "monitor-lag-epochs":
+                _gauge(newest["payload"], "monitor-lag-epochs"),
         }
         if len(in_window) < 2:
             return out

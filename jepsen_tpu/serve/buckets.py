@@ -191,6 +191,28 @@ def wgl_start_capacity(ev_bucket: int, w_bucket: int) -> int:
     return max(MIN_WGL_CAPACITY, min(cap, MAX_WGL_CAPACITY))
 
 
+#: floor / ceiling of the streaming monitor's per-epoch dispatch ladder.
+#: A monitored stream's epoch delivers a raw new-op count that varies
+#: continuously; the device-resident frontier (engine/stream.py) pads each
+#: epoch's event rows onto this pow2 ladder so the compiled epoch-advance
+#: executable is keyed on a handful of chunk rungs, not on raw epoch sizes.
+#: The ceiling keeps one epoch dispatch's scan bounded — a larger backlog
+#: simply dispatches several ceiling-sized chunks.
+MIN_EPOCH_EVENTS_BUCKET = 64
+MAX_EPOCH_EVENTS_BUCKET = 2048
+
+
+def epoch_events_bucket(n_new: int) -> int:
+    """The stream engine's per-epoch event-chunk rung: pow2 at least the
+    new-op count, clamped to [MIN_EPOCH_EVENTS_BUCKET,
+    MAX_EPOCH_EVENTS_BUCKET].  Pure function of the new-op count alone —
+    total history length must never reach an epoch dispatch shape, or the
+    compiled-signature universe grows with stream lifetime (the exact
+    leak TRACE02's stream leg guards)."""
+    return min(pow2_at_least(max(1, n_new), MIN_EPOCH_EVENTS_BUCKET),
+               MAX_EPOCH_EVENTS_BUCKET)
+
+
 def wgl_bucket(h: History) -> Tuple[int, int]:
     return (events_bucket(h), width_bucket(h))
 

@@ -60,7 +60,8 @@ from typing import Any, Dict, List, Optional
 # monitor/ module already imports it from metrics.
 from jepsen_tpu.clock import mono_now  # noqa: F401
 from jepsen_tpu.obs.hist import (HistogramSet, compile_event_count,
-                                 compile_hist_stats, merge_skipped_count)
+                                 compile_hist_stats, merge_skipped_count,
+                                 monitor_epoch_hist_stats)
 
 
 class Metrics:
@@ -228,7 +229,14 @@ class Metrics:
         # for the resulting tear contract
         queue = self._queue_fn() if self._queue_fn else \
             {"depth": 0, "buckets": {}, "oldest-wait-s": 0.0}
-        hists = {**self.hists.snapshot(), **compile_hist_stats()}
+        hists = {**self.hists.snapshot(), **compile_hist_stats(),
+                 **monitor_epoch_hist_stats()}
+        pg = process_gauges()
+        # worst per-stream monitor lag, in epochs (Monitor.flush sets one
+        # `monitor-lag-epochs:<stream>` gauge per streaming monitor; the
+        # scalar the SLO burns on is the max across streams)
+        lag_epochs = max([int(v) for k, v in pg.items()
+                          if k.startswith("monitor-lag-epochs:")] or [0])
         # per-tenant cut: lifecycle counters + the tenant verdict-edge
         # p99 + the tenant table's policy/accounting (quota, priority,
         # open, quota-rejections).  Names and numbers only — never token
@@ -254,7 +262,8 @@ class Metrics:
                 # monitor runs in this process) — set by Monitor.flush
                 # through obs.telemetry.set_gauge
                 "epochs-behind-live":
-                    int(process_gauges().get("epochs-behind-live", 0)),
+                    int(pg.get("epochs-behind-live", 0)),
+                "monitor-lag-epochs": lag_epochs,
                 # the autoscaler's wait-age input signal, sampled with
                 # the other gauges (same tear contract)
                 "queue-oldest-wait-s": queue.get("oldest-wait-s", 0.0),

@@ -187,7 +187,18 @@ class Router:
 
         Workers marked ``draining`` (a scale-down in progress —
         serve/autoscale.py) take no new cells: they finish what they
-        have while the rest of the fleet absorbs their share."""
+        have while the rest of the fleet absorbs their share.
+
+        Hydra sub-problems (cells carrying ``fission`` scatter
+        metadata) get **placement spread**: all siblings of one split
+        rank against the *group* token (so the whole swarm agrees on
+        one deterministic worker ring) and each sibling starts the walk
+        at its own ``index`` rotation into that ring.  k <= N
+        sub-problems land on k distinct workers instead of convoying on
+        the group winner; k > N wraps the ring — the natural rendezvous
+        behaviour.  Failover order is preserved: a sibling whose head
+        worker trips its circuit walks the same ring everyone agrees
+        on, just from a different start."""
         ex = set(exclude)
         alive = [w for w in self._workers
                  if w.wid not in ex and w.alive()
@@ -196,9 +207,18 @@ class Router:
             fitting = [w for w in alive if w.fits(cell)]
             if fitting:
                 alive = fitting
+        fiss = getattr(cell, "fission", None) if cell is not None else None
+        if isinstance(fiss, dict) and fiss.get("group") is not None \
+                and fiss.get("index") is not None and len(alive) > 1:
+            token = f"fission:{fiss['group']}"
         scored = [(rendezvous_score(token, str(w.wid)), w) for w in alive]
         scored.sort(key=lambda sw: sw[0], reverse=True)
-        return [w for _, w in scored]
+        ring = [w for _, w in scored]
+        if isinstance(fiss, dict) and fiss.get("group") is not None \
+                and fiss.get("index") is not None and len(ring) > 1:
+            rot = int(fiss["index"]) % len(ring)
+            ring = ring[rot:] + ring[:rot]
+        return ring
 
     def pick(self, token: str, exclude: Iterable[int] = (), cell=None):
         """The worker to route ``token`` to, or None when no alive worker

@@ -71,6 +71,11 @@ class Scheduler:
         self._stop = False
         self._inflight = 0
         self._idle_listeners: List[Any] = []
+        # monitor lane: thunks the streaming monitors want run on the
+        # device-loop thread, between batch dispatches — the monitor's
+        # epoch-advance chunks share the device with request traffic
+        # without a second dispatch thread racing it (see monitor_call)
+        self._monitor_lane: deque = deque()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-scheduler")
         self._started = False
@@ -149,6 +154,44 @@ class Scheduler:
         or a stop/kill landed — the fleet's heartbeat probes this."""
         return (self._started and not self._stop
                 and self._thread.is_alive())
+
+    def monitor_call(self, fn, timeout: float = 300.0) -> Any:
+        """Run ``fn()`` on the device-loop thread, between batch
+        dispatches, and return its result (re-raising its exception).
+
+        The streaming monitors (engine/stream.py) route their epoch
+        chunk dispatches here when a service owns the device: the device
+        is one serially-dispatched resource, so monitor work must
+        interleave with request batches on the ONE loop thread instead
+        of racing them from the monitor's thread.  Monitor thunks run
+        before the next batch pick — an epoch chunk is small (one
+        bucketed dispatch), so lane traffic cannot starve requests.
+
+        When the loop is not running (never started, stopped, crashed),
+        ``fn`` runs inline on the caller — the monitor still advances,
+        just without interleaving.  The generous default timeout covers
+        a first-call XLA compile landing in front of the thunk."""
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+        with self._cond:
+            live = self.alive()
+            if live:
+                self._monitor_lane.append((fn, box, done))
+                self._cond.notify_all()
+        if not live:
+            return fn()
+        if not done.wait(timeout):
+            raise TimeoutError("monitor-lane dispatch timed out")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _drain_monitor_lane(self) -> List[Tuple[Any, Dict[str, Any],
+                                                threading.Event]]:
+        """Snapshot-and-clear the lane (caller holds the lock)."""
+        lane = list(self._monitor_lane)
+        self._monitor_lane.clear()
+        return lane
 
     def evict_pending(self) -> List[Cell]:
         """Drain hook: pop every *queued* (not yet dispatched) cell and
@@ -283,13 +326,30 @@ class Scheduler:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while self._depth == 0 and not self._stop:
+                while (self._depth == 0 and not self._monitor_lane
+                       and not self._stop):
                     self._cond.wait(timeout=0.1)
                 if self._stop and self._depth == 0:
+                    # waiters must not hang on a dead loop: fail the
+                    # lane so monitor_call raises instead of timing out
+                    for _fn, box, done in self._drain_monitor_lane():
+                        box["error"] = RuntimeError("scheduler stopped")
+                        done.set()
                     return
+                lane = self._drain_monitor_lane()
                 cells = self._take_group()
                 self._inflight = len(cells)
                 self._cond.notify_all()  # depth dropped: wake producers
+            # monitor thunks run outside the lock, before the batch —
+            # an epoch chunk ahead of a dispatch, never inside either
+            for fn, box, done in lane:
+                try:
+                    box["result"] = fn()
+                    self.metrics.inc("monitor-epoch-dispatches")
+                except Exception as e:  # noqa: BLE001 — caller re-raises
+                    box["error"] = e
+                finally:
+                    done.set()
             if not cells:
                 continue
             try:

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Stream smoke: the device-resident monitor tier at fleet cadence.
+
+Eight concurrent cas-register streams (2k ops each) ride their own
+``JTPU_STREAM_ENGINE=1`` monitors, fed round-robin one epoch at a time —
+the shape N monitored runs sharing one process would produce.  One
+stream has a read corrupted near op 1k.  Asserts:
+
+  1. **Refutation latency** — the corrupted stream refutes before its
+     stream ends, within 2 epochs of the epoch containing the faulty op,
+     and its refutation dict is byte-identical to a host KeyFrontier
+     replay of the same prefix (the stream tier's parity contract).
+  2. **Zero steady-state recompiles** — all 8 streams share the same
+     rung triple, so once the epoch-bucket ladder is warm (one
+     throwaway stream pre-compiles each rung) the process-wide
+     compile-event count must not move across the fleet's entire run —
+     over 1,000 epoch dispatches.
+  3. **Flat per-epoch wall** — each epoch pays for its new ops only:
+     the median epoch wall of the final quarter of the run stays within
+     5x the median of the first post-warmup quarter (cold restarts would
+     grow linearly with prefix length and blow through this).
+  4. **Clean-stream validity + settled lag** — every clean stream ends
+     valid with zero fallbacks, and every clean stream's
+     ``monitor-lag-epochs`` gauge settles at 0 after finalize (the
+     refuted stream keeps its residual by design).
+  5. **Incremental elle parity** — one list-append stream runs with
+     ``JTPU_STREAM_ORACLE=1``: warm extensions happen and the cold
+     device oracle never disagrees.
+
+Writes the full metrics report to argv[1] (default
+/tmp/stream_metrics.json) — CI uploads it as an artifact.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["JTPU_STREAM_ENGINE"] = "1"
+os.environ["JTPU_STREAM_ORACLE"] = "1"
+
+from jepsen_tpu.history import OK, History  # noqa: E402
+from jepsen_tpu.models import CASRegister, get_model  # noqa: E402
+from jepsen_tpu.monitor import Monitor  # noqa: E402
+from jepsen_tpu.monitor.epochs import KeyFrontier  # noqa: E402
+from jepsen_tpu.obs.hist import compile_event_count  # noqa: E402
+from jepsen_tpu.obs.telemetry import process_gauges  # noqa: E402
+from jepsen_tpu.synth import (  # noqa: E402
+    cas_register_history, list_append_history,
+)
+
+N_STREAMS = 8
+N_OPS = 20000
+EPOCH_OPS = 256
+FAULT_STREAM = 3
+FAULT_AT = 1000
+#: rounds excluded from the flat-wall median (first-epoch jitter)
+WARMUP_ROUNDS = 2
+
+
+def prewarm():
+    """Compile every epoch-bucket rung the fleet can touch (64..512 for
+    256-op epochs) on a throwaway frontier, so the fleet run proper
+    asserts ZERO compiles — steady state from its very first epoch."""
+    from jepsen_tpu.engine.stream import DeviceKeyFrontier
+    f = DeviceKeyFrontier(get_model("cas-register"), CASRegister())
+    ops = list(cas_register_history(700, concurrency=4, crash_p=0.0,
+                                    seed=99))
+    i = 0
+    for chunk in (512, 256, 140, 100, 50):
+        for op in ops[i:i + chunk]:
+            f.feed(op)
+        f.advance()
+        i += chunk
+    f.finalize()
+    assert f.verdict()["valid"] is True
+
+
+def build_streams():
+    streams = []
+    for s in range(N_STREAMS):
+        ops = [o.with_() for o in
+               cas_register_history(N_OPS, concurrency=4, crash_p=0.0,
+                                    seed=s)]
+        if s == FAULT_STREAM:
+            i = next(j for j, o in enumerate(ops)
+                     if j >= FAULT_AT and o.type == OK and o.f == "read")
+            ops[i] = ops[i].with_(value=9999)   # never a register value
+        h = History(ops, reindex=True)
+        m = Monitor(kind="wgl", model=CASRegister(),
+                    jax_model=get_model("cas-register"),
+                    epoch_ops=EPOCH_OPS, name=f"s{s}")
+        streams.append({"name": f"s{s}", "history": h, "monitor": m,
+                        "cursor": 0, "walls": [], "refuted-at-epoch": None})
+    return streams
+
+
+def drive(streams):
+    """Round-robin: every live stream gets one epoch of ops per round.
+    A refuted stream is done — the live cut stops feeding it."""
+    def live(st):
+        return (st["cursor"] < len(st["history"])
+                and not st["monitor"].channel.status()["refuted"])
+
+    rounds = 0
+    while any(live(st) for st in streams):
+        rounds += 1
+        for st in streams:
+            h, m = st["history"], st["monitor"]
+            if not live(st):
+                continue
+            nxt = min(st["cursor"] + EPOCH_OPS, len(h))
+            for op in list(h)[st["cursor"]:nxt]:
+                m.offer(op)
+            st["cursor"] = nxt
+            t0 = time.perf_counter()
+            m.flush()
+            st["walls"].append(time.perf_counter() - t0)
+            if m.channel.status()["refuted"] \
+                    and st["refuted-at-epoch"] is None:
+                st["refuted-at-epoch"] = len(m.epochs)
+    return rounds
+
+
+def elle_leg():
+    h = list_append_history(n_txns=400, seed=1)
+    m = Monitor(kind="elle", epoch_ops=EPOCH_OPS, name="elle-stream")
+    ops = list(h)
+    for i in range(0, len(ops), EPOCH_OPS):
+        for op in ops[i:i + EPOCH_OPS]:
+            m.offer(op)
+        m.flush()
+    m.finalize()
+    c = m.engine.counters()
+    return {"valid-so-far": (m.engine.last or {}).get("valid"),
+            "warm-extends": c["elle-warm-extends"],
+            "resets": c["elle-resets"],
+            "oracle-mismatches": c["elle-oracle-mismatches"]}
+
+
+def main():
+    dump = sys.argv[1] if len(sys.argv) > 1 else "/tmp/stream_metrics.json"
+    prewarm()
+    warm_compiles = compile_event_count()
+    streams = build_streams()
+    rounds = drive(streams)
+    for st in streams:
+        st["monitor"].finalize()
+    steady_compiles = compile_event_count()
+    dispatches = sum(st["monitor"].engine.counters()["epoch-dispatches"]
+                     for st in streams)
+
+    fault = streams[FAULT_STREAM]
+    verdict = fault["monitor"].channel.status()["verdict"] or {}
+    op_index = verdict.get("op-index")
+    refuted_epoch = verdict.get("epoch")
+    faulty_epoch = (op_index // EPOCH_OPS) + 1 if op_index is not None \
+        else None
+    behind = (refuted_epoch - faulty_epoch
+              if refuted_epoch is not None and faulty_epoch is not None
+              else None)
+
+    # byte-parity of the refutation against a pure host replay
+    frontier = fault["monitor"].engine.frontiers[None]
+    host = KeyFrontier(CASRegister())
+    for op in frontier.prefix:
+        host.feed(op)
+    host.finalize()
+
+    # flat wall: pool post-warmup epoch walls across the clean streams
+    walls = [w for st in streams if st is not fault
+             for w in st["walls"][WARMUP_ROUNDS:]]
+    q = max(1, len(walls) // 4)
+    early, late = walls[:q], walls[-q:]
+    wall_ratio = (statistics.median(late) / statistics.median(early)
+                  if early and late else None)
+
+    # the refuted stream keeps a residual by design (refutation is
+    # final; its tail is never folded) — the settled-lag claim is for
+    # the clean streams
+    lag_gauges = {k: v for k, v in process_gauges().items()
+                  if k.startswith("monitor-lag-epochs:s")
+                  and k != f"monitor-lag-epochs:s{FAULT_STREAM}"}
+    clean = [{"name": st["name"],
+              **{k: st["monitor"].engine.counters()[k]
+                 for k in ("epoch-dispatches", "fallbacks")},
+              "valid": st["monitor"].engine.frontiers[None]
+              .verdict()["valid"]}
+             for st in streams if st is not fault]
+    elle = elle_leg()
+
+    report = {
+        "streams": N_STREAMS, "ops-per-stream": N_OPS,
+        "epoch-ops": EPOCH_OPS, "rounds": rounds,
+        "corrupted": {"op-index": op_index,
+                      "refuted-epoch": refuted_epoch,
+                      "faulty-op-epoch": faulty_epoch,
+                      "epochs-behind": behind,
+                      "host-parity": frontier.result == host.result},
+        "epoch-dispatches": dispatches,
+        "compiles": {"after-prewarm": warm_compiles,
+                     "at-end": steady_compiles,
+                     "steady-state-delta": steady_compiles - warm_compiles},
+        "wall": {"post-warmup-epochs": len(walls),
+                 "median-early-s": round(statistics.median(early), 4)
+                 if early else None,
+                 "median-late-s": round(statistics.median(late), 4)
+                 if late else None,
+                 "late-over-early": round(wall_ratio, 2)
+                 if wall_ratio is not None else None},
+        "clean-streams": clean,
+        "lag-gauges": lag_gauges,
+        "elle": elle,
+    }
+    with open(dump, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps({k: report[k] for k in
+                      ("corrupted", "compiles", "wall", "elle")}))
+
+    assert fault["monitor"].channel.status()["refuted"], \
+        "the corrupted stream never refuted"
+    assert fault["cursor"] < len(fault["history"]), \
+        "refutation must cut the stream before it ends"
+    assert behind is not None and behind <= 2, \
+        f"refutation lagged {behind} epochs behind the faulty op"
+    assert frontier.result == host.result, \
+        "stream refutation diverged from the host replay"
+
+    assert dispatches >= 1000, \
+        f"only {dispatches} epoch dispatches — not a steady-state run"
+    assert steady_compiles == warm_compiles, \
+        f"{steady_compiles - warm_compiles} steady-state recompile(s) " \
+        f"across {dispatches} epoch dispatches"
+    assert wall_ratio is not None and wall_ratio <= 5.0, \
+        f"per-epoch wall grew {wall_ratio:.1f}x over the run " \
+        f"(the frontier is recomputing, not streaming)"
+
+    for c in clean:
+        assert c["valid"] is True and c["fallbacks"] == 0, c
+    assert all(v == 0 for v in lag_gauges.values()), lag_gauges
+    assert elle["warm-extends"] >= 1 and elle["oracle-mismatches"] == 0, \
+        elle
+
+    print(f"stream smoke OK: refuted at op {op_index} "
+          f"({behind} epoch(s) behind the fault, host parity exact); "
+          f"{dispatches} epoch dispatches, 0 recompiles, "
+          f"wall ratio {wall_ratio:.2f}; elle warm-extends "
+          f"{elle['warm-extends']}, 0 oracle mismatches; "
+          f"metrics dumped to {dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

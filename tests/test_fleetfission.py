@@ -11,6 +11,8 @@ parity fuzz of the scattered pipeline against single-worker
 ``fission.split_check`` and the CPU oracle, and one real-Fleet
 integration run including the evidence-loss nemesis."""
 
+from types import SimpleNamespace
+
 import pytest
 
 from jepsen_tpu.checker import wgl_cpu, wgl_tpu
@@ -24,6 +26,7 @@ from jepsen_tpu.serve.chaos import ChaosNemesis
 from jepsen_tpu.serve.decompose import decompose
 from jepsen_tpu.serve.fleet import Fleet
 from jepsen_tpu.serve.request import Cell, KIND_WGL, Request
+from jepsen_tpu.serve.router import CircuitBreaker, Router
 from jepsen_tpu.serve.service import build_spec
 from jepsen_tpu.synth import (bitset_ceiling_history, cas_register_history,
                               corrupt_reads, ghost_write_burst)
@@ -179,6 +182,78 @@ class TestScatter:
         assert len(cells) == 1
         assert cells[0].fission is None
         assert fission_plane.plane_stats()["scattered"] == 0
+
+
+# ---------------------------------------------------------------------------
+# placement spread (router rotation for scatter siblings)
+# ---------------------------------------------------------------------------
+
+
+class _SpreadWorker:
+    def __init__(self, wid):
+        self.wid = wid
+        self.breaker = CircuitBreaker(fail_threshold=1)
+
+    def alive(self):
+        return True
+
+    def fits(self, cell):
+        return True
+
+
+def _sib(i, group="g1"):
+    return SimpleNamespace(bucket=(KIND_WGL, "eng", 256, 64),
+                           fission={"group": group, "mode": "components",
+                                    "index": i})
+
+
+class TestPlacementSpread:
+    def test_siblings_land_on_distinct_workers(self):
+        router = Router([_SpreadWorker(i) for i in range(4)])
+        heads = [router.ranked(f"cell:{i}", cell=_sib(i))[0].wid
+                 for i in range(4)]
+        assert len(set(heads)) == 4   # no convoy on the group winner
+
+    def test_rings_are_rotations_of_one_group_ring(self):
+        # every sibling agrees on ONE deterministic worker ring (the
+        # group token), each starting at its own index — so failover
+        # order is shared, only the head differs
+        router = Router([_SpreadWorker(i) for i in range(4)])
+        base = [w.wid for w in router.ranked("cell:0", cell=_sib(0))]
+        for i in range(1, 4):
+            ring = [w.wid for w in router.ranked(f"cell:{i}",
+                                                 cell=_sib(i))]
+            assert ring == base[i:] + base[:i]
+
+    def test_more_siblings_than_workers_wrap(self):
+        router = Router([_SpreadWorker(i) for i in range(3)])
+        heads = [router.ranked(f"cell:{i}", cell=_sib(i))[0].wid
+                 for i in range(6)]
+        assert heads[:3] == heads[3:]           # ring wrap
+        assert len(set(heads[:3])) == 3
+
+    def test_ordinary_cells_keep_their_own_token(self):
+        router = Router([_SpreadWorker(i) for i in range(4)])
+        plain = SimpleNamespace(bucket=(KIND_WGL, "eng", 256, 64),
+                                fission=None)
+        assert [w.wid for w in router.ranked("tok", cell=plain)] \
+            == [w.wid for w in router.ranked("tok")]
+
+    def test_single_worker_fleet_degenerates(self):
+        router = Router([_SpreadWorker(0)])
+        assert [w.wid for w in router.ranked("cell:2", cell=_sib(2))] \
+            == [0]
+
+    def test_scattered_cells_spread_for_real(self):
+        # the real plane's metadata, not a stub's: scatter a component
+        # split and route its children
+        h = bitset_ceiling_history(2, n_clean=3, concurrency=2)
+        req = make_req(h)
+        cells = fission_plane.scatter(req)
+        assert len(cells) >= 2
+        router = Router([_SpreadWorker(i) for i in range(len(cells))])
+        heads = [router.ranked(c.cid, cell=c)[0].wid for c in cells]
+        assert len(set(heads)) == len(cells)
 
 
 # ---------------------------------------------------------------------------

@@ -21,8 +21,8 @@ from jepsen_tpu.lint.ast_lint import run_ast_tier
 from jepsen_tpu.lint.findings import (Baseline, Finding, apply_pragmas,
                                       pragma_rules, to_sarif)
 from jepsen_tpu.lint.interp_lint import run_interp_tier
-from jepsen_tpu.lint.rules import (conc01, conc02, dev01, dl01, sec01,
-                                   shape01, sound01)
+from jepsen_tpu.lint.rules import (conc01, conc02, dev01, dl01, obs01,
+                                   sec01, shape01, sound01)
 
 
 def run_rule(rule, src, path):
@@ -438,6 +438,90 @@ class TestConc01:
                             pass
             """, "jepsen_tpu/net_proxy.py")
         assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# OBS01
+# ---------------------------------------------------------------------------
+
+class TestObs01:
+    PATH = "jepsen_tpu/serve/fixture.py"
+
+    def test_wall_duration_in_record_flagged(self):
+        fs = run_rule(obs01, """
+            import time
+
+            def flush(self, t0):
+                RECORDER.record("monitor", "epoch",
+                                dur_s=time.time() - t0)
+            """, self.PATH)
+        assert len(fs) == 1
+        assert fs[0].rule == "OBS01"
+        assert "monotonic" in fs[0].message
+        assert "mono_now" in fs[0].hint
+
+    def test_wall_anchor_duration_flagged(self):
+        fs = run_rule(obs01, """
+            def flush(self, span):
+                RECORDER.record("serve", "dispatch",
+                                t=span.end - self.anchor_unix_s)
+            """, self.PATH)
+        assert len(fs) >= 1
+        assert any("anchor" in f.message or "monotonic" in f.message
+                   for f in fs)
+
+    def test_anchor_arithmetic_flagged(self):
+        fs = run_rule(obs01, """
+            def age(self, span_t0):
+                return span_t0 + self.trace.anchor_unix_s
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "anchor" in fs[0].message
+
+    def test_handbuilt_trace_context_flagged(self):
+        fs = run_rule(obs01, """
+            def absorb(self):
+                return {"trace-id": "t-1", "span-id": new_span_id()}
+            """, self.PATH)
+        assert len(fs) == 1
+        assert "trace identity" in fs[0].message
+
+    def test_fstring_trace_id_flagged(self):
+        fs = run_rule(obs01, """
+            def absorb(self, wid):
+                return {"trace-id": f"w{wid}", "parent-span-id": self.sid}
+            """, self.PATH)
+        assert len(fs) == 1
+
+    def test_monotonic_and_plumbed_ids_clean(self):
+        fs = run_rule(obs01, """
+            def flush(self, t0):
+                wall = mono_now() - t0
+                RECORDER.record("monitor", "epoch", dur_s=wall)
+                return {"trace-id": self.trace_id,
+                        "span-id": new_span_id()}
+            """, self.PATH)
+        assert fs == []
+
+    def test_non_span_dict_ignored(self):
+        # a trace-id alone (no span-id key) is reporting, not a context
+        fs = run_rule(obs01, """
+            def status(self):
+                return {"trace-id": "none", "spans": 0}
+            """, self.PATH)
+        assert fs == []
+
+    def test_pragma_escape(self):
+        src = ("def export(self, t0):\n"
+               "    # lint: disable=OBS01(export-only wall anchor)\n"
+               "    return t0 + self.anchor_unix_s\n")
+        findings, _ = run_ast_tier(
+            files={"jepsen_tpu/serve/exporter_fixture.py": src})
+        assert findings == []
+
+    def test_out_of_scope_path_ignored(self):
+        assert not any("jepsen_tpu/engine/x.py".startswith(p)
+                       for p in obs01.SCOPE)
 
 
 # ---------------------------------------------------------------------------

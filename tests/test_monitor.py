@@ -506,6 +506,12 @@ class TestElleEpochEngine:
         pfx = eng._prefix()
         assert len(pfx) == 2
         assert pfx[1].type == "info" and pfx[1].error == ":monitor-cut"
+        # the cut txn carries WHICH epoch cut it as a trailing
+        # ["monitor-cut", None, epoch] micro-op (1-based, pre-advance)
+        assert pfx[1].value == [["append", 0, 1],
+                                ["monitor-cut", None, 1]]
+        eng.advance()
+        assert eng._prefix()[1].value[-1] == ["monitor-cut", None, 2]
 
 
 class TestMonitoredRun:

@@ -441,6 +441,7 @@ class TestMetricsSchema:
         assert set(snap["gauges"]) == {"queue-depth", "inflight-requests",
                                        "compiles-per-1k-dispatches",
                                        "epochs-behind-live",
+                                       "monitor-lag-epochs",
                                        "queue-oldest-wait-s"}
         # the Governor's wait-age input: per-bucket depths + oldest age
         assert {"depth", "buckets", "oldest-wait-s"} <= set(snap["queue"])
