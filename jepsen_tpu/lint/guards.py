@@ -1,0 +1,515 @@
+"""Guarded-by inference: which declared lock actually guards which field.
+
+CONC02 proves the locks are taken in a safe *order*; nothing before this
+module proved a lock is taken *at all* around a given shared field.  This
+is the Warden tier's substrate: an Eraser-style lockset analysis over the
+PR 15 call graph that, for every ``self.<attr>`` access in the threaded
+subsystems, computes the set of declared-manifest locks that are
+guaranteed held at that access — and therefore, per attribute, the
+candidate-guard set (the intersection across all of its access sites).
+
+The held set at an access is the union of two parts:
+
+- the **lexical** part — ``with`` blocks of declared locks
+  (lint/lock_order.py) enclosing the access inside its own function,
+  exactly CONC01/CONC02's notion of "held";
+- the **inherited** part — the function's *MUST-hold entry set*: the
+  intersection, over every resolved call edge reaching the function, of
+  (caller's entry set ∪ locks lexically held at that call site).  A
+  helper called only from inside ``with self._lock`` blocks inherits the
+  lock; one call site outside the lock empties the entry set, which is
+  the point — MUST analysis, so a single unlocked path surfaces.
+  ``kind="thread"`` edges contribute the empty set (the spawned target
+  runs on a fresh stack), as do functions with no in-edges at all
+  (public entry points: external callers hold nothing we can see).
+
+Concurrency structure comes from the same thread seams the call graph
+already models: every ``threading.Thread(target=...)`` edge is a
+concurrency root, and an attribute is **shared** only when its
+post-publication accesses span at least two distinct roots ("main" —
+code reachable from functions that are not thread-entered — counts as
+one root).  State touched only inside a single spawned loop's call tree
+is single-threaded and never reported.
+
+Safe publication: writes in ``__init__`` *before the first statement
+that may start a thread* (a ``threading.Thread`` construction, a
+``.start()`` call, or a call into a callee that may transitively spawn)
+happen-before any sharing of the object and are exempt; so are
+attributes bound to internally-synchronized stdlib types
+(``queue.Queue``, ``threading.Event``, locks themselves, ...).
+
+Resolution limits (the conservatism contract, same as callgraph.py):
+only ``self.<attr>`` and ``self.<ctor-typed-attr>.<attr>`` receivers are
+tracked — writes through untyped locals and parameters are invisible
+here and remain the chaos smokes' department; the call-graph dump's
+``unresolved`` ledger shows every call edge the entry-set propagation
+could not follow.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint.callgraph import CallGraph, Edge, FuncInfo
+from jepsen_tpu.lint.lock_order import lock_level
+
+#: a declared lock: (manifest level, manifest name)
+Lock = Tuple[int, str]
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: constructors whose instances synchronize internally — an attribute
+#: bound to one of these needs no external guard
+_THREADSAFE_CTORS = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "collections.deque",
+    "threading.Event", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "threading.local",
+})
+
+#: receiver-mutating method names: ``self.d.pop(...)`` mutates ``d``
+#: even though the attribute itself is only loaded
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "remove", "discard", "clear", "pop", "popleft", "popitem",
+    "setdefault", "sort", "reverse",
+})
+
+
+@dataclass
+class Access:
+    """One read/write of a tracked attribute at one source location."""
+
+    fid: str                    # accessing function id
+    cid: str                    # owning class id of the attribute
+    attr: str
+    lineno: int
+    col: int
+    kind: str                   # "read" | "write" | "rmw" | "mutate"
+    held: Tuple[Lock, ...]      # lexically held at the access
+    in_init: bool               # access is inside the owning __init__
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind in ("write", "rmw", "mutate")
+
+
+@dataclass
+class _FnSummary:
+    """What one function does, lexically."""
+
+    accesses: List[Access] = field(default_factory=list)
+    #: (lineno, col) -> locks lexically held at that call site
+    callsite_held: Dict[Tuple[int, int], Tuple[Lock, ...]] = \
+        field(default_factory=dict)
+    #: linenos of statements that may start a thread directly
+    #: (Thread construction or a ``self.*.start()`` call)
+    spawn_lines: List[int] = field(default_factory=list)
+    #: linenos of calls that can carry ``self`` into the callee
+    #: (``self.m()`` or ``self`` in the arguments) — only these can
+    #: publish the object through a may-spawn callee
+    self_call_lines: Set[int] = field(default_factory=set)
+    #: constructs threading.Thread lexically
+    spawns: bool = False
+
+
+class GuardAnalysis:
+    """The finished inference: per-function entry sets, per-attribute
+    access sites, sharing classification, publication points."""
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        self.local: Dict[str, _FnSummary] = {}
+        #: MUST-hold set on entry, per function
+        self.entry: Dict[str, FrozenSet[Lock]] = {}
+        #: (cid, attr) -> all access sites
+        self.accesses: Dict[Tuple[str, str], List[Access]] = {}
+        #: functions that may (transitively) start a thread
+        self.may_spawn: Set[str] = set()
+        #: first lineno in each __init__ at which a thread may already
+        #: be running (publication point); absent = never publishes
+        self.init_pub_line: Dict[str, int] = {}
+        #: concurrency roots reaching each function: "main" or a
+        #: thread-edge callee fid
+        self.origins: Dict[str, FrozenSet[str]] = {}
+        self._run()
+
+    # -- public queries ----------------------------------------------------
+
+    def held_at(self, a: Access) -> FrozenSet[Lock]:
+        """Locks guaranteed held at an access: lexical ∪ entry set."""
+        return frozenset(a.held) | self.entry.get(a.fid, frozenset())
+
+    def pre_publication(self, a: Access) -> bool:
+        """Writes in ``__init__`` before the first possible thread start
+        happen-before every share of the object."""
+        if not a.in_init:
+            return False
+        init_fid = f"{a.cid.split('::')[0]}::" \
+                   f"{self.graph.classes[a.cid].name}.__init__"
+        if a.fid != init_fid:
+            return False
+        pub = self.init_pub_line.get(init_fid)
+        return pub is None or a.lineno < pub
+
+    def shared(self, cid: str, attr: str) -> bool:
+        """Do post-publication accesses span ≥ 2 concurrency roots?"""
+        roots: Set[str] = set()
+        for a in self.accesses.get((cid, attr), ()):
+            if self.pre_publication(a):
+                continue
+            roots |= self.origins.get(a.fid, frozenset())
+            if len(roots) >= 2:
+                return True
+        return False
+
+    def post_publication_sites(self, cid: str, attr: str) -> List[Access]:
+        return [a for a in self.accesses.get((cid, attr), ())
+                if not self.pre_publication(a)]
+
+    def threadsafe_attr(self, cid: str, attr: str) -> bool:
+        """Attribute bound to an internally-synchronized stdlib type
+        anywhere in the class body (queue.Queue, Event, a lock, ...)."""
+        info = self.graph.classes.get(cid)
+        if info is None:
+            return False
+        ctor = info.attr_ctors.get(attr)
+        if not ctor:
+            return False
+        m = self.graph.modules.get(info.path)
+        ext = self.graph.external_name(m, ctor) if m else None
+        return (ext or ctor) in _THREADSAFE_CTORS or \
+            ctor.split(".")[-1] in ("Lock", "RLock", "Condition",
+                                    "Event", "deque", "Queue")
+
+    def chain_from_root(self, fid: str) -> List[Tuple[str, str]]:
+        """Shortest chain [(edge-kind, fid), ...] from a concurrency
+        root (a no-in-edge function or a thread-edge target) down to
+        ``fid``; the first element's kind is "" (the root itself)."""
+        rev: Dict[str, List[Tuple[str, str]]] = {}
+        for cfid, edges in self.graph.out.items():
+            for e in edges:
+                rev.setdefault(e.callee, []).append((e.kind, cfid))
+        seen = {fid}
+        queue: List[List[Tuple[str, str]]] = [[("", fid)]]
+        while queue:
+            path = queue.pop(0)
+            kind, cur = path[0]
+            ins = rev.get(cur, [])
+            if not ins or kind == "thread":
+                return path
+            for ekind, caller in sorted(ins):
+                if caller not in seen:
+                    seen.add(caller)
+                    queue.append([(ekind, caller)] + path)
+        return [("", fid)]                  # cycle with no entry
+
+    def render_chain(self, fid: str) -> str:
+        """``a.py::f ~thread~> b.py::g -> b.py::h`` — element ``i``'s
+        recorded kind is the kind of the edge from ``i`` to ``i+1``."""
+        chain = self.chain_from_root(fid)
+        parts = [self.graph.funcs[chain[0][1]].label]
+        for i in range(1, len(chain)):
+            arrow = "~thread~>" if chain[i - 1][0] == "thread" else "->"
+            parts.append(f"{arrow} {self.graph.funcs[chain[i][1]].label}")
+        return " ".join(parts)
+
+    # -- construction ------------------------------------------------------
+
+    def _run(self) -> None:
+        g = self.graph
+        for fid, f in g.funcs.items():
+            self.local[fid] = _summarize(g, f)
+        self._spawn_fixpoint()
+        self._publication_points()
+        self._entry_fixpoint()
+        self._origin_sets()
+        for fid, s in self.local.items():
+            for a in s.accesses:
+                self.accesses.setdefault((a.cid, a.attr), []).append(a)
+
+    def _spawn_fixpoint(self) -> None:
+        g = self.graph
+        self.may_spawn = {fid for fid, s in self.local.items() if s.spawns}
+        changed = True
+        while changed:
+            changed = False
+            for fid, edges in g.out.items():
+                if fid in self.may_spawn:
+                    continue
+                for e in edges:
+                    if e.kind == "call" and e.callee in self.may_spawn:
+                        self.may_spawn.add(fid)
+                        changed = True
+                        break
+
+    def _publication_points(self) -> None:
+        """First lineno in each __init__ at which another thread may
+        already be running *with a reference to self*: a lexical spawn
+        marker, or a call that both carries ``self`` and reaches a
+        may-spawn callee.  A callee spawning threads on a different
+        object (``self.fleet = Fleet(...)`` starting Fleet's own loops)
+        does not publish this object."""
+        g = self.graph
+        for fid, f in g.funcs.items():
+            if not f.qual.endswith(".__init__") or f.cls is None:
+                continue
+            s = self.local[fid]
+            candidates = list(s.spawn_lines)
+            for (lineno, _col), e in g.edge_at.get(fid, {}).items():
+                if e.kind == "thread" or (
+                        e.kind == "call" and e.callee in self.may_spawn
+                        and lineno in s.self_call_lines):
+                    candidates.append(lineno)
+            if candidates:
+                self.init_pub_line[fid] = min(candidates)
+
+    def _entry_fixpoint(self) -> None:
+        """Greatest fixpoint of
+        entry(f) = ⋂ over call in-edges (entry(caller) ∪ held-at-site),
+        with thread-edge targets and no-in-edge functions pinned at ∅."""
+        g = self.graph
+        in_edges: Dict[str, List[Tuple[str, Edge]]] = {}
+        for cfid, edges in g.out.items():
+            for e in edges:
+                in_edges.setdefault(e.callee, []).append((cfid, e))
+        top: Optional[FrozenSet[Lock]] = None   # ⊤ sentinel
+        entry: Dict[str, Optional[FrozenSet[Lock]]] = {}
+        for fid in g.funcs:
+            ins = in_edges.get(fid, [])
+            if not ins or any(e.kind == "thread" for _c, e in ins):
+                entry[fid] = frozenset()
+            else:
+                entry[fid] = top
+        changed = True
+        while changed:
+            changed = False
+            for fid in g.funcs:
+                ins = in_edges.get(fid, [])
+                if not ins or any(e.kind == "thread" for _c, e in ins):
+                    continue
+                acc: Optional[FrozenSet[Lock]] = top
+                for cfid, e in ins:
+                    ce = entry.get(cfid, frozenset())
+                    if ce is top:
+                        continue            # ⊤ caller constrains nothing yet
+                    held = self.local[cfid].callsite_held.get(
+                        (e.lineno, e.col), ())
+                    contrib = frozenset(ce) | frozenset(held)
+                    acc = contrib if acc is top else (acc & contrib)
+                if acc is top:
+                    continue
+                # force monotone descent so the loop terminates even if
+                # a caller's entry set arrives late in the iteration
+                new = acc if entry[fid] is top else (entry[fid] & acc)
+                if new != entry[fid]:
+                    entry[fid] = new
+                    changed = True
+        self.entry = {fid: (v if v is not top and v is not None
+                            else frozenset())
+                      for fid, v in entry.items()}
+
+    def _origin_sets(self) -> None:
+        """"main" = closure from functions that are not thread-entered;
+        each thread-edge target is its own root, closed over call edges."""
+        g = self.graph
+        in_kinds: Dict[str, Set[str]] = {}
+        for edges in g.out.values():
+            for e in edges:
+                in_kinds.setdefault(e.callee, set()).add(e.kind)
+        origins: Dict[str, Set[str]] = {fid: set() for fid in g.funcs}
+
+        def close_from(roots: List[str], tag_of) -> None:
+            for root in roots:
+                tag = tag_of(root)
+                stack, seen = [root], {root}
+                while stack:
+                    cur = stack.pop()
+                    origins[cur].add(tag)
+                    for e in g.out.get(cur, []):
+                        if e.kind == "call" and e.callee not in seen \
+                                and e.callee in origins:
+                            seen.add(e.callee)
+                            stack.append(e.callee)
+
+        main_roots = [fid for fid in g.funcs
+                      if "thread" not in in_kinds.get(fid, set())
+                      and not in_kinds.get(fid)]
+        close_from(sorted(main_roots), lambda _r: "main")
+        thread_roots = sorted({e.callee for edges in g.out.values()
+                               for e in edges if e.kind == "thread"
+                               if e.callee in g.funcs})
+        close_from(thread_roots, lambda r: r)
+        # functions with call in-edges but unreachable from any root
+        # (dead code / cycles): treat as main so they are not silently
+        # dropped from sharing decisions
+        for fid, o in origins.items():
+            if not o:
+                o.add("main")
+        self.origins = {fid: frozenset(o) for fid, o in origins.items()}
+
+
+# ---------------------------------------------------------------------------
+# per-function lexical summary
+# ---------------------------------------------------------------------------
+
+def _annotate_parents(root: ast.AST) -> None:
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+def _dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def _receiver_class(g: CallGraph, f: FuncInfo,
+                    node: ast.Attribute) -> Optional[Tuple[str, str]]:
+    """(owning class id, attr name) for a tracked attribute access:
+    ``self.x`` resolves to the enclosing class; ``self.a.b`` resolves
+    through ``a``'s constructor type when the class recorded one."""
+    if f.cls is None:
+        return None
+    v = node.value
+    if isinstance(v, ast.Name) and v.id == "self":
+        return f.cls, node.attr
+    if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+            and v.value.id == "self":
+        info = g.classes.get(f.cls)
+        ctor = info.attr_ctors.get(v.attr) if info else None
+        if ctor:
+            m = g.modules.get(f.path)
+            t = g.resolve_dotted(m, ctor) if m else None
+            if t and t[0] == "class":
+                return t[1], node.attr
+    return None
+
+
+def _classify(g: CallGraph, f: FuncInfo,
+              node: ast.Attribute) -> Optional[str]:
+    """Access kind for an attribute node, or None when it is not a data
+    access (method references/calls belong to the call graph)."""
+    parent = getattr(node, "parent", None)
+    ctx = node.ctx
+    if isinstance(ctx, (ast.Store, ast.Del)):
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return "rmw"
+        return "write"
+    # Load contexts
+    if isinstance(parent, ast.Call) and parent.func is node:
+        return None                         # self.m() — a call, not data
+    if isinstance(parent, ast.Attribute) and parent.value is node:
+        gp = getattr(parent, "parent", None)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            if parent.attr in _MUTATORS:
+                return "mutate"             # self.d.pop(...)
+            # self.attr.m() — receiver load; a method call on a typed
+            # attr is an edge, a data read otherwise.  Either way the
+            # reference itself is read.
+            return "read"
+        return "read"
+    if isinstance(parent, ast.Subscript) and parent.value is node:
+        sctx = parent.ctx
+        if isinstance(sctx, (ast.Store, ast.Del)):
+            return "mutate"                 # self.d[k] = v / del self.d[k]
+        gp = getattr(parent, "parent", None)
+        if isinstance(gp, ast.AugAssign) and gp.target is parent:
+            return "mutate"                 # self.d[k] += v
+        return "read"
+    # bound-method reference (target=self._loop) — not a data access
+    if f.cls is not None and isinstance(node.value, ast.Name) \
+            and node.value.id == "self" \
+            and g.method_of(f.cls, node.attr) is not None:
+        return None
+    return "read"
+
+
+def _summarize(g: CallGraph, f: FuncInfo) -> _FnSummary:
+    out = _FnSummary()
+    _annotate_parents(f.node)
+    m = g.modules.get(f.path)
+    in_init = f.qual.endswith(".__init__") and f.cls is not None
+
+    def is_spawn_marker(call: ast.Call) -> Optional[str]:
+        d = _dotted(call.func)
+        if not d:
+            return None
+        ext = g.external_name(m, d) if m else None
+        if (ext or d) == "threading.Thread":
+            return "ctor"
+        # only self-rooted receivers: a helper object's .start() does
+        # not hand this object to a new thread
+        if d.endswith(".start") and d.startswith("self."):
+            return "start"
+        return None
+
+    def carries_self(call: ast.Call) -> bool:
+        if _dotted(call.func).startswith("self."):
+            return True
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        return any(isinstance(n, ast.Name) and n.id == "self"
+                   for a in args for n in ast.walk(a))
+
+    def visit(node: ast.AST, held: Tuple[Lock, ...]) -> None:
+        if isinstance(node, _FN):
+            return                          # its own graph node
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                try:
+                    expr_s = ast.unparse(item.context_expr)
+                except Exception:  # pragma: no cover - defensive
+                    expr_s = ""
+                lv = lock_level(f.path, expr_s)
+                if lv is not None:
+                    new_held = new_held + (lv,)
+                visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, new_held)
+            return
+        if isinstance(node, ast.Call):
+            out.callsite_held[(node.lineno, node.col_offset)] = held
+            if carries_self(node):
+                out.self_call_lines.add(node.lineno)
+            marker = is_spawn_marker(node)
+            if marker is not None:
+                out.spawn_lines.append(node.lineno)
+                if marker == "ctor":
+                    out.spawns = True
+        if isinstance(node, ast.Attribute):
+            rc = _receiver_class(g, f, node)
+            if rc is not None:
+                kind = _classify(g, f, node)
+                if kind is not None:
+                    cid, attr = rc
+                    out.accesses.append(Access(
+                        fid=f.id, cid=cid, attr=attr,
+                        lineno=node.lineno, col=node.col_offset,
+                        kind=kind, held=held,
+                        in_init=in_init and cid == f.cls))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in f.node.body:
+        visit(stmt, ())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared entry point (memoized per graph: RACE01 and ATOM01 both consume it)
+# ---------------------------------------------------------------------------
+
+def analyze(graph: CallGraph) -> GuardAnalysis:
+    cached = getattr(graph, "_guard_analysis", None)
+    if cached is None:
+        cached = GuardAnalysis(graph)
+        graph._guard_analysis = cached      # type: ignore[attr-defined]
+    return cached

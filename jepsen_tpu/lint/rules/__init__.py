@@ -19,7 +19,10 @@ The catalog (rationale per rule lives in docs/static_analysis.md):
   I/O while holding a lock;
 - OBS01   — span discipline on the tracing plane: exported durations
   are monotonic intervals, the wall anchor is export-alignment only,
-  trace identity is plumbed, never minted from literals.
+  trace identity is plumbed, never minted from literals;
+- ENV01   — every literal JEPSEN_TPU_*/JTPU_* env read is documented in
+  README.md's environment table (verbatim or via a placeholder family
+  row).
 """
 
 from __future__ import annotations
@@ -83,9 +86,9 @@ def dotted(node: ast.AST) -> str:
 
 
 def all_rules():
-    from jepsen_tpu.lint.rules import (conc01, dev01, obs01, shape01,
-                                       sound01)
-    return (sound01, dev01, shape01, conc01, obs01)
+    from jepsen_tpu.lint.rules import (conc01, dev01, env01, obs01,
+                                       shape01, sound01)
+    return (sound01, dev01, shape01, conc01, obs01, env01)
 
 
 def interp_rules():
@@ -103,6 +106,19 @@ def interp_rules():
     - SOUND02 — unknown-never-false dataflow-proven across the fission
       merge surface: any 'valid: False' sub-result reaching a
       recombined verdict flows through a witness-bearing site.
+
+    The Warden tier (lint/guards.py's guarded-by inference) rides the
+    same graph:
+
+    - RACE01 — every shared mutable attribute of the threaded
+      subsystems has a consistent declared guard (Eraser-style lockset
+      intersection over all post-publication access sites);
+    - ATOM01 — no guarded check whose dependent act reacquires the
+      lock (check-then-act torn across two critical sections);
+    - RES01  — every constructed Request/Cell reaches a finish
+      terminal on all paths including raise edges (no leaked
+      admissions).
     """
-    from jepsen_tpu.lint.rules import conc02, dl01, sec01, sound02
-    return (conc02, sec01, dl01, sound02)
+    from jepsen_tpu.lint.rules import (atom01, conc02, dl01, race01,
+                                       res01, sec01, sound02)
+    return (conc02, sec01, dl01, sound02, race01, atom01, res01)

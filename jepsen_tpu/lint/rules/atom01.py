@@ -1,0 +1,267 @@
+"""ATOM01: a guarded check whose dependent act reacquires the lock.
+
+Holding the right lock at every site (RACE01's contract) is not enough
+when a *decision* spans two critical sections: read a field under the
+lock, release, branch on the captured value, then reacquire the lock to
+act — the field may have changed between the check and the act, and the
+act applies a stale decision.  The classic shape::
+
+    with self._lock:
+        depth = self._depth          # check, under 'scheduler'
+    if depth < limit:                # lock released here
+        with self._lock:
+            self._depth += 1         # act reacquires — not atomic
+
+The rule is deliberately narrow (positively-detected patterns only, no
+speculative dataflow): within one function it finds a name bound from a
+tracked attribute inside a ``with`` of a declared lock, a later
+``if``/``while`` whose test uses that name (or re-reads the attribute)
+*outside* that critical section, and inside the branch an act that
+writes the same attribute under a **fresh** acquisition of the same
+lock — lexically, or through a call edge into a callee that may acquire
+the lock and may write the attribute (the CONC02-style may-summaries).
+A check and act inside one ``with`` block never fires; neither does a
+re-check of the attribute after reacquiring (the double-checked idiom
+re-reads under the lock before acting).
+
+Messages are line-free symbol text; the finding's location is the
+check, where the fix (widen the critical section, or re-validate under
+the lock) belongs.  Sanctioned stale-decision sites carry
+``# lint: disable=ATOM01(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from jepsen_tpu.lint import guards
+from jepsen_tpu.lint.callgraph import CallGraph, FuncInfo
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.guards import Lock
+from jepsen_tpu.lint.lock_order import lock_level
+
+RULE = "ATOM01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+_CLASS_SCOPE = ("jepsen_tpu/serve/", "jepsen_tpu/monitor/",
+                "jepsen_tpu/obs/")
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _attrs_read(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        a = _self_attr(n)
+        if a is not None and isinstance(n.ctx, ast.Load):
+            out.add(a)
+    return out
+
+
+def _attrs_written(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, _FN):
+            continue
+        a = _self_attr(n)
+        if a is not None and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(a)
+        if isinstance(n, ast.AugAssign):
+            a = _self_attr(n.target)
+            if a is not None:
+                out.add(a)
+    return out
+
+
+def _with_locks(f: FuncInfo, node: ast.With) -> Set[Lock]:
+    out: Set[Lock] = set()
+    for item in node.items:
+        try:
+            expr_s = ast.unparse(item.context_expr)
+        except Exception:  # pragma: no cover - defensive
+            continue
+        lv = lock_level(f.path, expr_s)
+        if lv is not None:
+            out.add(lv)
+    return out
+
+
+def _may_write_fixpoint(graph: CallGraph,
+                        ga: guards.GuardAnalysis
+                        ) -> Dict[str, Set[str]]:
+    """attr names each function may write (self-attrs), transitively
+    through call edges — the act side of a check-then-act may hide in a
+    helper."""
+    may: Dict[str, Set[str]] = {
+        fid: {a.attr for a in s.accesses if a.is_write}
+        for fid, s in ga.local.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, edges in graph.out.items():
+            s = may.get(fid)
+            if s is None:
+                continue
+            for e in edges:
+                if e.kind != "call":
+                    continue
+                callee = may.get(e.callee)
+                if callee and not callee <= s:
+                    s |= callee
+                    changed = True
+    return may
+
+
+def _may_acquire_fixpoint(graph: CallGraph,
+                          ga: guards.GuardAnalysis
+                          ) -> Dict[str, Set[Lock]]:
+    from jepsen_tpu.lint.rules import conc02
+    may: Dict[str, Set[Lock]] = {
+        fid: set(conc02._summarize(f).acquires)
+        for fid, f in graph.funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, edges in graph.out.items():
+            s = may.get(fid)
+            if s is None:
+                continue
+            for e in edges:
+                if e.kind != "call":
+                    continue
+                callee = may.get(e.callee)
+                if callee and not callee <= s:
+                    s |= callee
+                    changed = True
+    return may
+
+
+def _act_reacquires(graph: CallGraph, f: FuncInfo, branch_body: List,
+                    attr: str, lock: Lock,
+                    may_write: Dict[str, Set[str]],
+                    may_acquire: Dict[str, Set[Lock]]
+                    ) -> Optional[str]:
+    """Does the branch body write ``attr`` under a fresh acquisition of
+    ``lock``?  Returns a human label for the act site, or None.  A
+    re-read of ``attr`` inside the reacquired section before the write
+    (double-checked idiom) clears the pattern."""
+    for stmt in branch_body:
+        for node in ast.walk(stmt):
+            if isinstance(node, _FN):
+                continue
+            if isinstance(node, ast.With) and \
+                    lock in _with_locks(f, node):
+                body_reads: Set[str] = set()
+                for inner in node.body:
+                    # an If/While test re-reads before its body writes
+                    if isinstance(inner, (ast.If, ast.While)):
+                        body_reads |= _attrs_read(inner.test)
+                    if attr in _attrs_written(inner) and \
+                            attr not in body_reads:
+                        return f"`with` in {f.label}"
+                    body_reads |= _attrs_read(inner)
+            if isinstance(node, ast.Call):
+                edge = graph.edge_at.get(f.id, {}).get(
+                    (node.lineno, node.col_offset))
+                if edge is not None and edge.kind == "call" and \
+                        lock in may_acquire.get(edge.callee, ()) and \
+                        attr in may_write.get(edge.callee, ()):
+                    return f"call to {graph.funcs[edge.callee].label}"
+    return None
+
+
+def _check_function(graph: CallGraph, ga: guards.GuardAnalysis,
+                    f: FuncInfo, may_write: Dict[str, Set[str]],
+                    may_acquire: Dict[str, Set[Lock]]
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def scan_block(body: List, held: Tuple[Lock, ...]) -> None:
+        #: name -> (attr, lock, check lineno) captured under a lock
+        captured: Dict[str, Tuple[str, Lock, int]] = {}
+        for stmt in body:
+            if isinstance(stmt, _FN):
+                continue
+            if isinstance(stmt, ast.With):
+                locks = _with_locks(f, stmt)
+                for inner in stmt.body:
+                    if isinstance(inner, ast.Assign) and \
+                            len(inner.targets) == 1 and \
+                            isinstance(inner.targets[0], ast.Name):
+                        for attr in _attrs_read(inner.value):
+                            for lk in locks:
+                                captured[inner.targets[0].id] = \
+                                    (attr, lk, inner.lineno)
+                scan_block(stmt.body, held + tuple(sorted(locks)))
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                test_names = _names(stmt.test)
+                for name, (attr, lk, check_ln) in list(captured.items()):
+                    if name not in test_names or lk in held:
+                        continue
+                    act = _act_reacquires(graph, f, stmt.body, attr, lk,
+                                          may_write, may_acquire)
+                    if act is not None:
+                        findings.append(Finding(
+                            RULE, f.path, check_ln,
+                            f"check-then-act on `self.{attr}` in "
+                            f"{f.label} is not atomic: the check reads "
+                            f"it under '{lk[1]}' into `{name}`, the "
+                            f"lock is released, and the dependent act "
+                            f"({act}) reacquires '{lk[1]}' to write it "
+                            f"— the checked value can be stale by the "
+                            f"time the act runs",
+                            hint="widen the critical section over "
+                                 "check+act, or re-validate the field "
+                                 "after reacquiring (double-checked "
+                                 "idiom), or add `# lint: disable="
+                                 "ATOM01(reason)` if staleness is "
+                                 "acceptable here"))
+                        del captured[name]
+                scan_block(stmt.body, held)
+                scan_block(stmt.orelse, held)
+                continue
+            # any other compound statement: recurse into blocks
+            for field_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field_name, None)
+                if isinstance(sub, list):
+                    scan_block(sub, held)
+            if isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    scan_block(h.body, held)
+            # a write to the attr outside the pattern invalidates the
+            # captured snapshot (the function re-synchronized its view)
+            written = _attrs_written(stmt)
+            for name in [n for n, (a, _l, _ln) in captured.items()
+                         if a in written]:
+                del captured[name]
+
+    scan_block(f.node.body, ())
+    return findings
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    ga = guards.analyze(graph)
+    may_write = _may_write_fixpoint(graph, ga)
+    may_acquire = _may_acquire_fixpoint(graph, ga)
+    findings: List[Finding] = []
+    for fid, f in sorted(graph.funcs.items()):
+        if f.cls is None or not any(f.path.startswith(p)
+                                    for p in _CLASS_SCOPE):
+            continue
+        findings.extend(_check_function(graph, ga, f, may_write,
+                                        may_acquire))
+    return findings

@@ -1,0 +1,148 @@
+"""ENV01: every environment knob the code reads is documented.
+
+The service is configured through ``JEPSEN_TPU_*`` / ``JTPU_*``
+environment variables, and README.md's environment table is the single
+operator-facing inventory.  A knob the code reads but the table omits is
+invisible configuration: deployments copy the table, so the knob is
+effectively unusable — or worse, used with a stale name after a rename.
+
+The rule finds every *literal* env read in scope —
+
+- ``os.environ.get("JTPU_X")`` / ``os.environ.get("JTPU_X", d)``
+- ``os.getenv("JTPU_X")``
+- ``os.environ["JTPU_X"]``
+- ``"JTPU_X" in os.environ``
+
+(also through ``from os import environ, getenv`` aliases) — and requires
+the name to appear in README.md: either verbatim, or covered by a
+placeholder family row such as ``JEPSEN_TPU_SLO_<NAME>`` or an
+optional-suffix row like ``JEPSEN_TPU_TENANT_QUOTA[_<NAME>]``
+(``<...>`` matches any ``[A-Z0-9_]+`` run; ``[...]`` is optional).
+
+Knobs read through a *computed* name (``os.environ.get(name)`` where
+``name`` is built at runtime — the autoscaler's ``_env_num`` helper
+pattern) are out of scope here by construction: the literal sits at the
+helper's call sites, where this rule sees it.
+
+The message carries the knob name and the reading symbol, no line
+numbers, so the baseline key is stable; a deliberately-undocumented
+knob (test-only escape hatches) carries
+``# lint: disable=ENV01(reason)`` at the read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator, List, Optional
+
+from jepsen_tpu.lint.findings import Finding
+from jepsen_tpu.lint.rules import qualname_of, walk_with_parents
+
+RULE = "ENV01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+_PREFIX_RE = re.compile(r"^(JEPSEN_TPU|JTPU)_")
+
+#: README rows: a knob token, possibly with <PLACEHOLDER> runs and
+#: [optional] groups
+_DOC_TOKEN_RE = re.compile(
+    r"(?:JEPSEN_TPU|JTPU)(?:_[A-Z0-9]+|_?<[A-Za-z_]+>|\[[^\]\n]*\])+")
+
+_README_CACHE: dict = {}
+
+
+def _readme_patterns(readme_path: Optional[str] = None) -> List[re.Pattern]:
+    """Compiled matchers for every documented knob token in README.md."""
+    if readme_path is None:
+        from jepsen_tpu.lint.ast_lint import repo_root
+        readme_path = os.path.join(repo_root(), "README.md")
+    cached = _README_CACHE.get(readme_path)
+    if cached is not None:
+        return cached
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    pats: List[re.Pattern] = []
+    for tok in sorted(set(_DOC_TOKEN_RE.findall(text))):
+        esc = re.escape(tok)
+        # optional [...] groups first (their contents may hold a
+        # placeholder), then <PLACEHOLDER> runs
+        esc = re.sub(r"\\\[([^\]]*)\\\]", r"(?:\1)?", esc)
+        esc = re.sub(r"<[A-Za-z_]+>", "[A-Z0-9_]+", esc)
+        try:
+            pats.append(re.compile(f"^{esc}$"))
+        except re.error:  # pragma: no cover - defensive
+            continue
+    _README_CACHE[readme_path] = pats
+    return pats
+
+
+def documented(knob: str, readme_path: Optional[str] = None) -> bool:
+    return any(p.match(knob) for p in _readme_patterns(readme_path))
+
+
+def _env_reads(tree: ast.AST) -> Iterator[ast.AST]:
+    """Nodes whose first string argument/key is an env-var name read
+    through os.environ / os.getenv (dotted or imported bare)."""
+
+    def is_environ(node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id == "environ"
+        if isinstance(node, ast.Attribute):
+            return node.attr == "environ" and \
+                isinstance(node.value, ast.Name) and node.value.id == "os"
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            # os.environ.get(...) / environ.get(...)
+            if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                    is_environ(f.value) and node.args:
+                yield node.args[0]
+            # os.getenv(...) / getenv(...)
+            elif ((isinstance(f, ast.Attribute) and f.attr == "getenv"
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id == "os")
+                  or (isinstance(f, ast.Name) and f.id == "getenv")) \
+                    and node.args:
+                yield node.args[0]
+        elif isinstance(node, ast.Subscript) and is_environ(node.value):
+            yield node.slice
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                is_environ(node.comparators[0]):
+            yield node.left
+
+
+def check(tree: ast.AST, src_lines: List[str],
+          path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    walk_with_parents(tree)                 # annotate for qualname_of
+    seen = set()
+    for arg in _env_reads(tree):
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue                        # computed name: out of scope
+        knob = arg.value
+        if not _PREFIX_RE.match(knob) or documented(knob):
+            continue
+        qual = qualname_of(arg)
+        key = (knob, qual)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            RULE, path, arg.lineno,
+            f"env knob `{knob}` read in {qual} is not in README.md's "
+            f"environment table — undocumented configuration is "
+            f"unusable configuration",
+            hint="add a row to README.md's env table (name, default, "
+                 "what it does), or `# lint: disable=ENV01(reason)` "
+                 "for a deliberately-internal knob"))
+    return findings

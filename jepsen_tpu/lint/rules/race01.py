@@ -1,0 +1,115 @@
+"""RACE01: every shared mutable attribute has a consistent guard.
+
+Eraser's lockset discipline, statically, over the guarded-by inference
+in :mod:`jepsen_tpu.lint.guards`: for each attribute of a class under
+``serve/``, ``monitor/``, or ``obs/`` whose post-publication accesses
+span at least two concurrency roots (a ``threading.Thread`` seam and
+"main", or two distinct seams), intersect the locks *guaranteed held*
+(lexically + inherited MUST-hold entry sets through the call graph) at
+every post-publication site.  An attribute that is written after
+publication and whose intersection is empty has **no consistent guard**
+— two threads can interleave on it — and the finding prints both
+unsynchronized sites with the symbol chain from each site's concurrency
+root, so the reviewer sees the two racing stacks, not just a field name.
+
+What does *not* fire:
+
+- attributes written only in ``__init__`` before the first possible
+  thread start — safely published, immutable afterwards;
+- attributes bound to internally-synchronized types (``queue.Queue``,
+  ``threading.Event``, the locks themselves);
+- attributes touched from a single thread's call tree only;
+- read-only attributes (no post-publication write anywhere).
+
+Deliberately-torn sites (e.g. the gauge sampling in ``serve/metrics.py``,
+whose tear contract is documented in that module and in
+docs/observability.md) carry ``# lint: disable=RACE01(reason)`` on the
+write — the pragma-with-reason idiom, never the baseline.
+
+Messages are line-free symbol chains (baseline/SARIF keys survive line
+churn); the finding's *location* is the unguarded write, so the pragma
+lands where the tear lives.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from jepsen_tpu.lint import guards
+from jepsen_tpu.lint.callgraph import CallGraph
+from jepsen_tpu.lint.findings import Finding
+
+RULE = "RACE01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+#: classes whose attributes are audited (the threaded subsystems)
+_CLASS_SCOPE = ("jepsen_tpu/serve/", "jepsen_tpu/monitor/",
+                "jepsen_tpu/obs/")
+
+
+def _fmt_locks(locks) -> str:
+    if not locks:
+        return "no lock"
+    return ", ".join(f"'{name}'" for _lv, name in sorted(locks))
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    ga = guards.analyze(graph)
+    findings: List[Finding] = []
+    for (cid, attr), _sites in sorted(ga.accesses.items()):
+        info = graph.classes.get(cid)
+        if info is None or not any(info.path.startswith(p)
+                                   for p in _CLASS_SCOPE):
+            continue
+        if ga.threadsafe_attr(cid, attr):
+            continue
+        sites = ga.post_publication_sites(cid, attr)
+        writes = [a for a in sites if a.is_write]
+        if not writes or not ga.shared(cid, attr):
+            continue
+        common = None
+        for a in sites:
+            h = ga.held_at(a)
+            common = h if common is None else (common & h)
+            if not common:
+                break
+        if common:
+            continue                        # a consistent guard exists
+        # exemplars: the barest write, and the barest conflicting site
+        # in a different function (prefer a different concurrency root)
+        w = min(writes, key=lambda a: (len(ga.held_at(a)), a.fid,
+                                       a.lineno))
+        others = [a for a in sites
+                  if a.fid != w.fid or (a.lineno, a.col) != (w.lineno,
+                                                             w.col)]
+        conflict = None
+        if others:
+            w_roots = ga.origins.get(w.fid, frozenset())
+            conflict = min(
+                others,
+                key=lambda a: (len(ga.held_at(a)),
+                               ga.origins.get(a.fid, frozenset())
+                               <= w_roots,
+                               a.fid, a.lineno))
+        cls_label = f"{info.name}.{attr}"
+        msg = (f"shared attribute `{cls_label}` has no consistent "
+               f"guard: candidate-lock intersection over "
+               f"{len(sites)} post-publication site(s) is empty; "
+               f"{w.kind} in {graph.funcs[w.fid].label} holds "
+               f"{_fmt_locks(ga.held_at(w))} "
+               f"[{ga.render_chain(w.fid)}]")
+        if conflict is not None:
+            msg += (f"; conflicting {conflict.kind} in "
+                    f"{graph.funcs[conflict.fid].label} holds "
+                    f"{_fmt_locks(ga.held_at(conflict))} "
+                    f"[{ga.render_chain(conflict.fid)}]")
+        findings.append(Finding(
+            RULE, w.fid.split("::")[0], w.lineno, msg,
+            hint="guard every post-publication access with one declared "
+                 "lock (lint/lock_order.py), make the field "
+                 "safely-published (write it in __init__ before any "
+                 "thread starts), or add `# lint: disable=RACE01"
+                 "(reason)` at the write if the tear is a documented "
+                 "contract"))
+    return findings

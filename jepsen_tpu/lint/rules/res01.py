@@ -1,0 +1,270 @@
+"""RES01: an admitted Request/Cell always reaches a finish terminal.
+
+The serve plane's second load-bearing invariant (after unknown-never-
+false) is "an admitted request is always resolved, never dropped": every
+``Request``/``Cell`` that enters the lifecycle must reach
+``claim_finish()`` / ``finish()`` / a ``_finish_*`` / ``_finalize*``
+terminal on **every** path — including the raise edges.  Today that is
+pinned dynamically (expiry-while-blocked smokes, chaos suites); this
+rule proves the per-function discipline statically.
+
+Per function, the rule tracks each name bound from a ``Request(...)`` /
+``Cell(...)`` construction (resolved through the call graph, so aliased
+imports and subclasses count).  From that binding until the obligation
+is **discharged**, every statement that can raise is a leak edge unless
+a protector is in scope.  Discharge events:
+
+- a terminal call on the object (``req.claim_finish()``,
+  ``req.finish(...)``, ``self._finish_expired(req)``, ...);
+- a hand-off: the object passed as an argument to any resolved call or
+  thread spawn, stored into an attribute/container, returned or yielded
+  — ownership moved, the new owner's own discipline applies;
+- entering a ``try`` whose ``finally`` or catch-all handler reaches a
+  terminal for the object (directly, or via a callee that may call a
+  terminal — the may-terminal summary propagates through call edges).
+
+Statements that cannot raise on the tracked path (constant/name
+assignments, attribute writes on the object itself, ``pass``) do not
+open leak edges; anything containing an unrelated call or an explicit
+``raise``/bare ``return`` does.  The finding names the function, the
+object, and the leaking expression — line-free, so baseline/SARIF keys
+survive line churn; the location is the leaking statement, where either
+the ``try/finally`` or the ``# lint: disable=RES01(reason)`` belongs.
+
+What this rule does *not* prove (the conservatism contract): ownership
+through untracked parameters (a helper that receives a live cell is
+audited only at its call sites' hand-off boundary), and containers as
+queues (once stored, the consumer side's discipline is the scheduler
+loop's catch-all — covered by its own creation-site window when the
+consumer also constructs, else by the chaos smokes).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from jepsen_tpu.lint.callgraph import CallGraph, FuncInfo
+from jepsen_tpu.lint.findings import Finding
+
+RULE = "RES01"
+
+SCOPE = ("jepsen_tpu/", "suites/")
+
+#: classes whose instances carry the resolve obligation
+_TRACKED_CLASSES = ("Request", "Cell")
+
+#: method/function names that resolve the obligation
+_TERMINAL_RE = re.compile(r"^(claim_finish|finish|cancel"
+                          r"|_finish\w*|_finalize\w*)$")
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _tracked_ctor_classes(graph: CallGraph) -> Set[str]:
+    """fids of ``__init__`` methods of Request/Cell (and subclasses)."""
+    out: Set[str] = set()
+    for cid, info in graph.classes.items():
+        names = {info.name}
+        stack = [(graph.modules.get(info.path), b) for b in info.bases]
+        while stack:
+            m, b = stack.pop()
+            t = graph.resolve_dotted(m, b) if m else None
+            if t and t[0] == "class":
+                base = graph.classes[t[1]]
+                names.add(base.name)
+                bm = graph.modules.get(base.path)
+                stack.extend((bm, bb) for bb in base.bases)
+        if names & set(_TRACKED_CLASSES):
+            init = graph.method_of(cid, "__init__")
+            if init:
+                out.add(init)
+    return out
+
+
+def _may_terminal_fixpoint(graph: CallGraph) -> Set[str]:
+    """Functions that call a terminal-named method, transitively."""
+    may: Set[str] = set()
+    for fid, f in graph.funcs.items():
+        for node in ast.walk(f.node):
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name and _TERMINAL_RE.match(name):
+                    may.add(fid)
+                    break
+    changed = True
+    while changed:
+        changed = False
+        for fid, edges in graph.out.items():
+            if fid in may:
+                continue
+            for e in edges:
+                if e.callee in may:
+                    may.add(fid)
+                    changed = True
+                    break
+    return may
+
+
+def _uses_name(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _discharges(graph: CallGraph, f: FuncInfo, stmt: ast.stmt,
+                name: str, may_terminal: Set[str]) -> bool:
+    """Does this statement resolve or hand off the tracked object?"""
+    for node in ast.walk(stmt):
+        if isinstance(node, _FN):
+            continue
+        if isinstance(node, ast.Return) and node.value is not None \
+                and _uses_name(node.value, name):
+            return True
+        if isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                and node.value is not None \
+                and _uses_name(node.value, name):
+            return True
+        if isinstance(node, ast.Call):
+            # terminal invoked on the object itself
+            if isinstance(node.func, ast.Attribute) and \
+                    _TERMINAL_RE.match(node.func.attr) and \
+                    _uses_name(node.func.value, name):
+                return True
+            # the object passed onward: to a terminal-named callee, a
+            # may-terminal callee, a thread spawn, or any call at all —
+            # ownership is no longer this function's alone
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_uses_name(a, name) for a in args):
+                return True
+        if isinstance(node, ast.Assign):
+            if _uses_name(node.value, name):
+                for t in node.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        return True         # stored: published/handed off
+    return False
+
+
+def _may_raise(stmt: ast.stmt, name: str) -> Optional[str]:
+    """The source text of the first raise edge in this statement that
+    does not involve the tracked object, or None when the statement is
+    raise-free.  Attribute stores on the object itself (``n.seq = 7``)
+    and trivial assignments don't raise on the tracked path."""
+    for node in ast.walk(stmt):
+        if isinstance(node, _FN):
+            continue
+        if isinstance(node, ast.Raise):
+            try:
+                return ast.unparse(node)
+            except Exception:  # pragma: no cover - defensive
+                return "raise"
+        if isinstance(node, ast.Call) and not _uses_name(node, name):
+            # calls on/with the object itself were hand-off/terminal
+            # candidates already; an unrelated call is the leak edge
+            try:
+                return ast.unparse(node)[:60]
+            except Exception:  # pragma: no cover - defensive
+                return "a call"
+    return None
+
+
+def _protected(graph: CallGraph, f: FuncInfo, try_stmt: ast.Try,
+               name: str, may_terminal: Set[str]) -> bool:
+    """Does the try's finally or a catch-all handler reach a terminal
+    (or hand the object off) for the tracked name?"""
+    blocks: List[List[ast.stmt]] = []
+    if try_stmt.finalbody:
+        blocks.append(try_stmt.finalbody)
+    for h in try_stmt.handlers:
+        is_catch_all = h.type is None or (
+            isinstance(h.type, ast.Name) and
+            h.type.id in ("Exception", "BaseException"))
+        if is_catch_all:
+            blocks.append(h.body)
+    for body in blocks:
+        for stmt in body:
+            if _discharges(graph, f, stmt, name, may_terminal):
+                return True
+            # a catch-all that delegates wholesale to a may-terminal
+            # callee (the scheduler loop's `self._finalize_all()` shape)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    edge = graph.edge_at.get(f.id, {}).get(
+                        (node.lineno, node.col_offset))
+                    if edge is not None and edge.callee in may_terminal:
+                        return True
+    return False
+
+
+def _check_function(graph: CallGraph, f: FuncInfo, ctors: Set[str],
+                    may_terminal: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def scan_block(body: List[ast.stmt]) -> None:
+        #: live obligations: name -> class label
+        live: Dict[str, str] = {}
+        for stmt in body:
+            if isinstance(stmt, _FN):
+                continue
+            # discharge first: a statement may both bind and hand off
+            for name in [n for n in live
+                         if _discharges(graph, f, stmt, n, may_terminal)]:
+                del live[name]
+            if isinstance(stmt, ast.Try):
+                for name in list(live):
+                    if _protected(graph, f, stmt, name, may_terminal):
+                        del live[name]
+            for name, label in sorted(live.items()):
+                edge_src = _may_raise(stmt, name)
+                if edge_src is not None:
+                    findings.append(Finding(
+                        RULE, f.path, stmt.lineno,
+                        f"admitted {label} `{name}` in {f.label} can "
+                        f"leak on a raise edge: `{edge_src}` may raise "
+                        f"after the {label} is constructed and before "
+                        f"any finish terminal or hand-off; no "
+                        f"try/finally or catch-all reaches "
+                        f"claim_finish()/_finish_*/_finalize* for it",
+                        hint="wrap the admission window in try/finally "
+                             "that resolves the object, hand it off "
+                             "first, or add `# lint: disable=RES01"
+                             "(reason)` if the raise provably cannot "
+                             "leak it"))
+                    del live[name]
+            # new obligations bound by this statement
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and isinstance(stmt.value, ast.Call):
+                edge = graph.edge_at.get(f.id, {}).get(
+                    (stmt.value.lineno, stmt.value.col_offset))
+                if edge is not None and edge.callee in ctors:
+                    cls = graph.funcs[edge.callee].qual.split(".")[0]
+                    live[stmt.targets[0].id] = cls
+            # recurse into compound statements with a fresh window —
+            # obligations do not cross block boundaries (conservatively
+            # narrow: the lexical window is the contract)
+            for field_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field_name, None)
+                if isinstance(sub, list):
+                    scan_block(sub)
+            if isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    scan_block(h.body)
+
+    scan_block(f.node.body)
+    return findings
+
+
+def check_program(graph: CallGraph) -> List[Finding]:
+    ctors = _tracked_ctor_classes(graph)
+    if not ctors:
+        return []
+    may_terminal = _may_terminal_fixpoint(graph)
+    findings: List[Finding] = []
+    for fid, f in sorted(graph.funcs.items()):
+        findings.extend(_check_function(graph, f, ctors, may_terminal))
+    return findings

@@ -79,10 +79,13 @@ _ids = iter(range(1, 1 << 62))
 
 
 def active_statuses() -> List[Dict[str, Any]]:
+    # snapshot the membership under the registry lock, but build each
+    # status OUTSIDE it: status() takes the flush lock, which sits
+    # ABOVE monitor-registry in the manifest
     with _REG_LOCK:
-        live = [m.status() for m in _ACTIVE.values()]
+        live_monitors = list(_ACTIVE.values())
         recent = list(_RECENT)
-    return live + recent
+    return [m.status() for m in live_monitors] + recent
 
 
 class Monitor:
@@ -342,9 +345,10 @@ class Monitor:
             }
             self.finalized = True
             tail = len(ops)
-        # final drain folded everything in: the lag gauge settles at the
-        # engine's residual (0 for wgl, open invocations for elle)
-        residual = int(self.engine.counters().get("pending-ops", 0))
+            # final drain folded everything in: the lag gauge settles
+            # at the engine's residual (0 for wgl, open invocations for
+            # elle) — read from `post`, sampled under the flush lock
+            residual = int(post.get("pending-ops", 0))
         set_gauge("epochs-behind-live", residual)
         set_gauge(f"monitor-lag-epochs:{self.name}",
                   -(-residual // self.epoch_ops))
@@ -353,17 +357,21 @@ class Monitor:
             args={"tail-ops": tail})
         from jepsen_tpu.monitor import resume
         resume.save(self)
-        with _REG_LOCK:
-            _ACTIVE.pop(self.id, None)
-            _RECENT.appendleft(self.status())
+        snap = self.status()      # takes the flush lock: build it
+        snap["active"] = False    # BEFORE entering the registry lock;
+        with _REG_LOCK:           # the retained snapshot describes the
+            _ACTIVE.pop(self.id, None)   # deregistered state
+            _RECENT.appendleft(snap)
 
     def close(self) -> None:
         """Idempotent teardown (also safe before finalize on a crashed
         run): stops the flusher and deregisters."""
         self.stop()
+        snap = self.status()      # flush lock sits above _REG_LOCK
+        snap["active"] = False
         with _REG_LOCK:
             if self.id in _ACTIVE:
-                _RECENT.appendleft(self.status())
+                _RECENT.appendleft(snap)
             _ACTIVE.pop(self.id, None)
 
     # -- observability ----------------------------------------------------
@@ -376,20 +384,27 @@ class Monitor:
         return None
 
     def status(self) -> Dict[str, Any]:
-        return {
-            "id": self.id,
-            "name": self.name,
-            "kind": self.kind,
-            "independent": self.independent,
-            "active": self.id in _ACTIVE,
-            "finalized": self.finalized,
-            "t": round(mono_now() - self.t0, 6),
-            "epoch-ops": self.epoch_ops,
-            "epochs": len(self.epochs),
-            "last-epoch": self.epochs[-1] if self.epochs else None,
-            "counters": self.engine.counters(),
-            "tap": self.tap.stats(),
-            "poisoned": self.poisoned,
-            "verdict": self.channel.status(),
-            "final-delta": self.final_delta,
-        }
+        # built under the flush lock: the epoch ring and engine
+        # frontiers are mutated by flush() under the same lock, so this
+        # is a consistent point-in-time view.  The verdict/tap locks
+        # acquired by channel.status()/tap.stats() sit BELOW
+        # monitor-flush in the manifest, so holding flush here is safe;
+        # callers must NOT hold monitor-registry (it orders after flush)
+        with self._flush_lock:
+            return {
+                "id": self.id,
+                "name": self.name,
+                "kind": self.kind,
+                "independent": self.independent,
+                "active": self.id in _ACTIVE,
+                "finalized": self.finalized,
+                "t": round(mono_now() - self.t0, 6),
+                "epoch-ops": self.epoch_ops,
+                "epochs": len(self.epochs),
+                "last-epoch": self.epochs[-1] if self.epochs else None,
+                "counters": self.engine.counters(),
+                "tap": self.tap.stats(),
+                "poisoned": self.poisoned,
+                "verdict": self.channel.status(),
+                "final-delta": self.final_delta,
+            }

@@ -160,7 +160,8 @@ class Autoscaler:
         return self
 
     def close(self) -> None:
-        self._closed = True
+        with self._lock:
+            self._closed = True
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=2 * self.policy.interval_s + 1.0)
@@ -171,9 +172,13 @@ class Autoscaler:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def _is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def _loop(self) -> None:
         import time
-        while not self._closed:
+        while not self._is_closed():
             try:
                 self.tick()
             except Exception:  # noqa: BLE001 — the loop must survive a
@@ -257,7 +262,8 @@ class Autoscaler:
                 "t": round(now, 6), "action": "up", "mode": "spawn",
                 "from": workers, "to": workers + 1, "worker": w.wid,
                 "reason": self._reason(sig), "signals": sig})
-            self._counters["ups"] += 1
+            with self._lock:
+                self._counters["ups"] += 1
             return decision
         req = {"t": round(now, 6), "action": "scale-up",
                "from": workers, "to": workers + 1,

@@ -559,7 +559,7 @@ class Fleet:
         dropped, never false.  ``trace`` and ``tenant`` ride beside the
         spec (never inside it — reroute and journal recovery round-trip
         the spec through build_spec)."""
-        if self._closed:
+        if self._is_closed():
             raise ServiceClosed("fleet is closed")
         spec = build_spec(kind, **kw)
         if deadline_s is None:
@@ -720,7 +720,7 @@ class Fleet:
             self.metrics.inc("worker-failures")
             attempts.append({"worker": offender.wid, "error": failure})
             excluded.add(offender.wid)
-            if len(excluded) >= len(self.workers):
+            if len(excluded) >= len(self.workers_snapshot()):
                 # Everyone has failed this cell once; a retry round
                 # against recovered/restarted workers is still worth it.
                 excluded = set(exclude)
@@ -915,8 +915,8 @@ class Fleet:
 
     # -- health -----------------------------------------------------------
     def _heartbeat_loop(self) -> None:
-        while not self._closed:
-            for w in self.workers:
+        while not self._is_closed():
+            for w in self.workers_snapshot():
                 if w.retired:
                     continue  # decommissioned slot: dead for good
                 try:
@@ -942,7 +942,7 @@ class Fleet:
         worker processes don't know which respawn they are — then lands
         the push and evaluates the SLOs against it."""
         try:
-            w = self.workers[wid]
+            w = self.workers_snapshot()[wid]
         except (IndexError, TypeError):
             return
         payload = dict(payload or {})
@@ -971,7 +971,7 @@ class Fleet:
             "uptime-s": round(now - self._t0, 3),
             "interval-s": self.telemetry.interval_s,
             "metrics": snap}, now=now)
-        for w in self.workers:
+        for w in self.workers_snapshot():
             svc = w.service
             if w.retired:
                 continue  # evicted from the store; must not re-register
@@ -1004,7 +1004,7 @@ class Fleet:
         else:
             RECORDER.disable()
         acks: Dict[str, bool] = {}
-        for w in self.workers:
+        for w in self.workers_snapshot():
             fn = getattr(w.service, "set_recorder", None)
             if fn is None:
                 continue   # in-process worker: shares this RECORDER
@@ -1021,7 +1021,7 @@ class Fleet:
         journal-relevant state lives fleet-side, so nothing is replayed
         here — cells routed to the corpse already rerouted via their
         owner threads."""
-        w = self.workers[wid]
+        w = self.workers_snapshot()[wid]
         if w.restart(only_if_dead=only_if_dead):
             self.metrics.inc("worker-restarts")
         return w
@@ -1036,7 +1036,7 @@ class Fleet:
     def active_workers(self) -> int:
         """Slots currently able to take traffic: alive, not draining,
         not retired — the autoscaler's worker-count signal."""
-        return sum(1 for w in self.workers
+        return sum(1 for w in self.workers_snapshot()
                    if w.alive() and not w.draining and not w.retired)
 
     def journal_pending(self) -> int:
@@ -1088,11 +1088,11 @@ class Fleet:
         slot un-drains and keeps serving, because killing a worker with
         journal-pending work would turn bounded unknowns into recovery
         churn.  Returns the decision evidence either way."""
-        w = self.workers[wid]
+        w = self.workers_snapshot()[wid]
         w.draining = True
         deadline = mono_now() + timeout_s
         drained = False
-        while mono_now() < deadline and not self._closed:
+        while mono_now() < deadline and not self._is_closed():
             try:
                 p = w.service.ping()
             except Exception:  # noqa: BLE001 — already dead is idle
@@ -1121,7 +1121,8 @@ class Fleet:
         return {"worker": wid, "drained": True, "journal-pending": pending}
 
     def fleet_status(self) -> Dict[str, Any]:
-        return {"workers": [w.status() for w in self.workers],
+        workers = self.workers_snapshot()
+        return {"workers": [w.status() for w in workers],
                 "journal": {"enabled": self._journal is not None,
                             "pending": (self._journal.pending_count()
                                         if self._journal else 0),
@@ -1130,7 +1131,7 @@ class Fleet:
                             "path": (self._journal.path
                                      if self._journal else None)},
                 "circuits": {w.wid: dict(w.breaker.transitions)
-                             for w in self.workers}}
+                             for w in workers}}
 
     def worker_snapshots(self) -> List[Optional[Dict[str, Any]]]:
         """Scrape every worker's ``Metrics.snapshot()`` — for in-process
@@ -1140,7 +1141,7 @@ class Fleet:
         exception — one bad link must not fail the fleet's /metrics
         document."""
         out: List[Optional[Dict[str, Any]]] = []
-        for w in self.workers:
+        for w in self.workers_snapshot():
             snap: Optional[Dict[str, Any]] = None
             try:
                 svc = w.service
@@ -1197,7 +1198,8 @@ class Fleet:
             budget = (self.deep_healthz_timeout_s()
                       if deep_timeout_s is None else float(deep_timeout_s))
             targets = [(w, entry)
-                       for w, entry in zip(self.workers, st["workers"])
+                       for w, entry in zip(self.workers_snapshot(),
+                                           st["workers"])
                        if getattr(w.service, "healthz", None) is not None]
             if targets:
                 pool = ThreadPoolExecutor(
@@ -1270,8 +1272,21 @@ class Fleet:
         with self._lock:
             return len(self._open_cells)
 
+    def workers_snapshot(self) -> List["FleetWorker"]:
+        """Point-in-time copy of the slot list.  ``add_worker`` appends
+        under the fleet lock, so the heartbeat/supervisor/export threads
+        must not iterate the live list — they iterate this copy; the
+        slot objects themselves carry their own breaker/health locks."""
+        with self._lock:
+            return list(self.workers)
+
+    def _is_closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def alive(self) -> bool:
-        return not self._closed and any(w.alive() for w in self.workers)
+        return not self._is_closed() and any(
+            w.alive() for w in self.workers_snapshot())
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         deadline = (mono_now() + timeout) if timeout is not None else None
@@ -1293,7 +1308,7 @@ class Fleet:
         with self._lock:
             self._closed = True
         self._pool.shutdown(wait=True)
-        for w in self.workers:
+        for w in self.workers_snapshot():
             try:
                 w.service.close(timeout=timeout)
             except Exception:  # noqa: BLE001 — close the rest regardless
@@ -1308,7 +1323,7 @@ class Fleet:
         for :meth:`recover`."""
         with self._lock:
             self._closed = True
-        for w in self.workers:
+        for w in self.workers_snapshot():
             try:
                 w.kill()
             except Exception:  # noqa: BLE001
@@ -1472,8 +1487,8 @@ class ProcFleet(Fleet):
 
     # -- the supervisor ----------------------------------------------------
     def _supervise_loop(self) -> None:
-        while not self._closed:
-            for w in self.workers:
+        while not self._is_closed():
+            for w in self.workers_snapshot():
                 try:
                     if self._maybe_respawn(w):
                         self.metrics.inc("supervisor-respawns")
@@ -1492,7 +1507,8 @@ class ProcFleet(Fleet):
         if w.alive() or w.retired:
             return False
         with self._sup_lock:
-            if self._closed or w.alive() or w.retired:
+            # fleet lock under the sup lock is manifest-descending
+            if self._is_closed() or w.alive() or w.retired:
                 return False
             if w.restart(only_if_dead=True):
                 self.metrics.inc("worker-restarts")
@@ -1507,7 +1523,7 @@ class ProcFleet(Fleet):
         # super().close() swept the old ones: final sweep under the sup
         # lock catches it (ProcWorkerService.close is idempotent)
         with self._sup_lock:
-            for w in self.workers:
+            for w in self.workers_snapshot():
                 try:
                     w.service.close(timeout=5.0)
                 except Exception:  # noqa: BLE001
@@ -1520,7 +1536,7 @@ class ProcFleet(Fleet):
         super().kill()
         self._join_supervisor()
         with self._sup_lock:
-            for w in self.workers:
+            for w in self.workers_snapshot():
                 try:
                     w.service.kill()
                 except Exception:  # noqa: BLE001

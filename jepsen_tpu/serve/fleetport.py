@@ -251,7 +251,8 @@ class Fleetport(Fleet):
                     # registry — and log the failure MODE only, never
                     # any token or mac material (nor the claimed tenant
                     # string: it arrived unauthenticated).
-                    self.auth_rejections += 1
+                    with self._sup_lock:
+                        self.auth_rejections += 1
                     self.metrics.inc("auth-rejections")
                     what = ("unknown tenant" if not known
                             else "unauthenticated frame"
@@ -456,10 +457,12 @@ class Fleetport(Fleet):
         construction: the registry snapshot carries addresses and lease
         arithmetic; auth status is a boolean."""
         now = mono_now() if now is None else now
+        with self._sup_lock:
+            rejections = self.auth_rejections
         return {"listen": {"host": self.listen_host,
                            "port": self.listen_port},
                 "auth-enabled": bool(self._token),
-                "auth-rejections": self.auth_rejections,
+                "auth-rejections": rejections,
                 "reap-s": self._reap_s,
                 **self.registry.snapshot(now)}
 

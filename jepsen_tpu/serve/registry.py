@@ -312,10 +312,12 @@ class FleetRegistry:
             live = [r.snapshot(now) for r in self._records.values()]
             evicted = [dict(e) for e in self._evicted]
             blocked = sorted(self._blocked)
+            registrations = self.registrations
+            evictions = self.evictions
         live.sort(key=lambda r: (r["wid"] is None, r["wid"], r["name"]))
         return {"lease-s": self.lease_s,
                 "workers": live,
-                "registrations": self.registrations,
-                "evictions": self.evictions,
+                "registrations": registrations,
+                "evictions": evictions,
                 "renewals-blocked": blocked,
                 "recent-evictions": evicted}

@@ -115,7 +115,8 @@ class Scheduler:
             return True
 
     def depth(self) -> int:
-        return self._depth
+        with self._lock:
+            return self._depth
 
     def occupancy(self) -> Dict[str, Any]:
         """The autoscaler's input signals as first-class data: per-bucket
@@ -146,12 +147,19 @@ class Scheduler:
             self._idle_listeners.append(fn)
 
     def inflight(self) -> int:
-        return self._inflight
+        with self._lock:
+            return self._inflight
 
     def alive(self) -> bool:
         """Is the device loop still able to make progress?  False once the
         thread died (a crash the loop's own try/except failed to contain)
         or a stop/kill landed — the fleet's heartbeat probes this."""
+        with self._lock:
+            return self._alive_locked()
+
+    def _alive_locked(self) -> bool:
+        """:meth:`alive` for callers already inside the scheduler lock
+        (monitor_call's admission check)."""
         return (self._started and not self._stop
                 and self._thread.is_alive())
 
@@ -174,7 +182,7 @@ class Scheduler:
         box: Dict[str, Any] = {}
         done = threading.Event()
         with self._cond:
-            live = self.alive()
+            live = self._alive_locked()
             if live:
                 self._monitor_lane.append((fn, box, done))
                 self._cond.notify_all()

@@ -127,8 +127,10 @@ class CheckService:
         self._sched.start()
 
     def _inflight(self) -> int:
-        snap = self.metrics._counters  # bound gauge; reads are atomic ints
-        return max(0, self._submitted - snap.get("requests-completed", 0))
+        # bound gauge; counter() takes the metrics lock briefly, which
+        # is safe here because snapshot() samples gauges outside it
+        completed = self.metrics.counter("requests-completed")
+        return max(0, self._submitted - completed)
 
     # -- submission -------------------------------------------------------
     def submit(self, history: History, *,

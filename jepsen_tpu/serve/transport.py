@@ -299,9 +299,12 @@ class WireClient:
                 raise TransportError(f"wire client {self.name} is closed")
             if self._sock is not None:
                 return self._sock
+            # snapshot the target under the lock; retarget() may still
+            # swap it mid-dial, in which case this dial's socket loses
+            # to the retarget's _conn_lost and the next call re-dials
+            addr = self.addr
         # dial OUTSIDE the lock: a slow or refused connect must not
         # stall every thread touching the pending table
-        addr = self.addr  # snapshot: retarget() may swap it mid-dial
         try:
             sock = socket.create_connection(
                 addr, timeout=self.connect_timeout_s)
@@ -508,10 +511,18 @@ class WireClient:
             if tuple(addr) == self.addr:
                 return
             self.addr = tuple(addr)
+            # lint: disable=ATOM01(_conn_lost re-validates under the lock: it only clears _sock if it still IS this captured socket, so a connection established in the gap survives)
             sock = self._sock
         if sock is not None:
             self._conn_lost(sock, ConnectionLost(
                 f"retargeted to {addr[0]}:{addr[1]}"))
+
+    def target(self) -> Tuple[str, int]:
+        """The (host, port) future dials will use, read under the state
+        lock — the supervisor compares this against a respawned
+        worker's address to decide whether to retarget."""
+        with self._lock:
+            return tuple(self.addr)
 
     def _send(self, frame: Dict[str, Any]) -> None:
         sock = self._ensure_conn()
@@ -610,8 +621,9 @@ class ProcWorkerService:
                     addr, policy=self._policy, name=self.name,
                     ack_timeout_s=self._ack_timeout_s,
                     max_frame=self._max_frame)
+                # lint: disable=RACE01(bound immediately after construction, before the first dial can spawn the reader thread. A racing reader sees None and drops that frame - telemetry is lossy push by contract)
                 self._client.on_telemetry = self._dispatch_telemetry
-            elif self._client.addr != addr:
+            elif self._client.target() != addr:
                 self._client.retarget(addr)
             return self._client
 

@@ -148,7 +148,8 @@ class WorkerServer:
                              name=f"worker-tele-{self.port}").start()
 
     def _note_idle(self) -> None:
-        self._last_idle = mono_now()
+        with self._lock:
+            self._last_idle = mono_now()
 
     # -- telemetry push ----------------------------------------------------
     def telemetry_payload(self) -> Dict[str, Any]:
@@ -369,7 +370,8 @@ class WorkerServer:
         with self._lock:
             p["wire-inflight"] = len(self._inflight)
             p["wire-done-cached"] = len(self._done)
-        p["idle-age-s"] = round(mono_now() - self._last_idle, 3)
+            last_idle = self._last_idle
+        p["idle-age-s"] = round(mono_now() - last_idle, 3)
         p["pid"] = os.getpid()
         if frame and frame.get("recorder") is not None:
             # runtime arm/disarm of this process's flight recorder — the
@@ -400,7 +402,10 @@ class WorkerServer:
 
     # -- lifecycle ---------------------------------------------------------
     def alive(self) -> bool:
-        return not self._closed and self.service.alive()
+        with self._lock:
+            if self._closed:
+                return False
+        return self.service.alive()
 
     def close(self) -> None:
         self._tele_stop.set()

@@ -13,6 +13,7 @@ silently.
 
 import textwrap
 
+from jepsen_tpu.lint import guards
 from jepsen_tpu.lint.callgraph import build_graph, map_args_to_params
 from jepsen_tpu.lint.interp_lint import run_interp_tier
 from jepsen_tpu.lint.rules import sound02
@@ -413,3 +414,225 @@ class TestSound02:
         findings, _ = run_interp_tier(rules=[sound02])
         assert findings == [], "\n" + "\n".join(
             f.render() for f in findings)
+
+
+class TestGuardedByInference:
+    """Unit contract for the Warden guarded-by engine (lint/guards.py):
+    MUST-hold entry sets over call in-edges, thread targets pinned at ∅,
+    safe-publication windows in __init__, and origin-based sharing."""
+
+    FLEET = "jepsen_tpu/serve/fleet.py"
+    LOCK = (2, "fleet")
+
+    def ga(self, files):
+        return guards.analyze(g(files))
+
+    def test_entry_set_intersects_call_sites(self):
+        """entry(f) = ⋂ over in-edges of (entry(caller) ∪ held-at-site):
+        one unlocked call site empties the helper's entry set."""
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def locked_path(self):
+                    with self._lock:
+                        self._bump()
+                def unlocked_path(self):
+                    self._bump()
+                def _bump(self):
+                    pass
+            """})
+        assert ga.entry[f"{self.FLEET}::Fleet._bump"] == frozenset()
+
+    def test_entry_set_inherited_when_all_sites_hold(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def locked_path(self):
+                    with self._lock:
+                        self._bump()
+                def other_locked_path(self):
+                    with self._lock:
+                        self._bump()
+                def _bump(self):
+                    pass
+            """})
+        assert ga.entry[f"{self.FLEET}::Fleet._bump"] == \
+            frozenset({self.LOCK})
+
+    def test_entry_set_transitive_through_middle_callee(self):
+        """The entry set flows through an intermediate helper that adds
+        no lock of its own."""
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def top(self):
+                    with self._lock:
+                        self._middle()
+                def _middle(self):
+                    self._leaf()
+                def _leaf(self):
+                    pass
+            """})
+        assert ga.entry[f"{self.FLEET}::Fleet._leaf"] == \
+            frozenset({self.LOCK})
+
+    def test_thread_target_pinned_empty(self):
+        """A thread-edge target is a concurrency root: it enters with
+        nothing held, even if it is ALSO called under the lock."""
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._loop).start()
+                def inline_drive(self):
+                    with self._lock:
+                        self._loop()
+                def _loop(self):
+                    pass
+            """})
+        assert ga.entry[f"{self.FLEET}::Fleet._loop"] == frozenset()
+
+    def test_zero_in_edge_function_pinned_empty(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def public_entry(self):
+                    pass
+            """})
+        assert ga.entry[f"{self.FLEET}::Fleet.public_entry"] == \
+            frozenset()
+
+    def test_held_at_unions_lexical_and_entry(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.depth = 0
+                def top(self):
+                    with self._lock:
+                        self._bump()
+                def _bump(self):
+                    self.depth += 1
+            """})
+        sites = ga.accesses[(f"{self.FLEET}::Fleet", "depth")]
+        bump = [a for a in sites
+                if a.fid == f"{self.FLEET}::Fleet._bump"]
+        assert bump and all(
+            self.LOCK in ga.held_at(a) for a in bump)
+
+    def test_init_publication_point_is_thread_start(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self.before = 1
+                    threading.Thread(target=self._loop).start()
+                    self.after = 2
+                def _loop(self):
+                    pass
+            """})
+        cid = f"{self.FLEET}::Fleet"
+        before = ga.accesses[(cid, "before")][0]
+        after = ga.accesses[(cid, "after")][0]
+        assert ga.pre_publication(before)
+        assert not ga.pre_publication(after)
+
+    def test_foreign_spawning_ctor_does_not_publish(self):
+        """Constructing a helper that spawns its OWN threads does not
+        carry `self` out — everything in this __init__ stays
+        pre-publication."""
+        ga = self.ga({
+            "jepsen_tpu/serve/helper.py": """
+                import threading
+                class Helper:
+                    def __init__(self):
+                        threading.Thread(target=self._loop).start()
+                    def _loop(self):
+                        pass
+                """,
+            self.FLEET: """
+                from jepsen_tpu.serve.helper import Helper
+                class Fleet:
+                    def __init__(self):
+                        self.helper = Helper()
+                        self.after = 2
+                """})
+        cid = f"{self.FLEET}::Fleet"
+        assert ga.pre_publication(ga.accesses[(cid, "after")][0])
+
+    def test_self_carrying_call_to_spawner_publishes(self):
+        """`self._start_loops()` where the callee spawns a thread DOES
+        publish: writes after it are post-publication."""
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._start_loops()
+                    self.after = 2
+                def _start_loops(self):
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    pass
+            """})
+        cid = f"{self.FLEET}::Fleet"
+        assert not ga.pre_publication(ga.accesses[(cid, "after")][0])
+
+    def test_shared_requires_two_origins(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self.depth = 0
+                    self.main_only = 0
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    self.depth += 1
+                def bump(self):
+                    self.depth += 1
+                def tweak(self):
+                    self.main_only += 1
+            """})
+        cid = f"{self.FLEET}::Fleet"
+        assert ga.shared(cid, "depth")
+        assert not ga.shared(cid, "main_only")
+
+    def test_origins_tag_thread_roots(self):
+        ga = self.ga({self.FLEET: """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    self._tick()
+                def _tick(self):
+                    pass
+                def from_main(self):
+                    pass
+            """})
+        loop_fid = f"{self.FLEET}::Fleet._loop"
+        assert loop_fid in ga.origins[f"{self.FLEET}::Fleet._tick"]
+        assert ga.origins[f"{self.FLEET}::Fleet.from_main"] == \
+            frozenset({"main"})
+
+    def test_threadsafe_ctor_attr_exempt(self):
+        ga = self.ga({self.FLEET: """
+            import queue
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    self.depth = 0
+            """})
+        cid = f"{self.FLEET}::Fleet"
+        assert ga.threadsafe_attr(cid, "q")
+        assert not ga.threadsafe_attr(cid, "depth")

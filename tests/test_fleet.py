@@ -455,3 +455,49 @@ class TestHealthzEndpoint:
                 assert json.loads(r.read()) == {"ok": True, "workers": []}
         finally:
             httpd.shutdown()
+
+
+class TestWorkersSnapshotRace:
+    """Regression pin for the Warden RACE01 fix on ``Fleet.workers``:
+    ``add_worker`` appends to the slot list under the fleet lock, but
+    the heartbeat/supervisor/export paths used to iterate the live
+    list.  They now go through ``workers_snapshot()``; this scales up
+    concurrently with status reads and demands internally-consistent
+    views throughout."""
+
+    def test_concurrent_scale_up_and_status(self):
+        with Fleet(workers=1, max_lanes=8, capacity=16,
+                   default_deadline_s=60.0, pin_devices=False) as f:
+            stop = threading.Event()
+            errors = []
+
+            def reader():
+                while not stop.is_set():
+                    try:
+                        snap = f.workers_snapshot()
+                        # a snapshot is a point-in-time copy: wids are
+                        # exactly 0..n-1 in append order, never torn
+                        assert [w.wid for w in snap] == \
+                            list(range(len(snap)))
+                        st = f.fleet_status()
+                        assert len(st["workers"]) >= 1
+                        f.healthz()
+                    except Exception as e:  # noqa: BLE001 — collected
+                        errors.append(e)
+                        return
+
+            readers = [threading.Thread(target=reader) for _ in range(3)]
+            for t in readers:
+                t.start()
+            added = [f.add_worker() for _ in range(4)]
+            stop.set()
+            for t in readers:
+                t.join()
+            assert not errors, errors
+            assert [w.wid for w in f.workers_snapshot()] == \
+                list(range(1 + len(added)))
+            # the snapshot is a copy — mutating it cannot corrupt the
+            # fleet's own slot list
+            snap = f.workers_snapshot()
+            snap.clear()
+            assert len(f.workers_snapshot()) == 1 + len(added)

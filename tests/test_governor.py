@@ -13,6 +13,7 @@ token envelope.  Everything runs on the CPU backend.
 """
 
 import json
+import threading
 
 import pytest
 
@@ -438,3 +439,51 @@ class TestTenantAuth:
         stolen["tenant"] = "edge"
         assert not verify_frame(stolen, "gold-secret")
         assert not verify_frame(frame, "edge-secret")
+
+
+class TestCounterAtomicity:
+    """Regression pin for the Warden RACE01 fix in ``_scale_up``: the
+    spawn branch incremented ``_counters["ups"]`` without the policy
+    lock, so two concurrent spawns could lose an update.  Every counter
+    mutation now happens under ``self._lock``; this drives the spawn
+    branch from many threads and demands an exact count."""
+
+    class _SpawnyFleet:
+        """Minimal locally-scalable fleet: every _scale_up call takes
+        the spawn branch (the one whose increment was unlocked)."""
+
+        class _Metrics:
+            def inc(self, name, n=1):
+                pass
+
+        def __init__(self):
+            self._wid = 0
+            self._wid_lock = threading.Lock()
+            self.metrics = self._Metrics()
+
+        def can_scale_locally(self):
+            return True
+
+        def add_worker(self):
+            with self._wid_lock:
+                self._wid += 1
+                return type("W", (), {"wid": self._wid})()
+
+    def test_concurrent_spawns_count_exactly(self):
+        gov = Autoscaler(fleet=self._SpawnyFleet(), policy=_policy())
+        n_threads, per_thread = 8, 50
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(per_thread):
+                gov._scale_up({"workers": 1}, now=0.0)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert gov.snapshot()["counters"]["ups"] == \
+            n_threads * per_thread

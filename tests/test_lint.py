@@ -21,8 +21,9 @@ from jepsen_tpu.lint.ast_lint import run_ast_tier
 from jepsen_tpu.lint.findings import (Baseline, Finding, apply_pragmas,
                                       pragma_rules, to_sarif)
 from jepsen_tpu.lint.interp_lint import run_interp_tier
-from jepsen_tpu.lint.rules import (conc01, conc02, dev01, dl01, obs01,
-                                   sec01, shape01, sound01)
+from jepsen_tpu.lint.rules import (atom01, conc01, conc02, dev01, dl01,
+                                   env01, obs01, race01, res01, sec01,
+                                   shape01, sound01)
 
 
 def run_rule(rule, src, path):
@@ -989,6 +990,418 @@ class TestDl01:
                 frame = {"type": "register", "worker": "w0"}
                 sock.sendall(frame)
             """}, rules=[dl01]) == []
+
+
+# ---------------------------------------------------------------------------
+# the Warden tier: RACE01 / ATOM01 / RES01 over the guarded-by inference
+# ---------------------------------------------------------------------------
+
+class TestRace01:
+    #: one declared lock ('fleet'), one thread seam, one unguarded write
+    UNGUARDED = {"jepsen_tpu/serve/fleet.py": """
+        import threading
+        class Fleet:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.depth = 0
+                threading.Thread(target=self._loop).start()
+            def _loop(self):
+                with self._lock:
+                    self.depth += 1
+            def bump(self):
+                self.depth = 5
+            def view(self):
+                return self.depth
+        """}
+
+    def test_unguarded_write_caught(self):
+        fs = run_interp(self.UNGUARDED, rules=[race01])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "RACE01"
+        assert "Fleet.depth" in f.message
+        assert "no consistent guard" in f.message
+        # both racing sides are named, with their lock state
+        assert "Fleet.bump" in f.message and "no lock" in f.message
+
+    def test_message_is_line_free(self):
+        fs = run_interp(self.UNGUARDED, rules=[race01])
+        assert not re.search(r"\d+:\d+|line \d+", fs[0].message)
+
+    def test_lock_held_through_callee_clean(self):
+        """The MUST-hold entry set inherits the caller's lock: a helper
+        that only ever runs under the lock is guarded, even with no
+        lexical ``with`` of its own."""
+        assert run_interp({"jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.depth = 0
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    with self._lock:
+                        self._bump()
+                def _bump(self):
+                    self.depth += 1
+                def view(self):
+                    with self._lock:
+                        return self.depth
+            """}, rules=[race01]) == []
+
+    def test_safe_publication_exempt(self):
+        """Writes in __init__ before the first thread start are safe
+        publication; a read-only field afterwards needs no lock."""
+        assert run_interp({"jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = "idle"
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    m = self.mode
+                def view(self):
+                    return self.mode
+            """}, rules=[race01]) == []
+
+    def test_post_spawn_init_write_caught(self):
+        """The same write AFTER the thread starts is post-publication
+        and unguarded — the ordering inside __init__ is load-bearing."""
+        fs = run_interp({"jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    threading.Thread(target=self._loop).start()
+                    self.mode = "idle"
+                def _loop(self):
+                    m = self.mode
+            """}, rules=[race01])
+        assert len(fs) == 1
+        assert "Fleet.mode" in fs[0].message
+
+    def test_other_objects_spawn_does_not_publish(self):
+        """A callee spawning threads on a DIFFERENT object (a helper
+        fleet starting its own loops) does not publish this object:
+        writes after such a call are still safe publication."""
+        assert run_interp({
+            "jepsen_tpu/serve/helper.py": """
+                import threading
+                class Helper:
+                    def __init__(self):
+                        threading.Thread(target=self._loop).start()
+                    def _loop(self):
+                        pass
+                """,
+            "jepsen_tpu/serve/fleet.py": """
+                import threading
+                from jepsen_tpu.serve.helper import Helper
+                class Fleet:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.helper = Helper()
+                        self.mode = "idle"
+                        threading.Thread(target=self._loop).start()
+                    def _loop(self):
+                        m = self.mode
+                    def view(self):
+                        return self.mode
+                """}, rules=[race01]) == []
+
+    def test_threadsafe_ctor_attr_exempt(self):
+        """queue.Queue / Event fields are internally synchronized."""
+        assert run_interp({"jepsen_tpu/serve/fleet.py": """
+            import queue
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    self.q.put(1)
+                def push(self):
+                    self.q = queue.Queue()
+            """}, rules=[race01]) == []
+
+    def test_single_root_attr_not_shared(self):
+        """No thread seam, no sharing: a single-threaded class needs no
+        locks at all."""
+        assert run_interp({"jepsen_tpu/serve/fleet.py": """
+            class Fleet:
+                def __init__(self):
+                    self.depth = 0
+                def bump(self):
+                    self.depth += 1
+            """}, rules=[race01]) == []
+
+    def test_pragma_with_reason_suppresses(self):
+        files = dict(self.UNGUARDED)
+        files["jepsen_tpu/serve/fleet.py"] = files[
+            "jepsen_tpu/serve/fleet.py"].replace(
+            "self.depth = 5",
+            "# lint: disable=RACE01(documented tear contract)\n"
+            "        self.depth = 5")
+        assert run_interp(files, rules=[race01]) == []
+
+
+class TestAtom01:
+    CHECK_THEN_ACT = {"jepsen_tpu/serve/fleet.py": """
+        import threading
+        class Fleet:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.depth = 0
+                threading.Thread(target=self._loop).start()
+            def _loop(self):
+                with self._lock:
+                    self.depth += 1
+            def maybe_reset(self):
+                with self._lock:
+                    d = self.depth
+                if d > 10:
+                    with self._lock:
+                        self.depth = 0
+        """}
+
+    def test_check_then_act_caught(self):
+        fs = run_interp(self.CHECK_THEN_ACT, rules=[atom01])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "ATOM01"
+        assert "check-then-act on `self.depth`" in f.message
+        assert "'fleet'" in f.message
+
+    def test_double_checked_reread_clean(self):
+        files = {"jepsen_tpu/serve/fleet.py":
+                 self.CHECK_THEN_ACT["jepsen_tpu/serve/fleet.py"].replace(
+                     "with self._lock:\n                        "
+                     "self.depth = 0",
+                     "with self._lock:\n                        "
+                     "if self.depth > 10:\n"
+                     "                            self.depth = 0")}
+        assert run_interp(files, rules=[atom01]) == []
+
+    def test_check_and_act_in_one_section_clean(self):
+        assert run_interp({"jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.depth = 0
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    with self._lock:
+                        self.depth += 1
+                def maybe_reset(self):
+                    with self._lock:
+                        d = self.depth
+                        if d > 10:
+                            self.depth = 0
+            """}, rules=[atom01]) == []
+
+    def test_act_through_callee_caught(self):
+        """The act side hiding in a helper that may acquire the lock and
+        may write the attr is still a torn decision."""
+        fs = run_interp({"jepsen_tpu/serve/fleet.py": """
+            import threading
+            class Fleet:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.depth = 0
+                    threading.Thread(target=self._loop).start()
+                def _loop(self):
+                    with self._lock:
+                        self.depth += 1
+                def _reset(self):
+                    with self._lock:
+                        self.depth = 0
+                def maybe_reset(self):
+                    with self._lock:
+                        d = self.depth
+                    if d > 10:
+                        self._reset()
+            """}, rules=[atom01])
+        assert len(fs) == 1
+        assert "Fleet._reset" in fs[0].message
+
+
+class TestRes01:
+    REQUEST = {"jepsen_tpu/serve/request.py": """
+        class Request:
+            def __init__(self, h):
+                self.h = h
+            def claim_finish(self):
+                return True
+            def cancel(self):
+                pass
+        """}
+
+    def test_leaked_on_raise_caught(self):
+        files = dict(self.REQUEST)
+        files["jepsen_tpu/serve/service.py"] = """
+            from jepsen_tpu.serve.request import Request
+            def validate(h):
+                if not h:
+                    raise ValueError("empty")
+            def admit(h):
+                req = Request(h)
+                validate(h)
+                req.claim_finish()
+                return req
+            """
+        fs = run_interp(files, rules=[res01])
+        assert len(fs) == 1
+        f = fs[0]
+        assert f.rule == "RES01"
+        assert "`req`" in f.message and "Request" in f.message
+        assert "validate" in f.message
+
+    def test_finally_resolved_clean(self):
+        files = dict(self.REQUEST)
+        files["jepsen_tpu/serve/service.py"] = """
+            from jepsen_tpu.serve.request import Request
+            def validate(h):
+                if not h:
+                    raise ValueError("empty")
+            def admit(h):
+                req = Request(h)
+                try:
+                    validate(h)
+                    req.claim_finish()
+                finally:
+                    req.cancel()
+                return req
+            """
+        assert run_interp(files, rules=[res01]) == []
+
+    def test_hand_off_discharges(self):
+        """Passing the object onward moves ownership: the new owner's
+        discipline applies, this window closes."""
+        files = dict(self.REQUEST)
+        files["jepsen_tpu/serve/service.py"] = """
+            from jepsen_tpu.serve.request import Request
+            def enqueue(req):
+                pass
+            def validate(h):
+                if not h:
+                    raise ValueError("empty")
+            def admit(h):
+                req = Request(h)
+                enqueue(req)
+                validate(h)
+            """
+        assert run_interp(files, rules=[res01]) == []
+
+    def test_subclass_ctor_tracked(self):
+        files = dict(self.REQUEST)
+        files["jepsen_tpu/serve/service.py"] = """
+            from jepsen_tpu.serve.request import Request
+            class WglRequest(Request):
+                pass
+            def validate(h):
+                if not h:
+                    raise ValueError("empty")
+            def admit(h):
+                req = WglRequest(h)
+                validate(h)
+                req.claim_finish()
+            """
+        fs = run_interp(files, rules=[res01])
+        assert len(fs) == 1
+        assert fs[0].rule == "RES01" and "`req`" in fs[0].message
+
+    def test_catch_all_delegating_to_finalizer_clean(self):
+        files = dict(self.REQUEST)
+        files["jepsen_tpu/serve/service.py"] = """
+            from jepsen_tpu.serve.request import Request
+            def validate(h):
+                if not h:
+                    raise ValueError("empty")
+            class Svc:
+                def _finalize_all(self):
+                    pass
+                def admit(self, h):
+                    req = Request(h)
+                    try:
+                        validate(h)
+                        req.claim_finish()
+                    except Exception:
+                        self._finalize_all()
+                        raise
+                    return req
+            """
+        assert run_interp(files, rules=[res01]) == []
+
+
+class TestEnv01:
+    PATH = "jepsen_tpu/serve/fixture.py"
+
+    def test_undocumented_knob_caught(self):
+        fs = run_rule(env01, """
+            import os
+            def knob():
+                return os.environ.get("JTPU_DEFINITELY_NOT_DOCUMENTED")
+            """, self.PATH)
+        assert len(fs) == 1
+        assert fs[0].rule == "ENV01"
+        assert "JTPU_DEFINITELY_NOT_DOCUMENTED" in fs[0].message
+        assert "knob" in fs[0].message
+
+    def test_documented_knob_clean(self):
+        assert run_rule(env01, """
+            import os
+            def knob():
+                return os.environ.get("JTPU_PROBES", "3")
+            """, self.PATH) == []
+
+    def test_placeholder_family_row_matches(self):
+        # JEPSEN_TPU_SLO_<NAME> covers any concrete member
+        assert run_rule(env01, """
+            import os
+            def knob():
+                return os.environ.get("JEPSEN_TPU_SLO_UNKNOWN_RATE")
+            """, self.PATH) == []
+
+    def test_optional_bracket_row_matches_both_forms(self):
+        # JEPSEN_TPU_TENANT_QUOTA[_<NAME>]: bare and suffixed
+        assert run_rule(env01, """
+            import os
+            def knobs():
+                a = os.environ.get("JEPSEN_TPU_TENANT_QUOTA")
+                b = os.environ.get("JEPSEN_TPU_TENANT_QUOTA_ACME")
+                return a, b
+            """, self.PATH) == []
+
+    def test_all_read_forms_seen(self):
+        fs = run_rule(env01, """
+            import os
+            from os import environ, getenv
+            def knobs():
+                a = os.environ["JTPU_NOT_DOCUMENTED_A"]
+                b = os.getenv("JTPU_NOT_DOCUMENTED_B")
+                c = getenv("JTPU_NOT_DOCUMENTED_C")
+                d = "JTPU_NOT_DOCUMENTED_D" in os.environ
+                e = environ.get("JTPU_NOT_DOCUMENTED_E")
+                return a, b, c, d, e
+            """, self.PATH)
+        assert {re.search(r"JTPU_NOT_DOCUMENTED_[A-E]", f.message).group()
+                for f in fs} == {f"JTPU_NOT_DOCUMENTED_{s}"
+                                 for s in "ABCDE"}
+
+    def test_computed_name_out_of_scope(self):
+        assert run_rule(env01, """
+            import os
+            def knob(name):
+                return os.environ.get("JEPSEN_TPU_" + name.upper())
+            """, self.PATH) == []
+
+    def test_non_prefixed_env_ignored(self):
+        assert run_rule(env01, """
+            import os
+            def knob():
+                return os.environ.get("HOME")
+            """, self.PATH) == []
 
 
 class TestSarif:
